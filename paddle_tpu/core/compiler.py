@@ -1,0 +1,62 @@
+"""CompiledProgram / strategies.
+
+Parity: python/paddle/fluid/compiler.py + parallel_executor.py build/exec
+strategies. `with_data_parallel` marks the program for SPMD execution
+over the local device mesh; the ParallelExecutor/Executor then jit with
+batch-sharded in_shardings so XLA inserts the grad all-reduce over ICI
+(replacing the reference's NCCL AllReduce SSA graph pass).
+"""
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+
+class BuildStrategy:
+    """Accepted knobs for API parity; XLA owns fusion/scheduling decisions
+    the reference exposed here (reduce_strategy, memory_optimize...)."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+        self.allow_op_delay = False
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self.program = program_or_graph
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.data_parallel = False
+        self.loss_name = None
+        self.share_vars_from = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self.data_parallel = True
+        self.loss_name = loss_name
+        if build_strategy:
+            self.build_strategy = build_strategy
+        self.share_vars_from = share_vars_from
+        self.places = places
+        return self
+
+    def with_inference_optimize(self, config=None):
+        self.program = self.program.clone(for_test=True)
+        return self

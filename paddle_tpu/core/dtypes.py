@@ -1,0 +1,50 @@
+"""Dtype normalization between Fluid-style strings and JAX/numpy dtypes.
+
+Parity: paddle/fluid/framework/data_type.h — the reference enumerates
+VarType dtypes; here everything maps onto numpy/jnp dtypes, with bfloat16
+first-class (TPU native precision) instead of float16.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+_ALIASES = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (string / np.dtype / jnp dtype) to a canonical string."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise TypeError(f"unsupported dtype string: {dtype}")
+        return dtype
+    dt = np.dtype(dtype) if not hasattr(dtype, "name") else dtype
+    name = getattr(dt, "name", str(dt))
+    if name == "bool_":
+        name = "bool"
+    if name not in _ALIASES:
+        raise TypeError(f"unsupported dtype: {dtype}")
+    return name
+
+
+def as_jnp_dtype(dtype):
+    return _ALIASES[convert_dtype(dtype)]
+
+
+def is_float(dtype):
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(dtype):
+    return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")

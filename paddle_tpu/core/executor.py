@@ -1,0 +1,108 @@
+"""Executor: compiles a Program into an XLA module and runs it.
+
+Parity: python/paddle/fluid/executor.py + paddle/fluid/framework/executor.cc.
+API-compatible `Executor(place).run(program, feed=..., fetch_list=...)`,
+but execution is whole-program: the op list is traced once per
+(program-version, feed-signature, fetch-set, mode) into a jitted step
+function with persistable buffers DONATED — param/optimizer-state updates
+happen in-place in HBM, and one compiled module per step replaces per-op
+kernel launches (BASELINE.json north-star).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .framework import default_main_program, Program
+from .place import core_place_of
+from .scope import global_scope
+from .trace import build_step_fn
+from .dtypes import as_jnp_dtype
+
+__all__ = ["Executor"]
+
+
+def _feed_signature(feed):
+    return tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype) if not hasattr(v, "dtype") else str(v.dtype))
+                        for k, v in feed.items()))
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = core_place_of(place)
+        self._cache = {}
+        self._step = 0
+        self._seed = 0
+        self.check_nan_inf = False   # failure-detection flag (SURVEY §2.8)
+
+    def close(self):
+        self._cache.clear()
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True, is_test=None):
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if hasattr(f, "name") else f for f in fetch_list]
+        if is_test is None:
+            is_test = getattr(program, "_is_test", False)
+
+        seed = program.random_seed if program.random_seed else self._seed
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        self._step += 1
+
+        dev = self.place.jax_device()
+        feed_arrays = {}
+        for k, v in feed.items():
+            var = program.global_block().vars.get(k)
+            dt = as_jnp_dtype(var.dtype) if var is not None else None
+            arr = jax.device_put(jnp.asarray(np.asarray(v), dtype=dt), dev)
+            feed_arrays[k] = arr
+
+        persist_vars = program.persistable_vars()
+        persist = {}
+        missing = []
+        for v in persist_vars:
+            val = scope.get(v.name)
+            if val is None:
+                missing.append(v.name)
+            else:
+                persist[v.name] = val
+        if missing:
+            # vars this program itself produces (startup program case) are fine
+            produced = {n for op in program.global_block().ops for n in op.output_names()}
+            hard_missing = [n for n in missing if n not in produced]
+            if hard_missing:
+                raise RuntimeError(
+                    f"persistable vars not initialized: {hard_missing[:5]} "
+                    f"(+{max(0, len(hard_missing)-5)} more); run the startup program first")
+
+        ckey = (id(program), program._version, _feed_signature(feed_arrays),
+                tuple(fetch_names), bool(is_test))
+        fn = self._cache.get(ckey) if use_program_cache else None
+        if fn is None:
+            step_fn = build_step_fn(program, fetch_names, is_test, self.place)
+            fn = jax.jit(step_fn, donate_argnums=(0,))
+            if use_program_cache:
+                self._cache[ckey] = fn
+
+        fetches, new_persist = fn(persist, feed_arrays, key)
+        for name, val in new_persist.items():
+            scope.set(name, val)
+
+        if self.check_nan_inf and fetches:
+            for name, val in zip(fetch_names, fetches):
+                arr = np.asarray(val)
+                if np.issubdtype(arr.dtype, np.floating) and not np.all(np.isfinite(arr)):
+                    raise FloatingPointError(f"NaN/Inf detected in fetched var {name!r}")
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    # convenience used by tests/tools
+    def run_startup(self, startup_program=None, scope=None):
+        from .framework import default_startup_program
+        return self.run(startup_program or default_startup_program(),
+                        feed={}, fetch_list=[], scope=scope)

@@ -1,0 +1,109 @@
+"""Scope: name → device-resident array store.
+
+Parity: paddle/fluid/framework/scope.{h,cc}. The reference's Scope owns
+LoDTensors on CUDA/CPU; here values are jax.Arrays living in HBM via PJRT.
+The Executor reads persistable vars from the scope before a step and
+writes updated ones back after (buffer donation makes this in-place on
+device — the allocator story is PJRT's, per SURVEY §6).
+"""
+import numpy as np
+import jax
+
+__all__ = ["Scope", "global_scope", "scope_guard"]
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self.kids = []
+
+    def var(self, name):
+        """Find-or-create slot (returns a _VarHandle for set/get)."""
+        return _VarHandle(self, name)
+
+    def find_var(self, name):
+        if name in self._vars:
+            return _VarHandle(self, name)
+        if self.parent is not None:
+            return self.parent.find_var(name)
+        return None
+
+    def new_scope(self):
+        k = Scope(self)
+        self.kids.append(k)
+        return k
+
+    # dict-like access used throughout the framework
+    def get(self, name, default=None):
+        if name in self._vars:
+            return self._vars[name]
+        if self.parent is not None:
+            return self.parent.get(name, default)
+        return default
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def __contains__(self, name):
+        return name in self._vars or (self.parent is not None and name in self.parent)
+
+    def keys(self):
+        ks = set(self._vars)
+        if self.parent is not None:
+            ks |= set(self.parent.keys())
+        return ks
+
+    def delete(self, name):
+        self._vars.pop(name, None)
+
+    def drop_kids(self):
+        self.kids = []
+
+    def memory_stats(self):
+        """Live-buffer accounting (ref memory/ allocator stats analog)."""
+        total = 0
+        per_var = {}
+        for k, v in self._vars.items():
+            nb = int(np.prod(v.shape)) * v.dtype.itemsize if hasattr(v, "dtype") else 0
+            per_var[k] = nb
+            total += nb
+        return {"total_bytes": total, "vars": per_var}
+
+
+class _VarHandle:
+    def __init__(self, scope, name):
+        self.scope = scope
+        self.name = name
+
+    def get_tensor(self):
+        return self.scope.get(self.name)
+
+    def set_tensor(self, value, place=None):
+        arr = value
+        if isinstance(value, (np.ndarray, list, tuple, int, float)):
+            arr = np.asarray(value)
+        if place is not None:
+            arr = jax.device_put(arr, place.jax_device())
+        self.scope.set(self.name, arr)
+        return arr
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev

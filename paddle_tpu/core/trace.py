@@ -1,0 +1,183 @@
+"""Program → pure JAX function tracer.
+
+This is the TPU-native replacement for the reference's op-by-op executor
+(paddle/fluid/framework/executor.cc): instead of dispatching one kernel
+per op per step, the whole op list is traced into ONE pure function
+
+    step(persist: dict, feed: dict, key) -> (fetches: list, new_persist: dict)
+
+which the Executor jits — XLA sees the entire step (forward, backward,
+optimizer update) as a single module and can fuse/layout/overlap freely.
+
+The `backward_macro` op (appended by core/backward.py:append_backward) is
+handled here: the forward segment is replayed inside jax.value_and_grad
+(has_aux carries the full env so intermediate vars stay fetchable and
+batch-norm stat updates survive), replacing the reference's symbolic
+per-op grad ops (python/paddle/fluid/backward.py).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import get_kernel, KernelCtx
+from .framework import grad_var_name
+from .dtypes import is_float
+
+__all__ = ["build_step_fn", "exec_op"]
+
+
+def _replay_block(program, blk, env, base_key, is_test, place):
+    """Execute a sub-block's ops against env (used by control-flow ops)."""
+    for j, op in enumerate(blk.ops):
+        exec_op(env, op, blk.idx * 100000 + j, base_key, is_test, place, blk,
+                program=program)
+
+
+def _exec_control_flow(env, op, base_key, is_test, place, program):
+    import jax as _jax
+    attrs = op.attrs
+    if op.type == "cond":
+        pred = env[op.inputs["Cond"][0]]
+        tb = program.blocks[attrs["true_block"]]
+        fb = program.blocks[attrs["false_block"]]
+
+        def branch(blk, out_names):
+            def f(_):
+                e = dict(env)
+                _replay_block(program, blk, e, base_key, is_test, place)
+                return tuple(e[n] for n in out_names)
+            return f
+
+        pred_scalar = jnp.reshape(pred, ()).astype(bool)
+        res = _jax.lax.cond(pred_scalar,
+                            branch(tb, attrs["true_outs"]),
+                            branch(fb, attrs["false_outs"]), None)
+        for n, v in zip(op.outputs["Out"], res):
+            env[n] = v
+        return
+    if op.type == "while_loop":
+        carry_names = attrs["carry_names"]
+        cb = program.blocks[attrs["cond_block"]]
+        bb = program.blocks[attrs["body_block"]]
+
+        def cond_f(carry):
+            e = dict(env)
+            e.update(dict(zip(carry_names, carry)))
+            _replay_block(program, cb, e, base_key, is_test, place)
+            return jnp.reshape(e[attrs["cond_out"]], ()).astype(bool)
+
+        def body_f(carry):
+            e = dict(env)
+            e.update(dict(zip(carry_names, carry)))
+            _replay_block(program, bb, e, base_key, is_test, place)
+            return tuple(e[n] for n in attrs["body_outs"])
+
+        init = tuple(env[n] for n in carry_names)
+        res = _jax.lax.while_loop(cond_f, body_f, init)
+        for n, v in zip(op.outputs["Out"], res):
+            env[n] = v
+        return
+    if op.type == "scan":
+        bb = program.blocks[attrs["body_block"]]
+
+        def body_f(carry, x):
+            e = dict(env)
+            e[attrs["init_name"]] = carry
+            e[attrs["x_name"]] = x
+            _replay_block(program, bb, e, base_key, is_test, place)
+            return e[attrs["carry_out"]], e[attrs["y_out"]]
+
+        carry, ys = _jax.lax.scan(body_f, env[op.inputs["Init"][0]],
+                                  env[op.inputs["Xs"][0]])
+        env[op.outputs["CarryOut"][0]] = carry
+        env[op.outputs["Ys"][0]] = ys
+        return
+    raise NotImplementedError(op.type)
+
+
+def exec_op(env, op, op_idx, base_key, is_test, place, block, program=None):
+    """Execute one op against env (name → array)."""
+    if op.type in ("cond", "while_loop", "scan"):
+        prog = program if program is not None else block.program
+        _exec_control_flow(env, op, base_key, is_test, place, prog)
+        return
+    kern = get_kernel(op.type)
+    ins = {}
+    for slot, names in op.inputs.items():
+        if not names:
+            continue
+        vals = []
+        for n in names:
+            if n not in env:
+                raise KeyError(
+                    f"op {op.type!r} input {slot}:{n!r} not materialized; "
+                    f"did you run the startup program / feed it?")
+            vals.append(env[n])
+        ins[slot] = vals
+    key = jax.random.fold_in(base_key, op_idx) if base_key is not None else None
+    ctx = KernelCtx(key=key, is_test=is_test, place=place)
+    attrs = dict(op.attrs)
+    attrs.setdefault("_op_type", op.type)
+    outs = kern(ctx, ins, attrs)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for n, v in zip(names, vals):
+            var = block.vars.get(n)
+            if var is not None and var.stop_gradient and is_float(str(v.dtype)) \
+                    and not var.persistable:
+                v = jax.lax.stop_gradient(v)
+            env[n] = v
+
+
+def _find_backward(ops):
+    idxs = [i for i, op in enumerate(ops) if op.type == "backward_macro"]
+    if not idxs:
+        return None
+    if len(idxs) > 1:
+        raise NotImplementedError("multiple backward sections in one program")
+    return idxs[0]
+
+
+def build_step_fn(program, fetch_names, is_test, place):
+    """Returns step(persist, feed, key) -> (fetches, new_persist).
+
+    Pure and jittable; the op list/attrs are closed over (static)."""
+    block = program.global_block()
+    ops = list(block.ops)
+    persist_names = [v.name for v in program.persistable_vars()]
+    bi = _find_backward(ops)
+
+    def step(persist, feed, key):
+        env = {}
+        env.update(feed)
+        env.update(persist)
+        if bi is None:
+            for i, op in enumerate(ops):
+                exec_op(env, op, i, key, is_test, place, block)
+        else:
+            bop = ops[bi]
+            pnames = bop.attrs["param_names"]
+            loss_name = bop.attrs["loss_name"]
+            base_env = dict(env)
+
+            def fwd(pvals):
+                e = dict(base_env)
+                e.update(pvals)
+                for i, op in enumerate(ops[:bi]):
+                    exec_op(e, op, i, key, is_test, place, block)
+                loss = e[loss_name]
+                return jnp.sum(loss.astype(jnp.float32)), e
+
+            pvals = {n: env[n] for n in pnames}
+            (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(pvals)
+            for n in pnames:
+                env[grad_var_name(n)] = grads[n].astype(env[n].dtype) \
+                    if hasattr(grads[n], "astype") else grads[n]
+            for i, op in enumerate(ops[bi + 1:], start=bi + 1):
+                exec_op(env, op, i, key, is_test, place, block)
+        new_persist = {n: env[n] for n in persist_names if n in env}
+        fetches = [env[n] for n in fetch_names]
+        return fetches, new_persist
+
+    return step

@@ -1,0 +1,43 @@
+"""DataFeeder — minibatch lists → feed dict of device-ready arrays.
+
+Parity: python/paddle/fluid/data_feeder.py. Ragged (lod_level>0) slots are
+padded + get a companion `<name>_seq_len` entry (see lod.py), replacing
+the reference's LoDTensor construction.
+"""
+import numpy as np
+
+from .core.dtypes import as_jnp_dtype
+from .lod import to_padded
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = feed_list
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of samples, each a tuple matching feed_list."""
+        samples = list(iterable)
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            name = var.name if hasattr(var, "name") else var
+            column = [s[i] for s in samples]
+            lod_level = getattr(var, "lod_level", 0)
+            if lod_level and lod_level > 0:
+                padded, lens = to_padded(column)
+                dt = np.dtype(str(np.asarray(padded).dtype))
+                out[name] = padded
+                out[name + "_seq_len"] = lens
+            else:
+                arr = np.asarray(column)
+                if hasattr(var, "dtype"):
+                    arr = arr.astype(as_jnp_dtype(var.dtype))
+                # honor declared trailing shape (e.g. label [-1, 1])
+                if hasattr(var, "shape") and var.shape:
+                    want = [s for s in var.shape]
+                    if (len(want) == arr.ndim + 1 and want[-1] == 1):
+                        arr = arr[..., None]
+                out[name] = arr
+        return out

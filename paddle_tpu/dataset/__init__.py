@@ -1,0 +1,17 @@
+"""Datasets.
+
+Parity: python/paddle/dataset/* (mnist, cifar, uci_housing, imdb,
+imikolov, movielens, wmt16). This environment has zero egress, so each
+dataset uses a real on-disk cache when present and otherwise falls back
+to a DETERMINISTIC synthetic generator with the exact same sample
+schema/shapes as the reference loader — models and tests exercise the
+same code paths either way.
+"""
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import wmt16
+from . import common

@@ -1,0 +1,67 @@
+"""CIFAR-10/100 dataset (ref python/paddle/dataset/cifar.py).
+
+Samples: (image float32[3072] in [0,1], label int64). Synthetic fallback:
+class-colored noise images (each class biases one color channel pattern).
+"""
+import os
+import pickle
+import tarfile
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+_IMG = 3 * 32 * 32
+
+
+def _synthetic(n, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    proto_rng = np.random.RandomState(42)
+    protos = proto_rng.uniform(0, 1, size=(num_classes, _IMG)).astype("float32")
+
+    def reader():
+        for i in range(n):
+            label = i % num_classes
+            img = 0.7 * protos[label] + 0.3 * rng.rand(_IMG).astype("float32")
+            yield img.astype("float32"), int(label)
+    return reader
+
+
+def _tar_reader(path, key, sub):
+    def reader():
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if sub in m.name:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    for img, lbl in zip(d[b"data"], d[key]):
+                        yield (img.astype("float32") / 255.0), int(lbl)
+    return reader
+
+
+def train10(n_synthetic=2048):
+    p = common.data_path("cifar", "cifar-10-python.tar.gz")
+    if os.path.exists(p):
+        return _tar_reader(p, b"labels", "data_batch")
+    return _synthetic(n_synthetic, 10, seed=0)
+
+
+def test10(n_synthetic=512):
+    p = common.data_path("cifar", "cifar-10-python.tar.gz")
+    if os.path.exists(p):
+        return _tar_reader(p, b"labels", "test_batch")
+    return _synthetic(n_synthetic, 10, seed=1)
+
+
+def train100(n_synthetic=2048):
+    p = common.data_path("cifar", "cifar-100-python.tar.gz")
+    if os.path.exists(p):
+        return _tar_reader(p, b"fine_labels", "train")
+    return _synthetic(n_synthetic, 100, seed=0)
+
+
+def test100(n_synthetic=512):
+    p = common.data_path("cifar", "cifar-100-python.tar.gz")
+    if os.path.exists(p):
+        return _tar_reader(p, b"fine_labels", "test")
+    return _synthetic(n_synthetic, 100, seed=1)

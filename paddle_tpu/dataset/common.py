@@ -1,0 +1,23 @@
+"""Shared dataset utilities (ref python/paddle/dataset/common.py)."""
+import os
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def data_path(*parts):
+    return os.path.join(DATA_HOME, *parts)
+
+
+def cached_exists(*parts):
+    return os.path.exists(data_path(*parts))
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    """Zero-egress environment: only returns an existing cache path."""
+    path = data_path(module_name, save_name or os.path.basename(url))
+    if os.path.exists(path):
+        return path
+    raise IOError(
+        f"dataset file {path} not present and downloads are disabled; "
+        f"synthetic fallback should have been used")

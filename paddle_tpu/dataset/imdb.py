@@ -1,0 +1,38 @@
+"""IMDB sentiment dataset (ref python/paddle/dataset/imdb.py).
+
+Samples: (word-id list, label 0/1). Synthetic fallback: two vocab
+distributions (positive ids skew low, negative skew high) so sentiment
+models can actually learn.
+"""
+import numpy as np
+
+__all__ = ["train", "test", "word_dict"]
+
+_VOCAB = 5147  # matches ref default vocab cutoff order of magnitude
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for i in range(n):
+            label = i % 2
+            length = int(rng.randint(20, 120))
+            if label == 1:
+                ids = rng.zipf(1.7, size=length) % (_VOCAB // 2)
+            else:
+                ids = _VOCAB // 2 + (rng.zipf(1.7, size=length) % (_VOCAB // 2))
+            yield ids.astype("int64").tolist(), int(label)
+    return reader
+
+
+def train(word_idx=None, n_synthetic=1024):
+    return _synthetic(n_synthetic, seed=0)
+
+
+def test(word_idx=None, n_synthetic=256):
+    return _synthetic(n_synthetic, seed=1)

@@ -1,0 +1,36 @@
+"""PTB-style LM dataset (ref python/paddle/dataset/imikolov.py).
+
+Samples: n-gram tuples of word ids. Synthetic fallback: a Markov chain
+with deterministic transition structure (learnable next-word signal).
+"""
+import numpy as np
+
+__all__ = ["train", "test", "build_dict"]
+
+_VOCAB = 2048
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synthetic(n, window, seed):
+    rng = np.random.RandomState(seed)
+    # deterministic "grammar": next ~ (3*cur + noise) mod V
+    def reader():
+        cur = 1
+        for _ in range(n):
+            seq = []
+            for _ in range(window):
+                seq.append(cur)
+                cur = (3 * cur + int(rng.randint(0, 5))) % _VOCAB
+            yield tuple(np.asarray(seq, dtype="int64"))
+    return reader
+
+
+def train(word_idx=None, n=5, n_synthetic=2048):
+    return _synthetic(n_synthetic, n, seed=0)
+
+
+def test(word_idx=None, n=5, n_synthetic=512):
+    return _synthetic(n_synthetic, n, seed=1)

@@ -1,0 +1,63 @@
+"""MNIST dataset (ref python/paddle/dataset/mnist.py).
+
+Samples: (image float32[784] scaled to [-1,1], label int64). Falls back
+to a deterministic synthetic digit generator (class-dependent blob
+patterns — linearly separable enough for convergence tests) when the
+real IDX files are not cached locally.
+"""
+import gzip
+import os
+import struct
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+_IMG = 784
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    protos = rng.RandomState if False else None
+    # 10 fixed class prototypes; samples = prototype + noise
+    proto_rng = np.random.RandomState(1234)
+    prototypes = proto_rng.uniform(-1, 1, size=(10, _IMG)).astype("float32")
+
+    def reader():
+        for i in range(n):
+            label = i % 10
+            img = prototypes[label] + 0.3 * rng.randn(_IMG).astype("float32")
+            yield np.clip(img, -1, 1).astype("float32"), int(label)
+    return reader
+
+
+def _idx_reader(img_path, lbl_path):
+    def reader():
+        with gzip.open(img_path, "rb") as fi, gzip.open(lbl_path, "rb") as fl:
+            fi.read(16)
+            fl.read(8)
+            while True:
+                lbl = fl.read(1)
+                if not lbl:
+                    break
+                img = np.frombuffer(fi.read(_IMG), dtype=np.uint8)
+                img = img.astype("float32") / 127.5 - 1.0
+                yield img, int(lbl[0])
+    return reader
+
+
+def train(n_synthetic=2048):
+    ip = common.data_path("mnist", "train-images-idx3-ubyte.gz")
+    lp = common.data_path("mnist", "train-labels-idx1-ubyte.gz")
+    if os.path.exists(ip) and os.path.exists(lp):
+        return _idx_reader(ip, lp)
+    return _synthetic(n_synthetic, seed=0)
+
+
+def test(n_synthetic=512):
+    ip = common.data_path("mnist", "t10k-images-idx3-ubyte.gz")
+    lp = common.data_path("mnist", "t10k-labels-idx1-ubyte.gz")
+    if os.path.exists(ip) and os.path.exists(lp):
+        return _idx_reader(ip, lp)
+    return _synthetic(n_synthetic, seed=1)

@@ -1,0 +1,49 @@
+"""MovieLens CTR dataset (ref python/paddle/dataset/movielens.py).
+
+Samples: (user_id, gender, age, job, movie_id, category, score). The
+synthetic fallback generates preference structure (score correlates with
+user/movie id buckets) so ranking models can learn.
+"""
+import numpy as np
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
+           "age_table"]
+
+_USERS, _MOVIES, _JOBS = 6040, 3952, 21
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _USERS
+
+
+def max_movie_id():
+    return _MOVIES
+
+
+def max_job_id():
+    return _JOBS - 1
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            u = int(rng.randint(1, _USERS + 1))
+            m = int(rng.randint(1, _MOVIES + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, len(age_table)))
+            job = int(rng.randint(0, _JOBS))
+            base = 1 + ((u * 7 + m * 13) % 9) / 2.0
+            score = float(np.clip(base + 0.3 * rng.randn(), 1, 5))
+            yield [u], [gender], [age], [job], [m], [score]
+    return reader
+
+
+def train(n_synthetic=2048):
+    return _synthetic(n_synthetic, seed=0)
+
+
+def test(n_synthetic=512):
+    return _synthetic(n_synthetic, seed=1)

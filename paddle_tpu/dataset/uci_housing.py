@@ -1,0 +1,53 @@
+"""UCI housing regression dataset (ref python/paddle/dataset/uci_housing.py).
+
+Samples: (features float32[13], target float32[1]). Synthetic fallback is
+a fixed linear model + noise so linear-regression convergence tests have
+a recoverable signal.
+"""
+import os
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+_DIM = 13
+_W = np.linspace(-1.5, 2.0, _DIM).astype("float32")
+_B = 0.7
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            x = rng.uniform(-1, 1, _DIM).astype("float32")
+            y = float(x @ _W + _B + 0.05 * rng.randn())
+            yield x, np.array([y], dtype="float32")
+    return reader
+
+
+def _file_reader(path, start, end):
+    data = np.loadtxt(path)
+    mx, mn = data[:, :-1].max(0), data[:, :-1].min(0)
+    feats = (data[:, :-1] - mn) / np.maximum(mx - mn, 1e-6)
+
+    def reader():
+        for i in range(start, min(end, len(data))):
+            yield feats[i].astype("float32"), \
+                np.array([data[i, -1]], dtype="float32")
+    return reader
+
+
+def train(n_synthetic=1024):
+    p = common.data_path("uci_housing", "housing.data")
+    if os.path.exists(p):
+        return _file_reader(p, 0, 404)
+    return _synthetic(n_synthetic, seed=0)
+
+
+def test(n_synthetic=256):
+    p = common.data_path("uci_housing", "housing.data")
+    if os.path.exists(p):
+        return _file_reader(p, 404, 506)
+    return _synthetic(n_synthetic, seed=1)

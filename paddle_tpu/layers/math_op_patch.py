@@ -1,0 +1,70 @@
+"""Operator overloading for Variables.
+
+Parity: python/paddle/fluid/layers/math_op_patch.py — patches __add__ etc.
+onto Variable so `a + b`, `a * 2`, `a < b` append elementwise ops.
+"""
+from ..core.framework import Variable
+from ..layer_helper import LayerHelper
+
+_patched = False
+
+
+def _scalar_to_var(value, ref):
+    from . import tensor
+    shape = [1]
+    return tensor.fill_constant(shape, ref.dtype, float(value))
+
+
+def _binary(op_type, reverse=False):
+    def impl(self, other):
+        from . import nn
+        if isinstance(other, (int, float)):
+            if op_type == "elementwise_add":
+                return nn.scale(self, 1.0, bias=float(other))
+            if op_type == "elementwise_sub" and not reverse:
+                return nn.scale(self, 1.0, bias=-float(other))
+            if op_type == "elementwise_mul":
+                return nn.scale(self, float(other))
+            other = _scalar_to_var(other, self)
+        x, y = (other, self) if reverse else (self, other)
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference(
+            x.dtype, x.shape if len(x.shape) >= len(y.shape) else y.shape)
+        helper.append_op(op_type, {"X": [x], "Y": [y]}, {"Out": [out]},
+                         {"axis": -1})
+        return out
+    return impl
+
+
+def _cmp(op_type):
+    def impl(self, other):
+        if isinstance(other, (int, float)):
+            other = _scalar_to_var(other, self)
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference("bool", self.shape, True)
+        helper.append_op(op_type, {"X": [self], "Y": [other]}, {"Out": [out]}, {})
+        return out
+    return impl
+
+
+def monkey_patch_variable():
+    global _patched
+    if _patched:
+        return
+    _patched = True
+    Variable.__add__ = _binary("elementwise_add")
+    Variable.__radd__ = _binary("elementwise_add")
+    Variable.__sub__ = _binary("elementwise_sub")
+    Variable.__rsub__ = _binary("elementwise_sub", reverse=True)
+    Variable.__mul__ = _binary("elementwise_mul")
+    Variable.__rmul__ = _binary("elementwise_mul")
+    Variable.__truediv__ = _binary("elementwise_div")
+    Variable.__rtruediv__ = _binary("elementwise_div", reverse=True)
+    Variable.__pow__ = _binary("elementwise_pow")
+    Variable.__mod__ = _binary("elementwise_mod")
+    Variable.__neg__ = lambda self: __import__(
+        "paddle_tpu.layers.nn", fromlist=["scale"]).scale(self, -1.0)
+    Variable.__lt__ = _cmp("less_than")
+    Variable.__le__ = _cmp("less_equal")
+    Variable.__gt__ = _cmp("greater_than")
+    Variable.__ge__ = _cmp("greater_equal")

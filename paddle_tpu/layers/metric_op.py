@@ -1,0 +1,49 @@
+"""In-graph metric layers.
+
+Parity: python/paddle/fluid/layers/metric_op.py (accuracy, auc).
+"""
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(input.shape[:-1]) + (k,), True)
+    topk_idx = helper.create_variable_for_type_inference(
+        "int64", tuple(input.shape[:-1]) + (k,), True)
+    helper.append_op("top_k", {"X": [input]},
+                     {"Out": [topk_out], "Indices": [topk_idx]}, {"k": k})
+    acc = helper.create_variable_for_type_inference("float32", (), True)
+    correct = correct or helper.create_variable_for_type_inference(
+        "int32", (), True)
+    total = total or helper.create_variable_for_type_inference(
+        "int32", (), True)
+    helper.append_op("accuracy",
+                     {"Out": [input], "Indices": [topk_idx], "Label": [label]},
+                     {"Accuracy": [acc], "Correct": [correct],
+                      "Total": [total]}, {"k": k})
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Streaming AUC with persistable histogram state (ref metric_op.py:auc)."""
+    helper = LayerHelper("auc")
+    buckets = num_thresholds + 1
+    stat_pos = helper.create_global_variable([buckets], "float32",
+                                             persistable=True)
+    stat_neg = helper.create_global_variable([buckets], "float32",
+                                             persistable=True)
+    helper.set_variable_initializer(stat_pos, ConstantInitializer(0.0))
+    helper.set_variable_initializer(stat_neg, ConstantInitializer(0.0))
+    auc_out = helper.create_variable_for_type_inference("float32", (), True)
+    helper.append_op(
+        "auc",
+        {"Predict": [input], "Label": [label],
+         "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        {"AUC": [auc_out], "StatPosOut": [stat_pos], "StatNegOut": [stat_neg]},
+        {"num_thresholds": num_thresholds})
+    return auc_out, auc_out, [stat_pos, stat_neg]

@@ -1,0 +1,31 @@
+"""Generated activation layers.
+
+Parity: python/paddle/fluid/layers/ops.py — one thin layer function per
+registered activation op (the ref generates these from OpProtos).
+"""
+from ..layer_helper import LayerHelper
+
+_UNARY = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "sqrt", "rsqrt",
+    "abs", "ceil", "floor", "cos", "sin", "tan", "acos", "asin", "atan",
+    "sinh", "cosh", "round", "reciprocal", "square", "softplus", "softsign",
+    "log", "log1p", "relu", "gelu", "elu", "selu", "erf", "sign", "silu",
+    "mish",
+]
+
+__all__ = list(_UNARY)
+
+
+def _make(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+        helper.append_op(op_type, {"X": [x]}, {"Out": [out]}, {})
+        return out
+    layer.__name__ = op_type
+    layer.__doc__ = f"{op_type} activation (ref layers/ops.py:{op_type})"
+    return layer
+
+
+for _t in _UNARY:
+    globals()[_t] = _make(_t)

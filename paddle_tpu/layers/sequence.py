@@ -1,0 +1,96 @@
+"""Sequence layers — LoD ops over padded arrays + explicit lengths.
+
+Parity: python/paddle/fluid/operators/sequence_ops/* and the sequence
+functions in layers/nn.py. The reference encodes variable-length batches
+as LoDTensors; XLA needs static shapes, so every sequence layer here
+takes (data [B,T,...], seq_len [B]) — see lod.py for converters. This is
+the design swap documented in SURVEY §6.
+"""
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_concat",
+    "sequence_reverse", "sequence_mask", "sequence_last_step",
+    "sequence_first_step", "sequence_pad",
+]
+
+
+def sequence_pool(input, pool_type, seq_len=None, is_test=False):
+    helper = LayerHelper("sequence_pool")
+    if seq_len is None:
+        raise ValueError(
+            "sequence_pool requires seq_len (padded-array LoD convention; "
+            "see paddle_tpu.lod.to_padded)")
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0],) + tuple(input.shape[2:]))
+    helper.append_op("sequence_pool", {"X": [input], "SeqLen": [seq_len]},
+                     {"Out": [out]}, {"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "last", seq_len)
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "first", seq_len)
+
+
+def sequence_softmax(input, seq_len=None, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    if seq_len is None:
+        raise ValueError("sequence_softmax requires seq_len")
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("sequence_softmax", {"X": [input], "SeqLen": [seq_len]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out_shape = (x.shape[0], y.shape[1]) + tuple(x.shape[2:]) \
+        if len(x.shape) != len(y.shape) else tuple(y.shape[:2]) + tuple(x.shape[2:])
+    out = helper.create_variable_for_type_inference(x.dtype, out_shape)
+    helper.append_op("sequence_expand", {"X": [x], "Y": [y]}, {"Out": [out]},
+                     {"ref_level": ref_level})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    xs = list(input)
+    t = sum(x.shape[1] for x in xs) if all(x.shape[1] > 0 for x in xs) else -1
+    out = helper.create_variable_for_type_inference(
+        xs[0].dtype, (xs[0].shape[0], t) + tuple(xs[0].shape[2:]))
+    helper.append_op("sequence_concat", {"X": xs}, {"Out": [out]}, {})
+    return out
+
+
+def sequence_reverse(x, seq_len=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    if seq_len is None:
+        raise ValueError("sequence_reverse requires seq_len")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("sequence_reverse", {"X": [x], "SeqLen": [seq_len]},
+                     {"Y": [out]}, {})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    if maxlen is None or maxlen <= 0:
+        raise ValueError("sequence_mask requires a static maxlen on TPU")
+    out = helper.create_variable_for_type_inference(
+        dtype, (x.shape[0], maxlen), True)
+    helper.append_op("sequence_mask", {"X": [x]}, {"Y": [out]},
+                     {"maxlen": maxlen, "out_dtype": dtype})
+    return out
+
+
+def sequence_pad(x, pad_value=None, maxlen=None, seq_len=None, name=None):
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    length = helper.create_variable_for_type_inference("int64", (x.shape[0],), True)
+    helper.append_op("sequence_pad", {"X": [x], "SeqLen": [seq_len]},
+                     {"Out": [out], "Length": [length]}, {})
+    return out, length

@@ -1,0 +1,199 @@
+"""Tensor-creation layers.
+
+Parity: python/paddle/fluid/layers/tensor.py (create_tensor, fill_constant,
+concat, cast, assign, argmax/argsort live in nn here as in ref split).
+"""
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..core.framework import default_main_program
+from ..core.dtypes import convert_dtype
+
+__all__ = [
+    "create_tensor", "create_parameter", "create_global_var", "concat",
+    "assign", "fill_constant", "fill_constant_batch_size_like",
+    "ones", "zeros", "ones_like", "zeros_like", "reverse", "linspace",
+    "range", "shape", "increment", "uniform_random", "gaussian_random",
+    "sums",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.block.create_var(name=name, dtype=dtype,
+                                   persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    helper = LayerHelper("create_parameter", name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..initializer import ConstantInitializer
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(shape, dtype, persistable=persistable,
+                                        name=name)
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    xs = list(input)
+    shape = list(xs[0].shape)
+    ax = axis % len(shape)
+    tot = 0
+    for x in xs:
+        if x.shape[ax] < 0:
+            tot = -1
+            break
+        tot += x.shape[ax]
+    shape[ax] = tot
+    out = helper.create_variable_for_type_inference(xs[0].dtype, tuple(shape))
+    helper.append_op("concat", {"X": xs}, {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sums")
+    xs = list(input)
+    if out is None:
+        out = helper.create_variable_for_type_inference(xs[0].dtype, xs[0].shape)
+    helper.append_op("sum", {"X": xs}, {"Out": [out]}, {})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, np.ndarray):
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                str(input.dtype), input.shape)
+        helper.append_op("assign_value", {}, {"Out": [output]},
+                         {"shape": list(input.shape), "dtype": str(input.dtype),
+                          "values": input.reshape(-1).tolist()})
+        return output
+    if output is None:
+        output = helper.create_variable_for_type_inference(input.dtype,
+                                                           input.shape)
+    helper.append_op("assign", {"X": [input]}, {"Out": [output]}, {})
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    dtype = convert_dtype(dtype)
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype, tuple(shape), True)
+    helper.append_op("fill_constant", {}, {"Out": [out]},
+                     {"shape": [int(s) for s in shape], "dtype": dtype,
+                      "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    shape2 = list(shape)
+    shape2[output_dim_idx] = input.shape[input_dim_idx]
+    out = helper.create_variable_for_type_inference(
+        convert_dtype(dtype), tuple(shape2), True)
+    helper.append_op("fill_constant_batch_size_like", {"Input": [input]},
+                     {"Out": [out]},
+                     {"shape": [int(s) for s in shape],
+                      "dtype": convert_dtype(dtype), "value": float(value),
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("ones_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape, True)
+    helper.append_op("fill_any_like", {"X": [x]}, {"Out": [out]},
+                     {"value": 1.0})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape, True)
+    helper.append_op("fill_zeros_like", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("reverse", {"X": [x]}, {"Out": [out]},
+                     {"axis": [axis] if isinstance(axis, int) else list(axis)})
+    return out
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(dtype, (num,), True)
+    helper.append_op("linspace", {}, {"Out": [out]},
+                     {"start": float(start), "stop": float(stop),
+                      "num": int(num), "dtype": dtype})
+    return out
+
+
+def range(start, end, step, dtype="float32"):
+    helper = LayerHelper("range")
+    n = max(0, int(np.ceil((end - start) / step)))
+    out = helper.create_variable_for_type_inference(dtype, (n,), True)
+    helper.append_op("range", {}, {"Out": [out]},
+                     {"start": start, "end": end, "step": step, "dtype": dtype})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    out = helper.create_variable_for_type_inference(
+        "int32", (len(input.shape),), True)
+    helper.append_op("shape", {"Input": [input]}, {"Out": [out]}, {})
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("increment", {"X": [x]}, {"Out": [out]}, {"step": value})
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype, tuple(shape), True)
+    helper.append_op("uniform_random", {}, {"Out": [out]},
+                     {"shape": [int(s) for s in shape], "dtype": dtype,
+                      "min": min, "max": max, "seed": seed})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype, tuple(shape), True)
+    helper.append_op("gaussian_random", {}, {"Out": [out]},
+                     {"shape": [int(s) for s in shape], "dtype": dtype,
+                      "mean": mean, "std": std, "seed": seed})
+    return out

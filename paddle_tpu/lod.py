@@ -1,0 +1,92 @@
+"""LoD (level-of-detail / variable-length) tensor compatibility layer.
+
+Parity: paddle/fluid/framework/lod_tensor.{h,cc} + python lod_tensor.py.
+The reference packs ragged sequences into one flat tensor + offset table
+(LoD). XLA needs static shapes, so here ragged data is PADDED to [B, T]
+with an explicit lengths array — `to_padded`/`to_lod` convert both ways,
+and sequence layers take (data, seq_len). SURVEY §6 documents the swap.
+"""
+import numpy as np
+
+__all__ = ["LoDTensor", "create_lod_tensor", "to_padded", "to_ragged",
+           "sequence_mask_np", "bucket_by_length"]
+
+
+class LoDTensor:
+    """Padded array + lengths; .lod() emulates the reference accessor."""
+
+    def __init__(self, data, seq_lens=None):
+        self.data = np.asarray(data)
+        self.seq_lens = (np.asarray(seq_lens, dtype=np.int64)
+                         if seq_lens is not None else None)
+
+    def lod(self):
+        if self.seq_lens is None:
+            return []
+        offsets = np.concatenate([[0], np.cumsum(self.seq_lens)])
+        return [offsets.tolist()]
+
+    def set_lod(self, lod):
+        if lod:
+            offs = np.asarray(lod[0])
+            self.seq_lens = (offs[1:] - offs[:-1]).astype(np.int64)
+
+    def shape(self):
+        return self.data.shape
+
+    def __array__(self, dtype=None):
+        return self.data if dtype is None else self.data.astype(dtype)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """ref lod_tensor.py:create_lod_tensor — here: pad ragged rows."""
+    if isinstance(data, list):
+        lens = recursive_seq_lens[-1]
+        return LoDTensor(*to_padded(data))
+    lens = np.asarray(recursive_seq_lens[-1], dtype=np.int64)
+    return LoDTensor(np.asarray(data), lens)
+
+
+def to_padded(sequences, maxlen=None, pad_value=0, dtype=None):
+    """ragged list[list|array] → (padded [B,T,...], lengths [B])."""
+    seqs = [np.asarray(s) for s in sequences]
+    lens = np.asarray([len(s) for s in seqs], dtype=np.int64)
+    T = int(maxlen or (lens.max() if len(lens) else 0))
+    tail = seqs[0].shape[1:] if seqs and seqs[0].ndim > 1 else ()
+    dt = dtype or (seqs[0].dtype if seqs else np.float32)
+    out = np.full((len(seqs), T) + tail, pad_value, dtype=dt)
+    for i, s in enumerate(seqs):
+        n = min(len(s), T)
+        out[i, :n] = s[:n]
+    return out, np.minimum(lens, T)
+
+
+def to_ragged(padded, seq_lens):
+    """(padded, lengths) → list of trimmed arrays."""
+    return [padded[i, :int(n)] for i, n in enumerate(seq_lens)]
+
+
+def sequence_mask_np(seq_lens, maxlen):
+    seq_lens = np.asarray(seq_lens)
+    return (np.arange(maxlen)[None, :] < seq_lens[:, None])
+
+
+def bucket_by_length(reader, bucket_bounds, batch_size, len_fn=len):
+    """Length-bucketing decorator: groups samples into per-bucket batches
+    so padding waste (and XLA recompiles) stay bounded — the TPU answer to
+    the reference's LoD dynamic batching."""
+    def bucketed():
+        buckets = {b: [] for b in bucket_bounds}
+        for sample in reader():
+            L = len_fn(sample)
+            for b in bucket_bounds:
+                if L <= b:
+                    buckets[b].append(sample)
+                    if len(buckets[b]) == batch_size:
+                        yield b, buckets[b]
+                        buckets[b] = []
+                    break
+        for b, items in buckets.items():
+            if items:
+                yield b, items
+    return bucketed

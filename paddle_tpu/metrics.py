@@ -1,0 +1,145 @@
+"""Host-side streaming metrics.
+
+Parity: python/paddle/fluid/metrics.py — Accuracy, Precision, Recall,
+F1, Auc, CompositeMetric, ChunkEvaluator-lite.
+"""
+import numpy as np
+
+__all__ = ["MetricBase", "Accuracy", "Precision", "Recall", "F1",
+           "Auc", "CompositeMetric", "EditDistance"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1.0):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy: no updates yet")
+        return self.value / self.weight
+
+
+class _PRBase(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        p = (preds > 0.5).astype(np.int64) if preds.dtype.kind == "f" else preds
+        self.tp += int(np.sum((p == 1) & (labels == 1)))
+        self.fp += int(np.sum((p == 1) & (labels == 0)))
+        self.fn += int(np.sum((p == 0) & (labels == 1)))
+
+
+class Precision(_PRBase):
+    def eval(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(_PRBase):
+    def eval(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+
+class F1(_PRBase):
+    def eval(self):
+        p = self.tp / (self.tp + self.fp) if (self.tp + self.fp) else 0.0
+        r = self.tp / (self.tp + self.fn) if (self.tp + self.fn) else 0.0
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+
+class Auc(MetricBase):
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._n = num_thresholds + 1
+        self.reset()
+
+    def reset(self):
+        self.stat_pos = np.zeros(self._n)
+        self.stat_neg = np.zeros(self._n)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2:
+            preds = preds[:, -1]
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * (self._n - 1)).astype(int), 0, self._n - 1)
+        np.add.at(self.stat_pos, idx, labels)
+        np.add.at(self.stat_neg, idx, 1 - labels)
+
+    def eval(self):
+        pos_c = np.cumsum(self.stat_pos[::-1])
+        neg_c = np.cumsum(self.stat_neg[::-1])
+        tot_pos = max(pos_c[-1], 1e-9)
+        tot_neg = max(neg_c[-1], 1e-9)
+        return float(np.trapezoid(pos_c / tot_pos, neg_c / tot_neg))
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, dists, seq_num=None):
+        d = np.asarray(dists).reshape(-1)
+        self.total += float(d.sum())
+        self.count += len(d)
+
+    def eval(self):
+        return self.total / max(self.count, 1)

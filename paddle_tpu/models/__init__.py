@@ -1,0 +1,15 @@
+"""Model zoo.
+
+Parity: /root/reference/benchmark/fluid/models/* + fluid tests/book
+models, rebuilt on paddle_tpu layers. Each module exposes
+`build(...) -> (feeds, fetches)`-style builders usable inside
+program_guard.
+"""
+from . import mnist
+from . import vgg
+from . import resnet
+from . import se_resnext
+from . import transformer
+from . import stacked_lstm
+from . import deepfm
+from . import word2vec

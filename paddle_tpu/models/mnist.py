@@ -1,0 +1,40 @@
+"""MNIST models (ref benchmark/fluid/models/mnist.py: cnn_model; book
+ch.3: MLP). PR1-parity model per BASELINE.json configs."""
+from .. import layers
+from ..optimizer import Adam
+
+__all__ = ["mlp", "cnn", "build_program"]
+
+
+def mlp(img, hidden_sizes=(200, 200)):
+    h = img
+    for size in hidden_sizes:
+        h = layers.fc(h, size=size, act="relu")
+    return layers.fc(h, size=10, act="softmax")
+
+
+def cnn(img):
+    """ref models/mnist.py:cnn_model (conv-pool x2 + fc)."""
+    from .. import nets
+    conv1 = nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act="relu")
+    conv2 = nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act="relu")
+    return layers.fc(conv2, size=10, act="softmax")
+
+
+def build_program(model="mlp", lr=1e-3):
+    """Build train graph; returns (feeds, loss, acc)."""
+    if model == "cnn":
+        img = layers.data("img", shape=[1, 28, 28])
+        predict = cnn(img)
+    else:
+        img = layers.data("img", shape=[784])
+        predict = mlp(img)
+    label = layers.data("label", shape=[1], dtype="int64")
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return [img, label], avg_cost, acc

@@ -1,0 +1,7 @@
+"""Op kernel library — importing this module registers all kernels."""
+from . import registry
+from . import kernels_tensor
+from . import kernels_math
+from . import kernels_nn
+from . import kernels_optim
+from .registry import KERNELS, get_kernel, has_kernel

@@ -1,0 +1,369 @@
+"""Math kernels: elementwise, activations, reductions, matmul, comparisons.
+
+Parity: paddle/fluid/operators/{activation,elementwise/*,reduce_ops/*,
+matmul,mul,...}_op.cc. All map to jnp/lax primitives — XLA fuses the
+elementwise chains into surrounding matmuls (HBM-bandwidth win, SURVEY §6)
+so there is deliberately no hand-written fusion here.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import kernel
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+def _bcast(x, y, axis):
+    """Fluid elementwise broadcasting: y's shape aligns to x at `axis`."""
+    if axis is None or axis == -1 or x.ndim == y.ndim:
+        return x, y
+    # pad y's shape with 1s so its dims line up at `axis`
+    shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        shape[axis + i] = s
+    return x, jnp.reshape(y, tuple(shape))
+
+
+def _elementwise(fn):
+    def k(ctx, ins, attrs):
+        x, y = _bcast(ins["X"][0], ins["Y"][0], attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+    return k
+
+
+kernel("elementwise_add")(_elementwise(jnp.add))
+kernel("elementwise_sub")(_elementwise(jnp.subtract))
+kernel("elementwise_mul")(_elementwise(jnp.multiply))
+kernel("elementwise_div")(_elementwise(jnp.divide))
+kernel("elementwise_max")(_elementwise(jnp.maximum))
+kernel("elementwise_min")(_elementwise(jnp.minimum))
+kernel("elementwise_pow")(_elementwise(jnp.power))
+kernel("elementwise_mod")(_elementwise(jnp.mod))
+kernel("elementwise_floordiv")(_elementwise(jnp.floor_divide))
+
+
+@kernel("scale")
+def _scale(ctx, ins, attrs):
+    x = _x(ins)
+    s = jnp.asarray(attrs.get("scale", 1.0), dtype=x.dtype)
+    b = jnp.asarray(attrs.get("bias", 0.0), dtype=x.dtype)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * s + b]}
+    return {"Out": [(x + b) * s]}
+
+
+@kernel("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(_x(ins), attrs["min"], attrs["max"])]}
+
+
+@kernel("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = _x(ins)
+    mn = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+    scale = jnp.where(norm > mn, mn / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [(x.astype(jnp.float32) * scale).astype(x.dtype)]}
+
+
+# ---- activations ----------------------------------------------------------
+_ACTS = {
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "sigmoid": jax.nn.sigmoid,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "tanh": jnp.tanh,
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+    "gelu": jax.nn.gelu,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "square": jnp.square,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log1p": jnp.log1p,
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "reciprocal": jnp.reciprocal,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "erf": jax.lax.erf,
+    "sign": jnp.sign,
+    "silu": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+}
+
+for _name, _fn in _ACTS.items():
+    def _mk(fn):
+        def k(ctx, ins, attrs):
+            return {"Out": [fn(ins["X"][0])]}
+        return k
+    kernel(_name)(_mk(_fn))
+
+
+@kernel("leaky_relu")
+def _leaky_relu(ctx, ins, attrs):
+    return {"Out": [jax.nn.leaky_relu(_x(ins), attrs.get("alpha", 0.02))]}
+
+
+@kernel("hard_sigmoid")
+def _hard_sigmoid(ctx, ins, attrs):
+    s = attrs.get("slope", 0.2)
+    o = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(s * _x(ins) + o, 0.0, 1.0)]}
+
+
+@kernel("hard_swish")
+def _hard_swish(ctx, ins, attrs):
+    x = _x(ins)
+    t, s, o = attrs.get("threshold", 6.0), attrs.get("scale", 6.0), attrs.get("offset", 3.0)
+    return {"Out": [x * jnp.clip(x + o, 0.0, t) / s]}
+
+
+@kernel("swish")
+def _swish(ctx, ins, attrs):
+    b = attrs.get("beta", 1.0)
+    x = _x(ins)
+    return {"Out": [x * jax.nn.sigmoid(b * x)]}
+
+
+@kernel("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": [jnp.power(_x(ins), attrs.get("factor", 1.0))]}
+
+
+@kernel("prelu")
+def _prelu(ctx, ins, attrs):
+    x, alpha = _x(ins), ins["Alpha"][0]
+    if attrs.get("mode", "all") == "channel" and alpha.ndim == 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@kernel("soft_relu")
+def _soft_relu(ctx, ins, attrs):
+    t = attrs.get("threshold", 40.0)
+    return {"Out": [jnp.log1p(jnp.exp(jnp.clip(_x(ins), -t, t)))]}
+
+
+@kernel("thresholded_relu")
+def _thresholded_relu(ctx, ins, attrs):
+    x = _x(ins)
+    return {"Out": [jnp.where(x > attrs.get("threshold", 1.0), x, jnp.zeros_like(x))]}
+
+
+# ---- matmul family --------------------------------------------------------
+@kernel("mul")
+def _mul(ctx, ins, attrs):
+    """ref operators/mul_op.cc: flatten x to 2-D at x_num_col_dims, matmul."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
+    y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
+    out = x2 @ y2
+    out = out.reshape(xs[:xn] + ys[yn:])
+    return {"Out": [out]}
+
+
+@kernel("matmul", "matmul_v2")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", attrs.get("trans_x", False)):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("transpose_Y", attrs.get("trans_y", False)):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@kernel("bmm")
+def _bmm(ctx, ins, attrs):
+    return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}
+
+
+@kernel("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
+
+
+@kernel("bilinear_tensor_product")
+def _bilinear(ctx, ins, attrs):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if "Bias" in ins and ins["Bias"]:
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+# ---- reductions -----------------------------------------------------------
+def _reduce(fn):
+    def k(ctx, ins, attrs):
+        x = ins["X"][0]
+        dims = attrs.get("dim")
+        if attrs.get("reduce_all", False) or dims is None:
+            axis = None
+        else:
+            axis = tuple(d if d >= 0 else d + x.ndim for d in dims)
+        out = fn(x, axis=axis, keepdims=attrs.get("keep_dim", False))
+        return {"Out": [out]}
+    return k
+
+
+kernel("reduce_sum")(_reduce(jnp.sum))
+kernel("reduce_mean")(_reduce(jnp.mean))
+kernel("reduce_max")(_reduce(jnp.max))
+kernel("reduce_min")(_reduce(jnp.min))
+kernel("reduce_prod")(_reduce(jnp.prod))
+kernel("reduce_all")(_reduce(jnp.all))
+kernel("reduce_any")(_reduce(jnp.any))
+
+
+@kernel("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(_x(ins))]}
+
+
+@kernel("sum")
+def _sum(ctx, ins, attrs):
+    out = ins["X"][0]
+    for x in ins["X"][1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@kernel("logsumexp")
+def _logsumexp(ctx, ins, attrs):
+    x = _x(ins)
+    dims = attrs.get("dim")
+    axis = tuple(dims) if dims else None
+    return {"Out": [jax.scipy.special.logsumexp(x, axis=axis, keepdims=attrs.get("keep_dim", False))]}
+
+
+@kernel("l2_normalize", "norm")
+def _l2_normalize(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@kernel("frobenius_norm")
+def _frobenius_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sqrt(jnp.sum(jnp.square(_x(ins))))]}
+
+
+@kernel("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    x = _x(ins).astype(jnp.float32)
+    return {"Out": [jnp.sum(jnp.square(x))]}
+
+
+# ---- comparisons / logical -----------------------------------------------
+def _cmp(fn):
+    def k(ctx, ins, attrs):
+        return {"Out": [fn(ins["X"][0], ins["Y"][0])]}
+    return k
+
+
+kernel("equal")(_cmp(jnp.equal))
+kernel("not_equal")(_cmp(jnp.not_equal))
+kernel("less_than")(_cmp(jnp.less))
+kernel("less_equal")(_cmp(jnp.less_equal))
+kernel("greater_than")(_cmp(jnp.greater))
+kernel("greater_equal")(_cmp(jnp.greater_equal))
+
+
+@kernel("logical_and")
+def _logical_and(ctx, ins, attrs):
+    return {"Out": [jnp.logical_and(ins["X"][0], ins["Y"][0])]}
+
+
+@kernel("logical_or")
+def _logical_or(ctx, ins, attrs):
+    return {"Out": [jnp.logical_or(ins["X"][0], ins["Y"][0])]}
+
+
+@kernel("logical_xor")
+def _logical_xor(ctx, ins, attrs):
+    return {"Out": [jnp.logical_xor(ins["X"][0], ins["Y"][0])]}
+
+
+@kernel("logical_not")
+def _logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+@kernel("where")
+def _where(ctx, ins, attrs):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+# ---- index / sort ---------------------------------------------------------
+@kernel("arg_max")
+def _arg_max(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out]}
+
+
+@kernel("arg_min")
+def _arg_min(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    return {"Out": [jnp.argmin(x, axis=axis).astype(jnp.int64)]}
+
+
+@kernel("argsort")
+def _argsort(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@kernel("top_k", "top_k_v2")
+def _top_k(ctx, ins, attrs):
+    x = _x(ins)
+    k = attrs["k"]
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@kernel("max", "maximum")
+def _maximum(ctx, ins, attrs):
+    return {"Out": [jnp.maximum(ins["X"][0], ins["Y"][0])]}
+
+
+@kernel("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}

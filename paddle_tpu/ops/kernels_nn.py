@@ -1,0 +1,772 @@
+"""NN kernels: conv/pool/norm/dropout/softmax/losses/rnn/sequence/attention.
+
+Parity: paddle/fluid/operators/{conv,pool,batch_norm,layer_norm,dropout,
+softmax,cross_entropy,lstm,gru,sequence_ops/*}_op.* — the reference
+dispatches cuDNN kernels; here convs/matmuls lower through lax conv
+primitives onto the MXU, RNNs are lax.scan loops (compiler-friendly
+control flow), and sequence (LoD) ops act on padded arrays + length masks
+(static shapes, SURVEY §6).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import kernel
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+def _opt(ins, slot):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+# ---------------------------------------------------------------------------
+# convolution / pooling  (NCHW layout, matching the reference's default)
+# ---------------------------------------------------------------------------
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+@kernel("conv2d", "depthwise_conv2d")
+def _conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]      # x: NCHW, w: OIHW
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    if attrs.get("_op_type") == "depthwise_conv2d":
+        groups = x.shape[1]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    out = out.astype(x.dtype)
+    b = _opt(ins, "Bias")
+    if b is not None:
+        out = out + b.reshape((1, -1, 1, 1))
+    return {"Output": [out]}
+
+
+@kernel("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]      # w: IOHW for transpose
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    out = jax.lax.conv_transpose(
+        x, jnp.swapaxes(w, 0, 1),
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True)
+    b = _opt(ins, "Bias")
+    if b is not None:
+        out = out + b.reshape((1, -1, 1, 1))
+    return {"Output": [out]}
+
+
+@kernel("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s = attrs.get("strides", [1, 1, 1])
+    p = attrs.get("paddings", [0, 0, 0])
+    d = attrs.get("dilations", [1, 1, 1])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+        rhs_dilation=tuple(d),
+        feature_group_count=attrs.get("groups", 1),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": [out]}
+
+
+@kernel("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = _x(ins)
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("adaptive", False):
+        oh, ow = _pair(attrs["ksize"])
+        n, c, h, wd = x.shape
+        x5 = x.reshape(n, c, oh, h // oh, ow, wd // ow)
+        out = x5.max(axis=(3, 5)) if ptype == "max" else x5.mean(axis=(3, 5))
+        return {"Out": [out]}
+    if attrs.get("global_pooling", False):
+        ks = (x.shape[2], x.shape[3])
+        strides, pads = ks, (0, 0)
+    else:
+        ks = _pair(attrs["ksize"])
+        strides = _pair(attrs.get("strides", ks))
+        pads = _pair(attrs.get("paddings", [0, 0]))
+    window = (1, 1) + ks
+    strd = (1, 1) + strides
+    pad = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strd, pad)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strd, pad)
+        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strd, pad)
+            out = summed / cnt
+        else:
+            out = summed / (ks[0] * ks[1])
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+@kernel("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    """ref operators/batch_norm_op.cc. In-graph moving-stat updates: the
+    MeanOut/VarianceOut outputs alias the input stat var names, the traced
+    step function returns them as updated persistables."""
+    x = _x(ins)
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(x.shape[i] if i == c_axis else 1 for i in range(x.ndim))
+    xf = x.astype(jnp.float32)
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        bm = jnp.mean(xf, axis=red_axes)
+        bv = jnp.var(xf, axis=red_axes)
+        use_mean, use_var = bm, bv
+        mean_out = momentum * mean + (1 - momentum) * bm
+        var_out = momentum * var + (1 - momentum) * bv
+        saved_mean, saved_var = bm, bv
+    inv = jax.lax.rsqrt(use_var.reshape(bshape) + eps)
+    y = (xf - use_mean.reshape(bshape)) * inv
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": [y.astype(x.dtype)], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@kernel("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = _x(ins)
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    scale, bias = _opt(ins, "Scale"), _opt(ins, "Bias")
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    return {"Y": [y.astype(x.dtype)], "Mean": [mean.squeeze()], "Variance": [var.squeeze()]}
+
+
+@kernel("group_norm")
+def _group_norm(ctx, ins, attrs):
+    x = _x(ins)  # NCHW
+    g = attrs.get("groups", 32)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:]).astype(jnp.float32)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    scale, bias = _opt(ins, "Scale"), _opt(ins, "Bias")
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": [y.astype(x.dtype)], "Mean": [mean.squeeze()], "Variance": [var.squeeze()]}
+
+
+@kernel("instance_norm")
+def _instance_norm(ctx, ins, attrs):
+    x = _x(ins)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    scale, bias = _opt(ins, "Scale"), _opt(ins, "Bias")
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": [y]}
+
+
+# ---------------------------------------------------------------------------
+# dropout / softmax / losses
+# ---------------------------------------------------------------------------
+@kernel("dropout")
+def _dropout(ctx, ins, attrs):
+    x = _x(ins)
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test or p == 0.0:
+        # ref semantics: downgrade_in_infer scales at inference by (1-p)
+        out = x * (1.0 - p) if (impl == "downgrade_in_infer" and p) else x
+        return {"Out": [out], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+    else:
+        out = jnp.where(keep, x, jnp.zeros_like(x))
+    return {"Out": [out], "Mask": [keep.astype(x.dtype)]}
+
+
+@kernel("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.softmax(_x(ins), axis=attrs.get("axis", -1))]}
+
+
+@kernel("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(_x(ins), axis=attrs.get("axis", -1))]}
+
+
+def _gather_label_logp(logp, label, ignore_index=-100):
+    lbl = label.astype(jnp.int32)
+    if lbl.ndim == logp.ndim and lbl.shape[-1] == 1:
+        lbl = jnp.squeeze(lbl, -1)
+    safe = jnp.clip(lbl, 0, logp.shape[-1] - 1)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)
+    mask = (lbl != ignore_index)[..., None]
+    return jnp.where(mask, picked, jnp.zeros_like(picked))
+
+
+@kernel("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    """ref operators/cross_entropy_op.cc: input is PROBABILITIES."""
+    p, label = _x(ins), ins["Label"][0]
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.clip(p, 1e-8, 1.0)), axis=-1, keepdims=True)
+        return {"Y": [loss]}
+    logp = jnp.log(jnp.clip(p, 1e-8, 1.0))
+    loss = -_gather_label_logp(logp, label, attrs.get("ignore_index", -100))
+    return {"Y": [loss]}
+
+
+@kernel("softmax_with_cross_entropy")
+def _softmax_ce(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        loss = -_gather_label_logp(logp, label, attrs.get("ignore_index", -100))
+    return {"Loss": [loss.astype(logits.dtype)], "Softmax": [jnp.exp(logp).astype(logits.dtype)]}
+
+
+@kernel("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, ins, attrs):
+    x, label = _x(ins), ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ii = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ii, jnp.zeros_like(loss), loss)
+    return {"Out": [loss]}
+
+
+@kernel("square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    return {"Out": [jnp.square(ins["X"][0] - ins["Y"][0])]}
+
+
+@kernel("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = _x(ins), ins["Y"][0]
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@kernel("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = _x(ins), ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    r = jnp.abs(x - y)
+    loss = jnp.where(r < 1.0 / s2, 0.5 * s2 * r * r, r - 0.5 / s2)
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, loss.ndim)), keepdims=False)[..., None]],
+            "Diff": [x - y]}
+
+
+@kernel("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * label - 1.0) * logits)]}
+
+
+@kernel("bpr_loss")
+def _bpr_loss(ctx, ins, attrs):
+    x, label = _x(ins), ins["Label"][0]
+    lbl = label.astype(jnp.int32)
+    if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+        lbl = jnp.squeeze(lbl, -1)
+    pos = jnp.take_along_axis(x, lbl[..., None], axis=-1)
+    diff = pos - x
+    loss = -jnp.mean(jnp.log(jax.nn.sigmoid(diff) + 1e-8), axis=-1, keepdims=True)
+    return {"Y": [loss]}
+
+
+@kernel("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    x1, x2, label = ins["X1"][0], ins["X2"][0], ins["Label"][0]
+    m = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + m)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@kernel("log_loss")
+def _log_loss(ctx, ins, attrs):
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@kernel("kldiv_loss")
+def _kldiv_loss(ctx, ins, attrs):
+    x, target = _x(ins), ins["Target"][0]
+    loss = target * (jnp.log(jnp.clip(target, 1e-8)) - x)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": [loss]}
+
+
+@kernel("mse_loss")
+def _mse_loss(ctx, ins, attrs):
+    return {"Out": [jnp.mean(jnp.square(ins["X"][0] - ins["Y"][0]))]}
+
+
+@kernel("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    x = _x(ins)
+    e = attrs.get("epsilon", 0.1)
+    if "PriorDist" in ins and ins["PriorDist"]:
+        prior = ins["PriorDist"][0]
+        return {"Out": [(1 - e) * x + e * prior]}
+    return {"Out": [(1 - e) * x + e / x.shape[-1]]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent (lax.scan — compiler-friendly; ref dynamic_lstm/gru use LoD loops)
+# ---------------------------------------------------------------------------
+def _lstm_scan(x_seq, h0, c0, w_ih, w_hh, b, mask=None, reverse=False):
+    """x_seq: [T,B,4H in-proj already applied? no: D], returns (h_seq, (hT, cT)).
+
+    Gate order follows the reference lstm_op: input, forget, cell(candidate),
+    output.
+    """
+    T = x_seq.shape[0]
+    H = h0.shape[-1]
+
+    def step(carry, inp):
+        h, c = carry
+        xt, mt = inp
+        gates = xt @ w_ih + h @ w_hh
+        if b is not None:
+            gates = gates + b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if mt is not None:
+            m = mt[..., None]
+            h_new = jnp.where(m, h_new, h)
+            c_new = jnp.where(m, c_new, c)
+        return (h_new, c_new), h_new
+
+    seq = jnp.flip(x_seq, 0) if reverse else x_seq
+    msk = None if mask is None else (jnp.flip(mask, 0) if reverse else mask)
+    inputs = (seq, msk if msk is not None else jnp.ones(seq.shape[:2], dtype=bool))
+    (hT, cT), h_seq = jax.lax.scan(step, (h0, c0), inputs)
+    if reverse:
+        h_seq = jnp.flip(h_seq, 0)
+    return h_seq, (hT, cT)
+
+
+@kernel("lstm")
+def _lstm(ctx, ins, attrs):
+    """Padded-batch LSTM (ref operators/lstm_op.cc LoD variant → mask-based).
+
+    Input: [B,T,D]; SeqLen optional [B]; Weight packs (w_ih[D,4H], w_hh[H,4H]).
+    """
+    x = _x(ins, "Input")            # [B,T,D]
+    w_ih = ins["WeightIH"][0]
+    w_hh = ins["WeightHH"][0]
+    b = _opt(ins, "Bias")
+    seq_len = _opt(ins, "SeqLen")
+    H = w_hh.shape[0]
+    B, T = x.shape[0], x.shape[1]
+    h0 = _opt(ins, "H0")
+    c0 = _opt(ins, "C0")
+    if h0 is None:
+        h0 = jnp.zeros((B, H), dtype=x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, H), dtype=x.dtype)
+    mask = None
+    if seq_len is not None:
+        mask = (jnp.arange(T)[None, :] < seq_len.reshape(B, 1)).T  # [T,B]
+    xs = jnp.swapaxes(x, 0, 1)      # [T,B,D]
+    h_seq, (hT, cT) = _lstm_scan(xs, h0, c0, w_ih, w_hh, b, mask,
+                                 reverse=attrs.get("is_reverse", False))
+    return {"Hidden": [jnp.swapaxes(h_seq, 0, 1)], "LastH": [hT], "LastC": [cT]}
+
+
+@kernel("gru")
+def _gru(ctx, ins, attrs):
+    """Padded-batch GRU (ref operators/gru_op.cc → mask-based scan)."""
+    x = _x(ins, "Input")            # [B,T,D]
+    w_ih = ins["WeightIH"][0]       # [D,3H] (update,reset,candidate)
+    w_hh = ins["WeightHH"][0]       # [H,3H]
+    b = _opt(ins, "Bias")
+    seq_len = _opt(ins, "SeqLen")
+    H = w_hh.shape[0]
+    B, T = x.shape[0], x.shape[1]
+    h0 = _opt(ins, "H0")
+    if h0 is None:
+        h0 = jnp.zeros((B, H), dtype=x.dtype)
+    mask = None
+    if seq_len is not None:
+        mask = (jnp.arange(T)[None, :] < seq_len.reshape(B, 1)).T
+
+    def step(h, inp):
+        xt, mt = inp
+        xg = xt @ w_ih
+        if b is not None:
+            xg = xg + b
+        hg = h @ w_hh
+        xu, xr, xc = jnp.split(xg, 3, axis=-1)
+        hu, hr, hc = jnp.split(hg, 3, axis=-1)
+        u = jax.nn.sigmoid(xu + hu)
+        r = jax.nn.sigmoid(xr + hr)
+        c = jnp.tanh(xc + r * hc)
+        h_new = u * h + (1 - u) * c
+        h_new = jnp.where(mt[..., None], h_new, h)
+        return h_new, h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if attrs.get("is_reverse", False):
+        xs = jnp.flip(xs, 0)
+        mask = jnp.flip(mask, 0) if mask is not None else None
+    m = mask if mask is not None else jnp.ones(xs.shape[:2], dtype=bool)
+    hT, h_seq = jax.lax.scan(step, h0, (xs, m))
+    if attrs.get("is_reverse", False):
+        h_seq = jnp.flip(h_seq, 0)
+    return {"Hidden": [jnp.swapaxes(h_seq, 0, 1)], "LastH": [hT]}
+
+
+@kernel("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    x, c_prev = _x(ins), ins["C_prev"][0]
+    i, f, g, o = jnp.split(x, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f + attrs.get("forget_bias", 0.0)), jax.nn.sigmoid(o)
+    c = f * c_prev + i * jnp.tanh(g)
+    h = o * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@kernel("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    x, h_prev, w = _x(ins, "Input"), ins["HiddenPrev"][0], ins["Weight"][0]
+    b = _opt(ins, "Bias")
+    H = h_prev.shape[-1]
+    if b is not None:
+        x = x + b
+    xu, xr, xc = jnp.split(x, 3, axis=-1)
+    wu, wc = w[:, :2 * H], w[:, 2 * H:]
+    hg = h_prev @ wu
+    hu, hr = jnp.split(hg, 2, axis=-1)
+    u = jax.nn.sigmoid(xu + hu)
+    r = jax.nn.sigmoid(xr + hr)
+    c = jnp.tanh(xc + (r * h_prev) @ wc)
+    h = u * h_prev + (1 - u) * c
+    return {"Hidden": [h], "Gate": [jnp.concatenate([u, r], -1)], "ResetHiddenPrev": [r * h_prev]}
+
+
+# ---------------------------------------------------------------------------
+# sequence ops — padded arrays + length masks replace LoD levels
+# ---------------------------------------------------------------------------
+def _seq_mask(x, seq_len):
+    """mask [B,T,1...] for x [B,T,...] given lengths [B]."""
+    B, T = x.shape[0], x.shape[1]
+    m = jnp.arange(T)[None, :] < seq_len.reshape(B, 1)
+    return m.reshape((B, T) + (1,) * (x.ndim - 2))
+
+
+@kernel("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    x, seq_len = _x(ins), ins["SeqLen"][0]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    m = _seq_mask(x, seq_len)
+    lens = jnp.maximum(seq_len.reshape((-1,) + (1,) * (x.ndim - 2)), 1).astype(x.dtype)
+    if ptype in ("AVERAGE", "MEAN"):
+        out = jnp.sum(jnp.where(m, x, 0), axis=1) / lens
+    elif ptype == "SUM":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1)
+    elif ptype == "SQRT":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.sqrt(lens)
+    elif ptype == "MAX":
+        out = jnp.max(jnp.where(m, x, -jnp.inf), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(seq_len - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2))
+                                  .astype(jnp.int32) * jnp.ones_like(x[:, :1], dtype=jnp.int32), axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"bad pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@kernel("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x, seq_len = _x(ins), ins["SeqLen"][0]
+    m = _seq_mask(x, seq_len)
+    z = jnp.where(m, x, -jnp.inf)
+    out = jax.nn.softmax(z, axis=1)
+    return {"Out": [jnp.where(m, out, 0.0)]}
+
+
+@kernel("sequence_mask")
+def _sequence_mask_op(ctx, ins, attrs):
+    seq_len = _x(ins)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen <= 0:
+        raise ValueError("sequence_mask requires static maxlen > 0 on TPU")
+    m = jnp.arange(maxlen)[None, :] < seq_len.reshape(-1, 1)
+    from ..core.dtypes import as_jnp_dtype
+    return {"Y": [m.astype(as_jnp_dtype(attrs.get("out_dtype", "int64")))]}
+
+
+@kernel("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    x, seq_len = _x(ins), ins["SeqLen"][0]
+    B, T = x.shape[0], x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    ridx = jnp.where(idx < seq_len[:, None], seq_len[:, None] - 1 - idx, idx)
+    return {"Y": [jnp.take_along_axis(x, ridx.reshape((B, T) + (1,) * (x.ndim - 2))
+                                      .astype(jnp.int32)
+                                      * jnp.ones((B, T) + x.shape[2:], jnp.int32), axis=1)]}
+
+
+@kernel("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    # padded analog: broadcast x [B,1,...] or [B,...] along T of Y [B,T,...]
+    x, y = _x(ins), ins["Y"][0]
+    if x.ndim == y.ndim:
+        return {"Out": [jnp.broadcast_to(x, y.shape[:2] + x.shape[2:])]}
+    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])]}
+
+
+@kernel("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=1)]}
+
+
+@kernel("sequence_pad")
+def _sequence_pad(ctx, ins, attrs):
+    # inputs already padded in this framework; pass through with lengths
+    x, seq_len = _x(ins), ins["SeqLen"][0]
+    return {"Out": [x], "Length": [seq_len]}
+
+
+@kernel("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    x = _x(ins)  # NCHW
+    kh, kw = _pair(attrs["kernels"])
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # [N, C*kh*kw, oh, ow] → [N, oh*ow, C*kh*kw]
+    out = patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# attention (jnp reference path; Pallas flash kernel in ops/pallas)
+# ---------------------------------------------------------------------------
+@kernel("scaled_dot_product_attention")
+def _sdpa(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    mask = _opt(ins, "Mask")
+    scale = attrs.get("scale", None) or (1.0 / np.sqrt(q.shape[-1]))
+    logits = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    if attrs.get("causal", False):
+        T, S = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((T, S), dtype=bool), k=S - T)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    w = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("...qk,...kd->...qd", w, v)
+    return {"Out": [out], "Weights": [w]}
+
+
+@kernel("add_position_encoding")
+def _add_position_encoding(ctx, ins, attrs):
+    x = _x(ins)  # [B,T,D]
+    alpha, beta = attrs.get("alpha", 1.0), attrs.get("beta", 1.0)
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * i / D)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+    return {"Out": [alpha * x + beta * pe[None, :, :].astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+@kernel("bilinear_interp", "nearest_interp", "interpolate")
+def _interp(ctx, ins, attrs):
+    x = _x(ins)  # NCHW
+    oh = attrs.get("out_h")
+    ow = attrs.get("out_w")
+    if not oh or not ow:
+        s = attrs.get("scale", 1.0)
+        oh, ow = int(x.shape[2] * s), int(x.shape[3] * s)
+    method = "nearest" if "nearest" in attrs.get("_op_type", attrs.get("interp_method", "bilinear")) else attrs.get("interp_method", "bilinear")
+    if method == "bilinear":
+        method = "linear"
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method=method)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@kernel("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    x, grid = _x(ins), ins["Grid"][0]  # x NCHW, grid [N,H,W,2] in [-1,1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx, wy = gx - x0, gy - y0
+
+    def sample(xi, yi):
+        xi = jnp.clip(xi, 0, w - 1)
+        yi = jnp.clip(yi, 0, h - 1)
+        return x[jnp.arange(n)[:, None, None, None], jnp.arange(c)[None, :, None, None],
+                 yi[:, None], xi[:, None]]
+
+    v00 = sample(x0, y0)
+    v01 = sample(x1, y0)
+    v10 = sample(x0, y1)
+    v11 = sample(x1, y1)
+    wxb = wx[:, None]
+    wyb = wy[:, None]
+    out = (v00 * (1 - wxb) * (1 - wyb) + v01 * wxb * (1 - wyb)
+           + v10 * (1 - wxb) * wyb + v11 * wxb * wyb)
+    return {"Output": [out]}
+
+
+@kernel("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    x, scale, bias = _x(ins), ins["Scale"][0], ins["Bias"][0]
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    return {"Out": [x * scale.reshape(bshape) + bias.reshape(bshape)]}
+
+
+@kernel("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    x = _x(ins)
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)]}
+
+
+@kernel("maxout")
+def _maxout(ctx, ins, attrs):
+    x = _x(ins)  # NCHW
+    g = attrs["groups"]
+    n, c = x.shape[0], x.shape[1]
+    return {"Out": [x.reshape((n, c // g, g) + x.shape[2:]).max(axis=2)]}
+
+
+@kernel("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    x = _x(ins)
+    r = attrs["upscale_factor"]
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
+    return {"Out": [out]}
+
+
+@kernel("sampled_softmax_ce")
+def _sampled_softmax_ce(ctx, ins, attrs):
+    """Fixed-size sampled softmax (TPU stand-in for ref nce_op — static
+    shapes instead of data-dependent sparse sampling)."""
+    x, label, w, b = ins["X"][0], ins["Label"][0], ins["W"][0], ins["B"][0]
+    num_samples = attrs["num_samples"]
+    num_classes = attrs["num_classes"]
+    lbl = label.astype(jnp.int32).reshape(-1)
+    neg = jax.random.randint(ctx.key, (lbl.shape[0], num_samples - 1), 0, num_classes)
+    cand = jnp.concatenate([lbl[:, None], neg], axis=1)      # [B, S]
+    wc = w[cand]                                             # [B, S, D]
+    bc = b[cand]                                             # [B, S]
+    logits = jnp.einsum("bd,bsd->bs", x, wc) + bc
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return {"Loss": [-logp[:, :1].astype(x.dtype)]}
+
+
+@kernel("flash_attention")
+def _flash_attention(ctx, ins, attrs):
+    """Flash attention: Pallas TPU kernel when available, jnp fallback.
+
+    Replaces the reference's unfused softmax(QK^T)V (cuDNN path) with a
+    tiled online-softmax kernel — no [T,T] HBM materialization."""
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    mask = _opt(ins, "Mask")
+    causal = attrs.get("causal", False)
+    scale = attrs.get("scale", None) or (1.0 / np.sqrt(q.shape[-1]))
+    try:
+        if mask is None and q.ndim == 4:
+            from .pallas.flash_attention import flash_attention as _fa
+            out = _fa(q, k, v, causal=causal, scale=scale)
+            return {"Out": [out], "Weights": [jnp.zeros((0,), q.dtype)]}
+    except Exception:
+        pass
+    logits = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    if causal:
+        T, S = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((T, S), dtype=bool), k=S - T)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    wts = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("...qk,...kd->...qd", wts, v)
+    return {"Out": [out], "Weights": [wts]}

@@ -1,0 +1,275 @@
+"""Optimizer-update and metric kernels.
+
+Parity: paddle/fluid/operators/optimizers/{sgd,momentum,adam,adagrad,
+rmsprop,ftrl,lamb,...}_op.cc and metrics/{accuracy,auc}_op.cc.
+Update ops write outputs to the SAME var names as their param/moment
+inputs — the traced step function returns them as updated persistables and
+jit buffer donation makes the update in-place in HBM.
+
+All moment math runs in float32 regardless of param dtype (master-weight
+style, bf16-safe).
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import kernel
+
+
+def _lr(ins):
+    lr = ins["LearningRate"][0]
+    return lr.astype(jnp.float32).reshape(())
+
+
+@kernel("sgd")
+def _sgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    out = (p.astype(jnp.float32) - _lr(ins) * g.astype(jnp.float32)).astype(p.dtype)
+    return {"ParamOut": [out]}
+
+
+@kernel("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins)
+    gf = g.astype(jnp.float32)
+    v_new = mu * v + gf
+    if attrs.get("use_nesterov", False):
+        p_new = p.astype(jnp.float32) - lr * (gf + mu * v_new)
+    else:
+        p_new = p.astype(jnp.float32) - lr * v_new
+    return {"ParamOut": [p_new.astype(p.dtype)], "VelocityOut": [v_new]}
+
+
+@kernel("adam")
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    gf = g.astype(jnp.float32)
+    m_new = b1 * m + (1 - b1) * gf
+    v_new = b2 * v + (1 - b2) * jnp.square(gf)
+    b1p_new = b1p * b1
+    b2p_new = b2p * b2
+    lr_t = lr * jnp.sqrt(1 - b2p_new) / (1 - b1p_new)
+    p_new = p.astype(jnp.float32) - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "Moment1Out": [m_new],
+            "Moment2Out": [v_new], "Beta1PowOut": [b1p_new], "Beta2PowOut": [b2p_new]}
+
+
+@kernel("adamax")
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, u = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    gf = g.astype(jnp.float32)
+    m_new = b1 * m + (1 - b1) * gf
+    u_new = jnp.maximum(b2 * u, jnp.abs(gf))
+    p_new = p.astype(jnp.float32) - (lr / (1 - b1p)) * m_new / (u_new + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "MomentOut": [m_new],
+            "InfNormOut": [u_new], "Beta1PowOut": [b1p * b1]}
+
+
+@kernel("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    m_new = mom + jnp.square(gf)
+    p_new = p.astype(jnp.float32) - _lr(ins) * gf / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "MomentOut": [m_new]}
+
+
+@kernel("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    asg = rho * avg_sq_g + (1 - rho) * jnp.square(gf)
+    upd = jnp.sqrt(avg_sq_u + eps) / jnp.sqrt(asg + eps) * gf
+    asu = rho * avg_sq_u + (1 - rho) * jnp.square(upd)
+    p_new = p.astype(jnp.float32) - _lr(ins) * upd
+    return {"ParamOut": [p_new.astype(p.dtype)], "AvgSquaredGradOut": [asg],
+            "AvgSquaredUpdateOut": [asu]}
+
+
+@kernel("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    lr = _lr(ins)
+    gf = g.astype(jnp.float32)
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_new = rho * mg + (1 - rho) * gf
+        ms_new = rho * ms + (1 - rho) * jnp.square(gf)
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        mom_new = mu * mom + lr * gf / denom
+        p_new = p.astype(jnp.float32) - mom_new
+        return {"ParamOut": [p_new.astype(p.dtype)], "MeanSquareOut": [ms_new],
+                "MomentOut": [mom_new], "MeanGradOut": [mg_new]}
+    ms_new = rho * ms + (1 - rho) * jnp.square(gf)
+    mom_new = mu * mom + lr * gf / jnp.sqrt(ms_new + eps)
+    p_new = p.astype(jnp.float32) - mom_new
+    return {"ParamOut": [p_new.astype(p.dtype)], "MeanSquareOut": [ms_new],
+            "MomentOut": [mom_new]}
+
+
+@kernel("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    gf = g.astype(jnp.float32)
+    new_sq = sq + jnp.square(gf)
+    sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + gf - sigma * p.astype(jnp.float32)
+    x = -new_lin + jnp.clip(new_lin, -l1, l1)
+    y = jnp.power(new_sq, -power) / lr + 2 * l2
+    p_new = x / y
+    return {"ParamOut": [p_new.astype(p.dtype)], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [new_lin]}
+
+
+@kernel("lamb")
+def _lamb(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    lr = _lr(ins)
+    pf = p.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    m_new = b1 * m + (1 - b1) * gf
+    v_new = b2 * v + (1 - b2) * jnp.square(gf)
+    m_hat = m_new / (1 - b1p * b1)
+    v_hat = v_new / (1 - b2p * b2)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * pf
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_new = pf - lr * trust * r
+    return {"ParamOut": [p_new.astype(p.dtype)], "Moment1Out": [m_new],
+            "Moment2Out": [v_new], "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@kernel("lars_momentum")
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    lr = _lr(ins)
+    pf = p.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + 1e-12), lr)
+    v_new = mu * v + local_lr * (gf + wd * pf)
+    p_new = pf - v_new
+    return {"ParamOut": [p_new.astype(p.dtype)], "VelocityOut": [v_new]}
+
+
+@kernel("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    m_new = decay * mom + (1 - decay) * jnp.square(gf)
+    p_new = p.astype(jnp.float32) - _lr(ins) * gf / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "MomentOut": [m_new]}
+
+
+# ---------------------------------------------------------------------------
+# gradient clipping (global ops appended by clip.py)
+# ---------------------------------------------------------------------------
+@kernel("global_norm_clip")
+def _global_norm_clip(ctx, ins, attrs):
+    """Clip ALL grads by their joint global norm (ref clip.py:GradientClipByGlobalNorm).
+    One op over all grads so XLA sees the whole reduction."""
+    grads = ins["X"]
+    max_norm = attrs["max_global_norm"]
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+    gnorm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
+    return {"Out": [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]}
+
+
+# ---------------------------------------------------------------------------
+# metrics (ref operators/metrics/{accuracy,auc}_op.cc)
+# ---------------------------------------------------------------------------
+@kernel("accuracy")
+def _accuracy(ctx, ins, attrs):
+    pred, label = ins["Out"][0], ins["Label"][0]
+    indices = ins.get("Indices", [None])[0]
+    k = attrs.get("k", 1)
+    lbl = label.astype(jnp.int64)
+    if lbl.ndim == 2 and lbl.shape[-1] == 1:
+        lbl = jnp.squeeze(lbl, -1)
+    if indices is None:
+        _, indices = jax.lax.top_k(pred, k)
+    correct = jnp.any(indices.astype(jnp.int64)[:, :k] == lbl[:, None], axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = pred.shape[0]
+    return {"Accuracy": [num_correct / total],
+            "Correct": [num_correct.astype(jnp.int32)],
+            "Total": [jnp.asarray(total, dtype=jnp.int32)]}
+
+
+@kernel("auc")
+def _auc(ctx, ins, attrs):
+    """Streaming AUC via fixed histogram buckets (static shapes)."""
+    pred, label = ins["Predict"][0], ins["Label"][0]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    buckets = attrs.get("num_thresholds", 4095) + 1
+    p1 = pred[:, -1] if pred.ndim == 2 else pred.reshape(-1)
+    lbl = label.reshape(-1).astype(jnp.float32)
+    idx = jnp.clip((p1 * (buckets - 1)).astype(jnp.int32), 0, buckets - 1)
+    pos_new = stat_pos.at[idx].add(lbl)
+    neg_new = stat_neg.at[idx].add(1.0 - lbl)
+    # trapezoid over cumulative TPR/FPR from histogram (descending threshold)
+    pos_c = jnp.cumsum(pos_new[::-1])
+    neg_c = jnp.cumsum(neg_new[::-1])
+    tp, fp = pos_c, neg_c
+    tot_pos = jnp.maximum(pos_c[-1], 1e-6)
+    tot_neg = jnp.maximum(neg_c[-1], 1e-6)
+    tpr = tp / tot_pos
+    fpr = fp / tot_neg
+    auc = jnp.trapezoid(tpr, fpr)
+    return {"AUC": [auc], "StatPosOut": [pos_new], "StatNegOut": [neg_new]}
+
+
+@kernel("mean_iou")
+def _mean_iou(ctx, ins, attrs):
+    pred, label = ins["Predictions"][0], ins["Labels"][0]
+    n = attrs["num_classes"]
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    cm = jnp.zeros((n, n), jnp.float32).at[l, p].add(1.0)
+    inter = jnp.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+    valid = (union > 0).astype(jnp.float32)
+    return {"OutMeanIou": [jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)],
+            "OutWrong": [union - inter], "OutCorrect": [inter]}

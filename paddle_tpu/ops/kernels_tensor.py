@@ -1,0 +1,377 @@
+"""Tensor creation / manipulation kernels.
+
+Parity: paddle/fluid/operators/{fill_constant,cast,concat,split,reshape,
+transpose,gather,scatter,one_hot,...}_op.cc — re-expressed as pure jnp
+functions; XLA fuses/elides these (reshape/transpose are free layout ops
+on TPU when fused into the consuming matmul).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import kernel
+from ..core.dtypes import as_jnp_dtype
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+@kernel("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dt = as_jnp_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dt)]}
+
+
+@kernel("fill_constant_batch_size_like")
+def _fill_cbsl(ctx, ins, attrs):
+    ref = _x(ins, "Input")
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dt = as_jnp_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dt)]}
+
+
+@kernel("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(_x(ins))]}
+
+
+@kernel("fill_any_like")
+def _fill_any_like(ctx, ins, attrs):
+    return {"Out": [jnp.full_like(_x(ins), attrs.get("value", 0.0))]}
+
+
+@kernel("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [_x(ins)]}
+
+
+@kernel("assign_value")
+def _assign_value(ctx, ins, attrs):
+    vals = np.asarray(attrs["values"], dtype=attrs.get("dtype", "float32"))
+    return {"Out": [jnp.asarray(vals.reshape(attrs["shape"]))]}
+
+
+@kernel("cast")
+def _cast(ctx, ins, attrs):
+    return {"Out": [_x(ins).astype(as_jnp_dtype(attrs["out_dtype"]))]}
+
+
+@kernel("reshape", "reshape2")
+def _reshape(ctx, ins, attrs):
+    x = _x(ins)
+    shape = list(attrs["shape"])
+    # Fluid semantics: 0 means copy input dim, -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    out = jnp.reshape(x, tuple(shape))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@kernel("transpose", "transpose2")
+def _transpose(ctx, ins, attrs):
+    x = _x(ins)
+    out = jnp.transpose(x, attrs["axis"])
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@kernel("squeeze", "squeeze2")
+def _squeeze(ctx, ins, attrs):
+    x = _x(ins)
+    axes = attrs.get("axes") or None
+    if axes:
+        out = jnp.squeeze(x, axis=tuple(a if a >= 0 else a + x.ndim for a in axes))
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@kernel("unsqueeze", "unsqueeze2")
+def _unsqueeze(ctx, ins, attrs):
+    x = _x(ins)
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@kernel("flatten", "flatten2")
+def _flatten(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    out = jnp.reshape(x, (lead, -1))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@kernel("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@kernel("split")
+def _split(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections")
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@kernel("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@kernel("unstack")
+def _unstack(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    outs = [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+    return {"Y": outs}
+
+
+@kernel("expand")
+def _expand(ctx, ins, attrs):
+    x = _x(ins)
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, tuple(times))]}
+
+
+@kernel("expand_as")
+def _expand_as(ctx, ins, attrs):
+    x, t = _x(ins), _x(ins, "target_tensor")
+    return {"Out": [jnp.broadcast_to(x, t.shape)]}
+
+
+@kernel("tile")
+def _tile(ctx, ins, attrs):
+    return {"Out": [jnp.tile(_x(ins), tuple(attrs["repeat_times"]))]}
+
+
+@kernel("slice")
+def _slice(ctx, ins, attrs):
+    x = _x(ins, "Input")
+    axes, starts, ends = attrs["axes"], attrs["starts"], attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@kernel("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = _x(ins, "Input")
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@kernel("gather")
+def _gather(ctx, ins, attrs):
+    x, idx = _x(ins), _x(ins, "Index")
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.take(x, idx.astype(jnp.int32), axis=axis)]}
+
+
+@kernel("gather_nd")
+def _gather_nd(ctx, ins, attrs):
+    x, idx = _x(ins), _x(ins, "Index")
+    idx = idx.astype(jnp.int32)
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return {"Out": [x[flat_idx]]}
+
+
+@kernel("scatter")
+def _scatter(ctx, ins, attrs):
+    x, idx, upd = _x(ins), _x(ins, "Ids"), _x(ins, "Updates")
+    idx = idx.astype(jnp.int32).reshape(-1)
+    if attrs.get("overwrite", True):
+        out = x.at[idx].set(upd)
+    else:
+        out = x.at[idx].add(upd)
+    return {"Out": [out]}
+
+
+@kernel("scatter_nd_add")
+def _scatter_nd_add(ctx, ins, attrs):
+    x, idx, upd = _x(ins), _x(ins, "Index"), _x(ins, "Updates")
+    idx = idx.astype(jnp.int32)
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return {"Out": [x.at[flat_idx].add(upd)]}
+
+
+@kernel("one_hot")
+def _one_hot(ctx, ins, attrs):
+    x = _x(ins).astype(jnp.int32)
+    depth = attrs["depth"]
+    if x.ndim >= 1 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@kernel("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    return {"Out": [out]}
+
+
+@kernel("linspace")
+def _linspace(ctx, ins, attrs):
+    dt = as_jnp_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.linspace(attrs["start"], attrs["stop"], attrs["num"], dtype=dt)]}
+
+
+@kernel("range")
+def _range(ctx, ins, attrs):
+    dt = as_jnp_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.arange(attrs["start"], attrs["end"], attrs["step"], dtype=dt)]}
+
+
+@kernel("shape")
+def _shape(ctx, ins, attrs):
+    x = _x(ins, "Input")
+    return {"Out": [jnp.asarray(x.shape, dtype=jnp.int32)]}
+
+
+@kernel("increment")
+def _increment(ctx, ins, attrs):
+    return {"Out": [_x(ins) + jnp.asarray(attrs.get("step", 1.0), dtype=_x(ins).dtype)]}
+
+
+@kernel("uniform_random", "uniform_random_batch_size_like")
+def _uniform_random(ctx, ins, attrs):
+    shape = list(attrs["shape"])
+    if "Input" in ins:  # batch_size_like variant
+        shape[attrs.get("output_dim_idx", 0)] = ins["Input"][0].shape[attrs.get("input_dim_idx", 0)]
+    dt = as_jnp_dtype(attrs.get("dtype", "float32"))
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    return {"Out": [jax.random.uniform(ctx.key, tuple(shape), dtype=dt, minval=lo, maxval=hi)]}
+
+
+@kernel("gaussian_random", "gaussian_random_batch_size_like")
+def _gaussian_random(ctx, ins, attrs):
+    shape = list(attrs["shape"])
+    if "Input" in ins:
+        shape[attrs.get("output_dim_idx", 0)] = ins["Input"][0].shape[attrs.get("input_dim_idx", 0)]
+    dt = as_jnp_dtype(attrs.get("dtype", "float32"))
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    return {"Out": [mean + std * jax.random.normal(ctx.key, tuple(shape), dtype=dt)]}
+
+
+@kernel("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dt = as_jnp_dtype(attrs.get("dtype", "float32"))
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    z = jax.random.truncated_normal(ctx.key, -2.0, 2.0, shape, dtype=dt)
+    return {"Out": [mean + std * z]}
+
+
+@kernel("randint")
+def _randint(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dt = as_jnp_dtype(attrs.get("dtype", "int64"))
+    return {"Out": [jax.random.randint(ctx.key, shape, attrs["low"], attrs["high"], dtype=dt)]}
+
+
+@kernel("pad")
+def _pad(ctx, ins, attrs):
+    x = _x(ins)
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@kernel("pad2d")
+def _pad2d(ctx, ins, attrs):
+    x = _x(ins)  # NCHW
+    t, b, l, r = attrs["paddings"]
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (t, b), (l, r)]
+    if mode == "constant":
+        out = jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pairs, mode="reflect")
+    else:
+        out = jnp.pad(x, pairs, mode="edge")
+    return {"Out": [out]}
+
+
+@kernel("reverse")
+def _reverse(ctx, ins, attrs):
+    x = _x(ins)
+    out = x
+    for a in attrs["axis"]:
+        out = jnp.flip(out, a)
+    return {"Out": [out]}
+
+
+@kernel("roll")
+def _roll(ctx, ins, attrs):
+    return {"Out": [jnp.roll(_x(ins), attrs["shifts"], axis=tuple(attrs["axis"]))]}
+
+
+@kernel("where_index")
+def _where_index(ctx, ins, attrs):
+    # nonzero has data-dependent shape; provide padded variant with size attr
+    raise NotImplementedError(
+        "where_index (nonzero) has a data-dependent shape; use masked ops instead "
+        "(XLA requires static shapes)")
+
+
+@kernel("lookup_table", "lookup_table_v2", "embedding")
+def _lookup_table(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ids = ids.astype(jnp.int32)
+    if ids.ndim >= 1 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return {"Out": [out]}
+
+
+@kernel("isfinite")
+def _isfinite(ctx, ins, attrs):
+    # ref operators/isfinite_op.cc: reduces over ALL inputs → scalar bool-ish
+    ok = jnp.asarray(True)
+    for x in ins["X"]:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {"Out": [ok]}
+
+
+@kernel("py_func")
+def _py_func(ctx, ins, attrs):
+    fn = attrs["_callable"]
+    outs = fn(*ins["X"])
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return {"Out": list(outs)}

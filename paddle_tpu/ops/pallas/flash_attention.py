@@ -1,0 +1,101 @@
+"""Pallas flash-attention kernel for TPU.
+
+Tiled online-softmax attention (FlashAttention algorithm) written as a
+Pallas TPU kernel: Q stays resident in VMEM per block, K/V stream in
+block-by-block, no [T,T] score matrix ever hits HBM. This replaces the
+reference's cuDNN softmax(QK^T)V sequence (paddle/fluid/operators/
+conv_cudnn-era attention composition) as the hot attention path.
+
+Falls back to None (caller uses the jnp path) when Pallas/TPU is
+unavailable or shapes don't tile.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+               seq_len):
+    """Grid: (batch*heads, q_blocks). Refs are [block_q, d] / [T, d]."""
+    q = q_ref[...].astype(jnp.float32) * scale      # [bq, d]
+    bq = q.shape[0]
+    q_idx = pl.program_id(1)
+    n_kb = seq_len // block_k
+
+    def body(kb, carry):
+        acc, l, m = carry
+        k = pl.load(k_ref, (pl.dslice(kb * block_k, block_k), slice(None)))
+        v = pl.load(v_ref, (pl.dslice(kb * block_k, block_k), slice(None)))
+        s = q @ k.astype(jnp.float32).T             # [bq, bk]
+        if causal:
+            q_pos = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + p @ v.astype(jnp.float32)
+        return acc_new, l_new, m_new
+
+    d = q.shape[-1]
+    acc = jnp.zeros((bq, v_ref.shape[-1]), jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+
+    if causal:
+        # only key blocks up to (and including) this q block contribute
+        last = (q_idx + 1) * bq // block_k
+        n_iter = jnp.minimum(n_kb, jnp.maximum(last, 1))
+    else:
+        n_iter = n_kb
+    acc, l, m = jax.lax.fori_loop(0, n_iter, body, (acc, l, m))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=256, interpret=False):
+    """q/k/v: [B, H, T, D] → [B, H, T, D]."""
+    if not _HAS_PALLAS:
+        raise NotImplementedError("pallas unavailable")
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    scale = scale if scale is not None else D ** -0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    if T % block_q or S % block_k:
+        raise NotImplementedError("seq len must tile")
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, v.shape[-1])
+
+    grid = (B * H, T // block_q)
+    out = pl.pallas_call(
+        functools.partial(_fa_kernel, block_k=block_k, causal=causal,
+                          scale=scale, seq_len=S),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, vr.shape[-1]), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, vr.shape[-1]),
+                               lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, vr.shape[-1]), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, T, vr.shape[-1])

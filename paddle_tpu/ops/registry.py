@@ -1,0 +1,51 @@
+"""Op kernel registry.
+
+Parity: paddle/fluid/framework/op_registry.h — the reference registers
+per-device C++ kernels under op type strings. Here each op type maps to
+ONE pure JAX function; device specialization is XLA's job at compile time,
+not the registry's. Programs stay serializable because Operators carry
+only the type string.
+
+Kernel signature:
+    fn(ctx, ins: dict[slot -> list[Array]], attrs: dict) -> dict[slot -> list[Array]]
+
+`ctx` (ops.registry.KernelCtx) provides:
+    .key      per-op PRNG key (deterministic: fold_in(program seed, op index))
+    .is_test  executor mode (inference disables dropout etc.)
+    .place    the target Place
+"""
+
+__all__ = ["kernel", "get_kernel", "has_kernel", "KernelCtx", "KERNELS"]
+
+KERNELS = {}
+
+
+class KernelCtx:
+    def __init__(self, key=None, is_test=False, place=None):
+        self.key = key
+        self.is_test = is_test
+        self.place = place
+
+
+def kernel(*types):
+    """Decorator registering fn under one or more op type names."""
+    def deco(fn):
+        for t in types:
+            if t in KERNELS:
+                raise ValueError(f"duplicate kernel registration: {t}")
+            KERNELS[t] = fn
+        return fn
+    return deco
+
+
+def get_kernel(type):
+    fn = KERNELS.get(type)
+    if fn is None:
+        raise NotImplementedError(
+            f"no kernel registered for op type {type!r} "
+            f"(registered: {len(KERNELS)} ops)")
+    return fn
+
+
+def has_kernel(type):
+    return type in KERNELS

@@ -1,0 +1,468 @@
+"""Optimizers.
+
+Parity: python/paddle/fluid/optimizer.py — SGD/Momentum/Adam/Adagrad/
+Adadelta/RMSProp/Adamax/Ftrl/Lamb/LarsMomentum + ModelAverage/EMA.
+`minimize(loss)` appends (1) the backward macro (core/backward.py),
+(2) regularization ops, (3) clip ops, (4) one update op per parameter —
+all into the SAME program, so the entire train step (fwd+bwd+update)
+compiles as one XLA module with donated param buffers.
+"""
+import numpy as np
+
+from . import unique_name
+from .core.framework import default_startup_program, grad_var_name
+from .core.backward import append_backward
+from .initializer import ConstantInitializer
+from .clip import append_gradient_clip_ops
+from .regularizer import append_regularization_ops
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "Adamax", "Adagrad", "Adadelta",
+    "RMSProp", "Ftrl", "Lamb", "LarsMomentum", "DecayedAdagrad",
+    "SGDOptimizer", "MomentumOptimizer", "AdamOptimizer", "AdamaxOptimizer",
+    "AdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
+    "FtrlOptimizer", "LambOptimizer", "LarsMomentumOptimizer",
+    "DecayedAdagradOptimizer", "ModelAverage", "ExponentialMovingAverage",
+]
+
+
+class Optimizer:
+    op_type = None
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._lr = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators = {}   # name -> {param_name: var}
+        self._lr_var = None
+
+    # ------------------------------------------------------------------
+    def _create_lr_var(self, block):
+        if hasattr(self._lr, "name"):       # scheduler output Variable
+            self._lr_var = self._lr
+            return
+        if self._lr_var is not None:
+            return
+        helper = LayerHelper(self.__class__.__name__.lower() + "_lr")
+        var = helper.create_global_variable(
+            [1], "float32", persistable=True,
+            name=unique_name.generate("learning_rate"))
+        helper.set_variable_initializer(
+            var, ConstantInitializer(float(self._lr)))
+        self._lr_var = var
+
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype="float32"):
+        helper = LayerHelper(f"{name}_acc")
+        var = helper.create_global_variable(
+            shape or list(param.shape), dtype, persistable=True,
+            name=unique_name.generate(f"{param.name}_{name}"))
+        helper.set_variable_initializer(var, ConstantInitializer(fill_value))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    def _create_accumulators(self, block, params):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return append_backward(loss, parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        block = params_grads[0][0].block.program.global_block()
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        self._create_lr_var(block)
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        ops = []
+        for pg in params_grads:
+            op = self._append_optimize_op(block, pg)
+            op.attrs["is_optimizer_op"] = True
+            ops.append(op)
+        return ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+
+class SGD(Optimizer):
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "sgd",
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_var]},
+            {"ParamOut": [p]}, {})
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            {"Param": [p], "Grad": [g], "Velocity": [v],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p], "VelocityOut": [v]},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentum(Momentum):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, momentum, **kw)
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            {"Param": [p], "Grad": [g], "Velocity": [v],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p], "VelocityOut": [v]},
+            {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+             "lars_weight_decay": self._lars_weight_decay})
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, fill_value=1.0, shape=[1])
+            self._add_accumulator("beta2_pow", p, fill_value=1.0, shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adam",
+            {"Param": [p], "Grad": [g],
+             "Moment1": [self._get_accumulator("moment1", p)],
+             "Moment2": [self._get_accumulator("moment2", p)],
+             "Beta1Pow": [self._get_accumulator("beta1_pow", p)],
+             "Beta2Pow": [self._get_accumulator("beta2_pow", p)],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p],
+             "Moment1Out": [self._get_accumulator("moment1", p)],
+             "Moment2Out": [self._get_accumulator("moment2", p)],
+             "Beta1PowOut": [self._get_accumulator("beta1_pow", p)],
+             "Beta2PowOut": [self._get_accumulator("beta2_pow", p)]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adamax",
+            {"Param": [p], "Grad": [g],
+             "Moment": [self._get_accumulator("moment", p)],
+             "InfNorm": [self._get_accumulator("inf_norm", p)],
+             "Beta1Pow": [self._get_accumulator("beta1_pow", p)],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p],
+             "MomentOut": [self._get_accumulator("moment", p)],
+             "InfNormOut": [self._get_accumulator("inf_norm", p)],
+             "Beta1PowOut": [self._get_accumulator("beta1_pow", p)]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adagrad",
+            {"Param": [p], "Grad": [g],
+             "Moment": [self._get_accumulator("moment", p)],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p], "MomentOut": [self._get_accumulator("moment", p)]},
+            {"epsilon": self._epsilon})
+
+
+class DecayedAdagrad(Adagrad):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, epsilon=epsilon, **kw)
+        self._decay = decay
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "decayed_adagrad",
+            {"Param": [p], "Grad": [g],
+             "Moment": [self._get_accumulator("moment", p)],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p], "MomentOut": [self._get_accumulator("moment", p)]},
+            {"decay": self._decay, "epsilon": self._epsilon})
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adadelta",
+            {"Param": [p], "Grad": [g],
+             "AvgSquaredGrad": [self._get_accumulator("avg_squared_grad", p)],
+             "AvgSquaredUpdate": [self._get_accumulator("avg_squared_update", p)],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p],
+             "AvgSquaredGradOut": [self._get_accumulator("avg_squared_grad", p)],
+             "AvgSquaredUpdateOut": [self._get_accumulator("avg_squared_update", p)]},
+            {"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("moment", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        ins = {"Param": [p], "Grad": [g],
+               "MeanSquare": [self._get_accumulator("mean_square", p)],
+               "Moment": [self._get_accumulator("moment", p)],
+               "LearningRate": [self._lr_var]}
+        outs = {"ParamOut": [p],
+                "MeanSquareOut": [self._get_accumulator("mean_square", p)],
+                "MomentOut": [self._get_accumulator("moment", p)]}
+        if self._centered:
+            ins["MeanGrad"] = [self._get_accumulator("mean_grad", p)]
+            outs["MeanGradOut"] = [self._get_accumulator("mean_grad", p)]
+        return block.append_op(
+            "rmsprop", ins, outs,
+            {"decay": self._rho, "epsilon": self._epsilon,
+             "momentum": self._momentum, "centered": self._centered})
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "ftrl",
+            {"Param": [p], "Grad": [g],
+             "SquaredAccumulator": [self._get_accumulator("squared", p)],
+             "LinearAccumulator": [self._get_accumulator("linear", p)],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p],
+             "SquaredAccumOut": [self._get_accumulator("squared", p)],
+             "LinearAccumOut": [self._get_accumulator("linear", p)]},
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, fill_value=1.0, shape=[1])
+            self._add_accumulator("beta2_pow", p, fill_value=1.0, shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "lamb",
+            {"Param": [p], "Grad": [g],
+             "Moment1": [self._get_accumulator("moment1", p)],
+             "Moment2": [self._get_accumulator("moment2", p)],
+             "Beta1Pow": [self._get_accumulator("beta1_pow", p)],
+             "Beta2Pow": [self._get_accumulator("beta2_pow", p)],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p],
+             "Moment1Out": [self._get_accumulator("moment1", p)],
+             "Moment2Out": [self._get_accumulator("moment2", p)],
+             "Beta1PowOut": [self._get_accumulator("beta1_pow", p)],
+             "Beta2PowOut": [self._get_accumulator("beta2_pow", p)]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon, "weight_decay": self._wd})
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (ref optimizer.py:ExponentialMovingAverage).
+    update() appends in-graph EMA ops; apply()/restore() swap scope values."""
+
+    def __init__(self, decay=0.999, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._pairs = []
+        self._counter_name = None
+
+    def update(self):
+        from .core.framework import default_main_program
+        block = default_main_program().global_block()
+        helper = LayerHelper(self._name)
+        # step counter for bias correction (ref debiases by 1/(1-decay^t))
+        counter = helper.create_global_variable(
+            [1], "float32", persistable=True,
+            name=unique_name.generate(f"{self._name}_step"))
+        helper.set_variable_initializer(counter, ConstantInitializer(0.0))
+        block.append_op("increment", {"X": [counter]}, {"Out": [counter]},
+                        {"step": 1.0, "is_train_only": True})
+        self._counter_name = counter.name
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            ema = helper.create_global_variable(
+                list(p.shape), p.dtype, persistable=True,
+                name=unique_name.generate(f"{p.name}_ema"))
+            helper.set_variable_initializer(ema, ConstantInitializer(0.0))
+            scaled_old = block.create_var(
+                name=unique_name.generate(f"{p.name}_ema_t"),
+                shape=p.shape, dtype=p.dtype, stop_gradient=True)
+            block.append_op("scale", {"X": [ema]}, {"Out": [scaled_old]},
+                            {"scale": self._decay, "is_train_only": True})
+            scaled_new = block.create_var(
+                name=unique_name.generate(f"{p.name}_ema_t"),
+                shape=p.shape, dtype=p.dtype, stop_gradient=True)
+            block.append_op("scale", {"X": [p]}, {"Out": [scaled_new]},
+                            {"scale": 1.0 - self._decay,
+                             "is_train_only": True})
+            block.append_op("elementwise_add",
+                            {"X": [scaled_old], "Y": [scaled_new]},
+                            {"Out": [ema]},
+                            {"axis": -1, "is_train_only": True})
+            self._pairs.append((p.name, ema.name))
+
+    def apply(self, executor, need_restore=True):
+        import contextlib
+        import numpy as _np
+
+        @contextlib.contextmanager
+        def guard():
+            from .core.scope import global_scope
+            scope = global_scope()
+            t = 0.0
+            if self._counter_name is not None:
+                cv = scope.get(self._counter_name)
+                if cv is not None:
+                    t = float(_np.asarray(cv).reshape(-1)[0])
+            debias = 1.0 - self._decay ** t if t > 0 else 1.0
+            saved = {}
+            for pname, ename in self._pairs:
+                saved[pname] = scope.get(pname)
+                ema_val = scope.get(ename)
+                if ema_val is not None:
+                    scope.set(pname, _np.asarray(ema_val) / max(debias, 1e-12))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for pname, val in saved.items():
+                        scope.set(pname, val)
+        return guard()
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window param average (ref optimizer.py:ModelAverage) —
+    implemented as EMA (the TPU-friendly constant-memory equivalent)."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, **kw):
+        super().__init__(learning_rate=0.0)
+        decay = 1.0 - 1.0 / max(min_average_window, 2)
+        self._ema = ExponentialMovingAverage(decay=decay)
+
+    def update(self):
+        self._ema.update()
+
+    def apply(self, executor, need_restore=True):
+        return self._ema.apply(executor, need_restore)
+
+    def restore(self, executor):
+        pass
+
+
+# Fluid-style aliases (ref exposes both `SGD` and `SGDOptimizer`)
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+AdagradOptimizer = Adagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+LambOptimizer = Lamb
+LarsMomentumOptimizer = LarsMomentum
+DecayedAdagradOptimizer = DecayedAdagrad

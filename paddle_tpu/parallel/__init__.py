@@ -1,0 +1,17 @@
+"""Distributed / parallel execution.
+
+Parity: ref parallel_executor.py + transpiler/distribute_transpiler.py +
+operators/distributed (gRPC pserver, NCCL). TPU-native design: a
+jax.sharding.Mesh with named axes (dp/tp/sp/pp), sharding annotations,
+and XLA collectives over ICI — see SURVEY §2.4/§6.
+"""
+from . import mesh
+from .mesh import make_mesh, local_mesh, axis_size
+from . import collective
+from . import parallel_executor
+from .parallel_executor import ParallelExecutor
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from . import ring_attention
+from . import sharding
+from . import fleet

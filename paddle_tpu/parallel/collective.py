@@ -1,0 +1,73 @@
+"""Collective communication API.
+
+Parity: paddle/fluid/operators/distributed + NCCL ops (allreduce,
+broadcast, allgather) and the gRPC send/recv pserver ops. Here every
+collective is an XLA primitive over named mesh axes — inside jit/
+shard_map these compile to ICI/DCN collectives; there is no separate
+runtime to manage (no rendezvous, no nccl communicator setup — XLA owns
+scheduling/overlap).
+
+Functions are meant to be called INSIDE shard_map-ped functions (axis
+names bound by the enclosing mesh).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "all_to_all", "ppermute", "barrier", "psum", "pmean", "pmax",
+           "axis_index"]
+
+
+def all_reduce(x, op="sum", axis_name="dp"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "prod":
+        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+    raise ValueError(f"unsupported all_reduce op {op!r}")
+
+
+psum = lambda x, axis_name="dp": lax.psum(x, axis_name)
+pmean = lambda x, axis_name="dp": lax.pmean(x, axis_name)
+pmax = lambda x, axis_name="dp": lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name="dp", axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name="dp", scatter_axis=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def broadcast(x, root=0, axis_name="dp"):
+    """Root's value on every member: psum of the root-masked value —
+    no gathered 8x buffer, lowers to one collective."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def all_to_all(x, axis_name="sp", split_axis=0, concat_axis=0):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, perm, axis_name="sp"):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name="dp"):
+    return lax.axis_index(axis_name)
+
+
+def barrier(axis_name="dp"):
+    """psum of a scalar — the XLA equivalent of a device barrier."""
+    return lax.psum(jnp.ones(()), axis_name)

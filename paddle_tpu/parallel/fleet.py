@@ -1,0 +1,67 @@
+"""Fleet-style high-level distributed API.
+
+Parity: the reference era's paddle.fluid.incubate.fleet — init() +
+distributed_optimizer() + worker introspection, mapped onto the mesh/
+jax.distributed world.
+"""
+import jax
+
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+
+__all__ = ["init", "distributed_optimizer", "worker_num", "worker_index",
+           "is_first_worker", "barrier_all"]
+
+_state = {"initialized": False, "transpiler": None}
+
+
+def init(role_maker=None, coordinator_address=None, num_processes=None,
+         process_id=None):
+    """Single-host: no-op. Multi-host: jax.distributed.initialize — after
+    which jax.devices() spans the pod and the SAME mesh code works."""
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    _state["initialized"] = True
+
+
+def worker_num():
+    return jax.process_count()
+
+
+def worker_index():
+    return jax.process_index()
+
+
+def is_first_worker():
+    return jax.process_index() == 0
+
+
+def barrier_all():
+    # blocking collective across all devices
+    import jax.numpy as jnp
+    jax.block_until_ready(
+        jax.jit(lambda x: x + 1)(jnp.zeros(())))
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap an Optimizer so minimize() also prepares the distributed
+    sharding plan (ref fleet.distributed_optimizer)."""
+    cfg = strategy or DistributeTranspilerConfig()
+
+    class _Wrapped:
+        def __init__(self, inner):
+            self._inner = inner
+            self.transpiler = None
+
+        def minimize(self, loss, **kw):
+            result = self._inner.minimize(loss, **kw)
+            t = DistributeTranspiler(cfg)
+            t.transpile(program=loss.block.program)
+            self.transpiler = t
+            _state["transpiler"] = t
+            return result
+
+        def __getattr__(self, k):
+            return getattr(self._inner, k)
+
+    return _Wrapped(optimizer)

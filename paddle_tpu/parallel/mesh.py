@@ -1,0 +1,47 @@
+"""Device mesh helpers.
+
+The reference scales by spawning one CUDA stream/process per GPU and
+wiring NCCL rings (paddle/fluid/framework/details/*_ssa_graph*); here a
+single SPMD program spans a jax.sharding.Mesh. Axis conventions:
+    dp — data parallel (batch)
+    tp — tensor/model parallel (Megatron-style)
+    sp — sequence/context parallel (ring attention)
+    pp — pipeline stages
+Multi-host: the same Mesh API spans hosts after
+jax.distributed.initialize(); dp/pp map naturally onto DCN, tp/sp onto
+ICI (scaling-book layout).
+"""
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+__all__ = ["make_mesh", "local_mesh", "axis_size", "P", "NamedSharding",
+           "Mesh"]
+
+P = PartitionSpec
+
+
+def make_mesh(dp=1, tp=1, sp=1, pp=1, devices=None):
+    """Create a Mesh with the canonical axis order (pp, dp, sp, tp).
+
+    tp/sp innermost → neighboring devices (fastest ICI links) carry the
+    highest-bandwidth collectives, dp outermost → gradient all-reduce can
+    cross DCN on multi-host."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp * sp * pp
+    if need > len(devices):
+        raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(pp, dp, sp, tp)
+    return Mesh(arr, axis_names=("pp", "dp", "sp", "tp"))
+
+
+def local_mesh(axis="dp", devices=None):
+    """1-D mesh over all local devices (the ParallelExecutor default —
+    the analog of the reference's use_cuda=True all-GPU setup)."""
+    devices = list(devices if devices is not None else jax.devices())
+    arr = np.asarray(devices)
+    return Mesh(arr, axis_names=(axis,))
+
+
+def axis_size(mesh, name):
+    return mesh.shape[name] if name in mesh.shape else 1

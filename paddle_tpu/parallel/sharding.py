@@ -1,0 +1,62 @@
+"""Parameter/activation sharding rules.
+
+TPU-native replacement for the reference's distribute_transpiler param
+splitting (python/paddle/fluid/transpiler/distribute_transpiler.py:
+slice_variable → pserver blocks). Rules produce PartitionSpecs per
+parameter name for Megatron-style tensor parallel and ZeRO-style
+optimizer-state sharding — XLA moves the bytes over ICI.
+"""
+import re
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingRules", "megatron_rules", "zero_stage", "spec_for"]
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) table with a default."""
+
+    def __init__(self, rules=None, default=P()):
+        self.rules = list(rules or [])
+        self.default = default
+
+    def add(self, pattern, spec):
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec(self, name, ndim=None):
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        return self.default
+
+    def shardings(self, mesh, names):
+        return {n: NamedSharding(mesh, self.spec(n)) for n in names}
+
+
+def megatron_rules(tp_axis="tp"):
+    """Column-parallel first FF / QKV, row-parallel second FF / out-proj,
+    vocab-parallel embedding — the standard Megatron layout."""
+    r = ShardingRules()
+    r.add(r"(_q|_k|_v|ffn1|fc1|col)\S*\.w", P(None, tp_axis))
+    r.add(r"(_o|ffn2|fc2|row)\S*\.w", P(tp_axis, None))
+    r.add(r"embedding\S*\.w", P(tp_axis, None))
+    return r
+
+
+def zero_stage(mesh, names, axis="dp"):
+    """ZeRO-1 layout: optimizer accumulators sharded along dp — the
+    TPU-native pserver analog (each dp member owns a param shard's
+    state, like each pserver owned a param block in the reference)."""
+    specs = {}
+    for n in names:
+        if any(t in n for t in ("moment", "velocity", "_acc", "beta",
+                                "mean_square", "inf_norm")):
+            specs[n] = NamedSharding(mesh, P(axis))
+        else:
+            specs[n] = NamedSharding(mesh, P())
+    return specs
+
+
+def spec_for(var_name, rules, mesh):
+    return NamedSharding(mesh, rules.spec(var_name))

@@ -1,0 +1,47 @@
+"""ParamAttr — per-parameter configuration.
+
+Parity: python/paddle/fluid/param_attr.py (name, initializer, lr scale,
+regularizer, trainable, gradient clip).
+"""
+from .initializer import XavierInitializer, ConstantInitializer
+
+__all__ = ["ParamAttr", "WeightNormParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, gradient_clip=None,
+                 do_model_average=False):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.gradient_clip = gradient_clip
+        self.do_model_average = do_model_average
+
+    @staticmethod
+    def _to_attr(arg):
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, (list, tuple)):
+            return [ParamAttr._to_attr(a) for a in arg]
+        if arg is False:
+            return False
+        # an Initializer instance
+        return ParamAttr(initializer=arg)
+
+    def _default_initializer(self, default=None):
+        if self.initializer is not None:
+            return self.initializer
+        return default if default is not None else XavierInitializer()
+
+
+class WeightNormParamAttr(ParamAttr):
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

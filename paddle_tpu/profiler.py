@@ -1,0 +1,75 @@
+"""Profiler.
+
+Parity: python/paddle/fluid/profiler.py + platform/profiler.cc — here
+backed by jax.profiler (XLA/TPU traces viewable in TensorBoard /
+Perfetto) plus a host-side wall-clock summary table.
+"""
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "record_event", "summary"]
+
+_records = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_trace_dir = None
+
+
+def start_profiler(state="All", tracer_option=None, log_dir="/tmp/ptpu_prof"):
+    global _trace_dir
+    _trace_dir = log_dir
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception:
+        _trace_dir = None
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    global _trace_dir
+    if _trace_dir is not None:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _trace_dir = None
+    return summary(sorted_key)
+
+
+def reset_profiler():
+    _records.clear()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None,
+             log_dir="/tmp/ptpu_prof"):
+    start_profiler(state, log_dir=log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """Host-side timing + device annotation (jax named scope)."""
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        rec = _records[name]
+        rec[0] += 1
+        rec[1] += dt
+
+
+def summary(sorted_key="total"):
+    rows = [(name, c, tot, tot / max(c, 1))
+            for name, (c, tot) in _records.items()]
+    rows.sort(key=lambda r: -r[2])
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"]
+    for name, c, tot, avg in rows:
+        lines.append(f"{name:<40}{c:>8}{tot:>12.4f}{avg:>12.4f}")
+    report = "\n".join(lines)
+    return report

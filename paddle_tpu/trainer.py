@@ -1,0 +1,167 @@
+"""High-level Trainer / Inferencer.
+
+Parity: python/paddle/fluid/trainer.py + inferencer.py — train_func-based
+loop with event callbacks (BeginEpochEvent/EndStepEvent...), checkpoint
+config, and test(); and an Inferencer wrapping load_inference_model.
+"""
+import os
+import time
+
+import numpy as np
+
+from .core.framework import Program, program_guard
+from .core.executor import Executor
+from .core.place import core_place_of
+from .data_feeder import DataFeeder
+from . import io as _io
+
+__all__ = ["Trainer", "Inferencer", "BeginEpochEvent", "EndEpochEvent",
+           "BeginStepEvent", "EndStepEvent", "CheckpointConfig"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or "/tmp/paddle_tpu_ckpt"
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+
+
+class Trainer:
+    """ref trainer.py:Trainer — builds train/startup programs from
+    train_func, runs the loop, owns checkpointing."""
+
+    def __init__(self, train_func, optimizer_func, place=None,
+                 param_path=None, parallel=False, checkpoint_config=None):
+        self.place = core_place_of(place)
+        self.parallel = parallel
+        self.checkpoint_cfg = checkpoint_config
+        self.train_program = Program()
+        self.startup_program = Program()
+        with program_guard(self.train_program, self.startup_program):
+            outs = train_func()
+            if isinstance(outs, (list, tuple)):
+                self.loss = outs[0]
+                self.fetch_vars = list(outs)
+            else:
+                self.loss = outs
+                self.fetch_vars = [outs]
+            self.test_program = self.train_program.clone(for_test=True)
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss)
+        self.exe = Executor(self.place)
+        self.exe.run(self.startup_program)
+        if param_path:
+            _io.load_params(self.exe, param_path)
+        self._step = 0
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        feed_vars = [self.train_program.global_block().var(n)
+                     for n in feed_order]
+        feeder = DataFeeder(feed_vars, self.place)
+        runner = self.exe
+        if self.parallel:
+            from .parallel.parallel_executor import ParallelExecutor
+            runner = ParallelExecutor(loss_name=self.loss.name,
+                                      main_program=self.train_program)
+        for epoch in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch))
+            for step, data in enumerate(reader()):
+                begin = BeginStepEvent(epoch, step)
+                event_handler(begin)
+                fetch = self.fetch_vars if begin.fetch_metrics else []
+                if self.parallel:
+                    metrics = runner.run(feed=feeder.feed(data),
+                                         fetch_list=fetch)
+                else:
+                    metrics = runner.run(self.train_program,
+                                         feed=feeder.feed(data),
+                                         fetch_list=fetch)
+                self._step += 1
+                if (self.checkpoint_cfg and
+                        self._step % self.checkpoint_cfg.step_interval == 0):
+                    _io.save_checkpoint(self.exe,
+                                        self.checkpoint_cfg.checkpoint_dir,
+                                        self.train_program, step=self._step)
+                event_handler(EndStepEvent(epoch, step, metrics))
+            event_handler(EndEpochEvent(epoch))
+
+    def test(self, reader, feed_order):
+        feed_vars = [self.test_program.global_block().var(n)
+                     for n in feed_order]
+        feeder = DataFeeder(feed_vars, self.place)
+        totals = None
+        count = 0
+        for data in reader():
+            vals = self.exe.run(self.test_program, feed=feeder.feed(data),
+                                fetch_list=self.fetch_vars, is_test=True)
+            vals = [np.mean(v) for v in vals]
+            totals = vals if totals is None else [a + b for a, b in zip(totals, vals)]
+            count += 1
+        return [t / max(count, 1) for t in (totals or [])]
+
+    def save_params(self, param_path):
+        _io.save_params(self.exe, param_path, self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes=(0,)):
+        targets = [self.fetch_vars[i] for i in target_var_indexes]
+        _io.save_inference_model(param_path, feeded_var_names, targets,
+                                 self.exe, self.train_program)
+
+    def stop(self):
+        pass
+
+
+class Inferencer:
+    """ref inferencer.py:Inferencer."""
+
+    def __init__(self, infer_func=None, param_path=None, place=None,
+                 parallel=False):
+        self.place = core_place_of(place)
+        self.exe = Executor(self.place)
+        if infer_func is not None:
+            self.program = Program()
+            startup = Program()
+            with program_guard(self.program, startup):
+                outs = infer_func()
+                self.fetch_vars = outs if isinstance(outs, (list, tuple)) else [outs]
+                self.feed_names = [v.name for v in self.program.list_vars()
+                                   if v.is_data]
+            self.exe.run(startup)
+            if param_path:
+                _io.load_params(self.exe, param_path)
+            self.program = self.program.clone(for_test=True)
+        else:
+            self.program, self.feed_names, self.fetch_vars = \
+                _io.load_inference_model(param_path, self.exe)
+
+    def infer(self, inputs, return_numpy=True):
+        return self.exe.run(self.program, feed=inputs,
+                            fetch_list=self.fetch_vars,
+                            return_numpy=return_numpy, is_test=True)

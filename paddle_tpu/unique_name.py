@@ -1,0 +1,44 @@
+"""Unique name generator.
+
+Parity: python/paddle/fluid/unique_name.py (reference). Provides generate(),
+guard(), switch() so layer helpers can mint stable, per-program-unique
+variable/op names.
+"""
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class UniqueNameGenerator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key):
+        if key not in self.ids:
+            self.ids[key] = 0
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return f"{key}_{tmp}"
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    return generator(key)
+
+
+def switch(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,71 @@
+"""End-to-end: MNIST MLP trains and converges (PR1 parity —
+ref benchmark/fluid/models/mnist.py on CPUPlace; BASELINE.json config 1)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _mnist_batches(n_batches, batch_size=64, seed=0):
+    from paddle_tpu.dataset import mnist
+    reader = pt.reader.batch(mnist.train(), batch_size)
+    feeder = None
+    out = []
+    for i, batch in enumerate(reader()):
+        if i >= n_batches:
+            break
+        imgs = np.stack([b[0] for b in batch])
+        lbls = np.asarray([[b[1]] for b in batch], dtype=np.int64)
+        out.append((imgs, lbls))
+    return out
+
+
+def test_mnist_mlp_converges():
+    img = layers.data("img", shape=[784])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=128, act="relu")
+    pred = layers.fc(h, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    acc = layers.accuracy(pred, label)
+    opt = pt.optimizer.Adam(learning_rate=1e-3)
+    opt.minimize(loss)
+
+    place = pt.CPUPlace()
+    exe = pt.Executor(place)
+    exe.run(pt.default_startup_program())
+
+    batches = _mnist_batches(30)
+    losses = []
+    for imgs, lbls in batches:
+        lv, av = exe.run(feed={"img": imgs, "label": lbls},
+                         fetch_list=[loss, acc])
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, f"no convergence: {losses[:3]} -> {losses[-3:]}"
+
+
+def test_fetch_intermediate_and_cache():
+    img = layers.data("img", shape=[784])
+    h = layers.fc(img, size=32, act="relu")
+    out = layers.reduce_mean(h)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    x = np.random.RandomState(0).randn(4, 784).astype("float32")
+    r1 = exe.run(feed={"img": x}, fetch_list=[out, h])
+    r2 = exe.run(feed={"img": x}, fetch_list=[out, h])
+    assert r1[1].shape == (4, 32)
+    np.testing.assert_allclose(r1[0], r2[0], rtol=1e-6)
+
+
+def test_startup_is_deterministic_per_seed():
+    prog = pt.Program()
+    startup = pt.Program()
+    with pt.program_guard(prog, startup):
+        img = layers.data("img", shape=[16])
+        h = layers.fc(img, size=8)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    w_name = prog.all_parameters()[0].name
+    w1 = np.asarray(pt.global_scope().get(w_name))
+    assert w1.shape == (16, 8)
+    assert np.abs(w1).sum() > 0  # xavier init, not zeros
