@@ -1,8 +1,8 @@
 """Benchmark entry — prints ONE JSON line with the headline metric.
 
-Flagship: train-step throughput on the real chip. Until the Transformer
-model lands this measures the MNIST-MLP train step (BASELINE PR1 config);
-it will be upgraded to Transformer tokens/sec.
+Flagship: Transformer train-step throughput (tokens/sec) on the real
+chip — the BASELINE.json "Transformer-base NMT" config, sized to the
+single v5e chip the driver provides.
 """
 import json
 import time
@@ -12,31 +12,65 @@ import numpy as np
 
 def main():
     import jax
-    fn, (persist, feed, key) = __import__("__graft_entry__").entry()
-    jfn = jax.jit(fn, donate_argnums=(0,))
-    # warmup/compile
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.core.trace import build_step_fn
+    from paddle_tpu.models import transformer as tfm
+
+    B, T = 32, 128
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            cfg = tfm.TransformerConfig(
+                src_vocab=8000, trg_vocab=8000, max_len=T,
+                d_model=512, d_inner=2048, n_head=8, n_layer=6,
+                dropout=0.1)
+            feeds, avg_cost, tok = tfm.build_program(cfg, maxlen=T)
+            pt.optimizer.Adam(1e-3).minimize(avg_cost)
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        persist = {v.name: scope.get(v.name)
+                   for v in main_p.persistable_vars()}
+
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, cfg.src_vocab, (B, T)).astype("int32")
+    trg = np.concatenate([np.zeros((B, 1), "int32"),
+                          (src[:, :-1] + 1) % cfg.trg_vocab], axis=1)
+    feed = {"src": jnp.asarray(src),
+            "src_len": jnp.full(B, T, jnp.int32),
+            "trg": jnp.asarray(trg),
+            "trg_len": jnp.full(B, T, jnp.int32),
+            "label": jnp.asarray((src + 1) % cfg.trg_vocab, jnp.int32)}
+    key = jax.random.PRNGKey(0)
+
+    step_fn = build_step_fn(main_p, [avg_cost.name], False, None)
+    jfn = jax.jit(step_fn, donate_argnums=(0,))
     fetches, persist = jfn(persist, feed, key)
     jax.block_until_ready(fetches)
-    n = 50
+
+    n = 30
     t0 = time.perf_counter()
-    for i in range(n):
+    for _ in range(n):
         fetches, persist = jfn(persist, feed, key)
     jax.block_until_ready(fetches)
     dt = time.perf_counter() - t0
-    steps_per_sec = n / dt
-    samples_per_sec = steps_per_sec * feed["img"].shape[0]
+    tokens_per_sec = n * B * T / dt
 
     baseline = None
     try:
         with open("BASELINE.json") as f:
-            baseline = json.load(f).get("published", {}).get("samples_per_sec")
+            baseline = json.load(f).get("published", {}).get(
+                "transformer_tokens_per_sec")
     except Exception:
         pass
-    vs = samples_per_sec / baseline if baseline else 1.0
+    vs = tokens_per_sec / baseline if baseline else 1.0
     print(json.dumps({
-        "metric": "mnist_mlp_train_samples_per_sec",
-        "value": round(samples_per_sec, 2),
-        "unit": "samples/sec",
+        "metric": "transformer_base_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
         "vs_baseline": round(vs, 3),
     }))
 

@@ -1,1 +1,52 @@
-"""placeholder — filled in later this round"""
+"""DeepFM CTR model (BASELINE.json config 5: high-dim sparse embedding +
+factorization machine + deep tower). Reference pattern: Paddle CTR
+models (pserver-era); here the embedding is a dense MXU gather and the
+whole model compiles into one XLA module.
+"""
+from .. import layers
+
+__all__ = ["deepfm", "build_program"]
+
+
+def deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim=10,
+           deep_layers=(400, 400, 400)):
+    """feat_ids/feat_vals: [B, num_fields(,1)] sparse-feature ids+values."""
+    # ---- first-order term: w_i * x_i
+    first_w = layers.embedding(feat_ids, size=[vocab_size, 1])   # [B,F,1]
+    vals = layers.unsqueeze(feat_vals, [2]) \
+        if len(feat_vals.shape) == 2 else feat_vals
+    first = layers.reduce_sum(
+        layers.elementwise_mul(layers.squeeze(first_w, [2]),
+                               layers.squeeze(vals, [2])), dim=1,
+        keep_dim=True)                                            # [B,1]
+    # ---- second-order FM term: 0.5*((sum v x)^2 - sum (v x)^2)
+    emb = layers.embedding(feat_ids, size=[vocab_size, embed_dim])  # [B,F,D]
+    vx = layers.elementwise_mul(emb, vals)                        # broadcast
+    sum_vx = layers.reduce_sum(vx, dim=1)                         # [B,D]
+    sum_sq = layers.elementwise_mul(sum_vx, sum_vx)
+    sq = layers.elementwise_mul(vx, vx)
+    sq_sum = layers.reduce_sum(sq, dim=1)
+    second = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum), dim=1,
+                          keep_dim=True), 0.5)                    # [B,1]
+    # ---- deep tower
+    deep = layers.reshape(vx, [0, num_fields * embed_dim])
+    for width in deep_layers:
+        deep = layers.fc(deep, size=width, act="relu")
+    deep_out = layers.fc(deep, size=1)
+    logit = layers.elementwise_add(layers.elementwise_add(first, second),
+                                   deep_out)
+    return logit
+
+
+def build_program(num_fields=26, vocab_size=100000, embed_dim=10):
+    feat_ids = layers.data("feat_ids", shape=[num_fields], dtype="int64")
+    feat_vals = layers.data("feat_vals", shape=[num_fields],
+                            dtype="float32")
+    label = layers.data("label", shape=[1], dtype="float32")
+    logit = deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label))
+    from ..layers import ops
+    prob = ops.sigmoid(logit)
+    return [feat_ids, feat_vals, label], loss, prob

@@ -1,1 +1,89 @@
-"""placeholder — filled in later this round"""
+"""ResNet (ref benchmark/fluid/models/resnet.py — conv_bn_layer /
+shortcut / bottleneck/basicblock; configs 18/34/50/101/152).
+
+NCHW bf16-friendly: convs lower onto the MXU; batch-norm stats update
+in-graph (see ops/kernels_nn.py:_batch_norm).
+"""
+from .. import layers
+
+__all__ = ["resnet", "resnet_cifar10", "build_program"]
+
+_DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
+    conv = layers.conv2d(input=input, num_filters=ch_out,
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, act=None, bias_attr=False)
+    return layers.batch_norm(input=conv, act=act)
+
+
+def shortcut(input, ch_out, stride):
+    ch_in = int(input.shape[1])
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None)
+    return input
+
+
+def basicblock(input, ch_out, stride):
+    short = shortcut(input, ch_out, stride)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
+    return layers.elementwise_add(short, conv2, act="relu")
+
+
+def bottleneck(input, ch_out, stride):
+    short = shortcut(input, ch_out * 4, stride)
+    conv1 = conv_bn_layer(input, ch_out, 1, 1, 0)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
+    return layers.elementwise_add(short, conv3, act="relu")
+
+
+def layer_warp(block_func, input, ch_out, count, stride):
+    res = block_func(input, ch_out, stride)
+    for _ in range(1, count):
+        res = block_func(res, ch_out, 1)
+    return res
+
+
+def resnet(input, class_dim=1000, depth=50):
+    """ImageNet-shape ResNet (input [N,3,224,224] or smaller)."""
+    kind, counts = _DEPTH_CFG[depth]
+    block = bottleneck if kind == "bottleneck" else basicblock
+    conv = conv_bn_layer(input, 64, 7, 2, 3)
+    pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1,
+                         pool_type="max")
+    res = pool
+    for i, (ch, n) in enumerate(zip([64, 128, 256, 512], counts)):
+        res = layer_warp(block, res, ch, n, 1 if i == 0 else 2)
+    pool = layers.pool2d(res, pool_type="avg", global_pooling=True)
+    return layers.fc(pool, size=class_dim, act="softmax")
+
+
+def resnet_cifar10(input, class_dim=10, depth=32):
+    """ref fluid tests/book resnet_cifar10 (6n+2 layers)."""
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input, 16, 3, 1, 1)
+    res1 = layer_warp(basicblock, conv1, 16, n, 1)
+    res2 = layer_warp(basicblock, res1, 32, n, 2)
+    res3 = layer_warp(basicblock, res2, 64, n, 2)
+    pool = layers.pool2d(res3, pool_type="avg", global_pooling=True)
+    return layers.fc(pool, size=class_dim, act="softmax")
+
+
+def build_program(depth=50, class_dim=1000, image_shape=(3, 224, 224),
+                  lr=0.1):
+    img = layers.data("img", shape=list(image_shape))
+    label = layers.data("label", shape=[1], dtype="int64")
+    predict = resnet(img, class_dim, depth)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return [img, label], avg_cost, acc

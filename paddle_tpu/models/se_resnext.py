@@ -1,1 +1,67 @@
-"""placeholder — filled in later this round"""
+"""SE-ResNeXt-50 (ref benchmark/fluid/models/se_resnext.py — grouped
+bottleneck convs + squeeze-and-excitation gates)."""
+from .. import layers
+
+__all__ = ["se_resnext50", "build_program"]
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None):
+    conv = layers.conv2d(input=input, num_filters=num_filters,
+                         filter_size=filter_size, stride=stride,
+                         padding=(filter_size - 1) // 2, groups=groups,
+                         act=None, bias_attr=False)
+    return layers.batch_norm(input=conv, act=act)
+
+
+def squeeze_excitation(input, num_channels, reduction_ratio=16):
+    pool = layers.pool2d(input, pool_type="avg", global_pooling=True)
+    squeeze = layers.fc(pool, size=num_channels // reduction_ratio,
+                        act="relu")
+    excitation = layers.fc(squeeze, size=num_channels, act="sigmoid")
+    # scale channels: [N,C] -> broadcast mult over [N,C,H,W]
+    excitation = layers.unsqueeze(excitation, [2, 3])
+    return layers.elementwise_mul(input, excitation)
+
+
+def shortcut(input, ch_out, stride):
+    ch_in = int(input.shape[1])
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, cardinality=32,
+                     reduction_ratio=16):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu")
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride,
+                          groups=cardinality, act="relu")
+    conv2 = conv_bn_layer(conv1, num_filters * 2, 1, act=None)
+    scale = squeeze_excitation(conv2, num_filters * 2, reduction_ratio)
+    short = shortcut(input, num_filters * 2, stride)
+    return layers.elementwise_add(short, scale, act="relu")
+
+
+def se_resnext50(input, class_dim=1000):
+    depth = [3, 4, 6, 3]
+    num_filters = [128, 256, 512, 1024]
+    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu")
+    conv = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1,
+                         pool_type="max")
+    for block in range(len(depth)):
+        for i in range(depth[block]):
+            conv = bottleneck_block(
+                conv, num_filters[block],
+                stride=2 if i == 0 and block != 0 else 1)
+    pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
+    drop = layers.dropout(pool, dropout_prob=0.2)
+    return layers.fc(drop, size=class_dim, act="softmax")
+
+
+def build_program(class_dim=1000, image_shape=(3, 224, 224)):
+    img = layers.data("img", shape=list(image_shape))
+    label = layers.data("label", shape=[1], dtype="int64")
+    predict = se_resnext50(img, class_dim)
+    avg_cost = layers.mean(layers.cross_entropy(input=predict, label=label))
+    acc = layers.accuracy(input=predict, label=label)
+    return [img, label], avg_cost, acc

@@ -1,1 +1,33 @@
-"""placeholder — filled in later this round"""
+"""Stacked LSTM sentiment / LM model (ref benchmark/fluid/models/
+stacked_dynamic_lstm.py). Padded [B,T] + lengths replace LoD input."""
+from .. import layers
+
+__all__ = ["stacked_lstm_net", "build_program"]
+
+
+def stacked_lstm_net(data, seq_len, dict_dim, class_dim=2, emb_dim=128,
+                     hid_dim=128, stacked_num=3):
+    emb = layers.embedding(data, size=[dict_dim, emb_dim])
+    fc1 = layers.fc(emb, size=hid_dim, num_flatten_dims=2)
+    lstm1, _ = layers.dynamic_lstm(fc1, size=hid_dim * 4, seq_len=seq_len)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = layers.fc(layers.concat(inputs, axis=2), size=hid_dim,
+                       num_flatten_dims=2)
+        lstm, _ = layers.dynamic_lstm(fc, size=hid_dim * 4, seq_len=seq_len,
+                                      is_reverse=(i % 2) == 0)
+        inputs = [fc, lstm]
+    fc_last = layers.sequence_pool(inputs[0], "max", seq_len=seq_len)
+    lstm_last = layers.sequence_pool(inputs[1], "max", seq_len=seq_len)
+    return layers.fc(layers.concat([fc_last, lstm_last], axis=1),
+                     size=class_dim, act="softmax")
+
+
+def build_program(dict_dim=5147, maxlen=128, class_dim=2):
+    data = layers.data("words", shape=[maxlen], dtype="int64")
+    seq_len = layers.data("words_seq_len", shape=[], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="int64")
+    predict = stacked_lstm_net(data, seq_len, dict_dim, class_dim)
+    avg_cost = layers.mean(layers.cross_entropy(input=predict, label=label))
+    acc = layers.accuracy(input=predict, label=label)
+    return [data, seq_len, label], avg_cost, acc

@@ -1,1 +1,158 @@
-"""placeholder — filled in later this round"""
+"""Transformer-base NMT (ref benchmark/fluid/models/machine_translation.py
++ the fluid book transformer: encoder-decoder, multi-head attention,
+label smoothing, noam LR).
+
+TPU-native notes: padded [B,T] batches + in-graph attention biases from
+sequence lengths (replacing LoD), flash-attention Pallas kernel on the
+hot path, bf16-ready (normalizations compute in fp32).
+"""
+import numpy as np
+
+from .. import layers
+
+__all__ = ["transformer", "build_program", "TransformerConfig"]
+
+
+class TransformerConfig:
+    def __init__(self, src_vocab=10000, trg_vocab=10000, max_len=256,
+                 d_model=512, d_inner=2048, n_head=8, n_layer=6,
+                 dropout=0.1, label_smooth_eps=0.1):
+        self.src_vocab = src_vocab
+        self.trg_vocab = trg_vocab
+        self.max_len = max_len
+        self.d_model = d_model
+        self.d_inner = d_inner
+        self.n_head = n_head
+        self.n_layer = n_layer
+        self.dropout = dropout
+        self.label_smooth_eps = label_smooth_eps
+
+    @staticmethod
+    def base():
+        return TransformerConfig()
+
+    @staticmethod
+    def tiny():
+        return TransformerConfig(src_vocab=128, trg_vocab=128, max_len=32,
+                                 d_model=64, d_inner=128, n_head=4,
+                                 n_layer=2, dropout=0.0)
+
+
+def _pad_bias(seq_len, maxlen):
+    """[B] lengths -> additive attention bias [B,1,1,T] (0 keep / -1e9 pad)."""
+    mask = layers.sequence_mask(seq_len, maxlen=maxlen, dtype="float32")
+    bias = layers.scale(mask, scale=1e9, bias=-1e9)   # 1->0, 0->-1e9
+    return layers.unsqueeze(bias, [1, 2])
+
+
+def _embed(ids, vocab, cfg, name):
+    emb = layers.embedding(ids, size=[vocab, cfg.d_model], name=name)
+    emb = layers.scale(emb, scale=float(np.sqrt(cfg.d_model)))
+    emb = layers.add_position_encoding(emb)
+    if cfg.dropout:
+        emb = layers.dropout(emb, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    return emb
+
+
+def _ffn(x, cfg, name):
+    h = layers.fc(x, cfg.d_inner, num_flatten_dims=2, act="relu",
+                  name=f"{name}_fc1")
+    if cfg.dropout:
+        h = layers.dropout(h, cfg.dropout,
+                           dropout_implementation="upscale_in_train")
+    return layers.fc(h, cfg.d_model, num_flatten_dims=2, name=f"{name}_fc2")
+
+
+def _res_norm(x, residual, cfg):
+    out = layers.elementwise_add(x, residual)
+    return layers.layer_norm(out, begin_norm_axis=2)
+
+
+def encoder(src_emb, src_bias, cfg):
+    x = src_emb
+    for i in range(cfg.n_layer):
+        attn = layers.multi_head_attention(
+            x, x, x, attn_bias=src_bias,
+            d_key=cfg.d_model // cfg.n_head,
+            d_value=cfg.d_model // cfg.n_head,
+            d_model=cfg.d_model, n_head=cfg.n_head,
+            dropout_rate=cfg.dropout, name=f"enc{i}")
+        x = _res_norm(attn, x, cfg)
+        ff = _ffn(x, cfg, f"enc{i}_ffn")
+        x = _res_norm(ff, x, cfg)
+    return x
+
+
+def decoder(trg_emb, enc_out, trg_bias, src_bias, cfg):
+    x = trg_emb
+    for i in range(cfg.n_layer):
+        self_attn = layers.multi_head_attention(
+            x, x, x, attn_bias=trg_bias, causal=True,
+            d_key=cfg.d_model // cfg.n_head,
+            d_value=cfg.d_model // cfg.n_head,
+            d_model=cfg.d_model, n_head=cfg.n_head,
+            dropout_rate=cfg.dropout, name=f"dec{i}_self")
+        x = _res_norm(self_attn, x, cfg)
+        cross = layers.multi_head_attention(
+            x, enc_out, enc_out, attn_bias=src_bias,
+            d_key=cfg.d_model // cfg.n_head,
+            d_value=cfg.d_model // cfg.n_head,
+            d_model=cfg.d_model, n_head=cfg.n_head,
+            dropout_rate=cfg.dropout, name=f"dec{i}_cross")
+        x = _res_norm(cross, x, cfg)
+        ff = _ffn(x, cfg, f"dec{i}_ffn")
+        x = _res_norm(ff, x, cfg)
+    return x
+
+
+def transformer(src, src_len, trg, trg_len, cfg):
+    """Returns per-position logits [B, T_trg, trg_vocab]."""
+    T_src = int(src.shape[1])
+    T_trg = int(trg.shape[1])
+    src_bias = _pad_bias(src_len, T_src)
+    trg_bias = _pad_bias(trg_len, T_trg)
+    enc_in = _embed(src, cfg.src_vocab, cfg, "src_emb")
+    enc_out = encoder(enc_in, src_bias, cfg)
+    dec_in = _embed(trg, cfg.trg_vocab, cfg, "trg_emb")
+    dec_out = decoder(dec_in, enc_out, trg_bias, src_bias, cfg)
+    return layers.fc(dec_out, cfg.trg_vocab, num_flatten_dims=2,
+                     bias_attr=False, name="proj")
+
+
+def build_program(cfg=None, maxlen=None, use_noam=True, warmup=4000,
+                  lr=2.0):
+    """Declares feeds (src, src_len, trg, trg_len, label) and returns
+    (feeds, avg_cost, token_count)."""
+    cfg = cfg or TransformerConfig.base()
+    T = maxlen or cfg.max_len
+    src = layers.data("src", shape=[T], dtype="int64")
+    src_len = layers.data("src_len", shape=[], dtype="int64",
+                          append_batch_size=True)
+    trg = layers.data("trg", shape=[T], dtype="int64")
+    trg_len = layers.data("trg_len", shape=[], dtype="int64",
+                          append_batch_size=True)
+    label = layers.data("label", shape=[T], dtype="int64")
+
+    logits = transformer(src, src_len, trg, trg_len, cfg)
+
+    if cfg.label_smooth_eps:
+        oh = layers.one_hot(label, cfg.trg_vocab)
+        soft = layers.label_smooth(oh, epsilon=cfg.label_smooth_eps)
+        loss = layers.softmax_with_cross_entropy(logits, soft,
+                                                 soft_label=True)
+    else:
+        lab3 = layers.unsqueeze(label, [2])
+        loss = layers.softmax_with_cross_entropy(logits, lab3)
+
+    # mask padded target positions; normalize by real token count
+    tmask = layers.sequence_mask(trg_len, maxlen=T, dtype="float32")
+    loss = layers.squeeze(loss, [2]) if len(loss.shape) == 3 else loss
+    masked = layers.elementwise_mul(loss, tmask)
+    token_count = layers.reduce_sum(tmask)
+    avg_cost = layers.elementwise_div(layers.reduce_sum(masked),
+                                      layers.elementwise_max(
+                                          token_count,
+                                          layers.fill_constant([], "float32", 1.0)))
+    feeds = [src, src_len, trg, trg_len, label]
+    return feeds, avg_cost, token_count

@@ -1,1 +1,33 @@
-"""placeholder — filled in later this round"""
+"""VGG-16 (ref benchmark/fluid/models/vgg.py — img_conv_group stacks)."""
+from .. import layers, nets
+
+__all__ = ["vgg16", "build_program"]
+
+
+def vgg16(input, class_dim=1000, use_bn=True):
+    def conv_block(x, num_filter, groups):
+        return nets.img_conv_group(
+            input=x, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * groups, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=use_bn,
+            pool_type="max")
+
+    conv1 = conv_block(input, 64, 2)
+    conv2 = conv_block(conv1, 128, 2)
+    conv3 = conv_block(conv2, 256, 3)
+    conv4 = conv_block(conv3, 512, 3)
+    conv5 = conv_block(conv4, 512, 3)
+
+    fc1 = layers.fc(conv5, size=512, act="relu")
+    fc1 = layers.dropout(fc1, dropout_prob=0.5)
+    fc2 = layers.fc(fc1, size=512, act="relu")
+    return layers.fc(fc2, size=class_dim, act="softmax")
+
+
+def build_program(class_dim=10, image_shape=(3, 32, 32)):
+    img = layers.data("img", shape=list(image_shape))
+    label = layers.data("label", shape=[1], dtype="int64")
+    predict = vgg16(img, class_dim)
+    avg_cost = layers.mean(layers.cross_entropy(input=predict, label=label))
+    acc = layers.accuracy(input=predict, label=label)
+    return [img, label], avg_cost, acc

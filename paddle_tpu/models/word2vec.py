@@ -1,1 +1,26 @@
-"""placeholder — filled in later this round"""
+"""word2vec n-gram LM (ref fluid tests/book ch.5 word2vec)."""
+from .. import layers
+
+__all__ = ["ngram_lm", "build_program"]
+
+
+def ngram_lm(words, dict_size, embed_size=32, hidden_size=256):
+    """words: list of 4 context word vars [B,1] -> softmax over vocab."""
+    embeds = [layers.embedding(w, size=[dict_size, embed_size],
+                               param_attr="shared_w" + str(i))
+              for i, w in enumerate(words)]
+    concat = layers.concat(embeds, axis=1)
+    hidden = layers.fc(concat, size=hidden_size, act="sigmoid")
+    return layers.fc(hidden, size=dict_size, act="softmax")
+
+
+def build_program(dict_size=2048, embed_size=32, hidden_size=256):
+    w1 = layers.data("firstw", shape=[1], dtype="int64")
+    w2 = layers.data("secondw", shape=[1], dtype="int64")
+    w3 = layers.data("thirdw", shape=[1], dtype="int64")
+    w4 = layers.data("fourthw", shape=[1], dtype="int64")
+    next_word = layers.data("nextw", shape=[1], dtype="int64")
+    predict = ngram_lm([w1, w2, w3, w4], dict_size, embed_size, hidden_size)
+    avg_cost = layers.mean(layers.cross_entropy(input=predict,
+                                                label=next_word))
+    return [w1, w2, w3, w4, next_word], avg_cost, predict

@@ -1,0 +1,63 @@
+"""Native C++ runtime pieces (ctypes-bound; see SURVEY §2.5).
+
+Auto-builds libptpu_native.so with make/g++ on first import; every
+consumer has a pure-python fallback so the framework works unbuilt.
+"""
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libptpu_native.so")
+
+_lib = None
+
+
+def lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        _lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    # signatures
+    L = _lib
+    L.ptpu_recordio_writer_open.restype = ctypes.c_void_p
+    L.ptpu_recordio_writer_open.argtypes = [ctypes.c_char_p]
+    L.ptpu_recordio_write.restype = ctypes.c_int
+    L.ptpu_recordio_write.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_uint32]
+    L.ptpu_recordio_writer_close.restype = ctypes.c_int
+    L.ptpu_recordio_writer_close.argtypes = [ctypes.c_void_p]
+    L.ptpu_recordio_reader_open.restype = ctypes.c_void_p
+    L.ptpu_recordio_reader_open.argtypes = [ctypes.c_char_p]
+    L.ptpu_recordio_read.restype = ctypes.c_int64
+    L.ptpu_recordio_read.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint8),
+                                     ctypes.c_uint32]
+    L.ptpu_recordio_reader_close.restype = ctypes.c_int
+    L.ptpu_recordio_reader_close.argtypes = [ctypes.c_void_p]
+    L.ptpu_queue_create.restype = ctypes.c_void_p
+    L.ptpu_queue_create.argtypes = [ctypes.c_uint32]
+    L.ptpu_queue_push.restype = ctypes.c_int
+    L.ptpu_queue_push.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint8),
+                                  ctypes.c_uint64]
+    L.ptpu_queue_pop.restype = ctypes.c_int64
+    L.ptpu_queue_pop.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_uint8),
+                                 ctypes.c_uint64]
+    L.ptpu_queue_size.restype = ctypes.c_uint64
+    L.ptpu_queue_size.argtypes = [ctypes.c_void_p]
+    L.ptpu_queue_close.argtypes = [ctypes.c_void_p]
+    L.ptpu_queue_destroy.argtypes = [ctypes.c_void_p]
+    return _lib

@@ -1,0 +1,83 @@
+// Bounded MPMC byte-buffer queue for host-side prefetch.
+//
+// Parity: the reference's double-buffer / BlockingQueue feed path
+// (paddle/fluid/framework/blocking_queue.h + operators/reader/
+// buffered_reader): producer threads push serialized batches, the
+// Python feed loop pops them, keeping N batches in flight so host input
+// prep overlaps device compute. C API for ctypes; condition-variable
+// blocking with shutdown semantics.
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Queue {
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::deque<std::vector<uint8_t>> items;
+  size_t capacity;
+  bool closed = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_queue_create(uint32_t capacity) {
+  auto* q = new Queue();
+  q->capacity = capacity ? capacity : 1;
+  return q;
+}
+
+// Blocks while full. Returns 0 ok, -1 closed.
+int ptpu_queue_push(void* handle, const uint8_t* data, uint64_t len) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_full.wait(lk, [&] { return q->items.size() < q->capacity || q->closed; });
+  if (q->closed) return -1;
+  q->items.emplace_back(data, data + len);
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// Blocks while empty. Returns item length, 0 on closed+drained,
+// -(needed) if cap too small (item stays queued).
+int64_t ptpu_queue_pop(void* handle, uint8_t* out, uint64_t cap) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [&] { return !q->items.empty() || q->closed; });
+  if (q->items.empty()) return 0;  // closed and drained
+  auto& item = q->items.front();
+  if (item.size() > cap) return -(int64_t)item.size();
+  uint64_t n = item.size();
+  memcpy(out, item.data(), n);
+  q->items.pop_front();
+  q->not_full.notify_one();
+  return (int64_t)n;
+}
+
+uint64_t ptpu_queue_size(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+// Close: producers stop; consumers drain then get 0.
+void ptpu_queue_close(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+void ptpu_queue_destroy(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  delete q;
+}
+
+}  // extern "C"

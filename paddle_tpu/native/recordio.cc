@@ -1,0 +1,189 @@
+// RecordIO: chunked binary record file format + reader/writer.
+//
+// Parity: paddle/fluid/recordio/{chunk,writer,scanner}.{h,cc} — the
+// reference stores train data as CRC-checked chunks of length-prefixed
+// records for its C++ data feed path. This is an independent TPU-runtime
+// implementation (C API for ctypes binding, no protobuf dependency):
+//
+//   file  := MAGIC u32 | chunk*
+//   chunk := u32 n_records | u32 payload_len | u32 crc32(payload) | payload
+//   payload := (u32 len | bytes)*
+//
+// The reader memory-maps nothing and keeps only chunk offsets; records
+// stream out through a per-chunk buffer so multi-GB files feed the
+// host→device pipeline with O(chunk) memory.
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50545243;  // "PTRC"
+
+uint32_t crc32_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc32_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* buf, size_t len) {
+  crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc32_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<uint8_t> payload;
+  uint32_t n_records = 0;
+  uint32_t max_chunk_bytes = 1 << 20;
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<uint8_t> payload;   // current chunk
+  size_t pos = 0;                 // cursor into payload
+  uint32_t remaining = 0;         // records left in chunk
+  bool error = false;
+};
+
+void put_u32(std::vector<uint8_t>& v, uint32_t x) {
+  v.push_back(x & 0xFF);
+  v.push_back((x >> 8) & 0xFF);
+  v.push_back((x >> 16) & 0xFF);
+  v.push_back((x >> 24) & 0xFF);
+}
+
+bool read_u32(FILE* f, uint32_t* out) {
+  uint8_t b[4];
+  if (fread(b, 1, 4, f) != 4) return false;
+  *out = (uint32_t)b[0] | ((uint32_t)b[1] << 8) | ((uint32_t)b[2] << 16) |
+         ((uint32_t)b[3] << 24);
+  return true;
+}
+
+void write_u32(FILE* f, uint32_t x) {
+  uint8_t b[4] = {(uint8_t)(x & 0xFF), (uint8_t)((x >> 8) & 0xFF),
+                  (uint8_t)((x >> 16) & 0xFF), (uint8_t)((x >> 24) & 0xFF)};
+  fwrite(b, 1, 4, f);
+}
+
+void flush_chunk(Writer* w) {
+  if (w->n_records == 0) return;
+  write_u32(w->f, w->n_records);
+  write_u32(w->f, (uint32_t)w->payload.size());
+  write_u32(w->f, crc32(w->payload.data(), w->payload.size()));
+  fwrite(w->payload.data(), 1, w->payload.size(), w->f);
+  w->payload.clear();
+  w->n_records = 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_recordio_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  write_u32(f, kMagic);
+  return w;
+}
+
+int ptpu_recordio_write(void* handle, const uint8_t* data, uint32_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  if (!w || !w->f) return -1;
+  put_u32(w->payload, len);
+  w->payload.insert(w->payload.end(), data, data + len);
+  w->n_records++;
+  if (w->payload.size() >= w->max_chunk_bytes) flush_chunk(w);
+  return 0;
+}
+
+int ptpu_recordio_writer_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  if (!w) return -1;
+  flush_chunk(w);
+  fclose(w->f);
+  delete w;
+  return 0;
+}
+
+void* ptpu_recordio_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  uint32_t magic = 0;
+  if (!read_u32(f, &magic) || magic != kMagic) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// Returns record length (>= 0), kEof (-3) at end of file, -1 on error,
+// -2 on crc corruption. Data is copied into out (caller allocates cap
+// bytes); if cap is too small, returns -(needed) without consuming the
+// record (needed is always > 4, so it cannot collide with the codes).
+int64_t ptpu_recordio_read(void* handle, uint8_t* out, uint32_t cap) {
+  constexpr int64_t kEof = -3;
+  auto* r = static_cast<Reader*>(handle);
+  if (!r || r->error) return -1;
+  if (r->remaining == 0) {
+    uint32_t n, plen, crc;
+    if (!read_u32(r->f, &n)) return kEof;  // clean EOF
+    if (!read_u32(r->f, &plen) || !read_u32(r->f, &crc)) {
+      r->error = true;
+      return -1;
+    }
+    r->payload.resize(plen);
+    if (fread(r->payload.data(), 1, plen, r->f) != plen) {
+      r->error = true;
+      return -1;
+    }
+    if (crc32(r->payload.data(), plen) != crc) {
+      r->error = true;
+      return -2;  // corruption detected
+    }
+    r->remaining = n;
+    r->pos = 0;
+  }
+  if (r->pos + 4 > r->payload.size()) {
+    r->error = true;
+    return -1;
+  }
+  uint32_t len = (uint32_t)r->payload[r->pos] |
+                 ((uint32_t)r->payload[r->pos + 1] << 8) |
+                 ((uint32_t)r->payload[r->pos + 2] << 16) |
+                 ((uint32_t)r->payload[r->pos + 3] << 24);
+  if (len > cap) return -(int64_t)len;
+  r->pos += 4;
+  memcpy(out, r->payload.data() + r->pos, len);
+  r->pos += len;
+  r->remaining--;
+  return (int64_t)len;
+}
+
+int ptpu_recordio_reader_close(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  if (!r) return -1;
+  fclose(r->f);
+  delete r;
+  return 0;
+}
+
+}  // extern "C"

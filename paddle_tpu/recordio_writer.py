@@ -1,0 +1,187 @@
+"""RecordIO python API.
+
+Parity: python/paddle/fluid/recordio_writer.py (convert_reader_to_
+recordio_file) + recordio scanning. Backed by the C++ implementation in
+native/recordio.cc when built; a pure-python codec of the SAME on-disk
+format otherwise (the two interoperate byte-for-byte).
+"""
+import ctypes
+import pickle
+import struct
+import zlib
+
+from . import native
+
+__all__ = ["RecordIOWriter", "RecordIOReader",
+           "convert_reader_to_recordio_file", "recordio_reader"]
+
+_MAGIC = 0x50545243
+_CHUNK = 1 << 20
+
+
+def _crc32(b):
+    return zlib.crc32(b) & 0xFFFFFFFF
+
+
+class _PyWriter:
+    def __init__(self, path):
+        self.f = open(path, "wb")
+        self.f.write(struct.pack("<I", _MAGIC))
+        self.payload = bytearray()
+        self.n = 0
+
+    def write(self, data):
+        self.payload += struct.pack("<I", len(data)) + data
+        self.n += 1
+        if len(self.payload) >= _CHUNK:
+            self._flush()
+
+    def _flush(self):
+        if not self.n:
+            return
+        p = bytes(self.payload)
+        self.f.write(struct.pack("<III", self.n, len(p), _crc32(p)))
+        self.f.write(p)
+        self.payload = bytearray()
+        self.n = 0
+
+    def close(self):
+        self._flush()
+        self.f.close()
+
+
+class _PyReader:
+    def __init__(self, path):
+        self.f = open(path, "rb")
+        magic, = struct.unpack("<I", self.f.read(4))
+        if magic != _MAGIC:
+            raise IOError(f"{path}: not a recordio file")
+        self.records = []
+        self.idx = 0
+
+    def read(self):
+        while self.idx >= len(self.records):
+            hdr = self.f.read(12)
+            if len(hdr) < 12:
+                return None
+            n, plen, crc = struct.unpack("<III", hdr)
+            payload = self.f.read(plen)
+            if _crc32(payload) != crc:
+                raise IOError("recordio chunk crc mismatch (corruption)")
+            self.records = []
+            self.idx = 0
+            pos = 0
+            for _ in range(n):
+                ln, = struct.unpack_from("<I", payload, pos)
+                pos += 4
+                self.records.append(payload[pos:pos + ln])
+                pos += ln
+        rec = self.records[self.idx]
+        self.idx += 1
+        return rec
+
+    def close(self):
+        self.f.close()
+
+
+class RecordIOWriter:
+    """Prefers the native C++ writer; same format either way."""
+
+    def __init__(self, path, use_native=True):
+        self._native = None
+        L = native.lib() if use_native else None
+        if L is not None:
+            h = L.ptpu_recordio_writer_open(path.encode())
+            if h:
+                self._native = (L, h)
+        if self._native is None:
+            self._py = _PyWriter(path)
+
+    def write(self, data: bytes):
+        if self._native:
+            L, h = self._native
+            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+            if L.ptpu_recordio_write(h, buf, len(data)) != 0:
+                raise IOError("recordio native write failed")
+        else:
+            self._py.write(data)
+
+    def close(self):
+        if self._native:
+            L, h = self._native
+            L.ptpu_recordio_writer_close(h)
+            self._native = None
+        else:
+            self._py.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class RecordIOReader:
+    def __init__(self, path, use_native=True):
+        self._native = None
+        L = native.lib() if use_native else None
+        if L is not None:
+            h = L.ptpu_recordio_reader_open(path.encode())
+            if h:
+                self._native = (L, h)
+                self._cap = 1 << 16
+                self._buf = (ctypes.c_uint8 * self._cap)()
+        if self._native is None:
+            self._py = _PyReader(path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._native:
+            L, h = self._native
+            n = L.ptpu_recordio_read(h, self._buf, self._cap)
+            if n < 0 and -n > self._cap:   # grow buffer, retry
+                self._cap = int(-n)
+                self._buf = (ctypes.c_uint8 * self._cap)()
+                n = L.ptpu_recordio_read(h, self._buf, self._cap)
+            if n == -3:                    # EOF sentinel (0 = empty record)
+                raise StopIteration
+            if n < 0:
+                raise IOError("recordio corruption detected (crc)")
+            return bytes(self._buf[:n])
+        rec = self._py.read()
+        if rec is None:
+            raise StopIteration
+        return rec
+
+    def close(self):
+        if self._native:
+            L, h = self._native
+            L.ptpu_recordio_reader_close(h)
+            self._native = None
+        else:
+            self._py.close()
+
+
+def convert_reader_to_recordio_file(filename, reader_creator,
+                                    feeder=None, **kw):
+    """ref recordio_writer.py — serialize each sample with pickle."""
+    count = 0
+    with RecordIOWriter(filename) as w:
+        for sample in reader_creator():
+            w.write(pickle.dumps(sample, protocol=4))
+            count += 1
+    return count
+
+
+def recordio_reader(filename):
+    """Reader creator over a recordio file of pickled samples."""
+    def reader():
+        r = RecordIOReader(filename)
+        try:
+            for rec in r:
+                yield pickle.loads(rec)
+        finally:
+            r.close()
+    return reader

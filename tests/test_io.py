@@ -1,0 +1,148 @@
+"""IO tests: save/load roundtrips, inference model, checkpoints,
+recordio (native C++ + python codecs interop), prefetch queue."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _small_net():
+    img = layers.data("img", shape=[16])
+    h = layers.fc(img, size=8, act="relu")
+    pred = layers.fc(h, size=4, act="softmax")
+    return img, pred
+
+
+def test_save_load_params_roundtrip(tmp_path):
+    img, pred = _small_net()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program()
+    pnames = [p.name for p in prog.all_parameters()]
+    before = {n: np.asarray(pt.global_scope().get(n)) for n in pnames}
+    pt.io.save_params(exe, str(tmp_path))
+    for n in pnames:
+        pt.global_scope().set(n, np.zeros_like(before[n]))
+    pt.io.load_params(exe, str(tmp_path))
+    for n in pnames:
+        np.testing.assert_allclose(
+            np.asarray(pt.global_scope().get(n)), before[n])
+
+
+def test_inference_model_roundtrip(tmp_path):
+    img, pred = _small_net()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    x = np.random.RandomState(0).randn(4, 16).astype("float32")
+    expected = exe.run(feed={"img": x}, fetch_list=[pred], is_test=True)[0]
+    pt.io.save_inference_model(str(tmp_path), ["img"], [pred], exe)
+    prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path), exe)
+    got = exe.run(prog, feed={feeds[0]: x}, fetch_list=fetches,
+                  is_test=True)[0]
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_checkpoint_resume(tmp_path):
+    img = layers.data("img", shape=[8])
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = layers.fc(img, size=4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    pt.optimizer.Adam(1e-2).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.randn(4, 8).astype("float32"),
+            "label": rng.randint(0, 4, (4, 1)).astype("int64")}
+    exe.run(feed=feed, fetch_list=[loss])
+    meta = pt.io.save_checkpoint(exe, str(tmp_path), step=1)
+    assert meta["step"] == 1
+    after_save = {n: np.asarray(pt.global_scope().get(n))
+                  for n in meta["vars"]}
+    exe.run(feed=feed, fetch_list=[loss])  # advance state
+    meta2 = pt.io.load_checkpoint(exe, str(tmp_path))
+    assert meta2["step"] == 1
+    for n in meta["vars"]:
+        np.testing.assert_allclose(
+            np.asarray(pt.global_scope().get(n)), after_save[n],
+            err_msg=n)
+
+
+@pytest.mark.parametrize("w_native,r_native", [
+    (False, False), (True, True), (True, False), (False, True)])
+def test_recordio_roundtrip_and_interop(tmp_path, w_native, r_native):
+    from paddle_tpu.recordio_writer import RecordIOWriter, RecordIOReader
+    from paddle_tpu import native
+    if (w_native or r_native) and native.lib() is None:
+        pytest.skip("native lib unavailable")
+    path = str(tmp_path / "data.rio")
+    records = [os.urandom(n) for n in (1, 10, 1000, 70000)] + [b""]
+    w = RecordIOWriter(path, use_native=w_native)
+    for rec in records:
+        w.write(rec)
+    w.close()
+    got = list(RecordIOReader(path, use_native=r_native))
+    assert got == records
+
+
+def test_recordio_corruption_detected(tmp_path):
+    from paddle_tpu.recordio_writer import RecordIOWriter, RecordIOReader
+    path = str(tmp_path / "bad.rio")
+    w = RecordIOWriter(path, use_native=False)
+    w.write(b"hello world" * 100)
+    w.close()
+    data = bytearray(open(path, "rb").read())
+    data[-3] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(IOError):
+        list(RecordIOReader(path, use_native=False))
+
+
+def test_convert_reader_to_recordio(tmp_path):
+    from paddle_tpu import recordio_writer as rw
+    path = str(tmp_path / "samples.rio")
+
+    def reader():
+        for i in range(10):
+            yield np.full((3,), i, "float32"), i
+
+    n = rw.convert_reader_to_recordio_file(path, reader)
+    assert n == 10
+    out = list(rw.recordio_reader(path)())
+    assert len(out) == 10
+    np.testing.assert_allclose(out[7][0], np.full((3,), 7))
+    assert out[7][1] == 7
+
+
+def test_native_prefetch_queue():
+    from paddle_tpu import native
+    L = native.lib()
+    if L is None:
+        pytest.skip("native lib unavailable")
+    import ctypes
+    import threading
+    q = L.ptpu_queue_create(2)
+    items = [b"a" * 10, b"b" * 100000, b"c"]
+
+    def producer():
+        for it in items:
+            buf = (ctypes.c_uint8 * len(it)).from_buffer_copy(it)
+            L.ptpu_queue_push(q, buf, len(it))
+        L.ptpu_queue_close(q)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    got = []
+    cap = 1 << 17
+    buf = (ctypes.c_uint8 * cap)()
+    while True:
+        n = L.ptpu_queue_pop(q, buf, cap)
+        if n == 0:
+            break
+        assert n > 0
+        got.append(bytes(buf[:n]))
+    t.join()
+    L.ptpu_queue_destroy(q)
+    assert got == items
