@@ -19,19 +19,28 @@ _KEEP_FP32_PARAM_SUFFIX = ("batch_norm", "layer_norm", "group_norm")
 
 
 def cast_program_to_bf16(program, keep_io_fp32=True):
-    """Rewrite var dtypes float32→bfloat16 except norm scales and data IO.
-    Returns the modified program (in place, like the ref transpiler)."""
+    """Rewrite var dtypes float32→bfloat16 for Parameters and activations.
+
+    Never touched: data IO vars, norm scales/biases, and ALL persistable
+    non-Parameter state (optimizer moments, beta-pow scalars, LR vars,
+    counters, bn moving stats) — bf16 cannot represent e.g. beta2=0.999
+    (rounds to 1.0, zeroing Adam's bias-corrected LR), so optimizer state
+    must stay fp32 (master-weight style; the update kernels already
+    compute in fp32). Returns the modified program (in place, like the
+    ref float16 transpiler)."""
+    from .core.framework import Parameter
     for block in program.blocks:
         for var in block.vars.values():
             if var.dtype != "float32":
                 continue
             if keep_io_fp32 and var.is_data:
                 continue
-            from .core.framework import Parameter
             if isinstance(var, Parameter):
                 # norm scales stay fp32 (kernels compute stats in fp32)
                 if any(s in var.name for s in _KEEP_FP32_PARAM_SUFFIX):
                     continue
+            elif var.persistable:
+                continue
             var.dtype = "bfloat16"
     program._bump_version()
     return program

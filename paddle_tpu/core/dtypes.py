@@ -39,7 +39,13 @@ def convert_dtype(dtype):
 
 
 def as_jnp_dtype(dtype):
-    return _ALIASES[convert_dtype(dtype)]
+    dt = _ALIASES[convert_dtype(dtype)]
+    import jax
+    if not jax.config.jax_enable_x64:
+        # x32 mode (TPU default): 64-bit dtypes are declared for Fluid API
+        # parity but materialize as 32-bit arrays
+        dt = {jnp.int64: jnp.int32, jnp.float64: jnp.float32}.get(dt, dt)
+    return dt
 
 
 def is_float(dtype):

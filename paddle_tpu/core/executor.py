@@ -57,6 +57,10 @@ class Executor:
         for k, v in feed.items():
             var = program.global_block().vars.get(k)
             dt = as_jnp_dtype(var.dtype) if var is not None else None
+            if dt is not None and not jax.config.jax_enable_x64:
+                # avoid per-step truncation warnings: TPU runs x32
+                dt = {jnp.int64: jnp.int32, jnp.uint64: jnp.uint32,
+                      jnp.float64: jnp.float32}.get(dt, dt)
             arr = jax.device_put(jnp.asarray(np.asarray(v), dtype=dt), dev)
             feed_arrays[k] = arr
 

@@ -139,12 +139,41 @@ def _find_backward(ops):
     return idxs[0]
 
 
+def _prune_ops(program, ops, fetch_names):
+    """Keep only ops needed for the fetches or writing persistable state
+    (param updates, bn stats, counters) — the reference Executor prunes
+    the ProgramDesc to the fetch targets the same way."""
+    block = program.global_block()
+    persistable = {v.name for v in program.persistable_vars()}
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(ops):
+        outs = op.output_names()
+        if (needed & set(outs)) or any(o in persistable for o in outs):
+            kept.append(op)
+            needed |= set(op.input_names())
+            if op.type == "backward_macro":
+                needed.add(op.attrs["loss_name"])
+            if op.type in ("cond", "while_loop", "scan"):
+                # sub-block free vars are inputs too
+                for key in ("true_block", "false_block", "cond_block",
+                            "body_block"):
+                    bidx = op.attrs.get(key)
+                    if bidx is None:
+                        continue
+                    sub = program.blocks[bidx]
+                    produced = {n for o in sub.ops for n in o.output_names()}
+                    for o in sub.ops:
+                        needed |= set(o.input_names()) - produced
+    return list(reversed(kept))
+
+
 def build_step_fn(program, fetch_names, is_test, place):
     """Returns step(persist, feed, key) -> (fetches, new_persist).
 
     Pure and jittable; the op list/attrs are closed over (static)."""
     block = program.global_block()
-    ops = list(block.ops)
+    ops = _prune_ops(program, list(block.ops), fetch_names)
     persist_names = [v.name for v in program.persistable_vars()]
     bi = _find_backward(ops)
 

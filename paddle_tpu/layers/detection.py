@@ -1,0 +1,97 @@
+"""Detection layers (SSD family).
+
+Parity: python/paddle/fluid/layers/detection.py — prior_box, box_coder,
+multiclass NMS, iou. TPU notes: NMS output is FIXED-SIZE (keep_top_k
+padded with -1 labels) because XLA needs static shapes; the reference's
+LoD-variable outputs are a host-side concept.
+"""
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "box_coder", "iou_similarity", "multiclass_nms",
+           "ssd_loss_stub", "detection_output"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("prior_box", name=name)
+    h, w = int(input.shape[2]), int(input.shape[3])
+    n_prior = _num_priors(min_sizes, max_sizes, aspect_ratios, flip)
+    boxes = helper.create_variable_for_type_inference(
+        "float32", (h, w, n_prior, 4), True)
+    var = helper.create_variable_for_type_inference(
+        "float32", (h, w, n_prior, 4), True)
+    helper.append_op("prior_box", {"Input": [input], "Image": [image]},
+                     {"Boxes": [boxes], "Variances": [var]},
+                     {"min_sizes": list(min_sizes),
+                      "max_sizes": list(max_sizes or []),
+                      "aspect_ratios": list(aspect_ratios),
+                      "variances": list(variance), "flip": flip,
+                      "clip": clip, "steps": list(steps), "offset": offset})
+    return boxes, var
+
+
+def _num_priors(min_sizes, max_sizes, aspect_ratios, flip):
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if abs(ar - 1.0) > 1e-6:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    n = len(min_sizes) * len(ars)
+    if max_sizes:
+        n += len(max_sizes)
+    return n
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(
+        "float32", target_box.shape, True)
+    helper.append_op("box_coder",
+                     {"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                      "TargetBox": [target_box]},
+                     {"OutputBox": [out]},
+                     {"code_type": code_type,
+                      "box_normalized": box_normalized})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(
+        "float32", (x.shape[0], y.shape[0]), True)
+    helper.append_op("iou_similarity", {"X": [x], "Y": [y]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=200, nms_threshold=0.3, normalized=True,
+                   background_label=0, name=None):
+    """Fixed-size NMS: returns [N, keep_top_k, 6] (label, score, x1..y2),
+    padded rows have label=-1 (XLA static-shape version of the ref op)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(
+        "float32", (bboxes.shape[0], keep_top_k, 6), True)
+    helper.append_op("multiclass_nms",
+                     {"BBoxes": [bboxes], "Scores": [scores]},
+                     {"Out": [out]},
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold,
+                      "background_label": background_label})
+    return out
+
+
+detection_output = multiclass_nms
+
+
+def ssd_loss_stub(*a, **k):
+    raise NotImplementedError(
+        "ssd_loss: planned for a later round (needs matched-box targets); "
+        "prior_box/box_coder/iou/multiclass_nms are available")

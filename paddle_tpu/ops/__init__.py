@@ -4,4 +4,5 @@ from . import kernels_tensor
 from . import kernels_math
 from . import kernels_nn
 from . import kernels_optim
+from . import kernels_detection
 from .registry import KERNELS, get_kernel, has_kernel

@@ -1,0 +1,163 @@
+"""Detection op kernels.
+
+Parity: paddle/fluid/operators/detection/{prior_box,box_coder,
+iou_similarity,multiclass_nms}_op.* — static-shape XLA versions (NMS
+emits a fixed keep_top_k with -1 padding instead of LoD outputs).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import kernel
+
+
+@kernel("prior_box")
+def _prior_box(ctx, ins, attrs):
+    feat, img = ins["Input"][0], ins["Image"][0]
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes") or []
+    flip = attrs.get("flip", False)
+    offset = attrs.get("offset", 0.5)
+    sh, sw = attrs.get("steps", [0.0, 0.0])
+    sh = sh or ih / fh
+    sw = sw or iw / fw
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        if abs(ar - 1.0) > 1e-6:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    for i, ms in enumerate(max_sizes):
+        s = np.sqrt(min_sizes[i] * ms)
+        whs.append((s, s))
+    whs = np.asarray(whs, dtype=np.float32)          # [P, 2]
+    P = whs.shape[0]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)                   # [fh, fw]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    w2 = jnp.asarray(whs[:, 0])[None, None, :] / 2.0
+    h2 = jnp.asarray(whs[:, 1])[None, None, :] / 2.0
+    boxes = jnp.stack([(cxg - w2) / iw, (cyg - h2) / ih,
+                       (cxg + w2) / iw, (cyg + h2) / ih], axis=-1)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(
+        jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                    jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [variances]}
+
+
+@kernel("box_coder")
+def _box_coder(ctx, ins, attrs):
+    prior = ins["PriorBox"][0].reshape(-1, 4)
+    pvar = ins["PriorBoxVar"][0].reshape(-1, 4)
+    target = ins["TargetBox"][0]
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if attrs.get("code_type", "encode_center_size").startswith("encode"):
+        tw = target[..., 2] - target[..., 0]
+        th = target[..., 3] - target[..., 1]
+        tcx = target[..., 0] + 0.5 * tw
+        tcy = target[..., 1] + 0.5 * th
+        out = jnp.stack([
+            (tcx - pcx) / pw / pvar[:, 0],
+            (tcy - pcy) / ph / pvar[:, 1],
+            jnp.log(jnp.maximum(tw / pw, 1e-9)) / pvar[:, 2],
+            jnp.log(jnp.maximum(th / ph, 1e-9)) / pvar[:, 3]], axis=-1)
+    else:  # decode_center_size
+        dcx = pvar[:, 0] * target[..., 0] * pw + pcx
+        dcy = pvar[:, 1] * target[..., 1] * ph + pcy
+        dw = jnp.exp(pvar[:, 2] * target[..., 2]) * pw
+        dh = jnp.exp(pvar[:, 3] * target[..., 3]) * ph
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2, dcy + dh / 2], axis=-1)
+    return {"OutputBox": [out]}
+
+
+def _iou_matrix(a, b):
+    """a [N,4], b [M,4] → [N,M] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+
+
+@kernel("iou_similarity")
+def _iou_similarity(ctx, ins, attrs):
+    return {"Out": [_iou_matrix(ins["X"][0], ins["Y"][0])]}
+
+
+def _nms_single_class(boxes, scores, top_k, thresh):
+    """Greedy NMS on fixed top_k candidates → keep mask [top_k]."""
+    sc, idx = jax.lax.top_k(scores, top_k)
+    cand = boxes[idx]                                    # [K,4]
+    iou = _iou_matrix(cand, cand)
+
+    def body(i, keep):
+        # drop i if it overlaps any higher-scoring kept box
+        sup = jnp.any((iou[i, :i] > thresh) & keep[:i].astype(bool),
+                      size=None) if False else \
+            jnp.sum(jnp.where(jnp.arange(top_k) < i,
+                              (iou[i] > thresh) & keep.astype(bool),
+                              False)) > 0
+        return keep.at[i].set(jnp.where(sup, 0.0, 1.0))
+
+    keep0 = jnp.ones((top_k,), jnp.float32)
+    keep = jax.lax.fori_loop(1, top_k, body, keep0)
+    return idx, sc, keep
+
+
+@kernel("multiclass_nms")
+def _multiclass_nms(ctx, ins, attrs):
+    """bboxes [N, M, 4], scores [N, C, M] → [N, keep_top_k, 6]."""
+    bboxes, scores = ins["BBoxes"][0], ins["Scores"][0]
+    st = attrs.get("score_threshold", 0.05)
+    nms_top_k = min(attrs.get("nms_top_k", 400), bboxes.shape[1])
+    keep_top_k = attrs.get("keep_top_k", 200)
+    thresh = attrs.get("nms_threshold", 0.3)
+    bg = attrs.get("background_label", 0)
+    N, C, M = scores.shape
+
+    def per_image(bx, sc):
+        all_scores = []
+        all_labels = []
+        all_boxes = []
+        for c in range(C):
+            if c == bg:
+                continue
+            idx, s, keep = _nms_single_class(bx, sc[c], nms_top_k, thresh)
+            s = jnp.where((keep > 0) & (s > st), s, -1.0)
+            all_scores.append(s)
+            all_labels.append(jnp.full((nms_top_k,), c, jnp.float32))
+            all_boxes.append(bx[idx])
+        s = jnp.concatenate(all_scores)
+        l = jnp.concatenate(all_labels)
+        b = jnp.concatenate(all_boxes)
+        k = min(keep_top_k, s.shape[0])
+        top_s, top_i = jax.lax.top_k(s, k)
+        out = jnp.concatenate([
+            jnp.where(top_s[:, None] > 0, l[top_i][:, None], -1.0),
+            top_s[:, None], b[top_i]], axis=-1)
+        if k < keep_top_k:
+            pad = jnp.full((keep_top_k - k, 6), -1.0, jnp.float32)
+            out = jnp.concatenate([out, pad], axis=0)
+        return out
+
+    out = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out]}

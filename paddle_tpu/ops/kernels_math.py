@@ -324,7 +324,7 @@ def _where(ctx, ins, attrs):
 def _arg_max(ctx, ins, attrs):
     x = _x(ins)
     axis = attrs.get("axis", -1)
-    out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+    out = jnp.argmax(x, axis=axis).astype(jnp.int32)
     if attrs.get("keepdims", False):
         out = jnp.expand_dims(out, axis)
     return {"Out": [out]}
@@ -334,7 +334,7 @@ def _arg_max(ctx, ins, attrs):
 def _arg_min(ctx, ins, attrs):
     x = _x(ins)
     axis = attrs.get("axis", -1)
-    return {"Out": [jnp.argmin(x, axis=axis).astype(jnp.int64)]}
+    return {"Out": [jnp.argmin(x, axis=axis).astype(jnp.int32)]}
 
 
 @kernel("argsort")
@@ -344,7 +344,7 @@ def _argsort(ctx, ins, attrs):
     desc = attrs.get("descending", False)
     idx = jnp.argsort(-x if desc else x, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [out], "Indices": [idx.astype(jnp.int32)]}
 
 
 @kernel("top_k", "top_k_v2")
@@ -352,7 +352,7 @@ def _top_k(ctx, ins, attrs):
     x = _x(ins)
     k = attrs["k"]
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int32)]}
 
 
 @kernel("max", "maximum")

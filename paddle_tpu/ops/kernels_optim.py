@@ -224,12 +224,12 @@ def _accuracy(ctx, ins, attrs):
     pred, label = ins["Out"][0], ins["Label"][0]
     indices = ins.get("Indices", [None])[0]
     k = attrs.get("k", 1)
-    lbl = label.astype(jnp.int64)
+    lbl = label.astype(jnp.int32)
     if lbl.ndim == 2 and lbl.shape[-1] == 1:
         lbl = jnp.squeeze(lbl, -1)
     if indices is None:
         _, indices = jax.lax.top_k(pred, k)
-    correct = jnp.any(indices.astype(jnp.int64)[:, :k] == lbl[:, None], axis=1)
+    correct = jnp.any(indices.astype(jnp.int32)[:, :k] == lbl[:, None], axis=1)
     num_correct = jnp.sum(correct.astype(jnp.float32))
     total = pred.shape[0]
     return {"Accuracy": [num_correct / total],

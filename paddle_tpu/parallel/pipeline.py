@@ -1,0 +1,92 @@
+"""Pipeline parallelism over the `pp` mesh axis.
+
+The reference era scaled depth via pserver sharding only; modern Paddle
+added pipeline stages. TPU-native GPipe-style schedule: stage functions
+run under shard_map over `pp`, microbatches stream through with
+lax.scan + ppermute handing activations to the next stage over ICI.
+
+This module provides the generic schedule for stage functions expressed
+as pure JAX callables (models built with the Program IR can export one
+via core/trace.build_step_fn on a sub-program).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_forward", "gpipe_schedule"]
+
+
+def pipeline_forward(mesh, stage_fn, params_per_stage, x, n_microbatch,
+                     axis_name="pp"):
+    """Run x [B, ...] through n_stages stage_fn's, stage i on device i of
+    the pp axis (GPipe forward).
+
+    stage_fn(stage_params, h) -> h; all stages must share one signature
+    (same activation shape), the usual transformer-block case.
+    params_per_stage: pytree whose leaves are stacked on axis 0 with
+    length n_stages (leaf i goes to stage i).
+    """
+    n_stages = mesh.shape[axis_name]
+    if x.shape[0] % n_microbatch:
+        raise ValueError("batch must divide into microbatches")
+    mb = jnp.reshape(x, (n_microbatch, x.shape[0] // n_microbatch)
+                     + x.shape[1:])
+
+    def per_stage(params, mb_local):
+        """Runs on ONE pp member. params arrive as the local shard of the
+        stage-stacked pytree (leading dim 1) — unwrap it."""
+        params = jax.tree.map(lambda a: a[0], params)
+        stage = lax.axis_index(axis_name)
+        n_steps = n_microbatch + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def step(carry, t):
+            # carry: (incoming activation buffer, outputs accumulator)
+            inflight, outs = carry
+            # stage 0 ingests microbatch t (when valid); others take the
+            # activation handed over from the previous stage
+            mb_idx = jnp.clip(t, 0, n_microbatch - 1)
+            my_in = jnp.where(stage == 0, mb_local[mb_idx], inflight)
+            h = stage_fn(params, my_in)
+            # last stage records its finished microbatch (t - n_stages + 1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatch - 1)
+            valid = (t >= stage) & (t - stage < n_microbatch)
+            record = (stage == n_stages - 1) & valid & \
+                (t >= n_stages - 1)
+            outs = jnp.where(
+                record,
+                outs.at[out_idx].set(h),
+                outs)
+            # hand my activation to the next stage
+            nxt = lax.ppermute(h, axis_name, perm)
+            return (nxt, outs), None
+
+        outs0 = jnp.zeros((n_microbatch,) + mb_local.shape[1:],
+                          mb_local.dtype)
+        inflight0 = jnp.zeros_like(mb_local[0])
+        (_, outs), _ = lax.scan(step, (inflight0, outs0),
+                                jnp.arange(n_steps))
+        return outs[None]               # leading stage axis for out_specs
+
+    sm = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(P(axis_name), P()),   # stage params sharded over pp
+        out_specs=P(axis_name),         # [n_stages, n_mb, ...]
+        check_vma=False)
+    outs = sm(params_per_stage, mb)[-1]  # only the last stage's buffer
+    return jnp.reshape(outs, x.shape[:1] + outs.shape[2:])
+
+
+def gpipe_schedule(n_microbatch, n_stages):
+    """Return the (t, stage)->microbatch table of the GPipe schedule —
+    useful for tests/visualization."""
+    table = {}
+    for t in range(n_microbatch + n_stages - 1):
+        for s in range(n_stages):
+            m = t - s
+            if 0 <= m < n_microbatch:
+                table[(t, s)] = m
+    return table

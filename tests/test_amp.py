@@ -1,0 +1,37 @@
+"""bf16 mixed-precision training (amp.py — the ref float16_transpiler
+analog, bf16-native for TPU)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_bf16_training_converges():
+    img = layers.data("img", shape=[32])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=64, act="relu")
+    pred = layers.fc(h, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    pt.optimizer.Adam(1e-2).minimize(loss)
+
+    prog = pt.default_main_program()
+    pt.amp.cast_program_to_bf16(prog)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    pt.amp.cast_params_to_bf16(prog)
+
+    # params are now bf16 in scope
+    wname = prog.all_parameters()[0].name
+    assert str(pt.global_scope().get(wname).dtype) == "bfloat16"
+
+    rng = np.random.RandomState(0)
+    proto = rng.randn(10, 32).astype("float32")
+    losses = []
+    for i in range(20):
+        lbl = rng.randint(0, 10, 16)
+        x = proto[lbl] + 0.1 * rng.randn(16, 32).astype("float32")
+        lv = exe.run(feed={"img": x, "label": lbl[:, None]},
+                     fetch_list=[loss])[0]
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.6, losses

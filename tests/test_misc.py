@@ -1,0 +1,160 @@
+"""Detection ops, debugger, LoD utilities, metrics, reader decorators."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.layers import detection as det
+
+
+def test_prior_box_geometry():
+    img = layers.data("img", shape=[3, 64, 64])
+    feat = layers.data("feat", shape=[8, 8, 8])
+    boxes, var = det.prior_box(feat, img, min_sizes=[32.0],
+                               aspect_ratios=[1.0])
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    b, v = exe.run(feed={"img": np.zeros((1, 3, 64, 64), "f4"),
+                         "feat": np.zeros((1, 8, 8, 8), "f4")},
+                   fetch_list=[boxes, var])
+    assert b.shape == (8, 8, 1, 4)
+    # center of cell (0,0) is at offset 0.5*step=4px; box 32x32 → norm
+    np.testing.assert_allclose(b[0, 0, 0], [-12 / 64, -12 / 64, 20 / 64, 20 / 64],
+                               atol=1e-5)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0.1, 0.1, 0.5, 0.5]], "f4")
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]], "f4")
+    target = np.array([[0.15, 0.2, 0.55, 0.6]], "f4")
+    pb = layers.data("pb", shape=[1, 4], append_batch_size=False)
+    pv = layers.data("pv", shape=[1, 4], append_batch_size=False)
+    tb = layers.data("tb", shape=[1, 4], append_batch_size=False)
+    enc = det.box_coder(pb, pv, tb, code_type="encode_center_size")
+    dec = det.box_coder(pb, pv, enc, code_type="decode_center_size")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    e, d = exe.run(feed={"pb": prior, "pv": pvar, "tb": target},
+                   fetch_list=[enc, dec])
+    np.testing.assert_allclose(d, target, atol=1e-5)
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2]], "f4")
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2]], "f4")
+    av = layers.data("a", shape=[1, 4], append_batch_size=False)
+    bv = layers.data("b", shape=[2, 4], append_batch_size=False)
+    out = det.iou_similarity(av, bv)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = exe.run(feed={"a": a, "b": b}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got, [[1 / 7, 1.0]], rtol=1e-5)
+
+
+def test_debugger_outputs(tmp_path):
+    from paddle_tpu import debugger
+    img = layers.data("img", shape=[4])
+    h = layers.fc(img, size=2)
+    prog = pt.default_main_program()
+    txt = debugger.pprint_program(prog, show_vars=True)
+    assert "mul" in txt and "var img" in txt
+    path = debugger.draw_block_graphviz(prog.global_block(),
+                                        path=str(tmp_path / "g.dot"))
+    assert "digraph" in open(path).read()
+
+
+def test_lod_pad_unpad_roundtrip():
+    from paddle_tpu import lod
+    seqs = [np.arange(3), np.arange(5), np.arange(1)]
+    padded, lens = lod.to_padded(seqs)
+    assert padded.shape == (3, 5)
+    np.testing.assert_allclose(lens, [3, 5, 1])
+    back = lod.to_ragged(padded, lens)
+    for s, b in zip(seqs, back):
+        np.testing.assert_allclose(s, b)
+    t = lod.LoDTensor(padded, lens)
+    assert t.lod() == [[0, 3, 8, 9]]
+
+
+def test_bucketing():
+    from paddle_tpu import lod
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(40):
+            yield list(range(int(rng.randint(1, 20))))
+
+    b = lod.bucket_by_length(reader, [8, 16, 32], batch_size=4)
+    for bound, items in b():
+        assert all(len(s) <= bound for s in items)
+
+
+def test_host_metrics():
+    from paddle_tpu import metrics
+    acc = metrics.Accuracy()
+    acc.update(0.5, 10)
+    acc.update(1.0, 10)
+    assert abs(acc.eval() - 0.75) < 1e-9
+    p = metrics.Precision()
+    p.update(np.array([1, 1, 0]), np.array([1, 0, 0]))
+    assert abs(p.eval() - 0.5) < 1e-9
+    auc = metrics.Auc(num_thresholds=255)
+    scores = np.concatenate([np.random.RandomState(0).rand(100) * 0.4,
+                             np.random.RandomState(1).rand(100) * 0.4 + 0.6])
+    labels = np.concatenate([np.zeros(100), np.ones(100)])
+    auc.update(scores, labels)
+    assert auc.eval() > 0.99
+
+
+def test_reader_decorators():
+    import paddle_tpu.reader as R
+
+    def r():
+        yield from range(10)
+
+    assert list(R.firstn(r, 3)()) == [0, 1, 2]
+    batches = list(R.batch(r, 3)())
+    assert batches[0] == [0, 1, 2] and len(batches) == 3
+    assert sorted(list(R.shuffle(r, 5)())) == list(range(10))
+    assert list(R.map_readers(lambda a, b: a + b, r, r)()) == \
+        [2 * i for i in range(10)]
+    out = sorted(R.xmap_readers(lambda x: x * 2, r, 2, 4)())
+    assert out == [2 * i for i in range(10)]
+    assert list(R.buffered(r, 2)()) == list(range(10))
+
+
+def test_trainer_end_to_end(tmp_path):
+    from paddle_tpu.trainer import Trainer, EndStepEvent
+    import paddle_tpu.reader as R
+
+    def train_func():
+        img = layers.data("img", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = layers.fc(img, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        return loss
+
+    def opt_func():
+        return pt.optimizer.Adam(1e-2)
+
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(8):
+            x = rng.randn(8).astype("float32")
+            yield x, int(abs(x[0]) > 0.5)
+
+    seen = []
+
+    def handler(ev):
+        if isinstance(ev, EndStepEvent):
+            seen.append(float(np.asarray(ev.metrics[0])))
+
+    t = Trainer(train_func, opt_func, place=pt.CPUPlace())
+    t.train(num_epochs=2, event_handler=handler,
+            reader=R.batch(reader, 4), feed_order=["img", "label"])
+    assert len(seen) == 4 and np.isfinite(seen).all()
+    res = t.test(R.batch(reader, 4), feed_order=["img", "label"])
+    assert np.isfinite(res).all()
+    t.save_params(str(tmp_path))

@@ -1,0 +1,303 @@
+"""Op-level numeric tests vs numpy (ref tests/unittests/test_*_op.py
+pattern): build a tiny program around one layer, run, compare."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def run_layer(build, feeds, fetch_extra=(), is_test=True):
+    exe = pt.Executor(pt.CPUPlace())
+    out = build()
+    exe.run(pt.default_startup_program())
+    outs = exe.run(feed=feeds, fetch_list=[out, *fetch_extra],
+                   is_test=is_test)
+    return outs
+
+
+RNG = np.random.RandomState(7)
+
+
+def test_softmax():
+    x = RNG.randn(4, 9).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[9])
+        return layers.softmax(v)
+
+    out = run_layer(build, {"x": x})[0]
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+
+def test_elementwise_broadcast_axis():
+    x = RNG.randn(2, 3, 4).astype("float32")
+    y = RNG.randn(3).astype("float32")
+
+    def build():
+        a = layers.data("x", shape=[3, 4])
+        b = layers.data("y", shape=[3], append_batch_size=False)
+        return layers.elementwise_add(a, b, axis=1)
+
+    out = run_layer(build, {"x": x, "y": y})[0]
+    np.testing.assert_allclose(out, x + y[None, :, None], rtol=1e-6)
+
+
+def test_matmul_transpose():
+    x = RNG.randn(3, 4, 5).astype("float32")
+    y = RNG.randn(3, 6, 5).astype("float32")
+
+    def build():
+        a = layers.data("x", shape=[4, 5])
+        b = layers.data("y", shape=[6, 5])
+        return layers.matmul(a, b, transpose_y=True)
+
+    out = run_layer(build, {"x": x, "y": y})[0]
+    np.testing.assert_allclose(out, x @ y.transpose(0, 2, 1), rtol=1e-4)
+
+
+def test_conv2d_numeric():
+    torch = pytest.importorskip("torch")
+    x = RNG.randn(2, 3, 8, 8).astype("float32")
+    exe = pt.Executor(pt.CPUPlace())
+    v = layers.data("x", shape=[3, 8, 8])
+    out_v = layers.conv2d(v, num_filters=5, filter_size=3, stride=2,
+                          padding=1, bias_attr=False)
+    exe.run(pt.default_startup_program())
+    wname = pt.default_main_program().all_parameters()[0].name
+    w = np.asarray(pt.global_scope().get(wname))
+    got = exe.run(feed={"x": x}, fetch_list=[out_v], is_test=True)[0]
+    ref = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=2,
+        padding=1).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_pool2d_avg_max():
+    torch = pytest.importorskip("torch")
+    x = RNG.randn(2, 3, 8, 8).astype("float32")
+    for ptype in ("max", "avg"):
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            v = layers.data("x", shape=[3, 8, 8])
+            o = layers.pool2d(v, pool_size=2, pool_type=ptype,
+                              pool_stride=2)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        got = exe.run(prog, feed={"x": x}, fetch_list=[o], is_test=True)[0]
+        tfn = (torch.nn.functional.max_pool2d if ptype == "max"
+               else torch.nn.functional.avg_pool2d)
+        ref = tfn(torch.from_numpy(x), 2, 2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5, err_msg=ptype)
+
+
+def test_batch_norm_train_and_stats():
+    x = RNG.randn(8, 4, 3, 3).astype("float32") * 2 + 1.0
+    v = layers.data("x", shape=[4, 3, 3])
+    out_v = layers.batch_norm(v, momentum=0.8)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = exe.run(feed={"x": x}, fetch_list=[out_v], is_test=False)[0]
+    # normalized output: per-channel ~zero mean, unit var
+    m = got.mean(axis=(0, 2, 3))
+    s = got.std(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(s, np.ones(4), atol=1e-2)
+    # moving stats updated toward batch stats
+    prog = pt.default_main_program()
+    mv_names = [v2.name for v2 in prog.persistable_vars()
+                if "global" in v2.name]
+    mean_name = sorted(mv_names)[0]
+    mv = np.asarray(pt.global_scope().get(mean_name))
+    np.testing.assert_allclose(
+        mv, 0.2 * x.mean(axis=(0, 2, 3)), rtol=1e-4)
+
+
+def test_layer_norm():
+    x = RNG.randn(4, 10).astype("float32")
+    v = layers.data("x", shape=[10])
+    o = layers.layer_norm(v)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = exe.run(feed={"x": x}, fetch_list=[o], is_test=True)[0]
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_dropout_train_vs_test():
+    x = np.ones((64, 64), "float32")
+    v = layers.data("x", shape=[64])
+    o = layers.dropout(v, 0.5, dropout_implementation="upscale_in_train")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    train = exe.run(feed={"x": x}, fetch_list=[o], is_test=False)[0]
+    test = exe.run(feed={"x": x}, fetch_list=[o], is_test=True)[0]
+    assert (train == 0).mean() > 0.3  # roughly half dropped
+    np.testing.assert_allclose(train[train > 0], 2.0, rtol=1e-6)
+    np.testing.assert_allclose(test, x)
+
+
+def test_softmax_with_cross_entropy():
+    logits = RNG.randn(6, 5).astype("float32")
+    lbl = RNG.randint(0, 5, (6, 1)).astype("int64")
+    v = layers.data("x", shape=[5])
+    l = layers.data("y", shape=[1], dtype="int64")
+    loss = layers.softmax_with_cross_entropy(v, l)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = exe.run(feed={"x": logits, "y": lbl}, fetch_list=[loss])[0]
+    sm = np.exp(logits - logits.max(-1, keepdims=True))
+    sm /= sm.sum(-1, keepdims=True)
+    ref = -np.log(sm[np.arange(6), lbl[:, 0]])[:, None]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_topk_argmax_onehot():
+    x = RNG.randn(3, 7).astype("float32")
+    v = layers.data("x", shape=[7])
+    vals, idx = layers.topk(v, 3)
+    am = layers.argmax(v, axis=1)
+    oh = layers.one_hot(layers.unsqueeze(am, [1]), 7)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    o_vals, o_idx, o_am, o_oh = exe.run(
+        feed={"x": x}, fetch_list=[vals, idx, am, oh])
+    np.testing.assert_allclose(o_vals, np.sort(x, -1)[:, ::-1][:, :3],
+                               rtol=1e-6)
+    np.testing.assert_allclose(o_am, x.argmax(-1))
+    np.testing.assert_allclose(o_oh.argmax(-1), x.argmax(-1))
+
+
+def test_reduce_and_cumsum():
+    x = RNG.randn(3, 4, 5).astype("float32")
+    v = layers.data("x", shape=[4, 5])
+    s = layers.reduce_sum(v, dim=1)
+    m = layers.reduce_mean(v, dim=[1, 2], keep_dim=True)
+    c_ex_rev = layers.cumsum(v, axis=2, exclusive=True, reverse=True)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    o_s, o_m, o_c = exe.run(feed={"x": x}, fetch_list=[s, m, c_ex_rev])
+    np.testing.assert_allclose(o_s, x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(o_m, x.mean((1, 2), keepdims=True), rtol=1e-5)
+    ref = np.flip(np.cumsum(np.flip(x, 2), 2) - np.flip(x, 2), 2)
+    np.testing.assert_allclose(o_c, ref, rtol=1e-4)
+
+
+def test_gather_scatter_where():
+    x = RNG.randn(6, 3).astype("float32")
+    idx = np.array([0, 2, 4], "int64")
+    v = layers.data("x", shape=[6, 3], append_batch_size=False)
+    i = layers.data("i", shape=[3], dtype="int64", append_batch_size=False)
+    g = layers.gather(v, i)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = exe.run(feed={"x": x, "i": idx}, fetch_list=[g])[0]
+    np.testing.assert_allclose(got, x[idx])
+
+
+def test_sequence_ops_masked():
+    x = RNG.randn(3, 5, 4).astype("float32")
+    lens = np.array([2, 5, 3], "int64")
+    v = layers.data("x", shape=[5, 4])
+    sl = layers.data("sl", shape=[], dtype="int64")
+    pool = layers.sequence_pool(v, "average", seq_len=sl)
+    smax = layers.sequence_pool(v, "max", seq_len=sl)
+    sm = layers.sequence_softmax(v, seq_len=sl)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    o_pool, o_max, o_sm = exe.run(feed={"x": x, "sl": lens},
+                                  fetch_list=[pool, smax, sm])
+    for b, L in enumerate(lens):
+        np.testing.assert_allclose(o_pool[b], x[b, :L].mean(0), rtol=1e-5)
+        np.testing.assert_allclose(o_max[b], x[b, :L].max(0), rtol=1e-5)
+        # softmax over valid region sums to 1; padding is 0
+        np.testing.assert_allclose(o_sm[b, :L].sum(0), np.ones(4),
+                                   rtol=1e-5)
+        if L < 5:
+            np.testing.assert_allclose(o_sm[b, L:], 0.0)
+
+
+def test_lstm_gru_shapes_and_mask():
+    x = RNG.randn(2, 6, 3).astype("float32")
+    lens = np.array([3, 6], "int64")
+    v = layers.data("x", shape=[6, 3])
+    sl = layers.data("sl", shape=[], dtype="int64")
+    h, c = layers.dynamic_lstm(v, size=16, seq_len=sl)
+    g = layers.dynamic_gru(v, size=4, seq_len=sl)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    o_h, o_c, o_g = exe.run(feed={"x": x, "sl": lens},
+                            fetch_list=[h, c, g])
+    assert o_h.shape == (2, 6, 4)
+    assert o_c.shape == (2, 4)
+    assert o_g.shape == (2, 6, 4)
+    # after seq end, hidden stays frozen (mask)
+    np.testing.assert_allclose(o_h[0, 2], o_h[0, 5], rtol=1e-5)
+
+
+def test_control_flow_cond_while():
+    from paddle_tpu.layers import control_flow as cf
+    from paddle_tpu.layers import tensor as t
+    x = layers.data("x", shape=[1])
+
+    def true_fn():
+        return layers.scale(x, 2.0)
+
+    def false_fn():
+        return layers.scale(x, -1.0)
+
+    pred = cf.greater_than(layers.reduce_sum(x),
+                           t.fill_constant([1], "float32", 0.0))
+    out = cf.cond(pred, true_fn, false_fn)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    pos = exe.run(feed={"x": np.array([[3.0]], "float32")},
+                  fetch_list=[out])[0]
+    neg = exe.run(feed={"x": np.array([[-3.0]], "float32")},
+                  fetch_list=[out])[0]
+    assert pos[0, 0] == 6.0 and neg[0, 0] == 3.0
+
+
+def test_while_loop():
+    from paddle_tpu.layers import control_flow as cf
+    from paddle_tpu.layers import tensor as t
+    i = t.fill_constant([1], "float32", 0.0)
+    ten = t.fill_constant([1], "float32", 10.0)
+
+    def cond_fn(it):
+        return cf.less_than(it, ten)
+
+    def body(it):
+        return [layers.scale(it, 1.0, bias=1.0)]
+
+    out = cf.while_loop(cond_fn, body, [i])
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = exe.run(feed={}, fetch_list=[out[0]])[0]
+    assert got[0] == 10.0
+
+
+def test_math_op_patch():
+    a = layers.data("a", shape=[4])
+    b = layers.data("b", shape=[4])
+    c = (a + b) * 2.0 - a / (b + 5.0)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    av = RNG.randn(2, 4).astype("float32")
+    bv = RNG.rand(2, 4).astype("float32")
+    got = exe.run(feed={"a": av, "b": bv}, fetch_list=[c])[0]
+    np.testing.assert_allclose(got, (av + bv) * 2 - av / (bv + 5), rtol=1e-5)
+
+
+def test_isfinite_detects_nan():
+    v = layers.data("x", shape=[3])
+    ok = layers.isfinite(v)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    good = exe.run(feed={"x": np.ones((2, 3), "float32")},
+                   fetch_list=[ok])[0]
+    bad = exe.run(feed={"x": np.array([[1, np.nan, 2]], "float32")},
+                  fetch_list=[ok])[0]
+    assert bool(good) is True and bool(bad) is False
