@@ -20,7 +20,8 @@ __all__ = [
     "hinge_loss", "bpr_loss", "margin_rank_loss", "log_loss", "kldiv_loss",
     "mse_loss", "smooth_l1", "label_smooth", "one_hot", "nce",
     "sampled_softmax_with_cross_entropy",
-    "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit",
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "lstm_unit", "gru_unit",
+    "lstm",
     "matmul", "mul", "bmm", "dot", "transpose", "reshape", "squeeze",
     "unsqueeze", "flatten", "stack", "unstack", "expand", "expand_as",
     "slice", "strided_slice", "gather", "gather_nd", "scatter",
@@ -549,7 +550,93 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     helper.append_op("lstm", ins,
                      {"Hidden": [h_seq], "LastH": [last_h], "LastC": [last_c]},
                      {"is_reverse": is_reverse})
+    h_seq._last_h, h_seq._last_c = last_h, last_c
     return h_seq, last_c
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, seq_len=None):
+    """LSTM with recurrent projection (ref layers/nn.py:dynamic_lstmp,
+    lstmp_op). input [B,T,D]; size = 4*hidden. Returns
+    (projection [B,T,P], last cell [B,H])."""
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    hidden = size // 4
+    d_in = int(input.shape[-1])
+    w_ih = helper.create_parameter(param_attr, shape=[d_in, 4 * hidden],
+                                   dtype=dtype)
+    w_hh = helper.create_parameter(param_attr, shape=[proj_size, 4 * hidden],
+                                   dtype=dtype)
+    w_proj = helper.create_parameter(param_attr, shape=[hidden, proj_size],
+                                     dtype=dtype)
+    b = helper.create_parameter(bias_attr, shape=[4 * hidden], dtype=dtype,
+                                is_bias=True)
+    B, T = input.shape[0], input.shape[1]
+    proj = helper.create_variable_for_type_inference(dtype, (B, T, proj_size))
+    last_h = helper.create_variable_for_type_inference(dtype, (B, proj_size))
+    last_c = helper.create_variable_for_type_inference(dtype, (B, hidden))
+    ins = {"Input": [input], "WeightIH": [w_ih], "WeightHH": [w_hh],
+           "Proj": [w_proj]}
+    if b is not None:
+        ins["Bias"] = [b]
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op("lstmp", ins,
+                     {"Projection": [proj], "LastH": [last_h],
+                      "LastC": [last_c]},
+                     {"is_reverse": is_reverse})
+    return proj, last_c
+
+
+def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, is_test=False,
+         name=None, default_initializer=None, seed=-1, seq_len=None):
+    """Multi-layer (optionally bidirectional) LSTM (ref layers/nn.py:lstm,
+    cudnn_lstm_op → stacked lax.scan LSTMs; XLA fuses the stack).
+
+    input [B,T,D]. Returns (rnn_out [B,T,H*dirs], last_h [L*dirs,B,H],
+    last_c [L*dirs,B,H]).
+    """
+    if hidden_size is None:
+        raise ValueError("lstm requires hidden_size")
+    from .tensor import concat as _concat
+
+    def _init_state(packed, idx):
+        # packed [L*dirs, B, H] → [B, H] for layer-direction idx
+        if packed is None:
+            return None
+        s = slice(packed, axes=[0], starts=[idx], ends=[idx + 1])
+        return squeeze(s, axes=[0])
+
+    x = input
+    last_hs, last_cs = [], []
+    idx = 0
+    for layer in range(num_layers):
+        fw, fw_c = dynamic_lstm(
+            x, 4 * hidden_size, h_0=_init_state(init_h, idx),
+            c_0=_init_state(init_c, idx), seq_len=seq_len,
+            name=f"{name or 'lstm'}_l{layer}_fw")
+        last_hs.append(fw._last_h)
+        idx += 1
+        if is_bidirec:
+            bw, bw_c = dynamic_lstm(
+                x, 4 * hidden_size, is_reverse=True,
+                h_0=_init_state(init_h, idx), c_0=_init_state(init_c, idx),
+                seq_len=seq_len, name=f"{name or 'lstm'}_l{layer}_bw")
+            last_hs.append(bw._last_h)
+            idx += 1
+            x = _concat([fw, bw], axis=-1)
+            last_cs += [fw_c, bw_c]
+        else:
+            x = fw
+            last_cs.append(fw_c)
+        if dropout_prob > 0.0 and layer < num_layers - 1:
+            x = dropout(x, dropout_prob, is_test=is_test)
+    last_h = stack(last_hs, axis=0)  # [L*dirs, B, H]
+    last_c = stack(last_cs, axis=0)
+    return x, last_h, last_c
 
 
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,

@@ -11,7 +11,10 @@ from ..layer_helper import LayerHelper
 __all__ = [
     "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_concat",
     "sequence_reverse", "sequence_mask", "sequence_last_step",
-    "sequence_first_step", "sequence_pad",
+    "sequence_first_step", "sequence_pad", "sequence_conv",
+    "sequence_expand_as", "sequence_reshape", "sequence_slice",
+    "sequence_unpad", "sequence_scatter", "sequence_enumerate", "row_conv",
+    "chunk_eval",
 ]
 
 
@@ -94,3 +97,143 @@ def sequence_pad(x, pad_value=None, maxlen=None, seq_len=None, name=None):
     helper.append_op("sequence_pad", {"X": [x], "SeqLen": [seq_len]},
                      {"Out": [out], "Length": [length]}, {})
     return out, length
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, bias_attr=None, param_attr=None, act=None,
+                  name=None, seq_len=None):
+    """Context-window conv over time (ref layers/nn.py:sequence_conv).
+    input [B,T,D]."""
+    if filter_stride != 1:
+        raise ValueError(
+            "sequence_conv supports filter_stride == 1 only (matching the "
+            "reference sequence_conv_op)")
+    helper = LayerHelper("sequence_conv", name=name, act=act,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    D = int(input.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                shape=[filter_size * D, num_filters],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype, (input.shape[0], input.shape[1], num_filters))
+    ins = {"X": [input], "Filter": [w]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op("sequence_conv", ins, {"Out": [out]},
+                     {"context_length": filter_size,
+                      "context_start": -((filter_size - 1) // 2),
+                      "context_stride": filter_stride})
+    out = helper.append_bias_op(out, dim_start=2, bias_attr=bias_attr,
+                                size=num_filters)
+    return helper.append_activation(out, act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None, name=None):
+    """Lookahead convolution (ref layers/nn.py:row_conv). input [B,T,D]."""
+    helper = LayerHelper("row_conv", name=name)
+    D = int(input.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                shape=[future_context_size + 1, D],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("row_conv", {"X": [input], "Filter": [w]},
+                     {"Out": [out]}, {})
+    return helper.append_activation(out, act)
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out_shape = ((x.shape[0], y.shape[1]) + tuple(x.shape[1:])
+                 if len(x.shape) != len(y.shape)
+                 else tuple(y.shape[:2]) + tuple(x.shape[2:]))
+    out = helper.create_variable_for_type_inference(x.dtype, out_shape)
+    helper.append_op("sequence_expand_as", {"X": [x], "Y": [y]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    helper = LayerHelper("sequence_reshape", name=name)
+    B, T, D = input.shape[0], int(input.shape[1]), int(input.shape[-1])
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (B, T * D // new_dim, new_dim))
+    helper.append_op("sequence_reshape", {"X": [input]}, {"Out": [out]},
+                     {"new_dim": new_dim})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence slice; offset/length are [B] (or [B,1]) tensors.
+    Output stays padded at input's T with new lengths returned."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    out_len = helper.create_variable_for_type_inference(
+        "int64", (input.shape[0],), True)
+    helper.append_op("sequence_slice",
+                     {"X": [input], "Offset": [offset], "Length": [length]},
+                     {"Out": [out], "OutLen": [out_len]}, {})
+    return out, out_len
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded analog of ref sequence_unpad: masks past-length positions;
+    returns (data, lengths)."""
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    out_len = helper.create_variable_for_type_inference(
+        "int64", (x.shape[0],), True)
+    helper.append_op("sequence_unpad", {"X": [x], "Length": [length]},
+                     {"Out": [out], "OutLen": [out_len]}, {})
+    return out, out_len
+
+
+def sequence_scatter(input, index, updates, seq_len=None, name=None):
+    """Adds updates into input at per-row time positions (ref
+    sequence_scatter_op)."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op("sequence_scatter", ins, {"Out": [out]}, {})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, seq_len=None, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(input.shape) + (win_size,), True)
+    ins = {"X": [input]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op("sequence_enumerate", ins, {"Out": [out]},
+                     {"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types, seq_len=None,
+               excluded_chunk_types=None, name=None):
+    """Chunk detection metrics (ref layers/nn.py:chunk_eval). IOB scheme:
+    label = type*2 + (0 for B, 1 for I); label == 2*num_chunk_types is O."""
+    if chunk_scheme not in ("IOB",):
+        raise NotImplementedError(
+            f"chunk_scheme {chunk_scheme!r}: only IOB supported (the other "
+            "ref schemes re-encode to IOB)")
+    helper = LayerHelper("chunk_eval", name=name)
+    f32 = lambda: helper.create_variable_for_type_inference("float32", (), True)
+    i64 = lambda: helper.create_variable_for_type_inference("int64", (), True)
+    prec, rec, f1 = f32(), f32(), f32()
+    ni, nl, nc = i64(), i64(), i64()
+    ins = {"Inference": [input], "Label": [label]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op("chunk_eval", ins,
+                     {"Precision": [prec], "Recall": [rec], "F1-Score": [f1],
+                      "NumInferChunks": [ni], "NumLabelChunks": [nl],
+                      "NumCorrectChunks": [nc]},
+                     {"num_chunk_types": num_chunk_types,
+                      "chunk_scheme": chunk_scheme,
+                      "excluded_chunk_types":
+                          list(excluded_chunk_types or [])})
+    return prec, rec, f1, ni, nl, nc

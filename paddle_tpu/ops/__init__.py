@@ -5,4 +5,5 @@ from . import kernels_math
 from . import kernels_nn
 from . import kernels_optim
 from . import kernels_detection
+from . import kernels_sequence
 from .registry import KERNELS, get_kernel, has_kernel

@@ -1,0 +1,257 @@
+"""Sequence op kernels (batch 2 of the LoD→padded redesign).
+
+Parity: paddle/fluid/operators/sequence_ops/{sequence_conv,sequence_slice,
+sequence_scatter,sequence_enumerate,sequence_reshape,sequence_unpad}_op.*,
+operators/row_conv_op.*, operators/lstmp_op.*, operators/chunk_eval_op.*.
+The reference walks LoD offsets on the host; every kernel here is a pure
+static-shape jnp function over (data [B,T,...], seq_len [B]) so the whole
+program stays inside one XLA module.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import kernel
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+def _opt(ins, slot):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+def _mask(B, T, seq_len):
+    return jnp.arange(T)[None, :] < seq_len.reshape(B, 1)
+
+
+@kernel("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over time (ref sequence_conv_op.cc).
+
+    X [B,T,D], Filter [ctx*D, M]. Window t covers
+    [t+context_start, t+context_start+ctx) with zero padding outside.
+    """
+    x, w = _x(ins), ins["Filter"][0]
+    seq_len = _opt(ins, "SeqLen")
+    ctx_len = int(attrs["context_length"])
+    ctx_start = int(attrs.get("context_start", -((ctx_len - 1) // 2)))
+    B, T, D = x.shape
+    if seq_len is not None:
+        x = jnp.where(_mask(B, T, seq_len)[..., None], x, 0.0)
+    lo = max(0, -ctx_start)
+    hi = max(0, ctx_start + ctx_len - 1)
+    xp = jnp.pad(x, ((0, 0), (lo, hi), (0, 0)))
+    cols = [jax.lax.dynamic_slice_in_dim(xp, lo + ctx_start + i, T, axis=1)
+            for i in range(ctx_len)]
+    windows = jnp.concatenate(cols, axis=-1)         # [B,T,ctx*D]
+    out = windows @ w
+    if seq_len is not None:
+        out = jnp.where(_mask(B, T, seq_len)[..., None], out, 0.0)
+    return {"Out": [out]}
+
+
+@kernel("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead conv (ref row_conv_op.cc, DeepSpeech2): Filter [F+1, D],
+    out[t] = sum_i x[t+i] * w[i]."""
+    x, w = _x(ins), ins["Filter"][0]
+    B, T, D = x.shape
+    F = w.shape[0]
+    xp = jnp.pad(x, ((0, 0), (0, F - 1), (0, 0)))
+    out = sum(jax.lax.dynamic_slice_in_dim(xp, i, T, axis=1) * w[i]
+              for i in range(F))
+    return {"Out": [out]}
+
+
+@kernel("sequence_expand_as")
+def _sequence_expand_as(ctx, ins, attrs):
+    x, y = _x(ins), ins["Y"][0]
+    if x.ndim == y.ndim:
+        return {"Out": [jnp.broadcast_to(x, y.shape[:2] + x.shape[2:])]}
+    return {"Out": [jnp.broadcast_to(x[:, None],
+                                     (x.shape[0], y.shape[1]) + x.shape[1:])]}
+
+
+@kernel("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    x = _x(ins)
+    new_dim = int(attrs["new_dim"])
+    B = x.shape[0]
+    return {"Out": [x.reshape(B, -1, new_dim)]}
+
+
+@kernel("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    """Per-sequence slice: Out[b, i] = X[b, offset[b]+i] for i < length[b],
+    zero elsewhere (static output T, lengths carried separately)."""
+    x = _x(ins)
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    B, T = x.shape[0], x.shape[1]
+    idx = off[:, None] + jnp.arange(T)[None, :]
+    idx = jnp.clip(idx, 0, T - 1)
+    gathered = jnp.take_along_axis(
+        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+    m = _mask(B, T, length).reshape((B, T) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(m, gathered, 0)], "OutLen": [length]}
+
+
+@kernel("sequence_unpad")
+def _sequence_unpad(ctx, ins, attrs):
+    """Padded analog of sequence_unpad_op: zero out positions past Length
+    (data stays padded; Length is the LoD)."""
+    x, length = _x(ins), ins["Length"][0].reshape(-1)
+    B, T = x.shape[0], x.shape[1]
+    m = _mask(B, T, length).reshape((B, T) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(m, x, 0)], "OutLen": [length]}
+
+
+@kernel("sequence_scatter")
+def _sequence_scatter(ctx, ins, attrs):
+    """Out = X with Updates added at time positions Ids (per batch row),
+    ref sequence_scatter_op.cc."""
+    x = _x(ins)
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    seq_len = _opt(ins, "SeqLen")
+    B, K = ids.shape[0], ids.shape[1]
+    if seq_len is not None:
+        m = _mask(B, K, seq_len).reshape((B, K) + (1,) * (upd.ndim - 2))
+        upd = jnp.where(m, upd, 0)
+    b_idx = jnp.repeat(jnp.arange(B), K)
+    return {"Out": [x.at[b_idx, ids.reshape(-1)].add(
+        upd.reshape((B * K,) + upd.shape[2:]))]}
+
+
+@kernel("sequence_enumerate")
+def _sequence_enumerate(ctx, ins, attrs):
+    """Ids [B,T] → [B,T,win] sliding windows, pad_value past end
+    (ref sequence_enumerate_op.cc)."""
+    ids = _x(ins)
+    win = int(attrs["win_size"])
+    pad = attrs.get("pad_value", 0)
+    seq_len = _opt(ins, "SeqLen")
+    B, T = ids.shape[0], ids.shape[1]
+    xp = jnp.pad(ids, ((0, 0), (0, win - 1)), constant_values=pad)
+    out = jnp.stack([jax.lax.dynamic_slice_in_dim(xp, i, T, axis=1)
+                     for i in range(win)], axis=-1)
+    if seq_len is not None:
+        # window element t+i valid only if t+i < seq_len
+        pos = jnp.arange(T)[None, :, None] + jnp.arange(win)[None, None, :]
+        valid = pos < seq_len.reshape(B, 1, 1)
+        out = jnp.where(valid, out, pad)
+    return {"Out": [out]}
+
+
+@kernel("lstmp")
+def _lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (ref lstmp_op.cc).
+
+    WeightIH [D,4H], WeightHH [P,4H], Proj [H,P]. Recurrent state is the
+    projected r [B,P]; cell state [B,H].
+    """
+    x = _x(ins, "Input")
+    w_ih, w_hh, w_proj = ins["WeightIH"][0], ins["WeightHH"][0], ins["Proj"][0]
+    b = _opt(ins, "Bias")
+    seq_len = _opt(ins, "SeqLen")
+    H, P = w_proj.shape
+    B, T = x.shape[0], x.shape[1]
+    r0 = _opt(ins, "H0")
+    c0 = _opt(ins, "C0")
+    r0 = jnp.zeros((B, P), x.dtype) if r0 is None else r0
+    c0 = jnp.zeros((B, H), x.dtype) if c0 is None else c0
+    mask = (_mask(B, T, seq_len).T if seq_len is not None
+            else jnp.ones((T, B), bool))
+
+    def step(carry, inp):
+        r, c = carry
+        xt, mt = inp
+        gates = xt @ w_ih + r @ w_hh
+        if b is not None:
+            gates = gates + b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        r_new = (o * jnp.tanh(c_new)) @ w_proj
+        m = mt[..., None]
+        r_new = jnp.where(m, r_new, r)
+        c_new = jnp.where(m, c_new, c)
+        return (r_new, c_new), r_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if attrs.get("is_reverse", False):
+        xs, mask = jnp.flip(xs, 0), jnp.flip(mask, 0)
+    (rT, cT), r_seq = jax.lax.scan(step, (r0, c0), (xs, mask))
+    if attrs.get("is_reverse", False):
+        r_seq = jnp.flip(r_seq, 0)
+    return {"Projection": [jnp.swapaxes(r_seq, 0, 1)],
+            "LastH": [rT], "LastC": [cT]}
+
+
+def _chunk_marks(lab, num_chunk_types, T):
+    """IOB decoding: label = type*2 + (0:B, 1:I); label == 2*n is O.
+
+    Returns (in_chunk, start, end_index, ctype): end_index[t] = index of the
+    last position of the chunk containing t (undefined outside chunks).
+    """
+    o_tag = 2 * num_chunk_types
+    is_o = lab >= o_tag
+    is_b = (~is_o) & (lab % 2 == 0)
+    is_i = (~is_o) & (lab % 2 == 1)
+    ctype = lab // 2
+    prev_type = jnp.concatenate([jnp.full_like(ctype[:, :1], -1),
+                                 ctype[:, :-1]], axis=1)
+    prev_in = jnp.concatenate([jnp.zeros_like(is_o[:, :1]),
+                               ~is_o[:, :-1]], axis=1)
+    # conll semantics: I starts a chunk when not continuing same-type chunk
+    start = is_b | (is_i & (~prev_in | (prev_type != ctype)))
+    in_chunk = ~is_o
+    # a position continues the chunk of t-1 iff in_chunk[t] and not start[t]
+    cont = in_chunk & (~start)                       # [B,T]
+
+    def back(carry, inp):
+        cont_next, idx = inp                          # cont[t+1], t
+        end = jnp.where(cont_next, carry, idx)        # if next continues, share
+        return end, end
+
+    idxs = jnp.arange(T)
+    cont_next = jnp.concatenate([cont[:, 1:], jnp.zeros_like(cont[:, :1])],
+                                axis=1)               # [B,T]
+    _, ends = jax.lax.scan(
+        back, jnp.full((lab.shape[0],), T - 1),
+        (cont_next.T, idxs), reverse=True)
+    return in_chunk, start, ends.T, ctype
+
+
+@kernel("chunk_eval")
+def _chunk_eval(ctx, ins, attrs):
+    """Chunk detection P/R/F1 (ref chunk_eval_op.cc, IOB scheme)."""
+    inf = ins["Inference"][0].reshape(ins["Inference"][0].shape[0], -1)
+    lab = ins["Label"][0].reshape(ins["Label"][0].shape[0], -1)
+    seq_len = _opt(ins, "SeqLen")
+    n = int(attrs["num_chunk_types"])
+    B, T = lab.shape
+    m = (_mask(B, T, seq_len) if seq_len is not None
+         else jnp.ones((B, T), bool))
+    o_tag = 2 * n
+    inf = jnp.where(m, inf, o_tag)
+    lab = jnp.where(m, lab, o_tag)
+    for t in attrs.get("excluded_chunk_types") or []:
+        inf = jnp.where(inf // 2 == t, o_tag, inf)
+        lab = jnp.where(lab // 2 == t, o_tag, lab)
+    _, s_i, e_i, t_i = _chunk_marks(inf, n, T)
+    _, s_l, e_l, t_l = _chunk_marks(lab, n, T)
+    n_inf = jnp.sum(s_i)
+    n_lab = jnp.sum(s_l)
+    correct = jnp.sum(s_i & s_l & (t_i == t_l) & (e_i == e_l))
+    f = jnp.float32
+    prec = jnp.where(n_inf > 0, correct / jnp.maximum(n_inf, 1).astype(f), 0.0)
+    rec = jnp.where(n_lab > 0, correct / jnp.maximum(n_lab, 1).astype(f), 0.0)
+    f1 = jnp.where(prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+    return {"Precision": [prec.astype(f)], "Recall": [rec.astype(f)],
+            "F1-Score": [f1.astype(f)],
+            "NumInferChunks": [n_inf.astype(jnp.int64)],
+            "NumLabelChunks": [n_lab.astype(jnp.int64)],
+            "NumCorrectChunks": [correct.astype(jnp.int64)]}

@@ -1,0 +1,242 @@
+"""Sequence-op batch tests (ref tests/unittests/test_sequence_*_op.py,
+test_row_conv_op.py, test_lstmp_op.py, test_chunk_eval_op.py) — numeric
+checks vs numpy over the padded+seq_len convention."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+RNG = np.random.RandomState(11)
+
+
+def run(build, feeds, n_fetch=1, is_test=True):
+    exe = pt.Executor(pt.CPUPlace())
+    outs = build()
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feeds, fetch_list=list(outs[:n_fetch]),
+                   is_test=is_test)
+
+
+def test_sequence_conv_matches_manual_window():
+    B, T, D, M, K = 2, 5, 3, 4, 3
+    x = RNG.randn(B, T, D).astype("float32")
+    lens = np.array([5, 3], dtype="int64")
+
+    def build():
+        v = layers.data("x", shape=[T, D])
+        sl = layers.data("sl", shape=[1], dtype="int64")
+        return layers.sequence_conv(v, M, filter_size=K, bias_attr=False,
+                                    seq_len=sl)
+
+    out = run(build, {"x": x, "sl": lens})[0]
+    # recompute: zero-masked input, zero-padded context window, times W
+    w = None
+    for v in pt.global_scope().keys():
+        if "sequence_conv" in v and v.endswith("w_0"):
+            w = np.asarray(pt.global_scope().find_var(v).get_tensor())
+    assert w is not None
+    xm = x.copy()
+    xm[1, 3:] = 0
+    xp = np.pad(xm, ((0, 0), (1, 1), (0, 0)))
+    win = np.concatenate([xp[:, i:i + T] for i in range(K)], axis=-1)
+    ref = win @ w
+    ref[1, 3:] = 0
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_row_conv_lookahead():
+    B, T, D, F = 2, 6, 4, 2
+    x = RNG.randn(B, T, D).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[T, D])
+        return layers.row_conv(v, F)
+
+    out = run(build, {"x": x})[0]
+    w = None
+    for v in pt.global_scope().keys():
+        if "row_conv" in v and v.endswith("w_0"):
+            w = np.asarray(pt.global_scope().find_var(v).get_tensor())
+    xp = np.pad(x, ((0, 0), (0, F), (0, 0)))
+    ref = sum(xp[:, i:i + T] * w[i] for i in range(F + 1))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_expand_as_and_reshape():
+    B, T, D = 2, 4, 6
+    x = RNG.randn(B, D).astype("float32")
+    y = RNG.randn(B, T, 2).astype("float32")
+
+    def build():
+        a = layers.data("x", shape=[D])
+        b = layers.data("y", shape=[T, 2])
+        e = layers.sequence_expand_as(a, b)
+        r = layers.sequence_reshape(b, new_dim=4)
+        return e, r
+
+    exe = pt.Executor(pt.CPUPlace())
+    e, r = None, None
+
+    def build2():
+        nonlocal e, r
+        e, r = build()
+        return e
+
+    run(build2, {"x": x, "y": y})
+    exe = pt.Executor(pt.CPUPlace())
+    outs = exe.run(feed={"x": x, "y": y}, fetch_list=[e, r], is_test=True)
+    np.testing.assert_allclose(outs[0],
+                               np.broadcast_to(x[:, None], (B, T, D)))
+    np.testing.assert_allclose(outs[1], y.reshape(B, T * 2 // 4, 4))
+
+
+def test_sequence_slice_and_unpad():
+    B, T, D = 2, 5, 3
+    x = RNG.randn(B, T, D).astype("float32")
+    off = np.array([1, 0], dtype="int64")
+    length = np.array([3, 2], dtype="int64")
+
+    def build():
+        v = layers.data("x", shape=[T, D])
+        o = layers.data("off", shape=[1], dtype="int64")
+        l = layers.data("len", shape=[1], dtype="int64")
+        out, _ = layers.sequence_slice(v, o, l)
+        up, _ = layers.sequence_unpad(v, l)
+        return out, up
+
+    exe = pt.Executor(pt.CPUPlace())
+    outs_v = []
+
+    def build2():
+        r = build()
+        outs_v.extend(r)
+        return r[0]
+
+    run(build2, {"x": x, "off": off, "len": length})
+    exe = pt.Executor(pt.CPUPlace())
+    outs = exe.run(feed={"x": x, "off": off, "len": length},
+                   fetch_list=outs_v, is_test=True)
+    ref = np.zeros_like(x)
+    ref[0, :3] = x[0, 1:4]
+    ref[1, :2] = x[1, 0:2]
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-6)
+    ref_up = x.copy()
+    ref_up[0, 3:] = 0
+    ref_up[1, 2:] = 0
+    np.testing.assert_allclose(outs[1], ref_up, rtol=1e-6)
+
+
+def test_sequence_scatter_adds_updates():
+    B, T, D, K = 2, 5, 2, 3
+    x = RNG.randn(B, T, D).astype("float32")
+    ids = np.array([[0, 2, 4], [1, 1, 3]], dtype="int64")
+    upd = RNG.randn(B, K, D).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[T, D])
+        i = layers.data("ids", shape=[K], dtype="int64")
+        u = layers.data("upd", shape=[K, D])
+        return layers.sequence_scatter(v, i, u)
+
+    out = run(build, {"x": x, "ids": ids, "upd": upd})[0]
+    ref = x.copy()
+    for b in range(B):
+        for k in range(K):
+            ref[b, ids[b, k]] += upd[b, k]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_sequence_enumerate_windows():
+    ids = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], dtype="int64")
+    lens = np.array([4, 2], dtype="int64")
+
+    def build():
+        v = layers.data("ids", shape=[4], dtype="int64")
+        sl = layers.data("sl", shape=[1], dtype="int64")
+        return layers.sequence_enumerate(v, win_size=2, pad_value=0,
+                                         seq_len=sl)
+
+    out = run(build, {"ids": ids, "sl": lens})[0]
+    ref = np.array([[[1, 2], [2, 3], [3, 4], [4, 0]],
+                    [[5, 6], [6, 0], [0, 0], [0, 0]]])
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_dynamic_lstmp_shapes_and_masking():
+    B, T, D, H, P = 2, 6, 4, 8, 3
+    x = RNG.randn(B, T, D).astype("float32")
+    lens = np.array([6, 3], dtype="int64")
+
+    def build():
+        v = layers.data("x", shape=[T, D])
+        sl = layers.data("sl", shape=[1], dtype="int64")
+        proj, c = layers.dynamic_lstmp(v, 4 * H, P, seq_len=sl)
+        return proj
+
+    out = run(build, {"x": x, "sl": lens})[0]
+    assert out.shape == (B, T, P)
+    # masked positions hold the frozen state, later positions equal t=2 state
+    np.testing.assert_allclose(out[1, 3], out[1, 5], rtol=1e-6)
+
+
+def test_multilayer_lstm_runs():
+    B, T, D, H = 2, 5, 3, 4
+    x = RNG.randn(B, T, D).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[T, D])
+        h0 = layers.data("h0", shape=[4, B, H], append_batch_size=False)
+        c0 = layers.data("c0", shape=[4, B, H], append_batch_size=False)
+        out, lh, lc = layers.lstm(v, init_h=h0, init_c=c0, hidden_size=H,
+                                  num_layers=2, is_bidirec=True)
+        return out, lh, lc
+
+    vs = []
+
+    def build2():
+        vs.extend(build())
+        return vs[0]
+
+    h0 = RNG.randn(4, B, H).astype("float32")
+    c0 = RNG.randn(4, B, H).astype("float32")
+    feeds = {"x": x, "h0": h0, "c0": c0}
+    run(build2, feeds)
+    exe = pt.Executor(pt.CPUPlace())
+    out, lh, lc = exe.run(feed=feeds, fetch_list=vs, is_test=True)
+    assert out.shape == (B, T, 2 * H)
+    assert lh.shape == (4, B, H) and lc.shape == (4, B, H)
+    # hidden and cell states are distinct streams
+    assert not np.allclose(lh, lc)
+
+
+def test_chunk_eval_iob():
+    # type*2 + {0:B, 1:I}; O == 4 (2 chunk types)
+    lab = np.array([[0, 1, 4, 2, 3, 4]], dtype="int64")   # chunks: A[0:2], B[3:5]
+    inf = np.array([[0, 1, 4, 2, 4, 4]], dtype="int64")   # chunks: A[0:2], B[3:4]
+    lens = np.array([6], dtype="int64")
+
+    def build():
+        i = layers.data("inf", shape=[6], dtype="int64")
+        l = layers.data("lab", shape=[6], dtype="int64")
+        sl = layers.data("sl", shape=[1], dtype="int64")
+        prec, rec, f1, ni, nl, nc = layers.chunk_eval(
+            i, l, "IOB", num_chunk_types=2, seq_len=sl)
+        return prec, rec, f1, ni, nl, nc
+
+    exe = pt.Executor(pt.CPUPlace())
+    vs = []
+
+    def build2():
+        vs.extend(build())
+        return vs[0]
+
+    run(build2, {"inf": inf, "lab": lab, "sl": lens})
+    exe = pt.Executor(pt.CPUPlace())
+    prec, rec, f1, ni, nl, nc = exe.run(
+        feed={"inf": inf, "lab": lab, "sl": lens}, fetch_list=vs,
+        is_test=True)
+    assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+    np.testing.assert_allclose(float(prec), 0.5)
+    np.testing.assert_allclose(float(rec), 0.5)
