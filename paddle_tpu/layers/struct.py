@@ -1,0 +1,188 @@
+"""Structured-prediction layers: CRF, CTC, edit distance, beam search,
+hierarchical sigmoid.
+
+Parity: python/paddle/fluid/layers/nn.py {linear_chain_crf, crf_decoding,
+warpctc, ctc_greedy_decoder, edit_distance, beam_search,
+beam_search_decode, hsigmoid}. LoD inputs become padded arrays +
+per-row length tensors (SURVEY §6); decode outputs are end/-1 padded
+with explicit lengths instead of LoD levels.
+"""
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "linear_chain_crf", "crf_decoding", "warpctc", "ctc_greedy_decoder",
+    "edit_distance", "beam_search", "beam_search_decode", "hsigmoid",
+]
+
+
+def linear_chain_crf(input, label, param_attr=None, seq_len=None, name=None):
+    """CRF negative log-likelihood [B,1]; creates transition param
+    [N+2, N] (row0 start, row1 end) like the reference."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    n = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[n + 2, n],
+                                dtype=input.dtype)
+    B = input.shape[0]
+    nll = helper.create_variable_for_type_inference(input.dtype, (B, 1))
+    alpha = helper.create_variable_for_type_inference(input.dtype, (B, n), True)
+    eexp = helper.create_variable_for_type_inference(input.dtype, input.shape, True)
+    texp = helper.create_variable_for_type_inference(input.dtype, (n + 2, n), True)
+    ins = {"Emission": [input], "Transition": [w], "Label": [label]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op("linear_chain_crf", ins,
+                     {"LogLikelihood": [nll], "Alpha": [alpha],
+                      "EmissionExps": [eexp], "TransitionExps": [texp]}, {})
+    return nll
+
+
+def crf_decoding(input, param_attr=None, label=None, seq_len=None, name=None):
+    """Viterbi decode [B,T] (or 0/1 correctness vs label). param_attr must
+    name the transition parameter created by linear_chain_crf."""
+    helper = LayerHelper("crf_decoding", name=name)
+    n = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[n + 2, n],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(
+        "int64", tuple(input.shape[:-1]), True)
+    ins = {"Emission": [input], "Transition": [w]}
+    if label is not None:
+        ins["Label"] = [label]
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    helper.append_op("crf_decoding", ins, {"ViterbiPath": [out]}, {})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None, name=None):
+    """CTC loss [B,1] from logits [B,T,C] and labels [B,L] (ref warpctc)."""
+    helper = LayerHelper("warpctc", name=name)
+    B = input.shape[0]
+    loss = helper.create_variable_for_type_inference(input.dtype, (B, 1))
+    grad = helper.create_variable_for_type_inference(
+        input.dtype, input.shape, True)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op("warpctc", ins,
+                     {"Loss": [loss], "WarpCTCGrad": [grad]},
+                     {"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Greedy CTC decode: (ids [B,T] padded with -1, lengths [B])."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    B, T = input.shape[0], input.shape[1]
+    out = helper.create_variable_for_type_inference("int64", (B, T), True)
+    out_len = helper.create_variable_for_type_inference("int64", (B,), True)
+    ins = {"X": [input]}
+    if input_length is not None:
+        ins["SeqLen"] = [input_length]
+    helper.append_op("ctc_greedy_decoder", ins,
+                     {"Out": [out], "OutLen": [out_len]}, {"blank": blank})
+    return out, out_len
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance [B,1] (+ SequenceNum scalar, ref parity)."""
+    helper = LayerHelper("edit_distance", name=name)
+    B = input.shape[0]
+    out = helper.create_variable_for_type_inference("float32", (B, 1), True)
+    seq_num = helper.create_variable_for_type_inference("int64", (), True)
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    helper.append_op("edit_distance", ins,
+                     {"Out": [out], "SequenceNum": [seq_num]},
+                     {"normalized": normalized,
+                      "ignored_tokens": list(ignored_tokens or [])})
+    return out, seq_num
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None):
+    """One beam expand+prune step on static [B,K(,V)] tensors.
+
+    `scores` [B,K,V]: accumulated log-probs when is_accumulated (default,
+    matching the reference), else per-step probabilities which the op
+    combines as pre_scores + log(scores). Optional `ids` [B,K,V] carries
+    candidate token ids (pre-pruned top-k); without it tokens are the
+    vocabulary index. Returns (selected_ids, selected_scores, parent_idx),
+    each [B,beam_size].
+    """
+    helper = LayerHelper("beam_search", name=name)
+    B = pre_ids.shape[0]
+    sel_ids = helper.create_variable_for_type_inference(
+        "int64", (B, beam_size), True)
+    sel_scores = helper.create_variable_for_type_inference(
+        scores.dtype, (B, beam_size), True)
+    parent = helper.create_variable_for_type_inference(
+        "int64", (B, beam_size), True)
+    ins = {"PreIds": [pre_ids], "PreScores": [pre_scores],
+           "Scores": [scores]}
+    if ids is not None:
+        ins["Ids"] = [ids]
+    helper.append_op("beam_search", ins,
+                     {"SelectedIds": [sel_ids],
+                      "SelectedScores": [sel_scores], "ParentIdx": [parent]},
+                     {"beam_size": beam_size, "end_id": end_id,
+                      "is_accumulated": is_accumulated})
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, parents, scores=None, beam_size=None, end_id=0,
+                       name=None):
+    """Backtrace stacked per-step (ids, parents) [B,T,K] into sequences
+    [B,K,T] (+ final scores). beam_size/end_id are accepted for reference
+    API parity: the beam width is the static K dim, and finished beams
+    already carry trailing end_id tokens from beam_search itself."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    B, T, K = ids.shape
+    if beam_size is not None and int(beam_size) != int(K):
+        raise ValueError(f"beam_size {beam_size} != ids beam dim {K}")
+    seqs = helper.create_variable_for_type_inference("int64", (B, K, T), True)
+    ins = {"Ids": [ids], "Parents": [parents]}
+    outs = {"SentenceIds": [seqs]}
+    sc = None
+    if scores is not None:
+        ins["Scores"] = [scores]
+        sc = helper.create_variable_for_type_inference(
+            scores.dtype, tuple(scores.shape), True)
+        outs["SentenceScores"] = [sc]
+    helper.append_op("beam_search_decode", ins, outs, {})
+    return (seqs, sc) if scores is not None else seqs
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid loss [B,1] over a complete binary tree
+    (custom trees of the reference are not supported — raise instead)."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid custom trees: only the default complete binary tree "
+            "is supported")
+    helper = LayerHelper("hsigmoid", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, d],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[num_classes - 1],
+                                dtype=input.dtype, is_bias=True)
+    B = input.shape[0]
+    loss = helper.create_variable_for_type_inference(input.dtype, (B, 1))
+    depth = max(int(num_classes - 1).bit_length(), 1)
+    pre = helper.create_variable_for_type_inference(
+        input.dtype, (B, depth), True)
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if b is not None:
+        ins["Bias"] = [b]
+    helper.append_op("hsigmoid", ins, {"Out": [loss], "PreOut": [pre]},
+                     {"num_classes": num_classes})
+    return loss

@@ -6,4 +6,5 @@ from . import kernels_nn
 from . import kernels_optim
 from . import kernels_detection
 from . import kernels_sequence
+from . import kernels_struct
 from .registry import KERNELS, get_kernel, has_kernel

@@ -1,0 +1,364 @@
+"""Structured-prediction op kernels: CRF, CTC, edit distance, beam search,
+hierarchical sigmoid.
+
+Parity: paddle/fluid/operators/{linear_chain_crf,crf_decoding,warpctc,
+ctc_align,edit_distance,beam_search,beam_search_decode,hsigmoid}_op.*.
+The reference implementations are host-side dynamic loops over LoD; here
+every op is a static-shape lax.scan so the loss (and even Viterbi/beam
+decode) compiles into the same XLA module as the model. warpctc's CUDA
+dependency is replaced by a log-space forward algorithm on the MXU-fed
+VPU; there is no external library.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import kernel
+
+NEG_INF = -1e30
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+def _opt(ins, slot):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+def _lengths(ins, slot, B, T):
+    v = _opt(ins, slot)
+    if v is None:
+        return jnp.full((B,), T, jnp.int32)
+    return v.reshape(-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF (log-space forward; ref exponentiates — less stable)
+# ---------------------------------------------------------------------------
+def _crf_unpack(w):
+    """Transition param [N+2, N]: row0 start, row1 end, rows2: [N,N]."""
+    return w[0], w[1], w[2:]
+
+
+@kernel("linear_chain_crf")
+def _linear_chain_crf(ctx, ins, attrs):
+    """Emission [B,T,N], Label [B,T], Transition [N+2,N] → NLL [B,1].
+
+    Output slot name keeps the reference's "LogLikelihood" (which the ref
+    also defines as the minimization target).
+    """
+    e = _x(ins, "Emission")
+    w = ins["Transition"][0]
+    y = ins["Label"][0].reshape(e.shape[0], -1).astype(jnp.int32)
+    B, T, N = e.shape
+    lens = _lengths(ins, "SeqLen", B, T)
+    start, end, trans = _crf_unpack(w)
+    mask = jnp.arange(T)[None, :] < lens[:, None]          # [B,T]
+
+    # --- partition function ---
+    alpha0 = start[None, :] + e[:, 0]                       # [B,N]
+
+    def fwd(alpha, inp):
+        et, mt = inp                                        # [B,N], [B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + et
+        alpha = jnp.where(mt[:, None], nxt, alpha)
+        return alpha, None
+
+    alphaT, _ = jax.lax.scan(
+        fwd, alpha0, (jnp.swapaxes(e, 0, 1)[1:], mask.T[1:]))
+    logz = jax.nn.logsumexp(alphaT + end[None], axis=-1)    # [B]
+
+    # --- gold score ---
+    em_score = jnp.sum(
+        jnp.where(mask, jnp.take_along_axis(e, y[..., None], -1)[..., 0], 0.0),
+        axis=1)
+    tr = trans[y[:, :-1], y[:, 1:]]                         # [B,T-1]
+    tr_score = jnp.sum(jnp.where(mask[:, 1:], tr, 0.0), axis=1)
+    last_y = jnp.take_along_axis(y, (lens - 1)[:, None], 1)[:, 0]
+    score = em_score + tr_score + start[y[:, 0]] + end[last_y]
+
+    nll = (logz - score)[:, None]
+    return {"LogLikelihood": [nll], "Alpha": [alphaT],
+            "EmissionExps": [e], "TransitionExps": [w]}
+
+
+@kernel("crf_decoding")
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode → path [B,T] (int64); ties to linear_chain_crf's
+    transition layout. With Label given, emits per-position correctness
+    like the reference."""
+    e = _x(ins, "Emission")
+    w = ins["Transition"][0]
+    B, T, N = e.shape
+    lens = _lengths(ins, "SeqLen", B, T)
+    start, end, trans = _crf_unpack(w)
+    mask = jnp.arange(T)[None, :] < lens[:, None]
+
+    # forward with backpointers; freeze past seq end
+    def fwd(carry, inp):
+        delta = carry
+        et, mt = inp
+        cand = delta[:, :, None] + trans[None]              # [B,from,to]
+        bp = jnp.argmax(cand, axis=1)                       # [B,N]
+        nxt = jnp.max(cand, axis=1) + et
+        delta = jnp.where(mt[:, None], nxt, delta)
+        bp = jnp.where(mt[:, None], bp, jnp.arange(N)[None, :])
+        return delta, bp
+
+    delta0 = start[None] + e[:, 0]
+    deltaT, bps = jax.lax.scan(
+        fwd, delta0, (jnp.swapaxes(e, 0, 1)[1:], mask.T[1:]))  # bps [T-1,B,N]
+    last = jnp.argmax(deltaT + end[None], axis=-1)          # [B]
+
+    def back(ptr, bp):
+        prev = jnp.take_along_axis(bp, ptr[:, None], 1)[:, 0]
+        return prev, ptr
+
+    # reverse scan: ys[t] = state at step t+1; final carry = state at step 0
+    s0, path_rev = jax.lax.scan(back, last, bps, reverse=True)  # [T-1,B]
+    path = (jnp.concatenate([s0[None], path_rev], 0).T if T > 1
+            else last[:, None])                              # [B,T]
+    path = jnp.where(mask, path, 0).astype(jnp.int64)
+    out = {"ViterbiPath": [path]}
+    label = _opt(ins, "Label")
+    if label is not None:
+        lab = label.reshape(B, -1).astype(jnp.int64)
+        out["ViterbiPath"] = [
+            jnp.where(mask, (path == lab).astype(jnp.int64), 0)]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CTC (ref warpctc_op wraps the warp-ctc CUDA lib; this is pure XLA)
+# ---------------------------------------------------------------------------
+@kernel("warpctc")
+def _warpctc(ctx, ins, attrs):
+    """Logits [B,T,C], Label [B,L] → CTC NLL [B,1], log-space forward."""
+    logits = _x(ins, "Logits")
+    labels = ins["Label"][0].astype(jnp.int32)
+    B, T, C = logits.shape
+    L = labels.shape[1]
+    blank = int(attrs.get("blank", 0))
+    in_len = _lengths(ins, "LogitsLength", B, T)
+    lab_len = _lengths(ins, "LabelLength", B, L)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)                        # [B,S]
+    # can skip from s-2: ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_prev2)           # [B,S]
+
+    lp0 = lp[:, 0]
+    alpha = jnp.full((B, S), NEG_INF)
+    alpha = alpha.at[:, 0].set(lp0[:, blank])
+    if L > 0:
+        alpha = alpha.at[:, 1].set(
+            jnp.where(lab_len > 0,
+                      jnp.take_along_axis(lp0, ext[:, 1:2], 1)[:, 0],
+                      NEG_INF))
+
+    def step(alpha, inp):
+        lpt, active = inp                                    # [B,C], [B]
+        a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                          constant_values=NEG_INF)[:, :S]
+        a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                          constant_values=NEG_INF)[:, :S]
+        a_prev2 = jnp.where(can_skip, a_prev2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2)
+        em = jnp.take_along_axis(lpt, ext, 1)                # [B,S]
+        nxt = merged + em
+        alpha = jnp.where(active[:, None], nxt, alpha)
+        return alpha, None
+
+    active = (jnp.arange(1, T)[None, :] < in_len[:, None]).T  # [T-1,B]
+    alpha, _ = jax.lax.scan(step, alpha, (jnp.swapaxes(lp, 0, 1)[1:], active))
+
+    end1 = 2 * lab_len                                        # blank after last
+    end2 = jnp.maximum(2 * lab_len - 1, 0)
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(alpha, end1[:, None], 1)[:, 0],
+        jnp.where(lab_len > 0,
+                  jnp.take_along_axis(alpha, end2[:, None], 1)[:, 0],
+                  NEG_INF))
+    loss = -ll[:, None]
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(in_len, 1)[:, None].astype(loss.dtype)
+    return {"Loss": [loss.astype(logits.dtype)], "WarpCTCGrad": [lp]}
+
+
+@kernel("ctc_greedy_decoder")
+def _ctc_greedy_decoder(ctx, ins, attrs):
+    """Argmax → collapse repeats → drop blanks; static-width output padded
+    with -1, lengths in OutLen (ref ctc_align_op)."""
+    probs = _x(ins)
+    blank = int(attrs.get("blank", 0))
+    B, T = probs.shape[0], probs.shape[1]
+    in_len = _lengths(ins, "SeqLen", B, T)
+    p = jnp.argmax(probs, axis=-1).astype(jnp.int32)         # [B,T]
+    prev = jnp.pad(p, ((0, 0), (1, 0)), constant_values=-1)[:, :T]
+    valid = jnp.arange(T)[None, :] < in_len[:, None]
+    keep = (p != blank) & (p != prev) & valid
+    pos = jnp.cumsum(keep, axis=1) - 1                       # [B,T]
+    pos = jnp.where(keep, pos, T)                            # dump slot
+    out = jnp.full((B, T + 1), -1, jnp.int32)
+    b_idx = jnp.repeat(jnp.arange(B), T)
+    out = out.at[b_idx, pos.reshape(-1)].set(
+        jnp.where(keep, p, -1).reshape(-1))[:, :T]
+    return {"Out": [out.astype(jnp.int64)],
+            "OutLen": [jnp.sum(keep, axis=1).astype(jnp.int64)]}
+
+
+@kernel("edit_distance")
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance Hyps [B,T1] vs Refs [B,T2] with per-row
+    lengths; row-scan DP with a cummin for the insertion dependency."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    B, T1 = hyp.shape
+    T2 = ref.shape[1]
+    h_len = _lengths(ins, "HypsLength", B, T1)
+    r_len = _lengths(ins, "RefsLength", B, T2)
+
+    ignored = attrs.get("ignored_tokens") or []
+    if ignored:
+        hyp, h_len = _compact_drop(hyp, h_len, ignored)
+        ref, r_len = _compact_drop(ref, r_len, ignored)
+
+    j = jnp.arange(T2 + 1)
+    row0 = jnp.broadcast_to(j[None, :].astype(jnp.float32), (B, T2 + 1))
+
+    def step(row, xi):
+        # xi: hyp column i (chars at row i+1), [B]
+        sub = (xi[:, None] != ref).astype(jnp.float32)       # [B,T2]
+        c = jnp.minimum(row[:, 1:] + 1.0, row[:, :-1] + sub)
+        c = jnp.concatenate([row[:, :1] + 1.0, c], axis=1)   # c[0]=i+1
+        m = jax.lax.associative_scan(jnp.minimum, c - j, axis=1)
+        new = m + j
+        return new, new
+
+    _, rows = jax.lax.scan(step, row0, jnp.swapaxes(hyp, 0, 1))  # [T1,B,T2+1]
+    rows = jnp.concatenate([row0[None], rows], axis=0)       # [T1+1,B,T2+1]
+    d = rows[h_len, jnp.arange(B), :]                        # [B,T2+1]
+    dist = jnp.take_along_axis(d, r_len[:, None], 1)         # [B,1]
+    if attrs.get("normalized", False):
+        dist = dist / jnp.maximum(r_len, 1)[:, None].astype(dist.dtype)
+    return {"Out": [dist],
+            "SequenceNum": [jnp.asarray(B, jnp.int64)]}
+
+
+def _compact_drop(seq, lens, drop_tokens):
+    """Remove listed token values from each row, left-compacting and
+    shrinking lengths (used by edit_distance's ignored_tokens)."""
+    B, T = seq.shape
+    keep = jnp.arange(T)[None, :] < lens[:, None]
+    for t in drop_tokens:
+        keep &= seq != t
+    pos = jnp.where(keep, jnp.cumsum(keep, axis=1) - 1, T)
+    out = jnp.zeros((B, T + 1), seq.dtype)
+    b_idx = jnp.repeat(jnp.arange(B), T)
+    out = out.at[b_idx, pos.reshape(-1)].set(seq.reshape(-1))[:, :T]
+    return out, jnp.sum(keep, axis=1).astype(lens.dtype)
+
+
+# ---------------------------------------------------------------------------
+# beam search (ref beam_search_op + beam_search_decode_op, LoD → static)
+# ---------------------------------------------------------------------------
+@kernel("beam_search")
+def _beam_search(ctx, ins, attrs):
+    """One expand+prune step. PreIds/PreScores [B,K], Scores = log-probs
+    [B,K,V] → SelectedIds/SelectedScores [B,K], ParentIdx [B,K]."""
+    pre_ids = ins["PreIds"][0].astype(jnp.int32)
+    pre_scores = ins["PreScores"][0]
+    scores = ins["Scores"][0]
+    cand_ids = _opt(ins, "Ids")                              # optional [B,K,V]
+    B, K, V = scores.shape
+    beam = int(attrs.get("beam_size", K))
+    end_id = int(attrs.get("end_id", 0))
+    if attrs.get("is_accumulated", True):
+        total = scores                                       # already summed
+    else:
+        total = pre_scores[:, :, None] + jnp.log(
+            jnp.maximum(scores, 1e-30))                      # probs → logs
+    # finished beams only propagate <end> with unchanged score
+    finished = pre_ids == end_id                             # [B,K]
+    fin_row = jnp.full((V,), NEG_INF)
+    if cand_ids is None:
+        fin_row = fin_row.at[end_id].set(0.0)
+        fin_total = pre_scores[:, :, None] + fin_row[None, None, :]
+    else:
+        fin_total = jnp.where(cand_ids == end_id,
+                              pre_scores[:, :, None], NEG_INF)
+    total = jnp.where(finished[:, :, None], fin_total, total)
+    flat = total.reshape(B, K * V)
+    sel_scores, idx = jax.lax.top_k(flat, beam)              # [B,beam]
+    if cand_ids is None:
+        sel_ids = idx % V
+    else:
+        sel_ids = jnp.take_along_axis(
+            cand_ids.reshape(B, K * V).astype(jnp.int32), idx, 1)
+    return {"SelectedIds": [sel_ids.astype(jnp.int64)],
+            "SelectedScores": [sel_scores],
+            "ParentIdx": [(idx // V).astype(jnp.int64)]}
+
+
+@kernel("beam_search_decode")
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrace stacked per-step ids/parents into full sequences.
+
+    Ids/Parents [B,T,K] → SentenceIds [B,K,T] (end-padded), plus final
+    scores passthrough.
+    """
+    ids = ins["Ids"][0].astype(jnp.int32)
+    parents = ins["Parents"][0].astype(jnp.int32)
+    B, T, K = ids.shape
+
+    def back(ptr, inp):
+        ids_t, par_t = inp                                   # [B,K]
+        tok = jnp.take_along_axis(ids_t, ptr, 1)             # [B,K]
+        ptr = jnp.take_along_axis(par_t, ptr, 1)
+        return ptr, tok
+
+    ptr0 = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+    _, toks = jax.lax.scan(back, ptr0,
+                           (jnp.swapaxes(ids, 0, 1),
+                            jnp.swapaxes(parents, 0, 1)),
+                           reverse=True)                     # [T,B,K]
+    seqs = jnp.transpose(toks, (1, 2, 0)).astype(jnp.int64)  # [B,K,T]
+    out = {"SentenceIds": [seqs]}
+    scores = _opt(ins, "Scores")
+    if scores is not None:
+        out["SentenceScores"] = [scores]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid (ref hsigmoid_op, complete-binary-tree default)
+# ---------------------------------------------------------------------------
+@kernel("hsigmoid")
+def _hsigmoid(ctx, ins, attrs):
+    """X [B,D], Label [B], W [num_classes-1, D], Bias [num_classes-1] →
+    Loss [B,1] via the complete-binary-tree code path (SimpleCode in the
+    reference: node index (c>>(j+1))-1, bit (c>>j)&1, c = label+C)."""
+    x = _x(ins)
+    w = ins["W"][0]
+    b = _opt(ins, "Bias")
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    C = int(attrs["num_classes"])
+    B, D = x.shape
+    depth = max(int(C - 1).bit_length(), 1)
+    c = label + C                                            # [B]
+    js = jnp.arange(depth)
+    node = (c[:, None] >> (js[None, :] + 1)) - 1             # [B,depth]
+    bit = (c[:, None] >> js[None, :]) & 1
+    valid = node >= 0
+    node_safe = jnp.clip(node, 0, C - 2)
+    logits = jnp.einsum("bd,bjd->bj", x, w[node_safe])       # [B,depth]
+    if b is not None:
+        logits = logits + b[node_safe]
+    # BCE with target = bit
+    losses = jax.nn.softplus(logits) - bit * logits
+    loss = jnp.sum(jnp.where(valid, losses, 0.0), axis=1, keepdims=True)
+    return {"Out": [loss], "PreOut": [logits]}

@@ -1,0 +1,235 @@
+"""Structured-prediction op tests: CRF vs brute-force enumeration, CTC vs
+torch.nn.functional.ctc_loss, edit distance vs python DP, beam search on a
+hand-worked example (ref tests/unittests/test_{linear_chain_crf,warpctc,
+edit_distance,beam_search}_op.py)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+RNG = np.random.RandomState(3)
+
+
+def run_fetch(build, feeds, is_test=True):
+    """build() returns a list of fetch vars."""
+    exe = pt.Executor(pt.CPUPlace())
+    outs = build()
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feeds, fetch_list=list(outs), is_test=is_test)
+
+
+def _crf_brute(e, w, y_len):
+    """Brute-force logZ and per-path scores for one sequence e [T,N]."""
+    start, end, trans = w[0], w[1], w[2:]
+    T, N = y_len, e.shape[1]
+    scores = {}
+    for path in itertools.product(range(N), repeat=T):
+        s = start[path[0]] + end[path[-1]] + sum(e[t, path[t]] for t in range(T))
+        s += sum(trans[path[t - 1], path[t]] for t in range(1, T))
+        scores[path] = s
+    arr = np.array(list(scores.values()))
+    m = arr.max()
+    logz = m + np.log(np.exp(arr - m).sum())
+    return scores, logz
+
+
+def test_linear_chain_crf_vs_brute_force():
+    B, T, N = 2, 4, 3
+    e = RNG.randn(B, T, N).astype("float32")
+    y = RNG.randint(0, N, (B, T)).astype("int64")
+    lens = np.array([4, 3], dtype="int64")
+
+    def build():
+        em = layers.data("e", shape=[T, N])
+        lab = layers.data("y", shape=[T], dtype="int64")
+        sl = layers.data("sl", shape=[1], dtype="int64")
+        return [layers.linear_chain_crf(em, lab, seq_len=sl)]
+
+    nll = run_fetch(build, {"e": e, "y": y, "sl": lens})[0]
+    w = None
+    for v in pt.global_scope().keys():
+        if "linear_chain_crf" in v and v.endswith("w_0"):
+            w = np.asarray(pt.global_scope().find_var(v).get_tensor())
+    for b in range(B):
+        scores, logz = _crf_brute(e[b], w, int(lens[b]))
+        gold = scores[tuple(y[b, :lens[b]])]
+        np.testing.assert_allclose(nll[b, 0], logz - gold, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force_argmax():
+    B, T, N = 2, 4, 3
+    e = RNG.randn(B, T, N).astype("float32")
+    lens = np.array([4, 3], dtype="int64")
+
+    def build():
+        em = layers.data("e", shape=[T, N])
+        sl = layers.data("sl", shape=[1], dtype="int64")
+        return [layers.crf_decoding(em, seq_len=sl)]
+
+    path = run_fetch(build, {"e": e, "sl": lens})[0]
+    w = None
+    for v in pt.global_scope().keys():
+        if "crf_decoding" in v and v.endswith("w_0"):
+            w = np.asarray(pt.global_scope().find_var(v).get_tensor())
+    for b in range(B):
+        scores, _ = _crf_brute(e[b], w, int(lens[b]))
+        best = max(scores, key=scores.get)
+        np.testing.assert_array_equal(path[b, :lens[b]], best)
+
+
+def test_warpctc_vs_torch():
+    torch = pytest.importorskip("torch")
+    B, T, C, L = 3, 8, 5, 3
+    logits = RNG.randn(B, T, C).astype("float32")
+    labels = RNG.randint(1, C, (B, L)).astype("int64")   # 0 is blank
+    in_len = np.array([8, 6, 7], dtype="int64")
+    lab_len = np.array([3, 2, 1], dtype="int64")
+
+    def build():
+        lg = layers.data("lg", shape=[T, C])
+        lb = layers.data("lb", shape=[L], dtype="int64")
+        il = layers.data("il", shape=[1], dtype="int64")
+        ll = layers.data("ll", shape=[1], dtype="int64")
+        return [layers.warpctc(lg, lb, blank=0, input_length=il,
+                               label_length=ll)]
+
+    loss = run_fetch(build, {"lg": logits, "lb": labels, "il": in_len,
+                             "ll": lab_len})[0]
+    t_lp = torch.log_softmax(torch.tensor(logits), dim=-1).transpose(0, 1)
+    ref = torch.nn.functional.ctc_loss(
+        t_lp, torch.tensor(labels), torch.tensor(in_len),
+        torch.tensor(lab_len), blank=0, reduction="none")
+    np.testing.assert_allclose(loss[:, 0], ref.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_ctc_greedy_decoder():
+    # argmax path: [b b 1 1 b 2 2 b] → [1, 2]
+    T, C = 8, 4
+    path = [0, 0, 1, 1, 0, 2, 2, 0]
+    probs = np.zeros((1, T, C), dtype="float32")
+    for t, c in enumerate(path):
+        probs[0, t, c] = 5.0
+
+    def build():
+        p = layers.data("p", shape=[T, C])
+        out, out_len = layers.ctc_greedy_decoder(p, blank=0)
+        return [out, out_len]
+
+    out, out_len = run_fetch(build, {"p": probs})
+    assert int(out_len[0]) == 2
+    np.testing.assert_array_equal(out[0, :2], [1, 2])
+    assert (out[0, 2:] == -1).all()
+
+
+def test_edit_distance_vs_python_dp():
+    def dp(a, b):
+        m, n = len(a), len(b)
+        d = np.zeros((m + 1, n + 1))
+        d[:, 0] = np.arange(m + 1)
+        d[0, :] = np.arange(n + 1)
+        for i in range(1, m + 1):
+            for j in range(1, n + 1):
+                d[i][j] = min(d[i - 1][j] + 1, d[i][j - 1] + 1,
+                              d[i - 1][j - 1] + (a[i - 1] != b[j - 1]))
+        return d[m][n]
+
+    B, T1, T2 = 3, 6, 5
+    hyp = RNG.randint(0, 4, (B, T1)).astype("int64")
+    ref = RNG.randint(0, 4, (B, T2)).astype("int64")
+    h_len = np.array([6, 4, 5], dtype="int64")
+    r_len = np.array([5, 5, 2], dtype="int64")
+
+    def build():
+        h = layers.data("h", shape=[T1], dtype="int64")
+        r = layers.data("r", shape=[T2], dtype="int64")
+        hl = layers.data("hl", shape=[1], dtype="int64")
+        rl = layers.data("rl", shape=[1], dtype="int64")
+        out, _ = layers.edit_distance(h, r, normalized=False,
+                                      input_length=hl, label_length=rl)
+        return [out]
+
+    out = run_fetch(build, {"h": hyp, "r": ref, "hl": h_len, "rl": r_len})[0]
+    for b in range(B):
+        assert out[b, 0] == dp(list(hyp[b, :h_len[b]]), list(ref[b, :r_len[b]]))
+
+
+def test_beam_search_step_and_decode():
+    B, K, V, end_id = 1, 2, 4, 0
+    pre_ids = np.array([[3, 2]], dtype="int64")
+    pre_scores = np.array([[-1.0, -2.0]], dtype="float32")
+    probs = np.array([[[.1, .2, .3, .4], [.25, .25, .25, .25]]],
+                     dtype="float32")
+
+    def build():
+        pi = layers.data("pi", shape=[K], dtype="int64")
+        ps = layers.data("ps", shape=[K])
+        sc = layers.data("sc", shape=[K, V])
+        ids, scores, parents = layers.beam_search(
+            pi, ps, None, sc, beam_size=K, end_id=end_id,
+            is_accumulated=False)
+        return [ids, scores, parents]
+
+    ids, scores, parents = run_fetch(
+        build, {"pi": pre_ids, "ps": pre_scores, "sc": probs})
+    # best: beam0 + token3 = -1 + log(.4); second: beam0 + token2 = -1+log(.3)
+    np.testing.assert_array_equal(ids[0], [3, 2])
+    np.testing.assert_array_equal(parents[0], [0, 0])
+    np.testing.assert_allclose(scores[0],
+                               [-1 + np.log(.4), -1 + np.log(.3)], rtol=1e-5)
+
+    # backtrace: steps ids/parents hand-built
+    ids_seq = np.array([[[1, 2], [3, 4]]], dtype="int64")     # [B,T=2,K]
+    par_seq = np.array([[[0, 0], [1, 0]]], dtype="int64")
+
+    def build2():
+        i = layers.data("i", shape=[2, K], dtype="int64")
+        p = layers.data("p", shape=[2, K], dtype="int64")
+        return [layers.beam_search_decode(i, p)]
+
+    seqs = run_fetch(build2, {"i": ids_seq, "p": par_seq})[0]
+    # beam0 final: tok 3 at t=1, parent 1 → tok 2 at t=0  → [2,3]
+    np.testing.assert_array_equal(seqs[0, 0], [2, 3])
+    np.testing.assert_array_equal(seqs[0, 1], [1, 4])
+
+
+def test_edit_distance_ignored_tokens():
+    # hyp [7,1,2,7], ref [1,2] with token 7 ignored → distance 0
+    hyp = np.array([[7, 1, 2, 7]], dtype="int64")
+    ref = np.array([[1, 2]], dtype="int64")
+
+    def build():
+        h = layers.data("h", shape=[4], dtype="int64")
+        r = layers.data("r", shape=[2], dtype="int64")
+        out, _ = layers.edit_distance(h, r, normalized=False,
+                                      ignored_tokens=[7])
+        return [out]
+
+    out = run_fetch(build, {"h": hyp, "r": ref})[0]
+    assert out[0, 0] == 0
+
+
+def test_hsigmoid_decreases():
+    B, D, C = 8, 6, 5
+    x = RNG.randn(B, D).astype("float32")
+    y = RNG.randint(0, C, (B, 1)).astype("int64")
+
+    def build():
+        v = layers.data("x", shape=[D])
+        lab = layers.data("y", shape=[1], dtype="int64")
+        loss = layers.mean(layers.hsigmoid(v, lab, C))
+        pt.optimizer.SGD(0.5).minimize(loss)
+        return [loss]
+
+    exe = pt.Executor(pt.CPUPlace())
+    vs = build()
+    exe.run(pt.default_startup_program())
+    losses = [float(exe.run(feed={"x": x, "y": y}, fetch_list=vs)[0])
+              for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
