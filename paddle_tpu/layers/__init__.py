@@ -21,6 +21,8 @@ from . import sequence
 from .sequence import *      # noqa: F401,F403
 from . import struct
 from .struct import *        # noqa: F401,F403
+from . import vision
+from .vision import *        # noqa: F401,F403
 from . import math_op_patch
 
 math_op_patch.monkey_patch_variable()

@@ -218,14 +218,18 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
     if global_pooling:
         oh = ow = 1
     else:
-        oh = _conv_out_size(int(input.shape[2]), ks[0], st[0], pd[0])
-        ow = _conv_out_size(int(input.shape[3]), ks[1], st[1], pd[1])
+        def _out(sz, k, s, p):
+            num = sz + 2 * p - k
+            return (-(-num // s) if ceil_mode else num // s) + 1
+        oh = _out(int(input.shape[2]), ks[0], st[0], pd[0])
+        ow = _out(int(input.shape[3]), ks[1], st[1], pd[1])
     out = helper.create_variable_for_type_inference(
         input.dtype, (input.shape[0], input.shape[1], oh, ow))
     helper.append_op("pool2d", {"X": [input]}, {"Out": [out]},
                      {"pooling_type": pool_type, "ksize": list(ks),
                       "strides": list(st), "paddings": list(pd),
-                      "global_pooling": global_pooling, "exclusive": exclusive})
+                      "global_pooling": global_pooling,
+                      "exclusive": exclusive, "ceil_mode": ceil_mode})
     return out
 
 

@@ -1,0 +1,296 @@
+"""Vision / 3-D / misc layers.
+
+Parity: python/paddle/fluid/layers/nn.py {conv3d_transpose, pool3d,
+adaptive_pool3d, lrn, affine_grid, space_to_depth, crop,
+pad_constant_like, random_crop, multiplex, similarity_focus, rank_loss,
+dice_loss, mean_iou, sampling_id, hash, stanh} and tensor.py
+{sum, has_inf, has_nan, *_batch_size_like randoms}.
+"""
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "conv3d_transpose", "pool3d", "adaptive_pool3d", "lrn", "affine_grid",
+    "space_to_depth", "crop", "pad_constant_like", "random_crop",
+    "multiplex", "similarity_focus", "rank_loss", "dice_loss", "mean_iou",
+    "sampling_id", "hash", "stanh", "sum", "has_inf", "has_nan",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+]
+
+
+def _triple(v):
+    return [v, v, v] if isinstance(v, int) else list(v)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", name=name, act=act,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    c_in = int(input.shape[1])
+    st, pd, dl = _triple(stride), _triple(padding), _triple(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("conv3d_transpose needs filter_size or "
+                             "output_size")
+        out_sz = _triple(output_size)
+        filter_size = [
+            (out_sz[i] - (int(input.shape[2 + i]) - 1) * st[i]
+             + 2 * pd[i] - 1) // dl[i] + 1 for i in range(3)]
+    fs = _triple(filter_size)
+    w = helper.create_parameter(param_attr,
+                                shape=[c_in, num_filters] + fs, dtype=dtype)
+    od = [(int(input.shape[2 + i]) - 1) * st[i] - 2 * pd[i]
+          + dl[i] * (fs[i] - 1) + 1 for i in range(3)]
+    out = helper.create_variable_for_type_inference(
+        dtype, (input.shape[0], num_filters) + tuple(od))
+    helper.append_op("conv3d_transpose",
+                     {"Input": [input], "Filter": [w]}, {"Output": [out]},
+                     {"strides": st, "paddings": pd, "dilations": dl})
+    out = helper.append_bias_op(out, dim_start=1, bias_attr=bias_attr,
+                                size=num_filters)
+    return helper.append_activation(out, act)
+
+
+def _pool_out(sz, k, s, p, ceil_mode=False):
+    num = sz + 2 * p - k
+    return (-(-num // s) if ceil_mode else num // s) + 1
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    ks, st, pd = _triple(pool_size), _triple(pool_stride), _triple(pool_padding)
+    if global_pooling:
+        od = [1, 1, 1]
+    else:
+        od = [_pool_out(int(input.shape[2 + i]), ks[i], st[i], pd[i],
+                        ceil_mode) for i in range(3)]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], input.shape[1]) + tuple(od))
+    helper.append_op("pool3d", {"X": [input]}, {"Out": [out]},
+                     {"pooling_type": pool_type, "ksize": ks, "strides": st,
+                      "paddings": pd, "global_pooling": global_pooling,
+                      "exclusive": exclusive, "ceil_mode": ceil_mode})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if require_index:
+        raise NotImplementedError("adaptive_pool3d: require_index "
+                                  "unsupported (mask output)")
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    ks = _triple(pool_size)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], input.shape[1]) + tuple(ks))
+    helper.append_op("pool3d", {"X": [input]}, {"Out": [out]},
+                     {"pooling_type": pool_type if pool_type != "avg" else "avg",
+                      "ksize": ks, "adaptive": True})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    mid = helper.create_variable_for_type_inference(
+        input.dtype, input.shape, True)
+    helper.append_op("lrn", {"X": [input]}, {"Out": [out], "MidOut": [mid]},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    """theta [N,2,3]; out_shape static [N,C,H,W] list (dynamic shape
+    tensors are host-side in the ref; XLA needs static)."""
+    helper = LayerHelper("affine_grid", name=name)
+    if not isinstance(out_shape, (list, tuple)):
+        raise ValueError("affine_grid: out_shape must be a static list on "
+                         "TPU (ref also accepts a tensor; see SURVEY §6)")
+    N, _, H, W = [int(s) for s in out_shape]
+    out = helper.create_variable_for_type_inference(
+        theta.dtype, (theta.shape[0], H, W, 2))
+    helper.append_op("affine_grid", {"Theta": [theta]}, {"Output": [out]},
+                     {"output_shape": [N, 0, H, W]})
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    helper = LayerHelper("space_to_depth", name=name)
+    n, c, h, w = x.shape
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (n, int(c) * blocksize ** 2, int(h) // blocksize,
+                  int(w) // blocksize))
+    helper.append_op("space_to_depth", {"X": [x]}, {"Out": [out]},
+                     {"blocksize": blocksize})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop; shape may be a Variable (its static shape defines the
+    crop, wired as the op's Y input like the reference)."""
+    helper = LayerHelper("crop", name=name)
+    ins = {"X": [x]}
+    if hasattr(shape, "shape"):  # reference-tensor form
+        tgt = [int(s) for s in shape.shape]
+        ins["Y"] = [shape]
+    else:
+        tgt = [int(s) for s in shape]
+    if tgt and tgt[0] in (-1, 0):
+        tgt[0] = int(x.shape[0])
+    offs = list(offsets or [0] * len(tgt))
+    out = helper.create_variable_for_type_inference(x.dtype, tuple(tgt))
+    helper.append_op("crop", ins, {"Out": [out]},
+                     {"shape": tgt, "offsets": offs})
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype, x.shape)
+    helper.append_op("pad_constant_like", {"X": [x], "Y": [y]},
+                     {"Out": [out]}, {"pad_value": float(pad_value)})
+    return out
+
+
+def random_crop(x, shape, seed=None, name=None):
+    """Random crop of the trailing len(shape) dims (per-op PRNG key)."""
+    helper = LayerHelper("random_crop", name=name)
+    lead = len(x.shape) - len(shape)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, tuple(x.shape[:lead]) + tuple(shape))
+    helper.append_op("random_crop", {"X": [x]}, {"Out": [out]},
+                     {"shape": list(shape)})
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    helper = LayerHelper("multiplex", name=name)
+    out = helper.create_variable_for_type_inference(
+        inputs[0].dtype, inputs[0].shape)
+    helper.append_op("multiplex", {"X": list(inputs), "Ids": [index]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("similarity_focus", {"X": [input]}, {"Out": [out]},
+                     {"axis": axis, "indexes": list(indexes)})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype, left.shape)
+    helper.append_op("rank_loss",
+                     {"Label": [label], "Left": [left], "Right": [right]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    helper = LayerHelper("dice_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, ())
+    helper.append_op("dice_loss", {"X": [input], "Label": [label]},
+                     {"Out": [out]}, {"epsilon": epsilon})
+    return out
+
+
+def mean_iou(input, label, num_classes, name=None):
+    helper = LayerHelper("mean_iou", name=name)
+    miou = helper.create_variable_for_type_inference("float32", (), True)
+    wrong = helper.create_variable_for_type_inference(
+        "int64", (num_classes,), True)
+    correct = helper.create_variable_for_type_inference(
+        "int64", (num_classes,), True)
+    helper.append_op("mean_iou",
+                     {"Predictions": [input], "Labels": [label]},
+                     {"OutMeanIou": [miou], "OutWrong": [wrong],
+                      "OutCorrect": [correct]},
+                     {"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32", name=None):
+    helper = LayerHelper("sampling_id", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int64", (x.shape[0],), True)
+    helper.append_op("sampling_id", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Bucket-hash int id windows → [..., num_hash] int64 in
+    [0, hash_size)."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int64", tuple(input.shape[:-1]) + (num_hash,), True)
+    helper.append_op("hash", {"X": [input]}, {"Out": [out]},
+                     {"mod_by": hash_size, "num_hash": num_hash})
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    helper = LayerHelper("stanh", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("stanh", {"X": [x]}, {"Out": [out]},
+                     {"scale_a": scale_a, "scale_b": scale_b})
+    return out
+
+
+def sum(x, name=None):
+    """Elementwise sum of a list of tensors (ref sum_op); single tensors
+    pass through the sums kernel unchanged."""
+    from .tensor import sums
+    return sums(x if isinstance(x, (list, tuple)) else [x])
+
+
+def has_inf(x, name=None):
+    helper = LayerHelper("has_inf", name=name)
+    out = helper.create_variable_for_type_inference("bool", (), True)
+    helper.append_op("has_inf", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def has_nan(x, name=None):
+    helper = LayerHelper("has_nan", name=name)
+    out = helper.create_variable_for_type_inference("bool", (), True)
+    helper.append_op("has_nan", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0, name=None):
+    helper = LayerHelper("uniform_random_batch_size_like", name=name)
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    out = helper.create_variable_for_type_inference(
+        dtype, tuple(out_shape), True)
+    helper.append_op("uniform_random_batch_size_like", {"Input": [input]},
+                     {"Out": [out]},
+                     {"shape": list(shape), "dtype": dtype, "min": min,
+                      "max": max, "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, dtype="float32",
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    mean=0.0, std=1.0, seed=0, name=None):
+    helper = LayerHelper("gaussian_random_batch_size_like", name=name)
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    out = helper.create_variable_for_type_inference(
+        dtype, tuple(out_shape), True)
+    helper.append_op("gaussian_random_batch_size_like", {"Input": [input]},
+                     {"Out": [out]},
+                     {"shape": list(shape), "dtype": dtype, "mean": mean,
+                      "std": std, "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out

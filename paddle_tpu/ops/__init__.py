@@ -7,4 +7,5 @@ from . import kernels_optim
 from . import kernels_detection
 from . import kernels_sequence
 from . import kernels_struct
+from . import kernels_vision
 from .registry import KERNELS, get_kernel, has_kernel

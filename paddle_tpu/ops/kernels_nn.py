@@ -54,17 +54,20 @@ def _conv2d(ctx, ins, attrs):
 
 @kernel("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
-    x, w = ins["Input"][0], ins["Filter"][0]      # w: IOHW for transpose
+    """w is IOHW [c_in, f, kh, kw]; lax wants it labeled OIHW with
+    transpose_kernel=True (the label names the FORWARD conv whose VJP this
+    is). Paddle's `padding` crops the VALID result, out = (i-1)s - 2p +
+    d(k-1) + 1 — verified numerically against torch.conv_transpose2d."""
+    x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
     out = jax.lax.conv_transpose(
-        x, jnp.swapaxes(w, 0, 1),
-        strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dil,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        transpose_kernel=True)
+        x, w, strides=strides, padding="VALID", rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), transpose_kernel=True)
+    if pads[0] or pads[1]:
+        out = out[:, :, pads[0]:out.shape[2] - pads[0],
+                  pads[1]:out.shape[3] - pads[1]]
     b = _opt(ins, "Bias")
     if b is not None:
         out = out + b.reshape((1, -1, 1, 1))
@@ -88,14 +91,12 @@ def _conv3d(ctx, ins, attrs):
 
 @kernel("pool2d")
 def _pool2d(ctx, ins, attrs):
+    # shares adaptive/windowed helpers with pool3d (kernels_vision)
+    from .kernels_vision import adaptive_pool_nd, _pool_window
     x = _x(ins)
     ptype = attrs.get("pooling_type", "max")
     if attrs.get("adaptive", False):
-        oh, ow = _pair(attrs["ksize"])
-        n, c, h, wd = x.shape
-        x5 = x.reshape(n, c, oh, h // oh, ow, wd // ow)
-        out = x5.max(axis=(3, 5)) if ptype == "max" else x5.mean(axis=(3, 5))
-        return {"Out": [out]}
+        return {"Out": [adaptive_pool_nd(x, _pair(attrs["ksize"]), ptype)]}
     if attrs.get("global_pooling", False):
         ks = (x.shape[2], x.shape[3])
         strides, pads = ks, (0, 0)
@@ -103,21 +104,9 @@ def _pool2d(ctx, ins, attrs):
         ks = _pair(attrs["ksize"])
         strides = _pair(attrs.get("strides", ks))
         pads = _pair(attrs.get("paddings", [0, 0]))
-    window = (1, 1) + ks
-    strd = (1, 1) + strides
-    pad = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
-    if ptype == "max":
-        init = -jnp.inf
-        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strd, pad)
-    else:
-        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strd, pad)
-        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
-            ones = jnp.ones_like(x)
-            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strd, pad)
-            out = summed / cnt
-        else:
-            out = summed / (ks[0] * ks[1])
-    return {"Out": [out]}
+    return {"Out": [_pool_window(x, ks, strides, pads, ptype,
+                                 attrs.get("exclusive", True),
+                                 attrs.get("ceil_mode", False))]}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,314 @@
+"""Vision / 3-D / misc op kernels.
+
+Parity: paddle/fluid/operators/{conv3d_transpose,pool3d,lrn,affine_grid,
+space_to_depth,crop,pad_constant_like,random_crop,multiplex,
+similarity_focus,rank_loss,mean_iou,sampling_id,hash,isfinite}_op.* and
+the *_batch_size_like random ops. All static-shape jnp; stochastic ops
+draw from ctx.key (deterministic per (program seed, op index) like the
+reference's per-op seeds).
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import kernel
+from ..core.dtypes import as_jnp_dtype
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+def _opt(ins, slot):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+@kernel("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    # w is IODHW [c_in, f, ...] labeled OIDHW with transpose_kernel=True
+    # (names the forward conv whose VJP this is); paddle padding crops the
+    # VALID result — same scheme as conv2d_transpose in kernels_nn.py.
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s = _triple(attrs.get("strides", [1, 1, 1]))
+    p = _triple(attrs.get("paddings", [0, 0, 0]))
+    d = _triple(attrs.get("dilations", [1, 1, 1]))
+    out = jax.lax.conv_transpose(
+        x, w, strides=s, padding="VALID", rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), transpose_kernel=True)
+    if any(p):
+        out = out[:, :, p[0]:out.shape[2] - p[0], p[1]:out.shape[3] - p[1],
+                  p[2]:out.shape[4] - p[2]]
+    b = _opt(ins, "Bias")
+    if b is not None:
+        out = out + b.reshape((1, -1, 1, 1, 1))
+    return {"Output": [out]}
+
+
+def adaptive_pool_nd(x, out_sizes, ptype):
+    """General adaptive pooling over the trailing len(out_sizes) dims with
+    the torch/paddle window rule start = floor(i*sz/o), end = ceil((i+1)*
+    sz/o) — handles non-divisible sizes (divisible sizes get the fast
+    single-reshape path)."""
+    lead = x.ndim - len(out_sizes)
+    sizes = [int(s) for s in x.shape[lead:]]
+    if all(sz % o == 0 for sz, o in zip(sizes, out_sizes)):
+        shape = list(x.shape[:lead])
+        axes = []
+        for i, (sz, o) in enumerate(zip(sizes, out_sizes)):
+            shape += [o, sz // o]
+            axes.append(lead + 2 * i + 1)
+        xr = x.reshape(shape)
+        return (xr.max(axis=tuple(axes)) if ptype == "max"
+                else xr.mean(axis=tuple(axes)))
+
+    def pool_axis(arr, axis, sz, o):
+        slabs = []
+        for i in range(o):
+            lo, hi = (i * sz) // o, -((-(i + 1) * sz) // o)
+            sl = jax.lax.slice_in_dim(arr, lo, hi, axis=axis)
+            slabs.append(sl.max(axis=axis, keepdims=True) if ptype == "max"
+                         else sl.mean(axis=axis, keepdims=True))
+        return jnp.concatenate(slabs, axis=axis)
+
+    for i, (sz, o) in enumerate(zip(sizes, out_sizes)):
+        x = pool_axis(x, lead + i, sz, o)
+    return x
+
+
+def _pool_window(x, ks, strides, pads, ptype, exclusive, ceil_mode):
+    """Shared reduce_window pooling over trailing spatial dims; ceil_mode
+    extends the high-side padding so the last partial window counts (its
+    pad elements are excluded from avg counts like the reference)."""
+    spatial = x.ndim - 2
+    pad = [(0, 0), (0, 0)]
+    for i in range(spatial):
+        hi = pads[i]
+        if ceil_mode:
+            sz = int(x.shape[2 + i])
+            out = -(-(sz + 2 * pads[i] - ks[i]) // strides[i]) + 1
+            hi = (out - 1) * strides[i] + ks[i] - sz - pads[i]
+        pad.append((pads[i], hi))
+    window = (1, 1) + tuple(ks)
+    strd = (1, 1) + tuple(strides)
+    if ptype == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strd,
+                                     pad)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strd, pad)
+    if (exclusive or ceil_mode) and any(p != (0, 0) for p in pad[2:]):
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    window, strd, pad)
+        return summed / cnt
+    from math import prod
+    return summed / prod(ks)
+
+
+@kernel("pool3d")
+def _pool3d(ctx, ins, attrs):
+    x = _x(ins)
+    ptype = attrs.get("pooling_type", "max")
+    n, c, D, H, W = x.shape
+    if attrs.get("adaptive", False):
+        return {"Out": [adaptive_pool_nd(x, _triple(attrs["ksize"]), ptype)]}
+    if attrs.get("global_pooling", False):
+        ks, strides, pads = (D, H, W), (D, H, W), (0, 0, 0)
+    else:
+        ks = _triple(attrs["ksize"])
+        strides = _triple(attrs.get("strides", ks))
+        pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    return {"Out": [_pool_window(x, ks, strides, pads, ptype,
+                                 attrs.get("exclusive", True),
+                                 attrs.get("ceil_mode", False))]}
+
+
+@kernel("lrn")
+def _lrn(ctx, ins, attrs):
+    """Local response normalization across channels (ref lrn_op.cc):
+    out = x / (k + alpha * sum_{window n} x^2)^beta."""
+    x = _x(ins)
+    n = int(attrs.get("n", 5))
+    k = attrs.get("k", 1.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    half = n // 2
+    sq = x * x
+    acc = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add, (1, n, 1, 1), (1, 1, 1, 1),
+        [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)])
+    return {"Out": [x / jnp.power(k + alpha * acc, beta)],
+            "MidOut": [k + alpha * acc]}
+
+
+@kernel("affine_grid")
+def _affine_grid(ctx, ins, attrs):
+    """Theta [N,2,3] → sampling grid [N,H,W,2] (ref affine_grid_op.cc,
+    align_corners=True semantics of the v1 reference)."""
+    theta = ins["Theta"][0]
+    N, _, H, W = attrs["output_shape"]
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    xg, yg = jnp.meshgrid(xs, ys)                   # [H,W]
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)       # [H,W,3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)  # [N,H,W,2]
+    return {"Output": [grid.astype(theta.dtype)]}
+
+
+@kernel("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    x = _x(ins)
+    bs = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    out = (x.reshape(n, c, h // bs, bs, w // bs, bs)
+           .transpose(0, 3, 5, 1, 2, 4)
+           .reshape(n, c * bs * bs, h // bs, w // bs))
+    return {"Out": [out]}
+
+
+@kernel("crop")
+def _crop(ctx, ins, attrs):
+    """Static-offset crop (ref crop_op). Shape from attrs or the Y ref
+    tensor; offsets from attrs (data-dependent offsets use random_crop)."""
+    x = _x(ins)
+    y = _opt(ins, "Y")
+    shape = list(y.shape) if y is not None else list(attrs["shape"])
+    offsets = list(attrs.get("offsets") or [0] * x.ndim)
+    return {"Out": [jax.lax.slice(
+        x, offsets, [o + s for o, s in zip(offsets, shape)])]}
+
+
+@kernel("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = _x(ins), ins["Y"][0]
+    pads = [(0, int(xs) - int(ys)) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@kernel("random_crop")
+def _random_crop(ctx, ins, attrs):
+    """Random spatial crop to attrs['shape'] (trailing dims), uniform
+    offsets from ctx.key (ref random_crop_op)."""
+    x = _x(ins)
+    shape = list(attrs["shape"])
+    lead = x.ndim - len(shape)
+    keys = jax.random.split(ctx.key, len(shape))
+    starts = [0] * lead + [
+        jax.random.randint(keys[i], (), 0, int(x.shape[lead + i]) - shape[i] + 1)
+        for i in range(len(shape))]
+    sizes = list(x.shape[:lead]) + shape
+    return {"Out": [jax.lax.dynamic_slice(x, starts, sizes)]}
+
+
+@kernel("multiplex")
+def _multiplex(ctx, ins, attrs):
+    """Row-wise select among candidate tensors by index (ref
+    multiplex_op): Ids [B,1] over len(X) candidates."""
+    xs = jnp.stack(ins["X"], axis=0)                # [K,B,...]
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    B = xs.shape[1]
+    return {"Out": [xs[ids, jnp.arange(B)]]}
+
+
+@kernel("similarity_focus")
+def _similarity_focus(ctx, ins, attrs):
+    """Greedy row/col-exclusive max selection mask (ref
+    similarity_focus_op.cc). X [B,C,H,W], axis=1, indexes into C."""
+    x = _x(ins)
+    if attrs.get("axis", 1) != 1:
+        raise NotImplementedError("similarity_focus: only axis=1")
+    B, C, H, W = x.shape
+    mask = jnp.zeros((B, H, W), x.dtype)
+    for idx in attrs["indexes"]:
+        t = x[:, int(idx)]                           # [B,H,W]
+
+        def step(carry, _):
+            m, row_used, col_used = carry
+            avail = (~row_used[:, :, None]) & (~col_used[:, None, :])
+            masked = jnp.where(avail, t, -jnp.inf)
+            flat = masked.reshape(B, -1)
+            pos = jnp.argmax(flat, axis=1)
+            r, c = pos // W, pos % W
+            m = m.at[jnp.arange(B), r, c].set(1.0)
+            row_used = row_used.at[jnp.arange(B), r].set(True)
+            col_used = col_used.at[jnp.arange(B), c].set(True)
+            return (m, row_used, col_used), None
+
+        init = (jnp.zeros((B, H, W), x.dtype),
+                jnp.zeros((B, H), bool), jnp.zeros((B, W), bool))
+        (m, _, _), _ = jax.lax.scan(step, init, None, length=min(H, W))
+        mask = jnp.maximum(mask, m)
+    return {"Out": [jnp.broadcast_to(mask[:, None], x.shape)]}
+
+
+@kernel("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    """Pairwise rank loss (ref rank_loss_op.cc):
+    C = log(1+exp(o1-o2)) - label*(o1-o2)."""
+    label = ins["Label"][0]
+    o1, o2 = ins["Left"][0], ins["Right"][0]
+    d = o1 - o2
+    return {"Out": [jax.nn.softplus(d) - label * d]}
+
+
+@kernel("dice_loss")
+def _dice_loss(ctx, ins, attrs):
+    """Dice loss (ref layers/nn.py:dice_loss composition)."""
+    x = _x(ins)                                      # [B,...,C] probs
+    label = ins["Label"][0].reshape(x.shape[:-1]).astype(jnp.int32)
+    one_hot = jax.nn.one_hot(label, x.shape[-1], dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    eps = attrs.get("epsilon", 1e-5)
+    inter = jnp.sum(x * one_hot, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(one_hot, axis=reduce_dims)
+    return {"Out": [jnp.mean(1.0 - (2.0 * inter + eps) / (union + eps))]}
+
+
+@kernel("sampling_id")
+def _sampling_id(ctx, ins, attrs):
+    """Sample a column index per row from probability rows (ref
+    sampling_id_op) using the op's PRNG key."""
+    x = _x(ins)
+    return {"Out": [jax.random.categorical(
+        ctx.key, jnp.log(jnp.maximum(x, 1e-30)), axis=-1).astype(jnp.int64)]}
+
+
+@kernel("hash")
+def _hash(ctx, ins, attrs):
+    """Deterministic bucket hashing of int id windows (ref hash_op uses
+    xxhash; same contract — stable int → [0, mod_by) — different mix)."""
+    x = _x(ins).astype(jnp.uint32)
+    mod_by = int(attrs["mod_by"])
+    num_hash = int(attrs.get("num_hash", 1))
+    outs = []
+    for i in range(num_hash):
+        h = jnp.full(x.shape[:-1], 2166136261 + i * 97, jnp.uint32)
+        for j in range(x.shape[-1]):
+            h = (h ^ x[..., j]) * jnp.uint32(16777619)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return {"Out": [jnp.stack(outs, axis=-1)]}
+
+
+@kernel("stanh")
+def _stanh(ctx, ins, attrs):
+    """Scaled tanh b*tanh(a*x) (ref stanh_op)."""
+    x = _x(ins)
+    return {"Out": [attrs.get("scale_b", 1.7159)
+                    * jnp.tanh(attrs.get("scale_a", 0.67) * x)]}
+
+
+@kernel("has_inf")
+def _has_inf(ctx, ins, attrs):
+    return {"Out": [jnp.any(jnp.isinf(_x(ins)))]}
+
+
+@kernel("has_nan")
+def _has_nan(ctx, ins, attrs):
+    return {"Out": [jnp.any(jnp.isnan(_x(ins)))]}
+
+
+# uniform_random_batch_size_like / gaussian_random_batch_size_like kernels
+# live in kernels_tensor.py (shared with the non-batch-size-like variants).

@@ -1,0 +1,294 @@
+"""Vision/3-D/misc op batch tests (ref tests/unittests/test_{pool3d,lrn,
+space_to_depth,crop,multiplex,rank_loss,mean_iou,hash}_op.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+RNG = np.random.RandomState(5)
+
+
+def run(build, feeds, is_test=True):
+    exe = pt.Executor(pt.CPUPlace())
+    outs = build()
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feeds, fetch_list=list(outs), is_test=is_test)
+
+
+def test_pool3d_and_adaptive():
+    x = RNG.randn(2, 3, 4, 4, 4).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[3, 4, 4, 4])
+        a = layers.pool3d(v, pool_size=2, pool_type="max", pool_stride=2)
+        b = layers.adaptive_pool3d(v, pool_size=2, pool_type="avg")
+        return a, b
+
+    a, b = run(build, {"x": x})
+    ref = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(a, ref, rtol=1e-6)
+    ref_b = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(b, ref_b, rtol=1e-6)
+
+
+def test_pool_ceil_mode_and_nondivisible_adaptive():
+    torch = pytest.importorskip("torch")
+    x = RNG.randn(1, 2, 5, 5).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[2, 5, 5])
+        a = layers.pool2d(v, pool_size=2, pool_stride=2, ceil_mode=True)
+        b = layers.adaptive_pool2d(v, pool_size=2, pool_type="avg")
+        return a, b
+
+    a, b = run(build, {"x": x})
+    ref_a = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, 2, ceil_mode=True).numpy()
+    ref_b = torch.nn.functional.adaptive_avg_pool2d(
+        torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(a, ref_a, rtol=1e-6)
+    np.testing.assert_allclose(b, ref_b, rtol=1e-5)
+
+
+def test_conv3d_transpose_shape():
+    x = RNG.randn(1, 4, 3, 3, 3).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[4, 3, 3, 3])
+        return layers.conv3d_transpose(v, 2, filter_size=2, stride=2,
+                                       bias_attr=False)
+
+    out = run(build, {"x": x})[0]
+    assert out.shape == (1, 2, 6, 6, 6)
+
+
+def test_conv2d_transpose_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = RNG.randn(2, 4, 5, 5).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[4, 5, 5])
+        return layers.conv2d_transpose(v, 3, filter_size=3, stride=2,
+                                       padding=1, bias_attr=False)
+
+    out = run(build, {"x": x})[0]
+    w = None
+    for v in pt.global_scope().keys():
+        if "conv2d_transpose" in v and v.endswith("w_0"):
+            w = np.asarray(pt.global_scope().find_var(v).get_tensor())
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_lrn_matches_formula():
+    x = RNG.rand(2, 7, 3, 3).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[7, 3, 3])
+        return layers.lrn(v, n=5, k=2.0, alpha=1e-3, beta=0.75)
+
+    out = run(build, {"x": x})[0]
+    ref = np.zeros_like(x)
+    for c in range(7):
+        lo, hi = max(0, c - 2), min(7, c + 3)
+        acc = (x[:, lo:hi] ** 2).sum(axis=1)
+        ref[:, c] = x[:, c] / (2.0 + 1e-3 * acc) ** 0.75
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_space_to_depth_roundtrip_values():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+
+    def build():
+        v = layers.data("x", shape=[1, 4, 4])
+        return layers.space_to_depth(v, 2)
+
+    out = run(build, {"x": x})[0]
+    assert out.shape == (1, 4, 2, 2)
+    np.testing.assert_allclose(out[0, 0], [[0, 2], [8, 10]])
+
+
+def test_crop_and_pad_constant_like():
+    x = RNG.randn(2, 5, 6).astype("float32")
+    y = RNG.randn(2, 3, 4).astype("float32")
+
+    def build():
+        a = layers.data("x", shape=[5, 6])
+        b = layers.data("y", shape=[3, 4])
+        c = layers.crop(a, shape=[2, 3, 4], offsets=[0, 1, 2])
+        p = layers.pad_constant_like(a, b, pad_value=9.0)
+        return c, p
+
+    c, p = run(build, {"x": x, "y": y})
+    np.testing.assert_allclose(c, x[:, 1:4, 2:6])
+    assert p.shape == x.shape
+    np.testing.assert_allclose(p[:, :3, :4], y)
+    assert (p[:, 3:, :] == 9.0).all() and (p[:, :, 4:] == 9.0).all()
+
+
+def test_random_crop_shape_and_content():
+    x = RNG.randn(2, 8, 8).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[8, 8])
+        return layers.random_crop(v, shape=[5, 5])
+
+    out = run(build, {"x": x}, is_test=False)[0]
+    assert out.shape == (2, 5, 5)
+    # crop content must be a contiguous window of the source
+    found = any(np.allclose(out[0], x[0, i:i + 5, j:j + 5])
+                for i in range(4) for j in range(4))
+    assert found
+
+
+def test_multiplex():
+    a = RNG.randn(4, 3).astype("float32")
+    b = RNG.randn(4, 3).astype("float32")
+    ids = np.array([[0], [1], [1], [0]], dtype="int64")
+
+    def build():
+        va = layers.data("a", shape=[3])
+        vb = layers.data("b", shape=[3])
+        vi = layers.data("ids", shape=[1], dtype="int64")
+        return layers.multiplex([va, vb], vi)
+
+    out = run(build, {"a": a, "b": b, "ids": ids})[0]
+    ref = np.where(ids == 0, a, b)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_rank_loss_and_stanh_and_sum():
+    label = np.array([[1.0], [0.0]], dtype="float32")
+    left = np.array([[2.0], [0.5]], dtype="float32")
+    right = np.array([[1.0], [1.5]], dtype="float32")
+
+    def build():
+        l = layers.data("label", shape=[1])
+        o1 = layers.data("left", shape=[1])
+        o2 = layers.data("right", shape=[1])
+        rl = layers.rank_loss(l, o1, o2)
+        st = layers.stanh(o1, 0.5, 2.0)
+        s = layers.sum([o1, o2])
+        return rl, st, s
+
+    rl, st, s = run(build, {"label": label, "left": left, "right": right})
+    d = left - right
+    np.testing.assert_allclose(rl, np.log1p(np.exp(d)) - label * d, rtol=1e-5)
+    np.testing.assert_allclose(st, 2.0 * np.tanh(0.5 * left), rtol=1e-5)
+    np.testing.assert_allclose(s, left + right)
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2], dtype="int64")
+    lab = np.array([0, 1, 2, 2], dtype="int64")
+
+    def build():
+        p = layers.data("p", shape=[1], dtype="int64")
+        l = layers.data("l", shape=[1], dtype="int64")
+        miou, wrong, correct = layers.mean_iou(p, l, 3)
+        return miou, wrong, correct
+
+    miou, wrong, correct = run(
+        build, {"p": pred.reshape(4, 1), "l": lab.reshape(4, 1)})
+    # IoU: c0 = 1/1, c1 = 1/2, c2 = 1/2 → mean 2/3
+    np.testing.assert_allclose(float(miou), (1 + 0.5 + 0.5) / 3, rtol=1e-6)
+    np.testing.assert_array_equal(correct, [1, 1, 1])
+
+
+def test_dice_loss_perfect_prediction_is_zero():
+    lab = np.array([[0], [1], [2], [1]], dtype="int64")
+    x = np.eye(3, dtype="float32")[lab[:, 0]]
+
+    def build():
+        v = layers.data("x", shape=[3])
+        l = layers.data("l", shape=[1], dtype="int64")
+        return layers.dice_loss(v, l)
+
+    out = run(build, {"x": x, "l": lab})[0]
+    assert float(out) < 1e-4
+
+
+def test_hash_deterministic_in_range():
+    ids = RNG.randint(0, 1000, (4, 3)).astype("int64")
+
+    def build():
+        v = layers.data("ids", shape=[3], dtype="int64")
+        return layers.hash(v, hash_size=97, num_hash=2)
+
+    vs = []
+
+    def build2():
+        v = build()
+        vs.append(v)
+        return v
+
+    out1 = run(build2, {"ids": ids})[0]
+    assert out1.shape == (4, 2)
+    assert (out1 >= 0).all() and (out1 < 97).all()
+    # determinism: same ids → same buckets on a second run
+    exe = pt.Executor(pt.CPUPlace())
+    out2 = exe.run(feed={"ids": ids}, fetch_list=vs, is_test=True)[0]
+    np.testing.assert_array_equal(out1, out2)
+
+
+def test_has_inf_nan_and_randoms():
+    x = np.array([[1.0, np.inf], [0.0, 1.0]], dtype="float32")
+
+    def build():
+        v = layers.data("x", shape=[2])
+        hi = layers.has_inf(v)
+        hn = layers.has_nan(v)
+        u = layers.uniform_random_batch_size_like(v, [0, 7], min=0.0, max=1.0)
+        g = layers.gaussian_random_batch_size_like(v, [0, 7])
+        return hi, hn, u, g
+
+    hi, hn, u, g = run(build, {"x": x}, is_test=False)
+    assert bool(hi) and not bool(hn)
+    assert u.shape == (2, 7) and g.shape == (2, 7)
+    assert (u >= 0).all() and (u <= 1).all()
+
+
+def test_similarity_focus_mask():
+    x = RNG.rand(2, 3, 2, 2).astype("float32")
+
+    def build():
+        v = layers.data("x", shape=[3, 2, 2])
+        return layers.similarity_focus(v, axis=1, indexes=[0])
+
+    out = run(build, {"x": x})[0]
+    assert out.shape == x.shape
+    # mask has exactly min(H,W)=2 ones per sample per channel, 0/1 valued
+    assert set(np.unique(out)).issubset({0.0, 1.0})
+    assert (out[:, 0].reshape(2, -1).sum(axis=1) == 2).all()
+
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], dtype="float32"),
+                    (2, 1, 1))
+
+    def build():
+        t = layers.data("t", shape=[2, 3])
+        return layers.affine_grid(t, [2, 1, 3, 4])
+
+    grid = run(build, {"t": theta})[0]
+    assert grid.shape == (2, 3, 4, 2)
+    np.testing.assert_allclose(grid[0, 0, :, 0], np.linspace(-1, 1, 4),
+                               rtol=1e-6)
+    np.testing.assert_allclose(grid[0, :, 0, 1], np.linspace(-1, 1, 3),
+                               rtol=1e-6)
+
+
+def test_sampling_id_distribution():
+    probs = np.tile(np.array([[0.0, 1.0, 0.0]], dtype="float32"), (6, 1))
+
+    def build():
+        p = layers.data("p", shape=[3])
+        return layers.sampling_id(p)
+
+    out = run(build, {"p": probs}, is_test=False)[0]
+    np.testing.assert_array_equal(out, np.ones(6))
