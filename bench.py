@@ -27,11 +27,14 @@ def main():
                 dropout=0.1)
             feeds, avg_cost, tok = tfm.build_program(cfg, maxlen=T)
             pt.optimizer.Adam(1e-3).minimize(avg_cost)
+    # bf16 matmuls on the MXU, fp32 optimizer state (SURVEY §5: bf16 target)
+    pt.amp.cast_program_to_bf16(main_p)
 
     exe = pt.Executor()
     scope = pt.Scope()
     with pt.scope_guard(scope):
         exe.run(startup)
+        pt.amp.cast_params_to_bf16(main_p, scope)
         persist = {v.name: scope.get(v.name)
                    for v in main_p.persistable_vars()}
 
@@ -49,14 +52,17 @@ def main():
     step_fn = build_step_fn(main_p, [avg_cost.name], False, None)
     jfn = jax.jit(step_fn, donate_argnums=(0,))
     fetches, persist = jfn(persist, feed, key)
-    jax.block_until_ready(fetches)
+    # block_until_ready does not synchronize through the axon relay; a
+    # device→host readback is the only reliable completion barrier.
+    np.asarray(fetches[0])
 
-    n = 30
+    n = 50
     t0 = time.perf_counter()
     for _ in range(n):
         fetches, persist = jfn(persist, feed, key)
-    jax.block_until_ready(fetches)
+    loss = float(np.asarray(fetches[0]))
     dt = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite loss {loss}"
     tokens_per_sec = n * B * T / dt
 
     baseline = None
