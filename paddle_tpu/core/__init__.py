@@ -3,3 +3,9 @@ from . import place
 from . import scope
 from . import executor
 from . import backward
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when a py_reader/file reader is exhausted
+    (parity: paddle.fluid.core.EOFException from the C++ reader queue)."""
+

@@ -43,6 +43,14 @@ class Executor:
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
+        # programs fed by py_reader/open_files queues: pop one batch per
+        # step for any reader whose vars aren't explicitly fed (parity:
+        # the C++ reader queue; raises core.EOFException when exhausted)
+        for rd in getattr(program, "_py_readers", []):
+            names = [v.name for v in rd.vars]
+            if rd.is_started() and any(n not in feed for n in names):
+                for k, v in rd.next_feed().items():
+                    feed.setdefault(k, v)
         fetch_list = list(fetch_list or [])
         fetch_names = [f.name if hasattr(f, "name") else f for f in fetch_list]
         if is_test is None:

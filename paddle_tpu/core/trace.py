@@ -76,6 +76,31 @@ def _exec_control_flow(env, op, base_key, is_test, place, program):
         for n, v in zip(op.outputs["Out"], res):
             env[n] = v
         return
+    if op.type == "static_rnn":
+        bb = program.blocks[attrs["step_block"]]
+        x_map = attrs["x_map"]        # [(outer_name, step_name)]
+        mem_map = attrs["mem_map"]    # [(init_name, prev_step_name, new_name)]
+        y_map = attrs["y_map"]        # [(step_y_name, out_name)]
+
+        def body_f(carry, xt):
+            e = dict(env)
+            for (_, sname), v in zip(x_map, xt):
+                e[sname] = v
+            for (_, pname, _), c in zip(mem_map, carry):
+                e[pname] = c
+            _replay_block(program, bb, e, base_key, is_test, place)
+            new_c = tuple(e[n] for _, _, n in mem_map)
+            ys = tuple(e[y] for y, _ in y_map)
+            return new_c, ys
+
+        init = tuple(env[i] for i, _, _ in mem_map)
+        xs = tuple(env[o] for o, _ in x_map)
+        carry, ys = _jax.lax.scan(body_f, init, xs)
+        for (_, outn), v in zip(y_map, ys):
+            env[outn] = v
+        for name, v in zip(attrs.get("final_mem_outs", []), carry):
+            env[name] = v
+        return
     if op.type == "scan":
         bb = program.blocks[attrs["body_block"]]
 
@@ -96,7 +121,7 @@ def _exec_control_flow(env, op, base_key, is_test, place, program):
 
 def exec_op(env, op, op_idx, base_key, is_test, place, block, program=None):
     """Execute one op against env (name → array)."""
-    if op.type in ("cond", "while_loop", "scan"):
+    if op.type in ("cond", "while_loop", "scan", "static_rnn"):
         prog = program if program is not None else block.program
         _exec_control_flow(env, op, base_key, is_test, place, prog)
         return
@@ -139,6 +164,28 @@ def _find_backward(ops):
     return idxs[0]
 
 
+def _sub_block_free_vars(program, op, _seen=None):
+    """Names a control-flow op's sub-blocks read but don't produce,
+    recursing through nested control flow (a Switch chain nests cond ops
+    in wrapper blocks — their free vars are still this op's inputs)."""
+    free = set()
+    seen = _seen if _seen is not None else set()
+    for key in ("true_block", "false_block", "cond_block", "body_block",
+                "step_block"):
+        bidx = op.attrs.get(key)
+        if bidx is None or bidx in seen:
+            continue
+        seen.add(bidx)
+        sub = program.blocks[bidx]
+        produced = {n for o in sub.ops for n in o.output_names()}
+        for o in sub.ops:
+            sub_free = set(o.input_names())
+            if o.type in ("cond", "while_loop", "scan", "static_rnn"):
+                sub_free |= _sub_block_free_vars(program, o, seen)
+            free |= sub_free - produced
+    return free
+
+
 def _prune_ops(program, ops, fetch_names):
     """Keep only ops needed for the fetches or writing persistable state
     (param updates, bn stats, counters) — the reference Executor prunes
@@ -154,17 +201,8 @@ def _prune_ops(program, ops, fetch_names):
             needed |= set(op.input_names())
             if op.type == "backward_macro":
                 needed.add(op.attrs["loss_name"])
-            if op.type in ("cond", "while_loop", "scan"):
-                # sub-block free vars are inputs too
-                for key in ("true_block", "false_block", "cond_block",
-                            "body_block"):
-                    bidx = op.attrs.get(key)
-                    if bidx is None:
-                        continue
-                    sub = program.blocks[bidx]
-                    produced = {n for o in sub.ops for n in o.output_names()}
-                    for o in sub.ops:
-                        needed |= set(o.input_names()) - produced
+            if op.type in ("cond", "while_loop", "scan", "static_rnn"):
+                needed |= _sub_block_free_vars(program, op)
     return list(reversed(kept))
 
 

@@ -14,11 +14,16 @@ While/Switch blocks are host-interpreted and cannot compile to XLA.
 from ..layer_helper import LayerHelper
 from ..core.framework import default_main_program
 
+from .. import unique_name
+
 __all__ = ["cond", "while_loop", "case", "switch_case", "scan_layer",
-           "array_write", "array_read", "create_array", "less_than",
+           "array_write", "array_read", "create_array", "array_length",
+           "tensor_array_to_tensor", "less_than",
            "less_equal", "greater_than", "greater_equal", "equal",
            "not_equal", "logical_and", "logical_or", "logical_not",
-           "logical_xor"]
+           "logical_xor", "While", "Switch", "IfElse", "StaticRNN",
+           "DynamicRNN", "Print", "is_empty", "py_func",
+           "reorder_lod_tensor_by_rank"]
 
 
 def _capture_block(fn, args):
@@ -120,19 +125,605 @@ def scan_layer(body_fn, init, xs, name=None):
     return out_c, out_y
 
 
-# --- tensor-array emulation (LoDTensorArray → stacked static array) -------
-def create_array(dtype):
-    raise NotImplementedError(
-        "LoDTensorArray is host-side dynamic; use scan_layer / while_loop "
-        "with fixed-size buffers on TPU (see SURVEY §6)")
+# --- tensor arrays (ref LoDTensorArray + tensor_array_read_write ops) ------
+# The reference's LoDTensorArray is a host-side growable vector of tensors.
+# On TPU an array is a fixed-capacity device buffer [capacity, *elem] plus an
+# int32 length scalar, so it can ride a lax.while_loop carry (static shapes).
+# Pass element_shape to create_array when the array is used inside While.
+
+def _alloc_array(helper, dtype, element_shape, capacity):
+    arr = helper.create_variable_for_type_inference(
+        dtype, (capacity,) + tuple(element_shape), True)
+    ln = helper.create_variable_for_type_inference("int32", (), True)
+    helper.append_op("alloc_array", {}, {"Array": [arr], "Len": [ln]},
+                     {"element_shape": [int(s) for s in element_shape],
+                      "capacity": int(capacity), "dtype": dtype})
+    arr._array_len_var = ln
+    return arr
+
+
+def create_array(dtype, element_shape=None, capacity=64, name=None):
+    helper = LayerHelper("array", name=name)
+    if element_shape is not None:
+        return _alloc_array(helper, dtype, element_shape, capacity)
+    arr = helper.create_variable_for_type_inference(dtype, (), True)
+    arr._array_lazy = {"dtype": dtype, "capacity": capacity}
+    return arr
 
 
 def array_write(x, i, array=None):
-    raise NotImplementedError("use scan_layer instead of array_write on TPU")
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    lazy = getattr(array, "_array_lazy", None)
+    if lazy is not None:
+        # allocate now that the element shape is known; keep the SAME
+        # variable so earlier references stay valid
+        real = _alloc_array(helper, lazy["dtype"], tuple(x.shape),
+                            lazy["capacity"])
+        # rebind: the freshly allocated buffer writes into array's name
+        real_op = helper.block.ops[-1]
+        real_op.outputs["Array"] = [array.name]
+        array.shape = real.shape
+        array._array_len_var = real._array_len_var
+        del array._array_lazy
+    ln = array._array_len_var
+    helper.append_op("array_write",
+                     {"X": [x], "I": [i], "Array": [array], "Len": [ln]},
+                     {"ArrayOut": [array], "LenOut": [ln]}, {})
+    return array
 
 
 def array_read(array, i):
-    raise NotImplementedError("use scan_layer instead of array_read on TPU")
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(
+        array.dtype, tuple(array.shape[1:]), True)
+    helper.append_op("array_read",
+                     {"Array": [array], "I": [i],
+                      "Len": [array._array_len_var]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int32", (), True)
+    helper.append_op("assign", {"X": [array._array_len_var]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """ref layers.tensor_array_to_tensor: concat/stack the array.
+    Returns (tensor, length) — length is the number of valid entries
+    (the tensor itself covers the full capacity; slice by length)."""
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    cap = int(input.shape[0])
+    elem = tuple(input.shape[1:])
+    if use_stack:
+        shape = elem[:axis] + (cap,) + elem[axis:]
+    else:
+        shape = tuple(s * cap if d == axis else s
+                      for d, s in enumerate(elem))
+    out = helper.create_variable_for_type_inference(input.dtype, shape, True)
+    idx = helper.create_variable_for_type_inference("int32", (), True)
+    helper.append_op("tensor_array_to_tensor",
+                     {"Array": [input], "Len": [input._array_len_var]},
+                     {"Out": [out], "OutIndex": [idx]},
+                     {"axis": axis, "use_stack": use_stack})
+    return out, idx
+
+
+# --- imperative control-flow classes ---------------------------------------
+def _outer_written_names(program, sub):
+    """Names written by ops in `sub` that are visible in an ancestor block —
+    these become the loop/branch carry (fluid writes them in place)."""
+    seen = []
+    for op in sub.ops:
+        for n in op.output_names():
+            if n in seen:
+                continue
+            idx = sub.parent_idx
+            while idx >= 0:
+                b = program.blocks[idx]
+                if n in b.vars:
+                    seen.append(n)
+                    break
+                idx = b.parent_idx
+    return seen
+
+
+class While:
+    """ref layers.While — imperative while block.
+
+    The reference interprets the sub-block on the host each iteration
+    (control_flow.py:While + while_op.cc); here the block is captured and
+    lowered to ONE lax.while_loop whose carry is every outer variable the
+    block writes (fluid's in-place writes, made functional). The condition
+    variable must be updated inside the block (e.g. layers.less_than(...,
+    cond=cond)) exactly as in the reference.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.program = default_main_program()
+        self._sub = None
+
+    def block(self):
+        w = self
+
+        class _Guard:
+            def __enter__(g):
+                w._sub = w.program.create_block()
+                return w._sub
+
+            def __exit__(g, et, ev, tb):
+                w.program.rollback()
+                if et is None:
+                    w._complete()
+                return False
+
+        return _Guard()
+
+    def _complete(self):
+        sub = self._sub
+        prog = self.program
+        parent = prog.current_block()
+        written = _outer_written_names(prog, sub)
+        cond_name = self.cond_var.name
+        carry = [cond_name] + [n for n in written if n != cond_name]
+        # empty condition block: the carried cond value IS the predicate
+        cond_blk = prog.create_block()
+        prog.rollback()
+        parent.append_op(
+            "while_loop", {"LoopVars": list(carry)},
+            {"Out": list(carry)},
+            {"cond_block": cond_blk.idx, "body_block": sub.idx,
+             "cond_out": cond_name, "body_outs": list(carry),
+             "carry_names": list(carry)})
+
+
+class Switch:
+    """ref layers.Switch — first matching case wins (used by LR schedules).
+    Lowered to a chain of lax.cond ops over the union of variables the
+    case blocks write."""
+
+    def __init__(self, name=None):
+        self.program = default_main_program()
+        self.cases = []
+        self.default_block = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is None:
+            self._complete()
+        return False
+
+    def _capture(self, store):
+        sw = self
+
+        class _G:
+            def __enter__(g):
+                g.blk = sw.program.create_block()
+                return g.blk
+
+            def __exit__(g, et, ev, tb):
+                sw.program.rollback()
+                if et is None:
+                    store(g.blk)
+                return False
+
+        return _G()
+
+    def case(self, condition):
+        cond_var = condition
+        return self._capture(lambda blk: self.cases.append((cond_var, blk)))
+
+    def default(self):
+        def store(blk):
+            self.default_block = blk
+        return self._capture(store)
+
+    def _complete(self):
+        if not self.cases:
+            raise ValueError("Switch needs at least one case")
+        prog = self.program
+        parent = prog.current_block()
+        blocks = [b for _, b in self.cases]
+        if self.default_block is not None:
+            blocks.append(self.default_block)
+        written = []
+        for b in blocks:
+            for n in _outer_written_names(prog, b):
+                if n not in written:
+                    written.append(n)
+        if not written:
+            return
+        out_vars = list(written)
+        if self.default_block is not None:
+            next_idx = self.default_block.idx
+        else:
+            empty = prog.create_block()
+            prog.rollback()
+            next_idx = empty.idx
+        # build the chain innermost-first; each wrapper block holds one cond
+        for cond_var, case_blk in reversed(self.cases[1:]):
+            w = prog.create_block()
+            prog.rollback()
+            w.append_op("cond", {"Cond": [cond_var]}, {"Out": out_vars},
+                        {"true_block": case_blk.idx, "false_block": next_idx,
+                         "true_outs": list(written),
+                         "false_outs": list(written)})
+            next_idx = w.idx
+        cond_var, case_blk = self.cases[0]
+        parent.append_op("cond", {"Cond": [cond_var]}, {"Out": out_vars},
+                        {"true_block": case_blk.idx, "false_block": next_idx,
+                         "true_outs": list(written),
+                         "false_outs": list(written)})
+
+
+class IfElse:
+    """ref layers.IfElse — per-ROW conditional over a [N, 1] bool mask.
+
+    The reference physically splits the batch by mask, runs each branch on
+    its subset, and merges (conditional_block + split/merge_lod_tensor
+    ops). On TPU both branches run on the FULL batch (static shapes; XLA
+    fuses them) and outputs merge row-wise by the mask — numerically
+    identical for row-independent branches, which is what the op requires
+    anyway (rows can't see each other across the split).
+    """
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.helper = LayerHelper("ifelse", name=name)
+        self._outs = {True: [], False: []}
+        self._branch = None
+
+    def _guard(self, flag):
+        ie = self
+
+        class _G:
+            def __enter__(g):
+                ie._branch = flag
+                return ie
+
+            def __exit__(g, et, ev, tb):
+                ie._branch = None
+                return False
+
+        return _G()
+
+    def true_block(self):
+        return self._guard(True)
+
+    def false_block(self):
+        return self._guard(False)
+
+    def input(self, x):
+        if self._branch is None:
+            raise RuntimeError("IfElse.input outside a branch block")
+        return x
+
+    def output(self, *outs):
+        if self._branch is None:
+            raise RuntimeError("IfElse.output outside a branch block")
+        self._outs[self._branch].extend(outs)
+
+    def __call__(self):
+        t, f = self._outs[True], self._outs[False]
+        if len(t) != len(f):
+            raise ValueError("IfElse branches produced different numbers "
+                             f"of outputs ({len(t)} vs {len(f)})")
+        res = []
+        for tv, fv in zip(t, f):
+            out = self.helper.create_variable_for_type_inference(
+                tv.dtype, tv.shape, True)
+            self.helper.append_op(
+                "mask_merge", {"Mask": [self.cond], "X": [tv], "Y": [fv]},
+                {"Out": [out]}, {})
+            res.append(out)
+        return res
+
+
+class StaticRNN:
+    """ref layers.StaticRNN — step over axis 0 of [T, B, ...] inputs.
+
+    The reference unrolls the step block T times into the ProgramDesc
+    (recurrent_op.cc); here the block is captured ONCE and lowered to
+    lax.scan — compile time independent of T, and XLA pipelines the steps.
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.program = default_main_program()
+        self.seq_len = None
+        self._x_map = []     # (outer_name, step_name)
+        self._mem = []       # [init_name, prev_step_name, new_name|None]
+        self._y_map = []     # (step_y_name, out_var)
+        self._block = None
+        self._in_step = False
+        self._outputs = []
+
+    def step(self):
+        rnn = self
+
+        class _G:
+            def __enter__(g):
+                rnn._block = rnn.program.create_block()
+                rnn._in_step = True
+                return rnn
+
+            def __exit__(g, et, ev, tb):
+                rnn._in_step = False
+                rnn.program.rollback()
+                if et is None:
+                    rnn._complete()
+                return False
+
+        return _G()
+
+    def _require_step(self):
+        if not self._in_step:
+            raise RuntimeError("call inside `with rnn.step():`")
+
+    def step_input(self, x):
+        self._require_step()
+        T = int(x.shape[0])
+        if self.seq_len is None:
+            self.seq_len = T
+        elif self.seq_len != T:
+            raise ValueError(f"step inputs disagree on T: {self.seq_len} vs {T}")
+        sv = self._block.create_var(
+            name=unique_name.generate("rnn_step_in"),
+            shape=tuple(x.shape[1:]), dtype=x.dtype, stop_gradient=False)
+        self._x_map.append((x.name, sv.name))
+        return sv
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        self._require_step()
+        parent = self.program.blocks[self._block.parent_idx]
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs init= or (shape=, batch_ref=)")
+            resolved = [int(batch_ref.shape[0]) if int(s) < 0 else int(s)
+                        for s in shape]
+            init_var = parent.create_var(
+                name=unique_name.generate("rnn_mem_init"),
+                shape=tuple(resolved), dtype=batch_ref.dtype,
+                stop_gradient=True)
+            parent.append_op("fill_constant", {}, {"Out": [init_var]},
+                             {"shape": resolved, "dtype": str(init_var.dtype),
+                              "value": float(init_value)})
+            init = init_var
+        prev = self._block.create_var(
+            name=unique_name.generate("rnn_mem_prev"),
+            shape=tuple(init.shape), dtype=init.dtype, stop_gradient=False)
+        self._mem.append([init.name, prev.name, None])
+        return prev
+
+    def update_memory(self, mem, x):
+        self._require_step()
+        for rec in self._mem:
+            if rec[1] == mem.name:
+                rec[2] = x.name
+                return
+        raise ValueError(f"{mem.name} is not a memory of this RNN")
+
+    def step_output(self, o):
+        self._require_step()
+        parent = self.program.blocks[self._block.parent_idx]
+        out = parent.create_var(
+            name=unique_name.generate("rnn_out"),
+            shape=(self.seq_len,) + tuple(o.shape), dtype=o.dtype,
+            stop_gradient=False)
+        self._y_map.append((o.name, out))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        if not self._x_map and self.seq_len is None:
+            raise ValueError("StaticRNN needs at least one step_input")
+        for rec in self._mem:
+            if rec[2] is None:
+                raise ValueError("memory never updated; call update_memory")
+        parent = self.program.current_block()
+        out_vars = [v for _, v in self._y_map]
+        parent.append_op(
+            "static_rnn",
+            {"Xs": [o for o, _ in self._x_map],
+             "MemInits": [i for i, _, _ in self._mem]},
+            {"Ys": out_vars},
+            {"step_block": self._block.idx,
+             "x_map": [list(p) for p in self._x_map],
+             "mem_map": [list(r) for r in self._mem],
+             "y_map": [[s, v.name] for s, v in self._y_map]})
+        self._outputs = out_vars
+
+    def __call__(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return self._outputs
+
+
+class DynamicRNN(StaticRNN):
+    """ref layers.DynamicRNN — variable-length sequences.
+
+    The reference shrinks the effective batch as short sequences finish
+    (lod_rank_table + shrink_memory, host-side). With padded [B, T, ...]
+    arrays the TPU version scans the full T and MASKS memory updates past
+    each row's length, which computes the same final states/outputs on
+    static shapes. Pass the per-row lengths as `seq_len` ([B] int vector,
+    the LoD substitute); padded output steps are zeroed.
+    """
+
+    def __init__(self, seq_len=None, name=None):
+        super().__init__(name=name)
+        self._lengths = seq_len
+        self._t_step = None
+        self._mask = None
+
+    def block(self):
+        return self.step()
+
+    def _time_mask(self):
+        """[B] bool mask: t < seq_len, built lazily inside the step block."""
+        if self._mask is not None or self._lengths is None:
+            return self._mask
+        parent = self.program.blocks[self._block.parent_idx]
+        tidx = parent.create_var(
+            name=unique_name.generate("drnn_t"), shape=(self.seq_len,),
+            dtype="int32", stop_gradient=True)
+        parent.append_op("range", {}, {"Out": [tidx]},
+                         {"start": 0, "end": int(self.seq_len), "step": 1,
+                          "dtype": "int32"})
+        t_step = self._block.create_var(
+            name=unique_name.generate("drnn_t_step"), shape=(),
+            dtype="int32", stop_gradient=True)
+        self._x_map.append((tidx.name, t_step.name))
+        mask = self._block.create_var(
+            name=unique_name.generate("drnn_mask"),
+            shape=(int(self._lengths.shape[0]),), dtype="bool",
+            stop_gradient=True)
+        self._block.append_op("less_than",
+                              {"X": [t_step], "Y": [self._lengths]},
+                              {"Out": [mask]}, {})
+        self._mask = mask
+        return mask
+
+    def step_input(self, x, level=0):
+        # x is batch-major [B, T, ...] in the padded world → scan over T
+        self._require_step()
+        B, T = int(x.shape[0]), int(x.shape[1])
+        parent = self.program.blocks[self._block.parent_idx]
+        xt = parent.create_var(
+            name=unique_name.generate("drnn_in_tmajor"),
+            shape=(T, B) + tuple(x.shape[2:]), dtype=x.dtype,
+            stop_gradient=False)
+        perm = [1, 0] + list(range(2, len(x.shape)))
+        parent.append_op("transpose", {"X": [x]}, {"Out": [xt]},
+                         {"axis": perm})
+        return super().step_input(xt)
+
+    def static_input(self, x):
+        return x
+
+    def update_memory(self, mem, x):
+        self._require_step()
+        mask = self._time_mask()
+        if mask is None:
+            return super().update_memory(mem, x)
+        merged = self._block.create_var(
+            name=unique_name.generate("drnn_mem_upd"),
+            shape=tuple(x.shape), dtype=x.dtype, stop_gradient=False)
+        self._block.append_op("mask_merge",
+                              {"Mask": [mask], "X": [x], "Y": [mem]},
+                              {"Out": [merged]}, {})
+        return super().update_memory(mem, merged)
+
+    def step_output(self, o):
+        self._require_step()
+        mask = self._time_mask()
+        if mask is not None:
+            zeros = self._block.create_var(
+                name=unique_name.generate("drnn_zeros"),
+                shape=tuple(o.shape), dtype=o.dtype, stop_gradient=True)
+            self._block.append_op("fill_zeros_like", {"X": [o]},
+                                  {"Out": [zeros]}, {})
+            masked = self._block.create_var(
+                name=unique_name.generate("drnn_y_masked"),
+                shape=tuple(o.shape), dtype=o.dtype, stop_gradient=False)
+            self._block.append_op("mask_merge",
+                                  {"Mask": [mask], "X": [o], "Y": [zeros]},
+                                  {"Out": [masked]}, {})
+            o = masked
+        super().step_output(o)
+
+    def _complete(self):
+        super()._complete()
+        # transpose outputs back to batch-major [B, T, ...]
+        parent = self.program.current_block()
+        bm = []
+        for _, tv in self._y_map:
+            shape = tuple(tv.shape)
+            out = parent.create_var(
+                name=unique_name.generate("drnn_out"),
+                shape=(shape[1], shape[0]) + shape[2:], dtype=tv.dtype,
+                stop_gradient=False)
+            perm = [1, 0] + list(range(2, len(shape)))
+            parent.append_op("transpose", {"X": [tv]}, {"Out": [out]},
+                             {"axis": perm})
+            bm.append(out)
+        self._outputs = bm
+
+
+# --- misc (Print / is_empty / py_func / reorder) ---------------------------
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """ref layers.Print → jax.debug.print inside the compiled module."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape,
+                                                    input.stop_gradient)
+    msg = message or ""
+    if print_tensor_name:
+        msg = f"{msg} {input.name}".strip()
+    helper.append_op("print", {"X": [input]}, {"Out": [out]},
+                     {"message": msg, "summarize": summarize,
+                      "print_tensor_type": print_tensor_type,
+                      "print_tensor_shape": print_tensor_shape,
+                      "print_tensor_value": True})
+    return out
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference("bool", (), True)
+    helper.append_op("is_empty", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None, name=None):
+    """ref layers.py_func — host-python escape hatch.
+
+    The reference re-enters the Python interpreter from the C++ executor
+    (py_func_op.cc); here the callable runs via jax.pure_callback so it
+    composes with jit (XLA inserts the host round-trip). backward_func,
+    if given, becomes a custom VJP the same way.
+    """
+    from ..ops.kernels_control import register_py_func
+    helper = LayerHelper("py_func", name=name)
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    attrs = {"func_id": register_py_func(func),
+             "out_shapes": [list(int(s) for s in o.shape) for o in outs],
+             "out_dtypes": [str(o.dtype) for o in outs],
+             "backward_func_id": (register_py_func(backward_func)
+                                  if backward_func else -1)}
+    helper.append_op("py_func", {"X": xs}, {"Out": outs}, attrs)
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """ref reorder_lod_tensor_by_rank: sort batch rows by descending
+    sequence length. `rank_table` is the [B] length vector (the
+    lod_rank_table analog in the padded world)."""
+    helper = LayerHelper("reorder_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape,
+                                                    x.stop_gradient)
+    order = helper.create_variable_for_type_inference(
+        "int32", (x.shape[0],), True)
+    helper.append_op("reorder_by_rank",
+                     {"X": [x], "RankTable": [rank_table]},
+                     {"Out": [out], "Order": [order]}, {})
+    return out
 
 
 # --- comparison layers (ref control_flow.py) -------------------------------

@@ -1,14 +1,26 @@
-"""Data layers.
+"""Data layers + in-program readers.
 
 Parity: python/paddle/fluid/layers/io.py — `data` declares a feed
-variable (LoD level becomes a companion sequence-length convention);
-`py_reader`/`double_buffer` map onto the host-side prefetch pipeline in
-reader/pipeline.py (device feed is async via jax dispatch).
+variable; py_reader / create_py_reader_by_data / open_files /
+random_data_generator build host-side prefetch queues that the Executor
+drains automatically when no explicit feed covers their variables
+(replacing the reference's C++ reader queue + double_buffer ops,
+reader/open_files_op.cc). End of data raises core.EOFException exactly
+like the reference.
 """
+import threading
+import queue as _queue
+
+import numpy as np
+
+from .. import unique_name
 from ..core.framework import default_main_program
 from ..core.dtypes import convert_dtype
+from ..core import EOFException
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "create_py_reader_by_data", "read_file",
+           "double_buffer", "batch", "shuffle", "open_files",
+           "random_data_generator", "Preprocessor", "load"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -26,3 +38,320 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     return block.create_var(
         name=name, shape=tuple(shape), dtype=convert_dtype(dtype),
         is_data=True, stop_gradient=stop_gradient, lod_level=lod_level)
+
+
+class PyReader:
+    """Host-side feed queue bound to program data variables.
+
+    A daemon thread pulls batches from the decorated provider into a
+    bounded queue; Executor.run pops one batch per step when the reader's
+    variables aren't explicitly fed. With use_double_buffer the queue
+    depth gives the double-buffer overlap (JAX device puts are async, so
+    one batch transfers while the previous computes)."""
+
+    def __init__(self, vars, capacity=64, use_double_buffer=True,
+                 provider=None):
+        self.vars = list(vars)
+        self.capacity = max(2 if use_double_buffer else 1, int(capacity))
+        self._provider = provider
+        self._thread = None
+        self._q = None
+        self._started = False
+        self._END = object()
+
+    # -- decoration (ref decorate_paddle_reader / decorate_tensor_provider)
+    def decorate_paddle_reader(self, reader):
+        """reader() yields batches: lists of per-sample tuples."""
+        def provider():
+            for batch_data in reader():
+                cols = list(zip(*batch_data))
+                yield [np.asarray(np.stack(c), dtype=v.dtype)
+                       for c, v in zip(cols, self.vars)]
+        self._provider = provider
+        return self
+
+    def decorate_tensor_provider(self, reader):
+        """reader() yields lists of ready arrays, one per variable."""
+        def provider():
+            for arrays in reader():
+                yield [np.asarray(a, dtype=v.dtype)
+                       for a, v in zip(arrays, self.vars)]
+        self._provider = provider
+        return self
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    # -- lifecycle
+    def start(self):
+        if self._provider is None:
+            raise RuntimeError("py_reader not decorated with a data source")
+        if self._started:
+            return
+        self._q = _queue.Queue(maxsize=self.capacity)
+        self._stop = threading.Event()
+        q, end, stop = self._q, self._END, self._stop
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for item in self._provider():
+                    if not put(item):
+                        return          # reset() requested — exit cleanly
+            finally:
+                put(end)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+        self._started = True
+
+    def reset(self):
+        if self._thread is not None:
+            self._stop.set()
+            # drain so a blocked worker can notice the stop flag
+            try:
+                while True:
+                    self._q.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=2.0)
+        self._thread, self._q, self._started = None, None, False
+
+    def is_started(self):
+        return self._started
+
+    def next_feed(self):
+        """One batch as {var_name: array}; EOFException at end of data."""
+        if not self._started:
+            self.start()
+        item = self._q.get()
+        if item is self._END:
+            self._started = False
+            raise EOFException("py_reader exhausted; call reset()+start()")
+        return {v.name: a for v, a in zip(self.vars, item)}
+
+
+def _register_reader(reader, program=None):
+    program = program or default_main_program()
+    if not hasattr(program, "_py_readers"):
+        program._py_readers = []
+    program._py_readers.append(reader)
+    return reader
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """ref layers.py_reader → PyReader over fresh data variables."""
+    name = name or unique_name.generate("py_reader")
+    vars = []
+    for i, (s, d) in enumerate(zip(shapes, dtypes)):
+        lod = lod_levels[i] if lod_levels else 0
+        vars.append(data(f"{name}_slot{i}", shape=list(s), dtype=d,
+                         lod_level=lod, append_batch_size=False))
+    return _register_reader(PyReader(vars, capacity, use_double_buffer))
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """ref create_py_reader_by_data: reuse existing data vars."""
+    return _register_reader(
+        PyReader(feed_list, capacity, use_double_buffer))
+
+
+def read_file(reader):
+    """ref layers.read_file: the variables one step of the reader fills."""
+    vars = reader.vars
+    return vars[0] if len(vars) == 1 else list(vars)
+
+
+def double_buffer(reader, place=None, name=None):
+    """ref layers.double_buffer — the PyReader queue already overlaps
+    host→device transfer with compute; this bumps its depth."""
+    reader.capacity = max(reader.capacity, 2)
+    return reader
+
+
+def batch(reader, batch_size):
+    """ref layers.batch (reader-op version): regroup a sample-level
+    provider into fixed batches."""
+    inner = reader._provider
+    if inner is None:
+        raise RuntimeError("decorate the reader before layers.batch")
+
+    def provider():
+        buf = []
+        for sample in inner():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield [np.stack(c) for c in zip(*buf)]
+                buf = []
+        if buf:
+            yield [np.stack(c) for c in zip(*buf)]
+    reader._provider = provider
+    return reader
+
+
+def shuffle(reader, buffer_size):
+    """ref layers.shuffle (reader-op version)."""
+    inner = reader._provider
+    if inner is None:
+        raise RuntimeError("decorate the reader before layers.shuffle")
+    import random as _random
+
+    def provider():
+        rng = _random.Random()   # fresh order each epoch/start
+        buf = []
+        for item in inner():
+            buf.append(item)
+            if len(buf) >= buffer_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+    reader._provider = provider
+    return reader
+
+
+def open_files(filenames, shapes, dtypes, lod_levels=None, thread_num=1,
+               buffer_size=None, pass_num=1, is_test=None, name=None):
+    """ref layers.open_files: read recordio files of pickled samples
+    (recordio_writer.convert_reader_to_recordio_file format)."""
+    from ..recordio_writer import recordio_reader
+    if isinstance(filenames, str):
+        filenames = [filenames]
+    rd = py_reader(buffer_size or 64, shapes, dtypes, lod_levels, name=name)
+
+    def provider():
+        for _ in range(pass_num):
+            for fn in filenames:
+                for sample in recordio_reader(fn)():
+                    yield [np.asarray(c, dtype=v.dtype)
+                           for c, v in zip(sample, rd.vars)]
+    rd._provider = provider
+    return rd
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=True):
+    """ref layers.random_data_generator: infinite uniform random feeds
+    (used by reader benchmarks/tests)."""
+    dtypes = ["float32"] * len(shapes)
+    rd = py_reader(4, shapes, dtypes, lod_levels)
+    rng = np.random.RandomState(0)
+
+    def provider():
+        while True:
+            yield [rng.uniform(low, high, size=tuple(s)).astype("float32")
+                   for s in shapes]
+    rd._provider = provider
+    return rd
+
+
+class Preprocessor:
+    """ref layers.io.Preprocessor: transform reader batches with a block
+    of ops. The block builds a SEPARATE small Program which runs on each
+    batch before it enters the feed queue (the reference splices the
+    sub-block into the main ProgramDesc; here the main program stays one
+    clean XLA module and preprocessing overlaps on the host thread)."""
+
+    def __init__(self, reader, name=None):
+        self.underlying = reader
+        self.name = name or unique_name.generate("preprocessor")
+        self._program = None
+        self._startup = None
+        self._in_vars = None
+        self._out_vars = None
+        self.vars = None
+
+    def block(self):
+        from ..core.framework import Program, program_guard
+        p = self
+
+        class _G:
+            def __enter__(g):
+                p._program = Program()
+                p._startup = Program()
+                g.guard = program_guard(p._program, p._startup)
+                g.guard.__enter__()
+                return p
+
+            def __exit__(g, et, ev, tb):
+                g.guard.__exit__(et, ev, tb)
+                if et is None:
+                    p._complete()
+                return False
+
+        return _G()
+
+    def inputs(self):
+        self._in_vars = [
+            data(f"{self.name}_in{i}", shape=list(v.shape), dtype=v.dtype,
+                 append_batch_size=False)
+            for i, v in enumerate(self.underlying.vars)]
+        return self._in_vars
+
+    def outputs(self, *outs):
+        self._out_vars = list(outs)
+
+    def _complete(self):
+        if self._in_vars is None or self._out_vars is None:
+            raise RuntimeError("Preprocessor.block must call inputs() and "
+                               "outputs()")
+        # declare transformed vars in the MAIN program for read_file
+        main = default_main_program().global_block()
+        self.vars = [
+            main.create_var(name=f"{self.name}_out{i}",
+                            shape=tuple(v.shape), dtype=v.dtype,
+                            is_data=True, stop_gradient=True)
+            for i, v in enumerate(self._out_vars)]
+        # the preprocessor replaces its underlying reader as the feed
+        # source — the raw slots must not also be auto-fed
+        prog = default_main_program()
+        regs = getattr(prog, "_py_readers", [])
+        if self.underlying in regs:
+            regs.remove(self.underlying)
+        _register_reader(self)
+
+        from ..core.executor import Executor
+        from ..core.place import CPUPlace
+        exe = Executor(CPUPlace())
+        prog, outs = self._program, self._out_vars
+
+        def transform(feed):
+            return exe.run(prog, feed=feed, fetch_list=outs)
+        self._transform = transform
+
+    # reader protocol (Executor pulls through these)
+    def start(self):
+        self.underlying.start()
+
+    def reset(self):
+        self.underlying.reset()
+
+    def is_started(self):
+        return self.underlying.is_started()
+
+    def next_feed(self):
+        raw = self.underlying.next_feed()
+        feed = {iv.name: raw[uv.name]
+                for iv, uv in zip(self._in_vars, self.underlying.vars)}
+        res = self._transform(feed)
+        return {v.name: a for v, a in zip(self.vars, res)}
+
+
+def load(out, file_path, load_as_fp16=False):
+    """ref layers.load: fill `out` from a file saved by io.save_vars."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("load")
+    helper.append_op("load_from_file", {}, {"Out": [out]},
+                     {"file_path": file_path, "var_name": out.name,
+                      "load_as_fp16": bool(load_as_fp16)})
+    return out

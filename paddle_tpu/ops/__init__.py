@@ -8,4 +8,5 @@ from . import kernels_detection
 from . import kernels_sequence
 from . import kernels_struct
 from . import kernels_vision
+from . import kernels_control
 from .registry import KERNELS, get_kernel, has_kernel

@@ -1,0 +1,174 @@
+"""Control-flow-adjacent op kernels: tensor arrays, Print, py_func,
+is_empty, masked merge, rank reorder.
+
+Parity: paddle/fluid/operators/{tensor_array_read_write,print_op,
+py_func_op,is_empty_op,reorder_lod_tensor_by_rank_op}.* — the reference's
+LoDTensorArray is a host-side growable vector; on TPU an array is a
+fixed-capacity device buffer [cap, *elem] plus an int32 length scalar so
+it can live inside lax.while_loop carries (static shapes).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import kernel
+
+# host-side registry for py_func callables (attrs carry only an index so
+# Programs stay JSON-serializable)
+PY_FUNCS = []
+
+
+def register_py_func(fn):
+    PY_FUNCS.append(fn)
+    return len(PY_FUNCS) - 1
+
+
+@kernel("alloc_array")
+def _alloc_array(ctx, ins, attrs):
+    shape = tuple(attrs["element_shape"])
+    cap = int(attrs["capacity"])
+    buf = jnp.zeros((cap,) + shape, dtype=attrs.get("dtype", "float32"))
+    return {"Array": [buf], "Len": [jnp.zeros((), jnp.int32)]}
+
+
+@kernel("array_write")
+def _array_write(ctx, ins, attrs):
+    x = ins["X"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    buf = ins["Array"][0]
+    ln = jnp.reshape(ins["Len"][0], ()).astype(jnp.int32)
+    cap = buf.shape[0]
+    # dynamic_update_slice clamps out-of-range starts, which would silently
+    # overwrite the last slot — surface the overflow loudly instead
+    jax.lax.cond(
+        i >= cap,
+        lambda: jax.debug.print(
+            "WARNING array_write index {i} >= capacity {c}; write clamped — "
+            "raise create_array(capacity=)", i=i, c=cap),
+        lambda: None)
+    start = (i,) + (0,) * x.ndim
+    buf = jax.lax.dynamic_update_slice(buf, x[None].astype(buf.dtype), start)
+    return {"ArrayOut": [buf], "LenOut": [jnp.maximum(ln, i + 1)]}
+
+
+@kernel("array_read")
+def _array_read(ctx, ins, attrs):
+    buf = ins["Array"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_index_in_dim(buf, i, 0, keepdims=False)]}
+
+
+@kernel("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    buf = ins["Array"][0]
+    axis = attrs.get("axis", 1)
+    if attrs.get("use_stack", False):
+        out = jnp.moveaxis(buf, 0, axis)
+    else:
+        # concat cap elements of shape elem along `axis`
+        out = jnp.concatenate(list(buf), axis=axis) if buf.shape[0] > 1 \
+            else buf[0]
+    return {"Out": [out], "OutIndex": [ins["Len"][0]]}
+
+
+@kernel("is_empty")
+def _is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.asarray(x.size == 0)]}
+
+
+@kernel("print")
+def _print(ctx, ins, attrs):
+    x = ins["X"][0]
+    msg = attrs.get("message", "") or ""
+    parts = [msg]
+    if attrs.get("print_tensor_shape", True):
+        parts.append(f"shape={tuple(x.shape)}")
+    if attrs.get("print_tensor_type", True):
+        parts.append(f"dtype={x.dtype}")
+    prefix = " ".join(p for p in parts if p)
+    if attrs.get("print_tensor_value", True) and x.size:
+        flat = x.reshape(-1)[: attrs.get("summarize", 20)]
+        jax.debug.print(prefix + " value={v}", v=flat)
+    else:
+        jax.debug.print(prefix)
+    return {"Out": [x]}
+
+
+@kernel("mask_merge")
+def _mask_merge(ctx, ins, attrs):
+    """out = where(mask, x, y) with mask broadcast from the left
+    (mask [B] or [B,1] selects rows of [B, ...])."""
+    mask, x, y = ins["Mask"][0], ins["X"][0], ins["Y"][0]
+    m = jnp.reshape(mask, mask.shape[: 1] + (1,) * (x.ndim - 1)).astype(bool)
+    return {"Out": [jnp.where(m, x, y)]}
+
+
+@kernel("reorder_by_rank")
+def _reorder_by_rank(ctx, ins, attrs):
+    """Sort batch rows by descending sequence length (ref
+    reorder_lod_tensor_by_rank over a lod_rank_table)."""
+    x, ln = ins["X"][0], ins["RankTable"][0].reshape(-1)
+    order = jnp.argsort(-ln.astype(jnp.int32), stable=True)
+    return {"Out": [x[order]], "Order": [order.astype(jnp.int32)]}
+
+
+@kernel("load_from_file")
+def _load_from_file(ctx, ins, attrs):
+    """ref load_op.cc: fill a variable from a saved file. The file is read
+    host-side at trace time (the path is a static attr) and enters the
+    module as a constant."""
+    path = attrs["file_path"]
+    if path.endswith(".npz"):
+        d = np.load(path)
+        name = attrs.get("var_name")
+        arr = d[name] if name in d.files else d[d.files[0]]
+    else:
+        arr = np.load(path)
+    if attrs.get("load_as_fp16"):
+        arr = arr.astype(np.float16)
+    return {"Out": [jnp.asarray(arr)]}
+
+
+@kernel("py_func")
+def _py_func(ctx, ins, attrs):
+    xs = ins["X"]
+    fn = PY_FUNCS[attrs["func_id"]]
+    out_shapes = [tuple(s) for s in attrs["out_shapes"]]
+    out_dtypes = attrs["out_dtypes"]
+    result_spec = [jax.ShapeDtypeStruct(s, np.dtype(d))
+                   for s, d in zip(out_shapes, out_dtypes)]
+
+    def host_fn(*arrays):
+        res = fn(*[np.asarray(a) for a in arrays])
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return [np.asarray(r, dtype=d) for r, d in zip(res, out_dtypes)]
+
+    bwd_id = attrs.get("backward_func_id", -1)
+    if bwd_id < 0:
+        outs = jax.pure_callback(host_fn, result_spec, *xs)
+        return {"Out": list(outs)}
+
+    bwd = PY_FUNCS[bwd_id]
+    in_spec = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in xs]
+
+    @jax.custom_vjp
+    def call(*args):
+        return tuple(jax.pure_callback(host_fn, result_spec, *args))
+
+    def fwd(*args):
+        return call(*args), args
+
+    def back(res, gs):
+        def host_bwd(*arrays):
+            n = len(res)
+            grads = bwd(*[np.asarray(a) for a in arrays])
+            if not isinstance(grads, (list, tuple)):
+                grads = [grads]
+            return [np.asarray(g, dtype=a.dtype)
+                    for g, a in zip(grads, arrays[:n])]
+        return tuple(jax.pure_callback(host_bwd, in_spec, *res, *gs))
+
+    call.defvjp(fwd, back)
+    return {"Out": list(call(*xs))}

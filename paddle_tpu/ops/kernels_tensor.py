@@ -368,10 +368,4 @@ def _isfinite(ctx, ins, attrs):
     return {"Out": [ok]}
 
 
-@kernel("py_func")
-def _py_func(ctx, ins, attrs):
-    fn = attrs["_callable"]
-    outs = fn(*ins["X"])
-    if not isinstance(outs, (list, tuple)):
-        outs = [outs]
-    return {"Out": list(outs)}
+# py_func kernel lives in kernels_control.py (pure_callback + custom VJP)

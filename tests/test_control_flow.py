@@ -1,0 +1,219 @@
+"""Imperative control flow: While, Switch, IfElse, StaticRNN, DynamicRNN,
+tensor arrays, py_func (ref tests/unittests/test_while_op.py,
+test_switch.py, test_ifelse.py, test_recurrent_op.py,
+test_tensor_array_to_tensor.py, test_py_func_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(fetch, feed=None):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return exe.run(pt.default_main_program(), feed=feed or {},
+                   fetch_list=fetch)
+
+
+def test_while_accumulates():
+    i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+    limit = layers.fill_constant(shape=[1], dtype="int32", value=10)
+    acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond=cond)
+    with w.block():
+        acc2 = layers.elementwise_add(acc, layers.cast(i, "float32"))
+        layers.assign(acc2, acc)
+        i2 = layers.increment(i, value=1, in_place=False)
+        layers.assign(i2, i)
+        layers.less_than(i, limit, cond=cond)
+    out, iv = _run([acc, i])
+    assert iv[0] == 10
+    assert out[0] == sum(range(10))
+
+
+def test_while_with_array_write_read():
+    i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+    limit = layers.fill_constant(shape=[1], dtype="int32", value=5)
+    arr = layers.create_array("float32", element_shape=(3,), capacity=8)
+    x = layers.fill_constant(shape=[3], dtype="float32", value=2.0)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond=cond)
+    with w.block():
+        val = layers.elementwise_mul(x, layers.cast(i, "float32"))
+        layers.array_write(val, i, array=arr)
+        i2 = layers.increment(i, value=1, in_place=False)
+        layers.assign(i2, i)
+        layers.less_than(i, limit, cond=cond)
+    ln = layers.array_length(arr)
+    third = layers.array_read(arr, layers.fill_constant([1], "int32", 3))
+    stacked, _ = layers.tensor_array_to_tensor(arr, axis=0, use_stack=True)
+    l, t, s = _run([ln, third, stacked])
+    assert l == 5
+    np.testing.assert_allclose(t, [6.0, 6.0, 6.0])
+    np.testing.assert_allclose(s[2], [4.0, 4.0, 4.0])
+    np.testing.assert_allclose(s[5:], 0.0)     # capacity padding
+
+
+def test_switch_piecewise():
+    lr = layers.create_global_var([1], 0.0, "float32", persistable=True)
+    step = layers.data("step", shape=[1], dtype="float32",
+                       append_batch_size=False)
+    b1 = layers.fill_constant([1], "float32", 10.0)
+    b2 = layers.fill_constant([1], "float32", 20.0)
+    with layers.Switch() as switch:
+        with switch.case(layers.less_than(step, b1)):
+            layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+        with switch.case(layers.less_than(step, b2)):
+            layers.assign(layers.fill_constant([1], "float32", 0.01), lr)
+        with switch.default():
+            layers.assign(layers.fill_constant([1], "float32", 0.001), lr)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    for s, want in [(5.0, 0.1), (15.0, 0.01), (99.0, 0.001)]:
+        out, = exe.run(pt.default_main_program(),
+                       feed={"step": np.array([s], "float32")},
+                       fetch_list=[lr])
+        assert out[0] == pytest.approx(want)
+
+
+def test_ifelse_rowwise():
+    x = layers.data("x", shape=[4, 1], dtype="float32",
+                    append_batch_size=False)
+    zero = layers.fill_constant([4, 1], "float32", 0.0)
+    mask = layers.less_than(zero, x)          # x > 0
+    ie = layers.IfElse(mask)
+    with ie.true_block():
+        d = ie.input(x)
+        ie.output(layers.scale(d, scale=2.0))
+    with ie.false_block():
+        d = ie.input(x)
+        ie.output(layers.scale(d, scale=-1.0))
+    out = ie()[0]
+    xv = np.array([[1.0], [-2.0], [3.0], [-4.0]], "float32")
+    res, = _run([out], feed={"x": xv})
+    np.testing.assert_allclose(res, np.where(xv > 0, 2 * xv, -xv))
+
+
+def test_static_rnn_cumsum():
+    T, B, D = 6, 4, 3
+    x = layers.data("x", shape=[T, B, D], dtype="float32",
+                    append_batch_size=False)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        prev = rnn.memory(shape=[-1, D], batch_ref=xt)
+        s = layers.elementwise_add(prev, xt)
+        rnn.update_memory(prev, s)
+        rnn.step_output(s)
+    out = rnn()
+    xv = np.random.RandomState(0).randn(T, B, D).astype("float32")
+    res, = _run([out], feed={"x": xv})
+    np.testing.assert_allclose(res, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_dynamic_rnn_masked():
+    B, T, D = 3, 5, 2
+    x = layers.data("x", shape=[B, T, D], dtype="float32",
+                    append_batch_size=False)
+    ln = layers.data("len", shape=[B], dtype="int32",
+                     append_batch_size=False)
+    drnn = layers.DynamicRNN(seq_len=ln)
+    with drnn.block():
+        xt = drnn.step_input(x)
+        prev = drnn.memory(shape=[-1, D], batch_ref=xt)
+        s = layers.elementwise_add(prev, xt)
+        drnn.update_memory(prev, s)
+        drnn.output(s)
+    out = drnn()
+    rng = np.random.RandomState(1)
+    xv = rng.randn(B, T, D).astype("float32")
+    lens = np.array([5, 2, 4], "int32")
+    res, = _run([out], feed={"x": xv, "len": lens})
+    want = np.cumsum(xv, axis=1)
+    for b, l in enumerate(lens):
+        want[b, l:] = 0.0                     # padded steps zeroed
+    np.testing.assert_allclose(res, want, rtol=1e-5)
+
+
+def test_py_func_forward_and_grad():
+    x = layers.data("x", shape=[4], dtype="float32",
+                    append_batch_size=False)
+    x.stop_gradient = False
+    out = pt.default_main_program().current_block().create_var(
+        name="pyfunc_out", shape=(4,), dtype="float32")
+    layers.py_func(lambda a: a * 3.0, x, out,
+                   backward_func=lambda a, g: g * 3.0)
+    loss = layers.reduce_sum(out)
+    res, = _run([loss], feed={"x": np.ones(4, "float32")})
+    assert res == pytest.approx(12.0)
+
+
+def test_print_is_identity_and_is_empty():
+    x = layers.fill_constant([2, 2], "float32", 7.0)
+    y = layers.Print(x, message="dbg")
+    e = layers.is_empty(x)
+    yv, ev = _run([y, e])
+    np.testing.assert_allclose(yv, 7.0)
+    assert not ev
+
+
+def test_switch_nested_case_reads_derived_var():
+    """Regression: a later case's block reads a main-block temp — the op
+    producing it must survive pruning even though the read happens inside
+    a nested wrapper block."""
+    lr = layers.create_global_var([1], 0.0, "float32", persistable=True)
+    step = layers.data("step", shape=[1], dtype="float32",
+                       append_batch_size=False)
+    derived = layers.elementwise_add(
+        layers.fill_constant([1], "float32", 0.004),
+        layers.fill_constant([1], "float32", 0.006))
+    b1 = layers.fill_constant([1], "float32", 10.0)
+    b2 = layers.fill_constant([1], "float32", 20.0)
+    with layers.Switch() as switch:
+        with switch.case(layers.less_than(step, b1)):
+            layers.assign(layers.fill_constant([1], "float32", 1.0), lr)
+        with switch.case(layers.less_than(step, b2)):
+            layers.assign(derived, lr)
+        with switch.default():
+            layers.assign(layers.fill_constant([1], "float32", 3.0), lr)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    out, = exe.run(pt.default_main_program(),
+                   feed={"step": np.array([15.0], "float32")},
+                   fetch_list=[lr])
+    assert out[0] == pytest.approx(0.01)
+
+
+def test_py_reader_partial_batch_and_explicit_feed_precedence():
+    reader = layers.py_reader(capacity=4, shapes=[(2,)], dtypes=["float32"])
+    x = layers.read_file(reader)
+    out = layers.reduce_sum(x)
+
+    def sample_provider():
+        yield from ([np.full(2, float(k), "float32")] for k in range(5))
+    reader._provider = sample_provider
+    layers.batch(reader, 2)        # 5 samples → 2 full + 1 partial batch
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    reader.start()
+    sums = []
+    from paddle_tpu.core import EOFException
+    try:
+        while True:
+            sums.append(float(exe.run(fetch_list=[out])[0]))
+    except EOFException:
+        pass
+    assert sums == [2.0, 10.0, 8.0]    # trailing partial batch kept
+
+
+def test_reorder_by_rank():
+    x = layers.data("x", shape=[3, 4], dtype="float32",
+                    append_batch_size=False)
+    ln = layers.data("len", shape=[3], dtype="int32",
+                     append_batch_size=False)
+    out = layers.reorder_lod_tensor_by_rank(x, ln)
+    xv = np.arange(12, dtype="float32").reshape(3, 4)
+    res, = _run([out], feed={"x": xv, "len": np.array([2, 5, 3], "int32")})
+    np.testing.assert_allclose(res, xv[[1, 2, 0]])

@@ -1,0 +1,102 @@
+"""In-program readers: py_reader, open_files, Preprocessor, load
+(ref tests/unittests/test_py_reader_*.py, test_multi_file_reader.py,
+test_preprocessor.py, test_load_op.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import EOFException
+
+
+def test_py_reader_trains_to_eof():
+    reader = layers.py_reader(capacity=8, shapes=[(4, 3), (4, 1)],
+                              dtypes=["float32", "int32"])
+    img, label = layers.read_file(reader)
+    loss = layers.reduce_sum(layers.square(img))
+    rng = np.random.RandomState(0)
+    batches = [(rng.randn(4, 3).astype("float32"),
+                np.zeros((4, 1), "int32")) for _ in range(5)]
+    reader.decorate_tensor_provider(lambda: iter(batches))
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    reader.start()
+    seen = []
+    with pytest.raises(EOFException):
+        while True:
+            v, = exe.run(pt.default_main_program(), fetch_list=[loss])
+            seen.append(float(v))
+    assert len(seen) == 5
+    np.testing.assert_allclose(
+        seen, [float((b[0] ** 2).sum()) for b in batches], rtol=1e-5)
+    # reset + restart replays the data
+    reader.reset()
+    reader.decorate_tensor_provider(lambda: iter(batches[:2]))
+    reader.start()
+    v, = exe.run(pt.default_main_program(), fetch_list=[loss])
+    assert float(v) == pytest.approx(seen[0], rel=1e-5)
+
+
+def test_create_py_reader_by_data_paddle_reader():
+    x = layers.data("x", shape=[2], dtype="float32",
+                    append_batch_size=False)
+    # batch of per-sample tuples (paddle-reader convention)
+    reader = layers.create_py_reader_by_data(capacity=4, feed_list=[x])
+    reader.decorate_paddle_reader(
+        lambda: iter([[(np.ones(2, "float32") * k,)] for k in range(3)]))
+    out = layers.reduce_sum(x)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    reader.start()
+    vals = [float(exe.run(fetch_list=[out])[0]) for _ in range(3)]
+    assert vals == [0.0, 2.0, 4.0]
+
+
+def test_open_files_recordio(tmp_path):
+    from paddle_tpu.recordio_writer import convert_reader_to_recordio_file
+    path = os.path.join(tmp_path, "data.recordio")
+    samples = [(np.full((3,), i, "float32"), np.array([i], "int32"))
+               for i in range(4)]
+    convert_reader_to_recordio_file(path, lambda: iter(samples))
+    rd = layers.open_files([path], shapes=[(3,), (1,)],
+                           dtypes=["float32", "int32"])
+    feat, idx = layers.read_file(rd)
+    s = layers.reduce_sum(feat)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rd.start()
+    got = [float(exe.run(fetch_list=[s])[0]) for _ in range(4)]
+    assert got == [0.0, 3.0, 6.0, 9.0]
+
+
+def test_preprocessor_transforms_batches():
+    reader = layers.py_reader(capacity=4, shapes=[(2, 2)],
+                              dtypes=["float32"])
+    reader.decorate_tensor_provider(
+        lambda: iter([[np.ones((2, 2), "float32") * k] for k in (1, 2)]))
+    p = layers.Preprocessor(reader)
+    with p.block():
+        ins = p.inputs()
+        p.outputs(layers.scale(ins[0], scale=10.0))
+    out_var = layers.read_file(p)
+    total = layers.reduce_sum(out_var)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    p.start()
+    vals = [float(exe.run(fetch_list=[total])[0]) for _ in range(2)]
+    assert vals == [40.0, 80.0]
+
+
+def test_layers_load_from_npz(tmp_path):
+    path = os.path.join(tmp_path, "w.npz")
+    w = np.arange(6, dtype="float32").reshape(2, 3)
+    np.savez(path, myvar=w)
+    out = pt.default_main_program().global_block().create_var(
+        name="myvar", shape=(2, 3), dtype="float32")
+    layers.load(out, path)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    got, = exe.run(fetch_list=[out])
+    np.testing.assert_allclose(got, w)
