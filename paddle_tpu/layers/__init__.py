@@ -23,6 +23,8 @@ from . import struct
 from .struct import *        # noqa: F401,F403
 from . import vision
 from .vision import *        # noqa: F401,F403
+from . import detection
+from .detection import *     # noqa: F401,F403
 from . import math_op_patch
 
 math_op_patch.monkey_patch_variable()
@@ -36,3 +38,4 @@ __all__ += learning_rate_scheduler.__all__
 __all__ += metric_op.__all__
 __all__ += io.__all__
 __all__ += sequence.__all__
+__all__ += detection.__all__

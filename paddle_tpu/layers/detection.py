@@ -1,16 +1,25 @@
-"""Detection layers (SSD family).
+"""Detection layers (SSD / Faster-RCNN / YOLOv3 families).
 
-Parity: python/paddle/fluid/layers/detection.py — prior_box, box_coder,
-multiclass NMS, iou. TPU notes: NMS output is FIXED-SIZE (keep_top_k
-padded with -1 labels) because XLA needs static shapes; the reference's
-LoD-variable outputs are a host-side concept.
+Parity: python/paddle/fluid/layers/detection.py. TPU conventions (static
+shapes replacing the reference's LoD variable-length tensors):
+- NMS-family outputs are fixed keep_top_k rows padded with label -1
+- ground truth comes as padded [B, G, ...] batches (pad label < 0 /
+  degenerate boxes); RoIs are [R, 5] (batch_idx, x1..y2) or [R, 4]
+- sampling ops (rpn_target_assign, generate_proposal_labels) emit fixed
+  sample counts with a validity weight instead of variable index lists
 """
 import numpy as np
 
 from ..layer_helper import LayerHelper
 
-__all__ = ["prior_box", "box_coder", "iou_similarity", "multiclass_nms",
-           "ssd_loss_stub", "detection_output"]
+__all__ = ["prior_box", "density_prior_box", "anchor_generator",
+           "box_coder", "iou_similarity", "multiclass_nms",
+           "bipartite_match", "target_assign", "ssd_loss",
+           "detection_output", "multi_box_head", "rpn_target_assign",
+           "generate_proposals", "generate_proposal_labels",
+           "roi_pool", "roi_align", "psroi_pool",
+           "roi_perspective_transform", "polygon_box_transform",
+           "yolov3_loss", "detection_map", "ssd_loss_stub"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
@@ -88,10 +97,390 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
     return out
 
 
-detection_output = multiclass_nms
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """ref layers.detection_output: decode loc vs priors, then NMS.
+    loc [N, M, 4], scores [N, M, C] (post-softmax) → [N, keep_top_k, 6]."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    from . import nn as _nn
+    sc = _nn.transpose(scores, perm=[0, 2, 1])       # [N, C, M]
+    return multiclass_nms(decoded, sc, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    h, w = int(input.shape[2]), int(input.shape[3])
+    A = len(anchor_sizes or []) * len(aspect_ratios or [])
+    anchors = helper.create_variable_for_type_inference(
+        "float32", (h, w, A, 4), True)
+    var = helper.create_variable_for_type_inference(
+        "float32", (h, w, A, 4), True)
+    helper.append_op("anchor_generator", {"Input": [input]},
+                     {"Anchors": [anchors], "Variances": [var]},
+                     {"anchor_sizes": list(anchor_sizes),
+                      "aspect_ratios": list(aspect_ratios),
+                      "variances": list(variance),
+                      "stride": list(stride or [16.0, 16.0]),
+                      "offset": offset})
+    return anchors, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    h, w = int(input.shape[2]), int(input.shape[3])
+    P = sum(d * d * len(fixed_ratios or [1.0]) for d in (densities or []))
+    shape = (h * w * P, 4) if flatten_to_2d else (h, w, P, 4)
+    boxes = helper.create_variable_for_type_inference("float32", shape, True)
+    var = helper.create_variable_for_type_inference("float32", shape, True)
+    helper.append_op("density_prior_box",
+                     {"Input": [input], "Image": [image]},
+                     {"Boxes": [boxes], "Variances": [var]},
+                     {"densities": list(densities or []),
+                      "fixed_sizes": list(fixed_sizes or []),
+                      "fixed_ratios": list(fixed_ratios or [1.0]),
+                      "variances": list(variance), "clip": clip,
+                      "steps": list(steps), "offset": offset,
+                      "flatten_to_2d": flatten_to_2d})
+    return boxes, var
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """ref layers.bipartite_match: greedy max matching col→row.
+    dist_matrix [G, M] (or [B, G, M]) → match indices [B?, M]."""
+    helper = LayerHelper("bipartite_match", name=name)
+    shape = dist_matrix.shape
+    out_shape = (shape[0], shape[2]) if len(shape) == 3 else (1, shape[1])
+    match = helper.create_variable_for_type_inference("int32", out_shape, True)
+    dist = helper.create_variable_for_type_inference("float32", out_shape, True)
+    helper.append_op("bipartite_match", {"DistMat": [dist_matrix]},
+                     {"ColToRowMatchIndices": [match],
+                      "ColToRowMatchDist": [dist]},
+                     {"match_type": match_type or "bipartite",
+                      "dist_threshold": (0.5 if dist_threshold is None
+                                         else dist_threshold)})
+    return match, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """ref layers.target_assign: out[b, j] = input[b, match[b, j]]."""
+    helper = LayerHelper("target_assign", name=name)
+    M = matched_indices.shape[-1]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (matched_indices.shape[0], M) + tuple(input.shape[2:]),
+        True)
+    wt = helper.create_variable_for_type_inference(
+        "float32", (matched_indices.shape[0], M, 1), True)
+    helper.append_op("target_assign",
+                     {"X": [input], "MatchIndices": [matched_indices]},
+                     {"Out": [out], "OutWeight": [wt]},
+                     {"mismatch_value": (0 if mismatch_value is None
+                                         else mismatch_value)})
+    return out, wt
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """ref layers.ssd_loss (detection.py:779) as one fused op.
+    location [B, M, 4], confidence [B, M, C], gt_box [B, G, 4],
+    gt_label [B, G] with pad < 0 → per-prior loss [B, M]."""
+    helper = LayerHelper("ssd_loss")
+    if mining_type != "max_negative":
+        raise ValueError("only max_negative mining is supported (ref default)")
+    from . import tensor as _t
+    if prior_box_var is None:
+        prior_box_var = _t.fill_constant(
+            [int(np.prod(prior_box.shape[:-1])), 4], "float32", 1.0)
+    B, M = int(location.shape[0]), int(location.shape[1])
+    loss = helper.create_variable_for_type_inference("float32", (B, M))
+    helper.append_op("ssd_loss",
+                     {"Loc": [location], "Conf": [confidence],
+                      "GtBox": [gt_box], "GtLabel": [gt_label],
+                      "PriorBox": [prior_box], "PriorVar": [prior_box_var]},
+                     {"Loss": [loss]},
+                     {"background_label": background_label,
+                      "overlap_threshold": overlap_threshold,
+                      "neg_pos_ratio": neg_pos_ratio,
+                      "neg_overlap": neg_overlap,
+                      "loc_loss_weight": loc_loss_weight,
+                      "conf_loss_weight": conf_loss_weight,
+                      "normalize": normalize})
+    return loss
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """ref layers.multi_box_head (detection.py:1259): SSD heads — per
+    feature map a conv for loc + conf and a prior_box, concatenated."""
+    from . import nn as _nn
+    from . import tensor as _t
+    n = len(inputs)
+    if min_sizes is None:
+        # ref: interpolate ratios between min_ratio and max_ratio
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (n - 2))) if n > 2 else 0
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n - 1]
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        mx = (max_sizes[i] if isinstance(max_sizes[i], (list, tuple))
+              else [max_sizes[i]]) if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        st = steps[i] if steps else [step_w[i] if step_w else 0.0,
+                                     step_h[i] if step_h else 0.0]
+        if not isinstance(st, (list, tuple)):
+            st = [st, st]
+        box, var = prior_box(feat, image, ms, mx, ar, variance, flip, clip,
+                             (st[1], st[0]), offset)
+        P = int(box.shape[2])
+        loc = _nn.conv2d(feat, num_filters=P * 4, filter_size=kernel_size,
+                         padding=pad, stride=stride)
+        conf = _nn.conv2d(feat, num_filters=P * num_classes,
+                          filter_size=kernel_size, padding=pad,
+                          stride=stride)
+        # [N, P*4, H, W] → [N, H*W*P, 4]
+        loc = _nn.transpose(loc, perm=[0, 2, 3, 1])
+        loc = _nn.reshape(loc, [0, -1, 4])
+        conf = _nn.transpose(conf, perm=[0, 2, 3, 1])
+        conf = _nn.reshape(conf, [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_l.append(_nn.reshape(box, [-1, 4]))
+        vars_l.append(_nn.reshape(var, [-1, 4]))
+    mbox_locs = _t.concat(locs, axis=1)
+    mbox_confs = _t.concat(confs, axis=1)
+    boxes = _t.concat(boxes_l, axis=0)
+    variances = _t.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+# --- Faster-RCNN pipeline ---------------------------------------------------
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """ref layers.rpn_target_assign (detection.py:54). Returns FIXED-size
+    (loc, score, target_label, target_bbox, bbox_inside_weight) of
+    S = rpn_batch_size_per_im samples per image; the last output doubles
+    as the validity mask (the reference's variable-length gather)."""
+    helper = LayerHelper("rpn_target_assign")
+    B = int(bbox_pred.shape[0])
+    S = rpn_batch_size_per_im
+    loc = helper.create_variable_for_type_inference("float32", (B, S, 4))
+    score = helper.create_variable_for_type_inference("float32", (B, S, 1))
+    lab = helper.create_variable_for_type_inference("int32", (B, S), True)
+    tgt = helper.create_variable_for_type_inference("float32", (B, S, 4), True)
+    w = helper.create_variable_for_type_inference("float32", (B, S), True)
+    helper.append_op("rpn_target_assign",
+                     {"BboxPred": [bbox_pred], "ClsLogits": [cls_logits],
+                      "AnchorBox": [anchor_box], "AnchorVar": [anchor_var],
+                      "GtBoxes": [gt_boxes]},
+                     {"PredictedLocation": [loc], "PredictedScores": [score],
+                      "TargetLabel": [lab], "TargetBBox": [tgt],
+                      "BBoxInsideWeight": [w]},
+                     {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                      "rpn_fg_fraction": rpn_fg_fraction,
+                      "rpn_positive_overlap": rpn_positive_overlap,
+                      "rpn_negative_overlap": rpn_negative_overlap,
+                      "use_random": use_random})
+    return loc, score, lab, tgt, w
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """ref layers.generate_proposals → rois [B, post_nms_top_n, 4] +
+    roi probs [B, post_nms_top_n, 1] (zero rows past the kept count)."""
+    helper = LayerHelper("generate_proposals", name=name)
+    B = int(scores.shape[0])
+    rois = helper.create_variable_for_type_inference(
+        "float32", (B, post_nms_top_n, 4), True)
+    probs = helper.create_variable_for_type_inference(
+        "float32", (B, post_nms_top_n, 1), True)
+    helper.append_op("generate_proposals",
+                     {"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                      "ImInfo": [im_info], "Anchors": [anchors],
+                      "Variances": [variances]},
+                     {"RpnRois": [rois], "RpnRoiProbs": [probs]},
+                     {"pre_nms_top_n": pre_nms_top_n,
+                      "post_nms_top_n": post_nms_top_n,
+                      "nms_thresh": nms_thresh, "min_size": min_size})
+    return rois, probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd=None,
+                             gt_boxes=None, im_info=None,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """ref layers.generate_proposal_labels → fixed P samples per image:
+    (rois, labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights)."""
+    helper = LayerHelper("generate_proposal_labels")
+    B = int(rpn_rois.shape[0])
+    P = batch_size_per_im
+    C = class_nums or 81
+    rois = helper.create_variable_for_type_inference("float32", (B, P, 4), True)
+    labels = helper.create_variable_for_type_inference("int32", (B, P), True)
+    tgts = helper.create_variable_for_type_inference(
+        "float32", (B, P, 4 * C), True)
+    inw = helper.create_variable_for_type_inference(
+        "float32", (B, P, 4 * C), True)
+    outw = helper.create_variable_for_type_inference(
+        "float32", (B, P, 4 * C), True)
+    helper.append_op("generate_proposal_labels",
+                     {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                      "GtBoxes": [gt_boxes]},
+                     {"Rois": [rois], "LabelsInt32": [labels],
+                      "BboxTargets": [tgts], "BboxInsideWeights": [inw],
+                      "BboxOutsideWeights": [outw]},
+                     {"batch_size_per_im": batch_size_per_im,
+                      "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+                      "bg_thresh_hi": bg_thresh_hi,
+                      "bg_thresh_lo": bg_thresh_lo,
+                      "bbox_reg_weights": list(bbox_reg_weights),
+                      "class_nums": C, "use_random": use_random})
+    return rois, labels, tgts, inw, outw
+
+
+# --- RoI ops ---------------------------------------------------------------
+def _roi_op(op_type, input, rois, pooled_height, pooled_width, attrs,
+            out_channels=None):
+    helper = LayerHelper(op_type)
+    R = int(rois.shape[0])
+    C = out_channels or int(input.shape[1])
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (R, C, pooled_height, pooled_width))
+    helper.append_op(op_type, {"X": [input], "ROIs": [rois]},
+                     {"Out": [out]}, attrs)
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    """ref layers.roi_pool (nn.py:6270). rois [R, 5] (batch_idx, x1..y2)
+    or [R, 4] (batch 0)."""
+    return _roi_op("roi_pool", input, rois, pooled_height, pooled_width,
+                   {"pooled_height": pooled_height,
+                    "pooled_width": pooled_width,
+                    "spatial_scale": spatial_scale})
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    """ref layers.roi_align (nn.py:6308)."""
+    return _roi_op("roi_align", input, rois, pooled_height, pooled_width,
+                   {"pooled_height": pooled_height,
+                    "pooled_width": pooled_width,
+                    "spatial_scale": spatial_scale,
+                    "sampling_ratio": sampling_ratio})
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """ref layers.psroi_pool (nn.py:9628): input channels must equal
+    output_channels * pooled_height * pooled_width."""
+    if int(input.shape[1]) != output_channels * pooled_height * pooled_width:
+        raise ValueError("psroi_pool: C != output_channels*ph*pw")
+    return _roi_op("psroi_pool", input, rois, pooled_height, pooled_width,
+                   {"pooled_height": pooled_height,
+                    "pooled_width": pooled_width,
+                    "output_channels": output_channels,
+                    "spatial_scale": spatial_scale},
+                   out_channels=output_channels)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """ref layers.roi_perspective_transform (detection.py:1600): rois are
+    quadrilaterals [R, 8] (or [R, 9] with batch index)."""
+    helper = LayerHelper("roi_perspective_transform")
+    R = int(rois.shape[0])
+    C = int(input.shape[1])
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (R, C, transformed_height, transformed_width))
+    helper.append_op("roi_perspective_transform",
+                     {"X": [input], "ROIs": [rois]}, {"Out": [out]},
+                     {"transformed_height": transformed_height,
+                      "transformed_width": transformed_width,
+                      "spatial_scale": spatial_scale})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    """ref layers.polygon_box_transform (EAST geometry decoding)."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, input.shape, True)
+    helper.append_op("polygon_box_transform", {"Input": [input]},
+                     {"Output": [out]}, {})
+    return out
+
+
+def yolov3_loss(x, gtbox, gtlabel, anchors, class_num, ignore_thresh,
+                loss_weight_xy=None, loss_weight_wh=None,
+                loss_weight_conf_target=None, loss_weight_conf_notarget=None,
+                loss_weight_class=None, name=None, downsample_ratio=32):
+    """ref layers.yolov3_loss (detection.py:408). gtbox [B, G, 4]
+    center-form normalized; gtlabel [B, G]; pad rows have width 0."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    B = int(x.shape[0])
+    loss = helper.create_variable_for_type_inference("float32", (B,))
+    helper.append_op("yolov3_loss",
+                     {"X": [x], "GTBox": [gtbox], "GTLabel": [gtlabel]},
+                     {"Loss": [loss]},
+                     {"anchors": list(anchors), "class_num": class_num,
+                      "ignore_thresh": ignore_thresh,
+                      "downsample_ratio": downsample_ratio})
+    return loss
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """ref layers.detection_map (detection.py:515): VOC mAP over the
+    fixed-size NMS output. label rows: (class, difficult, x1, y1, x2, y2),
+    pad class < 0."""
+    helper = LayerHelper("detection_map")
+    out = helper.create_variable_for_type_inference("float32", (), True)
+    helper.append_op("detection_map",
+                     {"DetectRes": [detect_res], "Label": [label]},
+                     {"MAP": [out]},
+                     {"class_num": class_num,
+                      "overlap_threshold": overlap_threshold,
+                      "evaluate_difficult": evaluate_difficult,
+                      "ap_version": ap_version})
+    return out
 
 
 def ssd_loss_stub(*a, **k):
-    raise NotImplementedError(
-        "ssd_loss: planned for a later round (needs matched-box targets); "
-        "prior_box/box_coder/iou/multiclass_nms are available")
+    """Deprecated alias kept for earlier-round callers."""
+    return ssd_loss(*a, **k)

@@ -33,7 +33,7 @@ __all__ = [
     "elementwise_max", "elementwise_min", "elementwise_pow",
     "elementwise_mod", "scale", "cast", "pad", "pad2d", "prelu",
     "brelu", "leaky_relu", "soft_relu", "relu6", "pow", "hard_sigmoid",
-    "swish", "hard_swish", "image_resize", "resize_bilinear",
+    "swish", "hard_swish", "image_resize", "image_resize_short", "resize_bilinear",
     "resize_nearest", "grid_sampler", "affine_channel", "shuffle_channel",
     "scaled_dot_product_attention", "multi_head_attention",
     "add_position_encoding", "lod_reset", "im2sequence",
@@ -1187,6 +1187,16 @@ def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
                      {"out_h": oh, "out_w": ow,
                       "interp_method": resample.lower()})
     return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """ref nn.py:image_resize_short — resize so the SHORT side equals
+    out_short_len, keeping aspect ratio."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    oh = int(round(h * out_short_len / short))
+    ow = int(round(w * out_short_len / short))
+    return image_resize(input, out_shape=(oh, ow), resample=resample)
 
 
 def resize_bilinear(input, out_shape=None, scale=None, name=None):

@@ -6,7 +6,8 @@ F1, Auc, CompositeMetric, ChunkEvaluator-lite.
 import numpy as np
 
 __all__ = ["MetricBase", "Accuracy", "Precision", "Recall", "F1",
-           "Auc", "CompositeMetric", "EditDistance"]
+           "Auc", "CompositeMetric", "EditDistance", "ChunkEvaluator",
+           "DetectionMAP"]
 
 
 class MetricBase:
@@ -140,6 +141,55 @@ class EditDistance(MetricBase):
         d = np.asarray(dists).reshape(-1)
         self.total += float(d.sum())
         self.count += len(d)
+
+    def eval(self):
+        return self.total / max(self.count, 1)
+
+
+class ChunkEvaluator(MetricBase):
+    """ref metrics.py:ChunkEvaluator — streaming chunk-level P/R/F1 from
+    the chunk_eval op's (num_infer, num_label, num_correct) counters."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class DetectionMAP(MetricBase):
+    """ref metrics.py:DetectionMAP — streaming mean over per-batch mAP
+    values produced by layers.detection_map."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, value, weight=1):
+        self.total += float(np.asarray(value).sum()) * weight
+        self.count += weight
 
     def eval(self):
         return self.total / max(self.count, 1)

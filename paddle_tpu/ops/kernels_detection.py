@@ -1,8 +1,11 @@
 """Detection op kernels.
 
-Parity: paddle/fluid/operators/detection/{prior_box,box_coder,
-iou_similarity,multiclass_nms}_op.* — static-shape XLA versions (NMS
-emits a fixed keep_top_k with -1 padding instead of LoD outputs).
+Parity: paddle/fluid/operators/detection/* — static-shape XLA versions.
+Conventions that replace the reference's LoD variable-length outputs:
+- NMS-style ops emit fixed keep_top_k rows padded with label/-1 rows
+- ground-truth boxes come as [B, G, ...] padded batches; a row is valid
+  when its label >= 0 (gt) or its box is non-degenerate (x2 > x1)
+- RoIs are [R, 5] rows (batch_idx, x1, y1, x2, y2); [R, 4] means batch 0
 """
 import jax
 import jax.numpy as jnp
@@ -161,3 +164,768 @@ def _multiclass_nms(ctx, ins, attrs):
 
     out = jax.vmap(per_image)(bboxes, scores)
     return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# anchors / priors
+# ---------------------------------------------------------------------------
+@kernel("anchor_generator")
+def _anchor_generator(ctx, ins, attrs):
+    """ref detection/anchor_generator_op.cc: absolute-pixel anchors."""
+    feat = ins["Input"][0]
+    fh, fw = feat.shape[2], feat.shape[3]
+    sizes = attrs["anchor_sizes"]
+    ratios = attrs["aspect_ratios"]
+    sh, sw = attrs.get("stride", [16.0, 16.0])
+    offset = attrs.get("offset", 0.5)
+    whs = []
+    for r in ratios:
+        for s in sizes:
+            area = s * s
+            w = np.sqrt(area / r)
+            whs.append((w, w * r))
+    whs = np.asarray(whs, np.float32)                      # [A, 2]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    w2 = jnp.asarray(whs[:, 0])[None, None, :] / 2
+    h2 = jnp.asarray(whs[:, 1])[None, None, :] / 2
+    anchors = jnp.stack([cxg[..., None] - w2, cyg[..., None] - h2,
+                         cxg[..., None] + w2, cyg[..., None] + h2], -1)
+    var = jnp.broadcast_to(
+        jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                    jnp.float32), anchors.shape)
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+@kernel("density_prior_box")
+def _density_prior_box(ctx, ins, attrs):
+    """ref detection/density_prior_box_op.cc (SSD-lite style priors)."""
+    feat, img = ins["Input"][0], ins["Image"][0]
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sh, sw = attrs.get("steps", [0.0, 0.0])
+    sh = sh or ih / fh
+    sw = sw or iw / fw
+    offset = attrs.get("offset", 0.5)
+    densities = attrs["densities"]
+    fixed_sizes = attrs["fixed_sizes"]
+    fixed_ratios = attrs.get("fixed_ratios") or [1.0]
+    # per-cell prior centers+sizes (relative shifts within the cell)
+    shifts = []                                            # (dx, dy, w, h)
+    for size, dens in zip(fixed_sizes, densities):
+        for r in fixed_ratios:
+            w, h = size * np.sqrt(r), size / np.sqrt(r)
+            step = 1.0 / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    shifts.append(((dj + 0.5) * step - 0.5,
+                                   (di + 0.5) * step - 0.5, w, h))
+    shifts = np.asarray(shifts, np.float32)                # [P, 4]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    px = cxg[..., None] + jnp.asarray(shifts[:, 0]) * sw
+    py = cyg[..., None] + jnp.asarray(shifts[:, 1]) * sh
+    w2 = jnp.asarray(shifts[:, 2])[None, None, :] / 2
+    h2 = jnp.asarray(shifts[:, 3])[None, None, :] / 2
+    boxes = jnp.stack([(px - w2) / iw, (py - h2) / ih,
+                       (px + w2) / iw, (py + h2) / ih], -1)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                    jnp.float32), boxes.shape)
+    if attrs.get("flatten_to_2d", False):
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+# ---------------------------------------------------------------------------
+# matching / target assignment
+# ---------------------------------------------------------------------------
+def _bipartite_match_single(dist):
+    """dist [N_gt, M]: greedy global-max matching. Returns
+    (col_to_row [M] int32 with -1 unmatched, col_dist [M])."""
+    N, M = dist.shape
+    BIG = jnp.float32(1e9)
+
+    def body(_, state):
+        d, match, mdist = state
+        flat = jnp.argmax(d)
+        r, c = flat // M, flat % M
+        ok = d[r, c] > 0
+        match = jnp.where(ok, match.at[c].set(r.astype(jnp.int32)), match)
+        mdist = jnp.where(ok, mdist.at[c].set(d[r, c]), mdist)
+        d = jnp.where(ok, d.at[r, :].set(-BIG).at[:, c].set(-BIG), d)
+        return d, match, mdist
+
+    init = (dist, jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), jnp.float32))
+    _, match, mdist = jax.lax.fori_loop(0, min(N, M), body, init)
+    return match, mdist
+
+
+@kernel("bipartite_match")
+def _bipartite_match(ctx, ins, attrs):
+    dist = ins["DistMat"][0]
+    batched = dist.ndim == 3
+    d3 = dist if batched else dist[None]
+    match, mdist = jax.vmap(_bipartite_match_single)(d3)
+    if attrs.get("match_type") == "per_prediction":
+        thresh = attrs.get("dist_threshold", 0.5)
+        best = jnp.max(d3, axis=1)
+        best_row = jnp.argmax(d3, axis=1).astype(jnp.int32)
+        extra = (match < 0) & (best >= thresh)
+        match = jnp.where(extra, best_row, match)
+        mdist = jnp.where(extra, best, mdist)
+    return {"ColToRowMatchIndices": [match],
+            "ColToRowMatchDist": [mdist]}
+
+
+@kernel("target_assign")
+def _target_assign(ctx, ins, attrs):
+    """ref detection/target_assign_op.cc: out[b, j] = X[b, match[b,j]] with
+    mismatch_value where match[b, j] < 0."""
+    x = ins["X"][0]                       # [B, N, K] source entities
+    match = ins["MatchIndices"][0]        # [B, M]
+    mval = attrs.get("mismatch_value", 0)
+    idx = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, idx[..., None], axis=1)
+    out = jnp.where((match < 0)[..., None], jnp.asarray(mval, x.dtype), out)
+    wt = (match >= 0).astype(jnp.float32)[..., None]
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+def _encode_boxes(gt, prior, pvar):
+    """center-size encode [*, 4] gt against priors (SSD/faster-rcnn)."""
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = prior[..., 0] + 0.5 * pw
+    pcy = prior[..., 1] + 0.5 * ph
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gcx = gt[..., 0] + 0.5 * gw
+    gcy = gt[..., 1] + 0.5 * gh
+    eps = 1e-9
+    return jnp.stack([
+        (gcx - pcx) / jnp.maximum(pw, eps) / pvar[..., 0],
+        (gcy - pcy) / jnp.maximum(ph, eps) / pvar[..., 1],
+        jnp.log(jnp.maximum(gw / jnp.maximum(pw, eps), eps)) / pvar[..., 2],
+        jnp.log(jnp.maximum(gh / jnp.maximum(ph, eps), eps)) / pvar[..., 3],
+    ], -1)
+
+
+def _decode_boxes(deltas, prior, pvar):
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = prior[..., 0] + 0.5 * pw
+    pcy = prior[..., 1] + 0.5 * ph
+    cx = pvar[..., 0] * deltas[..., 0] * pw + pcx
+    cy = pvar[..., 1] * deltas[..., 1] * ph + pcy
+    w = jnp.exp(jnp.minimum(pvar[..., 2] * deltas[..., 2], 10.0)) * pw
+    h = jnp.exp(jnp.minimum(pvar[..., 3] * deltas[..., 3], 10.0)) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+@kernel("ssd_loss")
+def _ssd_loss(ctx, ins, attrs):
+    """ref layers.ssd_loss pipeline in ONE fused kernel: iou → bipartite
+    match → encode targets → smooth-L1 loc loss + softmax conf loss with
+    max_negative hard mining (detection.py:779)."""
+    loc = ins["Loc"][0]          # [B, M, 4]
+    conf = ins["Conf"][0]        # [B, M, C]
+    gt_box = ins["GtBox"][0]     # [B, G, 4]
+    gt_label = ins["GtLabel"][0] # [B, G] (pad < 0)
+    prior = ins["PriorBox"][0].reshape(-1, 4)     # [M, 4]
+    pvar = ins["PriorVar"][0].reshape(-1, 4)
+    ov = attrs.get("overlap_threshold", 0.5)
+    npr = attrs.get("neg_pos_ratio", 3.0)
+    bg = attrs.get("background_label", 0)
+    loc_w = attrs.get("loc_loss_weight", 1.0)
+    conf_w = attrs.get("conf_loss_weight", 1.0)
+    B, M, C = conf.shape
+
+    def per_image(lc, cf, gb, gl):
+        valid_gt = gl >= 0
+        iou = _iou_matrix(gb, prior)                       # [G, M]
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        match, _ = _bipartite_match_single(iou)
+        best = jnp.max(jnp.where(valid_gt[:, None], iou, -1.0), axis=0)
+        best_row = jnp.argmax(iou, axis=0).astype(jnp.int32)
+        extra = (match < 0) & (best >= ov)
+        match = jnp.where(extra, best_row, match)          # [M]
+        pos = match >= 0
+        gidx = jnp.maximum(match, 0)
+        tgt_box = _encode_boxes(gb[gidx], prior, pvar)     # [M, 4]
+        tgt_lab = jnp.where(pos, gl[gidx], bg)             # [M]
+        # smooth-L1 localization loss over positives
+        d = lc - tgt_box
+        ad = jnp.abs(d)
+        sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(-1)
+        loc_loss = jnp.where(pos, sl1, 0.0)
+        # softmax CE per prior
+        logp = jax.nn.log_softmax(cf, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_lab[:, None], -1)[:, 0]
+        # hard negative mining (max_negative): keep top-k negatives by CE
+        num_pos = pos.sum()
+        num_neg = jnp.minimum((num_pos * npr).astype(jnp.int32),
+                              jnp.asarray(M, jnp.int32))
+        neg_score = jnp.where(pos, -jnp.inf, ce)
+        order = jnp.argsort(-neg_score)
+        rank = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M, dtype=jnp.int32))
+        neg = (~pos) & (rank < num_neg)
+        conf_loss = jnp.where(pos | neg, ce, 0.0)
+        total = conf_w * conf_loss + loc_w * loc_loss
+        if attrs.get("normalize", True):
+            total = total / jnp.maximum(num_pos.astype(jnp.float32), 1.0)
+        return total
+
+    loss = jax.vmap(per_image)(loc, conf, gt_box, gt_label)  # [B, M]
+    return {"Loss": [loss]}
+
+
+# ---------------------------------------------------------------------------
+# RoI ops
+# ---------------------------------------------------------------------------
+def _split_rois(rois):
+    if rois.shape[-1] == 5:
+        return rois[:, 0].astype(jnp.int32), rois[:, 1:]
+    return jnp.zeros((rois.shape[0],), jnp.int32), rois
+
+
+def _bilinear_at(img, ys, xs):
+    """img [C, H, W]; ys/xs broadcastable grids → [C, *grid]."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+    y1i = jnp.clip(y0i + 1, 0, H - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+    x1i = jnp.clip(x0i + 1, 0, W - 1)
+    v00 = img[:, y0i, x0i]
+    v01 = img[:, y0i, x1i]
+    v10 = img[:, y1i, x0i]
+    v11 = img[:, y1i, x1i]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+            v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@kernel("roi_align")
+def _roi_align(ctx, ins, attrs):
+    """ref roi_align_op.cc: average of bilinear samples per bin."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph, pw = attrs["pooled_height"], attrs["pooled_width"]
+    scale = attrs.get("spatial_scale", 1.0)
+    sr = attrs.get("sampling_ratio", -1)
+    sr = 2 if sr is None or sr <= 0 else int(sr)
+    bidx, boxes = _split_rois(rois)
+    boxes = boxes * scale
+
+    def one(b, box):
+        x1, y1, x2, y2 = box
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        ys = y1 + ((jnp.arange(ph)[:, None] +
+                    (jnp.arange(sr)[None, :] + 0.5) / sr) * rh / ph)
+        xs = x1 + ((jnp.arange(pw)[:, None] +
+                    (jnp.arange(sr)[None, :] + 0.5) / sr) * rw / pw)
+        Y = ys.reshape(-1)[:, None] * jnp.ones((1, pw * sr))
+        X = jnp.ones((ph * sr, 1)) * xs.reshape(-1)[None, :]
+        vals = _bilinear_at(x[b], Y, X)                    # [C, ph*sr, pw*sr]
+        C = vals.shape[0]
+        return vals.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+
+    return {"Out": [jax.vmap(one)(bidx, boxes)]}
+
+
+@kernel("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """ref roi_pool_op.cc (quantized max pool). Static-shape version: max
+    over a dense KxK nearest-neighbor sample grid per bin — exact whenever
+    the bin spans ≤ K pixels per side."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph, pw = attrs["pooled_height"], attrs["pooled_width"]
+    scale = attrs.get("spatial_scale", 1.0)
+    K = attrs.get("sample_grid", 8)
+    bidx, boxes = _split_rois(rois)
+    H, W = x.shape[2], x.shape[3]
+
+    def one(b, box):
+        x1 = jnp.round(box[0] * scale)
+        y1 = jnp.round(box[1] * scale)
+        x2 = jnp.round(box[2] * scale)
+        y2 = jnp.round(box[3] * scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        ys = y1 + (jnp.arange(ph)[:, None] + (jnp.arange(K)[None, :] + 0.5) / K) * rh / ph
+        xs = x1 + (jnp.arange(pw)[:, None] + (jnp.arange(K)[None, :] + 0.5) / K) * rw / pw
+        yi = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1).reshape(-1)
+        xi = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1).reshape(-1)
+        vals = x[b][:, yi[:, None], xi[None, :]]           # [C, ph*K, pw*K]
+        C = vals.shape[0]
+        return vals.reshape(C, ph, K, pw, K).max(axis=(2, 4))
+
+    return {"Out": [jax.vmap(one)(bidx, boxes)]}
+
+
+@kernel("psroi_pool")
+def _psroi_pool(ctx, ins, attrs):
+    """ref psroi_pool_op.cc: position-sensitive average pooling — output
+    channel c, bin (i,j) pools input channel c*ph*pw + i*pw + j."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph, pw = attrs["pooled_height"], attrs["pooled_width"]
+    oc = attrs["output_channels"]
+    scale = attrs.get("spatial_scale", 1.0)
+    K = attrs.get("sample_grid", 8)
+    bidx, boxes = _split_rois(rois)
+    H, W = x.shape[2], x.shape[3]
+
+    def one(b, box):
+        x1, y1, x2, y2 = box * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        ys = y1 + (jnp.arange(ph)[:, None] + (jnp.arange(K)[None, :] + 0.5) / K) * rh / ph
+        xs = x1 + (jnp.arange(pw)[:, None] + (jnp.arange(K)[None, :] + 0.5) / K) * rw / pw
+        yi = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1).reshape(-1)
+        xi = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1).reshape(-1)
+        vals = x[b][:, yi[:, None], xi[None, :]]
+        vals = vals.reshape(oc, ph, pw, ph, K, pw, K)      # [oc,ph,pw | ph,K,pw,K]
+        # select the position-sensitive channel for each output bin
+        i = jnp.arange(ph)
+        j = jnp.arange(pw)
+        # advanced indices are non-contiguous → broadcast dims go first:
+        # picked is [ph, pw, oc, K, K]
+        picked = vals[:, i[:, None], j[None, :], i[:, None], :, j[None, :], :]
+        return picked.mean(axis=(-1, -2)).transpose(2, 0, 1)   # [oc, ph, pw]
+
+    return {"Out": [jax.vmap(one)(bidx, boxes)]}
+
+
+# ---------------------------------------------------------------------------
+# RPN / proposal pipeline
+# ---------------------------------------------------------------------------
+@kernel("generate_proposals")
+def _generate_proposals(ctx, ins, attrs):
+    """ref detection/generate_proposals_op.cc: decode anchors, clip,
+    filter small boxes, NMS → fixed post_nms_top_n rois per image."""
+    scores = ins["Scores"][0]        # [B, A, H, W]
+    deltas = ins["BboxDeltas"][0]    # [B, A*4, H, W]
+    im_info = ins["ImInfo"][0]       # [B, 3] (h, w, scale)
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    var = ins["Variances"][0].reshape(-1, 4)
+    pre = min(attrs.get("pre_nms_top_n", 6000), anchors.shape[0])
+    post = attrs.get("post_nms_top_n", 1000)
+    thresh = attrs.get("nms_thresh", 0.5)
+    min_size = attrs.get("min_size", 0.1)
+    B = scores.shape[0]
+    A = anchors.shape[0]
+
+    # layout: scores [A,H,W] → [H,W,A] flat; deltas [A*4,H,W] → [H,W,A,4]
+    def prep(sc, dl):
+        Ax, H, W = sc.shape
+        sc = sc.transpose(1, 2, 0).reshape(-1)
+        dl = dl.reshape(Ax, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        return sc, dl
+
+    def one(sc, dl, info):
+        sc, dl = prep(sc, dl)
+        boxes = _decode_boxes(dl, anchors, var)
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - 1),
+                           jnp.clip(boxes[:, 1], 0, ih - 1),
+                           jnp.clip(boxes[:, 2], 0, iw - 1),
+                           jnp.clip(boxes[:, 3], 0, ih - 1)], -1)
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
+              (boxes[:, 3] - boxes[:, 1] >= min_size))
+        sc = jnp.where(ok, sc, -jnp.inf)
+        idx, s, keep = _nms_single_class(boxes, sc, pre, thresh)
+        s = jnp.where(keep > 0, s, -jnp.inf)
+        k = min(post, pre)
+        top_s, top_i = jax.lax.top_k(s, k)
+        rois = boxes[idx[top_i]]
+        if k < post:
+            rois = jnp.concatenate(
+                [rois, jnp.zeros((post - k, 4), rois.dtype)], 0)
+            top_s = jnp.concatenate(
+                [top_s, jnp.full((post - k,), -jnp.inf)], 0)
+        # Scores input is rpn_cls_prob (already post-sigmoid, ref contract)
+        probs = jnp.where(jnp.isfinite(top_s), top_s, 0.0)
+        return rois, probs[:, None]
+
+    rois, probs = jax.vmap(one)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs]}
+
+
+def _sample_quota(ctx, eligible, quota, total):
+    """Pick `quota` indices among `eligible` (bool [N]), randomized when a
+    PRNG key is available. Returns (idx [quota], valid [quota])."""
+    n = eligible.shape[0]
+    if ctx is not None and ctx.key is not None:
+        noise = jax.random.uniform(ctx.key, (n,))
+    else:
+        noise = jnp.linspace(1.0, 0.0, n)
+    score = jnp.where(eligible, 1.0 + noise, noise - 2.0)
+    top, idx = jax.lax.top_k(score, quota)
+    return idx, top > 1.0
+
+
+@kernel("rpn_target_assign")
+def _rpn_target_assign(ctx, ins, attrs):
+    """ref detection/rpn_target_assign_op.cc: sample fg/bg anchors.
+    Fixed-size outputs [B, S, ...] with a weight mask instead of the
+    reference's variable-length index lists."""
+    bbox_pred = ins["BboxPred"][0]    # [B, M, 4]
+    cls_logits = ins["ClsLogits"][0]  # [B, M, 1]
+    anchors = ins["AnchorBox"][0].reshape(-1, 4)
+    avar = ins["AnchorVar"][0].reshape(-1, 4)
+    gt = ins["GtBoxes"][0]            # [B, G, 4] (degenerate rows = pad)
+    S = attrs.get("rpn_batch_size_per_im", 256)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    pos_ov = attrs.get("rpn_positive_overlap", 0.7)
+    neg_ov = attrs.get("rpn_negative_overlap", 0.3)
+    n_fg = int(S * fg_frac)
+    n_bg = S - n_fg
+
+    def one(pred, logit, gb, key):
+        valid_gt = (gb[:, 2] > gb[:, 0]) & (gb[:, 3] > gb[:, 1])
+        iou = jnp.where(valid_gt[:, None], _iou_matrix(gb, anchors), -1.0)
+        amax = jnp.max(iou, axis=0)                       # [M]
+        gidx = jnp.argmax(iou, axis=0)
+        fg = amax >= pos_ov
+        # every gt's best anchor is fg too
+        best_anchor = jnp.argmax(iou, axis=1)             # [G]
+        fg = fg.at[best_anchor].set(
+            jnp.where(valid_gt, True, fg[best_anchor]))
+        # amax == -1 (no valid gt at all) still counts as background:
+        # images without objects must supply negatives (ref behavior)
+        bg = (amax < neg_ov) & ~fg
+        kctx = KCtx(key)
+        fg_i, fg_ok = _sample_quota(kctx, fg, n_fg, S)
+        kctx = KCtx(jax.random.fold_in(key, 1)) if key is not None else None
+        bg_i, bg_ok = _sample_quota(kctx, bg, n_bg, S)
+        idx = jnp.concatenate([fg_i, bg_i])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        lab = jnp.concatenate([jnp.ones((n_fg,), jnp.int32),
+                               jnp.zeros((n_bg,), jnp.int32)])
+        tgt = _encode_boxes(gb[gidx[idx]], anchors[idx], avar[idx])
+        tgt = jnp.where((lab > 0)[:, None], tgt, 0.0)
+        return (pred[idx], logit[idx], lab, tgt,
+                ok.astype(jnp.float32))
+
+    class KCtx:
+        def __init__(self, key):
+            self.key = key
+
+    B = bbox_pred.shape[0]
+    keys = (jax.random.split(ctx.key, B) if ctx and ctx.key is not None
+            else None)
+    if keys is None:
+        one_nokey = lambda p, l, g: one(p, l, g, None)
+        outs = jax.vmap(one_nokey)(bbox_pred, cls_logits, gt)
+    else:
+        outs = jax.vmap(one)(bbox_pred, cls_logits, gt, keys)
+    loc, score, lab, tgt, w = outs
+    return {"PredictedLocation": [loc], "PredictedScores": [score],
+            "TargetLabel": [lab], "TargetBBox": [tgt],
+            "BBoxInsideWeight": [w]}
+
+
+@kernel("generate_proposal_labels")
+def _generate_proposal_labels(ctx, ins, attrs):
+    """ref detection/generate_proposal_labels_op.cc: sample RoIs for the
+    second-stage head; fixed P rois per image with per-class box targets."""
+    rois = ins["RpnRois"][0]          # [B, R, 4]
+    gt_classes = ins["GtClasses"][0]  # [B, G] (pad < 0)
+    gt_boxes = ins["GtBoxes"][0]      # [B, G, 4]
+    P = attrs.get("batch_size_per_im", 256)
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thresh = attrs.get("fg_thresh", 0.25)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    weights = jnp.asarray(attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2]),
+                          jnp.float32)
+    n_cls = attrs.get("class_nums", 81)
+    n_fg = int(P * fg_frac)
+    n_bg = P - n_fg
+
+    def one(rs, gc, gb, key):
+        valid_gt = gc >= 0
+        iou = jnp.where(valid_gt[:, None], _iou_matrix(gb, rs), -1.0)
+        amax = jnp.max(iou, axis=0)
+        gidx = jnp.argmax(iou, axis=0)
+        fg = amax >= fg_thresh
+        bg = (amax < bg_hi) & (amax >= bg_lo)
+        kc = _K(key)
+        fg_i, fg_ok = _sample_quota(kc, fg, n_fg, P)
+        kc = _K(jax.random.fold_in(key, 1)) if key is not None else None
+        bg_i, bg_ok = _sample_quota(kc, bg, n_bg, P)
+        idx = jnp.concatenate([fg_i, bg_i])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        is_fg = jnp.concatenate([fg_ok, jnp.zeros((n_bg,), bool)])
+        labels = jnp.where(is_fg, gc[gidx[idx]], 0)
+        pvar = jnp.broadcast_to(1.0 / weights, (P, 4))
+        enc = _encode_boxes(gb[gidx[idx]], rs[idx], pvar)
+        # scatter into per-class slots [P, 4*n_cls]
+        tgt = jnp.zeros((P, 4 * n_cls), jnp.float32)
+        inw = jnp.zeros((P, 4 * n_cls), jnp.float32)
+        col = jnp.maximum(labels, 0) * 4
+        rowi = jnp.arange(P)
+        for k in range(4):
+            tgt = tgt.at[rowi, col + k].set(
+                jnp.where(is_fg, enc[:, k], 0.0))
+            # all 4 coords of a fg sample's class slot weigh 1, even when
+            # an encoded coordinate is exactly 0.0 (ref _expand_bbox_targets)
+            inw = inw.at[rowi, col + k].set(is_fg.astype(jnp.float32))
+        return (rs[idx], labels.astype(jnp.int32), tgt, inw,
+                inw * ok[:, None].astype(jnp.float32))
+
+    class _K:
+        def __init__(self, key):
+            self.key = key
+
+    B = rois.shape[0]
+    if ctx and ctx.key is not None:
+        keys = jax.random.split(ctx.key, B)
+        outs = jax.vmap(one)(rois, gt_classes, gt_boxes, keys)
+    else:
+        outs = jax.vmap(lambda r, c, b: one(r, c, b, None))(
+            rois, gt_classes, gt_boxes)
+    r, l, t, iw, ow = outs
+    return {"Rois": [r], "LabelsInt32": [l], "BboxTargets": [t],
+            "BboxInsideWeights": [iw], "BboxOutsideWeights": [ow]}
+
+
+# ---------------------------------------------------------------------------
+# YOLOv3 / EAST / misc
+# ---------------------------------------------------------------------------
+@kernel("yolov3_loss")
+def _yolov3_loss(ctx, ins, attrs):
+    """ref detection/yolov3_loss_op.cc. x [B, A*(5+K), S, S]; gtbox
+    [B, G, 4] center-form (cx, cy, w, h) normalized to [0,1]; gtlabel
+    [B, G] (pad rows have w<=0). Losses: BCE on xy/conf/class, squared
+    error on wh, non-target conf ignored above ignore_thresh."""
+    x = ins["X"][0]
+    gtbox = ins["GTBox"][0]
+    gtlabel = ins["GTLabel"][0]
+    anchors = np.asarray(attrs["anchors"], np.float32).reshape(-1, 2)
+    K = attrs["class_num"]
+    ignore = attrs.get("ignore_thresh", 0.7)
+    B, _, S, _ = x.shape
+    A = anchors.shape[0]
+    an = jnp.asarray(anchors)                      # pixels of input scale
+    in_size = attrs.get("downsample_ratio", 32) * S
+    x = x.reshape(B, A, 5 + K, S, S)
+    tx, ty, tw, th = x[:, :, 0], x[:, :, 1], x[:, :, 2], x[:, :, 3]
+    tconf = x[:, :, 4]
+    tcls = x[:, :, 5:]
+    G = gtbox.shape[1]
+
+    def one(gb, gl, ptx, pty, ptw, pth, pconf, pcls):
+        # build targets by scanning over gt entries
+        obj = jnp.zeros((A, S, S))
+        tgt = jnp.zeros((6, A, S, S))              # x,y,w,h,cls, scale
+        def body(carry, g):
+            obj, tgt = carry
+            box, lab = g[:4], g[4].astype(jnp.int32)
+            valid = box[2] > 1e-6
+            gi = jnp.clip((box[0] * S).astype(jnp.int32), 0, S - 1)
+            gj = jnp.clip((box[1] * S).astype(jnp.int32), 0, S - 1)
+            # best anchor by wh IoU
+            gw, gh = box[2] * in_size, box[3] * in_size
+            inter = jnp.minimum(gw, an[:, 0]) * jnp.minimum(gh, an[:, 1])
+            iou = inter / (gw * gh + an[:, 0] * an[:, 1] - inter + 1e-9)
+            a = jnp.argmax(iou)
+            upd = valid.astype(jnp.float32)
+            obj = obj.at[a, gj, gi].max(upd)
+            vals = jnp.stack([
+                box[0] * S - gi, box[1] * S - gj,
+                jnp.log(jnp.maximum(gw / an[a, 0], 1e-9)),
+                jnp.log(jnp.maximum(gh / an[a, 1], 1e-9)),
+                lab.astype(jnp.float32),
+                2.0 - box[2] * box[3]])
+            old = tgt[:, a, gj, gi]
+            tgt = tgt.at[:, a, gj, gi].set(jnp.where(valid, vals, old))
+            return (obj, tgt), None
+        g = jnp.concatenate([gb, gl[:, None].astype(gb.dtype)], -1)
+        (obj, tgt), _ = jax.lax.scan(body, (obj, tgt), g)
+        scale = tgt[5]
+        bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        loss_xy = (obj * scale * (bce(ptx, tgt[0]) + bce(pty, tgt[1]))).sum()
+        loss_wh = (obj * scale * ((ptw - tgt[2]) ** 2 +
+                                  (pth - tgt[3]) ** 2) * 0.5).sum()
+        # conf: positives get 1; no-object cells whose DECODED box
+        # overlaps any gt above ignore_thresh are excluded (ref yolov3
+        # "ignore" semantics)
+        gx = jnp.arange(S, dtype=jnp.float32)[None, None, :]
+        gy = jnp.arange(S, dtype=jnp.float32)[None, :, None]
+        pbx = (jax.nn.sigmoid(ptx) + gx) / S
+        pby = (jax.nn.sigmoid(pty) + gy) / S
+        pbw = an[:, 0, None, None] * jnp.exp(jnp.minimum(ptw, 10.0)) / in_size
+        pbh = an[:, 1, None, None] * jnp.exp(jnp.minimum(pth, 10.0)) / in_size
+        p1 = jnp.stack([pbx - pbw / 2, pby - pbh / 2,
+                        pbx + pbw / 2, pby + pbh / 2], -1)   # [A,S,S,4]
+        gvalid = gb[:, 2] > 1e-6
+        g1 = jnp.stack([gb[:, 0] - gb[:, 2] / 2, gb[:, 1] - gb[:, 3] / 2,
+                        gb[:, 0] + gb[:, 2] / 2, gb[:, 1] + gb[:, 3] / 2], -1)
+        iou_pg = _iou_matrix(p1.reshape(-1, 4), g1)          # [ASS, G]
+        best_iou = jnp.max(jnp.where(gvalid[None, :], iou_pg, 0.0),
+                           axis=1).reshape(A, S, S)
+        noobj = (1.0 - obj) * (best_iou <= ignore)
+        loss_conf = (obj * bce(pconf, jnp.ones_like(pconf)) +
+                     noobj * bce(pconf, jnp.zeros_like(pconf))).sum()
+        onehot = jax.nn.one_hot(tgt[4].astype(jnp.int32), K,
+                                axis=0).transpose(1, 0, 2, 3)
+        loss_cls = (obj[:, None] * bce(pcls, onehot)).sum()
+        return loss_xy + loss_wh + loss_conf + loss_cls
+
+    loss = jax.vmap(one)(gtbox, gtlabel, tx, ty, tw, th, tconf, tcls)
+    return {"Loss": [loss]}
+
+
+@kernel("polygon_box_transform")
+def _polygon_box_transform(ctx, ins, attrs):
+    """ref detection/polygon_box_transform_op.cc (EAST geometry map):
+    even channels: out = 4*w_index - in; odd: out = 4*h_index - in."""
+    x = ins["Input"][0]
+    B, C, H, W = x.shape
+    wi = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    hi = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+    return {"Output": [jnp.where(even, 4 * wi - x, 4 * hi - x)]}
+
+
+@kernel("roi_perspective_transform")
+def _roi_perspective_transform(ctx, ins, attrs):
+    """ref detection/roi_perspective_transform_op.cc: warp a quadrilateral
+    roi ([R, 8] corner coords, clockwise from top-left; [R, 9] with a
+    leading batch index) to a [th, tw] rectangle via its homography."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    th = attrs["transformed_height"]
+    tw = attrs["transformed_width"]
+    scale = attrs.get("spatial_scale", 1.0)
+    if rois.shape[-1] == 9:
+        bidx, quad = rois[:, 0].astype(jnp.int32), rois[:, 1:] * scale
+    else:
+        bidx, quad = jnp.zeros((rois.shape[0],), jnp.int32), rois * scale
+
+    # destination rectangle corners
+    dst = jnp.asarray([[0.0, 0.0], [tw - 1, 0.0],
+                       [tw - 1, th - 1], [0.0, th - 1]], jnp.float32)
+
+    def homography(q):
+        src = q.reshape(4, 2)
+        rows = []
+        for k in range(4):
+            X, Y = dst[k, 0], dst[k, 1]
+            u, v = src[k, 0], src[k, 1]
+            rows.append(jnp.stack([X, Y, 1., 0., 0., 0., -u * X, -u * Y]))
+            rows.append(jnp.stack([0., 0., 0., X, Y, 1., -v * X, -v * Y]))
+        Amat = jnp.stack(rows)
+        bvec = src.reshape(-1)
+        h = jnp.linalg.solve(Amat + 1e-6 * jnp.eye(8), bvec)
+        return jnp.concatenate([h, jnp.ones(1)]).reshape(3, 3)
+
+    ys, xs = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                          jnp.arange(tw, dtype=jnp.float32), indexing="ij")
+    grid = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)      # [th, tw, 3]
+
+    def one(b, q):
+        Hm = homography(q)
+        uvw = grid @ Hm.T
+        u = uvw[..., 0] / (uvw[..., 2] + 1e-9)
+        v = uvw[..., 1] / (uvw[..., 2] + 1e-9)
+        vals = _bilinear_at(x[b], v, u)
+        Hin, Win = x.shape[2], x.shape[3]
+        inside = ((u >= 0) & (u <= Win - 1) & (v >= 0) & (v <= Hin - 1))
+        return jnp.where(inside[None], vals, 0.0)
+
+    return {"Out": [jax.vmap(one)(bidx, quad)]}
+
+
+def _np_detection_map(detect, gt, class_num, overlap_threshold,
+                      evaluate_difficult, ap_version):
+    """Host mAP (VOC): detect [B, K, 6] (label, score, x1..y2; label<0 pad),
+    gt [B, G, 6] (label, difficult, x1..y2; label<0 pad)."""
+    def iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = max(0.0, (a[2] - a[0])) * max(0.0, (a[3] - a[1])) + \
+            max(0.0, (b[2] - b[0])) * max(0.0, (b[3] - b[1])) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    aps = []
+    for c in range(class_num):
+        dets = []     # (score, img, box)
+        npos = 0
+        gts = {}
+        for b in range(gt.shape[0]):
+            rows = [g for g in gt[b] if int(g[0]) == c]
+            keep = [g for g in rows
+                    if evaluate_difficult or g[1] < 0.5]
+            npos += len(keep)
+            gts[b] = [(g[2:6], g[1] >= 0.5, [False]) for g in rows]
+            for d in detect[b]:
+                if int(d[0]) == c and d[1] > -1:
+                    dets.append((float(d[1]), b, d[2:6]))
+        if npos == 0:
+            continue
+        dets.sort(key=lambda t: -t[0])
+        tp, fp = [], []
+        for score, b, box in dets:
+            best, bi = 0.0, -1
+            for i, (gbox, diff, used) in enumerate(gts.get(b, [])):
+                ov = iou(box, gbox)
+                if ov > best:
+                    best, bi = ov, i
+            if best >= overlap_threshold and bi >= 0:
+                gbox, diff, used = gts[b][bi]
+                if diff and not evaluate_difficult:
+                    continue
+                if not used[0]:
+                    used[0] = True
+                    tp.append(1.0); fp.append(0.0)
+                else:
+                    tp.append(0.0); fp.append(1.0)
+            else:
+                tp.append(0.0); fp.append(1.0)
+        tp = np.cumsum(tp); fp = np.cumsum(fp)
+        rec = tp / npos
+        prec = tp / np.maximum(tp + fp, 1e-9)
+        if ap_version == "11point":
+            ap = 0.0
+            for t in np.arange(0.0, 1.1, 0.1):
+                p = prec[rec >= t].max() if np.any(rec >= t) else 0.0
+                ap += p / 11.0
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for r, p in zip(rec, prec):
+                ap += (r - prev_r) * p
+                prev_r = r
+        aps.append(ap)
+    return np.float32(np.mean(aps) if aps else 0.0)
+
+
+@kernel("detection_map")
+def _detection_map(ctx, ins, attrs):
+    """ref detection_map_op.cc — mAP is a host-side metric (no gradient),
+    so it runs through pure_callback on padded fixed-size inputs."""
+    detect = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    fn = lambda d, g: _np_detection_map(
+        np.asarray(d), np.asarray(g), attrs["class_num"],
+        attrs.get("overlap_threshold", 0.3),
+        attrs.get("evaluate_difficult", True),
+        attrs.get("ap_version", "integral"))
+    out = jax.pure_callback(fn, jax.ShapeDtypeStruct((), np.float32),
+                            detect, gt)
+    return {"MAP": [out]}

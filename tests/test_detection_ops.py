@@ -1,0 +1,326 @@
+"""Detection suite (ref tests/unittests/test_{roi_pool,roi_align,
+bipartite_match,target_assign,ssd_loss,anchor_generator,
+generate_proposals,polygon_box_transform,yolov3_loss,detection_map}_op.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(fetch, feed=None):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return exe.run(pt.default_main_program(), feed=feed or {},
+                   fetch_list=fetch)
+
+
+def test_roi_align_matches_numpy_bilinear():
+    B, C, H, W = 1, 2, 8, 8
+    x = layers.data("x", shape=[B, C, H, W], dtype="float32",
+                    append_batch_size=False)
+    rois_np = np.array([[0, 1.0, 1.0, 5.0, 5.0]], "float32")
+    rois = layers.data("rois", shape=[1, 5], dtype="float32",
+                       append_batch_size=False)
+    out = layers.roi_align(x, rois, pooled_height=2, pooled_width=2,
+                           spatial_scale=1.0, sampling_ratio=2)
+    xv = np.random.RandomState(0).randn(B, C, H, W).astype("float32")
+    res, = _run([out], feed={"x": xv, "rois": rois_np})
+
+    # independent numpy reference
+    def bilinear(img, y, xq):
+        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        y0, x0 = max(y0, 0), max(x0, 0)
+        wy, wx = y - np.floor(y), xq - np.floor(xq)
+        return (img[y0, x0] * (1 - wy) * (1 - wx) + img[y0, x1] * (1 - wy) * wx
+                + img[y1, x0] * wy * (1 - wx) + img[y1, x1] * wy * wx)
+
+    x1, y1, x2, y2 = rois_np[0, 1:]
+    rh, rw = y2 - y1, x2 - x1
+    want = np.zeros((C, 2, 2), "float32")
+    for c in range(C):
+        for i in range(2):
+            for j in range(2):
+                acc = 0.0
+                for si in range(2):
+                    for sj in range(2):
+                        yy = y1 + (i + (si + 0.5) / 2) * rh / 2
+                        xx = x1 + (j + (sj + 0.5) / 2) * rw / 2
+                        acc += bilinear(xv[0, c], yy, xx)
+                want[c, i, j] = acc / 4
+    np.testing.assert_allclose(res[0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pool_exact_on_aligned_rois():
+    x = layers.data("x", shape=[1, 1, 8, 8], dtype="float32",
+                    append_batch_size=False)
+    rois = layers.data("rois", shape=[1, 5], dtype="float32",
+                       append_batch_size=False)
+    out = layers.roi_pool(x, rois, pooled_height=2, pooled_width=2)
+    xv = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    # roi covering rows/cols 0..3 → 4x4 region, 2x2 bins of 2x2 each
+    res, = _run([out], feed={"x": xv,
+                             "rois": np.array([[0, 0, 0, 3, 3]], "float32")})
+    want = np.array([[[9., 11.], [25., 27.]]], "float32")
+    np.testing.assert_allclose(res[0], want)
+
+
+def test_psroi_pool_uniform():
+    # position-sensitive: with channel c = constant c, out[c] = c map
+    ph = pw = 2
+    oc = 3
+    C = oc * ph * pw
+    x = layers.data("x", shape=[1, C, 6, 6], dtype="float32",
+                    append_batch_size=False)
+    rois = layers.data("rois", shape=[1, 5], dtype="float32",
+                       append_batch_size=False)
+    out = layers.psroi_pool(x, rois, output_channels=oc, spatial_scale=1.0,
+                            pooled_height=ph, pooled_width=pw)
+    xv = np.zeros((1, C, 6, 6), "float32")
+    for c in range(C):
+        xv[0, c] = c
+    res, = _run([out], feed={"x": xv,
+                             "rois": np.array([[0, 0, 0, 5, 5]], "float32")})
+    want = np.zeros((oc, ph, pw), "float32")
+    for c in range(oc):
+        for i in range(ph):
+            for j in range(pw):
+                want[c, i, j] = c * ph * pw + i * pw + j
+    np.testing.assert_allclose(res[0], want)
+
+
+def test_bipartite_match_greedy():
+    dist = layers.data("d", shape=[2, 3], dtype="float32",
+                       append_batch_size=False)
+    match, mdist = layers.bipartite_match(dist)
+    dv = np.array([[0.9, 0.1, 0.6],
+                   [0.8, 0.7, 0.2]], "float32")
+    m, md = _run([match, mdist], feed={"d": dv})
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; col2 unmatched
+    assert list(m[0]) == [0, 1, -1]
+    np.testing.assert_allclose(md[0], [0.9, 0.7, 0.0])
+
+
+def test_bipartite_match_per_prediction():
+    dist = layers.data("d", shape=[2, 3], dtype="float32",
+                       append_batch_size=False)
+    match, _ = layers.bipartite_match(dist, match_type="per_prediction",
+                                      dist_threshold=0.5)
+    dv = np.array([[0.9, 0.1, 0.6],
+                   [0.8, 0.7, 0.2]], "float32")
+    m, = _run([match], feed={"d": dv})
+    # col2's best row is 0 with 0.6 >= 0.5 → matched to row 0
+    assert list(m[0]) == [0, 1, 0]
+
+
+def test_target_assign():
+    x = layers.data("x", shape=[1, 2, 4], dtype="float32",
+                    append_batch_size=False)
+    mi = layers.data("mi", shape=[1, 3], dtype="int32",
+                     append_batch_size=False)
+    out, w = layers.target_assign(x, mi, mismatch_value=0)
+    xv = np.array([[[1, 1, 1, 1], [2, 2, 2, 2]]], "float32")
+    miv = np.array([[1, -1, 0]], "int32")
+    o, wv = _run([out, w], feed={"x": xv, "mi": miv})
+    np.testing.assert_allclose(o[0], [[2, 2, 2, 2], [0, 0, 0, 0],
+                                      [1, 1, 1, 1]])
+    np.testing.assert_allclose(wv[0][:, 0], [1, 0, 1])
+
+
+def test_ssd_loss_decreases_with_good_predictions():
+    M, C, G = 8, 3, 2
+    prior = layers.data("prior", shape=[M, 4], dtype="float32",
+                        append_batch_size=False)
+    loc = layers.data("loc", shape=[1, M, 4], dtype="float32",
+                      append_batch_size=False)
+    conf = layers.data("conf", shape=[1, M, C], dtype="float32",
+                       append_batch_size=False)
+    gtb = layers.data("gtb", shape=[1, G, 4], dtype="float32",
+                      append_batch_size=False)
+    gtl = layers.data("gtl", shape=[1, G], dtype="int32",
+                      append_batch_size=False)
+    loss = layers.reduce_sum(layers.ssd_loss(loc, conf, gtb, gtl, prior))
+    priors = np.stack([np.linspace(0, 0.8, M), np.linspace(0, 0.8, M),
+                       np.linspace(0.2, 1.0, M), np.linspace(0.2, 1.0, M)],
+                      -1).astype("float32")
+    # gt boxes equal priors 0 and 5 exactly → those two priors match
+    gt = priors[None, [0, 5]].copy()
+    gl = np.array([[1, 2]], "int32")
+    # bad: confidently the WRONG class everywhere
+    bad_conf = np.full((1, M, C), -4.0, "float32")
+    bad_conf[..., 1] = 4.0
+    bad_conf[0, 0, 1], bad_conf[0, 0, 0] = -4.0, 4.0   # wrong on matched too
+    bad_conf[0, 5, 2], bad_conf[0, 5, 0] = -4.0, 4.0
+    # good: background everywhere except the matched priors' true class
+    good_conf = np.full((1, M, C), -4.0, "float32")
+    good_conf[..., 0] = 4.0
+    good_conf[0, 0, 0], good_conf[0, 0, 1] = -4.0, 4.0
+    good_conf[0, 5, 0], good_conf[0, 5, 2] = -4.0, 4.0
+    feed = {"prior": priors, "loc": np.zeros((1, M, 4), "float32"),
+            "gtb": gt, "gtl": gl}
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    l_bad, = exe.run(feed={**feed, "conf": bad_conf}, fetch_list=[loss])
+    l_good, = exe.run(feed={**feed, "conf": good_conf}, fetch_list=[loss])
+    assert np.isfinite(l_bad) and np.isfinite(l_good)
+    assert l_good < l_bad
+
+
+def test_anchor_generator_shapes_and_values():
+    x = layers.data("x", shape=[1, 8, 4, 4], dtype="float32",
+                    append_batch_size=False)
+    anchors, var = layers.anchor_generator(
+        x, anchor_sizes=[32.0], aspect_ratios=[1.0], stride=[16.0, 16.0])
+    a, v = _run([anchors, var],
+                feed={"x": np.zeros((1, 8, 4, 4), "float32")})
+    assert a.shape == (4, 4, 1, 4)
+    # first cell center (8, 8) with 32x32 anchor → [-8, -8, 24, 24]
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24])
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_generate_proposals_runs():
+    A, H, W = 3, 4, 4
+    scores = layers.data("s", shape=[1, A, H, W], dtype="float32",
+                         append_batch_size=False)
+    deltas = layers.data("d", shape=[1, A * 4, H, W], dtype="float32",
+                         append_batch_size=False)
+    im_info = layers.data("im", shape=[1, 3], dtype="float32",
+                          append_batch_size=False)
+    anchors, var = layers.anchor_generator(
+        scores, anchor_sizes=[16.0], aspect_ratios=[0.5, 1.0, 2.0],
+        stride=[8.0, 8.0])
+    rois, probs = layers.generate_proposals(
+        scores, deltas, im_info, anchors, var, pre_nms_top_n=24,
+        post_nms_top_n=8, min_size=1.0)
+    rng = np.random.RandomState(0)
+    r, p = _run([rois, probs],
+                feed={"s": rng.randn(1, A, H, W).astype("float32"),
+                      "d": (rng.randn(1, A * 4, H, W) * 0.1).astype("float32"),
+                      "im": np.array([[32, 32, 1]], "float32")})
+    assert r.shape == (1, 8, 4) and p.shape == (1, 8, 1)
+    assert (r[:, :, 2] >= r[:, :, 0]).all()
+    assert np.isfinite(r).all()
+
+
+def test_rpn_target_assign_and_proposal_labels():
+    M, G, S = 16, 2, 8
+    pred = layers.data("pred", shape=[1, M, 4], dtype="float32",
+                       append_batch_size=False)
+    logit = layers.data("logit", shape=[1, M, 1], dtype="float32",
+                        append_batch_size=False)
+    anchors = layers.data("anchors", shape=[M, 4], dtype="float32",
+                          append_batch_size=False)
+    avar = layers.data("avar", shape=[M, 4], dtype="float32",
+                       append_batch_size=False)
+    gtb = layers.data("gtb", shape=[1, G, 4], dtype="float32",
+                      append_batch_size=False)
+    loc, score, lab, tgt, w = layers.rpn_target_assign(
+        pred, logit, anchors, avar, gtb, rpn_batch_size_per_im=S)
+    rng = np.random.RandomState(0)
+    anc = np.stack([np.linspace(0, 30, M), np.linspace(0, 30, M),
+                    np.linspace(4, 34, M), np.linspace(4, 34, M)],
+                   -1).astype("float32")
+    gt = np.array([[[0, 0, 4.2, 4.2], [20, 20, 24.5, 24.5]]], "float32")
+    o = _run([loc, score, lab, tgt, w],
+             feed={"pred": rng.randn(1, M, 4).astype("float32"),
+                   "logit": rng.randn(1, M, 1).astype("float32"),
+                   "anchors": anc, "avar": np.ones((M, 4), "float32"),
+                   "gtb": gt})
+    assert o[2].shape == (1, S)
+    assert set(np.unique(o[2])) <= {0, 1}
+    assert o[4].min() >= 0 and o[4].max() <= 1
+
+
+def test_yolov3_loss_finite_and_sensitive():
+    B, A, K, S = 1, 3, 4, 4
+    x = layers.data("x", shape=[B, A * (5 + K), S, S], dtype="float32",
+                    append_batch_size=False)
+    gtb = layers.data("gtb", shape=[B, 2, 4], dtype="float32",
+                      append_batch_size=False)
+    gtl = layers.data("gtl", shape=[B, 2], dtype="int32",
+                      append_batch_size=False)
+    loss = layers.yolov3_loss(x, gtb, gtl,
+                              anchors=[10, 13, 16, 30, 33, 23],
+                              class_num=K, ignore_thresh=0.7)
+    rng = np.random.RandomState(0)
+    gt = np.array([[[0.5, 0.5, 0.2, 0.3], [0, 0, 0, 0]]], "float32")
+    gl = np.array([[2, 0]], "int32")
+    l1, = _run([loss], feed={"x": rng.randn(B, A * (5 + K), S, S)
+                             .astype("float32") * 0.1,
+                             "gtb": gt, "gtl": gl})
+    assert np.isfinite(l1).all() and l1[0] > 0
+
+
+def test_polygon_box_transform():
+    x = layers.data("x", shape=[1, 2, 2, 3], dtype="float32",
+                    append_batch_size=False)
+    out = layers.polygon_box_transform(x)
+    xv = np.ones((1, 2, 2, 3), "float32")
+    res, = _run([out], feed={"x": xv})
+    # even channel: 4*w - 1 ; odd channel: 4*h - 1
+    np.testing.assert_allclose(res[0, 0], [[-1, 3, 7], [-1, 3, 7]])
+    np.testing.assert_allclose(res[0, 1], [[-1, -1, -1], [3, 3, 3]])
+
+
+def test_roi_perspective_transform_identity_rect():
+    H = W = 6
+    x = layers.data("x", shape=[1, 1, H, W], dtype="float32",
+                    append_batch_size=False)
+    rois = layers.data("rois", shape=[1, 8], dtype="float32",
+                       append_batch_size=False)
+    out = layers.roi_perspective_transform(x, rois, 4, 4)
+    xv = np.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+    # axis-aligned rect quad 0..3 → plain bilinear resize of that patch
+    quad = np.array([[0, 0, 3, 0, 3, 3, 0, 3]], "float32")
+    res, = _run([out], feed={"x": xv, "rois": quad})
+    assert res.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(res[0, 0, 0, 0], 0.0, atol=1e-3)
+    np.testing.assert_allclose(res[0, 0, 3, 3], xv[0, 0, 3, 3], atol=1e-3)
+
+
+def test_detection_map_perfect_predictions():
+    det = layers.data("det", shape=[1, 4, 6], dtype="float32",
+                      append_batch_size=False)
+    gt = layers.data("gt", shape=[1, 2, 6], dtype="float32",
+                     append_batch_size=False)
+    m = layers.detection_map(det, gt, class_num=3, overlap_threshold=0.5)
+    gtv = np.array([[[1, 0, 0.1, 0.1, 0.4, 0.4],
+                     [2, 0, 0.5, 0.5, 0.9, 0.9]]], "float32")
+    detv = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                      [2, 0.8, 0.5, 0.5, 0.9, 0.9],
+                      [-1, -1, 0, 0, 0, 0],
+                      [-1, -1, 0, 0, 0, 0]]], "float32")
+    res, = _run([m], feed={"det": detv, "gt": gtv})
+    assert res == pytest.approx(1.0)
+
+
+def test_multi_box_head_shapes():
+    img = layers.data("img", shape=[1, 3, 32, 32], dtype="float32",
+                      append_batch_size=False)
+    f1 = layers.data("f1", shape=[1, 8, 8, 8], dtype="float32",
+                     append_batch_size=False)
+    f2 = layers.data("f2", shape=[1, 8, 4, 4], dtype="float32",
+                     append_batch_size=False)
+    locs, confs, boxes, vars_ = layers.multi_box_head(
+        [f1, f2], img, base_size=32, num_classes=5,
+        aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90)
+    rng = np.random.RandomState(0)
+    o = _run([locs, confs, boxes, vars_],
+             feed={"img": rng.randn(1, 3, 32, 32).astype("float32"),
+                   "f1": rng.randn(1, 8, 8, 8).astype("float32"),
+                   "f2": rng.randn(1, 8, 4, 4).astype("float32")})
+    n_priors = o[2].shape[0]
+    assert o[0].shape == (1, n_priors, 4)
+    assert o[1].shape == (1, n_priors, 5)
+    assert o[3].shape == (n_priors, 4)
+
+
+def test_image_resize_short():
+    x = layers.data("x", shape=[1, 1, 8, 4], dtype="float32",
+                    append_batch_size=False)
+    out = layers.image_resize_short(x, 2)
+    res, = _run([out], feed={"x": np.zeros((1, 1, 8, 4), "float32")})
+    assert res.shape == (1, 1, 4, 2)
