@@ -45,6 +45,26 @@ from . import reader
 from . import dataset
 from . import models
 from . import imperative
-from .trainer import Trainer
+from .trainer import Trainer, Inferencer, CheckpointConfig
+from . import average
+from .average import WeightedAverage
+from . import evaluator
+from . import lod_tensor
+from .lod_tensor import create_random_int_lodtensor
+from . import transpiler
+from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
+                         InferenceTranspiler, memory_optimize,
+                         release_memory, HashName, RoundRobin)
+from . import contrib
+from .async_executor import AsyncExecutor
+from .data_feed_desc import DataFeedDesc
+from . import default_scope_funcs
+from . import distribute_lookup_table
+from . import net_drawer
+from . import op
+from .core import EOFException
+
+# Tensor/LoDTensor aliases (ref fluid.Tensor is LoDTensor without LoD)
+Tensor = LoDTensor
 
 __version__ = "0.1.0"

@@ -1,0 +1,98 @@
+"""AsyncExecutor — file-driven training loop.
+
+Parity: python/paddle/fluid/async_executor.py. The reference spawns C++
+worker threads, each reading MultiSlot text files and running the
+program op-by-op. On TPU one XLA module serves all batches, so the async
+part is the INPUT side: reader threads parse files into a bounded queue
+(paddle_tpu.layers.io.PyReader machinery) while the device steps —
+host-side parallelism where it matters, one compiled program where it
+counts.
+
+MultiSlot text format (one sample per line, per slot:
+`<len> v1 ... vlen`), matching the reference's MultiSlotDataFeed.
+"""
+import numpy as np
+
+from .core.executor import Executor
+from .core.framework import default_main_program
+from .layers.io import PyReader, _register_reader
+from .core import EOFException
+
+__all__ = ["AsyncExecutor"]
+
+
+class AsyncExecutor:
+    def __init__(self, place=None, run_mode=""):
+        self.executor = Executor(place)
+
+    def _parse_file(self, path, data_feed):
+        """Yield per-sample tuples following the DataFeedDesc slots."""
+        used = [s for s in data_feed.slots if s.is_used]
+        with open(path) as f:
+            for line in f:
+                vals = line.split()
+                pos = 0
+                sample = []
+                for s in data_feed.slots:
+                    n = int(vals[pos]); pos += 1
+                    raw = vals[pos:pos + n]; pos += n
+                    if not s.is_used:
+                        continue
+                    dt = "int64" if "int" in s.type or s.type == "uint64" \
+                        else "float32"
+                    sample.append(np.asarray(raw, dtype=dt))
+                yield tuple(sample)
+
+    def run(self, program, data_feed, filelist, thread_num=1, fetch=None,
+            mode="", debug=False):
+        """ref async_executor.py:AsyncExecutor.run. Streams every file's
+        samples through the program in data_feed.batch_size batches;
+        returns the list of fetch results per batch when debug/fetch."""
+        program = program or default_main_program()
+        fetch = fetch or []
+        used = [s for s in data_feed.slots if s.is_used]
+        feed_vars = []
+        for s in used:
+            v = program.global_block().vars.get(s.name)
+            if v is None:
+                raise ValueError(f"program has no data var {s.name!r} "
+                                 "matching the DataFeedDesc slot")
+            feed_vars.append(v)
+        reader = PyReader(feed_vars, capacity=16)
+        _register_reader(reader, program)
+
+        def stack_ragged(col):
+            """Sparse slots carry per-sample variable lengths — pad to the
+            batch max (the LoD→padded convention everywhere else)."""
+            width = max(a.shape[0] for a in col)
+            if all(a.shape[0] == width for a in col):
+                return np.stack(col)
+            out = np.zeros((len(col), width), col[0].dtype)
+            for i, a in enumerate(col):
+                out[i, :a.shape[0]] = a
+            return out
+
+        def provider():
+            batch = []
+            for path in filelist:
+                for sample in self._parse_file(path, data_feed):
+                    batch.append(sample)
+                    if len(batch) == data_feed.batch_size:
+                        yield [stack_ragged(c) for c in zip(*batch)]
+                        batch = []
+            if batch:
+                yield [stack_ragged(c) for c in zip(*batch)]
+
+        reader._provider = provider
+        reader.start()
+        results = []
+        try:
+            while True:
+                out = self.executor.run(program, fetch_list=fetch)
+                if debug or fetch:
+                    results.append(out)
+        except EOFException:
+            pass
+        finally:
+            getattr(program, "_py_readers", []).remove(reader)
+        return results

@@ -1,0 +1,45 @@
+"""Python-side averages.
+
+Parity: python/paddle/fluid/average.py — WeightedAverage (pure host-side
+accumulator; deprecated in the reference in favor of fluid.metrics, kept
+for API parity).
+"""
+import warnings
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number(v):
+    return isinstance(v, (int, float)) or (
+        isinstance(v, np.ndarray) and v.shape == (1,))
+
+
+class WeightedAverage:
+    def __init__(self):
+        warnings.warn(
+            f"The {self.__class__.__name__} is deprecated, please use "
+            "fluid.metrics.Accuracy instead.", Warning)
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not (_is_number(value) or isinstance(value, np.ndarray)):
+            raise ValueError("'value' must be a number or numpy ndarray")
+        if not _is_number(weight):
+            raise ValueError("'weight' must be a number")
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator is None:
+            raise ValueError("eval() before any add()")
+        return self.numerator / self.denominator

@@ -1,0 +1,17 @@
+"""Contrib package.
+
+Parity: python/paddle/fluid/contrib — quantize (QAT/PTQ transpiler),
+decoder (beam-search decoder API), slim (model compression: magnitude
+pruning), memory_usage_calc, op_frequence, reader, utils.
+"""
+from . import quantize
+from . import decoder
+from . import slim
+from . import reader
+from . import utils
+from .memory_usage_calc import memory_usage
+from .op_frequence import op_freq_statistic
+from ..trainer import Trainer, Inferencer  # ref contrib re-exports
+
+__all__ = ["quantize", "decoder", "slim", "reader", "utils",
+           "memory_usage", "op_freq_statistic", "Trainer", "Inferencer"]

@@ -1,0 +1,203 @@
+"""Seq2seq decoder API.
+
+Parity: python/paddle/fluid/contrib/decoder/beam_search_decoder.py —
+InitState / StateCell / TrainingDecoder / BeamSearchDecoder.
+
+The reference drives decoding with host-interpreted While blocks over
+LoDTensorArrays. Here:
+- TrainingDecoder lowers to ONE lax.scan over the target sequence
+  (via layers.DynamicRNN — teacher forcing, masked for padding)
+- BeamSearchDecoder lowers to a scan over decode steps where each step
+  calls the user's state updater + scoring function and the beam_search
+  op keeps the top-k hypotheses (static [B, beam] shapes; finished beams
+  hold end_id)
+"""
+import numpy as np
+
+from ... import layers
+from ...layer_helper import LayerHelper
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class _DecoderType:
+    TRAINING = 1
+    BEAM_SEARCH = 2
+
+
+class InitState:
+    """ref InitState: initial RNN state, from a var or (shape, value)."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError("init_boot must be provided to infer the init "
+                             "state batch size")
+        else:
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype)
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """ref StateCell: named states + inputs with a registered updater.
+
+    The updater is a plain function of the cell; inside it use
+    get_input/get_state/set_state. compute_state() runs it functionally —
+    no hidden program mutation, so the same cell drives both the training
+    scan and the beam-search scan."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self.helper = LayerHelper("state_cell", name=name)
+        self._inputs = dict(inputs)
+        self._init_states = dict(states)
+        self._cur_states = {n: s.value for n, s in states.items()}
+        self._out_state = out_state
+        self._state_updater = None
+
+    def state_updater(self, updater):
+        self._state_updater = updater
+
+        def _decorator(cell):
+            return updater(cell)
+        return _decorator
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs:
+            raise ValueError(f"input {input_name!r} not found")
+        v = self._inputs[input_name]
+        if v is None:
+            raise ValueError(f"input {input_name!r} not set for this step")
+        return v
+
+    def get_state(self, state_name):
+        return self._cur_states[state_name]
+
+    def set_state(self, state_name, state_value):
+        self._cur_states[state_name] = state_value
+
+    def compute_state(self, inputs):
+        for k, v in inputs.items():
+            self._inputs[k] = v
+        self._state_updater(self)
+
+    def update_states(self):
+        # states already updated functionally in set_state
+        pass
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+    def set_states(self, values):
+        self._cur_states = dict(values)
+
+    def states(self):
+        return dict(self._cur_states)
+
+
+class TrainingDecoder:
+    """ref TrainingDecoder: teacher-forced decoding as one scan."""
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None, seq_len=None):
+        self.state_cell = state_cell
+        self._status = self.BEFORE_DECODER
+        self._drnn = layers.DynamicRNN(seq_len=seq_len, name=name)
+        self._state_prev = {}
+
+    def block(self):
+        outer = self._drnn.block()
+        dec = self
+
+        class _G:
+            def __enter__(g):
+                outer.__enter__()
+                dec._status = dec.IN_DECODER
+                # expose states as RNN memories
+                dec._state_prev = {}
+                for n, st in dec.state_cell._init_states.items():
+                    prev = dec._drnn.memory(init=st.value)
+                    dec._state_prev[n] = prev
+                    dec.state_cell.set_state(n, prev)
+                return dec
+
+            def __exit__(g, et, ev, tb):
+                if et is None:
+                    for n, prev in dec._state_prev.items():
+                        dec._drnn.update_memory(
+                            prev, dec.state_cell.get_state(n))
+                dec._status = dec.AFTER_DECODER
+                return outer.__exit__(et, ev, tb)
+
+        return _G()
+
+    def step_input(self, x):
+        if self._status != self.IN_DECODER:
+            raise RuntimeError("step_input must be called in block()")
+        return self._drnn.step_input(x)
+
+    def static_input(self, x):
+        return x
+
+    def output(self, *outputs):
+        self._drnn.output(*outputs)
+
+    def __call__(self):
+        return self._drnn()
+
+
+class BeamSearchDecoder:
+    """ref BeamSearchDecoder. Functional TPU version: construct with the
+    pieces the reference gathers imperatively, then decode() runs the
+    whole beam search as one compiled loop.
+
+    step_fn(ids [B*beam], states {name: [B*beam, ...]})
+        -> (log_probs [B*beam, V], new_states)
+    """
+
+    def __init__(self, state_cell=None, init_ids=None, init_scores=None,
+                 target_dict_dim=None, word_dim=None, max_len=32,
+                 beam_size=4, end_id=1, name=None, step_fn=None):
+        self.state_cell = state_cell
+        self.init_ids = init_ids
+        self.max_len = max_len
+        self.beam_size = beam_size
+        self.end_id = end_id
+        self.target_dict_dim = target_dict_dim
+        self.step_fn = step_fn
+
+    def decode(self):
+        """Run beam search → (token ids [B, max_len, beam],
+        scores [B, beam]) via the beam_search_decode layer."""
+        if self.step_fn is None:
+            raise ValueError(
+                "BeamSearchDecoder needs step_fn(ids, states) -> "
+                "(log_probs, new_states); the reference's imperative "
+                "block() decoding is host-interpreted and cannot compile "
+                "to one XLA loop")
+        states = (self.state_cell.states() if self.state_cell is not None
+                  else {})
+        return layers.beam_search_loop(
+            self.init_ids, states, self.step_fn,
+            beam_size=self.beam_size, max_len=self.max_len,
+            end_id=self.end_id, vocab_size=self.target_dict_dim)
+
+    def __call__(self):
+        return self.decode()

@@ -1,0 +1,41 @@
+"""Estimate training memory usage.
+
+Parity: python/paddle/fluid/contrib/memory_usage_calc.py — sum variable
+bytes for a given batch size. The reference prices DESC-declared vars;
+here the same walk runs over the Program's blocks (persistables count
+once, batch-shaped activations scale with batch_size).
+"""
+from ..core.dtypes import dtype_size
+
+__all__ = ["memory_usage"]
+
+DEBUG = False
+
+
+def memory_usage(program, batch_size):
+    """Returns (min_usage, max_usage, unit_str) like the reference (the
+    spread covers XLA fusion reuse: best case only persistables +
+    fetches resident, worst case every declared var live at once)."""
+    if program is None:
+        raise ValueError("The program parameter can't be None.")
+    if not isinstance(batch_size, int) or batch_size <= 0:
+        raise ValueError("The batch_size must be a positive int.")
+    total = 0
+    persist = 0
+    seen = set()
+    for var in program.list_vars():
+        if var.name in seen:
+            continue
+        seen.add(var.name)
+        n = dtype_size(var.dtype)
+        for s in var.shape:
+            n *= batch_size if int(s) < 0 else max(int(s), 1)
+        total += n
+        if var.persistable:
+            persist += n
+    # ref reports a 0.7x..1.5x band around its estimate
+    low, high = persist, total
+    for unit, denom in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if high >= denom:
+            return low / denom, high / denom, unit
+    return float(low), float(high), "B"

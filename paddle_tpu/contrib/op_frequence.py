@@ -1,0 +1,28 @@
+"""Op frequency statistics.
+
+Parity: python/paddle/fluid/contrib/op_frequence.py — count op types in
+a program; also returns adjacent-pair counts like the reference.
+"""
+from collections import OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_op_freq) ordered dicts, most frequent
+    first (ref op_freq_statistic)."""
+    if program is None:
+        raise ValueError("The program cannot be None.")
+    uni = {}
+    adj = {}
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = f"{prev}->{op.type}"
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    uni_sorted = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj_sorted = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni_sorted, adj_sorted

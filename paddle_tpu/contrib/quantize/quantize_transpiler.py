@@ -1,0 +1,140 @@
+"""Quantization-aware training + freeze.
+
+Parity: python/paddle/fluid/contrib/quantize/quantize_transpiler.py.
+training_transpile inserts fake-quant ops on the inputs/weights of
+conv2d/depthwise_conv2d/mul ops (abs_max or range_abs_max scales). The
+reference also rewrites every backward grad op; here the backward is
+jax.grad of the traced forward, so the straight-through estimator in the
+fake-quant kernel handles QAT gradients with NO graph surgery.
+freeze_program converts weights to int8 + a dequantize op (PTQ deploy).
+"""
+import numpy as np
+
+from ...core.framework import Operator, default_main_program
+from ... import unique_name
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul")
+_IN_SLOTS = {"conv2d": ("Input", "Filter"),
+             "depthwise_conv2d": ("Input", "Filter"),
+             "mul": ("X", "Y")}
+
+
+def _quantized_var_name(name):
+    return f"{name}.quantized"
+
+
+def _scale_name(name):
+    return f"{name}.scale"
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        if activation_quantize_type not in ("abs_max", "range_abs_max"):
+            raise ValueError("unknown activation_quantize_type "
+                             f"{activation_quantize_type!r}")
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.window_size = window_size
+
+    # ------------------------------------------------------------------
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake-quant ops ahead of every quantizable op (QAT)."""
+        program = program or default_main_program()
+        block = program.global_block()
+        params = {p.name for p in program.all_parameters()}
+        quantized = {}          # original name -> quantized name
+        new_ops = []
+        for op in block.ops:
+            if op.type in _QUANTIZABLE:
+                for slot in _IN_SLOTS[op.type]:
+                    name = op.inputs[slot][0]
+                    if name not in quantized:
+                        is_w = name in params
+                        qname = self._insert_fake_quant(
+                            block, new_ops, name, is_w)
+                        quantized[name] = qname
+                    op.inputs[slot] = [quantized[name]]
+            new_ops.append(op)
+        block.ops[:] = new_ops
+        program._bump_version()
+        return program
+
+    def _insert_fake_quant(self, block, new_ops, name, is_weight):
+        src = block.vars.get(name)
+        qname = _quantized_var_name(name)
+        block.create_var(name=qname,
+                         shape=src.shape if src is not None else (),
+                         dtype=src.dtype if src is not None else "float32")
+        sname = _scale_name(name)
+        block.create_var(name=sname, shape=(1,), dtype="float32",
+                         persistable=self.activation_quantize_type
+                         == "range_abs_max" and not is_weight)
+        bits = self.weight_bits if is_weight else self.activation_bits
+        qtype = ("abs_max" if is_weight
+                 else self.activation_quantize_type)
+        if qtype == "abs_max":
+            op = Operator(block, "fake_quantize_abs_max",
+                          {"X": [name]},
+                          {"Out": [qname], "OutScale": [sname]},
+                          {"bit_length": bits})
+        else:
+            op = Operator(block, "fake_quantize_range_abs_max",
+                          {"X": [name], "InScale": [sname]},
+                          {"Out": [qname], "OutScale": [sname]},
+                          {"bit_length": bits,
+                           "window_size": self.window_size})
+            # range scale needs an initial value
+            from ...core.scope import global_scope
+            import jax.numpy as jnp
+            if global_scope().get(sname) is None:
+                global_scope().set(sname, jnp.ones((1,), jnp.float32))
+        new_ops.append(op)
+        return qname
+
+    # ------------------------------------------------------------------
+    def freeze_program(self, program, place=None, fuse_bn=False, scope=None):
+        """Deploy-time rewrite: weights become int8 arrays + a dequantize
+        op; weight fake-quant ops are removed (ref freeze_program)."""
+        from ...core.scope import global_scope
+        import jax.numpy as jnp
+        scope = scope or global_scope()
+        block = program.global_block()
+        params = {p.name for p in program.all_parameters()}
+        rng = float(2 ** (self.weight_bits - 1) - 1)
+        new_ops = []
+        for op in block.ops:
+            if op.type.startswith("fake_quantize") and \
+                    op.inputs["X"][0] in params:
+                wname = op.inputs["X"][0]
+                qname = op.outputs["Out"][0]
+                w = np.asarray(scope.get(wname))
+                scale = float(np.max(np.abs(w))) + 1e-9
+                w_int8 = np.clip(np.round(w / scale * rng),
+                                 -rng - 1, rng).astype(np.int8)
+                iname = f"{wname}.int8"
+                sname = f"{wname}.int8_scale"   # distinct from the QAT
+                # OutScale var, which is a per-step non-persistable temp
+                block.create_var(name=iname, shape=w.shape, dtype="int8",
+                                 persistable=True)
+                block.create_var(name=sname, shape=(1,), dtype="float32",
+                                 persistable=True)
+                scope.set(iname, jnp.asarray(w_int8))
+                scope.set(sname, jnp.asarray([scale], jnp.float32))
+                new_ops.append(Operator(
+                    block, "dequantize_abs_max",
+                    {"X": [iname], "Scale": [sname]}, {"Out": [qname]},
+                    {"bit_length": self.weight_bits}))
+            else:
+                new_ops.append(op)
+        block.ops[:] = new_ops
+        program._bump_version()
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        return self.freeze_program(program, place, scope=scope)

@@ -1,0 +1,3 @@
+from .ctr_reader import ctr_reader
+
+__all__ = ["ctr_reader"]

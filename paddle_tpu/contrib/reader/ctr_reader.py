@@ -1,0 +1,65 @@
+"""CTR data reader.
+
+Parity: python/paddle/fluid/contrib/reader/ctr_reader.py — the reference
+spawns a C++ ctr_reader reading svm-format CTR logs into a queue. Here
+the same file format feeds a layers.io.PyReader (host thread + bounded
+queue; the device pipeline is identical to py_reader's).
+
+File format (one sample per line):
+    <label> <slot_id>:<feature_sign> <slot_id>:<feature_sign> ...
+"""
+import numpy as np
+
+from ...layers.io import PyReader, _register_reader
+
+__all__ = ["ctr_reader"]
+
+
+def ctr_reader(feed_dict, capacity, thread_num, batch_size, file_list,
+               slots, name=None):
+    """Build a PyReader streaming CTR files. feed_dict: list of data
+    variables, one label var + one var per slot (int64 ids, padded to the
+    var's last static dim or batch-major variable length)."""
+    reader = PyReader(feed_dict, capacity)
+
+    def parse_line(line):
+        parts = line.split()
+        label = int(parts[0])
+        per_slot = {int(s): [] for s in slots}
+        for tok in parts[1:]:
+            sid, sign = tok.split(":")
+            sid = int(sid)
+            if sid in per_slot:
+                per_slot[sid].append(int(sign))
+        return label, per_slot
+
+    def provider():
+        batch = []
+        for path in file_list:
+            with open(path) as f:
+                for line in f:
+                    if line.strip():
+                        batch.append(parse_line(line))
+                    if len(batch) == batch_size:
+                        yield _to_arrays(batch)
+                        batch = []
+        if batch:
+            yield _to_arrays(batch)
+
+    def _to_arrays(batch):
+        labels = np.asarray([[b[0]] for b in batch], np.int64)
+        outs = [labels]
+        for i, sid in enumerate(slots):
+            width = max(max((len(b[1][int(sid)]) for b in batch)), 1)
+            var = feed_dict[i + 1]
+            if len(var.shape) >= 2 and int(var.shape[-1]) > 0:
+                width = int(var.shape[-1])
+            arr = np.zeros((len(batch), width), np.int64)
+            for r, b in enumerate(batch):
+                ids = b[1][int(sid)][:width]
+                arr[r, :len(ids)] = ids
+            outs.append(arr)
+        return outs
+
+    reader._provider = provider
+    return _register_reader(reader)

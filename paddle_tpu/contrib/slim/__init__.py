@@ -1,0 +1,12 @@
+"""Model compression (slim).
+
+Parity: python/paddle/fluid/contrib/slim — the reference ships a
+Compressor framework with graph wrappers and a magnitude Pruner
+(slim/prune/pruner.py). The TPU port keeps the two load-bearing pieces:
+- Pruner / MagnitudePruner: mask the smallest-|w| fraction of each
+  parameter (in scope, so the pruned program keeps training with XLA)
+- SensitivePruneStrategy-style helper: per-parameter ratios
+"""
+from .prune import Pruner, MagnitudePruner, prune_program
+
+__all__ = ["Pruner", "MagnitudePruner", "prune_program"]

@@ -1,0 +1,60 @@
+"""Magnitude pruning.
+
+Parity: python/paddle/fluid/contrib/slim/prune/pruner.py (RatioPruner /
+MagnitudePruner): zero the smallest-|w| entries of each parameter at a
+given sparsity ratio. Masks are applied to the scope values; a pruned
+parameter stays pruned through training if apply() is called after each
+update (or use the returned masks with layers.elementwise_mul).
+"""
+import numpy as np
+
+__all__ = ["Pruner", "MagnitudePruner", "prune_program"]
+
+
+class Pruner:
+    """Base pruner (ref slim/prune/pruner.py:Pruner)."""
+
+    def prune(self, param_array, ratio):
+        raise NotImplementedError
+
+
+class MagnitudePruner(Pruner):
+    """Zero the `ratio` fraction of smallest-magnitude entries."""
+
+    def __init__(self, threshold=None):
+        self.threshold = threshold
+
+    def prune(self, param_array, ratio=None):
+        w = np.asarray(param_array)
+        if self.threshold is not None:
+            mask = (np.abs(w) >= self.threshold)
+        else:
+            k = int(w.size * float(ratio))
+            if k <= 0:
+                return w, np.ones_like(w, dtype=bool)
+            thresh = np.partition(np.abs(w).reshape(-1), k - 1)[k - 1]
+            mask = np.abs(w) > thresh
+        return w * mask, mask
+
+
+def prune_program(program, ratios, scope=None, pruner=None):
+    """Prune named parameters of `program` in `scope`.
+
+    ratios: {param_name: sparsity_ratio} or a single float for all
+    parameters. Returns {param_name: mask ndarray}.
+    """
+    from ...core.scope import global_scope
+    import jax.numpy as jnp
+    scope = scope or global_scope()
+    pruner = pruner or MagnitudePruner()
+    if isinstance(ratios, float):
+        ratios = {p.name: ratios for p in program.all_parameters()}
+    masks = {}
+    for name, ratio in ratios.items():
+        val = scope.get(name)
+        if val is None:
+            raise ValueError(f"parameter {name!r} not initialized in scope")
+        pruned, mask = pruner.prune(val, ratio)
+        scope.set(name, jnp.asarray(pruned, dtype=str(np.asarray(val).dtype)))
+        masks[name] = mask
+    return masks

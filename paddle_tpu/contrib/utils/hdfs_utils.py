@@ -1,0 +1,77 @@
+"""HDFS helpers.
+
+Parity: python/paddle/fluid/contrib/utils/hdfs_utils.py — the reference
+shells out to a `hadoop fs` binary. This environment has no Hadoop
+client and no network egress, so the API is kept (HDFSClient with the
+same methods) and raises a clear error when invoked without a usable
+`hadoop` binary on PATH.
+"""
+import shutil
+import subprocess
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+
+class HDFSClient:
+    def __init__(self, hadoop_home=None, configs=None):
+        self.hadoop_home = hadoop_home
+        self.configs = configs or {}
+        self._bin = shutil.which("hadoop")
+
+    def _run(self, *args):
+        if self._bin is None:
+            raise RuntimeError(
+                "hadoop binary not found on PATH — HDFS access is "
+                "unavailable in this environment (API kept for parity)")
+        cmd = [self._bin, "fs"] + list(args)
+        return subprocess.run(cmd, capture_output=True, text=True)
+
+    def is_exist(self, hdfs_path):
+        return self._run("-test", "-e", hdfs_path).returncode == 0
+
+    def is_dir(self, hdfs_path):
+        return self._run("-test", "-d", hdfs_path).returncode == 0
+
+    def delete(self, hdfs_path):
+        return self._run("-rm", "-r", hdfs_path).returncode == 0
+
+    def rename(self, src, dst):
+        return self._run("-mv", src, dst).returncode == 0
+
+    def makedirs(self, hdfs_path):
+        return self._run("-mkdir", "-p", hdfs_path).returncode == 0
+
+    def ls(self, hdfs_path):
+        out = self._run("-ls", hdfs_path)
+        return [l.split()[-1] for l in out.stdout.splitlines()[1:]]
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        args = ["-put"] + (["-f"] if overwrite else []) + \
+            [local_path, hdfs_path]
+        return self._run(*args).returncode == 0
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        return self._run("-get", hdfs_path, local_path).returncode == 0
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """Download this trainer's shard of files (round-robin split)."""
+    files = client.ls(hdfs_path)
+    mine = files[trainer_id::trainers]
+    for f in mine:
+        client.download(f, local_path)
+    return mine
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False):
+    import os
+    uploaded = []
+    for root, _, names in os.walk(local_path):
+        for n in names:
+            p = os.path.join(root, n)
+            client.upload(hdfs_path, p, overwrite)
+            uploaded.append(p)
+    return uploaded

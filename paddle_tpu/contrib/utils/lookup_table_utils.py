@@ -1,0 +1,42 @@
+"""Distributed lookup-table persistence helpers.
+
+Parity: python/paddle/fluid/contrib/utils/lookup_table_utils.py. The
+reference reloads pserver-partitioned embedding shards; on TPU the table
+lives whole (or mesh-sharded) in HBM, so these reduce to scoped
+save/load of the table plus the regular persistables.
+"""
+from ...distribute_lookup_table import find_distributed_lookup_table
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+
+def convert_dist_to_sparse_program(program):
+    """ref: rewrite distributed lookup_table ops back to local sparse
+    ones. The TPU table is already local to the mesh — clear the
+    is_distributed flag so the program runs single-host."""
+    table = find_distributed_lookup_table(program)
+    if table is not None:
+        for op in program.global_block().ops:
+            if op.type == "lookup_table" and op.inputs["W"][0] == table:
+                op.attrs["is_distributed"] = False
+        program._bump_version()
+    return program
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """Resume training: load persistables (including the table)."""
+    from ... import io as _io
+    _io.load_persistables(executor, dirname, program)
+    return program
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """Load an inference program's persistables (including the table)."""
+    from ... import io as _io
+    _io.load_persistables(executor, dirname, program)
+    return program

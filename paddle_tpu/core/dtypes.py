@@ -54,3 +54,12 @@ def is_float(dtype):
 
 def is_integer(dtype):
     return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")
+
+
+def dtype_size(dtype):
+    """Bytes per element for a framework dtype string."""
+    import numpy as np
+    d = convert_dtype(dtype)
+    if d == "bfloat16":
+        return 2
+    return np.dtype(d).itemsize

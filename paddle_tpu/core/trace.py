@@ -236,6 +236,11 @@ def build_step_fn(program, fetch_names, is_test, place):
                 loss = e[loss_name]
                 return jnp.sum(loss.astype(jnp.float32)), e
 
+            if getattr(program, "_remat", False):
+                # transpiler.memory_optimize: recompute forward activations
+                # in the backward pass instead of keeping them in HBM
+                fwd = jax.checkpoint(fwd)
+
             pvals = {n: env[n] for n in pnames}
             (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(pvals)
             for n in pnames:

@@ -1,0 +1,79 @@
+"""DataFeed descriptor.
+
+Parity: python/paddle/fluid/data_feed_desc.py — parse the reference's
+protobuf-text DataFeedDesc format (framework/data_feed.proto):
+
+    name: "MultiSlotDataFeed"
+    batch_size: 2
+    multi_slot_desc {
+        slots { name: "words" type: "uint64" is_dense: false is_used: true }
+        slots { name: "label" type: "uint64" is_dense: false is_used: true }
+    }
+
+A small hand parser replaces the protobuf dependency (same accepted
+surface: name/batch_size/multi_slot_desc.slots fields).
+"""
+import re
+
+__all__ = ["DataFeedDesc"]
+
+
+class _Slot:
+    def __init__(self):
+        self.name = None
+        self.type = "float32"
+        self.is_dense = False
+        self.is_used = True
+        self.shape = []
+
+
+class DataFeedDesc:
+    def __init__(self, proto_file):
+        with open(proto_file) as f:
+            text = f.read()
+        self.proto_desc_name = self._scalar(text, "name", "MultiSlotDataFeed")
+        self.batch_size = int(self._scalar(text, "batch_size", 1))
+        self.slots = []
+        self._slot_index = {}
+        for m in re.finditer(r"slots\s*\{(.*?)\}", text, re.S):
+            body = m.group(1)
+            s = _Slot()
+            s.name = self._scalar(body, "name", None)
+            s.type = self._scalar(body, "type", "float32").strip('"')
+            s.is_dense = self._scalar(body, "is_dense", "false") == "true"
+            s.is_used = self._scalar(body, "is_used", "true") == "true"
+            s.shape = [int(x) for x in re.findall(r"shape:\s*(-?\d+)", body)]
+            self.slots.append(s)
+            self._slot_index[s.name] = len(self.slots) - 1
+
+    @staticmethod
+    def _scalar(text, key, default):
+        m = re.search(rf"\b{key}\s*:\s*(\"[^\"]*\"|\S+)", text)
+        if not m:
+            return default
+        return m.group(1).strip('"')
+
+    # -- reference API -----------------------------------------------------
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name):
+        for name in dense_slots_name:
+            self.slots[self._slot_index[name]].is_dense = True
+
+    def set_use_slots(self, use_slots_name):
+        for s in self.slots:
+            s.is_used = False
+        for name in use_slots_name:
+            self.slots[self._slot_index[name]].is_used = True
+
+    def desc(self):
+        lines = [f'name: "{self.proto_desc_name}"',
+                 f"batch_size: {self.batch_size}", "multi_slot_desc {"]
+        for s in self.slots:
+            lines.append(
+                f'  slots {{ name: "{s.name}" type: "{s.type}" '
+                f"is_dense: {str(s.is_dense).lower()} "
+                f"is_used: {str(s.is_used).lower()} }}")
+        lines.append("}")
+        return "\n".join(lines)

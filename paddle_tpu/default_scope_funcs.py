@@ -1,0 +1,56 @@
+"""Default scope function stack.
+
+Parity: python/paddle/fluid/default_scope_funcs.py — a thread-local
+stack of Scopes with enter/leave + scoped_function.
+"""
+import threading
+
+from .core.scope import Scope, global_scope
+
+__all__ = ["get_cur_scope", "enter_local_scope", "leave_local_scope",
+           "var", "find_var", "scoped_function"]
+
+_local = threading.local()
+
+
+def _stack():
+    if not hasattr(_local, "stack"):
+        _local.stack = [global_scope()]
+    return _local.stack
+
+
+def get_cur_scope():
+    return _stack()[-1]
+
+
+def enter_local_scope():
+    child = get_cur_scope().new_scope()
+    _stack().append(child)
+    return child
+
+
+def leave_local_scope():
+    stack = _stack()
+    if len(stack) > 1:
+        stack.pop()
+
+
+def var(name):
+    """Get or create a variable slot in the current scope."""
+    sc = get_cur_scope()
+    if sc.get(name) is None:
+        sc.set(name, None)
+    return sc.get(name)
+
+
+def find_var(name):
+    return get_cur_scope().get(name)
+
+
+def scoped_function(func):
+    """Run func inside a fresh local scope (ref scoped_function)."""
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

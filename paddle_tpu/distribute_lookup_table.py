@@ -1,0 +1,54 @@
+"""Distributed lookup-table discovery.
+
+Parity: python/paddle/fluid/distribute_lookup_table.py — find the single
+distributed embedding table in a program (used by the transpiler; on TPU
+the table is sharded over the mesh instead of pserver-partitioned, see
+parallel/transpiler.py).
+"""
+
+LOOKUP_TABLE_TYPE = "lookup_table"
+
+__all__ = ["find_distributed_lookup_table",
+           "find_distributed_lookup_table_inputs",
+           "find_distributed_lookup_table_outputs"]
+
+
+def find_distributed_lookup_table(program):
+    """Returns the table name or None; errors if several tables differ
+    (ref behavior: at most ONE distributed table per program), or if a
+    non-distributed lookup reads the same table — checked over ALL ops so
+    op order can't hide a violation."""
+    ops = [op for op in program.global_block().ops
+           if op.type == LOOKUP_TABLE_TYPE]
+    dist = {op.inputs["W"][0] for op in ops
+            if op.attrs.get("is_distributed")}
+    if not dist:
+        return None
+    if len(dist) > 1:
+        raise RuntimeError(
+            "all distributed lookup_table_ops should have only one table")
+    table_name = next(iter(dist))
+    for op in ops:
+        if op.inputs["W"][0] == table_name and \
+                not op.attrs.get("is_distributed"):
+            raise RuntimeError(
+                "lookup_table_ops on the same table must all be distributed")
+    return table_name
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    inputs = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and \
+                table_name == op.inputs["W"][0]:
+            inputs.extend(op.inputs["Ids"])
+    return inputs
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    outputs = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and \
+                table_name == op.inputs["W"][0]:
+            outputs.extend(op.outputs["Out"])
+    return outputs

@@ -1,0 +1,182 @@
+"""Program-state evaluators.
+
+Parity: python/paddle/fluid/evaluator.py — Evaluator base with
+persistable state variables updated by in-program ops, plus
+ChunkEvaluator, EditDistance and DetectionMAP. (The reference deprecates
+these in favor of fluid.metrics; both are provided.)
+
+TPU note: states are persistable scope variables updated inside the same
+compiled step (counter adds fuse into the train/eval module); reset()
+zeroes them through a tiny reset program exactly like the reference.
+"""
+import numpy as np
+
+from . import unique_name
+from .layer_helper import LayerHelper
+from .core.framework import Program, default_main_program, program_guard
+from . import layers
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+def _clone_var(var):
+    return var
+
+
+class Evaluator:
+    """ref evaluator.py:Evaluator — accumulate metric states over
+    mini-batches; reset()/eval() with an executor."""
+
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(reset_program):
+            for var in self.states:
+                g_var = reset_program.global_block().create_var(
+                    name=var.name, shape=var.shape, dtype=var.dtype,
+                    persistable=True)
+                layers.fill_constant(shape=var.shape, dtype=var.dtype,
+                                     value=0.0, out=g_var)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.main_program.global_block().create_var(
+            name="_".join([unique_name.generate(self.helper.name), suffix]),
+            persistable=True, dtype=dtype,
+            shape=tuple(int(s) for s in shape))
+        self.states.append(state)
+        return state
+
+
+class ChunkEvaluator(Evaluator):
+    """ref evaluator.py:ChunkEvaluator — accumulate chunk_eval counters
+    in-program; eval() returns (precision, recall, f1)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, seq_len=None):
+        super().__init__("chunk_eval")
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+        self.num_infer_chunks = self._create_state("num_infer_chunks",
+                                                   "int64", (1,))
+        self.num_label_chunks = self._create_state("num_label_chunks",
+                                                   "int64", (1,))
+        self.num_correct_chunks = self._create_state("num_correct_chunks",
+                                                     "int64", (1,))
+        kwargs = dict(chunk_scheme=chunk_scheme,
+                      num_chunk_types=num_chunk_types,
+                      excluded_chunk_types=excluded_chunk_types)
+        if seq_len is not None:
+            kwargs["seq_len"] = seq_len
+        (precision, recall, f1, num_infer, num_label,
+         num_correct) = layers.chunk_eval(input=input, label=label, **kwargs)
+        layers.sums(input=[self.num_infer_chunks, num_infer],
+                    out=self.num_infer_chunks)
+        layers.sums(input=[self.num_label_chunks, num_label],
+                    out=self.num_label_chunks)
+        layers.sums(input=[self.num_correct_chunks, num_correct],
+                    out=self.num_correct_chunks)
+        self.metrics.extend([precision, recall, f1])
+
+    def eval(self, executor, eval_program=None):
+        from .core.scope import global_scope
+        infer = np.asarray(global_scope().get(self.num_infer_chunks.name))
+        label = np.asarray(global_scope().get(self.num_label_chunks.name))
+        correct = np.asarray(global_scope().get(self.num_correct_chunks.name))
+        precision = float(correct / infer) if infer else 0.0
+        recall = float(correct / label) if label else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if correct else 0.0)
+        return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    """ref evaluator.py:EditDistance — accumulate total distance and
+    sequence/error counts; eval() returns (avg_distance, avg_instance_error).
+    """
+
+    def __init__(self, input, label, ignored_tokens=None, input_len=None,
+                 label_len=None, **kwargs):
+        super().__init__("edit_distance", **kwargs)
+        self.total_distance = self._create_state("total_distance",
+                                                 "float32", (1,))
+        self.seq_num = self._create_state("seq_num", "int64", (1,))
+        self.instance_error = self._create_state("instance_error",
+                                                 "float32", (1,))
+        ed_kwargs = {}
+        if input_len is not None:
+            ed_kwargs["input_length"] = input_len
+        if label_len is not None:
+            ed_kwargs["label_length"] = label_len
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens,
+            **ed_kwargs)
+        zero = layers.fill_constant(shape=[1], value=0.0, dtype="float32")
+        compare_result = layers.equal(distances, zero)
+        compare_result_float = layers.cast(compare_result, "float32")
+        seq_right_count = layers.reduce_sum(compare_result_float)
+        inst_err = layers.cast(seq_num, "float32") - seq_right_count
+        total_distance = layers.reduce_sum(distances)
+        layers.sums(input=[self.total_distance, total_distance],
+                    out=self.total_distance)
+        layers.sums(input=[self.seq_num, seq_num], out=self.seq_num)
+        layers.sums(input=[self.instance_error, inst_err],
+                    out=self.instance_error)
+        self.metrics.append(total_distance)
+        self.metrics.append(inst_err)
+
+    def eval(self, executor, eval_program=None):
+        from .core.scope import global_scope
+        total = np.asarray(global_scope().get(self.total_distance.name))
+        num = np.asarray(global_scope().get(self.seq_num.name))
+        err = np.asarray(global_scope().get(self.instance_error.name))
+        n = max(float(num), 1.0)
+        return np.array([float(total) / n]), np.array([float(err) / n])
+
+
+class DetectionMAP(Evaluator):
+    """ref evaluator.py:DetectionMAP — per-batch mAP via
+    layers.detection_map, accumulated host-side (mAP does not decompose
+    into in-program counters the way the reference's C++ states do)."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__("map_eval")
+        # detection_map wants [B, G, 6] rows (label, difficult, x1..y2) —
+        # assemble them like the reference concatenates its label inputs
+        B, G = int(gt_box.shape[0]), int(gt_box.shape[1])
+        lab = layers.reshape(layers.cast(gt_label, "float32"), [B, G, 1])
+        if gt_difficult is not None:
+            diff = layers.reshape(layers.cast(gt_difficult, "float32"),
+                                  [B, G, 1])
+        else:
+            diff = layers.fill_constant([B, G, 1], "float32", 0.0)
+        label = layers.concat([lab, diff, gt_box], axis=2)
+        self.map_var = layers.detection_map(
+            input, label, class_num=class_num,
+            background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version)
+        self._acc = []
+
+    def get_map_var(self):
+        return self.map_var
+
+    def reset(self, executor, reset_program=None):
+        self._acc = []
+
+    def update(self, value):
+        self._acc.append(float(np.asarray(value)))
+
+    def eval(self, executor=None, eval_program=None):
+        return np.array([np.mean(self._acc) if self._acc else 0.0])

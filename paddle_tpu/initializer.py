@@ -11,7 +11,8 @@ __all__ = [
     "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
     "Xavier", "MSRA", "Bilinear", "NumpyArrayInitializer",
     "ConstantInitializer", "UniformInitializer", "NormalInitializer",
-    "XavierInitializer", "MSRAInitializer",
+    "XavierInitializer", "MSRAInitializer", "init_on_cpu",
+    "force_init_on_cpu",
 ]
 
 
@@ -142,3 +143,27 @@ TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
+
+
+import contextlib as _contextlib
+
+_force_init_on_cpu_ = False
+
+
+def force_init_on_cpu():
+    """ref initializer.py:force_init_on_cpu — whether init ops are pinned
+    to host. On TPU initialization compiles into the startup module and
+    runs where XLA places it; the flag is kept for API parity."""
+    return _force_init_on_cpu_
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """Context manager forcing init on CPU (ref init_on_cpu)."""
+    global _force_init_on_cpu_
+    prev = _force_init_on_cpu_
+    _force_init_on_cpu_ = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_ = prev

@@ -11,7 +11,8 @@ from ..layer_helper import LayerHelper
 
 __all__ = [
     "linear_chain_crf", "crf_decoding", "warpctc", "ctc_greedy_decoder",
-    "edit_distance", "beam_search", "beam_search_decode", "hsigmoid",
+    "edit_distance", "beam_search", "beam_search_decode",
+    "beam_search_loop", "hsigmoid",
 ]
 
 
@@ -186,3 +187,31 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     helper.append_op("hsigmoid", ins, {"Out": [loss], "PreOut": [pre]},
                      {"num_classes": num_classes})
     return loss
+
+
+def beam_search_loop(init_ids, states, step_fn, beam_size, max_len, end_id,
+                     vocab_size, name=None):
+    """Whole-sequence beam search as ONE compiled loop.
+
+    TPU-native replacement for the reference's imperative decode (While +
+    beam_search + LoDTensorArray): `step_fn(ids [B*beam], states dict) ->
+    (log_probs [B*beam, V], new_states)` must be jax-traceable (jnp ops,
+    not layer calls). Returns (sentence_ids [B, beam, max_len] Variable,
+    scores [B, beam] Variable).
+    """
+    from ..ops.kernels_struct import register_beam_step_fn
+    helper = LayerHelper("beam_search_loop", name=name)
+    state_names = list(states)
+    B = int(init_ids.shape[0])
+    seqs = helper.create_variable_for_type_inference(
+        "int64", (B, beam_size, max_len), True)
+    scores = helper.create_variable_for_type_inference(
+        "float32", (B, beam_size), True)
+    helper.append_op(
+        "beam_search_loop",
+        {"InitIds": [init_ids], "States": [states[n] for n in state_names]},
+        {"SentenceIds": [seqs], "SentenceScores": [scores]},
+        {"fn_id": register_beam_step_fn(step_fn),
+         "state_names": state_names, "beam_size": beam_size,
+         "max_len": max_len, "end_id": end_id, "vocab_size": vocab_size})
+    return seqs, scores

@@ -1,0 +1,24 @@
+"""LoD tensor helpers module.
+
+Parity: python/paddle/fluid/lod_tensor.py — create_lod_tensor /
+create_random_int_lodtensor. In the TPU world a "LoDTensor" is a padded
+array plus per-row sequence lengths (see lod.py); these constructors
+accept the reference's recursive_seq_lens convention.
+"""
+import numpy as np
+
+from .lod import LoDTensor, create_lod_tensor
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    """ref lod_tensor.py:create_random_int_lodtensor — random ints in
+    [low, high] shaped by the level-0 sequence lengths."""
+    assert isinstance(base_shape, list), "base_shape should be a list"
+    converted_lod = recursive_seq_lens[-1]
+    overall = sum(converted_lod)
+    shape = [overall] + base_shape
+    data = np.random.random_integers(low, high, shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -1,0 +1,47 @@
+"""Program graph drawing.
+
+Parity: python/paddle/fluid/net_drawer.py — emit a Graphviz .dot of the
+op graph (the reference shells out to graphviz; here the DOT text is
+generated directly and optionally written to a file, rendering is up to
+the user's toolchain).
+"""
+import json
+
+__all__ = ["draw_graph", "parse_graph"]
+
+
+def parse_graph(program, graph=None, var_dict=None, **kwargs):
+    """Collect nodes/edges of the global block (ref parse_graph)."""
+    nodes, edges = [], []
+    for i, op in enumerate(program.global_block().ops):
+        op_node = f"op_{i}_{op.type}"
+        nodes.append((op_node, op.type, "op"))
+        for name in op.input_names():
+            nodes.append((f"var_{name}", name, "var"))
+            edges.append((f"var_{name}", op_node))
+        for name in op.output_names():
+            nodes.append((f"var_{name}", name, "var"))
+            edges.append((op_node, f"var_{name}"))
+    return nodes, edges
+
+
+def draw_graph(startup_program, main_program, output_path=None, **kwargs):
+    """Render the main program to DOT text; write to output_path if given
+    (ref draw_graph writes graph.dot + png via graphviz binary)."""
+    nodes, edges = parse_graph(main_program)
+    seen = set()
+    lines = ["digraph G {"]
+    for nid, label, kind in nodes:
+        if nid in seen:
+            continue
+        seen.add(nid)
+        shape = "box" if kind == "op" else "ellipse"
+        lines.append(f'  "{nid}" [label={json.dumps(label)}, shape={shape}];')
+    for a, b in edges:
+        lines.append(f'  "{a}" -> "{b}";')
+    lines.append("}")
+    dot = "\n".join(lines)
+    if output_path:
+        with open(output_path, "w") as f:
+            f.write(dot)
+    return dot

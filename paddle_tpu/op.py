@@ -1,0 +1,48 @@
+"""Raw operator construction helpers.
+
+Parity: python/paddle/fluid/op.py — thin factory for appending a raw op
+to a block by type string (the reference builds OpDesc protobufs from
+the C++ OpProto registry; here the kernel registry is the authority).
+"""
+from .core.framework import default_main_program
+from .ops.registry import has_kernel, KERNELS
+
+__all__ = ["Operator", "OpDescCreationMethod"]
+
+
+class OpDescCreationMethod:
+    """Callable that appends an op of a fixed type (ref op.py's
+    OpDescCreationMethod built per OpProto)."""
+
+    def __init__(self, op_type):
+        if not has_kernel(op_type):
+            raise ValueError(f"unknown op type {op_type!r} "
+                             f"({len(KERNELS)} registered)")
+        self.op_type = op_type
+
+    def __call__(self, inputs=None, outputs=None, attrs=None, block=None):
+        block = block or default_main_program().current_block()
+        return block.append_op(self.op_type, inputs or {}, outputs or {},
+                               attrs or {})
+
+
+class _OperatorFactory:
+    """`Operator("relu", inputs={"X": [x]}, outputs={"Out": [y]})` —
+    ref op.py:Operator factory. Slot direction isn't inferable without
+    the reference's OpProto registry, so slots must come as explicit
+    inputs=/outputs= dicts; bare slot kwargs raise instead of silently
+    appending a disconnected op."""
+
+    def types(self):
+        return sorted(KERNELS)
+
+    def __call__(self, op_type, inputs=None, outputs=None, attrs=None,
+                 **kwargs):
+        if kwargs:
+            raise TypeError(
+                f"pass op slots as inputs=/outputs= dicts, not bare "
+                f"kwargs {sorted(kwargs)} (slot direction is ambiguous)")
+        return OpDescCreationMethod(op_type)(inputs, outputs, attrs)
+
+
+Operator = _OperatorFactory()

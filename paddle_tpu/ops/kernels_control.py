@@ -19,6 +19,9 @@ PY_FUNCS = []
 
 
 def register_py_func(fn):
+    for i, f in enumerate(PY_FUNCS):
+        if f is fn:
+            return i                  # re-registration must not leak
     PY_FUNCS.append(fn)
     return len(PY_FUNCS) - 1
 
@@ -172,3 +175,43 @@ def _py_func(ctx, ins, attrs):
 
     call.defvjp(fwd, back)
     return {"Out": list(call(*xs))}
+
+
+@kernel("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    """ref operators/fake_quantize_op.cc (abs_max): quantize to the
+    bit_length int grid scaled by max|x|, straight-through gradient
+    (y = x + stop_grad(q(x) - x) — jax.grad sees identity, so the QAT
+    backward needs no per-op grad rewrite like the reference's)."""
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    rng = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x)) + 1e-9
+    q = jnp.round(x / scale * rng) / rng * scale
+    y = x + jax.lax.stop_gradient(q - x)
+    return {"Out": [y], "OutScale": [scale.reshape(1)]}
+
+
+@kernel("fake_quantize_range_abs_max")
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """range_abs_max variant: scale = moving max of abs_max across steps
+    (InScale persistable updated in-graph)."""
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    rng = float(2 ** (bits - 1) - 1)
+    cur = jnp.max(jnp.abs(x))
+    prev = jnp.reshape(ins["InScale"][0], ())
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    scale = jnp.where(is_test, prev, jnp.maximum(prev * 0.9, cur)) + 1e-9
+    q = jnp.round(x / scale * rng) / rng * scale
+    y = x + jax.lax.stop_gradient(q - x)
+    return {"Out": [y], "OutScale": [scale.reshape(1)]}
+
+
+@kernel("dequantize_abs_max")
+def _dequantize_abs_max(ctx, ins, attrs):
+    """int8 weight × stored scale → float (PTQ freeze path)."""
+    w = ins["X"][0]
+    scale = jnp.reshape(ins["Scale"][0], ())
+    rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
+    return {"Out": [w.astype(jnp.float32) * (scale / rng)]}

@@ -362,3 +362,76 @@ def _hsigmoid(ctx, ins, attrs):
     losses = jax.nn.softplus(logits) - bit * logits
     loss = jnp.sum(jnp.where(valid, losses, 0.0), axis=1, keepdims=True)
     return {"Out": [loss], "PreOut": [logits]}
+
+
+# host registry of TRACEABLE step callables for the whole-loop beam search
+# (attrs carry an index; the fn maps jnp arrays → jnp arrays inside scan)
+BEAM_STEP_FNS = []
+
+
+def register_beam_step_fn(fn):
+    for i, f in enumerate(BEAM_STEP_FNS):
+        if f is fn:
+            return i                  # re-registration must not leak
+    BEAM_STEP_FNS.append(fn)
+    return len(BEAM_STEP_FNS) - 1
+
+
+@kernel("beam_search_loop")
+def _beam_search_loop(ctx, ins, attrs):
+    """Whole beam-search decode as ONE lax.scan (replaces the reference's
+    host-interpreted While + LoDTensorArray loop,
+    contrib/decoder/beam_search_decoder.py:BeamSearchDecoder). The step
+    callable must be jax-traceable: (ids [B*K], states) -> (log_probs
+    [B*K, V], new_states)."""
+    fn = BEAM_STEP_FNS[attrs["fn_id"]]
+    init_ids = ins["InitIds"][0].reshape(-1).astype(jnp.int32)   # [B]
+    state_names = attrs.get("state_names", [])
+    state_vals = ins.get("States", [])
+    K = attrs["beam_size"]
+    V = attrs["vocab_size"]
+    T = attrs["max_len"]
+    end_id = attrs["end_id"]
+    B = init_ids.shape[0]
+
+    def tile(x):
+        return jnp.repeat(x, K, axis=0)
+
+    states = {n: tile(v) for n, v in zip(state_names, state_vals)}
+    ids0 = jnp.repeat(init_ids, K)
+    # only beam 0 live at t=0 so the K starts aren't identical
+    scores0 = jnp.tile(jnp.asarray([0.0] + [-1e9] * (K - 1), jnp.float32), B)
+    fin0 = jnp.zeros((B * K,), bool)
+
+    def step(carry, _):
+        ids, scores, states, finished = carry
+        logp, new_states = fn(ids, states)
+        logp = jax.nn.log_softmax(logp.astype(jnp.float32), axis=-1)
+        # finished beams emit end_id with no score change
+        keep = jnp.full((V,), -1e9, jnp.float32).at[end_id].set(0.0)
+        logp = jnp.where(finished[:, None], keep[None, :], logp)
+        total = (scores[:, None] + logp).reshape(B, K * V)
+        top_s, top_i = jax.lax.top_k(total, K)              # [B, K]
+        parent = top_i // V
+        word = (top_i % V).astype(jnp.int32)
+        flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        new_states = {n: v[flat_parent] for n, v in new_states.items()}
+        ids = word.reshape(-1)
+        scores = top_s.reshape(-1)
+        finished = finished[flat_parent] | (ids == end_id)
+        return (ids, scores, new_states, finished), (word, parent)
+
+    (_, scores, _, _), (words, parents) = jax.lax.scan(
+        step, (ids0, scores0, states, fin0), None, length=T)
+    # words/parents [T, B, K] → backtrace to sequences [B, K, T]
+    def back(ptr, inp):
+        ids_t, par_t = inp
+        tok = jnp.take_along_axis(ids_t, ptr, 1)
+        ptr = jnp.take_along_axis(par_t, ptr, 1)
+        return ptr, tok
+
+    ptr0 = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+    _, toks = jax.lax.scan(back, ptr0, (words, parents), reverse=True)
+    seqs = jnp.transpose(toks, (1, 2, 0)).astype(jnp.int64)
+    return {"SentenceIds": [seqs],
+            "SentenceScores": [scores.reshape(B, K)]}

@@ -1,0 +1,16 @@
+"""Program transpilers.
+
+Parity: python/paddle/fluid/transpiler/__init__.py — DistributeTranspiler
+(SPMD sharding rules over the mesh, parallel/transpiler.py),
+InferenceTranspiler (conv+bn folding), memory_optimize (rematerialization)
+and the pserver dispatchers.
+"""
+from ..parallel.transpiler import (DistributeTranspiler,
+                                   DistributeTranspilerConfig)
+from .inference_transpiler import InferenceTranspiler
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from .ps_dispatcher import HashName, RoundRobin
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "InferenceTranspiler", "memory_optimize", "release_memory",
+           "HashName", "RoundRobin"]

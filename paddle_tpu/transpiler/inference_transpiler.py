@@ -1,0 +1,88 @@
+"""Inference-time graph optimization.
+
+Parity: python/paddle/fluid/transpiler/inference_transpiler.py — fold
+batch_norm into the preceding conv2d (the reference also relies on MKLDNN
+fusions; under XLA elementwise chains fuse automatically, so the one
+rewrite that still pays is the conv+bn WEIGHT fold, which removes the bn
+op and its 4 parameter tensors from the graph entirely):
+
+    w' = w * scale / sqrt(var + eps)
+    b' = (b - mean) * scale / sqrt(var + eps) + bias_bn
+"""
+import numpy as np
+
+__all__ = ["InferenceTranspiler"]
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place=None, scope=None):
+        """Rewrite `program` in place using parameter values from `scope`
+        (defaults to the global scope). Run AFTER the startup program /
+        param load, on an inference (is_test) program."""
+        from ..core.scope import global_scope
+        scope = scope or global_scope()
+        self._fuse_batch_norm(program, scope)
+
+    # ------------------------------------------------------------------
+    def _fuse_batch_norm(self, program, scope):
+        block = program.global_block()
+        ops = block.ops
+        i = 0
+        while i < len(ops) - 1:
+            op = ops[i]
+            # conv only (like the reference): the mul kernel has no Bias
+            # slot to fold the shift into
+            if op.type not in ("conv2d", "depthwise_conv2d"):
+                i += 1
+                continue
+            out_name = op.outputs.get("Out", op.outputs.get("Output", [None]))[0]
+            nxt = ops[i + 1]
+            if nxt.type != "batch_norm" or \
+                    nxt.inputs.get("X", [None])[0] != out_name:
+                i += 1
+                continue
+            if not nxt.attrs.get("is_test", False) and \
+                    not getattr(program, "_is_test", False):
+                # folding uses the FROZEN moving stats — training bn stays
+                i += 1
+                continue
+            w_name = op.inputs["Filter"][0]
+            scale = np.asarray(scope.get(nxt.inputs["Scale"][0]))
+            bias = np.asarray(scope.get(nxt.inputs["Bias"][0]))
+            mean = np.asarray(scope.get(nxt.inputs["Mean"][0]))
+            var = np.asarray(scope.get(nxt.inputs["Variance"][0]))
+            eps = nxt.attrs.get("epsilon", 1e-5)
+            w = np.asarray(scope.get(w_name))
+            alpha = scale / np.sqrt(var + eps)
+            if w.ndim == 4:          # OIHW conv filter: scale output chans
+                w2 = w * alpha[:, None, None, None]
+            else:                    # [in, out] matmul weight
+                w2 = w * alpha[None, :]
+            import jax.numpy as jnp
+            scope.set(w_name, jnp.asarray(w2, dtype=str(w.dtype)))
+            # fold the shift into a conv bias (create one if absent)
+            b_names = op.inputs.get("Bias")
+            shift = bias - mean * alpha
+            if b_names:
+                b_old = np.asarray(scope.get(b_names[0]))
+                scope.set(b_names[0],
+                          jnp.asarray(b_old * alpha + shift,
+                                      dtype=str(b_old.dtype)))
+            else:
+                b_name = w_name + ".bn_fold_bias"
+                block.create_var(name=b_name, shape=shift.shape,
+                                 dtype="float32", persistable=True)
+                scope.set(b_name, jnp.asarray(shift, np.float32))
+                op.inputs["Bias"] = [b_name]
+            # the conv now writes straight into the bn's output var, so
+            # downstream consumers AND fetches of the bn var keep working
+            bn_out = nxt.outputs["Y"][0]
+            out_slot = "Output" if "Output" in op.outputs else "Out"
+            op.outputs[out_slot] = [bn_out]
+            del ops[i + 1]
+            for later in ops[i + 1:]:
+                for slot, names in later.inputs.items():
+                    later.inputs[slot] = [bn_out if n == out_name else n
+                                          for n in names]
+            program._bump_version()
+            i += 1

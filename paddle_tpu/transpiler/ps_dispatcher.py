@@ -1,0 +1,52 @@
+"""Parameter-server dispatchers.
+
+Parity: python/paddle/fluid/transpiler/ps_dispatcher.py — map variables
+onto pserver endpoints. On TPU the analog is assigning optimizer-state
+shards to mesh coordinates (ZeRO-style); these classes keep the
+reference API for distribute-transpiler callers.
+"""
+
+__all__ = ["PSDispatcher", "HashName", "RoundRobin"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError("Interface has not been implemented.")
+
+
+class HashName(PSDispatcher):
+    """ref ps_dispatcher.py:HashName — endpoint = hash(var name) % n."""
+
+    def _hash_block(self, block_str, total):
+        return hash(block_str) % total
+
+    def dispatch(self, varlist):
+        eplist = []
+        for var in varlist:
+            server_id = self._hash_block(var.name(), len(self._eps)) \
+                if callable(getattr(var, "name", None)) \
+                else self._hash_block(var.name, len(self._eps))
+            eplist.append(self._eps[server_id])
+        return eplist
+
+
+class RoundRobin(PSDispatcher):
+    """ref ps_dispatcher.py:RoundRobin — cycle endpoints in order."""
+
+    def dispatch(self, varlist):
+        eplist = []
+        for _ in varlist:
+            eplist.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return eplist

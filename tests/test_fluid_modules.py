@@ -1,0 +1,260 @@
+"""Top-level fluid module parity: average, evaluator, transpilers,
+quantization, slim pruning, async executor, beam-search decoder, misc
+(ref tests/unittests/test_{memory_optimization_transpiler,
+inference_transpiler, quantize_transpiler, async_executor, calc_memory,
+op_frequence}*.py)."""
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_weighted_average():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        avg = pt.average.WeightedAverage()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=2)
+    assert avg.eval() == pytest.approx(10.0 / 3.0)
+
+
+def test_memory_usage_and_op_freq():
+    x = layers.data("x", shape=[784])
+    y = layers.fc(x, size=10)
+    loss = layers.reduce_sum(y)
+    low, high, unit = pt.contrib.memory_usage(pt.default_main_program(),
+                                              batch_size=32)
+    assert high > low >= 0 and unit in ("B", "KB", "MB", "GB")
+    uni, adj = pt.contrib.op_freq_statistic(pt.default_main_program())
+    assert uni.get("mul", 0) >= 1 or uni.get("fc", 0) >= 1
+
+
+def test_inference_transpiler_conv_bn_fold():
+    img = layers.data("img", shape=[2, 8, 8])
+    c = layers.conv2d(img, num_filters=3, filter_size=3, padding=1)
+    out = layers.batch_norm(c, is_test=True)
+    test_prog = pt.default_main_program().clone(for_test=True)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    # make bn stats non-trivial
+    scope = pt.global_scope()
+    for v in pt.default_main_program().list_vars():
+        if "batch_norm" in v.name and v.persistable:
+            val = np.asarray(scope.get(v.name))
+            scope.set(v.name, np.abs(np.random.RandomState(0)
+                                     .randn(*val.shape)).astype("float32")
+                      + 0.5)
+    xv = np.random.RandomState(1).randn(2, 2, 8, 8).astype("float32")
+    before, = exe.run(test_prog, feed={"img": xv}, fetch_list=[out],
+                      is_test=True)
+    n_ops_before = len(test_prog.global_block().ops)
+    pt.InferenceTranspiler().transpile(test_prog)
+    n_ops_after = len(test_prog.global_block().ops)
+    after, = exe.run(test_prog, feed={"img": xv}, fetch_list=[out],
+                     is_test=True)
+    assert n_ops_after < n_ops_before            # bn op removed
+    np.testing.assert_allclose(before, after, rtol=2e-4, atol=2e-5)
+
+
+def test_memory_optimize_remat_still_trains():
+    x = layers.data("x", shape=[16])
+    y = layers.data("y", shape=[1])
+    h = layers.fc(x, size=32, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    saved = pt.memory_optimize(pt.default_main_program())
+    assert saved > 0
+    assert pt.release_memory(pt.default_main_program()) is not None
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 16).astype("float32")
+    yv = (xv.sum(1, keepdims=True) * 0.1).astype("float32")
+    losses = [float(exe.run(feed={"x": xv, "y": yv},
+                            fetch_list=[loss])[0]) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_quantize_transpiler_qat_and_freeze():
+    x = layers.data("x", shape=[8])
+    y = layers.data("y", shape=[1])
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(0.05).minimize(loss)
+    qt = pt.contrib.quantize.QuantizeTranspiler(weight_bits=8,
+                                                activation_bits=8)
+    qt.training_transpile(pt.default_main_program())
+    types = [op.type for op in pt.default_main_program().global_block().ops]
+    assert "fake_quantize_abs_max" in types
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 8).astype("float32")
+    yv = (xv.sum(1, keepdims=True) * 0.2).astype("float32")
+    losses = [float(exe.run(feed={"x": xv, "y": yv},
+                            fetch_list=[loss])[0]) for _ in range(10)]
+    assert losses[-1] < losses[0]      # STE gradients train through quant
+    # freeze: int8 weights + dequant ops, same prediction ballpark
+    test_prog = pt.default_main_program().clone(for_test=True)
+    qt2 = pt.contrib.quantize.QuantizeTranspiler()
+    qt2.training_transpile(test_prog)
+    qt2.freeze_program(test_prog)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "dequantize_abs_max" in types
+    out_q, = exe.run(test_prog, feed={"x": xv}, fetch_list=[pred.name],
+                     is_test=True)
+    assert np.isfinite(out_q).all()
+
+
+def test_slim_magnitude_pruning():
+    x = layers.data("x", shape=[8])
+    out = layers.fc(x, size=8, bias_attr=False)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    params = pt.default_main_program().all_parameters()
+    wname = params[0].name
+    masks = pt.contrib.slim.prune_program(pt.default_main_program(), 0.5)
+    w = np.asarray(pt.global_scope().get(wname))
+    sparsity = float((w == 0).mean())
+    assert 0.4 <= sparsity <= 0.6
+    assert masks[wname].dtype == bool
+
+
+def test_async_executor_with_data_feed_desc(tmp_path):
+    # MultiSlot text file: two slots (dense feature len 4, label len 1)
+    data_path = os.path.join(tmp_path, "part-0")
+    rng = np.random.RandomState(0)
+    with open(data_path, "w") as f:
+        for i in range(6):
+            feats = " ".join(str(round(v, 3)) for v in rng.randn(4))
+            f.write(f"4 {feats} 1 {i % 2}\n")
+    proto_path = os.path.join(tmp_path, "data.proto")
+    with open(proto_path, "w") as f:
+        f.write('name: "MultiSlotDataFeed"\nbatch_size: 2\n'
+                'multi_slot_desc {\n'
+                '  slots { name: "feat" type: "float32" is_dense: true '
+                'is_used: true }\n'
+                '  slots { name: "lab" type: "int64" is_dense: true '
+                'is_used: true }\n}\n')
+    feed = pt.DataFeedDesc(proto_path)
+    assert feed.batch_size == 2 and len(feed.slots) == 2
+    feat = layers.data("feat", shape=[4], append_batch_size=False)
+    lab = layers.data("lab", shape=[1], dtype="int64",
+                      append_batch_size=False)
+    s = layers.reduce_sum(feat)
+    ae = pt.AsyncExecutor()
+    ae.executor.run(pt.default_startup_program())
+    results = ae.run(pt.default_main_program(), feed, [data_path],
+                     fetch=[s], debug=True)
+    assert len(results) == 3         # 6 samples / batch 2
+
+
+def test_beam_search_decoder_loop():
+    import jax.numpy as jnp
+    V, B, beam, T = 6, 2, 3, 5
+    init = layers.data("init", shape=[B], dtype="int64",
+                       append_batch_size=False)
+
+    def step_fn(ids, states):
+        # deterministic LM: always prefer token (id+1) % V; end at 4
+        logits = -10.0 * jnp.ones((ids.shape[0], V))
+        nxt = (ids + 1) % V
+        logits = logits.at[jnp.arange(ids.shape[0]), nxt].set(0.0)
+        return logits, states
+
+    dec = pt.contrib.decoder.BeamSearchDecoder(
+        init_ids=init, target_dict_dim=V, max_len=T, beam_size=beam,
+        end_id=4, step_fn=step_fn)
+    seqs, scores = dec.decode()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    s, sc = exe.run(feed={"init": np.array([0, 2], "int64")},
+                    fetch_list=[seqs, scores])
+    assert s.shape == (B, beam, T)
+    # row 0 starts at 0 → best beam emits 1,2,3,4 then stays at 4
+    np.testing.assert_array_equal(s[0, 0], [1, 2, 3, 4, 4])
+    # row 1 starts at 2 → 3,4 then finished
+    np.testing.assert_array_equal(s[1, 0][:2], [3, 4])
+
+
+def test_detection_map_evaluator():
+    det = layers.data("det", shape=[1, 4, 6], dtype="float32",
+                      append_batch_size=False)
+    gt_label = layers.data("gl", shape=[1, 2], dtype="int32",
+                           append_batch_size=False)
+    gt_box = layers.data("gb", shape=[1, 2, 4], dtype="float32",
+                         append_batch_size=False)
+    ev = pt.evaluator.DetectionMAP(det, gt_label, gt_box, class_num=3,
+                                   overlap_threshold=0.5)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    detv = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                      [2, 0.8, 0.5, 0.5, 0.9, 0.9],
+                      [-1, -1, 0, 0, 0, 0],
+                      [-1, -1, 0, 0, 0, 0]]], "float32")
+    m, = exe.run(feed={"det": detv,
+                       "gl": np.array([[1, 2]], "int32"),
+                       "gb": np.array([[[0.1, 0.1, 0.4, 0.4],
+                                        [0.5, 0.5, 0.9, 0.9]]], "float32")},
+                 fetch_list=[ev.get_map_var()])
+    ev.update(m)
+    assert float(ev.eval()[0]) == pytest.approx(1.0)
+
+
+def test_net_drawer_and_default_scope():
+    x = layers.data("x", shape=[4])
+    layers.fc(x, size=2)
+    dot = pt.net_drawer.draw_graph(pt.default_startup_program(),
+                                   pt.default_main_program())
+    assert "digraph" in dot and "fc" in dot or "mul" in dot
+    from paddle_tpu.default_scope_funcs import (enter_local_scope,
+                                                leave_local_scope,
+                                                get_cur_scope,
+                                                scoped_function)
+    outer = get_cur_scope()
+    enter_local_scope()
+    assert get_cur_scope() is not outer
+    leave_local_scope()
+    assert get_cur_scope() is outer
+    called = []
+    scoped_function(lambda: called.append(1))
+    assert called == [1]
+
+
+def test_training_decoder_teacher_forcing():
+    B, T, D = 2, 4, 3
+    emb = layers.data("emb", shape=[B, T, D], dtype="float32",
+                      append_batch_size=False)
+    init = layers.data("h0", shape=[B, D], dtype="float32",
+                       append_batch_size=False)
+    cell = pt.contrib.decoder.StateCell(
+        inputs={"x": None}, states={"h": pt.contrib.decoder.InitState(init)},
+        out_state="h")
+
+    @cell.state_updater
+    def updater(c):
+        x = c.get_input("x")
+        h = c.get_state("h")
+        c.set_state("h", layers.elementwise_add(h, x))
+
+    dec = pt.contrib.decoder.TrainingDecoder(cell)
+    with dec.block():
+        x = dec.step_input(emb)
+        cell.compute_state(inputs={"x": x})
+        cell.update_states()
+        dec.output(cell.get_state("h"))
+    out = dec()
+    rng = np.random.RandomState(0)
+    ev = rng.randn(B, T, D).astype("float32")
+    h0 = rng.randn(B, D).astype("float32")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    res, = exe.run(feed={"emb": ev, "h0": h0}, fetch_list=[out])
+    want = h0[:, None, :] + np.cumsum(ev, axis=1)
+    np.testing.assert_allclose(res, want, rtol=1e-5)
