@@ -25,6 +25,8 @@ from . import vision
 from .vision import *        # noqa: F401,F403
 from . import detection
 from .detection import *     # noqa: F401,F403
+from . import layer_function_generator
+from .layer_function_generator import *  # noqa: F401,F403
 from . import math_op_patch
 
 math_op_patch.monkey_patch_variable()
@@ -39,3 +41,4 @@ __all__ += metric_op.__all__
 __all__ += io.__all__
 __all__ += sequence.__all__
 __all__ += detection.__all__
+__all__ += layer_function_generator.__all__

@@ -1,0 +1,81 @@
+"""Layer-function generation helpers.
+
+Parity: python/paddle/fluid/layers/layer_function_generator.py — doc
+decorators (autodoc/templatedoc/deprecated) and generate_layer_fn, which
+builds a layer function straight from a registered op type (the
+reference generates them from the C++ OpProto; here the kernel registry
+is the source of truth and the generated layer uses the common
+X→Out slot convention).
+"""
+import functools
+import re
+import warnings
+
+from ..layer_helper import LayerHelper
+from ..ops.registry import has_kernel
+
+__all__ = ["autodoc", "templatedoc", "deprecated", "generate_layer_fn",
+           "generate_layer_fn_noattr"]
+
+
+def autodoc(comment=""):
+    def deco(func):
+        func.__doc__ = comment + (func.__doc__ or "")
+        return func
+    return deco
+
+
+def templatedoc(op_type=None):
+    """Fill {comment}-style placeholders in the docstring (the reference
+    pulls text from the OpProto; the placeholders are simply stripped
+    when no proto text exists)."""
+    def deco(func):
+        if func.__doc__:
+            func.__doc__ = re.sub(r"\$\{[\w.]+\}", "", func.__doc__)
+        return func
+    return deco
+
+
+def deprecated(since="", instead="", extra_message=""):
+    def deco(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{func.__name__} is deprecated since {since}, use "
+                f"{instead} instead. {extra_message}", DeprecationWarning)
+            return func(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def generate_layer_fn(op_type):
+    """Build `layer(x, ..., **attrs) -> out` for a registered op that
+    follows the X→Out slot convention (activations, unary math...)."""
+    if not has_kernel(op_type):
+        raise ValueError(f"unknown op type {op_type!r}")
+
+    def layer(*args, **kwargs):
+        helper = LayerHelper(op_type, name=kwargs.pop("name", None))
+        if len(args) != 1:
+            raise ValueError(
+                f"{op_type} generated layer takes exactly one input "
+                f"variable (X→Out convention), got {len(args)}")
+        x = args[0]
+        out = kwargs.pop("out", None) or \
+            helper.create_variable_for_type_inference(x.dtype, x.shape)
+        helper.append_op(op_type, {"X": [x]}, {"Out": [out]}, dict(kwargs))
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = f"Auto-generated layer for the {op_type!r} op."
+    return layer
+
+
+def generate_layer_fn_noattr(op_type):
+    fn = generate_layer_fn(op_type)
+
+    def layer(x, name=None):
+        return fn(x, name=name)
+
+    layer.__name__ = op_type
+    return layer

@@ -17,8 +17,7 @@ from . import control_flow
 __all__ = [
     "exponential_decay", "natural_exp_decay", "inverse_time_decay",
     "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
-    "linear_lr_warmup",
-]
+    "linear_lr_warmup", "append_LARS"]
 
 LR_COUNTER_NAME = "@LR_DECAY_COUNTER@"
 
@@ -130,3 +129,20 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     else:
         base = tensor.fill_constant([1], "float32", learning_rate)
     return nn.where(in_warmup, warm, base)
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """ref learning_rate_scheduler.py:310 — layer-wise adaptive rate
+    scaling: lr_i = lr * ||w|| / (||g|| + wd * ||w||) per parameter.
+    Returns the list of per-parameter decayed LR variables."""
+    from . import nn as _nn
+    from . import ops as _ops
+    out = []
+    for param, grad in params_grads:
+        pn = _ops.sqrt(_nn.reduce_sum(_ops.square(param)))
+        gn = _ops.sqrt(_nn.reduce_sum(_ops.square(grad)))
+        # wd == 1.0 matches the reference's _balanced_weight special case:
+        # denom = ||g|| + ||w|| (identical to the generic formula at 1.0)
+        denom = gn + weight_decay * pn
+        out.append(learning_rate * pn / denom)
+    return out

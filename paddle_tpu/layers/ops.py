@@ -3,7 +3,7 @@
 Parity: python/paddle/fluid/layers/ops.py — one thin layer function per
 registered activation op (the ref generates these from OpProtos).
 """
-from ..layer_helper import LayerHelper
+from .layer_function_generator import generate_layer_fn_noattr
 
 _UNARY = [
     "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "sqrt", "rsqrt",
@@ -17,12 +17,7 @@ __all__ = list(_UNARY)
 
 
 def _make(op_type):
-    def layer(x, name=None):
-        helper = LayerHelper(op_type, name=name)
-        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
-        helper.append_op(op_type, {"X": [x]}, {"Out": [out]}, {})
-        return out
-    layer.__name__ = op_type
+    layer = generate_layer_fn_noattr(op_type)
     layer.__doc__ = f"{op_type} activation (ref layers/ops.py:{op_type})"
     return layer
 

@@ -15,6 +15,8 @@ __all__ = [
     "ones", "zeros", "ones_like", "zeros_like", "reverse", "linspace",
     "range", "shape", "increment", "uniform_random", "gaussian_random",
     "sums",
+    "autoincreased_step_counter", "get_tensor_from_selected_rows",
+    "merge_selected_rows",
 ]
 
 
@@ -197,3 +199,34 @@ def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
                      {"shape": [int(s) for s in shape], "dtype": dtype,
                       "mean": mean, "std": std, "seed": seed})
     return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """ref nn.py:5651 — persistable int64 counter incremented once per
+    Executor.run (the increment op compiles into the step module)."""
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    block = helper.main_program.global_block()
+    counter = block.vars.get(name)
+    if counter is None:
+        counter = create_global_var(
+            [1], float(begin - step), "int64", persistable=True, name=name)
+        helper.append_op("increment", {"X": [counter]}, {"Out": [counter]},
+                         {"step": float(step), "is_train_only": True})
+    return counter
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """ref get_tensor_from_selected_rows_op.cc. SelectedRows is the
+    reference's sparse-gradient format; TPU gradients are dense arrays,
+    so this is the identity (kept for API parity)."""
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("assign", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    """ref merge_selected_rows_op.cc — duplicate-row reduction for sparse
+    grads; dense on TPU, identity (see get_tensor_from_selected_rows)."""
+    return get_tensor_from_selected_rows(x, name=name)

@@ -73,9 +73,10 @@ def test_stacked_lstm_trains():
         lbl = (words[:, 0] < 50).astype("int64")[:, None]
         return {"words": words, "words_seq_len": lens, "label": lbl}
 
-    losses = _run_steps(feeds, loss, feed, steps=10,
+    losses = _run_steps(feeds, loss, feed, steps=30,
                         opt=pt.optimizer.Adam(5e-3))
-    assert losses[-1] < losses[0], losses
+    # fresh random batches each step → compare window means, not endpoints
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
 def test_deepfm_trains():
