@@ -11,8 +11,7 @@ import numpy as np
 
 from .core.scope import global_scope
 
-__all__ = ["bf16_guard", "cast_program_to_bf16", "cast_params_to_bf16",
-           "master_weight_note"]
+__all__ = ["bf16_guard", "cast_program_to_bf16", "cast_params_to_bf16"]
 
 # dtype-sensitive ops that must keep fp32 params (norm stats/scales)
 _KEEP_FP32_PARAM_SUFFIX = ("batch_norm", "layer_norm", "group_norm")
@@ -65,12 +64,52 @@ import contextlib
 
 @contextlib.contextmanager
 def bf16_guard(program=None):
-    """Build-time guard: layers created inside default to bfloat16 data.
-    (Declare data vars with dtype='bfloat16' for full effect.)"""
+    """Build-time scoped bf16 region (ref contrib amp bf16_guard): ops
+    appended to `program` (default main) INSIDE this context get their
+    float32 Parameters and intermediate vars rewritten to bfloat16 on
+    exit — the scoped version of cast_program_to_bf16, with the same
+    keep-fp32 rules (data IO, norm scales, optimizer/persistable state).
+    """
+    from .core.framework import default_main_program, Parameter
+    program = program or default_main_program()
+    block = program.global_block()
+    start = len(block.ops)
     yield
-
-
-def master_weight_note():
-    return ("Optimizer update kernels (ops/kernels_optim.py) keep all "
-            "moments in fp32 and upcast params for the update — master "
-            "weights are implicit; no loss scaling needed with bf16.")
+    new_ops = list(block.ops[start:])
+    # include ops nested in control-flow sub-blocks created in the region
+    def expand(ops):
+        out = []
+        for op in ops:
+            out.append(op)
+            for key in ("true_block", "false_block", "cond_block",
+                        "body_block", "step_block"):
+                bidx = op.attrs.get(key)
+                if bidx is not None:
+                    out.extend(expand(program.blocks[bidx].ops))
+        return out
+    new_ops = expand(new_ops)
+    # outputs created inside the region, plus the Parameters its ops
+    # consume — NOT inputs produced outside (those keep their dtype;
+    # the kernels' autocast handles the boundary)
+    all_vars = {}
+    for blk in program.blocks:
+        all_vars.update(blk.vars)
+    touched = set()
+    for op in new_ops:
+        touched.update(op.output_names())
+        for n in op.input_names():
+            if isinstance(all_vars.get(n), Parameter):
+                touched.add(n)
+    for blk in program.blocks:
+        for var in blk.vars.values():
+            if var.name not in touched or var.dtype != "float32":
+                continue
+            if var.is_data:
+                continue
+            if isinstance(var, Parameter):
+                if any(s in var.name for s in _KEEP_FP32_PARAM_SUFFIX):
+                    continue
+            elif var.persistable:
+                continue
+            var.dtype = "bfloat16"
+    program._bump_version()

@@ -8,6 +8,9 @@ function with persistable buffers DONATED — param/optimizer-state updates
 happen in-place in HBM, and one compiled module per step replaces per-op
 kernel launches (BASELINE.json north-star).
 """
+import logging
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -19,6 +22,8 @@ from .trace import build_step_fn
 from .dtypes import as_jnp_dtype
 
 __all__ = ["Executor"]
+
+_LOG = logging.getLogger("paddle_tpu.executor")
 
 
 def _feed_signature(feed):
@@ -33,6 +38,12 @@ class Executor:
         self._step = 0
         self._seed = 0
         self.check_nan_inf = False   # failure-detection flag (SURVEY §2.8)
+        # stall detection (SURVEY §2.8): a step (excluding its first-run
+        # XLA compile) exceeding this wall-clock budget logs a warning —
+        # the race/stall analog of the reference's distributed watchdogs.
+        self.step_timeout = None     # seconds; None disables
+        self.last_step_time = None   # wall seconds of the last run()
+        self._seen_keys = set()
 
     def close(self):
         self._cache.clear()
@@ -93,13 +104,29 @@ class Executor:
         ckey = (id(program), program._version, _feed_signature(feed_arrays),
                 tuple(fetch_names), bool(is_test))
         fn = self._cache.get(ckey) if use_program_cache else None
+        # first-run (compile) detection must survive use_program_cache=False
+        first_run = ckey not in self._seen_keys
+        self._seen_keys.add(ckey)
         if fn is None:
             step_fn = build_step_fn(program, fetch_names, is_test, self.place)
             fn = jax.jit(step_fn, donate_argnums=(0,))
             if use_program_cache:
                 self._cache[ckey] = fn
 
+        t0 = time.perf_counter()
         fetches, new_persist = fn(persist, feed_arrays, key)
+        if self.step_timeout is not None:
+            # completion barrier only when the watchdog is armed — don't
+            # break async dispatch for return_numpy=False callers
+            jax.block_until_ready(fetches)
+        dt = time.perf_counter() - t0
+        self.last_step_time = dt
+        if (self.step_timeout is not None and not first_run
+                and dt > self.step_timeout):
+            _LOG.warning(
+                "executor stall: step %d took %.2fs (timeout %.2fs) — "
+                "program version %s, %d feeds", self._step - 1, dt,
+                self.step_timeout, program._version, len(feed_arrays))
         for name, val in new_persist.items():
             scope.set(name, val)
 

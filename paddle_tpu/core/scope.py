@@ -9,7 +9,7 @@ device — the allocator story is PJRT's, per SURVEY §6).
 import numpy as np
 import jax
 
-__all__ = ["Scope", "global_scope", "scope_guard"]
+__all__ = ["Scope", "global_scope", "scope_guard", "live_array_stats"]
 
 
 class Scope:
@@ -69,6 +69,28 @@ class Scope:
             per_var[k] = nb
             total += nb
         return {"total_bytes": total, "vars": per_var}
+
+
+def live_array_stats():
+    """Process-wide live jax.Array summary (SURVEY §2.8 memory
+    introspection): every live device buffer, not just this scope's —
+    the BuddyAllocator-stats analog for the PJRT allocator."""
+    arrays = jax.live_arrays()
+    total = 0
+    by_dtype = {}
+    by_device = {}
+    for a in arrays:
+        try:
+            nb = a.nbytes
+        except Exception:
+            continue
+        total += nb
+        by_dtype[str(a.dtype)] = by_dtype.get(str(a.dtype), 0) + nb
+        for d in getattr(a, "devices", lambda: [])():
+            by_device[str(d)] = by_device.get(str(d), 0) + nb // max(
+                1, len(a.devices()))
+    return {"live_arrays": len(arrays), "total_bytes": total,
+            "by_dtype": by_dtype, "by_device": by_device}
 
 
 class _VarHandle:

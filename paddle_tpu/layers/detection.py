@@ -458,7 +458,20 @@ def yolov3_loss(x, gtbox, gtlabel, anchors, class_num, ignore_thresh,
                      {"Loss": [loss]},
                      {"anchors": list(anchors), "class_num": class_num,
                       "ignore_thresh": ignore_thresh,
-                      "downsample_ratio": downsample_ratio})
+                      "downsample_ratio": downsample_ratio,
+                      # ref yolov3_loss_op.h:387-392 scales each term
+                      "loss_weight_xy": 1.0 if loss_weight_xy is None
+                      else float(loss_weight_xy),
+                      "loss_weight_wh": 1.0 if loss_weight_wh is None
+                      else float(loss_weight_wh),
+                      "loss_weight_conf_target":
+                      1.0 if loss_weight_conf_target is None
+                      else float(loss_weight_conf_target),
+                      "loss_weight_conf_notarget":
+                      1.0 if loss_weight_conf_notarget is None
+                      else float(loss_weight_conf_notarget),
+                      "loss_weight_class": 1.0 if loss_weight_class is None
+                      else float(loss_weight_class)})
     return loss
 
 

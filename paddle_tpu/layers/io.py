@@ -8,6 +8,7 @@ drains automatically when no explicit feed covers their variables
 reader/open_files_op.cc). End of data raises core.EOFException exactly
 like the reference.
 """
+import logging
 import threading
 import queue as _queue
 
@@ -17,6 +18,8 @@ from .. import unique_name
 from ..core.framework import default_main_program
 from ..core.dtypes import convert_dtype
 from ..core import EOFException
+
+_LOG = logging.getLogger("paddle_tpu.py_reader")
 
 __all__ = ["data", "py_reader", "create_py_reader_by_data", "read_file",
            "double_buffer", "batch", "shuffle", "open_files",
@@ -58,6 +61,8 @@ class PyReader:
         self._q = None
         self._started = False
         self._END = object()
+        self._stats = {"polls": 0, "depth_sum": 0, "starved_polls": 0,
+                       "low_watermark": float("inf"), "high_watermark": 0}
 
     # -- decoration (ref decorate_paddle_reader / decorate_tensor_provider)
     def decorate_paddle_reader(self, reader):
@@ -131,11 +136,39 @@ class PyReader:
         """One batch as {var_name: array}; EOFException at end of data."""
         if not self._started:
             self.start()
+        # queue watermark accounting (SURVEY §2.8 stall detection): a
+        # consumer that keeps finding the queue empty is feed-starved —
+        # the producer thread (or upstream pipeline) is the stall.
+        depth = self._q.qsize()
+        self._stats["polls"] += 1
+        self._stats["depth_sum"] += depth
+        self._stats["low_watermark"] = min(self._stats["low_watermark"],
+                                           depth)
+        self._stats["high_watermark"] = max(self._stats["high_watermark"],
+                                            depth)
+        if depth == 0:
+            self._stats["starved_polls"] += 1
+            n = self._stats["starved_polls"]
+            if n in (10, 100) or n % 1000 == 0:
+                _LOG.warning(
+                    "py_reader feed starvation: queue empty on %d/%d "
+                    "polls (capacity %d) — the producer is the "
+                    "bottleneck", n, self._stats["polls"], self.capacity)
         item = self._q.get()
         if item is self._END:
             self._started = False
             raise EOFException("py_reader exhausted; call reset()+start()")
         return {v.name: a for v, a in zip(self.vars, item)}
+
+    def queue_stats(self):
+        """Watermark/starvation counters since construction."""
+        s = dict(self._stats)
+        s["capacity"] = self.capacity
+        if s["polls"]:
+            s["mean_depth"] = s["depth_sum"] / s["polls"]
+        if s["low_watermark"] == float("inf"):
+            s["low_watermark"] = 0
+        return s
 
 
 def _register_reader(reader, program=None):

@@ -42,17 +42,25 @@ def bottleneck_block(input, num_filters, stride, cardinality=32,
     return layers.elementwise_add(short, scale, act="relu")
 
 
-def se_resnext50(input, class_dim=1000):
+def se_resnext50(input, class_dim=1000, width=1.0, cardinality=32,
+                 reduction_ratio=16):
+    """width: channel multiplier (1.0 = the reference SE-ResNeXt-50;
+    tests train a narrow variant through the identical 50-layer stack)."""
+    # round UP to a multiple of cardinality: grouped convs need
+    # channels % groups == 0 at every width
+    w = lambda c: max(cardinality,
+                      -(-int(c * width) // cardinality) * cardinality)
     depth = [3, 4, 6, 3]
-    num_filters = [128, 256, 512, 1024]
-    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu")
+    num_filters = [w(128), w(256), w(512), w(1024)]
+    conv = conv_bn_layer(input, w(64), 7, stride=2, act="relu")
     conv = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1,
                          pool_type="max")
     for block in range(len(depth)):
         for i in range(depth[block]):
             conv = bottleneck_block(
                 conv, num_filters[block],
-                stride=2 if i == 0 and block != 0 else 1)
+                stride=2 if i == 0 and block != 0 else 1,
+                cardinality=cardinality, reduction_ratio=reduction_ratio)
     pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
     drop = layers.dropout(pool, dropout_prob=0.2)
     return layers.fc(drop, size=class_dim, act="softmax")

@@ -4,7 +4,12 @@ from .. import layers, nets
 __all__ = ["vgg16", "build_program"]
 
 
-def vgg16(input, class_dim=1000, use_bn=True):
+def vgg16(input, class_dim=1000, use_bn=True, width=1.0):
+    """width: channel multiplier (1.0 = the reference VGG-16; tests train
+    a narrow variant through the identical layer stack — XLA-CPU conv
+    grads at 512 channels are too slow for CI)."""
+    w = lambda c: max(1, int(c * width))
+
     def conv_block(x, num_filter, groups):
         return nets.img_conv_group(
             input=x, pool_size=2, pool_stride=2,
@@ -12,15 +17,15 @@ def vgg16(input, class_dim=1000, use_bn=True):
             conv_act="relu", conv_with_batchnorm=use_bn,
             pool_type="max")
 
-    conv1 = conv_block(input, 64, 2)
-    conv2 = conv_block(conv1, 128, 2)
-    conv3 = conv_block(conv2, 256, 3)
-    conv4 = conv_block(conv3, 512, 3)
-    conv5 = conv_block(conv4, 512, 3)
+    conv1 = conv_block(input, w(64), 2)
+    conv2 = conv_block(conv1, w(128), 2)
+    conv3 = conv_block(conv2, w(256), 3)
+    conv4 = conv_block(conv3, w(512), 3)
+    conv5 = conv_block(conv4, w(512), 3)
 
-    fc1 = layers.fc(conv5, size=512, act="relu")
+    fc1 = layers.fc(conv5, size=w(512), act="relu")
     fc1 = layers.dropout(fc1, dropout_prob=0.5)
-    fc2 = layers.fc(fc1, size=512, act="relu")
+    fc2 = layers.fc(fc1, size=w(512), act="relu")
     return layers.fc(fc2, size=class_dim, act="softmax")
 
 

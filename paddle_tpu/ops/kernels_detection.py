@@ -340,6 +340,7 @@ def _ssd_loss(ctx, ins, attrs):
     prior = ins["PriorBox"][0].reshape(-1, 4)     # [M, 4]
     pvar = ins["PriorVar"][0].reshape(-1, 4)
     ov = attrs.get("overlap_threshold", 0.5)
+    neg_ov = attrs.get("neg_overlap", 0.5)
     npr = attrs.get("neg_pos_ratio", 3.0)
     bg = attrs.get("background_label", 0)
     loc_w = attrs.get("loc_loss_weight", 1.0)
@@ -367,21 +368,26 @@ def _ssd_loss(ctx, ins, attrs):
         # softmax CE per prior
         logp = jax.nn.log_softmax(cf, axis=-1)
         ce = -jnp.take_along_axis(logp, tgt_lab[:, None], -1)[:, 0]
-        # hard negative mining (max_negative): keep top-k negatives by CE
+        # hard negative mining (max_negative): keep top-k negatives by CE,
+        # candidates restricted to unmatched priors with best overlap
+        # below neg_overlap (ref mine_hard_examples neg_dist_threshold)
         num_pos = pos.sum()
         num_neg = jnp.minimum((num_pos * npr).astype(jnp.int32),
                               jnp.asarray(M, jnp.int32))
-        neg_score = jnp.where(pos, -jnp.inf, ce)
+        neg_score = jnp.where(pos | (best >= neg_ov), -jnp.inf, ce)
         order = jnp.argsort(-neg_score)
         rank = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M, dtype=jnp.int32))
-        neg = (~pos) & (rank < num_neg)
+        neg = (~pos) & (rank < num_neg) & (best < neg_ov)
         conf_loss = jnp.where(pos | neg, ce, 0.0)
         total = conf_w * conf_loss + loc_w * loc_loss
-        if attrs.get("normalize", True):
-            total = total / jnp.maximum(num_pos.astype(jnp.float32), 1.0)
-        return total
+        return total, num_pos
 
-    loss = jax.vmap(per_image)(loc, conf, gt_box, gt_label)  # [B, M]
+    loss, num_pos = jax.vmap(per_image)(loc, conf, gt_box, gt_label)
+    if attrs.get("normalize", True):
+        # ref detection.py:1006-1008 divides by the BATCH-total matched
+        # count (reduce_sum of target_loc_weight), not per-image counts
+        total_pos = jnp.sum(num_pos).astype(jnp.float32)
+        loss = loss / jnp.maximum(total_pos, 1.0)
     return {"Loss": [loss]}
 
 
@@ -572,7 +578,9 @@ def _sample_quota(ctx, eligible, quota, total):
         noise = jnp.linspace(1.0, 0.0, n)
     score = jnp.where(eligible, 1.0 + noise, noise - 2.0)
     top, idx = jax.lax.top_k(score, quota)
-    return idx, top > 1.0
+    # >= : an eligible item with noise==0.0 scores exactly 1.0 and is
+    # still valid (ineligible branch maxes at -1.0, so no ambiguity)
+    return idx, top >= 1.0
 
 
 @kernel("rpn_target_assign")
@@ -706,16 +714,24 @@ def _generate_proposal_labels(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 @kernel("yolov3_loss")
 def _yolov3_loss(ctx, ins, attrs):
-    """ref detection/yolov3_loss_op.cc. x [B, A*(5+K), S, S]; gtbox
+    """ref detection/yolov3_loss_op.h. x [B, A*(5+K), S, S]; gtbox
     [B, G, 4] center-form (cx, cy, w, h) normalized to [0,1]; gtlabel
-    [B, G] (pad rows have w<=0). Losses: BCE on xy/conf/class, squared
-    error on wh, non-target conf ignored above ignore_thresh."""
+    [B, G] (pad rows have w<=0). Matches the reference form: MSE on
+    sigmoid(x/y) vs fractional offsets and on raw w/h vs log ratios (no
+    box-size re-weighting), BCE on conf/class, non-target conf ignored
+    above ignore_thresh, each term scaled by its loss_weight_* attr
+    (ref yolov3_loss_op.h:387-392)."""
     x = ins["X"][0]
     gtbox = ins["GTBox"][0]
     gtlabel = ins["GTLabel"][0]
     anchors = np.asarray(attrs["anchors"], np.float32).reshape(-1, 2)
     K = attrs["class_num"]
     ignore = attrs.get("ignore_thresh", 0.7)
+    w_xy = attrs.get("loss_weight_xy", 1.0)
+    w_wh = attrs.get("loss_weight_wh", 1.0)
+    w_ct = attrs.get("loss_weight_conf_target", 1.0)
+    w_cn = attrs.get("loss_weight_conf_notarget", 1.0)
+    w_cls = attrs.get("loss_weight_class", 1.0)
     B, _, S, _ = x.shape
     A = anchors.shape[0]
     an = jnp.asarray(anchors)                      # pixels of input scale
@@ -729,7 +745,7 @@ def _yolov3_loss(ctx, ins, attrs):
     def one(gb, gl, ptx, pty, ptw, pth, pconf, pcls):
         # build targets by scanning over gt entries
         obj = jnp.zeros((A, S, S))
-        tgt = jnp.zeros((6, A, S, S))              # x,y,w,h,cls, scale
+        tgt = jnp.zeros((5, A, S, S))              # x,y,w,h,cls
         def body(carry, g):
             obj, tgt = carry
             box, lab = g[:4], g[4].astype(jnp.int32)
@@ -747,19 +763,19 @@ def _yolov3_loss(ctx, ins, attrs):
                 box[0] * S - gi, box[1] * S - gj,
                 jnp.log(jnp.maximum(gw / an[a, 0], 1e-9)),
                 jnp.log(jnp.maximum(gh / an[a, 1], 1e-9)),
-                lab.astype(jnp.float32),
-                2.0 - box[2] * box[3]])
+                lab.astype(jnp.float32)])
             old = tgt[:, a, gj, gi]
             tgt = tgt.at[:, a, gj, gi].set(jnp.where(valid, vals, old))
             return (obj, tgt), None
         g = jnp.concatenate([gb, gl[:, None].astype(gb.dtype)], -1)
         (obj, tgt), _ = jax.lax.scan(body, (obj, tgt), g)
-        scale = tgt[5]
         bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
             jnp.log1p(jnp.exp(-jnp.abs(logit)))
-        loss_xy = (obj * scale * (bce(ptx, tgt[0]) + bce(pty, tgt[1]))).sum()
-        loss_wh = (obj * scale * ((ptw - tgt[2]) ** 2 +
-                                  (pth - tgt[3]) ** 2) * 0.5).sum()
+        # ref CalcMSEWithWeight: MSE on sigmoid(x/y) vs offsets, raw wh
+        loss_xy = (obj * ((jax.nn.sigmoid(ptx) - tgt[0]) ** 2 +
+                          (jax.nn.sigmoid(pty) - tgt[1]) ** 2)).sum()
+        loss_wh = (obj * ((ptw - tgt[2]) ** 2 +
+                          (pth - tgt[3]) ** 2)).sum()
         # conf: positives get 1; no-object cells whose DECODED box
         # overlaps any gt above ignore_thresh are excluded (ref yolov3
         # "ignore" semantics)
@@ -778,12 +794,13 @@ def _yolov3_loss(ctx, ins, attrs):
         best_iou = jnp.max(jnp.where(gvalid[None, :], iou_pg, 0.0),
                            axis=1).reshape(A, S, S)
         noobj = (1.0 - obj) * (best_iou <= ignore)
-        loss_conf = (obj * bce(pconf, jnp.ones_like(pconf)) +
-                     noobj * bce(pconf, jnp.zeros_like(pconf))).sum()
+        loss_conf_t = (obj * bce(pconf, jnp.ones_like(pconf))).sum()
+        loss_conf_nt = (noobj * bce(pconf, jnp.zeros_like(pconf))).sum()
         onehot = jax.nn.one_hot(tgt[4].astype(jnp.int32), K,
                                 axis=0).transpose(1, 0, 2, 3)
         loss_cls = (obj[:, None] * bce(pcls, onehot)).sum()
-        return loss_xy + loss_wh + loss_conf + loss_cls
+        return (w_xy * loss_xy + w_wh * loss_wh + w_ct * loss_conf_t +
+                w_cn * loss_conf_nt + w_cls * loss_cls)
 
     loss = jax.vmap(one)(gtbox, gtlabel, tx, ty, tw, th, tconf, tcls)
     return {"Loss": [loss]}

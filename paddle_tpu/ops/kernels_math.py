@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import kernel
+from .registry import kernel, autocast
 
 
 def _x(ins, slot="X"):
@@ -170,7 +170,7 @@ def _thresholded_relu(ctx, ins, attrs):
 @kernel("mul")
 def _mul(ctx, ins, attrs):
     """ref operators/mul_op.cc: flatten x to 2-D at x_num_col_dims, matmul."""
-    x, y = ins["X"][0], ins["Y"][0]
+    x, y = autocast(ins["X"][0], ins["Y"][0])
     xn = attrs.get("x_num_col_dims", 1)
     yn = attrs.get("y_num_col_dims", 1)
     xs, ys = x.shape, y.shape
@@ -183,7 +183,7 @@ def _mul(ctx, ins, attrs):
 
 @kernel("matmul", "matmul_v2")
 def _matmul(ctx, ins, attrs):
-    x, y = ins["X"][0], ins["Y"][0]
+    x, y = autocast(ins["X"][0], ins["Y"][0])
     if attrs.get("transpose_X", attrs.get("trans_x", False)):
         x = jnp.swapaxes(x, -1, -2)
     if attrs.get("transpose_Y", attrs.get("trans_y", False)):
@@ -197,7 +197,7 @@ def _matmul(ctx, ins, attrs):
 
 @kernel("bmm")
 def _bmm(ctx, ins, attrs):
-    return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}
+    return {"Out": [jnp.matmul(*autocast(ins["X"][0], ins["Y"][0]))]}
 
 
 @kernel("dot")

@@ -15,9 +15,27 @@ Kernel signature:
     .place    the target Place
 """
 
-__all__ = ["kernel", "get_kernel", "has_kernel", "KernelCtx", "KERNELS"]
+__all__ = ["kernel", "get_kernel", "has_kernel", "KernelCtx", "KERNELS",
+           "autocast"]
 
 KERNELS = {}
+
+
+def autocast(*arrays):
+    """AMP dtype alignment for MXU ops: if float operand dtypes are mixed
+    and any is bfloat16, compute in bfloat16 (amp.cast_program_to_bf16
+    keeps feeds/norm-params fp32, so conv(img_fp32, w_bf16) is the normal
+    autocast boundary — the reference float16 transpiler inserted explicit
+    cast ops here)."""
+    import numpy as np
+    import jax.numpy as jnp
+    floats = [a for a in arrays if jnp.issubdtype(a.dtype, jnp.floating)]
+    dts = {np.dtype(a.dtype) for a in floats}
+    if len(dts) > 1 and np.dtype(jnp.bfloat16) in dts:
+        return tuple(a.astype(jnp.bfloat16)
+                     if jnp.issubdtype(a.dtype, jnp.floating) else a
+                     for a in arrays)
+    return arrays
 
 
 class KernelCtx:

@@ -37,10 +37,23 @@ def is_first_worker():
 
 
 def barrier_all():
-    # blocking collective across all devices
+    """Blocking barrier: a real psum collective over ALL devices (and a
+    host-level sync across processes when running multi-host) — the
+    NCCL/gRPC barrier analog, not a single-device no-op."""
+    import numpy as np
     import jax.numpy as jnp
-    jax.block_until_ready(
-        jax.jit(lambda x: x + 1)(jnp.zeros(())))
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("fleet_barrier_all")
+        return
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("all",))
+    f = jax.jit(
+        jax.shard_map(lambda x: jax.lax.psum(x, "all"), mesh=mesh,
+                      in_specs=P("all"), out_specs=P()),
+        in_shardings=NamedSharding(mesh, P("all")))
+    jax.block_until_ready(f(jnp.ones(len(devs))))
 
 
 def distributed_optimizer(optimizer, strategy=None):

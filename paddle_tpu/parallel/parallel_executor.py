@@ -1,11 +1,17 @@
-"""ParallelExecutor — data-parallel training over the local mesh.
+"""ParallelExecutor — distributed training over the local mesh.
 
 Parity: python/paddle/fluid/parallel_executor.py. The reference builds a
 multi-GPU SSA graph with NCCL all-reduce nodes per gradient; here the
-SAME traced step function is jitted with batch-sharded feed inputs over a
-1-D dp mesh — XLA keeps global-batch semantics (loss/grads identical to
-single device) and inserts the gradient all-reduce over ICI itself.
-BuildStrategy/ExecutionStrategy are accepted for API parity.
+SAME traced step function is jitted with sharded inputs over the mesh —
+XLA keeps global-batch semantics (loss/grads identical to single device)
+and inserts the collectives over ICI itself.
+
+Beyond plain dp, a DistributeTranspiler (parallel/transpiler.py — the
+distribute_transpiler.py analog) can be attached: its sharding table is
+applied to params AND optimizer state, giving Megatron tensor parallel
+(tp axis) and ZeRO-style optimizer-state sharding (mode="zero", the
+pserver analog) THROUGH this executor — the scope then holds genuinely
+sharded jax.Arrays between steps.
 """
 import numpy as np
 import jax
@@ -25,11 +31,19 @@ class ParallelExecutor:
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None,
                  build_strategy=None, num_trainers=1, trainer_id=0,
-                 scope=None, mesh=None, use_tpu=None):
+                 scope=None, mesh=None, use_tpu=None, transpiler=None):
         self.program = main_program or default_main_program()
         self.loss_name = loss_name
         self.scope = scope or global_scope()
-        self.mesh = mesh if mesh is not None else local_mesh("dp")
+        self.transpiler = transpiler
+        if transpiler is not None:
+            if transpiler.mesh is None:
+                transpiler.transpile(program=self.program)
+            self.mesh = transpiler.mesh
+            self._shardings = transpiler.shardings()
+        else:
+            self.mesh = mesh if mesh is not None else local_mesh("dp")
+            self._shardings = {}
         self._cache = {}
         self._step = 0
         self._replicated = NamedSharding(self.mesh, P())
@@ -39,9 +53,12 @@ class ParallelExecutor:
         return int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
 
     def _feed_sharding(self, arr):
-        if arr.ndim == 0:
+        if arr.ndim == 0 or "dp" not in self.mesh.shape:
             return self._replicated
         return NamedSharding(self.mesh, P("dp", *([None] * (arr.ndim - 1))))
+
+    def _param_sharding(self, name):
+        return self._shardings.get(name, self._replicated)
 
     def run(self, fetch_list=None, feed=None, feed_dict=None,
             return_numpy=True, is_test=False):
@@ -54,25 +71,29 @@ class ParallelExecutor:
         key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
 
+        dp = self.mesh.shape.get("dp", 1)
         feed_arrays = {}
         for k, v in feed.items():
             var = program.global_block().vars.get(k)
             dt = as_jnp_dtype(var.dtype) if var is not None else None
             arr = jnp.asarray(np.asarray(v), dtype=dt)
-            if arr.ndim > 0 and arr.shape[0] % self.mesh.shape.get("dp", 1) != 0:
+            if arr.ndim > 0 and arr.shape[0] % dp != 0:
                 raise ValueError(
                     f"feed {k!r} batch {arr.shape[0]} not divisible by "
-                    f"dp={self.mesh.shape.get('dp', 1)}")
+                    f"dp={dp}")
             feed_arrays[k] = jax.device_put(arr, self._feed_sharding(arr))
 
         persist = {}
+        persist_sh = {}
         for v in program.persistable_vars():
             val = self.scope.get(v.name)
             if val is None:
                 raise RuntimeError(
                     f"persistable var {v.name!r} not initialized; run the "
                     f"startup program on a plain Executor first")
-            persist[v.name] = jax.device_put(val, self._replicated)
+            sh = self._param_sharding(v.name)
+            persist_sh[v.name] = sh
+            persist[v.name] = jax.device_put(val, sh)
 
         sig = tuple(sorted((k, v.shape, str(v.dtype))
                            for k, v in feed_arrays.items()))
@@ -81,13 +102,24 @@ class ParallelExecutor:
         fn = self._cache.get(ckey)
         if fn is None:
             step_fn = build_step_fn(program, fetch_names, is_test, None)
+
+            def wrapped(persist_in, feed_in, key_in, _step=step_fn,
+                        _sh=dict(persist_sh)):
+                fetches, new_persist = _step(persist_in, feed_in, key_in)
+                # pin state outputs to their input layout so the scope
+                # keeps genuinely sharded arrays between steps (tp/ZeRO)
+                new_persist = {
+                    n: jax.lax.with_sharding_constraint(v, _sh[n])
+                    if n in _sh else v
+                    for n, v in new_persist.items()}
+                return fetches, new_persist
+
             fn = jax.jit(
-                step_fn,
+                wrapped,
                 in_shardings=(
-                    {n: self._replicated for n in persist},
+                    persist_sh,
                     {n: self._feed_sharding(feed_arrays[n]) for n in feed_arrays},
                     self._replicated),
-                out_shardings=None,
                 donate_argnums=(0,))
             self._cache[ckey] = fn
 

@@ -5,18 +5,27 @@ added pipeline stages. TPU-native GPipe-style schedule: stage functions
 run under shard_map over `pp`, microbatches stream through with
 lax.scan + ppermute handing activations to the next stage over ICI.
 
-This module provides the generic schedule for stage functions expressed
-as pure JAX callables (models built with the Program IR can export one
-via core/trace.build_step_fn on a sub-program).
+Two layers of API:
+- pipeline_forward / gpipe_schedule: the generic schedule for stage
+  functions expressed as pure JAX callables.
+- PipelineTrainer: TRAINING integrated with the Program IR — splits a
+  built Program (with backward_macro + optimizer ops from
+  optimizer.minimize) into stages at caller-named activation
+  boundaries, runs the GPipe forward under shard_map, and gets the
+  backward schedule from jax.value_and_grad: the transpose of the
+  stage-to-stage ppermute IS the reverse permute, so gradients flow
+  across stage boundaries over the same ICI links, microbatch by
+  microbatch, without hand-written backward plumbing. The Program's own
+  optimizer ops then apply the updates.
 """
 import functools
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["pipeline_forward", "gpipe_schedule"]
+__all__ = ["pipeline_forward", "gpipe_schedule", "PipelineTrainer"]
 
 
 def pipeline_forward(mesh, stage_fn, params_per_stage, x, n_microbatch,
@@ -90,3 +99,227 @@ def gpipe_schedule(n_microbatch, n_stages):
             if 0 <= m < n_microbatch:
                 table[(t, s)] = m
     return table
+
+
+class PipelineTrainer:
+    """GPipe training of a Program over the `pp` mesh axis.
+
+    Parity: the reference scaled depth via pserver param placement
+    (transpiler/distribute_transpiler.py); this is the TPU-native
+    replacement — stage ops stay on their pp member, activations hop
+    stage→stage via ppermute, gradients hop back via the AD-transposed
+    permute, and the Program's optimizer ops run on the accumulated
+    grads (true GPipe: updates apply after all microbatches).
+
+    Constraints (the homogeneous-block case — transformer/MLP stacks):
+    - `boundaries` names n_stages-1 activation vars splitting the
+      forward op list into contiguous segments;
+    - every stage must hold the same NUMBER and SHAPES of trainable
+      params (stage i's params live on pp member i, stacked leaf-wise);
+    - the boundary activations must share one shape [B, ...].
+    """
+
+    def __init__(self, program, loss_name, boundaries, mesh,
+                 n_microbatch=4, axis_name="pp", scope=None):
+        from ..core.trace import exec_op, _find_backward
+        from ..core.framework import grad_var_name
+        from ..core.scope import global_scope
+        self.program = program
+        self.loss_name = loss_name if isinstance(loss_name, str) \
+            else loss_name.name
+        self.mesh = mesh
+        self.axis = axis_name
+        self.n_mb = n_microbatch
+        self.scope = scope or global_scope()
+        self.n_stages = mesh.shape[axis_name]
+
+        block = program.global_block()
+        ops = list(block.ops)
+        bi = _find_backward(ops)
+        if bi is None:
+            raise ValueError("program has no backward; call "
+                             "optimizer.minimize(loss) first")
+        fwd_ops, self._bwd_op = ops[:bi], ops[bi]
+        self._update_ops = ops[bi + 1:]
+
+        # split forward ops at the boundary-producing ops
+        if len(boundaries) != self.n_stages - 1:
+            raise ValueError(f"need {self.n_stages - 1} boundaries for "
+                             f"{self.n_stages} stages")
+        self.boundaries = list(boundaries)
+        cut_after = {}
+        for i, op in enumerate(fwd_ops):
+            for b in boundaries:
+                if b in op.output_names():
+                    cut_after[b] = i
+        missing = [b for b in boundaries if b not in cut_after]
+        if missing:
+            raise ValueError(f"boundary vars not produced: {missing}")
+        cuts = sorted(cut_after[b] for b in boundaries)
+        segs = []
+        lo = 0
+        for c in cuts:
+            segs.append(fwd_ops[lo:c + 1])
+            lo = c + 1
+        segs.append(fwd_ops[lo:])
+        self.segments = segs
+
+        # per-stage trainable params (deterministic first-use order)
+        persistable = {v.name: v for v in program.persistable_vars()}
+        bwd_params = set(self._bwd_op.attrs["param_names"])
+        self.stage_params = []
+        for seg in segs:
+            names, seen = [], set()
+            for op in seg:
+                for n in op.input_names():
+                    if n in bwd_params and n not in seen:
+                        seen.add(n)
+                        names.append(n)
+            self.stage_params.append(names)
+        shapes0 = [tuple(persistable[n].shape) for n in self.stage_params[0]]
+        for i, names in enumerate(self.stage_params):
+            sh = [tuple(persistable[n].shape) for n in names]
+            if sh != shapes0:
+                raise NotImplementedError(
+                    f"pipeline stages must be homogeneous: stage 0 params "
+                    f"{shapes0} vs stage {i} {sh}")
+        self._block = block
+        self._exec_op = exec_op
+        self._grad_name = grad_var_name
+        self._jit_cache = {}
+        self._step = 0
+
+    # ------------------------------------------------------------------
+    def _stage_branch(self, si, feed_names):
+        """Branch fn for stage si: (param_list, h, feed_slice, key) ->
+        (h_out, loss)."""
+        seg = self.segments[si]
+        in_b = None if si == 0 else self.boundaries[si - 1]
+        out_b = self.boundaries[si] if si < self.n_stages - 1 else None
+        pnames = self.stage_params[si]
+        exec_op = self._exec_op
+        block = self._block
+
+        def branch(params, h, feed, key):
+            env = dict(zip(feed_names, feed))
+            env.update(dict(zip(pnames, params)))
+            if in_b is not None:
+                env[in_b] = h
+            for j, op in enumerate(seg):
+                exec_op(env, op, si * 10000 + j, key, False, None, block)
+            if out_b is not None:
+                return env[out_b], jnp.zeros((), jnp.float32)
+            loss = env[self.loss_name]
+            return jnp.zeros_like(h), jnp.sum(loss.astype(jnp.float32))
+
+        return branch
+
+    def _build_fn(self, feed_names):
+        n_stages, n_mb, axis = self.n_stages, self.n_mb, self.axis
+        branches = [self._stage_branch(si, feed_names)
+                    for si in range(n_stages)]
+
+        def per_member(stacked, feed_mb, key):
+            """One pp member. stacked: leaves [1, ...] (local shard of the
+            stage-stacked params); feed_mb: [n_mb, mb, ...] replicated."""
+            params = [p[0] for p in stacked]
+            stage = lax.axis_index(axis)
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            n_steps = n_mb + n_stages - 1
+
+            def first_shape():
+                # boundary activation shape: run stage 0 shape-only
+                mb0 = jax.tree.map(lambda a: a[0], feed_mb)
+                h0, _ = jax.eval_shape(branches[0], params, 0.0, mb0, key)
+                return h0
+
+            hshape = first_shape()
+            h0 = jnp.zeros(hshape.shape, hshape.dtype)
+
+            def step(carry, t):
+                inflight, loss_sum = carry
+                mb_idx = jnp.clip(t - stage, 0, n_mb - 1)
+                mb = jax.tree.map(lambda a: a[mb_idx], feed_mb)
+                h_out, loss = lax.switch(
+                    stage, branches, params, inflight, mb,
+                    jax.random.fold_in(key, t))
+                valid = (t >= stage) & (t - stage < n_mb)
+                loss_sum = loss_sum + jnp.where(valid, loss, 0.0)
+                nxt = lax.ppermute(h_out, axis, perm)
+                return (nxt, loss_sum), None
+
+            (_, loss_sum), _ = lax.scan(
+                step, (h0, jnp.zeros((), jnp.float32)),
+                jnp.arange(n_steps))
+            # only the LAST stage produced loss; psum replicates the total
+            return lax.psum(loss_sum, axis) / n_mb
+
+        in_specs = ([P(axis)] * len(self.stage_params[0]), P(), P())
+        sm = jax.shard_map(per_member, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=P(), check_vma=False)
+
+        def train_loss(stacked, feed_mb, key):
+            return sm(stacked, feed_mb, key)
+
+        def step_fn(persist, feed_mb, key):
+            stacked = [
+                jnp.stack([persist[self.stage_params[s][i]]
+                           for s in range(n_stages)])
+                for i in range(len(self.stage_params[0]))]
+            loss, grads = jax.value_and_grad(train_loss)(
+                stacked, feed_mb, key)
+            env = dict(persist)
+            for i in range(len(grads)):
+                for s in range(n_stages):
+                    pname = self.stage_params[s][i]
+                    env[self._grad_name(pname)] = grads[i][s].astype(
+                        env[pname].dtype)
+            for j, op in enumerate(self._update_ops):
+                self._exec_op(env, op, 900000 + j, key, False, None,
+                              self._block)
+            new_persist = {n: env[n] for n in persist if n in env}
+            return loss, new_persist
+
+        return step_fn
+
+    # ------------------------------------------------------------------
+    def run(self, feed, fetch_loss=True):
+        """One GPipe training step over the microbatched feed."""
+        import numpy as np
+        from ..core.dtypes import as_jnp_dtype
+        feed_names = sorted(feed)
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.program.random_seed), self._step)
+        self._step += 1
+        feed_mb = []
+        for k in feed_names:
+            arr = np.asarray(feed[k])
+            var = self._block.vars.get(k)
+            dt = as_jnp_dtype(var.dtype) if var is not None else None
+            if arr.shape[0] % self.n_mb:
+                raise ValueError(
+                    f"batch {arr.shape[0]} must divide into "
+                    f"{self.n_mb} microbatches")
+            a = jnp.asarray(arr, dtype=dt)
+            feed_mb.append(a.reshape((self.n_mb, arr.shape[0] // self.n_mb)
+                                     + arr.shape[1:]))
+
+        persist = {}
+        for v in self.program.persistable_vars():
+            val = self.scope.get(v.name)
+            if val is None:
+                raise RuntimeError(f"{v.name!r} not initialized; run the "
+                                   f"startup program first")
+            persist[v.name] = jnp.asarray(val)
+
+        ck = tuple((k, tuple(a.shape), str(a.dtype))
+                   for k, a in zip(feed_names, feed_mb))
+        fn = self._jit_cache.get(ck)
+        if fn is None:
+            step = self._build_fn(feed_names)
+            fn = jax.jit(step)
+            self._jit_cache[ck] = fn
+        loss, new_persist = fn(persist, feed_mb, key)
+        for n, v in new_persist.items():
+            self.scope.set(n, v)
+        return float(np.asarray(loss))

@@ -52,17 +52,37 @@ class DistributeTranspiler:
         ndev = len(jax.devices())
         dp = cfg.dp or max(1, ndev // (cfg.tp * cfg.sp * cfg.pp))
         self.mesh = make_mesh(dp=dp, tp=cfg.tp, sp=cfg.sp, pp=cfg.pp)
-        names = [v.name for v in self.program.persistable_vars()]
+        pvars = list(self.program.persistable_vars())
+        shapes = {v.name: tuple(int(s) for s in (v.shape or ()))
+                  for v in pvars}
+        names = list(shapes)
         repl = NamedSharding(self.mesh, P())
         shardings = {n: repl for n in names}
+
+        def fits(name, spec):
+            """Spec applies only if the var's shape tiles onto the mesh
+            axes (the reference's slice_variable analog: a param that
+            can't split stays replicated)."""
+            shape = shapes[name]
+            if len(shape) < len(spec):
+                return False
+            for dim, ax in zip(shape, spec):
+                if ax is None:
+                    continue
+                if dim % self.mesh.shape[ax] != 0:
+                    return False
+            return True
+
         if cfg.tp > 1:
             rules = cfg.tp_rules or megatron_rules()
             for n in names:
                 spec = rules.spec(n)
-                if spec != P():
+                if spec != P() and fits(n, spec):
                     shardings[n] = NamedSharding(self.mesh, spec)
         if cfg.mode == "zero":
-            shardings.update(zero_stage(self.mesh, names, axis="dp"))
+            for n, sh in zero_stage(self.mesh, names, axis="dp").items():
+                if sh.spec == P() or fits(n, sh.spec):
+                    shardings[n] = sh
         self._shardings = shardings
         return self
 

@@ -1,52 +1,66 @@
-"""Parameter-server dispatchers.
+"""Variable → owner assignment policies.
 
-Parity: python/paddle/fluid/transpiler/ps_dispatcher.py — map variables
-onto pserver endpoints. On TPU the analog is assigning optimizer-state
-shards to mesh coordinates (ZeRO-style); these classes keep the
-reference API for distribute-transpiler callers.
+API parity with the reference's pserver dispatchers
+(python/paddle/fluid/transpiler/ps_dispatcher.py), re-purposed for the
+TPU design: there are no pserver endpoints, so the "endpoints" these
+policies cycle/hash over are the ZeRO shard owners — the dp-axis mesh
+members that hold a variable's optimizer-state shard
+(parallel/sharding.py:zero_stage is the layout these feed).
 """
+import itertools
+import zlib
 
 __all__ = ["PSDispatcher", "HashName", "RoundRobin"]
 
 
 class PSDispatcher:
-    def __init__(self, pserver_endpoints):
-        self._eps = list(pserver_endpoints)
-        self._step = 0
+    """Base policy: assign each var (or var block) an owner from `eplist`
+    — a list of endpoint strings for API compat, or mesh coordinates."""
+
+    def __init__(self, eplist):
+        self._eplist = list(eplist)
 
     @property
     def eps(self):
-        return self._eps
+        return self._eplist
 
     def reset(self):
-        self._step = 0
+        pass
 
     def dispatch(self, varlist):
-        raise NotImplementedError("Interface has not been implemented.")
+        raise NotImplementedError
+
+    def owner(self, var):
+        """Single-var convenience: owner of `var` under this policy."""
+        return self.dispatch([var])[0]
+
+
+def _var_name(v):
+    name = getattr(v, "name", v)
+    return name() if callable(name) else name
 
 
 class HashName(PSDispatcher):
-    """ref ps_dispatcher.py:HashName — endpoint = hash(var name) % n."""
-
-    def _hash_block(self, block_str, total):
-        return hash(block_str) % total
+    """Stable content-hash assignment: the same var name always lands on
+    the same owner regardless of dispatch order (crc32, not Python's
+    salted hash, so placements are reproducible across processes)."""
 
     def dispatch(self, varlist):
-        eplist = []
-        for var in varlist:
-            server_id = self._hash_block(var.name(), len(self._eps)) \
-                if callable(getattr(var, "name", None)) \
-                else self._hash_block(var.name, len(self._eps))
-            eplist.append(self._eps[server_id])
-        return eplist
+        n = len(self._eplist)
+        return [self._eplist[zlib.crc32(str(_var_name(v)).encode()) % n]
+                for v in varlist]
 
 
 class RoundRobin(PSDispatcher):
-    """ref ps_dispatcher.py:RoundRobin — cycle endpoints in order."""
+    """Cyclic assignment in dispatch order (balances shard count, not
+    bytes — use HashName for order-independent placement)."""
+
+    def __init__(self, eplist):
+        super().__init__(eplist)
+        self._cycle = itertools.cycle(range(len(self._eplist)))
+
+    def reset(self):
+        self._cycle = itertools.cycle(range(len(self._eplist)))
 
     def dispatch(self, varlist):
-        eplist = []
-        for _ in varlist:
-            eplist.append(self._eps[self._step])
-            self._step = (self._step + 1) % len(self._eps)
-        return eplist
+        return [self._eplist[next(self._cycle)] for _ in varlist]

@@ -1,0 +1,145 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU).
+
+Covers VERDICT r1 item 3: forward AND backward numerics vs the unfused
+jnp reference (bias x causal grid), and proof that the kernel — not the
+jnp fallback — is on the flagship transformer's training path under
+jax.value_and_grad (trace-time counter + loss parity with the fallback).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def _rand_qkv(rng, B=2, H=2, T=32, S=None, D=16):
+    S = S or T
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    return q, k, v
+
+
+def _pad_bias(rng, B, S):
+    lens = rng.randint(S // 2, S + 1, (B,))
+    mask = (np.arange(S)[None, :] < lens[:, None]).astype("float32")
+    return jnp.asarray((mask - 1.0) * 1e9)     # 0 keep / -1e9 pad
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_flash_forward_matches_reference(causal, with_bias):
+    rng = np.random.RandomState(0)
+    q, k, v = _rand_qkv(rng)
+    bias = _pad_bias(rng, q.shape[0], k.shape[2]) if with_bias else None
+    out = fa.flash_attention(q, k, v, bias=bias, causal=causal,
+                             interpret=True)
+    ref = fa.flash_attention_reference(q, k, v, bias=bias, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_forward_cross_attention():
+    """T != S (decoder cross-attention shape)."""
+    rng = np.random.RandomState(1)
+    q, k, v = _rand_qkv(rng, T=16, S=32)
+    bias = _pad_bias(rng, 2, 32)
+    out = fa.flash_attention(q, k, v, bias=bias, interpret=True)
+    ref = fa.flash_attention_reference(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_flash_backward_matches_reference(causal, with_bias):
+    rng = np.random.RandomState(2)
+    q, k, v = _rand_qkv(rng)
+    bias = _pad_bias(rng, q.shape[0], k.shape[2]) if with_bias else None
+    g = jnp.asarray(rng.randn(*q.shape).astype("float32"))
+
+    def loss_fa(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, bias=bias,
+                                          causal=causal, interpret=True) * g)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(fa.flash_attention_reference(q, k, v, bias=bias,
+                                                    causal=causal) * g)
+
+    dq, dk, dv = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_multiblock_tiling():
+    """Sequence longer than one block: online softmax across k blocks."""
+    rng = np.random.RandomState(3)
+    q, k, v = _rand_qkv(rng, B=1, H=1, T=64, D=8)
+    out = fa.flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                             interpret=True)
+    ref = fa.flash_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def _train_transformer_loss(steps=2):
+    """One tiny transformer Adam step sequence; returns losses."""
+    from paddle_tpu.models import transformer as tfm
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            cfg = tfm.TransformerConfig(src_vocab=50, trg_vocab=50,
+                                        max_len=16, d_model=32, d_inner=64,
+                                        n_head=2, n_layer=1, dropout=0.0)
+            feeds, avg_cost, tok = tfm.build_program(cfg, maxlen=16)
+            pt.optimizer.Adam(1e-3).minimize(avg_cost)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    B, T = 4, 16
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for i in range(steps):
+            src = rng.randint(3, cfg.src_vocab, (B, T)).astype("int64")
+            trg = np.concatenate([np.zeros((B, 1), "int64"),
+                                  (src[:, :-1] + 1) % cfg.trg_vocab],
+                                 axis=1)
+            out = exe.run(main, feed={
+                "src": src, "src_len": np.full(B, T, "int64"),
+                "trg": trg, "trg_len": np.full(B, T, "int64"),
+                "label": (src + 1) % cfg.trg_vocab},
+                fetch_list=[avg_cost])
+            losses.append(float(out[0]))
+    return losses
+
+
+def test_flash_active_on_transformer_training_path():
+    """The Pallas kernel (not the fallback) runs under value_and_grad on
+    the flagship model, and its training numerics match the fallback."""
+    before = fa.STATS["pallas_calls"]
+    fa.set_mode("interpret")
+    try:
+        losses_flash = _train_transformer_loss()
+    finally:
+        fa.set_mode("auto")
+    calls = fa.STATS["pallas_calls"] - before
+    # 1 enc self + 1 dec self + 1 dec cross per layer, traced fwd + replay
+    assert calls >= 3, f"flash kernel not traced ({calls} calls)"
+    assert np.isfinite(losses_flash).all()
+
+    # same seeds, jnp fallback path → numerics must agree
+    fa.set_mode("off")
+    try:
+        losses_ref = _train_transformer_loss()
+    finally:
+        fa.set_mode("auto")
+    np.testing.assert_allclose(losses_flash, losses_ref, rtol=2e-4,
+                               atol=2e-4)

