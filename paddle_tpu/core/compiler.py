@@ -26,7 +26,9 @@ class BuildStrategy:
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
         self.gradient_scale_strategy = \
             BuildStrategy.GradientScaleStrategy.CoeffNumDevice
-        self.memory_optimize = True
+        # opt-in like the reference: wires to Program._remat
+        # (jax.checkpoint rematerialization) when the program compiles
+        self.memory_optimize = False
         self.enable_inplace = True
         self.fuse_all_reduce_ops = True
 
@@ -53,6 +55,11 @@ class CompiledProgram:
         self.loss_name = loss_name
         if build_strategy:
             self.build_strategy = build_strategy
+        if self.build_strategy.memory_optimize:
+            # backward recomputes forward activations (jax.checkpoint in
+            # core/trace.py) instead of keeping them in HBM
+            self.program._remat = True
+            self.program._bump_version()
         self.share_vars_from = share_vars_from
         self.places = places
         return self

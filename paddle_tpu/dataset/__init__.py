@@ -14,4 +14,11 @@ from . import imdb
 from . import imikolov
 from . import movielens
 from . import wmt16
+from . import wmt14
+from . import conll05
+from . import sentiment
+from . import flowers
+from . import voc2012
+from . import mq2007
+from . import image
 from . import common

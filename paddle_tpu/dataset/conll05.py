@@ -1,0 +1,88 @@
+"""CoNLL-2005 SRL dataset (ref python/paddle/dataset/conll05.py).
+
+Samples are the reference's 9 slots per (sentence, predicate) pair:
+(word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark,
+label_idx) — the five context slots are the predicate window broadcast
+over the sentence, mark flags the window, labels are BIO SRL tags.
+Synthetic fallback: role labels correlate with position relative to the
+predicate so an SRL tagger can actually learn.
+"""
+import numpy as np
+
+__all__ = ["test", "get_dict", "get_embedding"]
+
+WORD_DICT_LEN = 4000
+PRED_DICT_LEN = 300
+# BIO tagset: O + B/I for a handful of core roles + B-V
+_ROLES = ["A0", "A1", "A2", "AM-TMP", "AM-LOC"]
+_LABELS = ["O", "B-V"] + [f"{bi}-{r}" for r in _ROLES for bi in ("B", "I")]
+LABEL_DICT_LEN = len(_LABELS)
+UNK_IDX = 0
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) — name → id."""
+    word_dict = {f"w{i}": i for i in range(WORD_DICT_LEN)}
+    verb_dict = {f"v{i}": i for i in range(PRED_DICT_LEN)}
+    label_dict = {l: i for i, l in enumerate(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding(emb_dim=32):
+    """Deterministic word-embedding table (the reference ships a
+    pretrained table; offline we provide a fixed random one)."""
+    rng = np.random.RandomState(17)
+    return rng.randn(WORD_DICT_LEN, emb_dim).astype("float32") * 0.1
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            sen_len = int(rng.randint(5, 40))
+            words = rng.randint(1, WORD_DICT_LEN, sen_len)
+            verb_index = int(rng.randint(0, sen_len))
+            pred = int(rng.randint(0, PRED_DICT_LEN))
+            # roles correlate with signed distance to the predicate
+            labels = []
+            for i in range(sen_len):
+                d = i - verb_index
+                if d == 0:
+                    labels.append("B-V")
+                elif -3 <= d < 0:
+                    labels.append("B-A0" if d == -3 else "I-A0")
+                elif 0 < d <= 3:
+                    labels.append("B-A1" if d == 1 else "I-A1")
+                else:
+                    labels.append("O")
+            label_dict = {l: i for i, l in enumerate(_LABELS)}
+
+            def ctx(off, default):
+                j = verb_index + off
+                return int(words[j]) if 0 <= j < sen_len else default
+
+            mark = [0] * sen_len
+            for off in (-2, -1, 0, 1, 2):
+                j = verb_index + off
+                if 0 <= j < sen_len:
+                    mark[j] = 1
+            word_idx = words.tolist()
+            bos, eos = 0, 0
+            yield (word_idx,
+                   [ctx(-2, bos)] * sen_len, [ctx(-1, bos)] * sen_len,
+                   [ctx(0, bos)] * sen_len,
+                   [ctx(1, eos)] * sen_len, [ctx(2, eos)] * sen_len,
+                   [pred] * sen_len, mark,
+                   [label_dict[l] for l in labels])
+    return reader
+
+
+def test(n_synthetic=256):
+    return _synthetic(n_synthetic, seed=1)
+
+
+def train(n_synthetic=1024):
+    """The reference only ships test() publicly; train() is provided for
+    the synthetic corpus so SRL models can fit something."""
+    return _synthetic(n_synthetic, seed=0)

@@ -1,0 +1,55 @@
+"""Oxford 102 Flowers dataset (ref python/paddle/dataset/flowers.py).
+
+Samples: (image CHW float32 scaled to [0,1], label int 0..101).
+Synthetic fallback: class-conditional color statistics (each class has a
+distinct mean hue) so classifiers can learn offline.
+"""
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+CLASS_NUM = 102
+_HW = 32   # synthetic resolution (ref resizes to 224 via mappers)
+
+
+def _synthetic(n, seed, hw=_HW):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for i in range(n):
+            label = int(rng.randint(0, CLASS_NUM))
+            base = np.zeros((3, hw, hw), "float32")
+            # distinct per-class channel means
+            base[0] += (label % 7) / 7.0
+            base[1] += (label % 11) / 11.0
+            base[2] += (label % 13) / 13.0
+            img = np.clip(base + rng.randn(3, hw, hw).astype("float32")
+                          * 0.1, 0.0, 1.0)
+            yield img, label
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+          n_synthetic=1024):
+    r = _synthetic(n_synthetic, seed=0)
+    return _apply(r, mapper, cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+         n_synthetic=256):
+    return _apply(_synthetic(n_synthetic, seed=1), mapper, cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True,
+          n_synthetic=256):
+    return _apply(_synthetic(n_synthetic, seed=2), mapper, False)
+
+
+def _apply(reader, mapper, cycle):
+    def out():
+        while True:
+            for s in reader():
+                yield mapper(s) if mapper else s
+            if not cycle:
+                break
+    return out

@@ -1,0 +1,155 @@
+"""Image preprocessing utilities (ref python/paddle/dataset/image.py).
+
+The reference shells out to cv2; here every transform is pure numpy
+(deterministic, no native deps — TPU input pipelines feed from host
+numpy anyway). Images are HWC uint8/float arrays unless noted; `to_chw`
+converts to the CHW layout the conv kernels use.
+"""
+import numpy as np
+
+__all__ = ["resize_short", "to_chw", "center_crop", "random_crop",
+           "left_right_flip", "simple_transform", "load_and_transform",
+           "load_image", "load_image_bytes", "batch_images_from_tar"]
+
+
+def _resize_bilinear(im, h, w):
+    """HWC (or HW) bilinear resize in numpy."""
+    src_h, src_w = im.shape[:2]
+    if (src_h, src_w) == (h, w):
+        return im
+    ys = (np.arange(h) + 0.5) * src_h / h - 0.5
+    xs = (np.arange(w) + 0.5) * src_w / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, src_h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, src_w - 1)
+    y1 = np.clip(y0 + 1, 0, src_h - 1)
+    x1 = np.clip(x0 + 1, 0, src_w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :]
+    if im.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    a = im[y0][:, x0].astype(np.float32)
+    b = im[y0][:, x1].astype(np.float32)
+    c = im[y1][:, x0].astype(np.float32)
+    d = im[y1][:, x1].astype(np.float32)
+    out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+           + c * wy * (1 - wx) + d * wy * wx)
+    return out.astype(im.dtype) if im.dtype == np.uint8 else out
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals `size`, keeping aspect ratio."""
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, max(1, int(round(w * size / h)))
+    else:
+        nh, nw = max(1, int(round(h * size / w))), size
+    return _resize_bilinear(im, nh, nw)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC → CHW (ref image.py:to_chw)."""
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = max(0, (h - size) // 2)
+    w0 = max(0, (w - size) // 2)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, h - size + 1) if h > size else 0
+    w0 = rng.randint(0, w - size + 1) if w > size else 0
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short + (random|center) crop (+ random flip in training)
+    + CHW + float32 + optional mean subtraction — ref simple_transform."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.asarray(mean, "float32")
+        im -= mean if mean.ndim != 1 else mean[:, None, None]
+    return im
+
+
+def load_image(file, is_color=True):
+    """Decode an image file to an HWC numpy array (PIL if available,
+    else raw .npy — the offline path)."""
+    if str(file).endswith(".npy"):
+        return np.load(file)
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(
+            "no image decoder available offline; save arrays as .npy or "
+            "install PIL") from e
+    img = Image.open(file)
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image_bytes(data, is_color=True):
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("no image decoder available offline") from e
+    img = Image.open(io.BytesIO(data))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pack tar'd images into pickled batch files (ref
+    image.py:batch_images_from_tar); returns the meta file path."""
+    import os
+    import pickle
+    import tarfile
+    out_path = f"{data_file}_{dataset_name}_batch"
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, file_id = [], [], 0
+    with tarfile.open(data_file) as f:
+        for mem in f.getmembers():
+            if mem.name not in img2label:
+                continue
+            data.append(f.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                with open(f"{out_path}/batch_{file_id}", "wb") as o:
+                    pickle.dump({"data": data, "label": labels}, o,
+                                protocol=2)
+                file_id += 1
+                data, labels = [], []
+    if data:
+        with open(f"{out_path}/batch_{file_id}", "wb") as o:
+            pickle.dump({"data": data, "label": labels}, o, protocol=2)
+    meta = f"{out_path}/meta"
+    with open(meta, "w") as o:
+        o.write(f"{len(img2label)}\n")
+    return meta

@@ -1,0 +1,69 @@
+"""MQ2007 learning-to-rank dataset (ref python/paddle/dataset/mq2007.py).
+
+Three sample formats, matching the reference generators:
+- pointwise: (score float, 46-dim feature vector)
+- pairwise:  (d_high [46], d_low [46]) with rel(high) > rel(low)
+- listwise:  (label_list, feature_list) per query
+
+Synthetic fallback: relevance is a noisy linear function of the
+features, so rankers can fit offline.
+"""
+import numpy as np
+
+__all__ = ["train", "test"]
+
+FEATURE_DIM = 46
+_W = None
+
+
+def _weights():
+    global _W
+    if _W is None:
+        _W = np.random.RandomState(7).randn(FEATURE_DIM) * 0.3
+    return _W
+
+
+def _queries(n_queries, seed):
+    rng = np.random.RandomState(seed)
+    w = _weights()
+    out = []
+    for _ in range(n_queries):
+        n_docs = int(rng.randint(5, 20))
+        feats = rng.rand(n_docs, FEATURE_DIM).astype("float32")
+        score = feats @ w + rng.randn(n_docs) * 0.1
+        rel = np.digitize(score, np.quantile(score, [0.5, 0.8]))
+        out.append((rel.astype("int64"), feats))
+    return out
+
+
+def _reader(n_queries, seed, format):
+    qs = _queries(n_queries, seed)
+    rng = np.random.RandomState(seed + 99)
+
+    def pointwise():
+        for rel, feats in qs:
+            for r, f in zip(rel, feats):
+                yield float(r), f
+
+    def pairwise():
+        for rel, feats in qs:
+            idx = np.arange(len(rel))
+            for i in idx:
+                for j in idx:
+                    if rel[i] > rel[j]:
+                        yield feats[i], feats[j]
+
+    def listwise():
+        for rel, feats in qs:
+            yield rel.tolist(), feats
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[format]
+
+
+def train(format="pairwise", n_queries=64):
+    return _reader(n_queries, seed=0, format=format)
+
+
+def test(format="pairwise", n_queries=16):
+    return _reader(n_queries, seed=1, format=format)

@@ -1,0 +1,39 @@
+"""NLTK movie-review sentiment dataset (ref
+python/paddle/dataset/sentiment.py).
+
+Samples: (word-id list, label 0/1). Synthetic fallback mirrors imdb's:
+class-conditional vocab skew makes the task learnable offline.
+"""
+import numpy as np
+
+__all__ = ["train", "test", "get_word_dict"]
+
+_VOCAB = 2048
+
+
+def get_word_dict():
+    """word → id, most-frequent-first like the reference's build."""
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for i in range(n):
+            label = i % 2
+            length = int(rng.randint(10, 60))
+            if label:
+                ids = rng.zipf(1.8, size=length) % (_VOCAB // 2)
+            else:
+                ids = _VOCAB // 2 + rng.zipf(1.8, size=length) % (_VOCAB // 2)
+            yield ids.astype("int64").tolist(), int(label)
+    return reader
+
+
+def train(n_synthetic=800):
+    return _synthetic(n_synthetic, seed=0)
+
+
+def test(n_synthetic=200):
+    return _synthetic(n_synthetic, seed=1)

@@ -1,0 +1,51 @@
+"""PASCAL VOC2012 segmentation dataset (ref
+python/paddle/dataset/voc2012.py).
+
+Samples: (image [3,H,W] uint8, segmentation label [H,W] int32 with
+class ids 0..20 and 255 = void border). Synthetic fallback: rectangular
+object blobs whose pixel statistics correlate with their class id.
+"""
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+CLASS_NUM = 21   # 20 object classes + background
+VOID = 255
+_HW = 64
+
+
+def _synthetic(n, seed, hw=_HW):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            img = rng.randint(0, 80, (3, hw, hw)).astype("uint8")
+            lab = np.zeros((hw, hw), "int32")
+            for _obj in range(int(rng.randint(1, 4))):
+                c = int(rng.randint(1, CLASS_NUM))
+                x0, y0 = rng.randint(0, hw - 8, 2)
+                w, h = rng.randint(6, hw // 2, 2)
+                x1, y1 = min(hw, x0 + w), min(hw, y0 + h)
+                lab[y0:y1, x0:x1] = c
+                # class-correlated intensity so segmenters can learn
+                img[:, y0:y1, x0:x1] = np.clip(
+                    80 + c * 8 + rng.randint(-10, 10, (3, y1 - y0, x1 - x0)),
+                    0, 255).astype("uint8")
+                # 1-px void border like VOC annotations
+                lab[y0:y1, x0] = VOID
+                if x1 - 1 > x0:
+                    lab[y0:y1, x1 - 1] = VOID
+            yield img, lab
+    return reader
+
+
+def train(n_synthetic=256):
+    return _synthetic(n_synthetic, seed=0)
+
+
+def test(n_synthetic=64):
+    return _synthetic(n_synthetic, seed=1)
+
+
+def val(n_synthetic=64):
+    return _synthetic(n_synthetic, seed=2)

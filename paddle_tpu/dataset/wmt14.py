@@ -1,0 +1,54 @@
+"""WMT14 en→fr NMT dataset (ref python/paddle/dataset/wmt14.py).
+
+Samples: (src_ids, trg_ids, trg_ids_next) where src has <s>/<e>
+wrapping, trg starts with <s>, trg_next ends with <e> — the reference's
+exact slot layout. Synthetic fallback: target is a deterministic
+function of the source (shifted ids, reversed order) so seq2seq models
+converge offline.
+"""
+import numpy as np
+
+__all__ = ["train", "test", "get_dict"]
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+START_IDX, END_IDX, UNK_IDX = 0, 1, 2
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict); reverse=True gives id → word like the ref."""
+    words = [START, END, UNK] + [f"w{i}" for i in range(dict_size - 3)]
+    d = {w: i for i, w in enumerate(words)}
+    if reverse:
+        rd = {i: w for w, i in d.items()}
+        return rd, dict(rd)
+    return d, dict(d)
+
+
+def _synthetic(n, dict_size, seed, max_len=30):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            length = int(rng.randint(3, max_len))
+            body = rng.randint(3, dict_size, length)
+            src_ids = [START_IDX] + body.tolist() + [END_IDX]
+            # deterministic "translation": shift + reverse
+            trg_body = ((body[::-1] - 3 + 7) % (dict_size - 3) + 3).tolist()
+            trg_ids = [START_IDX] + trg_body
+            trg_ids_next = trg_body + [END_IDX]
+            yield src_ids, trg_ids, trg_ids_next
+    return reader
+
+
+def train(dict_size=1000, n_synthetic=2048):
+    return _synthetic(n_synthetic, dict_size, seed=0)
+
+
+def test(dict_size=1000, n_synthetic=256):
+    return _synthetic(n_synthetic, dict_size, seed=1)
+
+
+def gen(dict_size=1000, n_synthetic=128):
+    return _synthetic(n_synthetic, dict_size, seed=2)

@@ -1,18 +1,25 @@
 """Imperative (dygraph) mode.
 
-Parity: paddle/fluid/imperative + python/paddle/fluid/imperative (the
-v1.2-era eager mode). Here eager execution is just... JAX: inside
+Parity: paddle/fluid/imperative + python/paddle/fluid/imperative/nn.py
+(the v1.2-era eager mode: Layer, FC, Conv2D, Pool2D, BatchNorm,
+Embedding). Here eager execution is just... JAX: inside
 `imperative.guard()` layer OBJECTS hold jnp parameter arrays and __call__
-computes immediately; `.backward()` uses jax.grad over the recorded pure
-function. This is a thin convenience layer — the graph (Program) path is
-the primary API, matching the reference era.
+computes immediately through the SAME registered kernels as the graph
+path (ops/registry), so eager and Program numerics agree by
+construction. Gradients come from `imperative.value_and_grad` — jax.grad
+over the model's parameter dict — instead of the reference's per-op
+autograd tape.
 """
 import contextlib
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["guard", "to_variable", "Layer", "FC", "enabled"]
+from .ops.registry import get_kernel, KernelCtx
+
+__all__ = ["guard", "to_variable", "Layer", "FC", "Conv2D", "Pool2D",
+           "BatchNorm", "Embedding", "value_and_grad", "sgd_step",
+           "enabled"]
 
 _in_guard = [False]
 
@@ -34,13 +41,33 @@ def to_variable(value, name=None):
     return jnp.asarray(np.asarray(value))
 
 
+def _kernel(op_type, ins, attrs, is_test=False):
+    """Run a registered graph kernel eagerly (shared numerics)."""
+    return get_kernel(op_type)(KernelCtx(key=None, is_test=is_test,
+                                         place=None), ins, attrs)
+
+
 class Layer:
     """Eager layer base (ref imperative/layers.py:Layer)."""
 
     def __init__(self, name_scope=None):
         self._params = {}
+        self._buffers = {}
         self._sublayers = {}
+        self._training = True
         self._rng = np.random.RandomState(0)
+
+    def train(self):
+        self._training = True
+        for sub in self._sublayers.values():
+            sub.train()
+        return self
+
+    def eval(self):
+        self._training = False
+        for sub in self._sublayers.values():
+            sub.eval()
+        return self
 
     def create_parameter(self, name, shape, dtype="float32", is_bias=False):
         if name not in self._params:
@@ -103,3 +130,156 @@ class FC(Layer):
         elif self.act:
             y = getattr(jax.nn, self.act)(y)
         return y
+
+
+class Conv2D(Layer):
+    """Eager conv (ref imperative/nn.py:Conv2D). NCHW input."""
+
+    def __init__(self, num_filters, filter_size, stride=1, padding=0,
+                 dilation=1, groups=1, act=None, use_bias=True,
+                 name_scope=None):
+        super().__init__(name_scope)
+        self.num_filters = num_filters
+        self.filter_size = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size, filter_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.act = act
+        self.use_bias = use_bias
+
+    def forward(self, x):
+        cin = int(x.shape[1])
+        kh, kw = self.filter_size
+        w = self.create_parameter(
+            "w", (self.num_filters, cin // self.groups, kh, kw),
+            str(x.dtype))
+        ins = {"Input": [x], "Filter": [w]}
+        if self.use_bias:
+            ins["Bias"] = [self.create_parameter(
+                "b", (self.num_filters,), str(x.dtype), is_bias=True)]
+        out = _kernel("conv2d", ins, {
+            "strides": [self.stride, self.stride],
+            "paddings": [self.padding, self.padding],
+            "dilations": [self.dilation, self.dilation],
+            "groups": self.groups})["Output"][0]
+        return _act(out, self.act)
+
+
+class Pool2D(Layer):
+    """Eager pool (ref imperative/nn.py:Pool2D)."""
+
+    def __init__(self, pool_size=2, pool_type="max", pool_stride=None,
+                 pool_padding=0, global_pooling=False, name_scope=None):
+        super().__init__(name_scope)
+        self.attrs = {
+            "ksize": [pool_size, pool_size] if np.isscalar(pool_size)
+            else list(pool_size),
+            "pooling_type": pool_type,
+            "strides": [pool_stride or pool_size] * 2
+            if np.isscalar(pool_stride or pool_size)
+            else list(pool_stride),
+            "paddings": [pool_padding, pool_padding]
+            if np.isscalar(pool_padding) else list(pool_padding),
+            "global_pooling": global_pooling,
+        }
+
+    def forward(self, x):
+        return _kernel("pool2d", {"X": [x]}, dict(self.attrs))["Out"][0]
+
+
+class BatchNorm(Layer):
+    """Eager batch norm (ref imperative/nn.py:BatchNorm): per-channel
+    affine + running stats, updated in train() mode, frozen in eval()."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 name_scope=None):
+        super().__init__(name_scope)
+        self.num_channels = num_channels
+        self.act = act
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self._params["scale"] = jnp.ones((num_channels,), jnp.float32)
+        self._params["bias"] = jnp.zeros((num_channels,), jnp.float32)
+        self._buffers["mean"] = jnp.zeros((num_channels,), jnp.float32)
+        self._buffers["var"] = jnp.ones((num_channels,), jnp.float32)
+
+    def forward(self, x):
+        outs = _kernel("batch_norm", {
+            "X": [x], "Scale": [self._params["scale"]],
+            "Bias": [self._params["bias"]],
+            "Mean": [self._buffers["mean"]],
+            "Variance": [self._buffers["var"]]},
+            {"momentum": self.momentum, "epsilon": self.epsilon,
+             "is_test": not self._training},
+            is_test=not self._training)
+        y = outs["Y"][0]
+        if self._training and not isinstance(
+                outs["MeanOut"][0], jax.core.Tracer):
+            # eager stat update; skipped under grad tracing (pure fn)
+            self._buffers["mean"] = outs["MeanOut"][0]
+            self._buffers["var"] = outs["VarianceOut"][0]
+        return _act(y, self.act)
+
+
+class Embedding(Layer):
+    """Eager embedding lookup (ref imperative nn Embedding)."""
+
+    def __init__(self, size, padding_idx=None, name_scope=None):
+        super().__init__(name_scope)
+        self.size = list(size)
+        self.padding_idx = padding_idx
+
+    def forward(self, ids):
+        w = self.create_parameter("w", tuple(self.size), "float32")
+        return _kernel("lookup_table", {"W": [w], "Ids": [ids]}, {
+            "padding_idx": -1 if self.padding_idx is None
+            else self.padding_idx})["Out"][0]
+
+
+def _act(y, act):
+    if not act:
+        return y
+    if act == "relu":
+        return jax.nn.relu(y)
+    if act == "softmax":
+        return jax.nn.softmax(y)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(y)
+    return getattr(jax.nn, act)(y)
+
+
+# ---------------------------------------------------------------------------
+# training helpers: jax.grad over the model's parameter dict
+# ---------------------------------------------------------------------------
+def value_and_grad(model, loss_fn):
+    """Returns step(*args) -> (loss, grads): differentiates loss_fn
+    (which calls `model`) wrt every trainable parameter of `model` —
+    the dygraph `loss.backward()` analog, as a pure function."""
+    def wrapped(params, *args, **kw):
+        model.set_parameters(params)
+        loss = loss_fn(*args, **kw)
+        return jnp.sum(jnp.asarray(loss).astype(jnp.float32))
+
+    initialized = [False]
+
+    def step(*args, **kw):
+        if not initialized[0]:
+            # one eager forward materializes lazily-created params so the
+            # grad structure covers them (FC/Conv2D create on first call)
+            loss_fn(*args, **kw)
+            initialized[0] = True
+        params = model.parameters()
+        loss, grads = jax.value_and_grad(wrapped)(params, *args, **kw)
+        model.set_parameters(params)   # restore concrete arrays
+        return loss, grads
+
+    return step
+
+
+def sgd_step(model, grads, lr):
+    """In-place SGD update of the model's parameters (dygraph
+    optimizer.minimize analog)."""
+    params = model.parameters()
+    model.set_parameters({k: params[k] - lr * grads[k] for k in params})

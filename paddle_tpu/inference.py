@@ -17,7 +17,7 @@ from .core.trace import build_step_fn
 from .core.dtypes import as_jnp_dtype
 from . import io as _io
 
-__all__ = ["InferenceEngine", "AnalysisConfig"]
+__all__ = ["InferenceEngine", "AnalysisConfig", "CompiledPredictor"]
 
 
 class AnalysisConfig:
@@ -105,15 +105,19 @@ class InferenceEngine:
         return outs
 
     # ------------------------------------------------------------------
-    def compile(self, feed_shapes, dtypes=None):
-        """AOT-compile for given {name: shape}; returns cost analysis.
-        (ref inference analysis pass / AOT story)."""
+    def _zero_feed(self, feed_shapes, dtypes=None):
         feed = {}
         for k, shape in feed_shapes.items():
             var = self.program.global_block().vars.get(k)
             dt = as_jnp_dtype((dtypes or {}).get(
                 k, var.dtype if var is not None else "float32"))
             feed[k] = jnp.zeros(shape, dtype=dt)
+        return feed
+
+    def compile(self, feed_shapes, dtypes=None):
+        """AOT-compile for given {name: shape}; returns cost analysis.
+        (ref inference analysis pass / AOT story)."""
+        feed = self._zero_feed(feed_shapes, dtypes)
         fn = self._get_fn(feed)
         lowered = jax.jit(
             lambda p, f: fn(p, f)).lower(self._persist, feed)
@@ -125,3 +129,62 @@ class InferenceEngine:
         return {"flops": cost.get("flops"),
                 "bytes accessed": cost.get("bytes accessed"),
                 "signature": sorted(feed_shapes.items())}
+
+    def save_compiled(self, dirname, feed_shapes, dtypes=None):
+        """Serialize the AOT-lowered inference function (StableHLO via
+        jax.export) + params to `dirname` — the reference's "serialized
+        inference program + weights" deployment artifact
+        (paddle/fluid/inference/api). Reload with load_compiled; the
+        reloaded module runs WITHOUT the Program/tracer machinery."""
+        import json
+        import os
+        from jax import export as jexport
+        os.makedirs(dirname, exist_ok=True)
+        feed = self._zero_feed(feed_shapes, dtypes)
+        step = build_step_fn(self.program, self.fetch_names, is_test=True,
+                             place=self.place)
+
+        def infer(persist, feed_arrays):
+            fetches, _ = step(persist, feed_arrays, jax.random.PRNGKey(0))
+            return fetches
+
+        exp = jexport.export(jax.jit(infer))(self._persist, feed)
+        with open(os.path.join(dirname, "module.stablehlo"), "wb") as f:
+            f.write(exp.serialize())
+        np.savez(os.path.join(dirname, "params.npz"),
+                 **{k: np.asarray(v) for k, v in self._persist.items()})
+        with open(os.path.join(dirname, "signature.json"), "w") as f:
+            json.dump({"feeds": {k: list(v.shape) for k, v in feed.items()},
+                       "dtypes": {k: str(v.dtype) for k, v in feed.items()},
+                       "fetches": self.fetch_names}, f)
+        return dirname
+
+    @staticmethod
+    def load_compiled(dirname):
+        """Deserialize a save_compiled artifact → CompiledPredictor."""
+        return CompiledPredictor(dirname)
+
+
+class CompiledPredictor:
+    """Runs a serialized AOT inference module (no Program needed)."""
+
+    def __init__(self, dirname):
+        import json
+        import os
+        from jax import export as jexport
+        with open(os.path.join(dirname, "module.stablehlo"), "rb") as f:
+            self._exported = jexport.deserialize(bytearray(f.read()))
+        pz = np.load(os.path.join(dirname, "params.npz"))
+        self._persist = {k: jnp.asarray(pz[k]) for k in pz.files}
+        with open(os.path.join(dirname, "signature.json")) as f:
+            self.signature = json.load(f)
+
+    def run(self, feed, return_numpy=True):
+        feed_arrays = {
+            k: jnp.asarray(np.asarray(v),
+                           dtype=self.signature["dtypes"].get(k))
+            for k, v in feed.items()}
+        outs = self._exported.call(self._persist, feed_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return outs

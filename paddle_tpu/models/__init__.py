@@ -13,3 +13,5 @@ from . import transformer
 from . import stacked_lstm
 from . import deepfm
 from . import word2vec
+from . import srl
+from . import recommender

@@ -1,0 +1,53 @@
+"""Recommender system: dual-tower embedding + cosine ranking.
+
+Parity: the reference book ch.5 (python/paddle/fluid/tests/book/
+test_recommender_system.py) — user tower (id/gender/age/job embeddings)
+and movie tower fused by cosine similarity, trained with square error
+against the movielens rating.
+"""
+from .. import layers
+from ..dataset import movielens
+
+__all__ = ["user_tower", "movie_tower", "build_program"]
+
+
+def user_tower(uid, gender, age, job, emb_dim=32, out_dim=64):
+    usr_emb = layers.embedding(uid, size=[movielens.max_user_id() + 1,
+                                          emb_dim])
+    gen_emb = layers.embedding(gender, size=[2, emb_dim // 2])
+    age_emb = layers.embedding(age, size=[len(movielens.age_table),
+                                          emb_dim // 2])
+    job_emb = layers.embedding(job, size=[movielens.max_job_id() + 1,
+                                          emb_dim // 2])
+    feats = [layers.fc(usr_emb, emb_dim),
+             layers.fc(gen_emb, emb_dim // 2),
+             layers.fc(age_emb, emb_dim // 2),
+             layers.fc(job_emb, emb_dim // 2)]
+    concat = layers.concat([layers.flatten(f, axis=1) for f in feats],
+                           axis=1)
+    return layers.fc(concat, out_dim, act="tanh")
+
+
+def movie_tower(mid, emb_dim=32, out_dim=64):
+    mov_emb = layers.embedding(mid, size=[movielens.max_movie_id() + 1,
+                                          emb_dim])
+    h = layers.fc(mov_emb, emb_dim)
+    return layers.fc(layers.flatten(h, axis=1), out_dim, act="tanh")
+
+
+def build_program(emb_dim=32, out_dim=64):
+    """Returns (feed vars, avg square-error cost, predicted score)."""
+    uid = layers.data("user_id", shape=[1], dtype="int64")
+    gender = layers.data("gender_id", shape=[1], dtype="int64")
+    age = layers.data("age_id", shape=[1], dtype="int64")
+    job = layers.data("job_id", shape=[1], dtype="int64")
+    mid = layers.data("movie_id", shape=[1], dtype="int64")
+    score = layers.data("score", shape=[1], dtype="float32")
+
+    usr = user_tower(uid, gender, age, job, emb_dim, out_dim)
+    mov = movie_tower(mid, emb_dim, out_dim)
+    sim = layers.cos_sim(usr, mov)
+    predict = layers.scale(sim, scale=5.0)
+    cost = layers.square_error_cost(predict, score)
+    avg_cost = layers.mean(cost)
+    return [uid, gender, age, job, mid, score], avg_cost, predict

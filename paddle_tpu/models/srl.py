@@ -1,0 +1,55 @@
+"""Semantic role labeling: deep bidirectional LSTM + CRF.
+
+Parity: the reference book ch.7 (python/paddle/fluid/tests/book/
+test_label_semantic_roles.py) — 8 input slots from the conll05 dataset
+(word, 5-word predicate context window, predicate, mark), stacked
+alternating-direction LSTMs, linear-chain CRF loss. Padded [B, T]
+batches + seq_len masks replace the reference's LoD tensors.
+"""
+from .. import layers
+from ..dataset import conll05
+
+__all__ = ["db_lstm", "build_program"]
+
+
+def db_lstm(word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark,
+            seq_len, word_dict_len, pred_dict_len, label_dict_len,
+            word_dim=32, mark_dim=5, hidden_dim=64, depth=4):
+    """Emission features [B, T, label_dict_len]."""
+    pred_emb = layers.embedding(predicate, size=[pred_dict_len, word_dim])
+    mark_emb = layers.embedding(mark, size=[2, mark_dim])
+    word_slots = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    embs = [layers.embedding(w, size=[word_dict_len, word_dim])
+            for w in word_slots]
+    emb = layers.concat(embs + [pred_emb, mark_emb], axis=-1)
+
+    hidden0 = layers.fc(emb, hidden_dim, num_flatten_dims=2, act="tanh")
+    lstm0, _ = layers.dynamic_lstm(hidden0, size=hidden_dim * 4,
+                                   seq_len=seq_len)
+    input_tmp = [hidden0, lstm0]
+    for i in range(1, depth):
+        mix = layers.fc(layers.concat(input_tmp, axis=-1), hidden_dim,
+                        num_flatten_dims=2, act="tanh")
+        lstm, _ = layers.dynamic_lstm(mix, size=hidden_dim * 4,
+                                      seq_len=seq_len,
+                                      is_reverse=(i % 2 == 1))
+        input_tmp = [mix, lstm]
+    return layers.fc(layers.concat(input_tmp, axis=-1), label_dict_len,
+                     num_flatten_dims=2)
+
+
+def build_program(maxlen=40, word_dim=32, hidden_dim=64, depth=4):
+    """Returns (feed vars, avg CRF NLL, emission)."""
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    slots = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+             "predicate", "mark"]
+    feeds = [layers.data(n, shape=[maxlen], dtype="int64") for n in slots]
+    label = layers.data("label", shape=[maxlen], dtype="int64")
+    seq_len = layers.data("seq_len", shape=[], dtype="int64",
+                          append_batch_size=True)
+    emission = db_lstm(*feeds, seq_len, len(word_dict), len(verb_dict),
+                       len(label_dict), word_dim=word_dim,
+                       hidden_dim=hidden_dim, depth=depth)
+    crf_cost = layers.linear_chain_crf(emission, label, seq_len=seq_len)
+    avg_cost = layers.mean(crf_cost)
+    return feeds + [label, seq_len], avg_cost, emission

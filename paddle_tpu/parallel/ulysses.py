@@ -1,0 +1,58 @@
+"""All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+Complements parallel/ring_attention.py: instead of rotating K/V blocks
+around the ring, two lax.all_to_all collectives re-shard the tensors
+head-wise (each sp member holds H/sp heads with the FULL sequence),
+run ordinary dense attention locally, and shard back sequence-wise.
+Preferable when H >= sp and the per-device full-sequence scores fit in
+HBM; ring attention covers the longer-sequence regime. Replaces the
+reference's NCCL all-to-all path (paddle/fluid/operators/distributed)
+with XLA ICI collectives.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ulysses_attention"]
+
+
+def ulysses_attention(mesh, q, k, v, causal=False, scale=None,
+                      axis_name="sp"):
+    """q/k/v: GLOBAL [B, H, T, D] (sharded or replicated — jit moves
+    them); returns [B, H, T, D] attention output sequence-sharded over
+    `axis_name`. H must divide by the sp axis size."""
+    sp = mesh.shape[axis_name]
+    B, H, T, D = q.shape
+    if H % sp:
+        raise ValueError(f"heads {H} must divide sp={sp}")
+    if T % sp:
+        raise ValueError(f"sequence {T} must divide sp={sp}")
+    scale = scale if scale is not None else D ** -0.5
+
+    def local(ql, kl, vl):
+        # local [B, H, T/sp, D] → all_to_all → [B, H/sp, T, D]
+        ql = lax.all_to_all(ql, axis_name, split_axis=1, concat_axis=2,
+                            tiled=True)
+        kl = lax.all_to_all(kl, axis_name, split_axis=1, concat_axis=2,
+                            tiled=True)
+        vl = lax.all_to_all(vl, axis_name, split_axis=1, concat_axis=2,
+                            tiled=True)
+        s = jnp.einsum("bhqd,bhkd->bhqk", ql, kl).astype(jnp.float32)
+        s = s * scale
+        if causal:
+            cm = jnp.tril(jnp.ones((T, T), dtype=bool))
+            s = jnp.where(cm, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(ql.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, vl)
+        # back: [B, H/sp, T, D] → [B, H, T/sp, D]
+        return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    seq_spec = P(None, None, axis_name, None)
+    fn = jax.jit(jax.shard_map(local, mesh=mesh,
+                               in_specs=(seq_spec, seq_spec, seq_spec),
+                               out_specs=seq_spec, check_vma=False),
+                 in_shardings=NamedSharding(mesh, seq_spec),
+                 out_shardings=NamedSharding(mesh, seq_spec))
+    return fn(q, k, v)

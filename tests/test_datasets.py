@@ -1,0 +1,125 @@
+"""Dataset loaders: sample schemas match the reference contracts
+(python/paddle/dataset/*), deterministic synthetic fallback offline."""
+import numpy as np
+
+from paddle_tpu import dataset
+
+
+def test_conll05_schema():
+    wd, vd, ld = dataset.conll05.get_dict()
+    assert len(ld) == dataset.conll05.LABEL_DICT_LEN
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(wd)
+    s = next(iter(dataset.conll05.test()()))
+    assert len(s) == 9
+    sen_len = len(s[0])
+    for slot in s[1:]:
+        assert len(slot) == sen_len
+    assert all(0 <= l < len(ld) for l in s[8])
+    # exactly one predicate mark window containing B-V
+    bv = ld["B-V"]
+    assert s[8].count(bv) == 1
+    assert s[7][s[8].index(bv)] == 1
+
+
+def test_sentiment_schema():
+    wd = dataset.sentiment.get_word_dict()
+    ids, label = next(iter(dataset.sentiment.train()()))
+    assert label in (0, 1)
+    assert all(0 <= i < len(wd) for i in ids)
+
+
+def test_wmt14_schema():
+    src, trg, trg_next = next(iter(dataset.wmt14.train(dict_size=100)()))
+    assert src[0] == dataset.wmt14.START_IDX
+    assert src[-1] == dataset.wmt14.END_IDX
+    assert trg[0] == dataset.wmt14.START_IDX
+    assert trg_next[-1] == dataset.wmt14.END_IDX
+    assert trg[1:] == trg_next[:-1]
+    d, _ = dataset.wmt14.get_dict(100)
+    assert d[0] == "<s>"
+
+
+def test_flowers_schema():
+    img, label = next(iter(dataset.flowers.train()()))
+    assert img.shape[0] == 3 and img.dtype == np.float32
+    assert 0 <= label < dataset.flowers.CLASS_NUM
+    assert 0.0 <= img.min() and img.max() <= 1.0
+    # mapper + cycle plumbing
+    r = dataset.flowers.test(mapper=lambda s: (s[0] * 2, s[1]),
+                             n_synthetic=3)
+    assert len(list(r())) == 3
+
+
+def test_voc2012_schema():
+    img, lab = next(iter(dataset.voc2012.train()()))
+    assert img.dtype == np.uint8 and img.shape[0] == 3
+    assert lab.shape == img.shape[1:]
+    classes = set(np.unique(lab)) - {dataset.voc2012.VOID}
+    assert classes <= set(range(dataset.voc2012.CLASS_NUM))
+
+
+def test_mq2007_formats():
+    score, feat = next(iter(dataset.mq2007.train(format="pointwise",
+                                                 n_queries=4)()))
+    assert feat.shape == (dataset.mq2007.FEATURE_DIM,)
+    hi, lo = next(iter(dataset.mq2007.train(format="pairwise",
+                                            n_queries=4)()))
+    assert hi.shape == lo.shape == (dataset.mq2007.FEATURE_DIM,)
+    rels, feats = next(iter(dataset.mq2007.train(format="listwise",
+                                                 n_queries=4)()))
+    assert len(rels) == feats.shape[0]
+
+
+def test_image_transforms():
+    from paddle_tpu.dataset import image as im
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 255, (48, 64, 3)).astype("uint8")
+    r = im.resize_short(x, 32)
+    assert min(r.shape[:2]) == 32 and r.shape[1] > r.shape[0]
+    c = im.center_crop(r, 32)
+    assert c.shape[:2] == (32, 32)
+    rc = im.random_crop(r, 24, rng=rng)
+    assert rc.shape[:2] == (24, 24)
+    f = im.left_right_flip(x)
+    np.testing.assert_array_equal(f[:, 0], x[:, -1])
+    chw = im.to_chw(c)
+    assert chw.shape == (3, 32, 32)
+    t = im.simple_transform(x, 40, 32, is_train=True,
+                            mean=[127.0, 127.0, 127.0],
+                            rng=np.random.RandomState(1))
+    assert t.shape == (3, 32, 32) and t.dtype == np.float32
+    # bilinear identity: resizing to the same size is a no-op
+    np.testing.assert_array_equal(im._resize_bilinear(x, 48, 64), x)
+
+
+def test_sentiment_lstm_learns():
+    """The synthetic sentiment corpus is actually learnable (mirrors the
+    ref book chapter: embedding+pool classifier fits it)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    data = layers.data("ids", shape=[40], dtype="int64",
+                       append_batch_size=True)
+    label = layers.data("label", shape=[1], dtype="int64")
+    emb = layers.embedding(data, size=[2048, 16])
+    pooled = layers.reduce_mean(emb, dim=1)
+    logits = layers.fc(pooled, size=2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.Adam(5e-3).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+
+    reader = dataset.sentiment.train(n_synthetic=512)
+    samples = list(reader())
+    losses = []
+    for epoch in range(4):
+        for i in range(0, 256, 32):
+            batch = samples[i:i + 32]
+            ids = np.zeros((32, 40), "int64")
+            for j, (s, _) in enumerate(batch):
+                ids[j, :min(40, len(s))] = s[:40]
+            lbl = np.asarray([[l] for _, l in batch], "int64")
+            lv = exe.run(feed={"ids": ids, "label": lbl},
+                         fetch_list=[loss])[0]
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])

@@ -144,3 +144,58 @@ def test_se_resnext_builds():
     losses = _run_steps([img, label], loss, feed, steps=1,
                         opt=pt.optimizer.Momentum(0.001, 0.9))
     assert np.isfinite(losses).all()
+
+
+def test_srl_db_lstm_crf_trains():
+    """Book ch.7 label_semantic_roles: 8-slot db-LSTM + CRF on the
+    conll05 schema (ref tests/book/test_label_semantic_roles.py)."""
+    from paddle_tpu.models import srl
+    from paddle_tpu.dataset import conll05
+    maxlen = 20
+    feeds, avg_cost, emission = srl.build_program(
+        maxlen=maxlen, word_dim=8, hidden_dim=16, depth=2)
+    samples = list(conll05.train(n_synthetic=64)())
+
+    def feed(i):
+        batch = samples[(i * 8) % 48:(i * 8) % 48 + 8]
+        out = {n: np.zeros((8, maxlen), "int64") for n in
+               ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+                "predicate", "mark", "label"]}
+        lens = np.zeros((8,), "int64")
+        for j, s in enumerate(batch):
+            L = min(maxlen, len(s[0]))
+            lens[j] = L
+            for k, name in enumerate(["word", "ctx_n2", "ctx_n1", "ctx_0",
+                                      "ctx_p1", "ctx_p2", "predicate",
+                                      "mark", "label"]):
+                out[name][j, :L] = s[k][:L]
+        out["seq_len"] = lens
+        return out
+
+    losses = _run_steps(feeds, avg_cost, feed, steps=8,
+                        opt=pt.optimizer.Adam(5e-3))
+    assert losses[-1] < losses[0], losses
+
+
+def test_recommender_system_trains():
+    """Book ch.5 recommender_system: dual-tower cosine ranking on
+    movielens (ref tests/book/test_recommender_system.py)."""
+    from paddle_tpu.models import recommender
+    from paddle_tpu.dataset import movielens
+    feeds, avg_cost, predict = recommender.build_program(emb_dim=8,
+                                                         out_dim=16)
+    samples = list(movielens.train(n_synthetic=256)())
+
+    def feed(i):
+        batch = samples[(i * 16) % 192:(i * 16) % 192 + 16]
+        cols = list(zip(*batch))
+        return {"user_id": np.asarray(cols[0], "int64"),
+                "gender_id": np.asarray(cols[1], "int64"),
+                "age_id": np.asarray(cols[2], "int64"),
+                "job_id": np.asarray(cols[3], "int64"),
+                "movie_id": np.asarray(cols[4], "int64"),
+                "score": np.asarray(cols[5], "float32")}
+
+    losses = _run_steps(feeds, avg_cost, feed, steps=10,
+                        opt=pt.optimizer.Adam(1e-2))
+    assert losses[-1] < losses[0], losses

@@ -114,3 +114,25 @@ def test_transpiler_builds_plan():
     assert len(sh) > 0
     # optimizer state missing here (SGD), but params replicated
     assert all(s.mesh is t.mesh for s in sh.values())
+
+
+def test_ulysses_attention_matches_full():
+    """All-to-all sequence parallelism == dense attention (the Ulysses
+    complement to ring attention; SURVEY §2.4)."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.ulysses import ulysses_attention
+    import jax.numpy as jnp
+    mesh = make_mesh(sp=8)
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 8, 32, 16
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    for causal in (False, True):
+        out = ulysses_attention(mesh, q, k, v, causal=causal)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+        if causal:
+            s = jnp.where(jnp.tril(jnp.ones((T, T), dtype=bool)), s, -1e30)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
