@@ -69,7 +69,12 @@ def active():
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
                 block_k, causal, scale, seq_len):
-    """Grid (B*H, T//block_q). q_ref [bq, D]; k/v_ref [S, D]; b_ref [S]."""
+    """Grid (B*H, T//block_q). q_ref [bq, D]; k/v_ref [S, D]; b_ref [1, S].
+
+    Mosaic requires the last two dims of every block to be (8,128)-tileable
+    or equal to the array dims, so the per-batch bias and the lse rows keep
+    an explicit singleton sublane dim instead of being squeezed to 1-D.
+    """
     q = q_ref[...].astype(jnp.float32) * scale          # [bq, d]
     bq = q.shape[0]
     q_idx = pl.program_id(1)
@@ -79,7 +84,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
         acc, l, m = carry
         k = k_ref[pl.dslice(kb * block_k, block_k), :]
         v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        b = b_ref[pl.dslice(kb * block_k, block_k)]
+        b = b_ref[0, pl.dslice(kb * block_k, block_k)]
         s = q @ k.astype(jnp.float32).T                 # [bq, bk]
         s = s + b.astype(jnp.float32)[None, :]
         if causal:
@@ -107,14 +112,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
     acc, l, m = jax.lax.fori_loop(0, n_iter, body, (acc, l, m))
     l = jnp.maximum(l, 1e-20)
     o_ref[...] = (acc / l).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l))[:, 0]
+    lse_ref[0, :] = (m + jnp.log(l))[:, 0]
 
 
-def _fwd_call(q, k, v, bias, causal, scale, block_q, block_k, interpret):
-    """q [BH, T, D]; k/v [BH, S, D]; bias [BH//H→B mapped outside: here
-    [BH, S] pre-broadcast]. Returns (out [BH,T,D], lse [BH,T])."""
+def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
+              interpret):
+    """q [BH, T, D]; k/v [BH, S, D]; bias [B, 1, S] (mapped to the batch
+    row b // n_heads by the index_map — no per-head materialization).
+    Returns (out [BH,T,D], lse [BH,1,T])."""
     BH, T, D = q.shape
     S = k.shape[1]
+    H = n_heads
     grid = (BH, T // block_q)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
@@ -124,16 +132,16 @@ def _fwd_call(q, k, v, bias, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, S, v.shape[-1]), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, S), lambda b, i: (b, 0)),
+            pl.BlockSpec((None, 1, S), lambda b, i: (b // H, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, v.shape[-1]),
                          lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, v.shape[-1]), q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, T), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, bias)
@@ -148,8 +156,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
     """Grid (B*H, T//block_q): recompute p block-wise, accumulate dq."""
     q = q_ref[...].astype(jnp.float32)                   # [bq, d]
     do = do_ref[...].astype(jnp.float32)                 # [bq, dv]
-    lse = lse_ref[...][:, None]                          # [bq, 1]
-    delta = dl_ref[...][:, None]                         # [bq, 1]
+    lse = lse_ref[0, :][:, None]                         # [bq, 1]
+    delta = dl_ref[0, :][:, None]                        # [bq, 1]
     bq = q.shape[0]
     q_idx = pl.program_id(1)
     n_kb = seq_len // block_k
@@ -157,7 +165,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
     def body(kb, dq):
         k = k_ref[pl.dslice(kb * block_k, block_k), :]
         v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        b = b_ref[pl.dslice(kb * block_k, block_k)]
+        b = b_ref[0, pl.dslice(kb * block_k, block_k)]
         k = k.astype(jnp.float32)
         s = (q * scale) @ k.T + b.astype(jnp.float32)[None, :]
         if causal:
@@ -186,7 +194,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
     """Grid (B*H, S//block_k): recompute p^T block-wise, accumulate dk/dv."""
     k = k_ref[...].astype(jnp.float32)                   # [bk, d]
     v = v_ref[...].astype(jnp.float32)                   # [bk, dv]
-    b = b_ref[...].astype(jnp.float32)                   # [bk]
+    b = b_ref[0, :].astype(jnp.float32)                  # [bk]
     bk = k.shape[0]
     k_idx = pl.program_id(1)
     n_qb = seq_len_q // block_q
@@ -195,8 +203,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
         dk, dv = carry
         q = q_ref[pl.dslice(qb * block_q, block_q), :]
         do = do_ref[pl.dslice(qb * block_q, block_q), :]
-        lse = lse_ref[pl.dslice(qb * block_q, block_q)][:, None]
-        delta = dl_ref[pl.dslice(qb * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.dslice(qb * block_q, block_q)][:, None]
+        delta = dl_ref[0, pl.dslice(qb * block_q, block_q)][:, None]
         q = q.astype(jnp.float32)
         do = do.astype(jnp.float32)
         s = (q * scale) @ k.T + b[None, :]               # [bq, bk]
@@ -226,14 +234,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_call(res, g, causal, scale, block_q, block_k, interpret):
+def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret):
     q, k, v, bias, out, lse = res
     BH, T, D = q.shape
     S = k.shape[1]
     DV = v.shape[-1]
+    H = n_heads
     do = g.astype(jnp.float32)
     # delta_i = rowsum(dO * O): the softmax-normalization correction term
-    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)   # [BH, T]
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1,
+                    keepdims=True).transpose(0, 2, 1)        # [BH, 1, T]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block_k, causal=causal,
@@ -243,10 +253,10 @@ def _bwd_call(res, g, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, S, DV), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, S), lambda b, i: (b, 0)),
+            pl.BlockSpec((None, 1, S), lambda b, i: (b // H, 0, 0)),
             pl.BlockSpec((None, block_q, DV), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
@@ -261,10 +271,10 @@ def _bwd_call(res, g, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((None, T, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((None, block_k, DV), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k), lambda b, j: (b, j)),
+            pl.BlockSpec((None, 1, block_k), lambda b, j: (b // H, 0, j)),
             pl.BlockSpec((None, T, DV), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, T), lambda b, j: (b, 0)),
-            pl.BlockSpec((None, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((None, 1, T), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, 1, T), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
@@ -282,21 +292,23 @@ def _bwd_call(res, g, causal, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper (flat [BH, T, D] layout)
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret):
-    out, _ = _fwd_call(q, k, v, bias, causal, scale, block_q, block_k,
-                       interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
+           interpret):
+    out, _ = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
+                       block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
-    out, lse = _fwd_call(q, k, v, bias, causal, scale, block_q, block_k,
-                         interpret)
+def _flash_fwd(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
+               interpret):
+    out, lse = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
+                         block_k, interpret)
     return out, (q, k, v, bias, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    dq, dk, dv = _bwd_call(res, g, causal, scale, block_q, block_k,
+def _flash_bwd(n_heads, causal, scale, block_q, block_k, interpret, res, g):
+    dq, dk, dv = _bwd_call(res, g, n_heads, causal, scale, block_q, block_k,
                            interpret)
     # pad biases come from integer lengths: no gradient flows (documented)
     return dq, dk, dv, jnp.zeros_like(res[3])
@@ -343,14 +355,14 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     kr = k.reshape(B * H, S, D)
     vr = v.reshape(B * H, S, v.shape[-1])
     if bias is None:
-        br = jnp.zeros((B, S), jnp.float32)
+        br = jnp.zeros((B, 1, S), jnp.float32)
     else:
         br = bias.reshape(bias.shape[0], S).astype(jnp.float32)
         if br.shape[0] == 1 and B > 1:
             br = jnp.broadcast_to(br, (B, S))
-    # broadcast per-batch bias across heads → [BH, S]
-    br = jnp.repeat(br, H, axis=0) if H > 1 else br
-    out = _flash(qr, kr, vr, br, bool(causal), scale, block_q, block_k,
+        br = br.reshape(B, 1, S)
+    # per-batch bias row is shared across heads via the kernel index_map
+    out = _flash(qr, kr, vr, br, H, bool(causal), scale, block_q, block_k,
                  bool(interpret))
     return out.reshape(B, H, T, vr.shape[-1])
 
