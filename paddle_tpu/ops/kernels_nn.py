@@ -744,6 +744,12 @@ def _flash_attention(ctx, ins, attrs):
     scale = attrs.get("scale", None) or (1.0 / np.sqrt(q.shape[-1]))
     from .pallas import flash_attention as _fa_mod
     use_pallas, interpret = _fa_mod.active()
+    # Perf gate (measured on v5e): below MIN_SEQ_LEN the fused XLA path is
+    # faster; the Pallas kernel takes over for long sequences where the
+    # [T,S] materialization is the bottleneck (or cannot compile at all).
+    # Interpret mode (CPU tests) exercises the kernel at any length.
+    if use_pallas and not interpret and k.shape[2] < _fa_mod.MIN_SEQ_LEN:
+        use_pallas = False
     if use_pallas and _fa_mod.supports(q, k, v, bias=mask):
         # Pallas hot path (differentiable via custom_vjp) — explicit
         # gating, no silent exception fallback (VERDICT r1 weak #2)

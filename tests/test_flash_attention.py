@@ -143,3 +143,47 @@ def test_flash_active_on_transformer_training_path():
         fa.set_mode("auto")
     np.testing.assert_allclose(losses_flash, losses_ref, rtol=2e-4,
                                atol=2e-4)
+
+
+def test_flash_causal_cross_shape_matches_reference():
+    """Causal with T != S must use the bottom-right-aligned diagonal
+    (jnp.tril k=S-T), matching the XLA fallback — the same op must not
+    change semantics across the MIN_SEQ_LEN dispatch gate."""
+    rng = np.random.RandomState(7)
+    q, k, v = _rand_qkv(rng, T=16, S=32)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    ref = fa.flash_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # grads too (block-skip predicate shares the offset)
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v).astype(jnp.float32) ** 2)
+    g = jax.grad(loss(lambda q, k, v: fa.flash_attention(
+        q, k, v, causal=True, interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: fa.flash_attention_reference(
+        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_flash_supports_non_default_block_multiples():
+    """Sequence lengths that are 8/128-multiples but don't divide the
+    tuned 512/1024 defaults must stay on the Pallas path (they are
+    exactly the long sequences the unfused path cannot handle)."""
+    rng = np.random.RandomState(8)
+    q, k, v = _rand_qkv(rng, T=24, S=40)   # 8-multiples, not 512/1024
+    assert fa.supports(q, k, v)
+    out = fa.flash_attention(q, k, v, interpret=True)
+    ref = fa.flash_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert fa._pick_block(16512, 1024) == 128
+    assert fa._pick_block(768, 512) == 256
+    # lane dims that are neither 128-multiples nor the full axis are not
+    # legal Mosaic tiles — supports() must refuse them (hardware-only
+    # failure; interpret mode can't catch it)
+    assert fa._pick_block(4160, 1024) == 0
+    q2, k2, v2 = _rand_qkv(rng, T=128, S=4160, D=16)
+    assert not fa.supports(q2, k2, v2)
