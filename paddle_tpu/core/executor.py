@@ -21,7 +21,9 @@ from .scope import global_scope
 from .trace import build_step_fn
 from .dtypes import as_jnp_dtype
 
-__all__ = ["Executor"]
+from .scope import scope_guard  # noqa: F401  (ref executor.py re-exports it)
+
+__all__ = ["Executor", "scope_guard"]
 
 _LOG = logging.getLogger("paddle_tpu.executor")
 

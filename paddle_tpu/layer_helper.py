@@ -54,6 +54,18 @@ class LayerHelper:
             raise ValueError(
                 f"parameter {name!r} has unresolved shape {shape}; "
                 f"specify static dims for parameter-creating layers")
+        existing = self.main_program.global_block().vars.get(name)
+        if existing is not None:
+            if tuple(existing.shape) != tuple(shape):
+                raise ValueError(
+                    f"parameter name {name!r} reused with a different shape "
+                    f"({tuple(existing.shape)} vs {tuple(shape)}) — two "
+                    f"weights would silently alias one array in the scope; "
+                    f"give each its own ParamAttr name")
+            # intentional sharing (e.g. a decoder step unrolled N times):
+            # reuse the declared param, don't append N-1 dead re-init ops
+            # to the startup program
+            return existing
         # declare in main program…
         param = self.main_program.global_block().create_parameter(
             name=name, shape=shape, dtype=dtype,

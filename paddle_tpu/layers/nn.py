@@ -58,17 +58,59 @@ def _same_shape_out(helper, x, type, attrs=None, extra_inputs=None, act=None):
 # ---------------------------------------------------------------------------
 # dense / embedding
 # ---------------------------------------------------------------------------
+def _sub_attr(param_attr, suffix):
+    """Distinct ParamAttr per weight in multi-weight layers: a NAMED attr
+    gets '<name>.<suffix>' so the weights don't silently alias one array
+    in the scope (unnamed attrs already auto-unique)."""
+    import copy
+    from ..param_attr import ParamAttr
+    if isinstance(param_attr, str):
+        return f"{param_attr}.{suffix}"
+    if isinstance(param_attr, ParamAttr) and param_attr.name:
+        a = copy.copy(param_attr)
+        a.name = f"{param_attr.name}.{suffix}"
+        return a
+    return param_attr
+
+
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
        act=None, is_test=False, name=None):
-    """Fully-connected layer (ref layers/nn.py:fc → mul + elementwise_add)."""
+    """Fully-connected layer (ref layers/nn.py:fc → mul + elementwise_add).
+
+    Like the reference, `input` may be a list of Variables: each gets its
+    own weight and the projections are summed before bias/activation."""
     helper = LayerHelper("fc", name=name, act=act, bias_attr=bias_attr)
-    dtype = input.dtype
-    in_dim = int(np.prod(input.shape[num_flatten_dims:]))
-    w = helper.create_parameter(param_attr, shape=[in_dim, size], dtype=dtype)
-    out_shape = tuple(input.shape[:num_flatten_dims]) + (size,)
-    tmp = helper.create_variable_for_type_inference(dtype, out_shape)
-    helper.append_op("mul", {"X": [input], "Y": [w]}, {"Out": [tmp]},
-                     {"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    if isinstance(param_attr, (list, tuple)):
+        if len(param_attr) != len(inputs):
+            raise ValueError(
+                f"fc got {len(inputs)} inputs but {len(param_attr)} "
+                f"param_attrs (the reference raises here too)")
+        attrs = list(param_attr)
+    elif len(inputs) > 1:
+        # one NAMED attr across several inputs would alias one array —
+        # derive a distinct name per input (cf. _sub_attr for lstm/gru)
+        attrs = [_sub_attr(param_attr, str(i)) for i in range(len(inputs))]
+    else:
+        attrs = [param_attr]
+    dtype = inputs[0].dtype
+    projs = []
+    for x, pa in zip(inputs, attrs):
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        w = helper.create_parameter(pa, shape=[in_dim, size], dtype=dtype)
+        out_shape = tuple(x.shape[:num_flatten_dims]) + (size,)
+        tmp = helper.create_variable_for_type_inference(dtype, out_shape)
+        helper.append_op("mul", {"X": [x], "Y": [w]}, {"Out": [tmp]},
+                         {"x_num_col_dims": num_flatten_dims,
+                          "y_num_col_dims": 1})
+        projs.append(tmp)
+    tmp = projs[0]
+    for other in projs[1:]:
+        summed = helper.create_variable_for_type_inference(
+            dtype, tuple(tmp.shape))
+        helper.append_op("elementwise_add", {"X": [tmp], "Y": [other]},
+                         {"Out": [summed]}, {"axis": -1})
+        tmp = summed
     tmp = helper.append_bias_op(tmp, dim_start=num_flatten_dims,
                                 bias_attr=bias_attr, size=size)
     return helper.append_activation(tmp, act)
@@ -534,8 +576,10 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     helper = LayerHelper("dynamic_lstm", name=name)
     hidden = size // 4
     d_in = int(input.shape[-1])
-    w_ih = helper.create_parameter(param_attr, shape=[d_in, 4 * hidden], dtype=dtype)
-    w_hh = helper.create_parameter(param_attr, shape=[hidden, 4 * hidden], dtype=dtype)
+    w_ih = helper.create_parameter(_sub_attr(param_attr, "ih"),
+                                   shape=[d_in, 4 * hidden], dtype=dtype)
+    w_hh = helper.create_parameter(_sub_attr(param_attr, "hh"),
+                                   shape=[hidden, 4 * hidden], dtype=dtype)
     b = helper.create_parameter(bias_attr, shape=[4 * hidden], dtype=dtype,
                                 is_bias=True)
     B, T = input.shape[0], input.shape[1]
@@ -569,11 +613,14 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
     helper = LayerHelper("dynamic_lstmp", name=name)
     hidden = size // 4
     d_in = int(input.shape[-1])
-    w_ih = helper.create_parameter(param_attr, shape=[d_in, 4 * hidden],
+    w_ih = helper.create_parameter(_sub_attr(param_attr, "ih"),
+                                   shape=[d_in, 4 * hidden],
                                    dtype=dtype)
-    w_hh = helper.create_parameter(param_attr, shape=[proj_size, 4 * hidden],
+    w_hh = helper.create_parameter(_sub_attr(param_attr, "hh"),
+                                   shape=[proj_size, 4 * hidden],
                                    dtype=dtype)
-    w_proj = helper.create_parameter(param_attr, shape=[hidden, proj_size],
+    w_proj = helper.create_parameter(_sub_attr(param_attr, "proj"),
+                                     shape=[hidden, proj_size],
                                      dtype=dtype)
     b = helper.create_parameter(bias_attr, shape=[4 * hidden], dtype=dtype,
                                 is_bias=True)
@@ -649,8 +696,10 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
     """Padded-batch GRU (ref layers/nn.py:dynamic_gru). input [B,T,D]."""
     helper = LayerHelper("dynamic_gru", name=name)
     d_in = int(input.shape[-1])
-    w_ih = helper.create_parameter(param_attr, shape=[d_in, 3 * size], dtype=dtype)
-    w_hh = helper.create_parameter(param_attr, shape=[size, 3 * size], dtype=dtype)
+    w_ih = helper.create_parameter(_sub_attr(param_attr, "ih"),
+                                   shape=[d_in, 3 * size], dtype=dtype)
+    w_hh = helper.create_parameter(_sub_attr(param_attr, "hh"),
+                                   shape=[size, 3 * size], dtype=dtype)
     b = helper.create_parameter(bias_attr, shape=[3 * size], dtype=dtype,
                                 is_bias=True)
     B, T = input.shape[0], input.shape[1]

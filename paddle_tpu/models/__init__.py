@@ -15,3 +15,4 @@ from . import deepfm
 from . import word2vec
 from . import srl
 from . import recommender
+from . import seq2seq

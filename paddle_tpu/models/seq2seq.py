@@ -1,0 +1,186 @@
+"""RNN encoder-decoder NMT with attention + beam-search inference.
+
+Parity: the book ch.8 models — tests/book/test_machine_translation.py
+(attention decoder + beam search), tests/book/test_rnn_encoder_decoder.py
+(vanilla decoder) and benchmark/fluid/machine_translation.py. LoD inputs
+become padded [B, T] + length vectors; the decoder is a DynamicRNN
+(lax.scan under the hood), attention reads the encoder states through the
+scan closure, and beam search unrolls `max_length` static steps of the
+`beam_search` op — static shapes end to end, XLA-friendly.
+"""
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+__all__ = ["encoder", "train_program", "infer_program", "build_program"]
+
+_NEG = -1e9
+
+
+def encoder(src, src_len, dict_size, word_dim=16, hidden_dim=32):
+    """LSTM encoder → (per-step states [B,T,H], last state [B,H])."""
+    emb = layers.embedding(src, size=[dict_size, word_dim],
+                           param_attr=ParamAttr(name="src_emb"))
+    fc1 = layers.fc(emb, size=hidden_dim * 4, act="tanh",
+                    num_flatten_dims=2, param_attr=ParamAttr(name="enc_fc.w"))
+    h, _ = layers.dynamic_lstm(fc1, size=hidden_dim * 4, seq_len=src_len,
+                               param_attr=ParamAttr(name="enc_lstm.w"))
+    context = layers.sequence_pool(h, "last", seq_len=src_len)
+    return h, context
+
+
+def _attend(state, enc_states, enc_mask, hidden_dim):
+    """Luong-general attention: softmax((enc W) . state) over source steps.
+
+    state [B,H]; enc_states [B,T,H]; enc_mask [B,T] (1 keep / 0 pad).
+    Returns the context vector [B,H]."""
+    proj = layers.fc(state, size=hidden_dim, bias_attr=False,
+                     param_attr=ParamAttr(name="att_proj.w"))     # [B,H]
+    scores = layers.squeeze(
+        layers.matmul(enc_states, layers.unsqueeze(proj, [2])), [2])  # [B,T]
+    scores = scores + (enc_mask - 1.0) * (-_NEG)
+    weights = layers.softmax(scores)                               # [B,T]
+    ctx = layers.squeeze(
+        layers.matmul(layers.unsqueeze(weights, [1]), enc_states), [1])
+    return ctx
+
+
+def train_decoder(trg, trg_len, enc_states, enc_mask, context, dict_size,
+                  word_dim=16, decoder_size=32, attention=True):
+    """Teacher-forced decoder returning per-step vocab probs [B,T,V]."""
+    emb = layers.embedding(trg, size=[dict_size, word_dim],
+                           param_attr=ParamAttr(name="trg_emb"))
+    rnn = layers.DynamicRNN(seq_len=trg_len)
+    with rnn.block():
+        word = rnn.step_input(emb)                  # [B, word_dim]
+        state = rnn.memory(init=context)            # [B, H]
+        step_in = [word, state]
+        if attention:
+            step_in.append(_attend(state, enc_states, enc_mask,
+                                   int(context.shape[-1])))
+        new_state = layers.fc(
+            step_in, size=decoder_size, act="tanh",
+            param_attr=[ParamAttr(name=f"dec_fc_{i}.w")
+                        for i in range(len(step_in))],
+            bias_attr=ParamAttr(name="dec_fc.b"))
+        prob = layers.fc(new_state, size=dict_size, act="softmax",
+                         param_attr=ParamAttr(name="dec_out.w"),
+                         bias_attr=ParamAttr(name="dec_out.b"))
+        rnn.update_memory(state, new_state)
+        rnn.output(prob)
+    return rnn()
+
+
+def train_program(dict_size=1000, maxlen=16, word_dim=16, hidden_dim=32,
+                  attention=True):
+    """Build the training graph; returns (feed names, avg_cost)."""
+    src = layers.data("src_word_id", shape=[maxlen], dtype="int64")
+    src_len = layers.data("src_len", shape=[], dtype="int64")
+    trg = layers.data("target_language_word", shape=[maxlen], dtype="int64")
+    trg_len = layers.data("trg_len", shape=[], dtype="int64")
+    label = layers.data("target_language_next_word", shape=[maxlen],
+                        dtype="int64")
+    enc_states, context = encoder(src, src_len, dict_size, word_dim,
+                                  hidden_dim)
+    enc_mask = layers.cast(
+        layers.sequence_mask(src_len, maxlen=maxlen), "float32")
+    probs = train_decoder(trg, trg_len, enc_states, enc_mask, context,
+                          dict_size, word_dim, hidden_dim, attention)
+    # per-token NLL, masked to each row's target length
+    flat = layers.reshape(probs, [-1, dict_size])
+    loss = layers.cross_entropy(flat, layers.reshape(label, [-1, 1]))
+    tmask = layers.cast(
+        layers.sequence_mask(trg_len, maxlen=maxlen), "float32")
+    tmask = layers.reshape(tmask, [-1, 1])
+    avg_cost = layers.reduce_sum(loss * tmask) / (
+        layers.reduce_sum(tmask) + 1e-9)
+    feeds = ["src_word_id", "src_len", "target_language_word", "trg_len",
+             "target_language_next_word"]
+    return feeds, avg_cost
+
+
+def _beam_step_state_gather(state, parent, batch, beam):
+    """Reorder [B*K, H] decoder states by the chosen parent beams [B,K]."""
+    hid = int(state.shape[-1])
+    st = layers.reshape(state, [batch, beam, hid])
+    bidx = layers.expand(
+        layers.reshape(
+            layers.cast(layers.range(0, batch, 1, "int64"), "int64"),
+            [batch, 1]),
+        [1, beam])                                       # [B,K]
+    idx = layers.stack([bidx, parent], axis=2)           # [B,K,2]
+    return layers.reshape(layers.gather_nd(st, idx), [batch * beam, hid])
+
+
+def infer_program(dict_size=1000, maxlen=16, word_dim=16, hidden_dim=32,
+                  beam_size=4, max_out_len=16, end_id=1, batch=4,
+                  attention=True):
+    """Beam-search inference graph sharing the training parameters.
+
+    Static unroll of max_out_len beam_search steps (fixed [B,K] beams);
+    returns the decoded [B, K, T] sequences + [B, K] scores."""
+    src = layers.data("src_word_id", shape=[maxlen], dtype="int64")
+    src_len = layers.data("src_len", shape=[], dtype="int64")
+    enc_states, context = encoder(src, src_len, dict_size, word_dim,
+                                  hidden_dim)
+    enc_mask = layers.cast(
+        layers.sequence_mask(src_len, maxlen=maxlen), "float32")
+
+    K = beam_size
+    # tile encoder outputs across beams: [B,...] -> [B*K,...]
+    ctx = layers.reshape(
+        layers.expand(layers.unsqueeze(context, [1]), [1, K, 1]),
+        [batch * K, hidden_dim])
+    enc_b = layers.reshape(
+        layers.expand(layers.unsqueeze(enc_states, [1]), [1, K, 1, 1]),
+        [batch * K, maxlen, hidden_dim])
+    mask_b = layers.reshape(
+        layers.expand(layers.unsqueeze(enc_mask, [1]), [1, K, 1]),
+        [batch * K, maxlen])
+
+    pre_ids = layers.fill_constant([batch, K], "int64", 0)   # <s>
+    # only beam 0 is live initially (others -inf) so step 1 fans out
+    init = np.zeros((batch, K), "float32")
+    init[:, 1:] = _NEG
+    pre_scores = layers.assign(init)
+
+    state = ctx
+    step_ids, step_parents = [], []
+    scores = None
+    for _ in range(max_out_len):
+        emb = layers.embedding(layers.reshape(pre_ids, [batch * K, 1]),
+                               size=[dict_size, word_dim],
+                               param_attr=ParamAttr(name="trg_emb"))
+        emb = layers.reshape(emb, [batch * K, word_dim])
+        step_in = [emb, state]
+        if attention:
+            step_in.append(_attend(state, enc_b, mask_b, hidden_dim))
+        new_state = layers.fc(
+            step_in, size=hidden_dim, act="tanh",
+            param_attr=[ParamAttr(name=f"dec_fc_{i}.w")
+                        for i in range(len(step_in))],
+            bias_attr=ParamAttr(name="dec_fc.b"))
+        prob = layers.fc(new_state, size=dict_size, act="softmax",
+                         param_attr=ParamAttr(name="dec_out.w"),
+                         bias_attr=ParamAttr(name="dec_out.b"))
+        logp = layers.log(prob + 1e-12)
+        acc = layers.reshape(logp, [batch, K, dict_size]) + \
+            layers.unsqueeze(pre_scores, [2])
+        sel_ids, sel_scores, parent = layers.beam_search(
+            pre_ids, pre_scores, None, acc, beam_size=K, end_id=end_id)
+        state = _beam_step_state_gather(new_state, parent, batch, K)
+        step_ids.append(sel_ids)
+        step_parents.append(parent)
+        pre_ids, pre_scores = sel_ids, sel_scores
+        scores = sel_scores
+    ids_t = layers.stack(step_ids, axis=1)        # [B, T, K]
+    parents_t = layers.stack(step_parents, axis=1)
+    seqs, final_scores = layers.beam_search_decode(
+        ids_t, parents_t, scores=scores, beam_size=K, end_id=end_id)
+    return ["src_word_id", "src_len"], seqs, final_scores
+
+
+def build_program(dict_size=1000, maxlen=16, word_dim=16, hidden_dim=32,
+                  attention=True):
+    return train_program(dict_size, maxlen, word_dim, hidden_dim, attention)

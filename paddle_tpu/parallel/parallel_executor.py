@@ -24,7 +24,9 @@ from ..core.trace import build_step_fn
 from ..core.dtypes import as_jnp_dtype
 from .mesh import local_mesh
 
-__all__ = ["ParallelExecutor"]
+from ..core.compiler import BuildStrategy, ExecutionStrategy  # noqa: F401
+
+__all__ = ["ParallelExecutor", "BuildStrategy", "ExecutionStrategy"]
 
 
 class ParallelExecutor:

@@ -10,7 +10,7 @@ from collections import defaultdict
 
 import jax
 
-__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+__all__ = ["cuda_profiler", "profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "record_event", "summary"]
 
 _records = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
@@ -73,3 +73,11 @@ def summary(sorted_key="total"):
         lines.append(f"{name:<40}{c:>8}{tot:>12.4f}{avg:>12.4f}")
     report = "\n".join(lines)
     return report
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Compat alias (ref profiler.py:cuda_profiler): profiles the device
+    whatever it is — on TPU this simply delegates to profiler()."""
+    with profiler("All", "total", output_file):
+        yield

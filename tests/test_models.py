@@ -199,3 +199,56 @@ def test_recommender_system_trains():
     losses = _run_steps(feeds, avg_cost, feed, steps=10,
                         opt=pt.optimizer.Adam(1e-2))
     assert losses[-1] < losses[0], losses
+
+
+def test_seq2seq_attention_trains():
+    """Book ch.8 (test_machine_translation.py): attention RNN
+    encoder-decoder learns the trg=src+1 copy-shift task."""
+    from paddle_tpu.models import seq2seq
+    V, T = 50, 8
+    feeds, avg_cost = seq2seq.train_program(dict_size=V, maxlen=T,
+                                            word_dim=16, hidden_dim=32)
+    rng = np.random.RandomState(0)
+
+    def feed(i):
+        B = 8
+        src = rng.randint(2, V - 1, (B, T)).astype("int64")
+        trg = np.concatenate([np.zeros((B, 1), "int64"),
+                              (src[:, :-1] + 1) % V], axis=1)
+        label = (src + 1) % V
+        return {"src_word_id": src, "src_len": np.full(B, T, "int64"),
+                "target_language_word": trg,
+                "trg_len": np.full(B, T, "int64"),
+                "target_language_next_word": label}
+
+    losses = _run_steps(feeds, avg_cost, feed, steps=15,
+                        opt=pt.optimizer.Adam(5e-3))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_seq2seq_beam_search_decodes():
+    """Beam-search inference graph builds, runs, and emits [B,K,T]
+    sequences with finite descending beam scores."""
+    from paddle_tpu.models import seq2seq
+    V, T, B, K = 30, 6, 3, 4
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            feeds, seqs, scores = seq2seq.infer_program(
+                dict_size=V, maxlen=T, word_dim=8, hidden_dim=16,
+                beam_size=K, max_out_len=5, end_id=1, batch=B)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    rng = np.random.RandomState(1)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        out, sc = exe.run(
+            main,
+            feed={"src_word_id": rng.randint(2, V, (B, T)).astype("int64"),
+                  "src_len": np.full(B, T, "int64")},
+            fetch_list=[seqs, scores])
+    assert out.shape == (B, K, 5)
+    assert np.all((out >= 0) & (out < V))
+    assert np.all(np.isfinite(sc))
+    # beams come out best-first
+    assert np.all(np.diff(sc, axis=1) <= 1e-5)
