@@ -56,12 +56,21 @@ class LayerHelper:
                 f"specify static dims for parameter-creating layers")
         existing = self.main_program.global_block().vars.get(name)
         if existing is not None:
+            from .core.framework import Parameter
             if tuple(existing.shape) != tuple(shape):
                 raise ValueError(
                     f"parameter name {name!r} reused with a different shape "
                     f"({tuple(existing.shape)} vs {tuple(shape)}) — two "
                     f"weights would silently alias one array in the scope; "
                     f"give each its own ParamAttr name")
+            if str(existing.dtype) != str(dtype):
+                raise ValueError(
+                    f"parameter name {name!r} reused with a different dtype "
+                    f"({existing.dtype} vs {dtype})")
+            if not isinstance(existing, Parameter):
+                raise ValueError(
+                    f"name {name!r} already belongs to a non-parameter "
+                    f"variable; it would never be initialized or trained")
             # intentional sharing (e.g. a decoder step unrolled N times):
             # reuse the declared param, don't append N-1 dead re-init ops
             # to the startup program

@@ -105,9 +105,7 @@ def _beam_step_state_gather(state, parent, batch, beam):
     hid = int(state.shape[-1])
     st = layers.reshape(state, [batch, beam, hid])
     bidx = layers.expand(
-        layers.reshape(
-            layers.cast(layers.range(0, batch, 1, "int64"), "int64"),
-            [batch, 1]),
+        layers.reshape(layers.range(0, batch, 1, "int64"), [batch, 1]),
         [1, beam])                                       # [B,K]
     idx = layers.stack([bidx, parent], axis=2)           # [B,K,2]
     return layers.reshape(layers.gather_nd(st, idx), [batch * beam, hid])

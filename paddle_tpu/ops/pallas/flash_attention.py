@@ -47,8 +47,9 @@ try:
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
-__all__ = ["flash_attention", "flash_attention_reference", "STATS",
-           "set_mode", "active", "MIN_SEQ_LEN"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "flash_attention_reference", "STATS", "set_mode", "active",
+           "MIN_SEQ_LEN"]
 
 _NEG_INF = -1e30
 
@@ -300,16 +301,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret):
+def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
+              g_lse=None):
     q, k, v, bias, out, lse = res
     BH, T, D = q.shape
     S = k.shape[1]
     DV = v.shape[-1]
     H = n_heads
     do = g.astype(jnp.float32)
-    # delta_i = rowsum(dO * O): the softmax-normalization correction term
+    # delta_i = rowsum(dO * O): the softmax-normalization correction term.
+    # An lse cotangent folds in here: d s_ij gets p_ij * g_lse_i, i.e.
+    # ds = p * (dp - (delta - g_lse)).
     delta = jnp.sum(do * out.astype(jnp.float32), axis=-1,
                     keepdims=True).transpose(0, 2, 1)        # [BH, 1, T]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     n_k = S // block_k
     n_q = T // block_q
 
@@ -394,6 +400,51 @@ def _flash_bwd(n_heads, causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
+               interpret):
+    """Like _flash but also returns the per-row logsumexp — the merge
+    currency of ring attention (parallel/ring_attention.py)."""
+    return _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
+                     block_k, interpret)
+
+
+def _flash_lse_fwd(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
+                   interpret):
+    out, lse = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
+                         block_k, interpret)
+    return (out, lse), (q, k, v, bias, out, lse)
+
+
+def _flash_lse_bwd(n_heads, causal, scale, block_q, block_k, interpret,
+                   res, g):
+    g_out, g_lse = g
+    dq, dk, dv = _bwd_call(res, g_out, n_heads, causal, scale, block_q,
+                           block_k, interpret, g_lse=g_lse)
+    return dq, dk, dv, jnp.zeros_like(res[3])
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, bias=None, causal=False, scale=None,
+                             block_q=None, block_k=None, interpret=False):
+    """q/k/v [B,H,T,D] → (out [B,H,T,Dv], lse [B,H,T]).
+
+    Differentiable (incl. the lse output); the unnormalized-merge entry
+    point for ring attention's cross-device online softmax."""
+    if not _HAS_PALLAS:
+        raise NotImplementedError("pallas unavailable")
+    STATS["pallas_calls"] += 1
+    B, H, T, _ = q.shape
+    qr, kr, vr, br, H, scale, block_q, block_k = _prep(
+        q, k, v, bias, scale, block_q or DEFAULT_BLOCK_Q,
+        block_k or DEFAULT_BLOCK_K)
+    out, lse = _flash_lse(qr, kr, vr, br, H, bool(causal), scale, block_q,
+                          block_k, bool(interpret))
+    return out.reshape(B, H, T, vr.shape[-1]), lse.reshape(B, H, T)
+
+
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
@@ -415,14 +466,10 @@ def supports(q, k, v, bias=None, block_q=DEFAULT_BLOCK_Q,
     return True
 
 
-def flash_attention(q, k, v, bias=None, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=False):
-    """q/k/v: [B, H, T, D] → [B, H, T, D]. Differentiable (custom_vjp);
-    bias is an additive key-padding bias [B, S] or [B,1,1,S]."""
-    if not _HAS_PALLAS:
-        raise NotImplementedError("pallas unavailable")
-    STATS["pallas_calls"] += 1
+def _prep(q, k, v, bias, scale, block_q, block_k):
+    """Shared dispatch prep: block picking, [B,H,T,D]→[BH,T,D] flatten,
+    [B,1,S] bias normalization — ONE place so flash_attention and
+    flash_attention_with_lse (and supports()) cannot drift."""
     B, H, T, D = q.shape
     S = k.shape[2]
     scale = float(scale) if scale is not None else D ** -0.5
@@ -440,6 +487,20 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
         if br.shape[0] == 1 and B > 1:
             br = jnp.broadcast_to(br, (B, S))
         br = br.reshape(B, 1, S)
+    return qr, kr, vr, br, H, scale, block_q, block_k
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=False):
+    """q/k/v: [B, H, T, D] → [B, H, T, D]. Differentiable (custom_vjp);
+    bias is an additive key-padding bias [B, S] or [B,1,1,S]."""
+    if not _HAS_PALLAS:
+        raise NotImplementedError("pallas unavailable")
+    STATS["pallas_calls"] += 1
+    B, H, T, _ = q.shape
+    qr, kr, vr, br, H, scale, block_q, block_k = _prep(
+        q, k, v, bias, scale, block_q, block_k)
     # per-batch bias row is shared across heads via the kernel index_map
     out = _flash(qr, kr, vr, br, H, bool(causal), scale, block_q, block_k,
                  bool(interpret))

@@ -2,9 +2,17 @@
 
 First-class long-context support (SURVEY §2.4): Q/K/V are sharded along
 the sequence dim across `sp` devices; K/V blocks rotate around the ring
-via ppermute while each device accumulates its queries' output with an
-online (flash-style) softmax. Peak memory per device is O(T/sp * T/sp)
-per block instead of O(T^2); comm rides neighbor ICI links.
+via ppermute while each device merges its queries' output in
+(out, logsumexp) space — the online-softmax invariant. Peak memory per
+device is O(T/sp * T/sp) per block instead of O(T^2); comm rides
+neighbor ICI links.
+
+The per-block engine is selected by size: the Pallas flash kernel
+(ops/pallas/flash_attention.py, via its lse-returning custom_vjp entry)
+when the local block is at/above the measured crossover, else the fused
+XLA path. Under causal masking, blocks strictly above the diagonal are
+skipped entirely via lax.switch (≈2x fewer FLOPs), the diagonal block
+runs the causal kernel, and blocks below run the full kernel.
 
 Public entry: ring_attention(mesh, q, k, v, causal=...) — call with
 GLOBAL [B, H, T, D] arrays; returns global output. Inside it shard_maps
@@ -16,33 +24,44 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax import shard_map
+
+from ..ops.pallas import flash_attention as _fa
 
 __all__ = ["ring_attention", "ring_attention_local"]
 
 _NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, bias=None):
-    """Unnormalized block attention: returns (acc, row_sum, row_max)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
-    if bias is not None:
-        s = s + bias
+def _block_jnp(q, k, v, causal, scale):
+    """Fused-XLA block attention → (normalized out, lse)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T, S = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((T, S), dtype=bool), k=S - T)
+        s = jnp.where(cm, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     m = jnp.maximum(m, _NEG_INF)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
-    return acc.astype(jnp.float32), l, m
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    out = out.astype(jnp.float32) / jnp.maximum(l, 1e-20)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-20)))[..., 0]     # [B,H,t]
+    return out, lse
 
 
-def _merge(acc1, l1, m1, acc2, l2, m2):
-    """Online-softmax merge of two partial attention results."""
-    m = jnp.maximum(m1, m2)
-    a1 = jnp.exp(m1 - m)
-    a2 = jnp.exp(m2 - m)
-    return acc1 * a1 + acc2 * a2, l1 * a1 + l2 * a2, m
+def _block_engine(q, k, v, scale):
+    """Pick the per-block attention fn (causal: bool) → (out_f32, lse)."""
+    use_pallas, interpret = _fa.active()
+    big_enough = interpret or k.shape[2] >= _fa.MIN_SEQ_LEN
+    if use_pallas and big_enough and _fa.supports(q, k, v):
+        def run(causal):
+            out, lse = _fa.flash_attention_with_lse(
+                q, k, v, causal=causal, scale=scale, interpret=interpret)
+            return out.astype(jnp.float32), lse
+        return run
+    return lambda causal: _block_jnp(q, k, v, causal, scale)
 
 
 def ring_attention_local(q, k, v, axis_name="sp", causal=False, scale=None):
@@ -52,36 +71,56 @@ def ring_attention_local(q, k, v, axis_name="sp", causal=False, scale=None):
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     scale = scale if scale is not None else (q.shape[-1] ** -0.5)
-    qs = (q * scale).astype(q.dtype)
-    t_q = q.shape[2]
-    t_k = k.shape[2]
-
-    def causal_bias(q_block, k_block):
-        # global positions of this device's queries vs the rotating k block
-        q_pos = q_block * t_q + jnp.arange(t_q)
-        k_pos = k_block * t_k + jnp.arange(t_k)
-        allowed = q_pos[:, None] >= k_pos[None, :]
-        return jnp.where(allowed, 0.0, _NEG_INF)[None, None]
+    B, H, t_q = q.shape[0], q.shape[1], q.shape[2]
+    DV = v.shape[-1]
+    if causal and q.shape[2] != k.shape[2]:
+        # the full/diag/skip block classification below assumes the global
+        # diagonal lines up with equal shard lengths; unequal q/kv shards
+        # under causal would silently mis-mask (cross-attention rings are
+        # never causal in practice)
+        raise NotImplementedError(
+            "causal ring attention requires equal q and k/v shard lengths "
+            f"(got {q.shape[2]} vs {k.shape[2]})")
 
     def step(carry, _):
-        acc, l, m, kk, vv, src = carry
-        bias = causal_bias(idx, src) if causal else None
-        acc2, l2, m2 = _block_attn(qs, kk, vv, bias)
-        acc, l, m = _merge(acc, l, m, acc2, l2, m2)
+        out, lse, kk, vv, src = carry
+        run = _block_engine(q, kk, vv, scale)
+        if causal:
+            def full(_):
+                return run(False)
+
+            def diag(_):
+                return run(True)
+
+            def skip(_):
+                return (jnp.zeros((B, H, t_q, DV), jnp.float32),
+                        jnp.full((B, H, t_q), _NEG_INF, jnp.float32))
+
+            branch = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+            o2, lse2 = lax.switch(branch, (full, diag, skip), None)
+        else:
+            o2, lse2 = run(False)
+        # online-softmax merge in (out, lse) space
+        m = jnp.maximum(lse, lse2)
+        a1 = jnp.exp(lse - m)
+        a2 = jnp.exp(lse2 - m)
+        out = out * a1[..., None] + o2 * a2[..., None]
+        denom = a1 + a2
+        out = out / jnp.maximum(denom, 1e-20)[..., None]
+        lse_new = m + jnp.log(jnp.maximum(denom, 1e-20))
+        # re-normalized running out ↔ running lse: keep the invariant that
+        # `out` is the softmax-normalized result over all blocks seen so far
         # rotate k/v one hop around the ring (neighbor ICI link)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
         src = (src - 1) % n
-        return (acc, l, m, kk, vv, src), None
+        return (out, lse_new, kk, vv, src), None
 
-    B, H = q.shape[0], q.shape[1]
-    acc0 = jnp.zeros((B, H, t_q, v.shape[-1]), jnp.float32)
-    l0 = jnp.zeros((B, H, t_q, 1), jnp.float32)
-    m0 = jnp.full((B, H, t_q, 1), _NEG_INF, jnp.float32)
-    (acc, l, m, _, _, _), _ = lax.scan(
-        step, (acc0, l0, m0, k, v, idx), None, length=n)
-    out = acc / jnp.maximum(l, 1e-20)
+    out0 = jnp.zeros((B, H, t_q, DV), jnp.float32)
+    lse0 = jnp.full((B, H, t_q), _NEG_INF, jnp.float32)
+    (out, lse, _, _, _), _ = lax.scan(
+        step, (out0, lse0, k, v, idx), None, length=n)
     return out.astype(q.dtype)
 
 

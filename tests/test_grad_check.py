@@ -1,0 +1,196 @@
+"""Finite-difference gradient checks for core ops (SURVEY §4).
+
+Mirrors the reference's op gradient checks
+(tests/unittests/test_*_op.py check_grad pattern): build a tiny Program
+ending in a scalar loss, get analytic grads from the framework's own
+backward (append_backward → jax.value_and_grad under the tracer), and
+compare a sample of coordinates against central finite differences of
+the loss computed through the same Executor. fp32 + smooth activations,
+so eps/tolerances are chosen accordingly.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _check_grads(build, feed, params_to_check=None, eps=5e-3, rtol=6e-2,
+                 atol=5e-4, n_coords=4, seed=3):
+    """build() → loss Variable (called inside a fresh program guard)."""
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 11
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            loss = build()
+            pairs = pt.core.backward.append_backward(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    rng = np.random.RandomState(seed)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        fetch = [loss] + [g for _, g in pairs]
+        vals = exe.run(main, feed=feed, fetch_list=fetch)
+        grads = {p.name: np.asarray(g) for (p, _), g in zip(pairs, vals[1:])}
+
+        def loss_at():
+            return float(np.asarray(
+                exe.run(main, feed=feed, fetch_list=[loss])[0]))
+
+        names = params_to_check or list(grads)
+        for name in names:
+            w0 = np.asarray(scope.get(name)).astype(np.float64)
+            g = grads[name]
+            assert np.all(np.isfinite(g)), f"{name}: non-finite grads"
+            flat = w0.reshape(-1)
+            coords = rng.choice(flat.size, size=min(n_coords, flat.size),
+                                replace=False)
+            for c in coords:
+                for sign, store in ((+1, "hi"), (-1, "lo")):
+                    w = flat.copy()
+                    w[c] += sign * eps
+                    scope.set(name, jnp.asarray(
+                        w.reshape(w0.shape).astype(np.float32)))
+                    if sign > 0:
+                        hi = loss_at()
+                    else:
+                        lo = loss_at()
+                scope.set(name, jnp.asarray(w0.astype(np.float32)))
+                fd = (hi - lo) / (2 * eps)
+                an = g.reshape(-1)[c]
+                assert abs(fd - an) <= atol + rtol * max(abs(fd), abs(an)), (
+                    f"{name}[{c}]: analytic {an:.6f} vs finite-diff "
+                    f"{fd:.6f}")
+
+
+def test_fc_tanh_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(6, 8).astype("float32")
+    y = rng.randn(6, 1).astype("float32")
+
+    def build():
+        xin = layers.data("x", shape=[8])
+        lbl = layers.data("y", shape=[1])
+        h = layers.fc(xin, size=5, act="tanh")
+        out = layers.fc(h, size=1)
+        return layers.mean(layers.square_error_cost(out, lbl))
+
+    _check_grads(build, {"x": x, "y": y})
+
+
+def test_conv2d_grad():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+
+    def build():
+        xin = layers.data("x", shape=[3, 8, 8])
+        c = layers.conv2d(xin, num_filters=4, filter_size=3, act="tanh")
+        return layers.mean(c)
+
+    _check_grads(build, {"x": x})
+
+
+def test_batch_norm_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 3, 5, 5).astype("float32")
+
+    def build():
+        xin = layers.data("x", shape=[3, 5, 5])
+        c = layers.conv2d(xin, num_filters=2, filter_size=3, act=None)
+        b = layers.batch_norm(c)
+        return layers.mean(layers.tanh(b))
+
+    # running stats get no gradient; restrict to weights
+    _check_grads(build, {"x": x},
+                 params_to_check=[n for n in _param_names(build)
+                                  if "mean" not in n and "variance" not in n])
+
+
+def _param_names(build):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            build()
+    return [p.name for p in main.global_block().all_parameters()
+            if p.trainable]
+
+
+def test_layer_norm_grad():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 12).astype("float32")
+
+    def build():
+        xin = layers.data("x", shape=[12])
+        return layers.mean(layers.tanh(layers.layer_norm(xin)))
+
+    _check_grads(build, {"x": x})
+
+
+def test_softmax_cross_entropy_grad():
+    rng = np.random.RandomState(4)
+    x = rng.randn(6, 10).astype("float32")
+    y = rng.randint(0, 7, (6, 1)).astype("int64")
+
+    def build():
+        xin = layers.data("x", shape=[10])
+        lbl = layers.data("y", shape=[1], dtype="int64")
+        logits = layers.fc(xin, size=7)
+        return layers.mean(
+            layers.softmax_with_cross_entropy(logits, lbl))
+
+    _check_grads(build, {"x": x, "y": y})
+
+
+def test_embedding_grad():
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 20, (6, 4)).astype("int64")
+
+    def build():
+        xin = layers.data("ids", shape=[4], dtype="int64")
+        emb = layers.embedding(xin, size=[20, 6])
+        return layers.mean(layers.tanh(emb))
+
+    _check_grads(build, {"ids": ids})
+
+
+def test_dynamic_lstm_grad():
+    rng = np.random.RandomState(6)
+    x = rng.randn(3, 5, 8).astype("float32")
+    lens = np.array([5, 3, 4], "int64")
+
+    def build():
+        xin = layers.data("x", shape=[5, 8])
+        sl = layers.data("len", shape=[], dtype="int64")
+        h, _ = layers.dynamic_lstm(xin, size=4 * 6, seq_len=sl)
+        return layers.mean(h)
+
+    _check_grads(build, {"x": x, "len": lens}, eps=1e-2)
+
+
+def test_sequence_pool_matmul_grad():
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 5, 6).astype("float32")
+    lens = np.array([5, 2, 4], "int64")
+
+    def build():
+        xin = layers.data("x", shape=[5, 6])
+        sl = layers.data("len", shape=[], dtype="int64")
+        w = layers.fc(xin, size=6, num_flatten_dims=2, act="tanh")
+        pooled = layers.sequence_pool(w, "mean", seq_len=sl)
+        return layers.mean(layers.matmul(pooled, pooled, transpose_y=True))
+
+    _check_grads(build, {"x": x, "len": lens})
+
+
+def test_gru_grad():
+    rng = np.random.RandomState(8)
+    x = rng.randn(3, 4, 6).astype("float32")
+
+    def build():
+        xin = layers.data("x", shape=[4, 6])
+        h = layers.dynamic_gru(layers.fc(
+            xin, size=3 * 5, num_flatten_dims=2), size=5)
+        return layers.mean(h)
+
+    _check_grads(build, {"x": x}, eps=1e-2)
