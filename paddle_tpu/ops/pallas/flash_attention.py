@@ -48,8 +48,8 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 __all__ = ["flash_attention", "flash_attention_with_lse",
-           "flash_attention_reference", "STATS", "set_mode", "active",
-           "MIN_SEQ_LEN"]
+           "flash_attention_reference", "try_flash", "STATS", "set_mode",
+           "active", "MIN_SEQ_LEN"]
 
 _NEG_INF = -1e30
 
@@ -521,3 +521,24 @@ def flash_attention_reference(q, k, v, bias=None, causal=False, scale=None):
         s = jnp.where(cm, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v).astype(q.dtype)
+
+
+def try_flash(q, k, v, bias=None, causal=False, scale=None, with_lse=False):
+    """THE dispatch policy, in one place (used by ops/kernels_nn.py,
+    parallel/ring_attention.py, parallel/ulysses.py): returns the Pallas
+    result — `out` or `(out, lse)` with `with_lse` — when the kernel is
+    active, profitable (S >= MIN_SEQ_LEN; interpret mode bypasses the
+    perf gate), and the shapes/bias layout are supported; else None and
+    the caller runs its own fused-XLA fallback."""
+    use_pallas, interpret = active()
+    if not use_pallas:
+        return None
+    if not interpret and k.shape[2] < MIN_SEQ_LEN:
+        return None
+    if not supports(q, k, v, bias=bias):
+        return None
+    if with_lse:
+        return flash_attention_with_lse(q, k, v, bias=bias, causal=causal,
+                                        scale=scale, interpret=interpret)
+    return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale,
+                           interpret=interpret)

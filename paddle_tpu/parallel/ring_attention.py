@@ -53,15 +53,14 @@ def _block_jnp(q, k, v, causal, scale):
 
 def _block_engine(q, k, v, scale):
     """Pick the per-block attention fn (causal: bool) → (out_f32, lse)."""
-    use_pallas, interpret = _fa.active()
-    big_enough = interpret or k.shape[2] >= _fa.MIN_SEQ_LEN
-    if use_pallas and big_enough and _fa.supports(q, k, v):
-        def run(causal):
-            out, lse = _fa.flash_attention_with_lse(
-                q, k, v, causal=causal, scale=scale, interpret=interpret)
-            return out.astype(jnp.float32), lse
-        return run
-    return lambda causal: _block_jnp(q, k, v, causal, scale)
+    def run(causal):
+        res = _fa.try_flash(q, k, v, causal=causal, scale=scale,
+                            with_lse=True)
+        if res is None:
+            return _block_jnp(q, k, v, causal, scale)
+        out, lse = res
+        return out.astype(jnp.float32), lse
+    return run
 
 
 def ring_attention_local(q, k, v, axis_name="sp", causal=False, scale=None):

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..ops.pallas import flash_attention as _fa
+
 __all__ = ["ulysses_attention"]
 
 
@@ -38,13 +40,18 @@ def ulysses_attention(mesh, q, k, v, causal=False, scale=None,
                             tiled=True)
         vl = lax.all_to_all(vl, axis_name, split_axis=1, concat_axis=2,
                             tiled=True)
-        s = jnp.einsum("bhqd,bhkd->bhqk", ql, kl).astype(jnp.float32)
-        s = s * scale
-        if causal:
-            cm = jnp.tril(jnp.ones((T, T), dtype=bool))
-            s = jnp.where(cm, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(ql.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", p, vl)
+        # full sequence is local after the all-to-all — the shared
+        # try_flash policy decides kernel vs fused-XLA exactly as for
+        # single-device attention
+        out = _fa.try_flash(ql, kl, vl, causal=causal, scale=scale)
+        if out is None:
+            s = jnp.einsum("bhqd,bhkd->bhqk", ql, kl).astype(jnp.float32)
+            s = s * scale
+            if causal:
+                cm = jnp.tril(jnp.ones((T, T), dtype=bool))
+                s = jnp.where(cm, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(ql.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p, vl)
         # back: [B, H/sp, T, D] → [B, H, T/sp, D]
         return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)

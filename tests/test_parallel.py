@@ -149,6 +149,7 @@ def test_ring_attention_flash_blocks_match_full():
     q, k, v = [jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
                for _ in range(3)]
     fa.set_mode("interpret")
+    calls_before = fa.STATS["pallas_calls"]
     try:
         for causal in (False, True):
             def ring_loss(q, k, v):
@@ -176,5 +177,32 @@ def test_ring_attention_flash_blocks_match_full():
             for a, b in zip(g1, g2):
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=1e-4, atol=1e-4)
+        # the kernel (not the jnp fallback) must actually have run
+        assert fa.STATS["pallas_calls"] > calls_before
+    finally:
+        fa.set_mode("auto")
+
+
+def test_ulysses_flash_local_matches_full():
+    """Ulysses with the Pallas kernel as the local engine (interpret
+    mode) matches full attention."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.parallel.ulysses import ulysses_attention
+    mesh = make_mesh(sp=4)
+    B, H, T, D = 1, 4, 32, 16
+    rng = np.random.RandomState(5)
+    q, k, v = [jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+               for _ in range(3)]
+    fa.set_mode("interpret")
+    calls_before = fa.STATS["pallas_calls"]
+    try:
+        out = ulysses_attention(mesh, q, k, v, causal=True)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q * D ** -0.5, k)
+        cm = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(cm, s, -1e30)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5)
+        assert fa.STATS["pallas_calls"] > calls_before
     finally:
         fa.set_mode("auto")
