@@ -15,3 +15,5 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import ring_attention
 from . import sharding
 from . import fleet
+from . import ulysses
+from . import moe

@@ -5,11 +5,13 @@ wiring NCCL rings (paddle/fluid/framework/details/*_ssa_graph*); here a
 single SPMD program spans a jax.sharding.Mesh. Axis conventions:
     dp — data parallel (batch)
     tp — tensor/model parallel (Megatron-style)
-    sp — sequence/context parallel (ring attention)
+    sp — sequence/context parallel (ring/Ulysses attention)
     pp — pipeline stages
+    ep — expert parallel (switch-MoE, parallel/moe.py)
 Multi-host: the same Mesh API spans hosts after
-jax.distributed.initialize(); dp/pp map naturally onto DCN, tp/sp onto
-ICI (scaling-book layout).
+jax.distributed.initialize(); dp/pp map naturally onto DCN, tp/sp/ep
+onto ICI (scaling-book layout; ep sits between dp and sp so expert
+all-to-alls stay on-host).
 """
 import numpy as np
 import jax
@@ -21,18 +23,19 @@ __all__ = ["make_mesh", "local_mesh", "axis_size", "P", "NamedSharding",
 P = PartitionSpec
 
 
-def make_mesh(dp=1, tp=1, sp=1, pp=1, devices=None):
-    """Create a Mesh with the canonical axis order (pp, dp, sp, tp).
+def make_mesh(dp=1, tp=1, sp=1, pp=1, ep=1, devices=None):
+    """Create a Mesh with the canonical axis order (pp, dp, ep, sp, tp).
 
     tp/sp innermost → neighboring devices (fastest ICI links) carry the
-    highest-bandwidth collectives, dp outermost → gradient all-reduce can
-    cross DCN on multi-host."""
+    highest-bandwidth collectives; ep between dp and sp so expert
+    all-to-alls stay within a host; dp outermost → gradient all-reduce
+    can cross DCN on multi-host."""
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * tp * sp * pp
+    need = dp * tp * sp * pp * ep
     if need > len(devices):
         raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
-    arr = np.asarray(devices[:need]).reshape(pp, dp, sp, tp)
-    return Mesh(arr, axis_names=("pp", "dp", "sp", "tp"))
+    arr = np.asarray(devices[:need]).reshape(pp, dp, ep, sp, tp)
+    return Mesh(arr, axis_names=("pp", "dp", "ep", "sp", "tp"))
 
 
 def local_mesh(axis="dp", devices=None):
