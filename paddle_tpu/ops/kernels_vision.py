@@ -81,8 +81,12 @@ def adaptive_pool_nd(x, out_sizes, ptype):
 
 def _pool_window(x, ks, strides, pads, ptype, exclusive, ceil_mode):
     """Shared reduce_window pooling over trailing spatial dims; ceil_mode
-    extends the high-side padding so the last partial window counts (its
-    pad elements are excluded from avg counts like the reference)."""
+    extends the high-side padding so the last partial window counts.
+
+    Avg divisor follows the reference exactly (operators/math/pooling.cc):
+    exclusive=True divides by the count of REAL cells in the clipped
+    window; exclusive=False divides by the constant kernel area — even
+    for ceil-extended or padded windows."""
     spatial = x.ndim - 2
     pad = [(0, 0), (0, 0)]
     for i in range(spatial):
@@ -98,7 +102,7 @@ def _pool_window(x, ks, strides, pads, ptype, exclusive, ceil_mode):
         return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strd,
                                      pad)
     summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strd, pad)
-    if (exclusive or ceil_mode) and any(p != (0, 0) for p in pad[2:]):
+    if exclusive and any(p != (0, 0) for p in pad[2:]):
         cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
                                     window, strd, pad)
         return summed / cnt

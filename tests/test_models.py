@@ -201,6 +201,17 @@ def test_recommender_system_trains():
     assert losses[-1] < losses[0], losses
 
 
+def _seq2seq_copy_shift_feed(rng, V, T, B=8):
+    """Shared copy-shift task feed for the seq2seq book tests."""
+    src = rng.randint(2, V - 1, (B, T)).astype("int64")
+    trg = np.concatenate([np.zeros((B, 1), "int64"),
+                          (src[:, :-1] + 1) % V], axis=1)
+    return {"src_word_id": src, "src_len": np.full(B, T, "int64"),
+            "target_language_word": trg,
+            "trg_len": np.full(B, T, "int64"),
+            "target_language_next_word": (src + 1) % V}
+
+
 def test_seq2seq_attention_trains():
     """Book ch.8 (test_machine_translation.py): attention RNN
     encoder-decoder learns the trg=src+1 copy-shift task."""
@@ -209,20 +220,9 @@ def test_seq2seq_attention_trains():
     feeds, avg_cost = seq2seq.train_program(dict_size=V, maxlen=T,
                                             word_dim=16, hidden_dim=32)
     rng = np.random.RandomState(0)
-
-    def feed(i):
-        B = 8
-        src = rng.randint(2, V - 1, (B, T)).astype("int64")
-        trg = np.concatenate([np.zeros((B, 1), "int64"),
-                              (src[:, :-1] + 1) % V], axis=1)
-        label = (src + 1) % V
-        return {"src_word_id": src, "src_len": np.full(B, T, "int64"),
-                "target_language_word": trg,
-                "trg_len": np.full(B, T, "int64"),
-                "target_language_next_word": label}
-
-    losses = _run_steps(feeds, avg_cost, feed, steps=15,
-                        opt=pt.optimizer.Adam(5e-3))
+    losses = _run_steps(feeds, avg_cost,
+                        lambda i: _seq2seq_copy_shift_feed(rng, V, T),
+                        steps=15, opt=pt.optimizer.Adam(5e-3))
     assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
 
 
@@ -252,3 +252,44 @@ def test_seq2seq_beam_search_decodes():
     assert np.all(np.isfinite(sc))
     # beams come out best-first
     assert np.all(np.diff(sc, axis=1) <= 1e-5)
+
+
+def test_fit_a_line_uci_housing_converges():
+    """Book ch.1 (test_fit_a_line.py): linear regression on uci_housing
+    through the full reader/DataFeeder/Executor stack."""
+    from paddle_tpu.dataset import uci_housing
+    import paddle_tpu.reader as reader
+    x = layers.data("x", shape=[13])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+    pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    batched = reader.batch(reader.shuffle(uci_housing.train(), buf_size=200),
+                           batch_size=20)
+    feeder = pt.DataFeeder(place=pt.CPUPlace(), feed_list=[x, y])
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        for epoch in range(4):
+            for batch in batched():
+                lv, = exe.run(feed=feeder.feed(batch), fetch_list=[loss])
+                losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.7, (
+        losses[:3], losses[-3:])
+
+
+def test_rnn_encoder_decoder_vanilla_trains():
+    """Book test_rnn_encoder_decoder.py: seq2seq WITHOUT attention."""
+    from paddle_tpu.models import seq2seq
+    V, T = 40, 8
+    feeds, avg_cost = seq2seq.train_program(dict_size=V, maxlen=T,
+                                            word_dim=16, hidden_dim=32,
+                                            attention=False)
+    rng = np.random.RandomState(1)
+    losses = _run_steps(feeds, avg_cost,
+                        lambda i: _seq2seq_copy_shift_feed(rng, V, T),
+                        steps=12, opt=pt.optimizer.Adam(5e-3))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses

@@ -1,0 +1,269 @@
+"""Torch-verified op numerics (ref test strategy: tests/unittests/
+test_*_op.py compare against an independent implementation).
+
+Each test builds the op through the full Program/Executor stack and
+compares against torch CPU as the independent oracle. Complements the
+numpy-formula checks in test_ops.py / test_vision_ops.py.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(feeds, fetch, feed):
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        outs = exe.run(feed=feed, fetch_list=fetch if isinstance(fetch, list)
+                       else [fetch])
+    return [np.asarray(o) for o in outs]
+
+
+def _cmp(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(a, np.asarray(b), rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("stride,pad,dil,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2)])
+def test_conv2d_vs_torch(stride, pad, dil, groups):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 9, 9).astype("float32")
+    w = rng.randn(6, 4 // groups, 3, 3).astype("float32")
+    xin = layers.data("x", shape=[4, 9, 9])
+    out = layers.conv2d(xin, num_filters=6, filter_size=3, stride=stride,
+                        padding=pad, dilation=dil, groups=groups,
+                        bias_attr=False)
+    got, = _run(["x"], out, {"x": x})
+    # load our initialized weight into torch instead: fetch the param
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        pname = [p.name for p in
+                 pt.default_main_program().global_block().all_parameters()][0]
+        scope.set(pname, __import__("jax.numpy", fromlist=["asarray"]).asarray(w))
+        got, = [np.asarray(o) for o in exe.run(feed={"x": x},
+                                               fetch_list=[out])]
+    ref = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), None,
+                   stride=stride, padding=pad, dilation=dil, groups=groups)
+    _cmp(got, ref.numpy())
+
+
+def test_depthwise_conv2d_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 8, 8).astype("float32")
+    w = rng.randn(4, 1, 3, 3).astype("float32")
+    xin = layers.data("x", shape=[4, 8, 8])
+    out = layers.conv2d(xin, num_filters=4, filter_size=3, groups=4,
+                        padding=1, bias_attr=False,
+                        use_cudnn=False)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    import jax.numpy as jnp
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        pname = [p.name for p in
+                 pt.default_main_program().global_block().all_parameters()][0]
+        scope.set(pname, jnp.asarray(w))
+        got, = [np.asarray(o) for o in exe.run(feed={"x": x},
+                                               fetch_list=[out])]
+    ref = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), None,
+                   padding=1, groups=4)
+    _cmp(got, ref.numpy())
+
+
+@pytest.mark.parametrize("ptype,ceil,exclusive", [
+    ("max", False, True), ("max", True, True),
+    ("avg", False, True), ("avg", False, False), ("avg", True, False)])
+def test_pool2d_vs_torch(ptype, ceil, exclusive):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 7, 7).astype("float32")
+    xin = layers.data("x", shape=[3, 7, 7])
+    out = layers.pool2d(xin, pool_size=3, pool_type=ptype, pool_stride=2,
+                        pool_padding=1, ceil_mode=ceil, exclusive=exclusive)
+    got, = _run(["x"], out, {"x": x})
+    t = torch.from_numpy(x)
+    if ptype == "max":
+        ref = F.max_pool2d(t, 3, 2, 1, ceil_mode=ceil)
+    else:
+        # paddle exclusive=True == torch count_include_pad=False
+        ref = F.avg_pool2d(t, 3, 2, 1, ceil_mode=ceil,
+                           count_include_pad=not exclusive)
+    _cmp(got, ref.numpy())
+
+
+def test_batch_norm_train_vs_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 5, 6, 6).astype("float32")
+    xin = layers.data("x", shape=[5, 6, 6])
+    out = layers.batch_norm(xin)
+    got, = _run(["x"], out, {"x": x})
+    ref = F.batch_norm(torch.from_numpy(x), torch.zeros(5), torch.ones(5),
+                       torch.ones(5), torch.zeros(5), training=True)
+    _cmp(got, ref.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_layer_norm_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 12).astype("float32")
+    xin = layers.data("x", shape=[12])
+    out = layers.layer_norm(xin)
+    got, = _run(["x"], out, {"x": x})
+    ref = F.layer_norm(torch.from_numpy(x), (12,))
+    _cmp(got, ref.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_group_and_instance_norm_vs_torch():
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 8, 5, 5).astype("float32")
+    xin = layers.data("x", shape=[8, 5, 5])
+    g = layers.group_norm(xin, groups=4)
+    i = layers.instance_norm(xin)
+    got_g, got_i = _run(["x"], [g, i], {"x": x})
+    t = torch.from_numpy(x)
+    _cmp(got_g, F.group_norm(t, 4).numpy(), rtol=1e-3, atol=1e-4)
+    _cmp(got_i, F.instance_norm(t).numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_grid_sampler_vs_torch():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+    grid = (rng.rand(2, 5, 5, 2).astype("float32") * 2 - 1)
+    xin = layers.data("x", shape=[3, 6, 6])
+    gin = layers.data("g", shape=[5, 5, 2])
+    out = layers.grid_sampler(xin, gin)
+    got, = _run(["x", "g"], out, {"x": x, "g": grid})
+    ref = F.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                        mode="bilinear", padding_mode="border",
+                        align_corners=True)
+    _cmp(got, ref.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_interpolate_vs_torch():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    xin = layers.data("x", shape=[3, 5, 5])
+    up = layers.resize_bilinear(xin, out_shape=[10, 10])
+    nn_ = layers.resize_nearest(xin, out_shape=[10, 10])
+    got_b, got_n = _run(["x"], [up, nn_], {"x": x})
+    t = torch.from_numpy(x)
+    # jax.image.resize uses half-pixel centers == torch align_corners=False
+    _cmp(got_b, F.interpolate(t, (10, 10), mode="bilinear",
+                              align_corners=False).numpy(),
+         rtol=1e-3, atol=1e-3)
+    # nearest: jax rounds half-pixel centers like torch 'nearest-exact'
+    _cmp(got_n, F.interpolate(t, (10, 10),
+                              mode="nearest-exact").numpy(),
+         rtol=1e-5, atol=1e-6)
+
+
+def test_losses_vs_torch():
+    rng = np.random.RandomState(8)
+    x = rng.randn(6, 4).astype("float32")
+    y = rng.randn(6, 4).astype("float32")
+    xin = layers.data("x", shape=[4])
+    yin = layers.data("y", shape=[4])
+    huber = layers.huber_loss(xin, yin, delta=1.3)
+    kl = layers.kldiv_loss(xin, layers.softmax(yin), reduction="mean")
+    got_h, got_k = _run(["x", "y"], [huber, kl], {"x": x, "y": y})
+    tx, ty = torch.from_numpy(x), torch.from_numpy(y)
+    ref_h = F.huber_loss(tx, ty, delta=1.3, reduction="none")
+    _cmp(got_h, ref_h.numpy())
+    ref_k = F.kl_div(tx, F.softmax(ty, -1), reduction="mean")
+    _cmp(got_k, ref_k.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_activations_vs_torch():
+    rng = np.random.RandomState(9)
+    x = rng.randn(4, 7).astype("float32") * 2
+    xin = layers.data("x", shape=[7])
+    outs = [layers.gelu(xin), layers.selu(xin), layers.softplus(xin),
+            layers.elu(xin), layers.swish(xin), layers.tanh_shrink(xin),
+            layers.softsign(xin)]
+    got = _run(["x"], outs, {"x": x})
+    t = torch.from_numpy(x)
+    refs = [F.gelu(t, approximate="tanh"), F.selu(t), F.softplus(t),
+            F.elu(t), t * torch.sigmoid(t), t - torch.tanh(t),
+            F.softsign(t)]
+    for g, r in zip(got, refs):
+        _cmp(g, r.numpy(), rtol=1e-3, atol=1e-5)
+
+
+def test_embedding_padding_idx_vs_torch():
+    rng = np.random.RandomState(10)
+    w = rng.randn(11, 5).astype("float32")
+    ids = rng.randint(0, 11, (4, 6)).astype("int64")
+    ids[0, 0] = 3
+    xin = layers.data("ids", shape=[6], dtype="int64")
+    emb = layers.embedding(xin, size=[11, 5], padding_idx=3)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    import jax.numpy as jnp
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        pname = [p.name for p in
+                 pt.default_main_program().global_block().all_parameters()][0]
+        scope.set(pname, jnp.asarray(w))
+        got, = [np.asarray(o) for o in exe.run(feed={"ids": ids},
+                                               fetch_list=[emb])]
+    # Paddle semantics (lookup_table_op.h:83): padding_idx rows are
+    # ZEROED in the output (torch zeroes only the gradient), so zero the
+    # torch table row to build the oracle
+    wz = w.copy()
+    wz[3] = 0.0
+    ref = F.embedding(torch.from_numpy(ids), torch.from_numpy(wz))
+    _cmp(got, ref.numpy())
+
+
+def test_softmax_ce_grad_vs_torch():
+    """End-to-end: fc+softmax_ce GRADIENTS vs torch autograd."""
+    rng = np.random.RandomState(11)
+    x = rng.randn(5, 6).astype("float32")
+    w = rng.randn(6, 4).astype("float32")
+    y = rng.randint(0, 4, (5, 1)).astype("int64")
+
+    xin = layers.data("x", shape=[6])
+    lbl = layers.data("y", shape=[1], dtype="int64")
+    logits = layers.fc(xin, size=4, bias_attr=False,
+                       param_attr=pt.ParamAttr(name="w_ce"))
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, lbl))
+    pairs = pt.core.backward.append_backward(loss)
+    gvar = dict((p.name, g) for p, g in pairs)["w_ce"]
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    import jax.numpy as jnp
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        scope.set("w_ce", jnp.asarray(w))
+        lv, gw = [np.asarray(o) for o in exe.run(
+            feed={"x": x, "y": y}, fetch_list=[loss, gvar])]
+    tw = torch.from_numpy(w).requires_grad_(True)
+    tl = F.cross_entropy(torch.from_numpy(x) @ tw,
+                         torch.from_numpy(y).squeeze(1))
+    tl.backward()
+    _cmp(lv, tl.detach().numpy())
+    _cmp(gw, tw.grad.numpy())
+
+
+def test_avg_pool_ceil_extension_divisor_hand_computed():
+    """exclusive=False must divide by the constant kernel area even for
+    the ceil-EXTENDED last window (torch has no equivalent mode there;
+    oracle is the reference formula, operators/math/pooling.cc)."""
+    x = np.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+    xin = layers.data("x", shape=[1, 6, 6])
+    out = layers.pool2d(xin, pool_size=3, pool_type="avg", pool_stride=2,
+                        pool_padding=0, ceil_mode=True, exclusive=False)
+    got, = _run(["x"], out, {"x": x})
+    assert got.shape == (1, 1, 3, 3)
+    img = x[0, 0]
+    # last window starts at (4,4): only a 2x2 real patch, divisor stays 9
+    expect_corner = img[4:6, 4:6].sum() / 9.0
+    np.testing.assert_allclose(got[0, 0, 2, 2], expect_corner, rtol=1e-6)
+    # interior window fully real: plain mean
+    np.testing.assert_allclose(got[0, 0, 0, 0], img[0:3, 0:3].mean(),
+                               rtol=1e-6)
