@@ -9,4 +9,5 @@ from . import kernels_sequence
 from . import kernels_struct
 from . import kernels_vision
 from . import kernels_control
+from . import kernels_extra
 from .registry import KERNELS, get_kernel, has_kernel

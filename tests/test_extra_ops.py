@@ -1,0 +1,241 @@
+"""Tests for the long-tail reference ops (ops/kernels_extra.py) — the
+round-2 op-registry parity sweep. Kernels are exercised directly through
+the registry (these are op-level entries used by desc replay / fusion
+passes; most have no fluid.layers wrapper in the reference either)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu  # noqa: F401  (registers kernels)
+from paddle_tpu.ops.registry import KERNELS, KernelCtx
+
+
+def _run(op, ins, attrs=None):
+    ins = {k: [jnp.asarray(v)] for k, v in ins.items()}
+    out = KERNELS[op](KernelCtx(key=jax.random.PRNGKey(0)), ins, attrs or {})
+    return {k: np.asarray(v[0]) for k, v in out.items()}
+
+
+def test_minus_fill_l1():
+    x = np.array([[1.0, -2.0], [3.0, -4.0]], "float32")
+    y = np.ones((2, 2), "float32")
+    assert np.allclose(_run("minus", {"X": x, "Y": y})["Out"], x - 1)
+    f = _run("fill", {}, {"shape": [2, 2], "dtype": "float32",
+                          "value": [1.0, 2.0, 3.0, 4.0]})["Out"]
+    assert np.allclose(f, [[1, 2], [3, 4]])
+    assert np.allclose(_run("l1_norm", {"X": x})["Out"], 10.0)
+
+
+def test_squared_l2_distance_and_modified_huber():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3).astype("float32")
+    y = rng.randn(4, 3).astype("float32")
+    d = _run("squared_l2_distance", {"X": x, "Y": y})["Out"]
+    assert np.allclose(d[:, 0], ((x - y) ** 2).sum(1), rtol=1e-5)
+
+    xs = np.array([[-2.0], [-0.5], [0.5], [2.0]], "float32")
+    ys = np.ones((4, 1), "float32")        # z = x
+    loss = _run("modified_huber_loss", {"X": xs, "Y": ys})["Out"]
+    expect = [8.0, 2.25, 0.25, 0.0]        # -4z | (1-z)^2 | 0
+    assert np.allclose(loss[:, 0], expect)
+
+
+def test_conv_shift_matches_naive():
+    rng = np.random.RandomState(1)
+    B, N, M = 3, 7, 3
+    x = rng.randn(B, N).astype("float32")
+    y = rng.randn(B, M).astype("float32")
+    got = _run("conv_shift", {"X": x, "Y": y})["Out"]
+    expect = np.zeros((B, N), "float32")
+    for b in range(B):
+        for i in range(N):
+            for j in range(M):
+                expect[b, i] += x[b, (i + j - M // 2) % N] * y[b, j]
+    assert np.allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_max_pool_with_index_unpool_roundtrip():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    pooled = _run("max_pool2d_with_index", {"X": x},
+                  {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]})
+    vals, mask = pooled["Out"], pooled["Mask"]
+    assert vals.shape == (2, 3, 4, 4)
+    # every pooled value really is the max of its window
+    for b, c in [(0, 0), (1, 2)]:
+        for i in range(4):
+            for j in range(4):
+                win = x[b, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                assert vals[b, c, i, j] == win.max()
+    up = _run("unpool", {"X": vals, "Indices": mask},
+              {"ksize": [2, 2], "strides": [2, 2],
+               "unpool_size": [8, 8]})["Out"]
+    assert up.shape == x.shape
+    # unpooled plane contains each max at its original argmax position
+    for b, c in [(0, 1)]:
+        assert np.isclose(up[b, c].max(), x[b, c].max())
+        pos = np.unravel_index(np.argmax(x[b, c]), (8, 8))
+        assert np.isclose(up[b, c][pos], x[b, c].max())
+
+
+def test_spp_shapes_and_values():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 8, 8).astype("float32")
+    out = _run("spp", {"X": x}, {"pyramid_height": 3,
+                                 "pooling_type": "max"})["Out"]
+    # 4 channels * (1 + 4 + 16) bins
+    assert out.shape == (2, 4 * 21)
+    assert np.allclose(out[:, :4], x.max(axis=(2, 3)))
+
+
+def test_fc_fused():
+    rng = np.random.RandomState(4)
+    x = rng.randn(5, 6).astype("float32")
+    w = rng.randn(6, 3).astype("float32")
+    b = rng.randn(3).astype("float32")
+    out = _run("fc", {"Input": x, "W": w, "Bias": b},
+               {"activation_type": "relu"})["Out"]
+    assert np.allclose(out, np.maximum(x @ w + b, 0), rtol=1e-5, atol=1e-5)
+
+
+def test_attention_lstm_matches_manual_loop():
+    rng = np.random.RandomState(5)
+    B, L, M, D = 2, 5, 4, 3
+    x = rng.randn(B, L, M).astype("float32")
+    c0 = rng.randn(B, D).astype("float32")
+    h0 = rng.randn(B, D).astype("float32")
+    aw = rng.randn(M + D, 1).astype("float32")
+    lw = rng.randn(D + M, 4 * D).astype("float32")
+    lb = rng.randn(1, 4 * D).astype("float32")
+    seq_len = np.array([5, 3], "int64")
+    got = _run("attention_lstm",
+               {"X": x, "C0": c0, "H0": h0, "AttentionWeight": aw,
+                "LSTMWeight": lw, "LSTMBias": lb, "SeqLen": seq_len})
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for b in range(B):
+        h, c = h0[b], c0[b]
+        Lb = seq_len[b]
+        for t in range(Lb):
+            score = np.maximum(
+                np.concatenate(
+                    [x[b, :Lb], np.tile(c, (Lb, 1))], 1) @ aw[:, 0], 0)
+            w = np.exp(score - score.max())
+            w = w / w.sum()
+            lstm_x = w @ x[b, :Lb]
+            g = np.concatenate([h, lstm_x]) @ lw + lb[0]
+            f, i = sigmoid(g[:D]), sigmoid(g[D:2 * D])
+            o, cand = sigmoid(g[2 * D:3 * D]), np.tanh(g[3 * D:])
+            c = f * c + i * cand
+            h = o * np.tanh(c)
+            np.testing.assert_allclose(got["Hidden"][b, t], h,
+                                       rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(got["Cell"][b, t], c,
+                                       rtol=2e-4, atol=2e-5)
+        # masked tail is zeroed
+        assert np.all(got["Hidden"][b, Lb:] == 0)
+
+
+def test_positive_negative_pair_hand_case():
+    score = np.array([[0.9], [0.3], [0.5], [0.2]], "float32")
+    label = np.array([[1.0], [0.0], [1.0], [0.0]], "float32")
+    qid = np.array([[7], [7], [7], [7]], "int64")
+    out = _run("positive_negative_pair",
+               {"Score": score, "Label": label, "QueryID": qid})
+    # pairs with different labels: (0,1),(0,3),(2,1) wait — enumerate:
+    # (0,1): s 0.9>0.3, l 1>0 -> pos; (0,3): 0.9>0.2, 1>0 -> pos
+    # (1,2): 0.3<0.5, 0<1 -> pos; (2,3): 0.5>0.2, 1>0 -> pos
+    assert float(out["PositivePair"][0]) == 4.0
+    assert float(out["NegativePair"][0]) == 0.0
+    assert float(out["NeutralPair"][0]) == 0.0
+
+
+def test_ctc_align_hand_case():
+    ids = np.array([[1, 1, 0, 2, 2, 3]], "int64")
+    out = _run("ctc_align", {"Input": ids}, {"blank": 0,
+                                             "merge_repeated": True})
+    assert list(out["Output"][0][:3]) == [1, 2, 3]
+    assert int(out["OutputLength"][0, 0]) == 3
+
+
+def test_average_accumulates_rotation():
+    p = np.ones((2, 2), "float32")
+    state = {"param": p,
+             "in_sum_1": np.zeros((2, 2), "float32"),
+             "in_sum_2": np.zeros((2, 2), "float32"),
+             "in_sum_3": np.zeros((2, 2), "float32"),
+             "in_num_accumulates": np.array([0], "int64"),
+             "in_old_num_accumulates": np.array([0], "int64"),
+             "in_num_updates": np.array([0], "int64")}
+    attrs = {"average_window": 1.0, "max_average_window": 2,
+             "min_average_window": 1}
+    for step in range(3):
+        out = _run("average_accumulates", state, attrs)
+        state = {"param": p,
+                 "in_sum_1": out["out_sum_1"],
+                 "in_sum_2": out["out_sum_2"],
+                 "in_sum_3": out["out_sum_3"],
+                 "in_num_accumulates": out["out_num_accumulates"],
+                 "in_old_num_accumulates": out["out_old_num_accumulates"],
+                 "in_num_updates": out["out_num_updates"]}
+    # reference rotation (average_accumulates_op.h): each rotation moves
+    # sum_1+sum_2 into sum_3 and DISCARDS the previous sum_3 window, so
+    # after 3 steps with window 1-2 only the latest window remains and
+    # num_acc + old_num == params represented in sum_1+2+3
+    total = (state["in_sum_1"] + state["in_sum_2"] +
+             state["in_sum_3"]).sum()
+    represented = (int(state["in_num_accumulates"][0]) +
+                   int(state["in_old_num_accumulates"][0]))
+    assert np.isclose(total, represented * p.sum())
+    assert int(state["in_num_updates"][0]) == 3
+
+
+def test_depthwise_conv2d_transpose_shape():
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 3, 5, 5).astype("float32")
+    w = rng.randn(3, 1, 3, 3).astype("float32")
+    out = _run("depthwise_conv2d_transpose",
+               {"Input": x, "Filter": w},
+               {"strides": [2, 2], "paddings": [1, 1]})["Output"]
+    assert out.shape == (1, 3, 9, 9)
+    # each channel only sees its own filter: zeroing others changes nothing
+    w2 = w.copy()
+    w2[1:] = 0.0
+    out2 = _run("depthwise_conv2d_transpose",
+                {"Input": x, "Filter": w2},
+                {"strides": [2, 2], "paddings": [1, 1]})["Output"]
+    np.testing.assert_allclose(out[:, 0], out2[:, 0], rtol=1e-5)
+
+
+def test_lod_reset_passthrough():
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+    out = _run("lod_reset", {"X": x}, {"target_lod": [0, 1, 2]})
+    assert np.allclose(out["Out"], x)
+
+
+def test_nce_without_bias_and_sample_outputs():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 8).astype("float32")
+    label = rng.randint(0, 20, (4, 1)).astype("int64")
+    w = rng.randn(20, 8).astype("float32")
+    out = _run("nce", {"Input": x, "Label": label, "Weight": w},
+               {"num_total_classes": 20, "num_neg_samples": 5})
+    assert out["Cost"].shape == (4, 1)
+    assert out["SampleLogits"].shape == (4, 6)
+    assert out["SampleLabels"].shape == (4, 6)
+    # first candidate is the true label
+    assert np.array_equal(out["SampleLabels"][:, 0], label[:, 0])
+    assert np.all(out["Cost"] > 0)
+
+
+def test_positive_negative_pair_weighted():
+    score = np.array([[0.9], [0.3]], "float32")
+    label = np.array([[1.0], [0.0]], "float32")
+    qid = np.array([[1], [1]], "int64")
+    weight = np.array([[2.0], [4.0]], "float32")
+    out = _run("positive_negative_pair",
+               {"Score": score, "Label": label, "QueryID": qid,
+                "Weight": weight})
+    assert float(out["PositivePair"][0]) == 3.0   # mean(2, 4)
