@@ -1,12 +1,16 @@
 """Model compression (slim).
 
-Parity: python/paddle/fluid/contrib/slim — the reference ships a
-Compressor framework with graph wrappers and a magnitude Pruner
-(slim/prune/pruner.py). The TPU port keeps the two load-bearing pieces:
-- Pruner / MagnitudePruner: mask the smallest-|w| fraction of each
-  parameter (in scope, so the pruned program keeps training with XLA)
-- SensitivePruneStrategy-style helper: per-parameter ratios
+Parity: python/paddle/fluid/contrib/slim — Compressor/strategy pass
+framework (core.py: Context/Strategy/CompressPass/ConfigFactory),
+magnitude pruner (prune.py, ref slim/prune/pruner.py), and pruning
+strategies (prune_strategy.py) including a SensitivePruneStrategy that
+genuinely measures per-parameter sensitivity (the reference's is an
+argument holder, prune_strategy.py:24-36).
 """
 from .prune import Pruner, MagnitudePruner, prune_program
+from .core import Context, Strategy, CompressPass, ConfigFactory
+from .prune_strategy import PruneStrategy, SensitivePruneStrategy
 
-__all__ = ["Pruner", "MagnitudePruner", "prune_program"]
+__all__ = ["Pruner", "MagnitudePruner", "prune_program", "Context",
+           "Strategy", "CompressPass", "ConfigFactory", "PruneStrategy",
+           "SensitivePruneStrategy"]

@@ -1,0 +1,151 @@
+"""Model-compression pass framework.
+
+Parity: python/paddle/fluid/contrib/slim/core/{compress_pass,strategy,
+config}.py — the epoch/batch-hook driven CompressPass. The graph
+executor of the reference collapses into the ordinary whole-program
+Executor here; strategies mutate scope arrays directly (device-resident
+jnp values) instead of building side programs with assign ops.
+"""
+from ...core.executor import Executor
+from ...core.scope import global_scope
+from ...core.place import CPUPlace
+
+__all__ = ["Context", "Strategy", "CompressPass", "ConfigFactory"]
+
+
+class Context:
+    """Mutable state threaded through strategy hooks
+    (ref core/compress_pass.py:Context)."""
+
+    def __init__(self, exe, program, scope, fetches=None):
+        self.epoch = 0
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.exe = exe
+        self.program = program
+        self.graph = program          # reference-name alias
+        self.scope = scope
+        self.fetches = fetches or []
+        self.last_results = None
+
+
+class Strategy:
+    """Base strategy with epoch/batch hooks (ref core/strategy.py)."""
+
+    def __init__(self, start_epoch=0, end_epoch=10):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compress_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compress_end(self, context):
+        pass
+
+
+class CompressPass:
+    """Drive training while strategies compress the model
+    (ref core/compress_pass.py:CompressPass)."""
+
+    def __init__(self, place=None, data_reader=None, data_feeder=None,
+                 scope=None, metrics=None, epoch=0, program_exe=None):
+        self.strategies = []
+        self.place = place or CPUPlace()
+        self.data_reader = data_reader
+        self.data_feeder = data_feeder
+        self.scope = scope
+        self.metrics = dict(metrics) if metrics else {}
+        self.epoch = epoch or 0
+        self.program_exe = program_exe
+
+    def add_strategy(self, strategy):
+        self.strategies.append(strategy)
+        self.epoch = max(strategy.end_epoch, self.epoch)
+
+    def apply(self, program):
+        """Run `epoch` epochs of the program while strategies fire."""
+        from ...core.scope import scope_guard
+        exe = self.program_exe if self.program_exe is not None \
+            else Executor(self.place)
+        scope = self.scope if self.scope is not None else global_scope()
+        fetches = list(self.metrics.values())
+        ctx = Context(exe, program, scope, fetches)
+        ctx.epoch = self.epoch
+
+        with scope_guard(scope):
+            for s in self.strategies:
+                s.on_compress_begin(ctx)
+            for _ in range(self.epoch):
+                for s in self.strategies:
+                    s.on_epoch_begin(ctx)
+                for data in self.data_reader():
+                    for s in self.strategies:
+                        s.on_batch_begin(ctx)
+                    feed = self.data_feeder.feed(data) \
+                        if self.data_feeder else data
+                    ctx.last_results = exe.run(program, feed=feed,
+                                               fetch_list=fetches) \
+                        if fetches else exe.run(program, feed=feed)
+                    for s in self.strategies:
+                        s.on_batch_end(ctx)
+                    ctx.batch_id += 1
+                for s in self.strategies:
+                    s.on_epoch_end(ctx)
+                ctx.epoch_id += 1
+            for s in self.strategies:
+                s.on_compress_end(ctx)
+        return ctx
+
+
+class ConfigFactory:
+    """Build a CompressPass + strategies from a config dict (ref
+    core/config.py reads the same structure from yaml; pass the parsed
+    dict — or a yaml path if pyyaml is importable). Any registered class
+    (strategies AND pruners) can be referenced by section name."""
+
+    _STRATEGY_REGISTRY = {}
+
+    @classmethod
+    def register_strategy(cls, name, ctor):
+        """Register a constructible class for configs (strategies,
+        pruners, or any other component a config section names)."""
+        cls._STRATEGY_REGISTRY[name] = ctor
+
+    register_class = register_strategy   # clearer alias
+
+    def __init__(self, config):
+        if isinstance(config, str):
+            import yaml   # optional dependency, matching the reference
+            with open(config) as f:
+                config = yaml.safe_load(f)
+        self.config = config
+
+    def instance(self, name):
+        spec = dict(self.config[name])
+        kind = spec.pop("class")
+        if kind == "CompressPass":
+            compress = CompressPass(**{k: v for k, v in spec.items()
+                                       if k != "strategies"})
+            for sname in spec.get("strategies", []):
+                compress.add_strategy(self.instance(sname))
+            return compress
+        ctor = self._STRATEGY_REGISTRY.get(kind)
+        if ctor is None:
+            raise ValueError(f"unknown config class {kind!r}; register it "
+                             f"with ConfigFactory.register_class")
+        for key, val in list(spec.items()):
+            if isinstance(val, str) and val in self.config:
+                spec[key] = self.instance(val)
+        return ctor(**spec)

@@ -1,0 +1,156 @@
+"""Pruning strategies for the CompressPass.
+
+Parity: python/paddle/fluid/contrib/slim/prune/prune_strategy.py. The
+reference's PruneStrategy builds a side program of assign ops per
+batch; here masks are applied straight to the scope's device arrays.
+The reference's SensitivePruneStrategy is an empty parameter holder
+(prune_strategy.py:24-36 stores args and nothing else); this one
+actually measures per-parameter sensitivity (eval-loss increase at a
+probe ratio) and allocates per-parameter ratios toward a global target
+— lowest-sensitivity weights pruned hardest.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .core import Strategy
+from .core import ConfigFactory
+from .prune import MagnitudePruner, prune_program
+
+__all__ = ["PruneStrategy", "SensitivePruneStrategy"]
+
+
+def _prunable(program):
+    return [p for p in program.global_block().all_parameters()
+            if p.trainable and len(p.shape) >= 2]
+
+
+def _apply_mask(scope, name, mask):
+    w = np.asarray(scope.get(name))
+    scope.set(name, jnp.asarray(w * mask))
+
+
+class PruneStrategy(Strategy):
+    """Iteratively re-zero the smallest-|w| entries every
+    `mini_batch_pruning_frequency` batches (masks re-derived, so weights
+    regrown by the optimizer are culled again — ref PruneStrategy)."""
+
+    def __init__(self, pruner=None, ratio=0.5,
+                 mini_batch_pruning_frequency=1, start_epoch=0,
+                 end_epoch=10, params=None):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or MagnitudePruner()
+        self.ratio = ratio
+        self.freq = mini_batch_pruning_frequency
+        self.params = params
+
+    def _targets(self, context):
+        names = self.params or [p.name for p in _prunable(context.program)]
+        return names
+
+    def _trigger(self, context):
+        return (context.batch_id % self.freq == 0 and
+                self.start_epoch <= context.epoch_id < self.end_epoch)
+
+    def on_batch_end(self, context):
+        if not self._trigger(context):
+            return
+        prune_program(context.program,
+                      {n: self.ratio for n in self._targets(context)},
+                      scope=context.scope, pruner=self.pruner)
+
+    def sparsity(self, context):
+        """Achieved zero-fraction over the targeted params."""
+        zeros = total = 0
+        for name in self._targets(context):
+            w = np.asarray(context.scope.get(name))
+            zeros += int((w == 0).sum())
+            total += w.size
+        return zeros / max(total, 1)
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """Sensitivity-aware pruning: at `start_epoch`, probe each parameter
+    (prune at `delta_rate`, measure the |eval-loss delta| on one held
+    batch), then allocate per-param ratios — inverse to sensitivity,
+    renormalized so the ELEMENT-WEIGHTED sparsity hits `target_ratio`
+    (iterative rescale under the [0.05, 0.95] clip). Per-batch
+    re-masking then uses those per-param ratios."""
+
+    def __init__(self, pruner=None, target_ratio=0.5, delta_rate=0.2,
+                 eval_program=None, eval_fetch=None, eval_feed=None,
+                 mini_batch_pruning_frequency=1, start_epoch=0,
+                 end_epoch=10, params=None):
+        super().__init__(pruner, target_ratio,
+                         mini_batch_pruning_frequency, start_epoch,
+                         end_epoch, params)
+        self.target_ratio = target_ratio
+        self.delta_rate = delta_rate
+        self.eval_program = eval_program
+        self.eval_fetch = eval_fetch
+        self.eval_feed = eval_feed
+        self.ratios = None            # name -> ratio
+        self.sensitivities = None     # name -> loss increase
+
+    def _eval_loss(self, context):
+        # probe on a for_test clone: no backward/optimizer ops run, so
+        # the probe neither trains the model nor perturbs the baseline
+        if self.eval_program is None:
+            self.eval_program = context.program.clone(for_test=True)
+        fetch = [self.eval_fetch] if self.eval_fetch is not None \
+            else context.fetches[:1]
+        out = context.exe.run(self.eval_program, feed=self.eval_feed,
+                              fetch_list=fetch)
+        return float(np.asarray(out[0]))
+
+    def on_epoch_begin(self, context):
+        # probe at start_epoch (on the by-then trained weights), not at
+        # compress begin
+        if context.epoch_id != self.start_epoch or self.ratios is not None:
+            return
+        if self.eval_feed is None and self.eval_program is None:
+            raise ValueError(
+                "SensitivePruneStrategy needs eval_feed (one held batch) "
+                "— without it the sensitivity probe cannot run")
+        if self.eval_fetch is None and not context.fetches:
+            raise ValueError(
+                "SensitivePruneStrategy needs eval_fetch or CompressPass "
+                "metrics to know which loss to probe")
+        names = self._targets(context)
+        base = self._eval_loss(context)
+        sens = {}
+        for name in names:
+            w0 = np.asarray(context.scope.get(name))
+            _, mask = self.pruner.prune(w0, self.delta_rate)
+            _apply_mask(context.scope, name, mask)
+            # |delta|: at probe time pruning can move the loss either
+            # way; magnitude of the disturbance is the sensitivity
+            sens[name] = abs(self._eval_loss(context) - base)
+            context.scope.set(name, jnp.asarray(w0))      # restore
+        self.sensitivities = sens
+        # inverse-sensitivity allocation; iterate the scale so the
+        # element-weighted sparsity hits target_ratio despite clipping
+        sizes = np.array([np.asarray(context.scope.get(n)).size
+                          for n in names], dtype=np.float64)
+        inv = np.array([1.0 / (1e-6 + sens[n]) for n in names])
+        lam = self.target_ratio / max(
+            float((inv * sizes).sum() / sizes.sum()), 1e-9)
+        ratios = None
+        for _ in range(20):
+            ratios = np.clip(lam * inv, 0.05, 0.95)
+            achieved = float((ratios * sizes).sum() / sizes.sum())
+            if abs(achieved - self.target_ratio) < 1e-3:
+                break
+            lam *= self.target_ratio / max(achieved, 1e-9)
+        self.ratios = {n: float(r) for n, r in zip(names, ratios)}
+
+    def on_batch_end(self, context):
+        if not self._trigger(context) or self.ratios is None:
+            return
+        prune_program(context.program, self.ratios,
+                      scope=context.scope, pruner=self.pruner)
+
+
+ConfigFactory.register_class("PruneStrategy", PruneStrategy)
+ConfigFactory.register_class("SensitivePruneStrategy",
+                             SensitivePruneStrategy)
+ConfigFactory.register_class("MagnitudePruner", MagnitudePruner)
