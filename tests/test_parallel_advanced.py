@@ -307,3 +307,75 @@ def test_moe_capacity_drops_overflow_tokens():
     out, _ = moe_ffn(x, params, capacity_factor=0.25)  # C = 2 of 16
     norms = np.asarray(jnp.sum(jnp.abs(out), axis=-1))
     assert (norms > 0).sum() == 2, norms  # only C survivors
+
+
+def test_tp_transformer_through_framework_matches_dense():
+    """The FLAGSHIP model family through the framework's tp path: a tiny
+    transformer Program trained tp=2 x dp=4 via ParallelExecutor +
+    DistributeTranspiler matches single-device numerics, with the
+    attention/ffn projections genuinely tp-sharded (megatron_rules keys
+    on the {name}_q/_k/_v/_o and *_fc1/_fc2 naming the model emits)."""
+    from paddle_tpu.parallel.transpiler import (DistributeTranspiler,
+                                                DistributeTranspilerConfig)
+    from paddle_tpu.parallel.parallel_executor import ParallelExecutor
+    from paddle_tpu.models import transformer as tfm
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = 9
+        startup.random_seed = 9
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                cfg = tfm.TransformerConfig(
+                    src_vocab=32, trg_vocab=32, max_len=8, d_model=16,
+                    d_inner=32, n_head=2, n_layer=1, dropout=0.0)
+                _, avg_cost, _ = tfm.build_program(cfg, maxlen=8)
+                pt.optimizer.Adam(1e-2).minimize(avg_cost)
+        return main, startup, avg_cost
+
+    def feed(rng):
+        # batches advance through the shared RandomState — the same rng
+        # must be replayed for the reference and the tp run
+        B, T = 8, 8
+        src = rng.randint(3, 32, (B, T)).astype("int64")
+        trg = np.concatenate([np.zeros((B, 1), "int64"),
+                              (src[:, :-1] + 1) % 32], axis=1)
+        return {"src": src, "src_len": np.full(B, T, "int64"),
+                "trg": trg, "trg_len": np.full(B, T, "int64"),
+                "label": (src + 1) % 32}
+
+    # single-device reference
+    main, startup, loss = build()
+    snapshot = _snapshot_init(main, startup)
+    scope = pt.Scope()
+    for n, v in snapshot.items():
+        scope.set(n, jnp.asarray(v))
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    ref = []
+    with pt.scope_guard(scope):
+        for _ in range(3):
+            ref.append(float(exe.run(main, feed=feed(rng),
+                                     fetch_list=[loss])[0]))
+
+    # tp=2 x dp=4 through the framework
+    main2, _, loss2 = build()
+    cfg = DistributeTranspilerConfig()
+    cfg.tp, cfg.dp = 2, 4
+    t = DistributeTranspiler(cfg).transpile(program=main2)
+    pscope = pt.Scope()
+    for n, v in snapshot.items():
+        pscope.set(n, jnp.asarray(v))
+    pe = ParallelExecutor(main_program=main2, scope=pscope, transpiler=t)
+    rng = np.random.RandomState(0)
+    got = []
+    for _ in range(3):
+        got.append(float(pe.run(feed=feed(rng), fetch_list=[loss2])[0]))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    # projections are genuinely tp-sharded in the scope
+    from jax.sharding import PartitionSpec as P
+    qnames = [n for n in t.shardings() if "_q" in n and n.endswith(".w_0")]
+    assert qnames, list(t.shardings())[:8]
+    arr = pscope.get(qnames[0])
+    assert arr.sharding.spec == P(None, "tp"), (qnames[0], arr.sharding)
