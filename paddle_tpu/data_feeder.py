@@ -12,6 +12,25 @@ from .lod import to_padded
 __all__ = ["DataFeeder"]
 
 
+def _concat_feeds(dicts):
+    out = {}
+    for k in dicts[0]:
+        arrs = [np.asarray(d[k]) for d in dicts]
+        # ragged slots were padded per-minibatch; re-pad to the common
+        # max before concatenating along the batch axis
+        if len({a.shape[1:] for a in arrs}) > 1:
+            tgt = tuple(max(a.shape[i] for a in arrs)
+                        for i in range(1, arrs[0].ndim))
+            padded = []
+            for a in arrs:
+                pads = [(0, 0)] + [(0, t - s) for t, s in
+                                   zip(tgt, a.shape[1:])]
+                padded.append(np.pad(a, pads))
+            arrs = padded
+        out[k] = np.concatenate(arrs, axis=0)
+    return out
+
+
 class DataFeeder:
     def __init__(self, feed_list, place=None, program=None):
         self.feed_vars = feed_list
@@ -41,3 +60,19 @@ class DataFeeder:
                         arr = arr[..., None]
                 out[name] = arr
         return out
+
+    def feed_parallel(self, iterable, num_places=None):
+        """ref data_feeder.py:feed_parallel — one minibatch per device.
+
+        The reference places each minibatch on its own device; here the
+        ParallelExecutor shards the batch axis over the mesh, so the
+        per-device batches concatenate into one global batch (each
+        device ends up with exactly its own minibatch's rows)."""
+        batches = [self.feed(mb) for mb in iterable]
+        if not batches:
+            raise ValueError("feed_parallel got no minibatches")
+        if num_places is not None and len(batches) != num_places:
+            raise ValueError(
+                f"feed_parallel got {len(batches)} minibatches for "
+                f"{num_places} places")
+        return _concat_feeds(batches)

@@ -16,3 +16,4 @@ from . import word2vec
 from . import srl
 from . import recommender
 from . import seq2seq
+from . import resnet_with_preprocess

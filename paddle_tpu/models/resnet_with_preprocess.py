@@ -1,0 +1,62 @@
+"""ResNet with in-graph preprocessing.
+
+Parity: benchmark/fluid/models/resnet_with_preprocess.py — uint8 HWC
+input, in-graph random crop, cast, HWC->CHW transpose, /255, imagenet
+mean/std normalization, then the ResNet trunk. On TPU this keeps the
+augmentation inside the XLA program (overlapped with the step instead
+of a host-side python loop).
+"""
+import numpy as np
+
+from .. import layers
+from ..layers import tensor
+from . import resnet as resnet_mod
+
+__all__ = ["build_program"]
+
+
+def build_program(class_dim=1000, in_hw=(40, 40), crop_hw=(32, 32),
+                  depth=8, is_train=True, trunk=None):
+    """Returns (feed names, avg_cost, acc1, acc5). Input is uint8 HWC
+    [H, W, 3] (the raw-image layout the reference feeds).
+
+    trunk: "cifar" (6n+2 basic blocks) or "imagenet" (the _DEPTH_CFG
+    table); default picks by crop size but VALIDATES depth against the
+    chosen family instead of silently reinterpreting it. is_train=False
+    swaps the random crop for a deterministic center crop."""
+    h, w = in_hw
+    ch, cw = crop_hw
+    trunk = trunk or ("cifar" if ch <= 64 else "imagenet")
+    if trunk == "cifar" and (depth - 2) % 6 != 0:
+        raise ValueError(
+            f"cifar trunk needs depth = 6n+2 (got {depth}); pass "
+            f"trunk='imagenet' for the ResNet-18/34/50/101 table")
+    data = layers.data("data", shape=[h, w, 3], dtype="uint8")
+    label = layers.data("label", shape=[1], dtype="int64")
+
+    if is_train:
+        cropped = layers.random_crop(data, shape=[ch, cw, 3])
+    else:
+        # deterministic eval: center crop (reproducible metrics)
+        oy, ox = (h - ch) // 2, (w - cw) // 2
+        cropped = layers.slice(data, axes=[1, 2], starts=[oy, ox],
+                               ends=[oy + ch, ox + cw])
+    casted = layers.cast(cropped, "float32")
+    trans = layers.transpose(casted, [0, 3, 1, 2]) / 255.0
+    img_mean = tensor.assign(
+        np.array([0.485, 0.456, 0.406], "float32").reshape((3, 1, 1)))
+    img_std = tensor.assign(
+        np.array([0.229, 0.224, 0.225], "float32").reshape((3, 1, 1)))
+    normed = layers.elementwise_div(
+        layers.elementwise_sub(trans, img_mean, axis=1), img_std, axis=1)
+
+    predict = resnet_mod.resnet_cifar10(normed, class_dim=class_dim,
+                                        depth=depth) \
+        if trunk == "cifar" else resnet_mod.resnet(normed,
+                                                   class_dim=class_dim,
+                                                   depth=depth)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc1 = layers.accuracy(input=predict, label=label, k=1)
+    acc5 = layers.accuracy(input=predict, label=label, k=5)
+    return ["data", "label"], avg_cost, acc1, acc5

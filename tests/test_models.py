@@ -293,3 +293,33 @@ def test_rnn_encoder_decoder_vanilla_trains():
                         lambda i: _seq2seq_copy_shift_feed(rng, V, T),
                         steps=12, opt=pt.optimizer.Adam(5e-3))
     assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_resnet_with_preprocess_trains():
+    """benchmark/fluid/models/resnet_with_preprocess.py parity: uint8
+    HWC in-graph crop/normalize feeding the trunk; one train step."""
+    from paddle_tpu.models import resnet_with_preprocess as rwp
+    feeds, avg_cost, acc1, acc5 = rwp.build_program(
+        class_dim=10, in_hw=(24, 24), crop_hw=(16, 16), depth=8)
+    rng = np.random.RandomState(0)
+
+    def feed(i):
+        return {"data": rng.randint(0, 256, (4, 24, 24, 3)).astype("uint8"),
+                "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+
+    losses = _run_steps(feeds, avg_cost, feed, steps=2,
+                        opt=pt.optimizer.Momentum(0.01, 0.9))
+    assert np.isfinite(losses).all()
+
+
+def test_data_feeder_feed_parallel():
+    x = layers.data("x", shape=[3])
+    y = layers.data("y", shape=[1], dtype="int64")
+    feeder = pt.DataFeeder(place=pt.CPUPlace(), feed_list=[x, y])
+    mb1 = [(np.ones(3, "float32"), np.array([1])),
+           (np.zeros(3, "float32"), np.array([0]))]
+    mb2 = [(np.full(3, 2.0, "float32"), np.array([2]))] * 2
+    out = feeder.feed_parallel([mb1, mb2], num_places=2)
+    assert out["x"].shape == (4, 3)
+    assert out["x"][0, 0] == 1.0 and out["x"][2, 0] == 2.0
+    assert out["y"].shape == (4, 1)
