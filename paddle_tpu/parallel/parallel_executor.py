@@ -54,9 +54,13 @@ class ParallelExecutor:
     def device_count(self):
         return int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
 
-    def _feed_sharding(self, arr):
+    def _feed_sharding(self, arr, name=None):
         if arr.ndim == 0 or "dp" not in self.mesh.shape:
             return self._replicated
+        if self.transpiler is not None:
+            # single source of truth: the transpiler's policy (dp batch
+            # axis + sp time axis; see transpiler.feed_sharding)
+            return self.transpiler.feed_sharding(arr.shape, name=name)
         return NamedSharding(self.mesh, P("dp", *([None] * (arr.ndim - 1))))
 
     def _param_sharding(self, name):
@@ -83,7 +87,8 @@ class ParallelExecutor:
                 raise ValueError(
                     f"feed {k!r} batch {arr.shape[0]} not divisible by "
                     f"dp={dp}")
-            feed_arrays[k] = jax.device_put(arr, self._feed_sharding(arr))
+            feed_arrays[k] = jax.device_put(
+                arr, self._feed_sharding(arr, name=k))
 
         persist = {}
         persist_sh = {}
@@ -120,7 +125,8 @@ class ParallelExecutor:
                 wrapped,
                 in_shardings=(
                     persist_sh,
-                    {n: self._feed_sharding(feed_arrays[n]) for n in feed_arrays},
+                    {n: self._feed_sharding(feed_arrays[n], name=n)
+                     for n in feed_arrays},
                     self._replicated),
                 donate_argnums=(0,))
             self._cache[ckey] = fn

@@ -28,6 +28,7 @@ class DistributeTranspilerConfig:
         self.sp = 1
         self.pp = 1
         self.tp_rules = None            # ShardingRules for tensor parallel
+        self.sp_feed_axes = {}          # feed name -> sp axis (None: exempt)
         self.min_block_size = 8192      # parity knob (unused: XLA tiles)
 
 
@@ -106,7 +107,26 @@ class DistributeTranspiler:
             raise RuntimeError("call transpile() first")
         return dict(self._shardings)
 
-    def feed_sharding(self, ndim):
+    def feed_sharding(self, shape, name=None):
+        """THE feed-sharding policy (ParallelExecutor delegates here):
+        axis 0 over dp; with sp>1 configured, axis 1 over sp when
+        divisible — sequence feeds keep their time axis sharded so
+        activations stay T-sharded through elementwise/ffn ops (XLA
+        gathers where attention needs cross-shard keys; numerics are
+        layout-independent). Non-sequence feeds whose axis 1 happens to
+        divide sp only pay an extra gather — exempt them via
+        config.sp_feed_axes[name] = None."""
+        shape = tuple(shape)
+        ndim = len(shape)
         if ndim == 0:
             return NamedSharding(self.mesh, P())
-        return NamedSharding(self.mesh, P("dp", *([None] * (ndim - 1))))
+        axes = ["dp"] + [None] * (ndim - 1)
+        sp = self.mesh.shape.get("sp", 1)
+        override = getattr(self.config, "sp_feed_axes", {}) or {}
+        if name is not None and name in override:
+            ax = override[name]
+            if ax is not None:
+                axes[ax] = "sp"
+        elif sp > 1 and ndim >= 2 and shape[1] % sp == 0:
+            axes[1] = "sp"
+        return NamedSharding(self.mesh, P(*axes))
