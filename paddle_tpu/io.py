@@ -12,7 +12,7 @@ import numpy as np
 from .core.framework import Program, Parameter
 from .core.scope import global_scope
 
-__all__ = [
+__all__ = ["CheckpointSaver", "latest_checkpoint", 
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "save_checkpoint", "load_checkpoint",
@@ -145,6 +145,139 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
 
 
 def load_checkpoint(executor, dirname, main_program=None):
+    """Load a checkpoint dir — or, for a CheckpointSaver root holding
+    rotated checkpoint_N subdirs, the latest one."""
+    latest = latest_checkpoint(dirname)
+    if latest is not None:
+        dirname = latest
     load_persistables(executor, dirname, main_program)
     with open(os.path.join(dirname, META_FILE)) as f:
         return json.load(f)
+
+
+def _list_checkpoints(root):
+    """[(step, name)] for every checkpoint_N subdir, sorted by step —
+    the ONE parser shared by latest_checkpoint and CheckpointSaver."""
+    out = []
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            if name.startswith("checkpoint_"):
+                suffix = name[len("checkpoint_"):]
+                if suffix.isdigit():
+                    out.append((int(suffix), name))
+    return sorted(out)
+
+
+def latest_checkpoint(root):
+    """Newest checkpoint_N subdir of a CheckpointSaver root, or None if
+    `root` is itself a flat checkpoint dir."""
+    if os.path.exists(os.path.join(root, META_FILE)):
+        return None
+    steps = _list_checkpoints(root)
+    if not steps:
+        return None
+    return os.path.join(root, steps[-1][1])
+
+
+class CheckpointSaver:
+    """Async, atomic, rotating checkpoints (orbax-style semantics).
+
+    save() snapshots the persistables to HOST memory on the calling
+    thread (a device->host DMA — the training loop can immediately keep
+    mutating/donating device buffers), then serializes + fsyncs + renames
+    on a background thread so checkpoint IO overlaps the next steps.
+    Writes go to a hidden tmp dir and are os.replace()d into
+    `root/checkpoint_<step>` — a crash mid-write never corrupts a
+    visible checkpoint. Keeps the newest `max_to_keep`.
+
+    The reference era blocks training for the whole save
+    (io.py:save_persistables); this removes the serialization from the
+    step critical path.
+    """
+
+    def __init__(self, root, max_to_keep=3, async_save=True):
+        self.root = root
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self._thread = None
+        self._error = None
+        os.makedirs(root, exist_ok=True)
+        self._clean_orphans()
+
+    def _clean_orphans(self):
+        """Remove .tmp_checkpoint_* left by a crashed writer."""
+        import shutil
+        for name in os.listdir(self.root):
+            if name.startswith(".tmp_checkpoint_"):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    def save(self, executor, main_program=None, step=0, extra=None):
+        from .core.framework import default_main_program
+        program = main_program or default_main_program()
+        scope = global_scope()
+        # device -> host snapshot NOW, with an explicit COPY: np.asarray
+        # can alias a CPU jax.Array (or a numpy value already in scope),
+        # and the executor donates the persist dict — an aliased buffer
+        # would be rewritten by the next step while the writer runs
+        arrays = {v.name: np.array(scope.get(v.name), copy=True)
+                  for v in program.persistable_vars()
+                  if scope.get(v.name) is not None}
+        meta = {"step": int(step), "vars": sorted(arrays),
+                "extra": extra or {}}
+        self.wait()                      # one in-flight save at a time
+        if self.async_save:
+            import threading
+            self._thread = threading.Thread(
+                target=self._write, args=(arrays, meta, step), daemon=True)
+            self._thread.start()
+        else:
+            self._write(arrays, meta, step)
+            if self._error is not None:   # sync mode: fail loudly NOW
+                err, self._error = self._error, None
+                raise RuntimeError(f"checkpoint write failed: {err}")
+        return meta
+
+    def _write(self, arrays, meta, step):
+        try:
+            tmp = os.path.join(self.root, f".tmp_checkpoint_{step}")
+            final = os.path.join(self.root, f"checkpoint_{step}")
+            if os.path.isdir(tmp):
+                import shutil
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            params_path = os.path.join(tmp, PARAMS_FILE)
+            np.savez(params_path, **arrays)
+            with open(params_path, "rb+") as f:     # npz data durable
+                os.fsync(f.fileno())
+            with open(os.path.join(tmp, META_FILE), "w") as f:
+                json.dump(meta, f)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.isdir(final):
+                import shutil
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+            # make the rename itself durable before pruning older
+            # checkpoints — a crash here must leave SOME valid checkpoint
+            dfd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+            self._prune()
+        except Exception as e:            # surfaced on next wait()/save()
+            self._error = e
+
+    def _prune(self):
+        import shutil
+        for _, name in _list_checkpoints(self.root)[:-self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.root, name), ignore_errors=True)
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(f"async checkpoint write failed: {err}")

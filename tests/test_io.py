@@ -146,3 +146,47 @@ def test_native_prefetch_queue():
     t.join()
     L.ptpu_queue_destroy(q)
     assert got == items
+
+
+def test_async_checkpoint_saver_rotation_and_snapshot(tmp_path):
+    """CheckpointSaver: save() snapshots at CALL time (later training
+    doesn't leak into the checkpoint), writes are atomic + rotated to
+    max_to_keep, and load_checkpoint picks the latest."""
+    import jax.numpy as jnp
+    from paddle_tpu.io import CheckpointSaver, latest_checkpoint
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name="ck.w"))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype("float32"),
+            "y": rng.randn(8, 1).astype("float32")}
+
+    saver = CheckpointSaver(str(tmp_path), max_to_keep=2)
+    snapshots = {}
+    for step in range(4):
+        exe.run(feed=feed, fetch_list=[loss])
+        saver.save(exe, step=step, extra={"note": f"s{step}"})
+        snapshots[step] = np.asarray(scope.get("ck.w")).copy()
+    saver.wait()
+
+    kept = sorted(p.name for p in tmp_path.iterdir()
+                  if p.name.startswith("checkpoint_"))
+    assert kept == ["checkpoint_2", "checkpoint_3"], kept
+    assert latest_checkpoint(str(tmp_path)).endswith("checkpoint_3")
+
+    # clobber the param, then restore the latest checkpoint
+    scope.set("ck.w", jnp.zeros_like(scope.get("ck.w")))
+    meta = pt.io.load_checkpoint(exe, str(tmp_path))
+    assert meta["step"] == 3 and meta["extra"]["note"] == "s3"
+    np.testing.assert_allclose(np.asarray(scope.get("ck.w")),
+                               snapshots[3], rtol=1e-6)
+    # the kept step-2 checkpoint holds the step-2 snapshot, not later state
+    meta2 = pt.io.load_checkpoint(exe, str(tmp_path / "checkpoint_2"))
+    assert meta2["step"] == 2
+    np.testing.assert_allclose(np.asarray(scope.get("ck.w")),
+                               snapshots[2], rtol=1e-6)
