@@ -3,7 +3,7 @@
 Parity: python/paddle/fluid/io.py — save_vars/save_params/
 save_persistables, save_inference_model/load_inference_model, plus
 incremental train checkpoints (program desc as JSON + params as .npz;
-layout is orbax-style dir with a manifest).
+layout is a directory with an npz payload + JSON manifest).
 """
 import json
 import os
