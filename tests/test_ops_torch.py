@@ -267,3 +267,75 @@ def test_avg_pool_ceil_extension_divisor_hand_computed():
     # interior window fully real: plain mean
     np.testing.assert_allclose(got[0, 0, 0, 0], img[0:3, 0:3].mean(),
                                rtol=1e-6)
+
+
+def test_dynamic_lstm_vs_torch_lstm():
+    """Full recurrent numerics: our scan LSTM with torch's weights must
+    reproduce torch.nn.LSTM (same [i,f,g,o] gate packing; our single
+    bias = b_ih + b_hh)."""
+    rng = np.random.RandomState(20)
+    B, T, D, H = 3, 6, 5, 4
+    x = rng.randn(B, T, D).astype("float32")
+    tl = torch.nn.LSTM(D, H, num_layers=1, batch_first=True)
+    with torch.no_grad():
+        ref, _ = tl(torch.from_numpy(x))
+
+    xin = layers.data("x", shape=[T, D])
+    h = layers.dynamic_lstm(xin, size=4 * H)[0]
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    import jax.numpy as jnp
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        names = [p.name for p in
+                 pt.default_main_program().global_block().all_parameters()]
+        w_ih_n, w_hh_n, b_n = names            # creation order
+        scope.set(w_ih_n, jnp.asarray(
+            tl.weight_ih_l0.detach().numpy().T))
+        scope.set(w_hh_n, jnp.asarray(
+            tl.weight_hh_l0.detach().numpy().T))
+        scope.set(b_n, jnp.asarray(
+            (tl.bias_ih_l0 + tl.bias_hh_l0).detach().numpy()))
+        got, = [np.asarray(o) for o in exe.run(feed={"x": x},
+                                               fetch_list=[h])]
+    _cmp(got, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_gru_vs_torch_gru():
+    """Our GRU (update,reset,candidate packing; candidate bias on the
+    input side only) must reproduce torch.nn.GRU when torch's hidden
+    bias is zeroed (torch applies b_hn inside the reset product; with
+    b_hh = 0 the formulas coincide). torch packs (r,z,n); ours (u,r,c)
+    with u == z, c == n."""
+    rng = np.random.RandomState(21)
+    B, T, D, H = 3, 5, 4, 6
+    x = rng.randn(B, T, D).astype("float32")
+    tg = torch.nn.GRU(D, H, num_layers=1, batch_first=True)
+    with torch.no_grad():
+        tg.bias_hh_l0.zero_()
+        ref, _ = tg(torch.from_numpy(x))
+
+    def reorder(w):
+        # torch rows [r; z; n] -> ours columns [u(z), r, c(n)]
+        r, z, n = np.split(w, 3, axis=0)
+        return np.concatenate([z, r, n], axis=0).T
+
+    xin = layers.data("x", shape=[T, D])
+    h = layers.dynamic_gru(xin, size=H)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    import jax.numpy as jnp
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        names = [p.name for p in
+                 pt.default_main_program().global_block().all_parameters()]
+        w_ih_n, w_hh_n, b_n = names
+        scope.set(w_ih_n, jnp.asarray(
+            reorder(tg.weight_ih_l0.detach().numpy())))
+        scope.set(w_hh_n, jnp.asarray(
+            reorder(tg.weight_hh_l0.detach().numpy())))
+        br, bz, bn_ = np.split(tg.bias_ih_l0.detach().numpy(), 3)
+        scope.set(b_n, jnp.asarray(np.concatenate([bz, br, bn_])))
+        got, = [np.asarray(o) for o in exe.run(feed={"x": x},
+                                               fetch_list=[h])]
+    _cmp(got, ref.numpy(), rtol=1e-4, atol=1e-5)
