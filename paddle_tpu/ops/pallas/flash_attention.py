@@ -26,7 +26,8 @@ Supported extras (covers the flagship transformer end-to-end):
 - `causal`: in-kernel triangular masking + whole-block skipping above
   the diagonal.
 
-Block sizes default to 512x1024 (tuned on v5e; 2.1x over 128x128).
+Block sizes default to 1024x2048 (tuned on v5e; clamped to a VMEM
+budget per head dim — see _choose_blocks).
 
 When to use which path: XLA's fused attention is faster below ~4k
 sequence length (the [T,S] tile still fits the fusion's working set);
@@ -73,10 +74,13 @@ _MODE = "auto"
 # vectors are not a legal VMEM tile).
 _LANES = 128
 
-# Tuned on v5e (block sweep at T=8192): shared by supports() and
+# Tuned on v5e (block sweeps at T=8192 and T=32768: 1024x2048 is ~12%
+# faster than 512x1024 at 32k and ties at 8k; 2048x2048 fails to compile
+# — the fp32 scores tile exceeds VMEM): shared by supports() and
 # flash_attention() so the dispatch guard and the call can't drift.
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 1024
+# _prep clamps the pair to a VMEM budget for larger head dims.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 2048
 
 
 def set_mode(mode):
@@ -99,18 +103,50 @@ def active():
 
 
 def _pick_block(n, pref):
-    """Largest 128-multiple block <= pref that divides n (halving), or n
-    itself when one block covers the whole axis (block == array dim is
-    always a legal Mosaic tile). Returns 0 when no legal block exists —
-    lane dims that are neither 128-multiples nor the full axis violate
-    the Mosaic tiling rule on hardware (interpret mode wouldn't catch
-    it), so such shapes must take the fallback path."""
+    """Largest 128-MULTIPLE block <= pref that divides n, or n itself
+    when one block covers the whole axis (block == array dim is always a
+    legal Mosaic tile). Returns 0 when no legal block exists — lane dims
+    that are neither 128-multiples nor the full axis violate the Mosaic
+    tiling rule on hardware (interpret mode wouldn't catch it), so such
+    shapes must take the fallback path. Scans multiples downward (a
+    naive halving loop can land on divisors like 960 that are not
+    128-multiples)."""
     if n <= 128:
         return n
-    b = min(pref, n)
-    while b >= 128 and n % b:
-        b //= 2
-    return b if b >= 128 and n % b == 0 else 0
+    if pref >= n:
+        return n
+    for b in range(pref // 128 * 128, 0, -128):
+        if n % b == 0:
+            return b
+    return 0
+
+
+def _choose_blocks(T, S, D, DV, pref_q=None, pref_k=None):
+    """The ONE block-selection policy (supports() and _prep share it):
+    pick legal tiles, then shrink — re-legalizing through _pick_block at
+    every step — until the fp32 scores tile fits the VMEM budget
+    (measured on v5e: 2M elements compiles at head dim <= 64, 4M does
+    not; halved budget for wider heads). Returns (0, 0) if no legal
+    in-budget pair exists."""
+    bq = _pick_block(T, pref_q or DEFAULT_BLOCK_Q)
+    bk = _pick_block(S, pref_k or DEFAULT_BLOCK_K)
+    if not bq or not bk:
+        return 0, 0
+    budget = 2 * 1024 * 1024 if max(D, DV) <= 64 else 1024 * 1024
+    while bq * bk > budget:
+        if bq >= bk and bq > 128:
+            nb = _pick_block(T, bq // 2)
+            if not nb:
+                return 0, 0
+            bq = nb
+        elif bk > 128:
+            nb = _pick_block(S, bk // 2)
+            if not nb:
+                return 0, 0
+            bk = nb
+        else:
+            break
+    return bq, bk
 
 
 def _causal_active(q_idx, k_idx, block_q, block_k, offset):
@@ -455,7 +491,7 @@ def supports(q, k, v, bias=None, block_q=DEFAULT_BLOCK_Q,
         return False
     B, H, T, D = q.shape
     S = k.shape[2]
-    bq, bk = _pick_block(T, block_q), _pick_block(S, block_k)
+    bq, bk = _choose_blocks(T, S, D, v.shape[-1], block_q, block_k)
     if not bq or not bk or T < 8 or S < 8:
         return False
     if bias is not None:
@@ -473,8 +509,8 @@ def _prep(q, k, v, bias, scale, block_q, block_k):
     B, H, T, D = q.shape
     S = k.shape[2]
     scale = float(scale) if scale is not None else D ** -0.5
-    block_q = _pick_block(T, block_q)
-    block_k = _pick_block(S, block_k)
+    block_q, block_k = _choose_blocks(T, S, D, v.shape[-1],
+                                      block_q, block_k)
     if not block_q or not block_k:
         raise NotImplementedError("seq len must tile")
     qr = q.reshape(B * H, T, D)

@@ -179,8 +179,14 @@ def test_flash_supports_non_default_block_multiples():
     ref = fa.flash_attention_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
-    assert fa._pick_block(16512, 1024) == 128
-    assert fa._pick_block(768, 512) == 256
+    assert fa._pick_block(16512, 1024) == 384   # 43 x 384
+    # the downward 128-multiple scan finds 384 (the halving loop it
+    # replaced could only reach 256 — or illegal non-multiples like 960)
+    assert fa._pick_block(768, 512) == 384
+    assert fa._pick_block(1920, 960) == 640
+    # VMEM clamp keeps wide-head long-seq shapes legal AND in budget
+    bq, bk = fa._choose_blocks(4096, 1920, 128, 128)
+    assert bq * bk <= 1024 * 1024 and 4096 % bq == 0 and 1920 % bk == 0
     # lane dims that are neither 128-multiples nor the full axis are not
     # legal Mosaic tiles — supports() must refuse them (hardware-only
     # failure; interpret mode can't catch it)
