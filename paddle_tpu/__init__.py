@@ -78,6 +78,11 @@ from . import distribute_lookup_table
 from . import net_drawer
 from . import op
 from .core import EOFException
+from . import annotations
+from . import compat
+from . import graphviz
+from . import inferencer
+from .batch import batch
 
 # Tensor/LoDTensor aliases (ref fluid.Tensor is LoDTensor without LoD)
 Tensor = LoDTensor

@@ -1,0 +1,28 @@
+"""API deprecation annotations (ref python/paddle/fluid/annotations.py).
+
+One decorator, `deprecated(since, instead)`, printed once per call site
+in the reference; here it warns once per function (warnings module, so
+filters/`-W error` behave normally) and still forwards the call.
+"""
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(since, instead, extra_message=""):
+    def decorator(func):
+        msg = (f"API {func.__name__} is deprecated since {since}. "
+               f"Please use {instead} instead.")
+        if extra_message:
+            msg += "\n" + extra_message
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (wrapper.__doc__ or "") + "\n    " + msg
+        return wrapper
+
+    return decorator

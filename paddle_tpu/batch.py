@@ -1,0 +1,24 @@
+"""Top-level `batch` reader decorator (ref python/paddle/batch.py).
+
+The reference exposes `paddle.batch` at the package top level in
+addition to the reader-decorator module; user training loops call it
+directly (`train_reader = paddle.batch(dataset.mnist.train(), 128)`).
+
+Note the deliberate TPU deviation documented in reader/__init__.py:
+`drop_last` defaults to True there because a ragged final batch changes
+the feed shape and forces an XLA recompile. This top-level shim keeps
+the REFERENCE default (False) for drop-in compatibility — callers who
+keep the default get the reference's behavior, and the executor's
+compile cache simply holds one extra entry for the tail batch.
+"""
+from .reader import batch as _batch
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+    return _batch(reader, batch_size, drop_last=drop_last)

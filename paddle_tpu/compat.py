@@ -1,0 +1,94 @@
+"""String/number compat helpers (ref python/paddle/compat.py).
+
+The reference carries py2/py3 bridging utilities that user code and the
+fluid tooling call (`to_text`/`to_bytes` on names read from serialized
+program descs, banker's `round`, true `floor_division`). Python 2 is
+gone, so the semantics here are the py3 branch of each reference
+function, kept because the *API* is what downstream code imports.
+"""
+import math
+
+__all__ = [
+    "long_type", "int_type",
+    "to_text", "to_bytes", "round", "floor_division",
+    "get_exception_message",
+]
+
+int_type = int
+long_type = int
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Decode bytes (or containers of bytes) to str. Lists/sets are
+    converted element-wise; `inplace` mutates the container."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_text(x, encoding) for x in obj]
+            return obj
+        return [_to_text(x, encoding) for x in obj]
+    if isinstance(obj, set):
+        new = {_to_text(x, encoding) for x in obj}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    return _to_text(obj, encoding)
+
+
+def _to_text(obj, encoding):
+    if obj is None or isinstance(obj, str):
+        return obj
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return str(obj)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Encode str (or containers of str) to bytes — inverse of to_text."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_bytes(x, encoding) for x in obj]
+            return obj
+        return [_to_bytes(x, encoding) for x in obj]
+    if isinstance(obj, set):
+        new = {_to_bytes(x, encoding) for x in obj}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    return _to_bytes(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if obj is None or isinstance(obj, bytes):
+        return obj
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return bytes(obj)
+
+
+def round(x, d=0):
+    """Half-away-from-zero rounding (the py2 semantics the reference
+    preserves, vs py3's banker's rounding)."""
+    if x is None:
+        return None
+    p = 10 ** d
+    if x >= 0:
+        return float(math.floor((x * p) + 0.5)) / p
+    return float(math.ceil((x * p) - 0.5)) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    """Message text of an exception instance."""
+    assert exc is not None
+    return str(exc)

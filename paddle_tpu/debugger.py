@@ -29,29 +29,23 @@ def pprint_program(program, show_vars=False):
 
 def draw_block_graphviz(block, highlights=None, path="./graph.dot"):
     """Emit a graphviz dot file of the op/var graph (ref debugger.py)."""
+    from .graphviz import Graph
     highlights = set(highlights or ())
-    lines = ["digraph G {", "  rankdir=TB;"]
-    seen_vars = set()
-    for i, op in enumerate(block.ops):
-        op_id = f"op_{i}"
-        lines.append(
-            f'  {op_id} [label="{op.type}", shape=box, style=filled, '
-            f'fillcolor={"yellow" if op.type in highlights else "lightgray"}];')
+    g = Graph("G", rankdir="TB")
+
+    def var_node(name):
+        return g.add_unique_node(name, prefix="var", shape="ellipse")
+
+    for op in block.ops:
+        op_node = g.add_node(
+            op.type, prefix="op", shape="box", style="filled",
+            fillcolor="yellow" if op.type in highlights else "lightgray")
         for name in op.input_names():
-            vid = f'var_{abs(hash(name))}'
-            if name not in seen_vars:
-                seen_vars.add(name)
-                lines.append(f'  {vid} [label="{name}", shape=ellipse];')
-            lines.append(f"  {vid} -> {op_id};")
+            g.add_edge(var_node(name), op_node)
         for name in op.output_names():
-            vid = f'var_{abs(hash(name))}'
-            if name not in seen_vars:
-                seen_vars.add(name)
-                lines.append(f'  {vid} [label="{name}", shape=ellipse];')
-            lines.append(f"  {op_id} -> {vid};")
-    lines.append("}")
+            g.add_edge(op_node, var_node(name))
     with open(path, "w") as f:
-        f.write("\n".join(lines))
+        f.write(g.code())
     return path
 
 

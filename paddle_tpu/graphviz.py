@@ -1,0 +1,133 @@
+"""Minimal DOT graph builder (ref python/paddle/fluid/graphviz.py).
+
+The reference's debugger/net_drawer render program graphs through a
+small graphviz wrapper; this is the paddle_tpu equivalent. It only
+*writes* DOT text — rendering to png needs the `dot` binary, which is
+gated (no installs in this image), so `Graph.show` falls back to saving
+the .dot file when graphviz isn't present.
+"""
+import os
+import shutil
+import subprocess
+
+__all__ = ["Graph", "Node", "Edge", "GraphPreviewGenerator"]
+
+
+def _quote(s):
+    return '"%s"' % s.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def crepr(v):
+    return _quote(v) if isinstance(v, str) else str(v)
+
+
+def _attr_str(attrs):
+    if not attrs:
+        return ""
+    inner = ", ".join(f"{k}={crepr(v)}" for k, v in sorted(attrs.items()))
+    return f" [{inner}]"
+
+
+class Node:
+    def __init__(self, label, name, **attrs):
+        self.name = name
+        self.attrs = dict(attrs, label=label)
+
+    def __str__(self):
+        return f"{self.name}{_attr_str(self.attrs)}"
+
+
+class Edge:
+    def __init__(self, source, target, **attrs):
+        self.source = source
+        self.target = target
+        self.attrs = dict(attrs)
+
+    def __str__(self):
+        return f"{self.source.name} -> {self.target.name}{_attr_str(self.attrs)}"
+
+
+class Graph:
+    def __init__(self, title, **attrs):
+        self.title = title
+        self.attrs = dict(attrs)
+        self.nodes = []
+        self.edges = []
+        self.rank_groups = {}
+        self._unique = {}
+
+    def add_node(self, label, prefix="node", **attrs):
+        # ids are per-graph sequential, so the same input graph always
+        # produces identical DOT (golden-file friendly)
+        node = Node(label, f"{prefix}_{len(self.nodes)}", **attrs)
+        self.nodes.append(node)
+        return node
+
+    def add_unique_node(self, key, label=None, prefix="node", **attrs):
+        """Memoized add_node: one node per `key` per graph."""
+        if key not in self._unique:
+            self._unique[key] = self.add_node(
+                key if label is None else label, prefix=prefix, **attrs)
+        return self._unique[key]
+
+    def add_edge(self, source, target, **attrs):
+        edge = Edge(source, target, **attrs)
+        self.edges.append(edge)
+        return edge
+
+    def rank_group(self, kind, nodes):
+        """Constrain `nodes` to one rank ('same', 'min', 'max')."""
+        self.rank_groups.setdefault(kind, []).append(nodes)
+
+    def code(self):
+        lines = [f"digraph {_quote(self.title)} {{"]
+        for k, v in sorted(self.attrs.items()):
+            lines.append(f"  {k}={crepr(v)};")
+        lines += [f"  {n};" for n in self.nodes]
+        lines += [f"  {e};" for e in self.edges]
+        for kind, groups in self.rank_groups.items():
+            for nodes in groups:
+                names = "; ".join(n.name for n in nodes)
+                lines.append(f"  {{rank={kind}; {names}}}")
+        lines.append("}")
+        return "\n".join(lines)
+
+    __str__ = code
+
+    def compile(self, dot_path):
+        """Write DOT; if the `dot` binary exists, also render a png next
+        to it. Returns the path actually produced."""
+        with open(dot_path, "w") as f:
+            f.write(self.code())
+        if shutil.which("dot"):
+            out = os.path.splitext(dot_path)[0] + ".png"
+            subprocess.run(["dot", "-Tpng", dot_path, "-o", out], check=True)
+            return out
+        return dot_path
+
+    # reference API name; no display in a headless container
+    show = compile
+
+
+class GraphPreviewGenerator:
+    """Higher-level preview: ops as rectangles, tensors as ellipses,
+    params highlighted (ref GraphPreviewGenerator)."""
+
+    def __init__(self, title):
+        self.graph = Graph(title, rankdir="TB")
+
+    def add_op(self, label):
+        return self.graph.add_node(label, prefix="op", shape="rect",
+                                   style="filled", fillcolor="#8eba42")
+
+    def add_arg(self, label, is_param=False):
+        return self.graph.add_node(
+            label, prefix="arg", shape="ellipse",
+            style="filled" if is_param else "solid",
+            fillcolor="#ffed6f" if is_param else "white")
+
+    def add_edge(self, source, target, **attrs):
+        return self.graph.add_edge(source, target, **attrs)
+
+    def __call__(self, path="temp.dot", show=False):
+        return self.graph.compile(path)

@@ -1,0 +1,9 @@
+"""Inferencer location shim (ref python/paddle/fluid/inferencer.py).
+
+The reference moved `Inferencer` into contrib and left this module as a
+pointer; here the implementation lives in `trainer.py` (high-level API
+pair) and this module re-exports it for import-path compatibility.
+"""
+from .trainer import Inferencer
+
+__all__ = ["Inferencer"]

@@ -7,9 +7,7 @@ reference generates them from the C++ OpProto; here the kernel registry
 is the source of truth and the generated layer uses the common
 X→Out slot convention).
 """
-import functools
 import re
-import warnings
 
 from ..layer_helper import LayerHelper
 from ..ops.registry import has_kernel
@@ -37,15 +35,10 @@ def templatedoc(op_type=None):
 
 
 def deprecated(since="", instead="", extra_message=""):
-    def deco(func):
-        @functools.wraps(func)
-        def wrapper(*args, **kwargs):
-            warnings.warn(
-                f"{func.__name__} is deprecated since {since}, use "
-                f"{instead} instead. {extra_message}", DeprecationWarning)
-            return func(*args, **kwargs)
-        return wrapper
-    return deco
+    # single implementation lives in annotations.py (the reference's home
+    # for it); this name is kept because layers code imports it from here
+    from ..annotations import deprecated as _deprecated
+    return _deprecated(since, instead, extra_message)
 
 
 def generate_layer_fn(op_type):

@@ -5,7 +5,6 @@ op graph (the reference shells out to graphviz; here the DOT text is
 generated directly and optionally written to a file, rendering is up to
 the user's toolchain).
 """
-import json
 
 __all__ = ["draw_graph", "parse_graph"]
 
@@ -28,19 +27,15 @@ def parse_graph(program, graph=None, var_dict=None, **kwargs):
 def draw_graph(startup_program, main_program, output_path=None, **kwargs):
     """Render the main program to DOT text; write to output_path if given
     (ref draw_graph writes graph.dot + png via graphviz binary)."""
+    from .graphviz import Graph
     nodes, edges = parse_graph(main_program)
-    seen = set()
-    lines = ["digraph G {"]
+    g = Graph("G")
     for nid, label, kind in nodes:
-        if nid in seen:
-            continue
-        seen.add(nid)
-        shape = "box" if kind == "op" else "ellipse"
-        lines.append(f'  "{nid}" [label={json.dumps(label)}, shape={shape}];')
+        g.add_unique_node(nid, label=label, prefix=kind,
+                          shape="box" if kind == "op" else "ellipse")
     for a, b in edges:
-        lines.append(f'  "{a}" -> "{b}";')
-    lines.append("}")
-    dot = "\n".join(lines)
+        g.add_edge(g.add_unique_node(a), g.add_unique_node(b))
+    dot = g.code()
     if output_path:
         with open(output_path, "w") as f:
             f.write(dot)

@@ -258,3 +258,63 @@ def test_training_decoder_teacher_forcing():
     res, = exe.run(feed={"emb": ev, "h0": h0}, fetch_list=[out])
     want = h0[:, None, :] + np.cumsum(ev, axis=1)
     np.testing.assert_allclose(res, want, rtol=1e-5)
+
+
+def test_compat_helpers():
+    from paddle_tpu import compat as cpt
+    assert cpt.to_text(b"abc") == "abc"
+    assert cpt.to_text(["a", b"b"]) == ["a", "b"]
+    assert cpt.to_bytes("abc") == b"abc"
+    s = {b"x", "y"}
+    assert cpt.to_text(s, inplace=True) is s and s == {"x", "y"}
+    # half-away-from-zero, not banker's
+    assert cpt.round(0.5) == 1.0
+    assert cpt.round(-0.5) == -1.0
+    assert cpt.round(2.675, 2) == pytest.approx(2.68)
+    assert cpt.floor_division(7, 2) == 3
+    assert cpt.get_exception_message(ValueError("boom")) == "boom"
+
+
+def test_top_level_batch_keeps_tail():
+    # reference default drop_last=False: tail batch is yielded
+    r = pt.batch(lambda: iter(range(5)), 2)
+    assert [list(b) for b in r()] == [[0, 1], [2, 3], [4]]
+    with pytest.raises(ValueError):
+        pt.batch(lambda: iter(range(5)), 0)
+
+
+def test_annotations_deprecated_decorator():
+    from paddle_tpu.annotations import deprecated
+
+    @deprecated(since="1.0", instead="new_api")
+    def old_api(v):
+        return v + 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api(1) == 2
+    assert any("deprecated since 1.0" in str(x.message) for x in w)
+    assert "new_api" in old_api.__doc__
+
+
+def test_graphviz_dot_builder(tmp_path):
+    from paddle_tpu.graphviz import Graph, GraphPreviewGenerator
+    g = Graph("net", rankdir="LR")
+    a = g.add_node("fc_w", shape="ellipse")
+    b = g.add_node("matmul", shape="rect")
+    g.add_edge(a, b, color="blue")
+    g.rank_group("same", [a, b])
+    code = g.code()
+    assert 'digraph "net"' in code and "-> " in code and "rank=same" in code
+    out = g.compile(str(tmp_path / "net.dot"))
+    assert os.path.exists(out)
+    gen = GraphPreviewGenerator("preview")
+    op = gen.add_op("conv2d")
+    arg = gen.add_arg("conv2d.w_0", is_param=True)
+    gen.add_edge(arg, op)
+    assert "conv2d" in gen.graph.code()
+
+
+def test_inferencer_shim_reexports():
+    from paddle_tpu.inferencer import Inferencer
+    assert Inferencer is pt.Inferencer
