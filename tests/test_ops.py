@@ -301,3 +301,54 @@ def test_isfinite_detects_nan():
     bad = exe.run(feed={"x": np.array([[1, np.nan, 2]], "float32")},
                   fetch_list=[ok])[0]
     assert bool(good) is True and bool(bad) is False
+
+
+def test_dropout_upscale_unbiased():
+    # upscale_in_train: kept values scaled by 1/(1-p) so E[out] == x
+    p = 0.1
+    x = np.ones((256, 256), "float32")
+    v = layers.data("x", shape=[256])
+    o = layers.dropout(v, p, dropout_implementation="upscale_in_train")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    train = exe.run(feed={"x": x}, fetch_list=[o], is_test=False)[0]
+    np.testing.assert_allclose(train[train > 0], 1.0 / (1.0 - p), rtol=1e-6)
+    assert abs((train > 0).mean() - (1.0 - p)) < 0.01
+    assert abs(train.mean() - 1.0) < 0.02
+
+
+def test_softmax_ce_fused_label_smooth_matches_composed():
+    V, eps = 11, 0.1
+    logits = RNG.randn(4, 7, V).astype("float32") * 3
+    lbl = RNG.randint(0, V, (4, 7, 1)).astype("int64")
+    lg = layers.data("lg", shape=[4, 7, V], append_batch_size=False)
+    lb = layers.data("lb", shape=[4, 7, 1], dtype="int64",
+                     append_batch_size=False)
+    fused = layers.softmax_with_cross_entropy(lg, lb, smooth_epsilon=eps)
+    oh = layers.one_hot(lb, V)
+    soft = layers.label_smooth(oh, epsilon=eps)
+    composed = layers.softmax_with_cross_entropy(lg, soft, soft_label=True)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    f, c = exe.run(feed={"lg": logits, "lb": lbl},
+                   fetch_list=[fused, composed])
+    np.testing.assert_allclose(np.asarray(f), np.asarray(c).reshape(f.shape),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_softmax_ce_fused_smooth_oob_label_zeroed():
+    # out-of-range / ignore_index labels: zero loss AND zero grad row,
+    # same policy as the unfused path
+    V, eps = 7, 0.1
+    logits = RNG.randn(4, V).astype("float32")
+    lbl = np.array([[2], [V], [-1], [3]], dtype="int64")  # V and -1 are OOB
+    lg = layers.data("lg", shape=[4, V], append_batch_size=False)
+    lb = layers.data("lb", shape=[4, 1], dtype="int64",
+                     append_batch_size=False)
+    loss = layers.softmax_with_cross_entropy(lg, lb, smooth_epsilon=eps)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = np.asarray(exe.run(feed={"lg": logits, "lb": lbl},
+                             fetch_list=[loss])[0]).ravel()
+    assert got[1] == 0.0 and got[2] == 0.0
+    assert got[0] > 0.0 and got[3] > 0.0
