@@ -46,9 +46,69 @@ class Executor:
         self.step_timeout = None     # seconds; None disables
         self.last_step_time = None   # wall seconds of the last run()
         self._seen_keys = set()
+        # per-device on-device step counters (PRNG stream position);
+        # donated through every run() so advancing costs no dispatch
+        self._step_counters = {}
 
     def close(self):
         self._cache.clear()
+
+    def _put_feeds(self, program, feed, dev):
+        """Feed values → device arrays with ONE transfer each: dtype
+        casts happen host-side, and values that are already jax Arrays
+        of the right dtype pass through untouched (a device_put per feed
+        per step is a relay round-trip — measured ~3 ms each on the
+        remote-TPU tunnel)."""
+        feed_arrays = {}
+        for k, v in feed.items():
+            var = program.global_block().vars.get(k)
+            dt = as_jnp_dtype(var.dtype) if var is not None else None
+            if dt is not None and not jax.config.jax_enable_x64:
+                # avoid per-step truncation warnings: TPU runs x32
+                dt = {jnp.int64: jnp.int32, jnp.uint64: jnp.uint32,
+                      jnp.float64: jnp.float32}.get(dt, dt)
+            npdt = np.dtype(dt) if dt is not None else None
+            if isinstance(v, jax.Array) and (npdt is None
+                                             or v.dtype == npdt) \
+                    and v.sharding.device_set == {dev}:
+                feed_arrays[k] = v
+                continue
+            arr = np.asarray(v)
+            if npdt is not None and arr.dtype != npdt:
+                arr = arr.astype(npdt)
+            feed_arrays[k] = jax.device_put(arr, dev)
+        return feed_arrays
+
+    def _collect_persist(self, program, scope):
+        """Scope values for the program's persistables, with a clear
+        error when training state was never initialized."""
+        persist = {}
+        missing = []
+        for v in program.persistable_vars():
+            val = scope.get(v.name)
+            if val is None:
+                missing.append(v.name)
+            else:
+                persist[v.name] = val
+        if missing:
+            # vars this program itself produces (startup program case) are fine
+            produced = {n for op in program.global_block().ops
+                        for n in op.output_names()}
+            hard_missing = [n for n in missing if n not in produced]
+            if hard_missing:
+                raise RuntimeError(
+                    f"persistable vars not initialized: {hard_missing[:5]} "
+                    f"(+{max(0, len(hard_missing)-5)} more); "
+                    "run the startup program first")
+        return persist
+
+    def _check_fetches_finite(self, fetch_names, fetches):
+        for name, val in zip(fetch_names, fetches):
+            arr = np.asarray(val)
+            if np.issubdtype(arr.dtype, np.floating) \
+                    and not np.all(np.isfinite(arr)):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in fetched var {name!r}")
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -70,53 +130,54 @@ class Executor:
             is_test = getattr(program, "_is_test", False)
 
         seed = program.random_seed if program.random_seed else self._seed
-        key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
 
         dev = self.place.jax_device()
-        feed_arrays = {}
-        for k, v in feed.items():
-            var = program.global_block().vars.get(k)
-            dt = as_jnp_dtype(var.dtype) if var is not None else None
-            if dt is not None and not jax.config.jax_enable_x64:
-                # avoid per-step truncation warnings: TPU runs x32
-                dt = {jnp.int64: jnp.int32, jnp.uint64: jnp.uint32,
-                      jnp.float64: jnp.float32}.get(dt, dt)
-            arr = jax.device_put(jnp.asarray(np.asarray(v), dtype=dt), dev)
-            feed_arrays[k] = arr
+        feed_arrays = self._put_feeds(program, feed, dev)
 
-        persist_vars = program.persistable_vars()
-        persist = {}
-        missing = []
-        for v in persist_vars:
-            val = scope.get(v.name)
-            if val is None:
-                missing.append(v.name)
-            else:
-                persist[v.name] = val
-        if missing:
-            # vars this program itself produces (startup program case) are fine
-            produced = {n for op in program.global_block().ops for n in op.output_names()}
-            hard_missing = [n for n in missing if n not in produced]
-            if hard_missing:
-                raise RuntimeError(
-                    f"persistable vars not initialized: {hard_missing[:5]} "
-                    f"(+{max(0, len(hard_missing)-5)} more); run the startup program first")
+        persist = self._collect_persist(program, scope)
 
         ckey = (id(program), program._version, _feed_signature(feed_arrays),
-                tuple(fetch_names), bool(is_test))
+                tuple(fetch_names), bool(is_test), seed)
         fn = self._cache.get(ckey) if use_program_cache else None
         # first-run (compile) detection must survive use_program_cache=False
         first_run = ckey not in self._seen_keys
         self._seen_keys.add(ckey)
         if fn is None:
             step_fn = build_step_fn(program, fetch_names, is_test, self.place)
-            fn = jax.jit(step_fn, donate_argnums=(0,))
+
+            # the PRNG key is derived ON DEVICE from a donated step
+            # counter rather than host-side fold_in: through a remote
+            # TPU relay every host-side jax.random call is an extra
+            # round-trip per step (measured 82 → 9 ms/step on MNIST)
+            def stepped(persist, feed, step):
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(seed), step.astype(jnp.uint32))
+                fetches, new_persist = step_fn(persist, feed, key)
+                return fetches, new_persist, step + 1
+
+            fn = jax.jit(stepped, donate_argnums=(0, 2))
             if use_program_cache:
                 self._cache[ckey] = fn
 
+        step_dev = self._step_counters.get(dev)
+        if step_dev is None:
+            # uncommitted on purpose: a device_put-committed counter
+            # would commit every jit OUTPUT (params included) to one
+            # device, poisoning later mesh-sharded use of the scope
+            # (e.g. startup → PipelineTrainer over a pp mesh)
+            step_dev = jnp.asarray(self._step - 1, jnp.int32)
         t0 = time.perf_counter()
-        fetches, new_persist = fn(persist, feed_arrays, key)
+        try:
+            fetches, new_persist, step_dev = fn(persist, feed_arrays,
+                                                step_dev)
+        except Exception:
+            # the counter was donated into the failed execution — drop
+            # it so the next run() re-seeds instead of passing a deleted
+            # buffer forever
+            self._step_counters.pop(dev, None)
+            raise
+        self._step_counters[dev] = step_dev
         if self.step_timeout is not None:
             # completion barrier only when the watchdog is armed — don't
             # break async dispatch for return_numpy=False callers
@@ -133,11 +194,95 @@ class Executor:
             scope.set(name, val)
 
         if self.check_nan_inf and fetches:
-            for name, val in zip(fetch_names, fetches):
-                arr = np.asarray(val)
-                if np.issubdtype(arr.dtype, np.floating) and not np.all(np.isfinite(arr)):
-                    raise FloatingPointError(f"NaN/Inf detected in fetched var {name!r}")
+            self._check_fetches_finite(fetch_names, fetches)
 
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    def run_scanned(self, program=None, feed=None, fetch_list=None,
+                    scope=None, return_numpy=True, is_test=None,
+                    steps=None):
+        """Run `steps` training steps as ONE compiled XLA program
+        (lax.scan over the step function, feeds stacked on a leading
+        [steps] axis). Returns stacked fetches [steps, ...].
+
+        This is the TPU-native replacement for the reference's hot
+        host-side train loop (python/paddle/fluid/trainer.py:train /
+        async_executor.cc): instead of one host→device dispatch per
+        batch, the whole window runs on-device — dispatch/relay latency
+        is paid once per window instead of once per step, which is the
+        difference between device-bound and dispatch-bound throughput on
+        remote-attached TPUs.
+
+        CAVEAT (measured): TPU relays that interpret XLA control flow on
+        the host (e.g. the axon tunnel this repo is developed against)
+        re-dispatch the scan body per iteration, so there run_scanned is
+        SLOWER than run() — use it on directly-attached TPU/CPU backends,
+        where the scan compiles to one on-device loop.
+
+        Each step gets its own fold_in key, so
+        dropout streams match `steps` sequential run() calls in
+        distribution (not bit-for-bit: run() folds the executor's global
+        step counter, the scan folds the window-local index)."""
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if hasattr(f, "name") else f
+                       for f in fetch_list]
+        if is_test is None:
+            is_test = getattr(program, "_is_test", False)
+
+        lens = {k: np.shape(v)[0] for k, v in feed.items()}
+        if steps is None:
+            if not lens:
+                raise ValueError("run_scanned needs feeds (leading axis = "
+                                 "steps) or an explicit steps=")
+            steps = next(iter(lens.values()))
+        bad = {k: n for k, n in lens.items() if n != steps}
+        if bad:
+            raise ValueError(
+                f"feeds must have leading steps axis {steps}; got {bad}")
+
+        seed = program.random_seed if program.random_seed else self._seed
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        self._step += steps
+
+        dev = self.place.jax_device()
+        feed_arrays = self._put_feeds(program, feed, dev)
+
+        persist = self._collect_persist(program, scope)
+
+        ckey = ("scan", steps, id(program), program._version,
+                _feed_signature(feed_arrays), tuple(fetch_names),
+                bool(is_test))
+        fn = self._cache.get(ckey)
+        if fn is None:
+            step_fn = build_step_fn(program, fetch_names, is_test,
+                                    self.place)
+
+            def scanned(persist, feeds, key):
+                keys = jax.random.split(key, steps)
+
+                def body(carry, xs):
+                    feed_t, k = xs
+                    fetches, new_carry = step_fn(carry, feed_t, k)
+                    return new_carry, fetches
+
+                new_persist, fetches = jax.lax.scan(
+                    body, persist, (feeds, keys))
+                return fetches, new_persist
+
+            fn = jax.jit(scanned, donate_argnums=(0,))
+            self._cache[ckey] = fn
+
+        fetches, new_persist = fn(persist, feed_arrays, key)
+        for name, val in new_persist.items():
+            scope.set(name, val)
+        if self.check_nan_inf and fetches:
+            self._check_fetches_finite(fetch_names, fetches)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -366,3 +366,68 @@ def test_slim_config_factory_builds_compress_pass():
     from paddle_tpu.contrib.slim import MagnitudePruner
     assert isinstance(compress.strategies[0].pruner, MagnitudePruner)
     assert compress.strategies[0].ratio == 0.3
+
+
+def test_run_scanned_matches_sequential():
+    # N scanned steps (one XLA program, lax.scan) == N sequential run()
+    # calls: same per-step losses and same final params (deterministic
+    # model: no dropout)
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    import numpy as np
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 11
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                x = layers.data("x", shape=[6])
+                y = layers.data("y", shape=[1])
+                h = layers.fc(x, 8, act="tanh")
+                p = layers.fc(h, 1)
+                loss = layers.mean(layers.square_error_cost(p, y))
+                pt.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(3)
+    xs = rng.randn(4, 8, 6).astype("float32")
+    ys = rng.randn(4, 8, 1).astype("float32")
+
+    main, startup, loss = build()
+    # fresh Executor per scope: the PRNG folds the executor step counter,
+    # so a shared executor would give the two startup runs different init
+    exe = pt.Executor(pt.CPUPlace())
+    seq_scope = pt.Scope()
+    with pt.scope_guard(seq_scope):
+        exe.run(startup)
+        seq_losses = [exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                              fetch_list=[loss])[0] for i in range(4)]
+    exe2 = pt.Executor(pt.CPUPlace())
+    scan_scope = pt.Scope()
+    with pt.scope_guard(scan_scope):
+        exe2.run(startup)
+        scan_losses, = exe2.run_scanned(main, feed={"x": xs, "y": ys},
+                                        fetch_list=[loss])
+    np.testing.assert_allclose(np.asarray(seq_losses).ravel(),
+                               np.asarray(scan_losses).ravel(), rtol=1e-5)
+    for v in main.all_parameters():
+        np.testing.assert_allclose(np.asarray(seq_scope.get(v.name)),
+                                   np.asarray(scan_scope.get(v.name)),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_run_scanned_feed_validation():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    import numpy as np
+    import pytest as _pytest
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = layers.data("x", shape=[3])
+            out = layers.fc(x, 2)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    with _pytest.raises(ValueError):
+        exe.run_scanned(main, feed={"x": np.zeros((2, 4, 3), "float32")},
+                        fetch_list=[out], steps=5)
