@@ -152,6 +152,12 @@ def _layer_norm(ctx, ins, attrs):
     x = _x(ins)
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
+    scale_in, bias_in = _opt(ins, "Scale"), _opt(ins, "Bias")
+    from .pallas import layer_norm as _ln_mod
+    got = _ln_mod.try_layer_norm(x, scale_in, bias_in, eps, begin)
+    if got is not None:
+        y, mean, var = got
+        return {"Y": [y], "Mean": [mean], "Variance": [var]}
     axes = tuple(range(begin, x.ndim))
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
@@ -649,25 +655,59 @@ def _im2sequence(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 # attention (jnp reference path; Pallas flash kernel in ops/pallas)
 # ---------------------------------------------------------------------------
+@jax.custom_vjp
+def _attn_softmax(logits):
+    """Softmax over the last dim with f32 internals but logits kept in
+    their own dtype. Under bf16 AMP the [.., Tq, Tk] score tensor stays
+    bf16 — half the HBM traffic of an astype(f32) upfront; max-subtract
+    keeps the f32 exp/sum exact where it matters. The custom_vjp makes
+    the bf16 WEIGHTS the only backward residual (plain AD would save the
+    f32 exp tensor). fp32 inputs compute exactly as before."""
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    # fully-masked rows (all -inf/-1e9): keep the shift finite
+    m = jnp.where(jnp.isfinite(m), m, jnp.zeros_like(m))
+    e = jnp.exp((logits - m).astype(jnp.float32))
+    return (e / jnp.sum(e, axis=-1, keepdims=True)).astype(logits.dtype)
+
+
+def _attn_softmax_fwd(logits):
+    w = _attn_softmax(logits)
+    return w, w
+
+
+def _attn_softmax_bwd(w, g):
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    gx = wf * (gf - jnp.sum(gf * wf, axis=-1, keepdims=True))
+    return (gx.astype(w.dtype),)
+
+
+_attn_softmax.defvjp(_attn_softmax_fwd, _attn_softmax_bwd)
+
+
 @kernel("scaled_dot_product_attention")
 def _sdpa(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     mask = _opt(ins, "Mask")
     scale = attrs.get("scale", None) or (1.0 / np.sqrt(q.shape[-1]))
     bthd = attrs.get("layout", "bhtd") == "bthd"  # see _flash_attention
+    # compute dtype: bf16 logits are safe (f32-sized exponent) and halve
+    # the score-tensor HBM traffic; fp16 would overflow (65504 max, and
+    # a -1e9 pad mask → -inf), so everything else computes in f32
+    cdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
     if bthd:
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) \
-            * scale
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(cdt) \
+            * jnp.asarray(scale, cdt)
     else:
-        logits = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) \
-            * scale
+        logits = jnp.einsum("...qd,...kd->...qk", q, k).astype(cdt) \
+            * jnp.asarray(scale, cdt)
     if mask is not None:
-        logits = logits + mask.astype(jnp.float32)
+        logits = logits + mask.astype(cdt)
     if attrs.get("causal", False):
         T, S = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((T, S), dtype=bool), k=S - T)
         logits = jnp.where(cm, logits, -jnp.inf)
-    w = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    w = _attn_softmax(logits).astype(q.dtype)
     if bthd:
         out = jnp.einsum("bhqk,bkhd->bqhd", w, v)
     else:

@@ -1,0 +1,251 @@
+"""Pallas fused LayerNorm for TPU — forward AND backward.
+
+XLA computes the stats pass, the normalize pass and the backward
+reductions as separate loops over the activation, each re-reading it
+from HBM. These kernels do each direction in ONE pass per block:
+
+- forward: grid over row blocks; mean/var/normalize/affine computed in
+  f32 from a single x read, y written in the input dtype.
+- backward: grid over row blocks; stats recomputed in-kernel (VMEM), dx
+  per block plus dscale/dbias accumulated across the sequential TPU
+  grid into (1, C) f32 outputs (revisited-output accumulation).
+
+Registered via jax.custom_vjp so jax.value_and_grad stays on the fused
+path. Dispatch: try_layer_norm() returns None (→ caller's jnp fallback)
+off-TPU, for norm axes that are not the minor axis, for C that violates
+the Mosaic lane rule, or when no legal row block exists.
+
+Measured (v5e): standalone matmul→LN→matmul fwd+bwd at [8192,512] runs
+1.6x faster than the XLA composition (1.76 vs 2.78 ms) and ties at
+C=2048; inside the full transformer-base step it is throughput-neutral
+(~21.6 ms/step either way — XLA fuses the stats/normalize passes into
+neighbors there, and what the kernel saves, the fusion boundary costs).
+Kept on the dispatch path: it never loses, wins standalone/wide-C, and
+block shapes preserve the array's native rank (an earlier 2D-reshape
+version re-tiled the surrounding program for +3 ms/step).
+
+Replaces the reference's per-op CUDA layer_norm
+(paddle/fluid/operators/layer_norm_op.cu) as the hot-path norm.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+from .flash_attention import active
+
+__all__ = ["layer_norm", "try_layer_norm", "STATS"]
+
+# Trace-time evidence the Pallas path was selected (tests assert on it).
+STATS = {"pallas_calls": 0}
+
+# Row-block budget: BR * C elements of x in VMEM (bf16/f32) plus f32
+# temps. 512x512 f32 = 1MB — comfortably inside ~16MB VMEM with
+# double-buffering.
+_BLOCK_BUDGET = 512 * 1024
+
+
+def _pick_rows(R, C):
+    """Largest row block (multiple of 8, or R itself) that divides R
+    within the VMEM budget. 0 if none."""
+    pref = max(8, min(R, _BLOCK_BUDGET // max(C, 1)))
+    if pref >= R:
+        return R
+    for b in range(pref // 8 * 8, 0, -8):
+        if R % b == 0:
+            return b
+    # no 8-multiple divides R: whole-array block only if it fits VMEM
+    return R if R <= 1024 and R * C <= _BLOCK_BUDGET else 0
+
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, *, eps):
+    xf = _rows2d(x_ref).astype(jnp.float32)
+    mean = jnp.mean(xf, axis=1, keepdims=True)
+    d = xf - mean
+    var = jnp.mean(d * d, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = d * rstd * _rows2d(scale_ref).astype(jnp.float32) \
+        + _rows2d(bias_ref).astype(jnp.float32)
+    y_ref[...] = y.reshape(y_ref.shape).astype(y_ref.dtype)
+
+
+def _bwd_kernel(dy_ref, x_ref, scale_ref, dx_ref, dscale_ref, dbias_ref,
+                *, eps, grid_rank):
+    # stats recomputed in-kernel from the x block: costs two VMEM-local
+    # reductions, saves the (R,1) stat outputs (awkward 1-lane stores
+    # and an extra boundary the fusion planner has to schedule around)
+    dyf = _rows2d(dy_ref).astype(jnp.float32)
+    xf = _rows2d(x_ref).astype(jnp.float32)
+    mean = jnp.mean(xf, axis=1, keepdims=True)
+    d = xf - mean
+    var = jnp.mean(d * d, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = d * rstd
+    dxhat = dyf * _rows2d(scale_ref).astype(jnp.float32)
+    m1 = jnp.mean(dxhat, axis=1, keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=1, keepdims=True)
+    dx = rstd * (dxhat - m1 - xhat * m2)
+    dx_ref[...] = dx.reshape(dx_ref.shape).astype(dx_ref.dtype)
+    # dscale/dbias: accumulate across the (sequential) row-block grid
+    first = pl.program_id(0) == 0
+    for gd in range(1, grid_rank):
+        first = jnp.logical_and(first, pl.program_id(gd) == 0)
+
+    @pl.when(first)
+    def _init():
+        dscale_ref[...] = jnp.zeros_like(dscale_ref)
+        dbias_ref[...] = jnp.zeros_like(dbias_ref)
+    dscale_ref[...] += jnp.sum(dyf * xhat, axis=0,
+                               keepdims=True).reshape(dscale_ref.shape)
+    dbias_ref[...] += jnp.sum(dyf, axis=0,
+                              keepdims=True).reshape(dbias_ref.shape)
+
+
+def _row_specs(shape, br, C):
+    """(block_shape, index_map, grid). The kernel runs on the array's
+    NATIVE rank: reshaping [B,T,C]→[R,C] at the call boundary is "free"
+    in isolation but re-tiles every producer/consumer around the kernel
+    in a large program (profiled +3 ms/step on the transformer when
+    these kernels reshaped to 2D). 3D blocks span whole [T,C] slabs of
+    as many batch entries as fit the VMEM budget, so per-step work stays
+    large (a (1,T,C) block at T=128 left 64 tiny grid steps — measured
+    slower than the 2D kernel)."""
+    *lead, T, _ = shape
+    if lead:
+        assert len(lead) == 1
+        B = lead[0]
+        bb = max(1, min(B, _BLOCK_BUDGET // max(T * C, 1)))
+        while B % bb:
+            bb -= 1
+        block = (bb, T, C)
+        grid = (B // bb,)
+        return block, (lambda i: (i, 0, 0)), grid
+    return (br, C), (lambda i: (i, 0)), (T // br,)
+
+
+def _bcast_spec(ndim, C, grid_rank):
+    shape = (1,) * (ndim - 1) + (C,)
+    if grid_rank == 2:
+        return pl.BlockSpec(shape, lambda b, i: (0,) * ndim)
+    return pl.BlockSpec(shape, lambda i: (0,) * ndim)
+
+
+def _rows2d(ref):
+    """View a (bb, T, C) or (br, C) block as (rows, C)."""
+    v = ref[...]
+    return v.reshape(-1, v.shape[-1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def layer_norm(x, scale, bias, eps=1e-5, block_rows=None,
+               interpret=False):
+    """Fused LN over the last axis. x: [R, C] or [B, T, C];
+    scale/bias: [C]."""
+    return _fwd(x, scale, bias, eps, block_rows, interpret)
+
+
+def _norm_rows(x):
+    return x.shape[-2]
+
+
+def _fwd(x, scale, bias, eps, block_rows, interpret):
+    STATS["pallas_calls"] += 1
+    C = x.shape[-1]
+    br = block_rows or _pick_rows(_norm_rows(x), C)
+    block, imap, grid = _row_specs(x.shape, br, C)
+    sshape = (1,) * (x.ndim - 1) + (C,)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(block, imap),
+            _bcast_spec(x.ndim, C, len(grid)),
+            _bcast_spec(x.ndim, C, len(grid)),
+        ],
+        out_specs=pl.BlockSpec(block, imap),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x, scale.reshape(sshape), bias.reshape(sshape))
+
+
+def _fwd_vjp(x, scale, bias, eps, block_rows, interpret):
+    y = _fwd(x, scale, bias, eps, block_rows, interpret)
+    return y, (x, scale)
+
+
+def _bwd_vjp(eps, block_rows, interpret, res, dy):
+    x, scale = res
+    C = x.shape[-1]
+    br = block_rows or _pick_rows(_norm_rows(x), C)
+    block, imap, grid = _row_specs(x.shape, br, C)
+    sshape = (1,) * (x.ndim - 1) + (C,)
+    dx, dscale, dbias = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps, grid_rank=len(grid)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(block, imap),
+            pl.BlockSpec(block, imap),
+            _bcast_spec(x.ndim, C, len(grid)),
+        ],
+        out_specs=[
+            pl.BlockSpec(block, imap),
+            _bcast_spec(x.ndim, C, len(grid)),
+            _bcast_spec(x.ndim, C, len(grid)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct(sshape, jnp.float32),
+            jax.ShapeDtypeStruct(sshape, jnp.float32),
+        ],
+        interpret=interpret,
+    )(dy, x, scale.reshape(sshape))
+    return (dx, dscale.reshape(C).astype(scale.dtype),
+            dbias.reshape(C).astype(scale.dtype))
+
+
+layer_norm.defvjp(_fwd_vjp, _bwd_vjp)
+
+
+def try_layer_norm(x, scale, bias, eps, begin_norm_axis):
+    """THE dispatch policy: returns (y, mean, var) on the Pallas path or
+    None → caller falls back to the fused-XLA composition. Requirements:
+    Pallas active, norm over exactly the minor axis, affine params
+    present, C a lane multiple (or small-array full tile), and a legal
+    row block."""
+    use_pallas, interpret = active()
+    if not use_pallas or scale is None or bias is None:
+        return None
+    if begin_norm_axis != x.ndim - 1 or x.ndim < 2:
+        return None
+    C = x.shape[-1]
+    if C % 128 != 0 and C > 256:
+        return None
+    # rank policy: 2D/3D run on their native shape — a boundary reshape
+    # re-tiles the surrounding program (see _row_specs); >3D folds the
+    # leading dims (rare shapes; accept the reshape there)
+    x_run = x if x.ndim <= 3 else x.reshape((-1,) + x.shape[-2:])
+    rows = x_run.shape[-2]
+    if rows < 8:
+        return None
+    br = _pick_rows(rows, C)
+    if not br or (rows // br) * br != rows:
+        return None
+    # 3D blocks span at least one whole [T, C] slab — gate it to the
+    # VMEM budget or the kernel would fail in Mosaic lowering on shapes
+    # the jnp fallback handles fine
+    if x_run.ndim == 3 and rows * C > _BLOCK_BUDGET:
+        return None
+    y = layer_norm(x_run, scale.reshape(C), bias.reshape(C), eps, None,
+                   interpret)
+    # Mean/Variance op outputs (usually dead → DCE'd): recompute
+    # cheaply; .squeeze() matches the jnp fallback's output shapes
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1)
+    var = jnp.var(xf, axis=-1)
+    return (y.reshape(x.shape), mean.squeeze(), var.squeeze())
