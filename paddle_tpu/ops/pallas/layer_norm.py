@@ -76,7 +76,7 @@ def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, *, eps):
 
 
 def _bwd_kernel(dy_ref, x_ref, scale_ref, dx_ref, dscale_ref, dbias_ref,
-                *, eps, grid_rank):
+                *, eps):
     # stats recomputed in-kernel from the x block: costs two VMEM-local
     # reductions, saves the (R,1) stat outputs (awkward 1-lane stores
     # and an extra boundary the fusion planner has to schedule around)
@@ -92,12 +92,8 @@ def _bwd_kernel(dy_ref, x_ref, scale_ref, dx_ref, dscale_ref, dbias_ref,
     m2 = jnp.mean(dxhat * xhat, axis=1, keepdims=True)
     dx = rstd * (dxhat - m1 - xhat * m2)
     dx_ref[...] = dx.reshape(dx_ref.shape).astype(dx_ref.dtype)
-    # dscale/dbias: accumulate across the (sequential) row-block grid
-    first = pl.program_id(0) == 0
-    for gd in range(1, grid_rank):
-        first = jnp.logical_and(first, pl.program_id(gd) == 0)
-
-    @pl.when(first)
+    # dscale/dbias: accumulate across the (sequential) rank-1 grid
+    @pl.when(pl.program_id(0) == 0)
     def _init():
         dscale_ref[...] = jnp.zeros_like(dscale_ref)
         dbias_ref[...] = jnp.zeros_like(dbias_ref)
@@ -129,10 +125,8 @@ def _row_specs(shape, br, C):
     return (br, C), (lambda i: (i, 0)), (T // br,)
 
 
-def _bcast_spec(ndim, C, grid_rank):
+def _bcast_spec(ndim, C):
     shape = (1,) * (ndim - 1) + (C,)
-    if grid_rank == 2:
-        return pl.BlockSpec(shape, lambda b, i: (0,) * ndim)
     return pl.BlockSpec(shape, lambda i: (0,) * ndim)
 
 
@@ -165,8 +159,8 @@ def _fwd(x, scale, bias, eps, block_rows, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec(block, imap),
-            _bcast_spec(x.ndim, C, len(grid)),
-            _bcast_spec(x.ndim, C, len(grid)),
+            _bcast_spec(x.ndim, C),
+            _bcast_spec(x.ndim, C),
         ],
         out_specs=pl.BlockSpec(block, imap),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -186,17 +180,17 @@ def _bwd_vjp(eps, block_rows, interpret, res, dy):
     block, imap, grid = _row_specs(x.shape, br, C)
     sshape = (1,) * (x.ndim - 1) + (C,)
     dx, dscale, dbias = pl.pallas_call(
-        functools.partial(_bwd_kernel, eps=eps, grid_rank=len(grid)),
+        functools.partial(_bwd_kernel, eps=eps),
         grid=grid,
         in_specs=[
             pl.BlockSpec(block, imap),
             pl.BlockSpec(block, imap),
-            _bcast_spec(x.ndim, C, len(grid)),
+            _bcast_spec(x.ndim, C),
         ],
         out_specs=[
             pl.BlockSpec(block, imap),
-            _bcast_spec(x.ndim, C, len(grid)),
-            _bcast_spec(x.ndim, C, len(grid)),
+            _bcast_spec(x.ndim, C),
+            _bcast_spec(x.ndim, C),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(x.shape, x.dtype),
