@@ -32,7 +32,7 @@ from .core.framework import (
     default_main_program, default_startup_program, program_guard,
     name_scope,
 )
-from .core.place import CPUPlace, TPUPlace, CUDAPlace
+from .core.place import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace
 from .core.scope import Scope, global_scope, scope_guard
 from .core.executor import Executor
 from .core.backward import append_backward, gradients
@@ -49,7 +49,7 @@ from .io import (save_params, save_persistables, load_params,
                  load_persistables, save_inference_model,
                  load_inference_model, save_checkpoint, load_checkpoint)
 from . import lod
-from .lod import LoDTensor, create_lod_tensor
+from .lod import LoDTensor, LoDTensorArray, create_lod_tensor
 from . import parallel
 from .parallel.parallel_executor import ParallelExecutor
 from .core.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
@@ -83,6 +83,11 @@ from . import compat
 from . import graphviz
 from . import inferencer
 from .batch import batch
+from . import recordio_writer
+from .core import backward
+# the reference's pre-layers LR-decay module name (same functions as
+# layers.learning_rate_scheduler)
+from .layers import learning_rate_scheduler as learning_rate_decay
 
 # Tensor/LoDTensor aliases (ref fluid.Tensor is LoDTensor without LoD)
 Tensor = LoDTensor

@@ -8,7 +8,8 @@ jit compile target.
 """
 import jax
 
-__all__ = ["Place", "CPUPlace", "TPUPlace", "CUDAPlace", "core_place_of"]
+__all__ = ["Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+           "core_place_of"]
 
 
 class Place:
@@ -54,9 +55,13 @@ class TPUPlace(Place):
         return devs[self.device_id % len(devs)]
 
 
-# Compatibility alias: reference programs say fluid.CUDAPlace(i); on this
-# framework that means "the accelerator", i.e. TPU.
+# Compatibility aliases: reference programs say fluid.CUDAPlace(i) (and
+# fluid.CUDAPinnedPlace() for pinned host staging buffers); on this
+# framework the accelerator is TPU, and "pinned host memory" has no
+# separate notion under PJRT — host arrays are staged by device_put — so
+# both names resolve to the nearest real place.
 CUDAPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace
 
 
 def core_place_of(place):

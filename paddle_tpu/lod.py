@@ -8,8 +8,16 @@ and sequence layers take (data, seq_len). SURVEY §6 documents the swap.
 """
 import numpy as np
 
-__all__ = ["LoDTensor", "create_lod_tensor", "to_padded", "to_ragged",
-           "sequence_mask_np", "bucket_by_length"]
+__all__ = ["LoDTensor", "LoDTensorArray", "create_lod_tensor", "to_padded",
+           "to_ragged", "sequence_mask_np", "bucket_by_length"]
+
+
+class LoDTensorArray(list):
+    """Host-side growable vector of LoDTensors (ref framework
+    LoDTensorArray). The IN-GRAPH analog — fixed-capacity device array +
+    length scalar so it can ride lax.while_loop — is
+    layers.control_flow.create_array; this list type serves the host API
+    (e.g. executor feed/fetch of array variables)."""
 
 
 class LoDTensor:
