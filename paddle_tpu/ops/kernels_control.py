@@ -215,3 +215,33 @@ def _dequantize_abs_max(ctx, ins, attrs):
     scale = jnp.reshape(ins["Scale"][0], ())
     rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
     return {"Out": [w.astype(jnp.float32) * (scale / rng)]}
+
+
+@kernel("quantize")
+def _quantize(ctx, ins, attrs):
+    """ref operators/quantize_op.cc (contrib int8): y = round(x*Scale),
+    saturated to u8 [0,255] by default and to s8 [-128,127] when
+    is_negative_input (matching the reference's range selection)."""
+    x = ins["Input"][0].astype(jnp.float32)
+    s = attrs.get("Scale", 1.0)
+    if attrs.get("is_negative_input", False):
+        return {"Output": [jnp.clip(jnp.round(x * s), -128, 127)
+                           .astype(jnp.int8)]}
+    return {"Output": [jnp.clip(jnp.round(x * s), 0, 255)
+                       .astype(jnp.uint8)]}
+
+
+@kernel("dequantize")
+def _dequantize(ctx, ins, attrs):
+    """ref operators/dequantize_op.cc: y = x / Scale as fp32."""
+    x = ins["Input"][0].astype(jnp.float32)
+    s = attrs.get("Scale", 1.0)
+    return {"Output": [x / s]}
+
+
+@kernel("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    """ref operators/fake_dequantize_op.cc: out = x * scale / max_range."""
+    x = ins["X"][0].astype(jnp.float32)
+    scale = ins["Scale"][0].astype(jnp.float32).reshape(())
+    return {"Out": [x * scale / attrs.get("max_range", 127.0)]}

@@ -946,3 +946,43 @@ def _detection_map(ctx, ins, attrs):
     out = jax.pure_callback(fn, jax.ShapeDtypeStruct((), np.float32),
                             detect, gt)
     return {"MAP": [out]}
+
+
+@kernel("mine_hard_examples")
+def _mine_hard_examples(ctx, ins, attrs):
+    """ref operators/detection/mine_hard_examples_op.cc. Static-shape
+    TPU analog: NegIndices is returned as a [N, Np] 0/1 mask over priors
+    (the reference emits a per-image LoD index list — data-dependent
+    length), selected as the top-loss eligible negatives per image.
+    UpdatedMatchIndices keeps positives (this kernel mines negatives
+    only; the reference's hard_example demotion of unselected positives
+    is handled by callers via the mask)."""
+    cls_loss = ins["ClsLoss"][0].astype(jnp.float32)       # [N, Np]
+    match_idx = ins["MatchIndices"][0].astype(jnp.int32)
+    match_dist = ins["MatchDist"][0].astype(jnp.float32) \
+        if ins.get("MatchDist") else jnp.zeros_like(cls_loss)
+    mining = attrs.get("mining_type", "max_negative")
+    loss = cls_loss
+    if mining == "hard_example" and ins.get("LocLoss"):
+        loss = loss + ins["LocLoss"][0].astype(jnp.float32)
+    thr = attrs.get("neg_dist_threshold", 0.5)
+    if mining == "hard_example":
+        # ref IsEligibleMining: hard_example ranks ALL priors
+        eligible = jnp.ones_like(match_idx, bool)
+    else:
+        eligible = (match_idx == -1) & (match_dist < thr)
+    n_eligible = jnp.sum(eligible, axis=1)
+    if mining == "hard_example":
+        neg_sel = jnp.minimum(attrs.get("sample_size", 0), n_eligible)
+    else:
+        num_pos = jnp.sum(match_idx != -1, axis=1)
+        ratio = attrs.get("neg_pos_ratio", 3.0)
+        neg_sel = jnp.minimum((num_pos * ratio).astype(jnp.int32),
+                              n_eligible)
+    score = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-score, axis=1)
+    rank = jax.vmap(lambda o: jnp.zeros(o.shape[0], jnp.int32).at[o].set(
+        jnp.arange(o.shape[0], dtype=jnp.int32)))(order)
+    neg_mask = (rank < neg_sel[:, None]) & eligible
+    return {"NegIndices": [neg_mask.astype(jnp.int32)],
+            "UpdatedMatchIndices": [match_idx]}

@@ -273,3 +273,84 @@ def _mean_iou(ctx, ins, attrs):
     valid = (union > 0).astype(jnp.float32)
     return {"OutMeanIou": [jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)],
             "OutWrong": [union - inter], "OutCorrect": [inter]}
+
+
+def _soft_threshold(prox, lr, l1, l2):
+    """Proximal L1/L2 projection shared by proximal_gd/proximal_adagrad
+    (ref operators/optimizers/proximal_{gd,adagrad}_op.h)."""
+    if l1 > 0:
+        return (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return prox / (1.0 + lr * l2)
+
+
+@kernel("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    """ref operators/optimizers/proximal_gd_op.h."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+    return {"ParamOut": [_soft_threshold(prox, lr, l1, l2).astype(p.dtype)]}
+
+
+@kernel("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    """ref operators/optimizers/proximal_adagrad_op.h."""
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    gf = g.astype(jnp.float32)
+    m_new = m + gf * gf
+    prox = p.astype(jnp.float32) - lr * gf / jnp.sqrt(m_new)
+    return {"ParamOut": [_soft_threshold(prox, lr, l1, l2).astype(p.dtype)],
+            "MomentOut": [m_new]}
+
+
+@kernel("precision_recall")
+def _precision_recall(ctx, ins, attrs):
+    """ref operators/metrics/precision_recall_op.h: per-class TP/FP/TN/FN
+    accumulation → [macro P, macro R, macro F1, micro P, micro R,
+    micro F1] for the batch and for batch+carried states."""
+    idx = ins["Indices"][0].astype(jnp.int32).reshape(-1)
+    lbl = ins["Labels"][0].astype(jnp.int32).reshape(-1)
+    C = attrs["class_number"]
+    w = ins["Weights"][0].astype(jnp.float32).reshape(-1) \
+        if ins.get("Weights") else jnp.ones_like(idx, jnp.float32)
+    onehot = lambda v: jax.nn.one_hot(v, C, dtype=jnp.float32)
+    hit = (idx == lbl).astype(jnp.float32)
+    tp = jnp.sum(w[:, None] * onehot(idx) * hit[:, None], axis=0)
+    fp = jnp.sum(w[:, None] * onehot(idx) * (1 - hit)[:, None], axis=0)
+    fn = jnp.sum(w[:, None] * onehot(lbl) * (1 - hit)[:, None], axis=0)
+    # ref: every sample adds w to all classes' TN, then backs out the
+    # predicted (and, on a miss, the labeled) class
+    total_w = jnp.sum(w)
+    tn = total_w - tp - fp - fn
+    states = jnp.stack([tp, fp, tn, fn], axis=1)         # [C, 4]
+
+    def metrics(st):
+        tp_, fp_, fn_ = st[:, 0], st[:, 1], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-30),
+                         1.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-30),
+                        1.0)
+        macro_p, macro_r = jnp.mean(prec), jnp.mean(rec)
+        f1 = lambda p_, r_: jnp.where(p_ + r_ > 0,
+                                      2 * p_ * r_ / jnp.maximum(p_ + r_,
+                                                                1e-30), 0.0)
+        ttp, tfp, tfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        micro_p = jnp.where(ttp + tfp > 0,
+                            ttp / jnp.maximum(ttp + tfp, 1e-30), 1.0)
+        micro_r = jnp.where(ttp + tfn > 0,
+                            ttp / jnp.maximum(ttp + tfn, 1e-30), 1.0)
+        return jnp.stack([macro_p, macro_r, f1(macro_p, macro_r),
+                          micro_p, micro_r, f1(micro_p, micro_r)])
+
+    batch_metrics = metrics(states)
+    if ins.get("StatesInfo"):
+        states = states + ins["StatesInfo"][0].astype(jnp.float32)
+    return {"BatchMetrics": [batch_metrics],
+            "AccumMetrics": [metrics(states)],
+            "AccumStatesInfo": [states]}

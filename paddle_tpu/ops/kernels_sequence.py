@@ -255,3 +255,37 @@ def _chunk_eval(ctx, ins, attrs):
             "NumInferChunks": [n_inf.astype(jnp.int64)],
             "NumLabelChunks": [n_lab.astype(jnp.int64)],
             "NumCorrectChunks": [correct.astype(jnp.int64)]}
+
+
+@kernel("sequence_erase")
+def _sequence_erase(ctx, ins, attrs):
+    """ref sequence_ops/sequence_erase_op.h: drop every token in
+    attrs["tokens"] from each sequence. TPU (static-shape) analog of the
+    reference's LoD compaction: kept tokens are stably compacted to the
+    front of the padded [B, T] row, the tail is zero-padded, and OutLen
+    carries the new lengths (the mask-based LoD convention used by every
+    sequence op here — SURVEY §6)."""
+    x = _x(ins)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    if squeeze:
+        x = x[..., 0]
+    B, T = x.shape
+    seq_len = _opt(ins, "SeqLen")
+    valid = _mask(B, T, seq_len) if seq_len is not None \
+        else jnp.ones((B, T), bool)
+    token_list = list(attrs.get("tokens", []) or [])
+    if token_list:
+        tokens = jnp.asarray(token_list, x.dtype)
+        keep = valid & ~jnp.any(x[..., None] == tokens, axis=-1)
+    else:
+        keep = valid   # nothing to erase
+    pos = jnp.arange(T)[None, :]
+    # stable compaction: kept positions sort before dropped ones,
+    # original order preserved within each group
+    order = jnp.argsort(jnp.where(keep, pos, pos + T), axis=1)
+    out = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(pos < new_len[:, None], out, jnp.zeros_like(out))
+    if squeeze:
+        out = out[..., None]
+    return {"Out": [out], "OutLen": [new_len]}

@@ -239,3 +239,109 @@ def test_positive_negative_pair_weighted():
                {"Score": score, "Label": label, "QueryID": qid,
                 "Weight": weight})
     assert float(out["PositivePair"][0]) == 3.0   # mean(2, 4)
+
+
+def test_proximal_gd_and_adagrad():
+    from paddle_tpu.ops.registry import get_kernel, KernelCtx
+    rng = np.random.RandomState(0)
+    p = rng.randn(6).astype("float32")
+    g = rng.randn(6).astype("float32")
+    lr = np.array([0.1], "float32")
+    l1, l2 = 0.05, 0.01
+    out = get_kernel("proximal_gd")(
+        KernelCtx(None, True, None),
+        {"Param": [p], "Grad": [g], "LearningRate": [lr]},
+        {"l1": l1, "l2": l2})
+    prox = p - 0.1 * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) \
+        / (1 + 0.1 * l2)
+    np.testing.assert_allclose(out["ParamOut"][0], want, rtol=1e-5)
+
+    m = np.abs(rng.randn(6)).astype("float32")
+    out = get_kernel("proximal_adagrad")(
+        KernelCtx(None, True, None),
+        {"Param": [p], "Grad": [g], "Moment": [m], "LearningRate": [lr]},
+        {"l1": l1, "l2": l2})
+    m2 = m + g * g
+    prox = p - 0.1 * g / np.sqrt(m2)
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) \
+        / (1 + 0.1 * l2)
+    np.testing.assert_allclose(out["MomentOut"][0], m2, rtol=1e-5)
+    np.testing.assert_allclose(out["ParamOut"][0], want, rtol=1e-5)
+
+
+def test_precision_recall_vs_sklearn_style():
+    from paddle_tpu.ops.registry import get_kernel, KernelCtx
+    idx = np.array([0, 1, 2, 1, 0, 2, 1], "int32")[:, None]
+    lbl = np.array([0, 1, 1, 1, 2, 2, 0], "int32")[:, None]
+    out = get_kernel("precision_recall")(
+        KernelCtx(None, True, None),
+        {"Indices": [idx], "Labels": [lbl]}, {"class_number": 3})
+    bm = np.asarray(out["BatchMetrics"][0])
+    # manual per-class: tp=[1,2,1] fp=[1,1,1] fn=[1,1,1]
+    prec = np.array([1 / 2, 2 / 3, 1 / 2])
+    rec = np.array([1 / 2, 2 / 3, 1 / 2])
+    np.testing.assert_allclose(bm[0], prec.mean(), rtol=1e-5)
+    np.testing.assert_allclose(bm[1], rec.mean(), rtol=1e-5)
+    micro = 4 / 7
+    np.testing.assert_allclose(bm[3], micro, rtol=1e-5)
+    np.testing.assert_allclose(bm[4], micro, rtol=1e-5)
+    # carried states accumulate
+    out2 = get_kernel("precision_recall")(
+        KernelCtx(None, True, None),
+        {"Indices": [idx], "Labels": [lbl],
+         "StatesInfo": [out["AccumStatesInfo"][0]]}, {"class_number": 3})
+    np.testing.assert_allclose(np.asarray(out2["AccumStatesInfo"][0]),
+                               2 * np.asarray(out["AccumStatesInfo"][0]),
+                               rtol=1e-5)
+
+
+def test_sequence_erase_reference_example():
+    from paddle_tpu.ops.registry import get_kernel, KernelCtx
+    x = np.array([[2, 2, 6, 1, 3, 9, 6, 1, 0, 1]], "int32")
+    out = get_kernel("sequence_erase")(
+        KernelCtx(None, True, None), {"X": [x]}, {"tokens": [2, 3, 5]})
+    np.testing.assert_array_equal(
+        np.asarray(out["Out"][0])[0, :7], [6, 1, 9, 6, 1, 0, 1])
+    assert int(out["OutLen"][0][0]) == 7
+
+
+def test_mine_hard_examples_max_negative():
+    from paddle_tpu.ops.registry import get_kernel, KernelCtx
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.3, 0.7]], "float32")
+    match = np.array([[2, -1, -1, -1, -1]], "int32")   # 1 positive
+    dist = np.array([[0.9, 0.1, 0.2, 0.6, 0.1]], "float32")
+    out = get_kernel("mine_hard_examples")(
+        KernelCtx(None, True, None),
+        {"ClsLoss": [cls_loss], "MatchIndices": [match],
+         "MatchDist": [dist]},
+        {"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5,
+         "mining_type": "max_negative"})
+    mask = np.asarray(out["NegIndices"][0])[0]
+    # eligible: priors 1,2,4 (unmatched, dist<0.5); top-2 by loss: 1, 4
+    np.testing.assert_array_equal(mask, [0, 1, 0, 0, 1])
+
+
+def test_quantize_dequantize_roundtrip():
+    from paddle_tpu.ops.registry import get_kernel, KernelCtx
+    x = np.array([[-1.0, 0.5, 0.25, 1.0]], "float32")
+    q = get_kernel("quantize")(KernelCtx(None, True, None),
+                               {"Input": [x]},
+                               {"Scale": 127.0, "is_negative_input": True})
+    assert q["Output"][0].dtype == np.int8
+    deq = get_kernel("dequantize")(KernelCtx(None, True, None),
+                                   {"Input": [q["Output"][0]]},
+                                   {"Scale": 127.0})
+    np.testing.assert_allclose(np.asarray(deq["Output"][0]), x, atol=1e-2)
+    # default range is u8 [0,255] (ref is_negative_input=false)
+    qu = get_kernel("quantize")(KernelCtx(None, True, None),
+                                {"Input": [np.array([[1.5]], "float32")]},
+                                {"Scale": 170.0})
+    assert qu["Output"][0].dtype == np.uint8
+    assert int(qu["Output"][0][0, 0]) == 255
+    fd = get_kernel("fake_dequantize_max_abs")(
+        KernelCtx(None, True, None),
+        {"X": [np.array([[127.0, -64.0]], "float32")],
+         "Scale": [np.array([2.0], "float32")]}, {"max_range": 127.0})
+    np.testing.assert_allclose(np.asarray(fd["Out"][0]), [[2.0, -64 * 2 / 127]],
+                               rtol=1e-5)
