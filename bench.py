@@ -60,12 +60,15 @@ def _probe_tpu(timeout=120.0):
 
 
 def _init_backend():
-    """Initialize the JAX backend: probe TPU out-of-process (retry once);
+    """Initialize the JAX backend: probe TPU out-of-process (3 tries —
+    the relay has been observed to drop out for minutes at a time);
     fall back to CPU so a number always exists."""
     import os
     ok = _probe_tpu()
-    if not ok:
-        time.sleep(5.0)
+    for _ in range(2):
+        if ok:
+            break
+        time.sleep(15.0)
         ok = _probe_tpu()
     if not ok:
         # TPU unreachable — CPU fallback (honest: platform is reported)
