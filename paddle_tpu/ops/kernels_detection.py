@@ -954,9 +954,9 @@ def _mine_hard_examples(ctx, ins, attrs):
     TPU analog: NegIndices is returned as a [N, Np] 0/1 mask over priors
     (the reference emits a per-image LoD index list — data-dependent
     length), selected as the top-loss eligible negatives per image.
-    UpdatedMatchIndices keeps positives (this kernel mines negatives
-    only; the reference's hard_example demotion of unselected positives
-    is handled by callers via the mask)."""
+    For mining_type=hard_example, UpdatedMatchIndices demotes positives
+    that did not make the top-loss selection to background (-1), matching
+    the reference's SelectOutput path."""
     cls_loss = ins["ClsLoss"][0].astype(jnp.float32)       # [N, Np]
     match_idx = ins["MatchIndices"][0].astype(jnp.int32)
     match_dist = ins["MatchDist"][0].astype(jnp.float32) \
@@ -973,7 +973,15 @@ def _mine_hard_examples(ctx, ins, attrs):
         eligible = (match_idx == -1) & (match_dist < thr)
     n_eligible = jnp.sum(eligible, axis=1)
     if mining == "hard_example":
-        neg_sel = jnp.minimum(attrs.get("sample_size", 0), n_eligible)
+        sample_size = attrs.get("sample_size", 0)
+        if sample_size <= 0:
+            # with the top-0 selection every positive would be demoted
+            # to background — silent corruption; the reference requires
+            # a positive sample_size for hard_example mining too
+            raise ValueError(
+                "mine_hard_examples(mining_type='hard_example') needs "
+                f"sample_size > 0, got {sample_size}")
+        neg_sel = jnp.minimum(sample_size, n_eligible)
     else:
         num_pos = jnp.sum(match_idx != -1, axis=1)
         ratio = attrs.get("neg_pos_ratio", 3.0)
@@ -983,6 +991,15 @@ def _mine_hard_examples(ctx, ins, attrs):
     order = jnp.argsort(-score, axis=1)
     rank = jax.vmap(lambda o: jnp.zeros(o.shape[0], jnp.int32).at[o].set(
         jnp.arange(o.shape[0], dtype=jnp.int32)))(order)
-    neg_mask = (rank < neg_sel[:, None]) & eligible
+    sel = (rank < neg_sel[:, None]) & eligible
+    if mining == "hard_example":
+        # hard_example ranks ALL priors: selected negatives become the
+        # mined set; positives outside the selection are demoted to
+        # background so their loc/cls losses drop out of training
+        neg_mask = sel & (match_idx == -1)
+        updated = jnp.where((match_idx != -1) & ~sel, -1, match_idx)
+    else:
+        neg_mask = sel
+        updated = match_idx
     return {"NegIndices": [neg_mask.astype(jnp.int32)],
-            "UpdatedMatchIndices": [match_idx]}
+            "UpdatedMatchIndices": [updated]}

@@ -166,18 +166,24 @@ def _bn_train_fwd(x, scale, bias, shift, red_axes, eps):
 
 def _bn_train_bwd(red_axes, eps, res, cts):
     x, scale, bm, bv, n = res
-    dy = cts[0]  # bm/bv cotangents are zero on any loss path (the
-    #              moving-stat updates are not differentiated)
+    dy, dbm_ct, dbv_ct = cts
     bshape = tuple(x.shape[i] if i not in red_axes else 1
                    for i in range(x.ndim))
     dyf = dy.astype(jnp.float32)
     xf = x.astype(jnp.float32)
     r = jax.lax.rsqrt(bv + eps).reshape(bshape)
-    xhat = (xf - bm.reshape(bshape)) * r
+    xc = xf - bm.reshape(bshape)
+    xhat = xc * r
     dbeta = jnp.sum(dyf, axis=red_axes)
     dgamma = jnp.sum(dyf * xhat, axis=red_axes)
     dx = (scale.reshape(bshape) * r / n) * (
         n * dyf - dbeta.reshape(bshape) - xhat * dgamma.reshape(bshape))
+    # direct cotangents through the batch-stat outputs (bm = mean(x),
+    # d bm/dx = 1/n; bv = E[(x-bm)^2], d bv/dx = 2(x-bm)/n): zero arrays
+    # on the usual loss path, and the broadcasts fuse into dx's existing
+    # elementwise pass, so the common case costs nothing extra
+    dx = dx + (dbm_ct.astype(jnp.float32).reshape(bshape)
+               + 2.0 * dbv_ct.astype(jnp.float32).reshape(bshape) * xc) / n
     return (dx.astype(x.dtype), dgamma.astype(scale.dtype),
             dbeta.astype(scale.dtype),
             jnp.zeros(bshape, x.dtype))
