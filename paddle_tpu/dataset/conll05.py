@@ -4,10 +4,24 @@ Samples are the reference's 9 slots per (sentence, predicate) pair:
 (word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark,
 label_idx) — the five context slots are the predicate window broadcast
 over the sentence, mark flags the window, labels are BIO SRL tags.
+
+When the conll05st-tests.tar.gz archive (with the standard
+conll05st-release/test.wsj/words|props .gz members) plus
+wordDict.txt/verbDict.txt/targetDict.txt are in the dataset cache, the
+real parser reads the words file (one token per line, blank line per
+sentence) zipped against the props file (column 0 = predicate lemma or
+'-', one bracket-tag column per predicate: '(A0*', '*', '*)' ...), and
+converts bracket spans to B-/I-/O tags exactly like the reference.
 Synthetic fallback: role labels correlate with position relative to the
 predicate so an SRL tagger can actually learn.
 """
+import gzip
+import os
+import tarfile
+
 import numpy as np
+
+from . import common
 
 __all__ = ["test", "get_dict", "get_embedding"]
 
@@ -19,9 +33,128 @@ _LABELS = ["O", "B-V"] + [f"{bi}-{r}" for r in _ROLES for bi in ("B", "I")]
 LABEL_DICT_LEN = len(_LABELS)
 UNK_IDX = 0
 
+_ARCHIVE = "conll05st-tests.tar.gz"
+_WORDS = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+_PROPS = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+
+
+def _cache(*names):
+    paths = [common.data_path("conll05st", n) for n in names]
+    return paths if all(os.path.exists(p) for p in paths) else None
+
+
+def load_dict(path):
+    with open(path) as f:
+        return {line.strip(): i for i, line in enumerate(f)}
+
+
+def load_label_dict(path):
+    """targetDict.txt lists B-*/I-* tags; ids pair Bs and Is per tag
+    with O last (ref conll05.py load_label_dict)."""
+    tags = set()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith(("B-", "I-")):
+                tags.add(line[2:])
+    d = {}
+    for tag in sorted(tags):  # deterministic ids across processes
+        d["B-" + tag] = len(d)
+        d["I-" + tag] = len(d)
+    d["O"] = len(d)
+    return d
+
+
+def _props_to_bio(col):
+    """One props bracket column → BIO tag sequence."""
+    out = []
+    cur, inside = "O", False
+    for tok in col:
+        if tok == "*":
+            out.append("I-" + cur if inside else "O")
+        elif tok == "*)":
+            out.append("I-" + cur)
+            inside = False
+        elif "(" in tok and ")" in tok:
+            cur = tok[1:tok.find("*")]
+            out.append("B-" + cur)
+            inside = False
+        elif "(" in tok:
+            cur = tok[1:tok.find("*")]
+            out.append("B-" + cur)
+            inside = True
+        else:
+            raise RuntimeError(f"unexpected props label {tok!r}")
+    return out
+
+
+def corpus_reader(data_path, words_name=_WORDS, props_name=_PROPS):
+    """Yield (sentence words, predicate lemma, BIO labels) per
+    (sentence, predicate) pair from the words/props gz pair."""
+
+    def reader():
+        with tarfile.open(data_path) as tf, \
+                gzip.GzipFile(fileobj=tf.extractfile(words_name)) as wf, \
+                gzip.GzipFile(fileobj=tf.extractfile(props_name)) as pf:
+            words, prop_rows = [], []
+            for wline, pline in zip(wf, pf):
+                word = wline.strip().decode("utf-8", "ignore")
+                cols = pline.strip().decode("utf-8", "ignore").split()
+                if not cols:  # blank line = end of sentence
+                    if prop_rows:
+                        lemmas = [r[0] for r in prop_rows]
+                        verbs = [l for l in lemmas if l != "-"]
+                        n_pred = len(prop_rows[0]) - 1
+                        for i in range(n_pred):
+                            col = [r[1 + i] for r in prop_rows]
+                            yield words, verbs[i], _props_to_bio(col)
+                    words, prop_rows = [], []
+                else:
+                    words.append(word)
+                    prop_rows.append(cols)
+    return reader
+
+
+def reader_creator(corpus, word_dict, predicate_dict, label_dict):
+    """9-slot transform (ref conll05.py reader_creator): predicate
+    window of ±2 words broadcast over the sentence + mark flags."""
+
+    def reader():
+        for sentence, predicate, labels in corpus():
+            sen_len = len(sentence)
+            verb_index = labels.index("B-V")
+            mark = [0] * sen_len
+
+            def ctx(off, default):
+                j = verb_index + off
+                if 0 <= j < sen_len:
+                    mark[j] = 1
+                    return sentence[j]
+                return default
+
+            ctx_n2 = ctx(-2, "bos")
+            ctx_n1 = ctx(-1, "bos")
+            ctx_0 = ctx(0, "bos")
+            ctx_p1 = ctx(1, "eos")
+            ctx_p2 = ctx(2, "eos")
+
+            def wids(w):
+                return [word_dict.get(w, UNK_IDX)] * sen_len
+
+            yield ([word_dict.get(w, UNK_IDX) for w in sentence],
+                   wids(ctx_n2), wids(ctx_n1), wids(ctx_0),
+                   wids(ctx_p1), wids(ctx_p2),
+                   [predicate_dict.get(predicate, 0)] * sen_len, mark,
+                   [label_dict.get(l, label_dict["O"]) for l in labels])
+    return reader
+
 
 def get_dict():
     """(word_dict, verb_dict, label_dict) — name → id."""
+    cached = _cache("wordDict.txt", "verbDict.txt", "targetDict.txt")
+    if cached:
+        return (load_dict(cached[0]), load_dict(cached[1]),
+                load_label_dict(cached[2]))
     word_dict = {f"w{i}": i for i in range(WORD_DICT_LEN)}
     verb_dict = {f"v{i}": i for i in range(PRED_DICT_LEN)}
     label_dict = {l: i for i, l in enumerate(_LABELS)}
@@ -79,6 +212,15 @@ def _synthetic(n, seed):
 
 
 def test(n_synthetic=256):
+    # the real path needs the archive AND the three dict files (separate
+    # downloads in the reference) — with synthetic dicts every real word
+    # would silently map to UNK
+    cached = _cache(_ARCHIVE, "wordDict.txt", "verbDict.txt",
+                    "targetDict.txt")
+    if cached:
+        word_dict, verb_dict, label_dict = get_dict()
+        return reader_creator(corpus_reader(cached[0]), word_dict,
+                              verb_dict, label_dict)
     return _synthetic(n_synthetic, seed=1)
 
 

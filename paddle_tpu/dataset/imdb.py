@@ -1,18 +1,79 @@
 """IMDB sentiment dataset (ref python/paddle/dataset/imdb.py).
 
-Samples: (word-id list, label 0/1). Synthetic fallback: two vocab
+Samples: (word-id list, label) with the reference's label convention
+(pos=0, neg=1). When the aclImdb_v1.tar.gz archive is present in the
+dataset cache, the real parser streams the tarball sequentially
+(aclImdb/{train,test}/{pos,neg}/*.txt members), tokenizes each review
+(punctuation stripped, lowercased, whitespace split — ref imdb.py
+tokenize()), and builds the frequency-sorted word dict with the
+reference's cutoff semantics. Synthetic fallback otherwise: two vocab
 distributions (positive ids skew low, negative skew high) so sentiment
-models can actually learn.
+models can actually learn offline.
 """
+import os
+import re
+import string
+import tarfile
+
 import numpy as np
 
-__all__ = ["train", "test", "word_dict"]
+from . import common
+
+__all__ = ["build_dict", "train", "test", "word_dict"]
 
 _VOCAB = 5147  # matches ref default vocab cutoff order of magnitude
+_ARCHIVE = "aclImdb_v1.tar.gz"
+_PUNCT = str.maketrans("", "", string.punctuation)
 
 
-def word_dict():
-    return {f"w{i}": i for i in range(_VOCAB)}
+def _archive_path():
+    p = common.data_path("imdb", _ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def tokenize(pattern, path=None):
+    """Stream reviews whose member name matches `pattern` from the
+    aclImdb tarball; yields token lists. Sequential tar access (next()),
+    matching the reference's streaming read."""
+    path = path or _archive_path()
+    with tarfile.open(path) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if pattern.match(tf.name):
+                text = tarf.extractfile(tf).read().decode(
+                    "utf-8", errors="ignore")
+                yield (text.rstrip("\n\r").translate(_PUNCT)
+                       .lower().split())
+            tf = tarf.next()
+
+
+def build_dict(pattern, cutoff, path=None):
+    """Frequency dict over tokenized reviews; words with freq > cutoff
+    get ids ordered by (-freq, word); '<unk>' is the last id."""
+    word_freq = {}
+    for doc in tokenize(pattern, path):
+        for w in doc:
+            word_freq[w] = word_freq.get(w, 0) + 1
+    items = [x for x in word_freq.items() if x[1] > cutoff]
+    items.sort(key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _real_reader(pos_pattern, neg_pattern, word_idx, path):
+    UNK = word_idx["<unk>"]
+
+    def load(pattern, label):
+        return [([word_idx.get(w, UNK) for w in doc], label)
+                for doc in tokenize(pattern, path)]
+
+    ins = load(pos_pattern, 0) + load(neg_pattern, 1)
+
+    def reader():
+        for doc, label in ins:
+            yield doc, label
+    return reader
 
 
 def _synthetic(n, seed):
@@ -30,9 +91,28 @@ def _synthetic(n, seed):
     return reader
 
 
+def word_dict(cutoff=150):
+    path = _archive_path()
+    if path:
+        return build_dict(
+            re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
+            cutoff, path)
+    return {f"w{i}": i for i in range(_VOCAB - 1)} | {"<unk>": _VOCAB - 1}
+
+
 def train(word_idx=None, n_synthetic=1024):
+    path = _archive_path()
+    if path and word_idx:
+        return _real_reader(re.compile(r"aclImdb/train/pos/.*\.txt$"),
+                            re.compile(r"aclImdb/train/neg/.*\.txt$"),
+                            word_idx, path)
     return _synthetic(n_synthetic, seed=0)
 
 
 def test(word_idx=None, n_synthetic=256):
+    path = _archive_path()
+    if path and word_idx:
+        return _real_reader(re.compile(r"aclImdb/test/pos/.*\.txt$"),
+                            re.compile(r"aclImdb/test/neg/.*\.txt$"),
+                            word_idx, path)
     return _synthetic(n_synthetic, seed=1)

@@ -1,17 +1,95 @@
 """PTB-style LM dataset (ref python/paddle/dataset/imikolov.py).
 
-Samples: n-gram tuples of word ids. Synthetic fallback: a Markov chain
-with deterministic transition structure (learnable next-word signal).
+Samples: n-gram tuples of word ids (DataType.NGRAM) or
+(src_seq, trg_seq) pairs with <s>/<e> wrapping (DataType.SEQ). When the
+simple-examples.tgz archive is in the dataset cache, the real parser
+reads ./simple-examples/data/ptb.{train,valid}.txt from the tarball,
+builds the frequency dict with the reference's min_word_freq cutoff
+('<unk>' last), and yields the reference's exact n-gram / seq layouts.
+Synthetic fallback: a Markov chain with deterministic transition
+structure (learnable next-word signal).
 """
+import os
+import tarfile
+
 import numpy as np
 
-__all__ = ["train", "test", "build_dict"]
+from . import common
+
+__all__ = ["train", "test", "build_dict", "DataType"]
 
 _VOCAB = 2048
+_ARCHIVE = "simple-examples.tgz"
+_TRAIN = "./simple-examples/data/ptb.train.txt"
+_VALID = "./simple-examples/data/ptb.valid.txt"
+
+
+class DataType(object):
+    NGRAM = 1
+    SEQ = 2
+
+
+def _archive_path():
+    p = common.data_path("imikolov", _ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def word_count(f, word_freq=None):
+    """Count words in an open (binary) file; each line also counts one
+    <s> and one <e> (ref imikolov.py word_count)."""
+    if word_freq is None:
+        word_freq = {}
+    for line in f:
+        for w in line.strip().split():
+            w = w.decode() if isinstance(w, bytes) else w
+            word_freq[w] = word_freq.get(w, 0) + 1
+        word_freq["<s>"] = word_freq.get("<s>", 0) + 1
+        word_freq["<e>"] = word_freq.get("<e>", 0) + 1
+    return word_freq
 
 
 def build_dict(min_word_freq=50):
-    return {f"w{i}": i for i in range(_VOCAB)}
+    path = _archive_path()
+    if not path:
+        return {f"w{i}": i for i in range(_VOCAB)}
+    with tarfile.open(path) as tf:
+        freq = word_count(tf.extractfile(_VALID),
+                          word_count(tf.extractfile(_TRAIN)))
+    freq.pop("<unk>", None)  # re-added as the last index
+    items = [x for x in freq.items() if x[1] > min_word_freq]
+    items.sort(key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _real_reader(filename, word_idx, n, data_type):
+    path = _archive_path()
+
+    def reader():
+        with tarfile.open(path) as tf:
+            f = tf.extractfile(filename)
+            UNK = word_idx["<unk>"]
+            for line in f:
+                line = line.decode() if isinstance(line, bytes) else line
+                if data_type == DataType.NGRAM:
+                    assert n > -1, "Invalid gram length"
+                    words = ["<s>"] + line.strip().split() + ["<e>"]
+                    if len(words) >= n:
+                        ids = [word_idx.get(w, UNK) for w in words]
+                        for i in range(n, len(ids) + 1):
+                            yield tuple(ids[i - n:i])
+                elif data_type == DataType.SEQ:
+                    ids = [word_idx.get(w, UNK)
+                           for w in line.strip().split()]
+                    src_seq = [word_idx["<s>"]] + ids
+                    trg_seq = ids + [word_idx["<e>"]]
+                    if n > 0 and len(src_seq) > n:
+                        continue
+                    yield src_seq, trg_seq
+                else:
+                    raise ValueError(f"unknown data type {data_type}")
+    return reader
 
 
 def _synthetic(n, window, seed):
@@ -28,9 +106,13 @@ def _synthetic(n, window, seed):
     return reader
 
 
-def train(word_idx=None, n=5, n_synthetic=2048):
+def train(word_idx=None, n=5, data_type=DataType.NGRAM, n_synthetic=2048):
+    if _archive_path() and word_idx:
+        return _real_reader(_TRAIN, word_idx, n, data_type)
     return _synthetic(n_synthetic, n, seed=0)
 
 
-def test(word_idx=None, n=5, n_synthetic=512):
+def test(word_idx=None, n=5, data_type=DataType.NGRAM, n_synthetic=512):
+    if _archive_path() and word_idx:
+        return _real_reader(_VALID, word_idx, n, data_type)
     return _synthetic(n_synthetic, n, seed=1)

@@ -35,8 +35,17 @@ def _synthetic(n, seed):
 def _idx_reader(img_path, lbl_path):
     def reader():
         with gzip.open(img_path, "rb") as fi, gzip.open(lbl_path, "rb") as fl:
-            fi.read(16)
-            fl.read(8)
+            # IDX headers (ref mnist.py reader_creator): images
+            # magic 2051 + count + rows + cols, labels magic 2049 + count
+            magic_i, n_i, rows, cols = struct.unpack(">IIII", fi.read(16))
+            magic_l, n_l = struct.unpack(">II", fl.read(8))
+            if magic_i != 2051 or magic_l != 2049:
+                raise ValueError(
+                    f"bad IDX magic: images={magic_i} labels={magic_l}")
+            if n_i != n_l:
+                raise ValueError(f"image/label count mismatch {n_i}/{n_l}")
+            if rows * cols != _IMG:
+                raise ValueError(f"unexpected image size {rows}x{cols}")
             while True:
                 lbl = fl.read(1)
                 if not lbl:

@@ -1,27 +1,108 @@
 """MovieLens CTR dataset (ref python/paddle/dataset/movielens.py).
 
-Samples: (user_id, gender, age, job, movie_id, category, score). The
-synthetic fallback generates preference structure (score correlates with
-user/movie id buckets) so ranking models can learn.
+Samples: ([user_id], [gender], [age_index], [job], [movie_id], [score]).
+When the ml-1m.zip archive is present in the dataset cache, the real
+parser reads ml-1m/{users,movies,ratings}.dat ('::'-separated, latin-1
+— the GroupLens format the reference downloads), maps gender M/F → 0/1
+and raw age → its age_table index, and splits train/test per rating
+with the reference's seeded uniform(0,1) < test_ratio rule.
+Synthetic fallback: preference structure (score correlates with
+user/movie id buckets) so ranking models can learn offline.
 """
+import os
+import zipfile
+
 import numpy as np
 
+from . import common
+
 __all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
-           "age_table"]
+           "age_table", "movie_categories", "get_movie_title_dict"]
 
 _USERS, _MOVIES, _JOBS = 6040, 3952, 21
 age_table = [1, 18, 25, 35, 45, 50, 56]
 
+_ARCHIVE = "ml-1m.zip"
+_meta = None
+
+
+def _archive_path():
+    p = common.data_path("movielens", _ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def _load_meta():
+    """Parse users.dat + movies.dat once; returns (users, movies,
+    categories, title_words) with users[uid] = (gender01, age_idx, job)."""
+    global _meta
+    if _meta is not None:
+        return _meta
+    path = _archive_path()
+    users, movies, categories, title_words = {}, {}, {}, {}
+    with zipfile.ZipFile(path) as z:
+        with z.open("ml-1m/users.dat") as f:
+            for line in f.read().decode("latin-1").splitlines():
+                uid, gender, age, job, _zip = line.strip().split("::")
+                users[int(uid)] = (0 if gender == "M" else 1,
+                                   age_table.index(int(age)), int(job))
+        with z.open("ml-1m/movies.dat") as f:
+            for line in f.read().decode("latin-1").splitlines():
+                mid, title, cats = line.strip().split("::")
+                for c in cats.split("|"):
+                    categories.setdefault(c, len(categories))
+                for w in title.split():
+                    title_words.setdefault(w.lower(), len(title_words))
+                movies[int(mid)] = (title, cats.split("|"))
+    _meta = (users, movies, categories, title_words)
+    return _meta
+
+
+def _real_reader(is_test, test_ratio=0.1, rand_seed=0):
+    users, _, _, _ = _load_meta()
+    path = _archive_path()
+
+    def reader():
+        rng = np.random.RandomState(rand_seed)
+        with zipfile.ZipFile(path) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f.read().decode("latin-1").splitlines():
+                    uid, mid, rating, _ts = line.strip().split("::")
+                    if (rng.uniform() < test_ratio) != is_test:
+                        continue
+                    u = int(uid)
+                    gender, age_idx, job = users[u]
+                    yield ([u], [gender], [age_idx], [job],
+                           [int(mid)], [float(rating)])
+    return reader
+
+
+def movie_categories():
+    if _archive_path():
+        return _load_meta()[2]
+    return {f"cat{i}": i for i in range(18)}
+
+
+def get_movie_title_dict():
+    if _archive_path():
+        return _load_meta()[3]
+    return {f"t{i}": i for i in range(512)}
+
 
 def max_user_id():
+    if _archive_path():
+        return max(_load_meta()[0])
     return _USERS
 
 
 def max_movie_id():
+    if _archive_path():
+        return max(_load_meta()[1])
     return _MOVIES
 
 
 def max_job_id():
+    if _archive_path():
+        return max(j for _, _, j in _load_meta()[0].values())
     return _JOBS - 1
 
 
@@ -42,8 +123,12 @@ def _synthetic(n, seed):
 
 
 def train(n_synthetic=2048):
+    if _archive_path():
+        return _real_reader(is_test=False)
     return _synthetic(n_synthetic, seed=0)
 
 
 def test(n_synthetic=512):
+    if _archive_path():
+        return _real_reader(is_test=True)
     return _synthetic(n_synthetic, seed=1)

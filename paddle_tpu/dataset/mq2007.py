@@ -5,12 +5,19 @@ Three sample formats, matching the reference generators:
 - pairwise:  (d_high [46], d_low [46]) with rel(high) > rel(low)
 - listwise:  (label_list, feature_list) per query
 
-Synthetic fallback: relevance is a noisy linear function of the
-features, so rankers can fit offline.
+When train.txt/test.txt in the LETOR 4.0 line format
+(``rel qid:N 1:v 2:v ... 46:v #docid = ...``) are present in the
+dataset cache, the real parser groups lines by query id and feeds the
+same three generators. Synthetic fallback: relevance is a noisy linear
+function of the features, so rankers can fit offline.
 """
+import os
+
 import numpy as np
 
-__all__ = ["train", "test"]
+from . import common
+
+__all__ = ["train", "test", "FEATURE_DIM"]
 
 FEATURE_DIM = 46
 _W = None
@@ -23,7 +30,43 @@ def _weights():
     return _W
 
 
-def _queries(n_queries, seed):
+def parse_letor_line(text):
+    """One LETOR line → (rel int, qid int, feats float32[46]); the
+    '#'-comment tail (docid etc.) is ignored."""
+    head = text.split("#", 1)[0].strip()
+    parts = head.split()
+    rel = int(parts[0])
+    qid = int(parts[1].split(":")[1])
+    feats = np.zeros(FEATURE_DIM, dtype="float32")
+    for p in parts[2:]:
+        k, v = p.split(":")
+        idx = int(k) - 1
+        if 0 <= idx < FEATURE_DIM:
+            feats[idx] = float(v)
+    return rel, qid, feats
+
+
+def _parse_file(path):
+    """Group a LETOR file by query id (file order preserved); returns
+    [(rel int64[n_docs], feats float32[n_docs, 46])]."""
+    queries = {}
+    order = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rel, qid, feats = parse_letor_line(line)
+            if qid not in queries:
+                queries[qid] = ([], [])
+                order.append(qid)
+            queries[qid][0].append(rel)
+            queries[qid][1].append(feats)
+    return [(np.asarray(queries[q][0], dtype="int64"),
+             np.stack(queries[q][1]).astype("float32")) for q in order]
+
+
+def _queries_synthetic(n_queries, seed):
     rng = np.random.RandomState(seed)
     w = _weights()
     out = []
@@ -36,10 +79,7 @@ def _queries(n_queries, seed):
     return out
 
 
-def _reader(n_queries, seed, format):
-    qs = _queries(n_queries, seed)
-    rng = np.random.RandomState(seed + 99)
-
+def _reader(qs, format):
     def pointwise():
         for rel, feats in qs:
             for r, f in zip(rel, feats):
@@ -61,9 +101,16 @@ def _reader(n_queries, seed, format):
             "listwise": listwise}[format]
 
 
+def _make(fname, format, n_queries, seed):
+    p = common.data_path("mq2007", fname)
+    if os.path.exists(p):
+        return _reader(_parse_file(p), format)
+    return _reader(_queries_synthetic(n_queries, seed), format)
+
+
 def train(format="pairwise", n_queries=64):
-    return _reader(n_queries, seed=0, format=format)
+    return _make("train.txt", format, n_queries, seed=0)
 
 
 def test(format="pairwise", n_queries=16):
-    return _reader(n_queries, seed=1, format=format)
+    return _make("test.txt", format, n_queries, seed=1)

@@ -28,9 +28,13 @@ def _synthetic(n, seed):
 
 
 def _file_reader(path, start, end):
+    # whitespace-separated 14-column UCI table; the reference normalizes
+    # each feature to (x - mean) / (max - min) over the WHOLE file
+    # before the 80/20 split (ref uci_housing.py load_data)
     data = np.loadtxt(path)
-    mx, mn = data[:, :-1].max(0), data[:, :-1].min(0)
-    feats = (data[:, :-1] - mn) / np.maximum(mx - mn, 1e-6)
+    mx, mn, avg = (data[:, :-1].max(0), data[:, :-1].min(0),
+                   data[:, :-1].mean(0))
+    feats = (data[:, :-1] - avg) / np.maximum(mx - mn, 1e-6)
 
     def reader():
         for i in range(start, min(end, len(data))):

@@ -1,18 +1,95 @@
 """WMT16-style NMT dataset (ref python/paddle/dataset/wmt16.py).
 
-Samples: (src ids, trg ids, trg_next ids). Synthetic fallback: a
-deterministic "translation" (trg = reversed src shifted by vocab offset)
-— a real learnable seq2seq mapping for Transformer convergence tests.
+Samples: (src ids, trg ids, trg_next ids) with <s>=0, <e>=1, <unk>=2.
+When the wmt16.tar.gz archive is in the dataset cache, the real parser
+reads the 'wmt16/{train,val,test}' members (one "en\tde" tokenized
+sentence pair per line — the format the reference downloads), builds
+frequency-capped dicts per language, and yields the reference's exact
+slot layout (trg wrapped with BOS, trg_next with EOS). Synthetic
+fallback: a deterministic "translation" (trg = reversed src shifted by
+vocab offset) — a real learnable seq2seq mapping for Transformer
+convergence tests.
 """
+import os
+import tarfile
+
 import numpy as np
 
-__all__ = ["train", "test", "get_dict"]
+from . import common
+
+__all__ = ["train", "test", "validation", "get_dict"]
 
 BOS, EOS, UNK = 0, 1, 2
+_BOS_MARK, _EOS_MARK, _UNK_MARK = "<s>", "<e>", "<unk>"
+_ARCHIVE = "wmt16.tar.gz"
 
 
-def get_dict(lang="en", dict_size=10000):
-    return {f"{lang}{i}": i for i in range(dict_size)}
+def _archive_path():
+    p = common.data_path("wmt16", _ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+_dict_cache = {}
+
+
+def _build_dict(lang, dict_size):
+    """Frequency dict over the train member for `lang` ('en' = column 0,
+    'de' = column 1); ids 0/1/2 are <s>/<e>/<unk>. Memoized — building
+    is a full decompress+tokenize pass over the corpus."""
+    key = (lang, dict_size, _archive_path())
+    if key in _dict_cache:
+        return _dict_cache[key]
+    freq = {}
+    with tarfile.open(_archive_path()) as tf:
+        for line in tf.extractfile("wmt16/train"):
+            parts = line.decode("utf-8", "ignore").strip().split("\t")
+            if len(parts) != 2:
+                continue
+            for w in parts[0 if lang == "en" else 1].split():
+                freq[w] = freq.get(w, 0) + 1
+    items = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
+    word_idx = {_BOS_MARK: BOS, _EOS_MARK: EOS, _UNK_MARK: UNK}
+    for w, _ in items:
+        if len(word_idx) >= dict_size:
+            break
+        if w not in word_idx:  # corpus may contain literal <s>/<e>/<unk>
+            word_idx[w] = len(word_idx)
+    _dict_cache[key] = word_idx
+    return word_idx
+
+
+def get_dict(lang="en", dict_size=10000, reverse=False):
+    if _archive_path():
+        d = _build_dict(lang, dict_size)
+    else:
+        d = {_BOS_MARK: BOS, _EOS_MARK: EOS, _UNK_MARK: UNK}
+        d.update({f"{lang}{i}": i + 3 for i in range(dict_size - 3)})
+    if reverse:
+        return {i: w for w, i in d.items()}
+    return d
+
+
+def _real_reader(member, src_dict_size, trg_dict_size, src_lang="en"):
+    path = _archive_path()
+    src_dict = _build_dict(src_lang, src_dict_size)
+    trg_lang = "de" if src_lang == "en" else "en"
+    trg_dict = _build_dict(trg_lang, trg_dict_size)
+    src_col = 0 if src_lang == "en" else 1
+
+    def reader():
+        with tarfile.open(path) as tf:
+            for line in tf.extractfile(member):
+                parts = line.decode("utf-8", "ignore").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [src_dict.get(w, UNK)
+                           for w in parts[src_col].split()]
+                trg_core = [trg_dict.get(w, UNK)
+                            for w in parts[1 - src_col].split()]
+                trg_ids = [BOS] + trg_core
+                trg_ids_next = trg_core + [EOS]
+                yield src_ids, trg_ids, trg_ids_next
+    return reader
 
 
 def _synthetic(n, src_vocab, trg_vocab, seed, max_len=24):
@@ -29,11 +106,25 @@ def _synthetic(n, src_vocab, trg_vocab, seed, max_len=24):
     return reader
 
 
-def train(src_dict_size=10000, trg_dict_size=10000, tag=None,
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en",
           n_synthetic=2048):
+    if _archive_path():
+        return _real_reader("wmt16/train", src_dict_size, trg_dict_size,
+                            src_lang)
     return _synthetic(n_synthetic, src_dict_size, trg_dict_size, seed=0)
 
 
-def test(src_dict_size=10000, trg_dict_size=10000, tag=None,
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en",
          n_synthetic=256):
+    if _archive_path():
+        return _real_reader("wmt16/test", src_dict_size, trg_dict_size,
+                            src_lang)
     return _synthetic(n_synthetic, src_dict_size, trg_dict_size, seed=1)
+
+
+def validation(src_dict_size=10000, trg_dict_size=10000, src_lang="en",
+               n_synthetic=256):
+    if _archive_path():
+        return _real_reader("wmt16/val", src_dict_size, trg_dict_size,
+                            src_lang)
+    return _synthetic(n_synthetic, src_dict_size, trg_dict_size, seed=2)

@@ -1,0 +1,381 @@
+"""Real-format dataset parsers against format-faithful fixture files
+(VERDICT r2 item 4): each test writes the reference's on-disk format
+(IDX gz, aclImdb tar, PTB tgz, ml-1m zip, LETOR txt, UCI table, CIFAR
+pickle tar.gz, WMT16 tsv tar, CoNLL05 words/props gz tar) into a tmp
+dataset cache and asserts the module's REAL parser reads it correctly.
+The synthetic fallbacks remain for the no-cache path (zero egress)."""
+import gzip
+import io
+import os
+import pickle
+import struct
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def data_home(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    return tmp_path
+
+
+def _add_tar_member(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+class TestMnistIdx:
+    def _write_idx(self, home, prefix, images, labels):
+        d = home / "mnist"
+        d.mkdir(exist_ok=True)
+        n = len(labels)
+        with gzip.open(d / f"{prefix}-images-idx3-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(images.astype(np.uint8).tobytes())
+        with gzip.open(d / f"{prefix}-labels-idx1-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(bytes(labels))
+
+    def test_parses_idx_gz(self, data_home):
+        from paddle_tpu.dataset import mnist
+        rng = np.random.RandomState(0)
+        images = rng.randint(0, 256, (3, 784), dtype=np.uint8)
+        labels = [7, 1, 4]
+        self._write_idx(data_home, "train-images-idx3-ubyte.gz"[:5]
+                        and "train", images, labels)
+        samples = list(mnist.train()())
+        assert len(samples) == 3
+        for (img, lbl), want_img, want_lbl in zip(samples, images, labels):
+            assert lbl == want_lbl
+            np.testing.assert_allclose(
+                img, want_img.astype("float32") / 127.5 - 1.0, rtol=1e-6)
+
+    def test_rejects_bad_magic(self, data_home):
+        from paddle_tpu.dataset import mnist
+        d = data_home / "mnist"
+        d.mkdir()
+        with gzip.open(d / "train-images-idx3-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">IIII", 1234, 1, 28, 28) + b"\0" * 784)
+        with gzip.open(d / "train-labels-idx1-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">II", 2049, 1) + b"\3")
+        with pytest.raises(ValueError, match="magic"):
+            list(mnist.train()())
+
+
+class TestImdbTar:
+    REVIEWS = {
+        "aclImdb/train/pos/0_9.txt": b"A great, GREAT movie!!",
+        "aclImdb/train/pos/1_8.txt": b"great acting; great fun",
+        "aclImdb/train/neg/0_2.txt": b"terrible movie. terrible!",
+        "aclImdb/test/pos/0_10.txt": b"great",
+        "aclImdb/test/neg/0_1.txt": b"boring terrible mess",
+    }
+
+    def _write(self, home):
+        d = home / "imdb"
+        d.mkdir()
+        with tarfile.open(d / "aclImdb_v1.tar.gz", "w:gz") as tf:
+            for name, data in self.REVIEWS.items():
+                _add_tar_member(tf, name, data)
+
+    def test_tokenize_and_labels(self, data_home):
+        from paddle_tpu.dataset import imdb
+        self._write(data_home)
+        wd = imdb.word_dict(cutoff=0)
+        # punctuation stripped + lowercased: "great" dominates
+        assert "great" in wd and "movie" in wd
+        assert "<unk>" in wd
+        assert wd["great"] == 0  # most frequent -> id 0
+        samples = list(imdb.train(wd)())
+        assert len(samples) == 3
+        # reference convention: pos label 0 first, then neg label 1
+        labels = [l for _, l in samples]
+        assert labels == [0, 0, 1]
+        ids, lbl = samples[0]
+        assert ids[0] == wd["a"] and ids[1] == wd["great"]
+
+    def test_unknown_words_map_to_unk(self, data_home):
+        from paddle_tpu.dataset import imdb
+        self._write(data_home)
+        wd = {"great": 0, "<unk>": 1}
+        doc, label = next(iter(imdb.test(wd)()))
+        assert label == 0
+        assert doc == [0]  # "great"
+
+
+class TestImikolovTgz:
+    TRAIN = b"the cat sat\nthe cat ran\nthe dog sat\n"
+    VALID = b"the cat sat\n"
+
+    def _write(self, home):
+        d = home / "imikolov"
+        d.mkdir()
+        with tarfile.open(d / "simple-examples.tgz", "w:gz") as tf:
+            _add_tar_member(tf, "./simple-examples/data/ptb.train.txt",
+                            self.TRAIN)
+            _add_tar_member(tf, "./simple-examples/data/ptb.valid.txt",
+                            self.VALID)
+
+    def test_build_dict_and_ngrams(self, data_home):
+        from paddle_tpu.dataset import imikolov
+        self._write(data_home)
+        wd = imikolov.build_dict(min_word_freq=0)
+        # 'the' most frequent after the per-line <s>/<e> counts
+        assert set(wd) == {"the", "cat", "sat", "ran", "dog", "<s>",
+                           "<e>", "<unk>"}
+        assert wd["<unk>"] == len(wd) - 1
+        grams = list(imikolov.train(wd, n=2)())
+        # first line "the cat sat" -> (<s>,the),(the,cat),(cat,sat),(sat,<e>)
+        assert grams[0] == (wd["<s>"], wd["the"])
+        assert grams[1] == (wd["the"], wd["cat"])
+        assert len(grams) == 3 * 4
+
+    def test_seq_mode(self, data_home):
+        from paddle_tpu.dataset import imikolov
+        self._write(data_home)
+        wd = imikolov.build_dict(min_word_freq=0)
+        src, trg = next(iter(imikolov.test(
+            wd, n=-1, data_type=imikolov.DataType.SEQ)()))
+        assert src == [wd["<s>"], wd["the"], wd["cat"], wd["sat"]]
+        assert trg == [wd["the"], wd["cat"], wd["sat"], wd["<e>"]]
+
+
+class TestMovielensZip:
+    USERS = "1::M::25::6::12345\n2::F::35::3::54321\n"
+    MOVIES = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Heat (1995)::Action|Crime\n")
+    RATINGS = ("1::1::5::978300760\n1::2::3::978302109\n"
+               "2::1::4::978301968\n2::2::1::978300275\n")
+
+    def _write(self, home):
+        d = home / "movielens"
+        d.mkdir()
+        with zipfile.ZipFile(d / "ml-1m.zip", "w") as z:
+            z.writestr("ml-1m/users.dat", self.USERS)
+            z.writestr("ml-1m/movies.dat", self.MOVIES)
+            z.writestr("ml-1m/ratings.dat", self.RATINGS)
+
+    def test_parses_and_splits(self, data_home):
+        import importlib
+        from paddle_tpu.dataset import movielens
+        importlib.reload(movielens)  # reset _meta cache
+        self._write(data_home)
+        train = list(movielens.train()())
+        test = list(movielens.test()())
+        assert len(train) + len(test) == 4
+        u, gender, age, job, m, score = train[0]
+        assert u == [1] and gender == [0]  # M -> 0
+        assert age == [movielens.age_table.index(25)]
+        assert job == [6]
+        assert m == [1] and score == [5.0]
+        assert movielens.max_user_id() == 2
+        assert movielens.max_movie_id() == 2
+        assert movielens.max_job_id() == 6
+        assert set(movielens.movie_categories()) == {
+            "Animation", "Comedy", "Action", "Crime"}
+        assert "toy" in movielens.get_movie_title_dict()
+
+
+class TestMq2007Letor:
+    LINES = (
+        "2 qid:10 1:0.5 2:0.25 46:1.0 #docid = GX001\n"
+        "0 qid:10 1:0.1 2:0.0 46:0.2 #docid = GX002\n"
+        "1 qid:11 1:0.9 46:0.5 #docid = GX003\n")
+
+    def _write(self, home, fname="train.txt"):
+        d = home / "mq2007"
+        d.mkdir(exist_ok=True)
+        (d / fname).write_text(self.LINES)
+
+    def test_pointwise_and_grouping(self, data_home):
+        from paddle_tpu.dataset import mq2007
+        self._write(data_home)
+        pts = list(mq2007.train(format="pointwise")())
+        assert len(pts) == 3
+        rel, feats = pts[0]
+        assert rel == 2.0
+        assert feats.shape == (46,)
+        assert feats[0] == np.float32(0.5) and feats[45] == np.float32(1.0)
+        lists = list(mq2007.train(format="listwise")())
+        assert len(lists) == 2  # two query ids
+        assert lists[0][0] == [2, 0]
+
+    def test_pairwise_order(self, data_home):
+        from paddle_tpu.dataset import mq2007
+        self._write(data_home)
+        pairs = list(mq2007.train(format="pairwise")())
+        assert len(pairs) == 1  # only qid 10 has rel(high) > rel(low)
+        hi, lo = pairs[0]
+        assert hi[0] == np.float32(0.5) and lo[0] == np.float32(0.1)
+
+
+class TestUciHousing:
+    def test_parses_and_normalizes(self, data_home):
+        from paddle_tpu.dataset import uci_housing
+        rng = np.random.RandomState(3)
+        data = np.round(rng.rand(506, 14) * 10, 3)
+        d = data_home / "uci_housing"
+        d.mkdir()
+        np.savetxt(d / "housing.data", data, fmt="%.3f")
+        train = list(uci_housing.train()())
+        test = list(uci_housing.test()())
+        assert len(train) == 404 and len(test) == 102  # ratio 0.8 split
+        feats = data[:, :-1]
+        want = (feats - feats.mean(0)) / (feats.max(0) - feats.min(0))
+        np.testing.assert_allclose(train[0][0], want[0], rtol=1e-4)
+        np.testing.assert_allclose(train[0][1], [data[0, -1]], rtol=1e-5)
+
+
+class TestCifarTar:
+    def _write(self, home):
+        d = home / "cifar"
+        d.mkdir()
+        rng = np.random.RandomState(1)
+        batch = {b"data": rng.randint(0, 256, (4, 3072), dtype=np.uint8),
+                 b"labels": [3, 1, 4, 1]}
+        with tarfile.open(d / "cifar-10-python.tar.gz", "w:gz") as tf:
+            payload = pickle.dumps(batch)
+            _add_tar_member(tf, "cifar-10-batches-py/data_batch_1",
+                            payload)
+            _add_tar_member(tf, "cifar-10-batches-py/test_batch",
+                            pickle.dumps({b"data": batch[b"data"][:1],
+                                          b"labels": [9]}))
+        return batch
+
+    def test_parses_pickled_batches(self, data_home):
+        from paddle_tpu.dataset import cifar
+        batch = self._write(data_home)
+        samples = list(cifar.train10()())
+        assert len(samples) == 4
+        img, lbl = samples[0]
+        assert lbl == 3
+        np.testing.assert_allclose(
+            img, batch[b"data"][0].astype("float32") / 255.0, rtol=1e-6)
+        test = list(cifar.test10()())
+        assert len(test) == 1 and test[0][1] == 9
+
+
+class TestWmt16Tar:
+    TRAIN = (b"the cat sat\tdie katze sass\n"
+             b"the dog ran\tder hund lief\n")
+    TEST = b"the cat ran\tdie katze lief\n"
+
+    def _write(self, home):
+        d = home / "wmt16"
+        d.mkdir()
+        with tarfile.open(d / "wmt16.tar.gz", "w:gz") as tf:
+            _add_tar_member(tf, "wmt16/train", self.TRAIN)
+            _add_tar_member(tf, "wmt16/test", self.TEST)
+            _add_tar_member(tf, "wmt16/val", self.TEST)
+
+    def test_dict_and_reader(self, data_home):
+        from paddle_tpu.dataset import wmt16
+        self._write(data_home)
+        en = wmt16.get_dict("en", dict_size=100)
+        de = wmt16.get_dict("de", dict_size=100)
+        assert en["<s>"] == 0 and en["<e>"] == 1 and en["<unk>"] == 2
+        assert en["the"] == 3  # most frequent en word
+        src, trg, trg_next = next(iter(wmt16.train(100, 100)()))
+        assert src == [en["the"], en["cat"], en["sat"]]
+        assert trg == [0, de["die"], de["katze"], de["sass"]]
+        assert trg_next == [de["die"], de["katze"], de["sass"], 1]
+
+    def test_literal_reserved_tokens_dont_collide(self, data_home):
+        from paddle_tpu.dataset import wmt16
+        d = data_home / "wmt16"
+        d.mkdir()
+        with tarfile.open(d / "wmt16.tar.gz", "w:gz") as tf:
+            _add_tar_member(tf, "wmt16/train",
+                            b"<unk> the the cat\t<unk> die die katze\n")
+        en = wmt16.get_dict("en", dict_size=100)
+        assert en["<unk>"] == 2  # reserved id survives corpus collision
+        ids = sorted(en.values())
+        assert ids == list(range(len(en)))  # no duplicate ids
+
+    def test_dict_size_cap_maps_to_unk(self, data_home):
+        from paddle_tpu.dataset import wmt16
+        self._write(data_home)
+        # dict of 4 => only 1 real word ('the'); everything else <unk>
+        src, _, _ = next(iter(wmt16.train(4, 4)()))
+        assert src[0] == 3 and src[1] == wmt16.UNK and src[2] == wmt16.UNK
+
+
+class TestConll05Tar:
+    WORDS = b"The\ncat\nsat\nquickly\n\n"
+    # one predicate column: (A0* *) for "The cat", B-V on "sat", AM on 4th
+    PROPS = (b"-\t(A0*\n"
+             b"-\t*)\n"
+             b"sit\t(V*)\n"
+             b"-\t(AM-TMP*)\n"
+             b"\n")
+
+    def _write(self, home):
+        d = home / "conll05st"
+        d.mkdir()
+        words_gz = gzip.compress(self.WORDS)
+        props_gz = gzip.compress(self.PROPS.replace(b"\t", b" "))
+        with tarfile.open(d / "conll05st-tests.tar.gz", "w:gz") as tf:
+            _add_tar_member(
+                tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                words_gz)
+            _add_tar_member(
+                tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                props_gz)
+        (d / "wordDict.txt").write_text("the\ncat\nsat\nquickly\n")
+        (d / "verbDict.txt").write_text("sit\nrun\n")
+        (d / "targetDict.txt").write_text(
+            "B-A0\nI-A0\nB-V\nI-V\nB-AM-TMP\nI-AM-TMP\nO\n")
+
+    def test_archive_without_dicts_stays_synthetic(self, data_home):
+        from paddle_tpu.dataset import conll05
+        d = data_home / "conll05st"
+        d.mkdir()
+        with tarfile.open(d / "conll05st-tests.tar.gz", "w:gz") as tf:
+            _add_tar_member(
+                tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                gzip.compress(self.WORDS))
+            _add_tar_member(
+                tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                gzip.compress(self.PROPS.replace(b"\t", b" ")))
+        # no dict files -> real words would all map to UNK; must fall
+        # back to synthetic rather than serve a garbage corpus
+        samples = list(conll05.test(n_synthetic=4)())
+        assert len(samples) == 4
+
+    def test_label_dict_ids_deterministic(self, data_home):
+        from paddle_tpu.dataset import conll05
+        d = data_home / "conll05st"
+        d.mkdir()
+        p = d / "targetDict.txt"
+        p.write_text("B-A1\nI-A1\nB-A0\nI-A0\nO\n")
+        d1 = conll05.load_label_dict(str(p))
+        assert d1 == {"B-A0": 0, "I-A0": 1, "B-A1": 2, "I-A1": 3, "O": 4}
+
+    def test_bracket_to_bio_and_slots(self, data_home):
+        from paddle_tpu.dataset import conll05
+        self._write(data_home)
+        corpus = conll05.corpus_reader(
+            str(data_home / "conll05st" / "conll05st-tests.tar.gz"))
+        sents = list(corpus())
+        assert len(sents) == 1
+        words, verb, labels = sents[0]
+        assert words == ["The", "cat", "sat", "quickly"]
+        assert verb == "sit"
+        assert labels == ["B-A0", "I-A0", "B-V", "B-AM-TMP"]
+        samples = list(conll05.test()())
+        slots = samples[0]
+        assert len(slots) == 9
+        word_idx, n2, n1, c0, p1, p2, pred, mark, label_idx = slots
+        wd, vd, ld = conll05.get_dict()
+        assert word_idx == [wd.get("The", 0), wd["cat"], wd["sat"],
+                            wd["quickly"]]
+        assert c0 == [wd["sat"]] * 4
+        assert pred == [vd["sit"]] * 4
+        assert mark == [1, 1, 1, 1]  # ±2 window around index 2
+        assert label_idx == [ld["B-A0"], ld["I-A0"], ld["B-V"],
+                             ld["B-AM-TMP"]]
