@@ -2,11 +2,18 @@
 
 Flagship: Transformer-base train-step throughput (tokens/sec) on the
 real chip (ref benchmark/fluid/machine_translation.py), with MFU
-computed from XLA's own cost analysis (fallback: analytic matmul FLOPs).
-Secondary metrics (SURVEY §5): ResNet-50 images/sec, MNIST MLP steps/sec
-— all in the same JSON line.
+computed from XLA's own cost analysis (fallback: analytic matmul FLOPs)
+and corroborated by device-side profiler timing. Secondary metrics
+(SURVEY §5): ResNet-50 images/sec, MNIST MLP steps/sec, inference
+latency — all in the same JSON line.
 
-Never exits without a JSON line: on failure prints
+Process structure: the axon TPU relay hangs (not errors) during init
+when it is down, and outages exceed an hour, so the parent process
+NEVER touches the TPU itself. It probes in subprocesses with backoff,
+then runs the whole TPU benchmark in a supervised child with a hard
+timeout, retrying while the budget (BENCH_TOTAL_BUDGET_S, default 45
+min) lasts; only then does it fall back to a CPU run. Never exits
+without a JSON line: on failure prints
 {"metric": ..., "value": 0, "error": ..., "stage": ...}.
 """
 import json
@@ -45,37 +52,37 @@ def _peak_flops(device):
 
 
 def _probe_tpu(timeout=120.0):
-    """Probe the TPU backend in a SUBPROCESS with a hard timeout — the
-    axon TPU plugin can hang (not error) during init, and a hung
-    jax.devices() in this process would be unrecoverable."""
+    """Probe the default backend in a SUBPROCESS with a hard timeout —
+    the axon TPU plugin can hang (not error) during init, and a hung
+    jax.devices() in this process would be unrecoverable. Returns the
+    probed platform string, or None on hang/failure."""
     import subprocess
-    code = ("import jax; d = jax.devices(); "
-            "print(d[0].platform, getattr(d[0], 'device_kind', ''))")
+    # a full compute+readback, not just device listing: the relay has
+    # been observed to answer jax.devices() while hanging on any real
+    # dispatch, and a listing-only probe would green-light a child run
+    # that then burns its whole timeout
+    code = ("import jax, jax.numpy as jnp, numpy as np; "
+            "d = jax.devices(); x = jnp.ones((8, 8)); "
+            "assert float(np.asarray(x + x)[0, 0]) == 2.0; "
+            "print('PLATFORM=' + d[0].platform)")
     try:
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=timeout)
-        return p.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return None
+    if p.returncode != 0:
+        return None
+    for line in (p.stdout or "").splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return None
 
 
-def _init_backend():
-    """Initialize the JAX backend: probe TPU out-of-process (3 tries —
-    the relay has been observed to drop out for minutes at a time);
-    fall back to CPU so a number always exists."""
+def _force_cpu():
     import os
-    ok = _probe_tpu()
-    for _ in range(2):
-        if ok:
-            break
-        time.sleep(15.0)
-        ok = _probe_tpu()
-    if not ok:
-        # TPU unreachable — CPU fallback (honest: platform is reported)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+    jax.config.update("jax_platforms", "cpu")
     return jax.devices()[0].platform
 
 
@@ -165,9 +172,9 @@ def bench_transformer(platform):
     key = jax.random.PRNGKey(0)
 
     step_fn = build_step_fn(main_p, [avg_cost.name], False, None)
-    jfn, flops_step = _aot_compile(jax.jit(step_fn, donate_argnums=(0,)),
-                                   (persist, feed, key))
-    flops_step = flops_step or _transformer_analytic_flops(cfg, B, T)
+    jfn, flops_ca = _aot_compile(jax.jit(step_fn, donate_argnums=(0,)),
+                                 (persist, feed, key))
+    flops_step = flops_ca or _transformer_analytic_flops(cfg, B, T)
     fetches, persist = jfn(persist, feed, key)
     # block_until_ready does not synchronize through the axon relay; a
     # device→host readback is the only reliable completion barrier.
@@ -190,7 +197,33 @@ def bench_transformer(platform):
 
     peak = _peak_flops(jax.devices()[0])
     mfu = (flops_step * n / dt / peak) if peak else None
-    return tokens_per_sec, mfu, loss
+    evidence = {
+        "mfu_method": "xla_cost_analysis" if flops_ca
+                      else "analytic_matmul",
+        "flops_per_step": flops_step,
+        "wall_step_ms": round(dt / n * 1e3, 2),
+    }
+    if on_tpu:
+        # device-side per-step time from the profiler trace — wall
+        # clock through the relay carries ±5-20% noise; the xplane
+        # event durations are the corroborating record
+        try:
+            from paddle_tpu.profiler import profile_step_fn
+
+            def one_step():
+                fetches, state["persist"] = jfn(state["persist"], feed,
+                                                key)
+                return fetches
+
+            dev_s, fams = profile_step_fn(one_step, steps=10)
+            evidence["device_step_ms"] = round(dev_s * 1e3, 2)
+            evidence["device_mfu"] = round(flops_step / dev_s / peak, 4)
+            top = sorted(fams.items(), key=lambda kv: -kv[1])[:5]
+            evidence["device_top_ops_ms"] = {
+                k: round(v * 1e3, 2) for k, v in top}
+        except Exception as e:
+            evidence["device_profile_error"] = f"{type(e).__name__}: {e}"
+    return tokens_per_sec, mfu, loss, evidence
 
 
 def bench_resnet(platform):
@@ -282,6 +315,63 @@ def bench_flash_long_context(platform):
             "flash_attn_32k_mfu": round(fl / dt / peak, 4)}
 
 
+def bench_inference(platform):
+    """InferenceEngine latency/throughput (ref inference/api/api_impl.cc
+    deploy story): transformer encoder forward and ResNet-50 forward,
+    jit-cached path plus the AOT-compiled (save_compiled/load_compiled)
+    path for ResNet."""
+    import tempfile
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.inference import InferenceEngine
+    from paddle_tpu.models import resnet
+
+    on_tpu = platform in ("tpu", "axon")
+    out = {}
+    rng = np.random.RandomState(0)
+
+    # --- ResNet-50 forward, B=32 ---
+    B, HW = (32, 224) if on_tpu else (2, 64)
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            img = pt.layers.data("image", (3, HW, HW), dtype="float32")
+            predict = resnet.resnet(img, class_dim=1000, depth=50)
+    infer_p = main_p.clone(for_test=True)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+    eng = InferenceEngine(infer_p, ["image"], [predict], scope,
+                          use_bf16=True)
+    x = rng.rand(B, 3, HW, HW).astype("float32")
+    eng.run({"image": x})  # compile
+    n = 20 if on_tpu else 2
+    dt = _median_window_time(
+        lambda: [eng.run({"image": x}, return_numpy=False)
+                 for _ in range(n)] and np.asarray(
+            eng.run({"image": x})[0][0, :1]), 3) / (n + 1)
+    out["resnet50_infer_images_per_sec"] = round(B / dt, 1)
+    out["resnet50_infer_latency_ms"] = round(dt * 1e3, 2)
+
+    # AOT roundtrip: save_compiled → load_compiled → run. TPU only:
+    # exporting ResNet-50 StableHLO on CPU takes minutes and the CPU
+    # number means nothing (the roundtrip itself is covered by tests)
+    if not on_tpu:
+        return out
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            eng.save_compiled(d, {"image": (B, 3, HW, HW)})
+            pred = InferenceEngine.load_compiled(d)
+            pred.run({"image": x})
+            dt = _median_window_time(
+                lambda: np.asarray(pred.run({"image": x})[0][0, :1]), 3)
+            out["resnet50_infer_aot_latency_ms"] = round(dt * 1e3, 2)
+    except Exception as e:
+        out["resnet50_infer_aot_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def bench_mnist(platform):
     """MNIST MLP train steps/sec (ref benchmark/fluid/mnist.py)."""
     import jax
@@ -328,7 +418,10 @@ def bench_mnist(platform):
     return n / dt
 
 
-def main():
+def run_benchmarks(platform):
+    """Run every benchmark on the already-initialized backend; returns
+    the result dict (no emission — the caller owns the single line)."""
+    import jax
     result = {
         "metric": "transformer_base_train_tokens_per_sec",
         "value": 0.0,
@@ -336,20 +429,24 @@ def main():
         "vs_baseline": 0.0,
     }
     try:
-        _STAGE["stage"] = "backend_init"
-        platform = _init_backend()
         result["platform"] = platform
+        result["device_kind"] = getattr(jax.devices()[0],
+                                        "device_kind", "")
 
         _STAGE["stage"] = "transformer"
-        tokens_per_sec, mfu, loss = bench_transformer(platform)
+        tokens_per_sec, mfu, loss, evidence = bench_transformer(platform)
         result["value"] = round(tokens_per_sec, 1)
         if mfu is not None:
             result["mfu"] = round(mfu, 4)
         result["loss"] = round(loss, 4)
+        result["evidence"] = evidence
 
         baseline = None
         try:
-            with open("BASELINE.json") as f:
+            import os
+            bp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BASELINE.json")
+            with open(bp) as f:
                 baseline = json.load(f).get("published", {}).get(
                     "transformer_tokens_per_sec")
         except Exception:
@@ -364,6 +461,11 @@ def main():
                 result[name] = round(fn(platform), 1)
             except Exception as e:
                 result[name + "_error"] = f"{type(e).__name__}: {e}"
+        _STAGE["stage"] = "inference"
+        try:
+            result.update(bench_inference(platform))
+        except Exception as e:
+            result["inference_error"] = f"{type(e).__name__}: {e}"
         _STAGE["stage"] = "flash_long_context"
         try:
             extra = bench_flash_long_context(platform)
@@ -375,7 +477,101 @@ def main():
         result["error"] = f"{type(e).__name__}: {e}"
         result["stage"] = _STAGE["stage"]
         result["traceback"] = traceback.format_exc()[-1500:]
+    return result
+
+
+def _child_main():
+    """BENCH_CHILD=1 mode: assume the default (TPU) backend, run all
+    benchmarks, print the JSON line. Any hang here is the parent's
+    problem — it holds the kill timer."""
+    import jax
+    platform = jax.devices()[0].platform  # may hang; parent supervises
+    _emit(run_benchmarks(platform))
+
+
+def _supervise():
+    """Parent mode: never touches the TPU in-process. Probe with
+    backoff, then run the TPU benchmark in a killable child; retry
+    until BENCH_TOTAL_BUDGET_S is spent, then CPU fallback."""
+    import os
+    import subprocess
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "2700"))
+    child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT_S", "1500"))
+    t0 = time.monotonic()
+    remaining = lambda: budget - (time.monotonic() - t0)
+    attempts, runs, last_err = 0, 0, ""
+    delay = 10.0
+
+    def backoff():
+        nonlocal delay
+        time.sleep(min(delay, max(0.0, remaining() - 60.0)))
+        delay = min(delay * 2, 180.0)
+
+    while remaining() > 60.0 and runs < 5:
+        attempts += 1
+        platform = _probe_tpu(timeout=min(120.0, remaining()))
+        if platform is None:
+            last_err = "probe timeout/failure"
+            backoff()
+            continue
+        if platform not in ("tpu", "axon"):
+            # no TPU in this environment at all (e.g. CPU-only CI):
+            # don't burn the budget retrying
+            break
+        # relay reachable — run the real benchmark in a killable child
+        runs += 1
+        env = dict(os.environ, BENCH_CHILD="1")
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=min(child_timeout, max(remaining(), 5.0)))
+        except subprocess.TimeoutExpired:
+            last_err = f"child run {runs} hung (killed)"
+            backoff()
+            continue
+        line = next((l for l in reversed(
+            (p.stdout or "").strip().splitlines())
+            if l.startswith("{")), None)
+        if p.returncode == 0 and line:
+            try:
+                result = json.loads(line)
+            except Exception:
+                last_err = f"child run {runs} emitted invalid JSON"
+                backoff()
+                continue
+            if result.get("platform") in ("tpu", "axon") \
+                    and not result.get("error"):
+                result["probe"] = {
+                    "attempts": attempts, "child_runs": runs,
+                    "seconds": round(time.monotonic() - t0, 1)}
+                _emit(result)
+                return
+            last_err = (f"child run {runs}: platform="
+                        f"{result.get('platform')} "
+                        f"error={result.get('error')!r}")
+        else:
+            last_err = (f"child run {runs} rc={p.returncode}: "
+                        + (p.stderr or "")[-300:].replace("\n", " "))
+        # failed child runs back off too — each retry pays full TPU
+        # init, and a deterministic child bug would otherwise spin
+        backoff()
+    # budget exhausted — honest CPU fallback in-process
+    platform = _force_cpu()
+    result = run_benchmarks(platform)
+    result["probe"] = {"attempts": attempts, "child_runs": runs,
+                      "seconds": round(time.monotonic() - t0, 1),
+                      "tpu_unreachable": last_err}
     _emit(result)
+
+
+def main():
+    import os
+    if os.environ.get("BENCH_CHILD"):
+        _child_main()
+    else:
+        _supervise()
 
 
 if __name__ == "__main__":

@@ -151,11 +151,22 @@ class InferenceEngine:
         exp = jexport.export(jax.jit(infer))(self._persist, feed)
         with open(os.path.join(dirname, "module.stablehlo"), "wb") as f:
             f.write(exp.serialize())
-        np.savez(os.path.join(dirname, "params.npz"),
-                 **{k: np.asarray(v) for k, v in self._persist.items()})
+        # npz has no bfloat16: store bf16 params as a uint16 view and
+        # record the true dtype so load_compiled can view them back
+        params, param_dtypes = {}, {}
+        for k, v in self._persist.items():
+            a = np.asarray(v)
+            param_dtypes[k] = str(a.dtype)
+            if a.dtype.kind not in "biufc":
+                a = a.view(np.uint16 if a.dtype.itemsize == 2
+                           else np.uint8 if a.dtype.itemsize == 1
+                           else np.uint32)
+            params[k] = a
+        np.savez(os.path.join(dirname, "params.npz"), **params)
         with open(os.path.join(dirname, "signature.json"), "w") as f:
             json.dump({"feeds": {k: list(v.shape) for k, v in feed.items()},
                        "dtypes": {k: str(v.dtype) for k, v in feed.items()},
+                       "param_dtypes": param_dtypes,
                        "fetches": self.fetch_names}, f)
         return dirname
 
@@ -174,10 +185,17 @@ class CompiledPredictor:
         from jax import export as jexport
         with open(os.path.join(dirname, "module.stablehlo"), "rb") as f:
             self._exported = jexport.deserialize(bytearray(f.read()))
-        pz = np.load(os.path.join(dirname, "params.npz"))
-        self._persist = {k: jnp.asarray(pz[k]) for k in pz.files}
         with open(os.path.join(dirname, "signature.json")) as f:
             self.signature = json.load(f)
+        pz = np.load(os.path.join(dirname, "params.npz"))
+        pdt = self.signature.get("param_dtypes", {})
+        self._persist = {}
+        for k in pz.files:
+            a = pz[k]
+            want = pdt.get(k)
+            if want and str(a.dtype) != want:
+                a = a.view(jnp.dtype(want))  # bf16 stored as uint16
+            self._persist[k] = jnp.asarray(a)
 
     def run(self, feed, return_numpy=True):
         feed_arrays = {

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["pipeline_forward", "gpipe_schedule", "PipelineTrainer"]
+__all__ = ["pipeline_forward", "gpipe_schedule", "one_f_one_b_schedule",
+           "PipelineTrainer"]
 
 
 def pipeline_forward(mesh, stage_fn, params_per_stage, x, n_microbatch,
@@ -101,6 +102,56 @@ def gpipe_schedule(n_microbatch, n_stages):
     return table
 
 
+def one_f_one_b_schedule(n_microbatch, n_stages, n_slots=None):
+    """Simulate the 1F1B (one-forward-one-backward) schedule and return
+    (act, mbi): two [T, n_stages] int lists with act in {0 idle, 1 fwd,
+    2 bwd} and mbi the microbatch index.
+
+    Policy: a stage runs a backward as soon as a cotangent is available
+    (the 1F1B invariant), otherwise a forward — capped at `n_slots`
+    microbatches in flight (default n_stages), which is what bounds
+    activation memory to n_slots slots instead of GPipe's n_microbatch.
+    Dependencies honored: fwd(s,m) needs fwd(s-1,m) at an earlier tick;
+    bwd(s,m) needs bwd(s+1,m) earlier (or its own fwd for the last
+    stage)."""
+    S = n_stages
+    n_slots = n_slots or S
+    F, B = {}, {}
+    fwd_done = [0] * S
+    bwd_done = [0] * S
+    act, mbi = [], []
+    t = 0
+    while not all(b == n_microbatch for b in bwd_done):
+        arow, mrow = [], []
+        for s in range(S):
+            m_b, m_f = bwd_done[s], fwd_done[s]
+            can_b = m_b < n_microbatch and (
+                (s == S - 1 and F.get((s, m_b), t) < t)
+                or (s < S - 1 and B.get((s + 1, m_b), t) < t))
+            can_f = (m_f < n_microbatch
+                     and m_f - m_b < n_slots
+                     and (s == 0 or F.get((s - 1, m_f), t) < t))
+            if can_b:
+                B[(s, m_b)] = t
+                bwd_done[s] += 1
+                arow.append(2)
+                mrow.append(m_b)
+            elif can_f:
+                F[(s, m_f)] = t
+                fwd_done[s] += 1
+                arow.append(1)
+                mrow.append(m_f)
+            else:
+                arow.append(0)
+                mrow.append(0)
+        act.append(arow)
+        mbi.append(mrow)
+        t += 1
+        if t > 4 * (n_microbatch + S) + 8:  # safety: schedule must close
+            raise RuntimeError("1F1B schedule did not converge")
+    return act, mbi
+
+
 class PipelineTrainer:
     """GPipe training of a Program over the `pp` mesh axis.
 
@@ -120,7 +171,8 @@ class PipelineTrainer:
     """
 
     def __init__(self, program, loss_name, boundaries, mesh,
-                 n_microbatch=4, axis_name="pp", scope=None):
+                 n_microbatch=4, axis_name="pp", scope=None,
+                 schedule="gpipe"):
         from ..core.trace import exec_op, _find_backward
         from ..core.framework import grad_var_name
         from ..core.scope import global_scope
@@ -132,6 +184,9 @@ class PipelineTrainer:
         self.n_mb = n_microbatch
         self.scope = scope or global_scope()
         self.n_stages = mesh.shape[axis_name]
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        self.schedule = schedule
 
         block = program.global_block()
         ops = list(block.ops)
@@ -240,9 +295,11 @@ class PipelineTrainer:
                 inflight, loss_sum = carry
                 mb_idx = jnp.clip(t - stage, 0, n_mb - 1)
                 mb = jax.tree.map(lambda a: a[mb_idx], feed_mb)
+                # key folds the MICROBATCH index (not the tick) so the
+                # dropout stream matches the 1F1B schedule bit-for-bit
                 h_out, loss = lax.switch(
                     stage, branches, params, inflight, mb,
-                    jax.random.fold_in(key, t))
+                    jax.random.fold_in(key, mb_idx))
                 valid = (t >= stage) & (t - stage < n_mb)
                 loss_sum = loss_sum + jnp.where(valid, loss, 0.0)
                 nxt = lax.ppermute(h_out, axis, perm)
@@ -268,6 +325,128 @@ class PipelineTrainer:
                 for i in range(len(self.stage_params[0]))]
             loss, grads = jax.value_and_grad(train_loss)(
                 stacked, feed_mb, key)
+            env = dict(persist)
+            for i in range(len(grads)):
+                for s in range(n_stages):
+                    pname = self.stage_params[s][i]
+                    env[self._grad_name(pname)] = grads[i][s].astype(
+                        env[pname].dtype)
+            for j, op in enumerate(self._update_ops):
+                self._exec_op(env, op, 900000 + j, key, False, None,
+                              self._block)
+            new_persist = {n: env[n] for n in persist if n in env}
+            return loss, new_persist
+
+        return step_fn
+
+    # ------------------------------------------------------------------
+    def _build_fn_1f1b(self, feed_names):
+        """1F1B schedule: activation memory is bounded by n_stages slots
+        (vs GPipe's n_microbatch residuals) — backwards run via jax.vjp
+        with the stage forward REMATERIALIZED from the stored stage
+        input, so only inputs are kept in flight. The schedule is
+        simulated host-side (one_f_one_b_schedule) and baked into static
+        [T, S] action/microbatch tables; every tick all members run one
+        masked compute (lax.cond — no collectives inside) and two
+        unconditional ppermutes (activations forward, cotangents
+        backward), so SPMD stays uniform."""
+        n_stages, n_mb, axis = self.n_stages, self.n_mb, self.axis
+        n_slots = n_stages
+        branches = [self._stage_branch(si, feed_names)
+                    for si in range(n_stages)]
+        act_tab_h, mb_tab_h = one_f_one_b_schedule(n_mb, n_stages,
+                                                   n_slots)
+        n_ticks = len(act_tab_h)
+        act_tab = jnp.asarray(act_tab_h, jnp.int32)
+        mb_tab = jnp.asarray(mb_tab_h, jnp.int32)
+
+        def per_member(stacked, feed_mb, key):
+            params = [p[0] for p in stacked]
+            stage = lax.axis_index(axis)
+            perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+            mb0 = jax.tree.map(lambda a: a[0], feed_mb)
+            hs = jax.eval_shape(branches[0], params, 0.0, mb0, key)[0]
+            zeros_h = jnp.zeros(hs.shape, hs.dtype)
+            zeros_p = [jnp.zeros(p.shape, p.dtype) for p in params]
+            # last stage's bwd seeds the loss cotangent (mean over mb)
+            seed = jnp.where(stage == n_stages - 1,
+                             jnp.float32(1.0 / n_mb), jnp.float32(0.0))
+
+            def apply(p, h, feed, k):
+                return lax.switch(stage, branches, p, h, feed, k)
+
+            def step(carry, t):
+                act_in, x_store, cot_in, gacc, loss_sum = carry
+                a = act_tab[t, stage]
+                m = mb_tab[t, stage]
+                slot = m % n_slots
+                feed_m = jax.tree.map(lambda arr: arr[m], feed_mb)
+                key_m = jax.random.fold_in(key, m)  # fwd == remat key
+
+                def fwd(_):
+                    return apply(params, act_in[slot], feed_m, key_m)
+
+                h_out, floss = lax.cond(
+                    a == 1, fwd,
+                    lambda _: (zeros_h, jnp.zeros((), jnp.float32)),
+                    None)
+
+                def bwd(_):
+                    f = lambda p, x: apply(p, x, feed_m, key_m)
+                    _, vjp_fn = jax.vjp(f, params, x_store[slot])
+                    dp, dx = vjp_fn((cot_in[slot], seed))
+                    return dp, dx
+
+                dp, dx = lax.cond(
+                    a == 2, bwd, lambda _: (zeros_p, zeros_h), None)
+
+                gacc = [g + d.astype(jnp.float32)
+                        for g, d in zip(gacc, dp)]
+                loss_sum = loss_sum + floss
+                x_store = jnp.where(a == 1,
+                                    x_store.at[slot].set(act_in[slot]),
+                                    x_store)
+
+                # hand activations downstream, cotangents upstream; the
+                # receiver files arrivals under the SENDER's static
+                # schedule entry for this tick
+                h_recv = lax.ppermute(h_out, axis, perm_fwd)
+                dx_recv = lax.ppermute(dx, axis, perm_bwd)
+                prev = (stage - 1) % n_stages
+                nxt = (stage + 1) % n_stages
+                pa, pm = act_tab[t, prev], mb_tab[t, prev]
+                na, nm = act_tab[t, nxt], mb_tab[t, nxt]
+                act_in = jnp.where(
+                    (pa == 1) & (stage > 0),
+                    act_in.at[pm % n_slots].set(h_recv), act_in)
+                cot_in = jnp.where(
+                    (na == 2) & (stage < n_stages - 1),
+                    cot_in.at[nm % n_slots].set(dx_recv), cot_in)
+                return (act_in, x_store, cot_in, gacc, loss_sum), None
+
+            buf = jnp.zeros((n_slots,) + hs.shape, hs.dtype)
+            gacc0 = [jnp.zeros(p.shape, jnp.float32) for p in params]
+            carry0 = (buf, buf, buf, gacc0,
+                      jnp.zeros((), jnp.float32))
+            (_, _, _, gacc, loss_sum), _ = lax.scan(
+                step, carry0, jnp.arange(n_ticks))
+            loss = lax.psum(loss_sum, axis) / n_mb
+            return loss, [g[None] for g in gacc]
+
+        in_specs = ([P(axis)] * len(self.stage_params[0]), P(), P())
+        sm = jax.shard_map(per_member, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=(P(), [P(axis)] * len(
+                               self.stage_params[0])),
+                           check_vma=False)
+
+        def step_fn(persist, feed_mb, key):
+            stacked = [
+                jnp.stack([persist[self.stage_params[s][i]]
+                           for s in range(n_stages)])
+                for i in range(len(self.stage_params[0]))]
+            loss, grads = sm(stacked, feed_mb, key)
             env = dict(persist)
             for i in range(len(grads)):
                 for s in range(n_stages):
@@ -316,7 +495,9 @@ class PipelineTrainer:
                    for k, a in zip(feed_names, feed_mb))
         fn = self._jit_cache.get(ck)
         if fn is None:
-            step = self._build_fn(feed_names)
+            step = (self._build_fn_1f1b(feed_names)
+                    if self.schedule == "1f1b"
+                    else self._build_fn(feed_names))
             fn = jax.jit(step)
             self._jit_cache[ck] = fn
         loss, new_persist = fn(persist, feed_mb, key)

@@ -154,6 +154,35 @@ class TestFeedAliasing:
             assert fa["x"] is not persist[wname]
 
 
+class TestBf16AotRoundtrip:
+    def test_save_load_compiled_bf16_params(self, tmp_path):
+        """npz cannot hold bfloat16; save_compiled must view-cast and
+        load_compiled must restore the true dtype bit-exactly."""
+        from paddle_tpu.inference import InferenceEngine
+        from paddle_tpu.models import mnist as mn
+        main_p, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup):
+            with pt.unique_name.guard():
+                img = pt.layers.data("image", (16,), dtype="float32")
+                pred = pt.layers.fc(img, size=4)
+        infer_p = main_p.clone(for_test=True)
+        scope = pt.Scope()
+        exe = pt.Executor()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+        eng = InferenceEngine(infer_p, ["image"], [pred], scope,
+                              use_bf16=True)
+        x = np.random.RandomState(0).rand(2, 16).astype("float32")
+        ref = eng.run({"image": x})[0]
+        d = str(tmp_path / "aot")
+        eng.save_compiled(d, {"image": (2, 16)})
+        loaded = InferenceEngine.load_compiled(d)
+        for k, v in loaded._persist.items():
+            assert v.dtype == eng._persist[k].dtype
+        out = loaded.run({"image": x})[0]
+        np.testing.assert_allclose(ref, out, rtol=1e-2, atol=1e-2)
+
+
 class TestBatchNormStatGrads:
     def test_saved_stats_carry_gradients(self):
         """A loss that reads SavedMean/SavedVariance must push nonzero,
