@@ -61,7 +61,15 @@ class ParallelExecutor:
             # single source of truth: the transpiler's policy (dp batch
             # axis + sp time axis; see transpiler.feed_sharding)
             return self.transpiler.feed_sharding(arr.shape, name=name)
-        return NamedSharding(self.mesh, P("dp", *([None] * (arr.ndim - 1))))
+        dp = self.mesh.shape.get("dp", 1)
+        dp_ok = arr.ndim > 0 and arr.shape[0] % dp == 0
+        if not dp_ok and dp > 1:
+            import warnings
+            warnings.warn(
+                f"feed batch {arr.shape[0]} does not divide dp={dp}; "
+                "replicating this feed (no data parallelism for it)")
+        return NamedSharding(self.mesh, P("dp" if dp_ok else None,
+                                          *([None] * (arr.ndim - 1))))
 
     def _param_sharding(self, name):
         return self._shardings.get(name, self._replicated)
@@ -77,16 +85,14 @@ class ParallelExecutor:
         key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
 
-        dp = self.mesh.shape.get("dp", 1)
         feed_arrays = {}
         for k, v in feed.items():
             var = program.global_block().vars.get(k)
             dt = as_jnp_dtype(var.dtype) if var is not None else None
             arr = jnp.asarray(np.asarray(v), dtype=dt)
-            if arr.ndim > 0 and arr.shape[0] % dp != 0:
-                raise ValueError(
-                    f"feed {k!r} batch {arr.shape[0]} not divisible by "
-                    f"dp={dp}")
+            # non-divisible batches fall back to replication inside
+            # feed_sharding (slice_variable remainder analog) rather
+            # than erroring — XLA still computes the correct math
             feed_arrays[k] = jax.device_put(
                 arr, self._feed_sharding(arr, name=k))
 

@@ -172,7 +172,7 @@ class PipelineTrainer:
 
     def __init__(self, program, loss_name, boundaries, mesh,
                  n_microbatch=4, axis_name="pp", scope=None,
-                 schedule="gpipe"):
+                 schedule="gpipe", data_axis=None):
         from ..core.trace import exec_op, _find_backward
         from ..core.framework import grad_var_name
         from ..core.scope import global_scope
@@ -187,6 +187,12 @@ class PipelineTrainer:
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.schedule = schedule
+        # dp x pp composition: feeds shard their microbatch batch dim
+        # over `data_axis`; params stay replicated across it, so the
+        # shard_map AD transpose inserts the gradient psum over dp
+        # automatically (grads of unmapped inputs are summed)
+        self.data_axis = data_axis
+        self.n_dp = mesh.shape[data_axis] if data_axis else 1
 
         block = program.global_block()
         ops = list(block.ops)
@@ -291,6 +297,11 @@ class PipelineTrainer:
             hshape = first_shape()
             h0 = jnp.zeros(hshape.shape, hshape.dtype)
 
+            # dp members hold DIFFERENT examples, so their dropout
+            # streams must differ — fold the dp member index once
+            mkey = (jax.random.fold_in(key, lax.axis_index(
+                self.data_axis)) if self.data_axis else key)
+
             def step(carry, t):
                 inflight, loss_sum = carry
                 mb_idx = jnp.clip(t - stage, 0, n_mb - 1)
@@ -299,7 +310,7 @@ class PipelineTrainer:
                 # dropout stream matches the 1F1B schedule bit-for-bit
                 h_out, loss = lax.switch(
                     stage, branches, params, inflight, mb,
-                    jax.random.fold_in(key, mb_idx))
+                    jax.random.fold_in(mkey, mb_idx))
                 valid = (t >= stage) & (t - stage < n_mb)
                 loss_sum = loss_sum + jnp.where(valid, loss, 0.0)
                 nxt = lax.ppermute(h_out, axis, perm)
@@ -308,10 +319,15 @@ class PipelineTrainer:
             (_, loss_sum), _ = lax.scan(
                 step, (h0, jnp.zeros((), jnp.float32)),
                 jnp.arange(n_steps))
-            # only the LAST stage produced loss; psum replicates the total
-            return lax.psum(loss_sum, axis) / n_mb
+            # only the LAST stage produced loss; psum replicates the
+            # total (and averages the dp members' local-shard means)
+            axes = (axis,) + ((self.data_axis,) if self.data_axis
+                              else ())
+            return lax.psum(loss_sum, axes) / (n_mb * self.n_dp)
 
-        in_specs = ([P(axis)] * len(self.stage_params[0]), P(), P())
+        feed_spec = P(None, self.data_axis) if self.data_axis else P()
+        in_specs = ([P(axis)] * len(self.stage_params[0]), feed_spec,
+                    P())
         sm = jax.shard_map(per_member, mesh=self.mesh, in_specs=in_specs,
                            out_specs=P(), check_vma=False)
 
@@ -370,12 +386,19 @@ class PipelineTrainer:
             hs = jax.eval_shape(branches[0], params, 0.0, mb0, key)[0]
             zeros_h = jnp.zeros(hs.shape, hs.dtype)
             zeros_p = [jnp.zeros(p.shape, p.dtype) for p in params]
-            # last stage's bwd seeds the loss cotangent (mean over mb)
+            # last stage's bwd seeds the loss cotangent: the global
+            # objective is the mean over microbatches AND dp shards
             seed = jnp.where(stage == n_stages - 1,
-                             jnp.float32(1.0 / n_mb), jnp.float32(0.0))
+                             jnp.float32(1.0 / (n_mb * self.n_dp)),
+                             jnp.float32(0.0))
 
             def apply(p, h, feed, k):
                 return lax.switch(stage, branches, p, h, feed, k)
+
+            # dp members hold different examples: decorrelate their
+            # dropout streams (mirrors the GPipe path exactly)
+            mkey = (jax.random.fold_in(key, lax.axis_index(
+                self.data_axis)) if self.data_axis else key)
 
             def step(carry, t):
                 act_in, x_store, cot_in, gacc, loss_sum = carry
@@ -383,7 +406,7 @@ class PipelineTrainer:
                 m = mb_tab[t, stage]
                 slot = m % n_slots
                 feed_m = jax.tree.map(lambda arr: arr[m], feed_mb)
-                key_m = jax.random.fold_in(key, m)  # fwd == remat key
+                key_m = jax.random.fold_in(mkey, m)  # fwd == remat key
 
                 def fwd(_):
                     return apply(params, act_in[slot], feed_m, key_m)
@@ -432,10 +455,19 @@ class PipelineTrainer:
                       jnp.zeros((), jnp.float32))
             (_, _, _, gacc, loss_sum), _ = lax.scan(
                 step, carry0, jnp.arange(n_ticks))
-            loss = lax.psum(loss_sum, axis) / n_mb
+            axes = (axis,) + ((self.data_axis,) if self.data_axis
+                              else ())
+            loss = lax.psum(loss_sum, axes) / (n_mb * self.n_dp)
+            if self.data_axis:
+                # grads accumulated explicitly (not via AD transpose
+                # through shard_map), so the dp reduction is explicit
+                # too; out_specs leave dp unmapped = must be replicated
+                gacc = [lax.psum(g, self.data_axis) for g in gacc]
             return loss, [g[None] for g in gacc]
 
-        in_specs = ([P(axis)] * len(self.stage_params[0]), P(), P())
+        feed_spec = P(None, self.data_axis) if self.data_axis else P()
+        in_specs = ([P(axis)] * len(self.stage_params[0]), feed_spec,
+                    P())
         sm = jax.shard_map(per_member, mesh=self.mesh, in_specs=in_specs,
                            out_specs=(P(), [P(axis)] * len(
                                self.stage_params[0])),
@@ -475,10 +507,10 @@ class PipelineTrainer:
             arr = np.asarray(feed[k])
             var = self._block.vars.get(k)
             dt = as_jnp_dtype(var.dtype) if var is not None else None
-            if arr.shape[0] % self.n_mb:
+            if arr.shape[0] % (self.n_mb * self.n_dp):
                 raise ValueError(
                     f"batch {arr.shape[0]} must divide into "
-                    f"{self.n_mb} microbatches")
+                    f"{self.n_mb} microbatches x {self.n_dp} dp shards")
             a = jnp.asarray(arr, dtype=dt)
             feed_mb.append(a.reshape((self.n_mb, arr.shape[0] // self.n_mb)
                                      + arr.shape[1:]))

@@ -120,7 +120,18 @@ class DistributeTranspiler:
         ndim = len(shape)
         if ndim == 0:
             return NamedSharding(self.mesh, P())
-        axes = ["dp"] + [None] * (ndim - 1)
+        # a batch that doesn't tile onto dp stays replicated (the
+        # reference's slice_variable remainder handling analog) — an
+        # uneven device_put would hard-error. Loud: silently disabled
+        # data parallelism is an n-times throughput loss.
+        dp = self.mesh.shape.get("dp", 1)
+        dp_ok = shape[0] % dp == 0
+        if not dp_ok and dp > 1:
+            import warnings
+            warnings.warn(
+                f"feed batch {shape[0]} does not divide dp={dp}; "
+                "replicating this feed (no data parallelism for it)")
+        axes = ["dp" if dp_ok else None] + [None] * (ndim - 1)
         sp = self.mesh.shape.get("sp", 1)
         override = getattr(self.config, "sp_feed_axes", {}) or {}
         if name is not None and name in override:
