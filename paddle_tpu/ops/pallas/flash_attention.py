@@ -41,9 +41,24 @@ with the default 1024x2048 blocks — a swept optimum (512/256-row and
 1024-col variants are 2-48% slower). The bound is the VPU, not the MXU:
 per score element the kernel does 2D=128 MXU flops against ~10 VPU ops
 (exp/max/mul in f32), so at D=64 the exp pipeline saturates first.
-attn-MFU rises with head dim; restructuring for more would mean bf16
-softmax arithmetic inside the kernel (precision loss the standard
-algorithm avoids).
+attn-MFU rises with head dim.
+
+The named escape is implemented behind `softmax_dtype`: with
+jnp.bfloat16, the probability exp (the dominant VPU cost — one
+transcendental per score element in fwd, dq AND dkv) runs in bf16 while
+everything that controls numerics stays f32: the scores matmul
+accumulation, the running max m, the scale factor alpha, the row-sum l
+(f32-accumulated reduction over bf16 p), and the output rescale. The
+bf16 exp argument is (s - m) <= 0, so the absolute error is bounded by
+bf16's ~3-digit mantissa on values in (0, 1] — ~0.4% per element,
+averaged down by the row sums. Default stays f32 (exact flash
+algorithm); set_softmax_dtype(jnp.bfloat16) or the per-call kwarg opts
+in. NOTE: the dtype is baked in at TRACE time — callers holding an
+already-jitted/cached executable (including Executor's program cache)
+keep the dtype they were traced with; flip the knob before building
+the step function. No on-chip measurement of the bf16 variant exists
+yet (the sweep needs the real chip); until one is recorded here and in
+SURVEY §5, treat it as an unvalidated escape hatch.
 """
 import functools
 
@@ -59,7 +74,7 @@ except Exception:  # pragma: no cover
 
 __all__ = ["flash_attention", "flash_attention_with_lse",
            "flash_attention_reference", "try_flash", "STATS", "set_mode",
-           "active", "MIN_SEQ_LEN"]
+           "set_softmax_dtype", "active", "MIN_SEQ_LEN"]
 
 _NEG_INF = -1e30
 
@@ -96,6 +111,21 @@ def set_mode(mode):
     global _MODE
     assert mode in ("auto", "interpret", "off")
     _MODE = mode
+
+
+# dtype of the probability exp inside the kernels; f32 = exact flash
+# algorithm, bf16 = the VPU-pressure escape (see module docstring)
+_SOFTMAX_DTYPE = jnp.float32
+
+
+def set_softmax_dtype(dtype):
+    """Set the in-kernel probability-exp dtype. Trace-time only: jitted
+    executables (and Executor's program cache) keep the dtype they were
+    traced with — call this BEFORE building the step function."""
+    global _SOFTMAX_DTYPE
+    dtype = jnp.dtype(dtype)
+    assert dtype in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+    _SOFTMAX_DTYPE = dtype
 
 
 def active():
@@ -189,7 +219,8 @@ def _dot(a, b):
 # forward
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
-                m_ref, l_ref, acc_ref, *, causal, scale, n_k, offset):
+                m_ref, l_ref, acc_ref, *, causal, scale, n_k, offset,
+                p_dtype=jnp.float32):
     """Grid (B*H, n_q, n_k), k innermost. q_ref [bq, D]; k/v_ref [bk, D];
     b_ref [1, bk]; scratch m/l [bq, _LANES] (lane-replicated), acc [bq, DV].
     """
@@ -216,9 +247,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         m_prev = m_ref[...][:, :1]                              # [bq, 1]
         l_prev = l_ref[...][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # the full-tile exp is the dominant VPU cost; p_dtype=bf16 runs
+        # it at the packed rate while m/alpha/l stay f32 (argument is
+        # <= 0, so bf16's mantissa bounds the element error at ~0.4%)
+        p = jnp.exp((s - m_new).astype(p_dtype))
         alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True,
+                                         dtype=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
         acc_ref[...] = acc_ref[...] * alpha + _dot(
@@ -233,7 +268,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
 
 
 def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-              interpret):
+              interpret, p_dtype=jnp.float32):
     """q [BH, T, D]; k/v [BH, S, D]; bias [B, 1, S] (mapped to the batch
     row b // n_heads by the index_map — no per-head materialization).
     Returns (out [BH,T,D], lse [BH,1,T])."""
@@ -245,7 +280,7 @@ def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
     grid = (BH, T // block_q, n_k)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale, n_k=n_k,
-                          offset=S - T),
+                          offset=S - T, p_dtype=p_dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -277,7 +312,8 @@ def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
 # backward
 # ---------------------------------------------------------------------------
 def _dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
-               dq_ref, acc_ref, *, causal, scale, n_k, offset):
+               dq_ref, acc_ref, *, causal, scale, n_k, offset,
+               p_dtype=jnp.float32):
     """Grid (B*H, n_q, n_k): recompute p block-wise, accumulate dq in
     VMEM scratch, flush on the last k step."""
     q_idx, k_idx = pl.program_id(1), pl.program_id(2)
@@ -298,7 +334,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
         s = s + b_ref[0, :].astype(jnp.float32)[None, :]
         if causal:
             s = _causal_mask(s, q_idx, k_idx, bq, bk, offset)
-        p = jnp.exp(s - lse)                             # [bq, bk]
+        p = jnp.exp((s - lse).astype(p_dtype))           # [bq, bk]
         dp = _dot_t(do_ref[...], v_ref[...])             # [bq, bk]
         ds = p * (dp - delta)
         acc_ref[...] = acc_ref[...] + _dot(
@@ -311,7 +347,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale, n_q,
-                offset):
+                offset, p_dtype=jnp.float32):
     """Grid (B*H, n_kv, n_q), q innermost: recompute p^T block-wise,
     accumulate dk/dv in VMEM scratch."""
     k_idx, q_idx = pl.program_id(1), pl.program_id(2)
@@ -334,7 +370,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
         s = s + b_ref[0, :].astype(jnp.float32)[None, :]
         if causal:
             s = _causal_mask(s, q_idx, k_idx, bq, bk, offset)
-        p = jnp.exp(s - lse).astype(q_ref.dtype)         # [bq, bk]
+        p = jnp.exp((s - lse).astype(p_dtype)).astype(
+            q_ref.dtype)                                 # [bq, bk]
         dv_acc[...] = dv_acc[...] + _dot(p.T, do_ref[...])
         dp = _dot_t(do_ref[...], v_ref[...])             # [bq, bk]
         ds = (p.astype(jnp.float32) * (dp - delta)).astype(q_ref.dtype)
@@ -347,7 +384,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
 
 
 def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
-              g_lse=None):
+              g_lse=None, p_dtype=jnp.float32):
     q, k, v, bias, out, lse = res
     BH, T, D = q.shape
     S = k.shape[1]
@@ -366,7 +403,7 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale, n_k=n_k,
-                          offset=S - T),
+                          offset=S - T, p_dtype=p_dtype),
         grid=(BH, n_q, n_k),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -387,7 +424,7 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q,
-                          offset=S - T),
+                          offset=S - T, p_dtype=p_dtype),
         grid=(BH, n_k, n_q),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
@@ -420,24 +457,25 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper (flat [BH, T, D] layout)
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-           interpret):
+           interpret, p_dtype):
     out, _ = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                       block_k, interpret)
+                       block_k, interpret, p_dtype)
     return out
 
 
 def _flash_fwd(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-               interpret):
+               interpret, p_dtype):
     out, lse = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                         block_k, interpret)
+                         block_k, interpret, p_dtype)
     return out, (q, k, v, bias, out, lse)
 
 
-def _flash_bwd(n_heads, causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(n_heads, causal, scale, block_q, block_k, interpret, p_dtype,
+               res, g):
     dq, dk, dv = _bwd_call(res, g, n_heads, causal, scale, block_q, block_k,
-                           interpret)
+                           interpret, p_dtype=p_dtype)
     # pad biases come from integer lengths: no gradient flows (documented)
     return dq, dk, dv, jnp.zeros_like(res[3])
 
@@ -445,27 +483,27 @@ def _flash_bwd(n_heads, causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash_lse(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-               interpret):
+               interpret, p_dtype):
     """Like _flash but also returns the per-row logsumexp — the merge
     currency of ring attention (parallel/ring_attention.py)."""
     return _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                     block_k, interpret)
+                     block_k, interpret, p_dtype)
 
 
 def _flash_lse_fwd(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-                   interpret):
+                   interpret, p_dtype):
     out, lse = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                         block_k, interpret)
+                         block_k, interpret, p_dtype)
     return (out, lse), (q, k, v, bias, out, lse)
 
 
 def _flash_lse_bwd(n_heads, causal, scale, block_q, block_k, interpret,
-                   res, g):
+                   p_dtype, res, g):
     g_out, g_lse = g
     dq, dk, dv = _bwd_call(res, g_out, n_heads, causal, scale, block_q,
-                           block_k, interpret, g_lse=g_lse)
+                           block_k, interpret, g_lse=g_lse, p_dtype=p_dtype)
     return dq, dk, dv, jnp.zeros_like(res[3])
 
 
@@ -473,7 +511,8 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention_with_lse(q, k, v, bias=None, causal=False, scale=None,
-                             block_q=None, block_k=None, interpret=False):
+                             block_q=None, block_k=None, interpret=False,
+                             softmax_dtype=None):
     """q/k/v [B,H,T,D] → (out [B,H,T,Dv], lse [B,H,T]).
 
     Differentiable (incl. the lse output); the unnormalized-merge entry
@@ -485,8 +524,9 @@ def flash_attention_with_lse(q, k, v, bias=None, causal=False, scale=None,
     qr, kr, vr, br, H, scale, block_q, block_k = _prep(
         q, k, v, bias, scale, block_q or DEFAULT_BLOCK_Q,
         block_k or DEFAULT_BLOCK_K)
+    p_dtype = jnp.dtype(softmax_dtype or _SOFTMAX_DTYPE)
     out, lse = _flash_lse(qr, kr, vr, br, H, bool(causal), scale, block_q,
-                          block_k, bool(interpret))
+                          block_k, bool(interpret), p_dtype)
     return out.reshape(B, H, T, vr.shape[-1]), lse.reshape(B, H, T)
 
 
@@ -537,7 +577,7 @@ def _prep(q, k, v, bias, scale, block_q, block_k):
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=False):
+                    interpret=False, softmax_dtype=None):
     """q/k/v: [B, H, T, D] → [B, H, T, D]. Differentiable (custom_vjp);
     bias is an additive key-padding bias [B, S] or [B,1,1,S]."""
     if not _HAS_PALLAS:
@@ -547,8 +587,9 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     qr, kr, vr, br, H, scale, block_q, block_k = _prep(
         q, k, v, bias, scale, block_q, block_k)
     # per-batch bias row is shared across heads via the kernel index_map
+    p_dtype = jnp.dtype(softmax_dtype or _SOFTMAX_DTYPE)
     out = _flash(qr, kr, vr, br, H, bool(causal), scale, block_q, block_k,
-                 bool(interpret))
+                 bool(interpret), p_dtype)
     return out.reshape(B, H, T, vr.shape[-1])
 
 

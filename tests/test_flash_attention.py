@@ -193,3 +193,51 @@ def test_flash_supports_non_default_block_multiples():
     assert fa._pick_block(4160, 1024) == 0
     q2, k2, v2 = _rand_qkv(rng, T=128, S=4160, D=16)
     assert not fa.supports(q2, k2, v2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_softmax_close_to_reference(causal):
+    """softmax_dtype=bf16 (the VPU-pressure escape): fwd and bwd must
+    stay within bf16-exp tolerance of the f32 reference."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(21)
+    q, k, v = _rand_qkv(rng, T=16, S=16)
+    out = fa.flash_attention(q, k, v, causal=causal, interpret=True,
+                             softmax_dtype=jnp.bfloat16)
+    ref = fa.flash_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+    def loss(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal, interpret=True,
+                               softmax_dtype=jnp.bfloat16)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        o = fa.flash_attention_reference(q, k, v, causal=causal)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-2)
+
+
+def test_flash_softmax_dtype_global_knob():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(22)
+    q, k, v = _rand_qkv(rng, T=16, S=16)
+    try:
+        fa.set_softmax_dtype(jnp.bfloat16)
+        out = fa.flash_attention(q, k, v, interpret=True)
+    finally:
+        fa.set_softmax_dtype(jnp.float32)
+    ref = fa.flash_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+    # knob restored: default path is exact-tolerance again
+    out2 = fa.flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
