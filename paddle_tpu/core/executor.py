@@ -204,8 +204,10 @@ class Executor:
         persist = self._collect_persist(program, scope)
         self._unalias_feeds(feed_arrays, persist)
 
+        from . import trace as _trace
         ckey = (id(program), program._version, _feed_signature(feed_arrays),
-                tuple(fetch_names), bool(is_test), seed)
+                tuple(fetch_names), bool(is_test), seed,
+                _trace.FUSE_OPTIMIZER_TAIL, _trace.FUSE_MAX_ELEMS)
         fn = self._cache.get(ckey) if use_program_cache else None
         # first-run (compile) detection must survive use_program_cache=False
         first_run = ckey not in self._seen_keys
@@ -338,9 +340,11 @@ class Executor:
                 "iteration; falling back to per-step execution (same "
                 "semantics, one dispatch per step)",
                 getattr(dev, "platform", dev))
+            from . import trace as _trace
             ckey = ("scanstep", id(program), program._version,
                     _feed_signature(feed_arrays), tuple(fetch_names),
-                    bool(is_test))
+                    bool(is_test), _trace.FUSE_OPTIMIZER_TAIL,
+                    _trace.FUSE_MAX_ELEMS)
             fn = self._cache.get(ckey)
             if fn is None:
                 step_fn = build_step_fn(program, fetch_names, is_test,
@@ -371,9 +375,11 @@ class Executor:
             fetches = [jnp.stack([o[j] for o in outs])
                        for j in range(len(fetch_names))]
         else:
+            from . import trace as _trace
             ckey = ("scan", steps, id(program), program._version,
                     _feed_signature(feed_arrays), tuple(fetch_names),
-                    bool(is_test))
+                    bool(is_test), _trace.FUSE_OPTIMIZER_TAIL,
+                    _trace.FUSE_MAX_ELEMS)
             fn = self._cache.get(ckey)
             if fn is None:
                 step_fn = build_step_fn(program, fetch_names, is_test,

@@ -24,6 +24,111 @@ from .dtypes import is_float
 
 __all__ = ["build_step_fn", "exec_op"]
 
+# Fuse the per-param optimizer tail (SURVEY §5 headroom note): maximal
+# consecutive runs of adam ops with identical hyperparams+LR are
+# grouped by (shape, dtype) and updated as ONE stacked elementwise
+# kernel instead of one fused kernel per param — transformer-base has
+# ~100 small bias/LayerNorm params whose individual updates are pure
+# per-kernel overhead. Only small params are stacked (the stack/unstack
+# copies a group; for large matmul weights the copy would cost more
+# than the launch it saves). Arithmetic is identical to the per-param
+# kernel (XLA's fusion choices may differ by ~1 ULP). Module-level
+# toggles so benchmarks can A/B.
+FUSE_OPTIMIZER_TAIL = True
+FUSE_MAX_ELEMS = 1 << 18
+
+
+def _adam_sig(op):
+    a = op.attrs
+    return (a.get("beta1", 0.9), a.get("beta2", 0.999),
+            a.get("epsilon", 1e-8), op.inputs["LearningRate"][0])
+
+
+def _plan_update_tail(tail_ops):
+    """Split the update-op tail into plan entries: ("op", op, idx) run
+    one-by-one, ("adam_run", [(op, idx), ...]) eligible for stacked
+    execution. Only CONSECUTIVE same-signature adam ops group — other
+    ops between them keep their program order."""
+    plan = []
+    i = 0
+    while i < len(tail_ops):
+        op, idx = tail_ops[i]
+        if op.type != "adam":
+            plan.append(("op", op, idx))
+            i += 1
+            continue
+        sig = _adam_sig(op)
+        run = [(op, idx)]
+        j = i + 1
+        while j < len(tail_ops) and tail_ops[j][0].type == "adam" \
+                and _adam_sig(tail_ops[j][0]) == sig:
+            run.append(tail_ops[j])
+            j += 1
+        plan.append(("adam_run", run))
+        i = j
+    return plan
+
+
+def _exec_adam_group(env, ops_, is_test, place):
+    """Stacked adam update for params of one (shape, dtype) group: the
+    REGISTERED 'adam' kernel runs once on [N, ...]-stacked inputs (no
+    second copy of the update math to drift), with the per-param [1]
+    beta-pow scalars stacked and reshaped so they broadcast as [N,1..]
+    leading-axis rows."""
+    n = len(ops_)
+
+    def stack(slot):
+        return jnp.stack([env[op.inputs[slot][0]] for op in ops_])
+
+    p = stack("Param")
+    bshape = (n,) + (1,) * (p.ndim - 1)
+    ins = {
+        "Param": [p],
+        "Grad": [stack("Grad")],
+        "Moment1": [stack("Moment1")],
+        "Moment2": [stack("Moment2")],
+        "Beta1Pow": [stack("Beta1Pow").reshape(bshape)],
+        "Beta2Pow": [stack("Beta2Pow").reshape(bshape)],
+        "LearningRate": [env[ops_[0].inputs["LearningRate"][0]]],
+    }
+    ctx = KernelCtx(is_test=is_test, place=place)
+    out = get_kernel("adam")(ctx, ins, ops_[0].attrs)
+    for i, op in enumerate(ops_):
+        env[op.outputs["ParamOut"][0]] = out["ParamOut"][0][i]
+        env[op.outputs["Moment1Out"][0]] = out["Moment1Out"][0][i]
+        env[op.outputs["Moment2Out"][0]] = out["Moment2Out"][0][i]
+        env[op.outputs["Beta1PowOut"][0]] = \
+            out["Beta1PowOut"][0][i].reshape(
+                env[op.inputs["Beta1Pow"][0]].shape)
+        env[op.outputs["Beta2PowOut"][0]] = \
+            out["Beta2PowOut"][0][i].reshape(
+                env[op.inputs["Beta2Pow"][0]].shape)
+
+
+def _exec_adam_run(env, run, key, is_test, place, block):
+    """Execute one consecutive adam run: same-(shape, dtype) params of
+    tail size stack into one kernel; the rest go through exec_op."""
+    groups = {}
+    order = []
+    for op, idx in run:
+        pv = env[op.inputs["Param"][0]]
+        gkey = (tuple(pv.shape), str(pv.dtype))
+        if gkey not in groups:
+            groups[gkey] = []
+            order.append(gkey)
+        groups[gkey].append((op, idx))
+    for gkey in order:
+        members = groups[gkey]
+        n_elems = 1
+        for s in gkey[0]:
+            n_elems *= s
+        if len(members) >= 2 and n_elems <= FUSE_MAX_ELEMS:
+            _exec_adam_group(env, [op for op, _ in members], is_test,
+                             place)
+        else:
+            for op, idx in members:
+                exec_op(env, op, idx, key, is_test, place, block)
+
 
 def _replay_block(program, blk, env, base_key, is_test, place):
     """Execute a sub-block's ops against env (used by control-flow ops)."""
@@ -246,8 +351,19 @@ def build_step_fn(program, fetch_names, is_test, place):
             for n in pnames:
                 env[grad_var_name(n)] = grads[n].astype(env[n].dtype) \
                     if hasattr(grads[n], "astype") else grads[n]
-            for i, op in enumerate(ops[bi + 1:], start=bi + 1):
-                exec_op(env, op, i, key, is_test, place, block)
+            tail = [(op, i) for i, op in
+                    enumerate(ops[bi + 1:], start=bi + 1)]
+            if FUSE_OPTIMIZER_TAIL:
+                for entry in _plan_update_tail(tail):
+                    if entry[0] == "op":
+                        exec_op(env, entry[1], entry[2], key, is_test,
+                                place, block)
+                    else:
+                        _exec_adam_run(env, entry[1], key, is_test,
+                                       place, block)
+            else:
+                for op, i in tail:
+                    exec_op(env, op, i, key, is_test, place, block)
         new_persist = {n: env[n] for n in persist_names if n in env}
         fetches = [env[n] for n in fetch_names]
         return fetches, new_persist

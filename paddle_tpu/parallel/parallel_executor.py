@@ -110,8 +110,10 @@ class ParallelExecutor:
 
         sig = tuple(sorted((k, v.shape, str(v.dtype))
                            for k, v in feed_arrays.items()))
+        from ..core import trace as _trace
         ckey = (id(program), program._version, sig, tuple(fetch_names),
-                bool(is_test))
+                bool(is_test), _trace.FUSE_OPTIMIZER_TAIL,
+                _trace.FUSE_MAX_ELEMS)
         fn = self._cache.get(ckey)
         if fn is None:
             step_fn = build_step_fn(program, fetch_names, is_test, None)
