@@ -24,7 +24,14 @@ Supported extras (covers the flagship transformer end-to-end):
   derived from integer lengths and carry no gradient. Full [B,H,T,S]
   biases take the caller's jnp fallback.
 - `causal`: in-kernel triangular masking + whole-block skipping above
-  the diagonal.
+  the diagonal. `causal_offset` shifts the diagonal (offset -1 = strict
+  triangle, the striped-ring case). CONVENTION for fully-masked rows
+  (possible only with negative offsets): the normalized `out` row is
+  implementation-defined (it averages v over whichever blocks ran — NOT
+  the reference's uniform softmax over all keys), while its lse is
+  ~-1e30, so (out, lse)-merging callers (ring attention) weight it to
+  zero. Do not read fully-masked rows from the plain `flash_attention`
+  output.
 
 Block sizes default to 1024x2048 (tuned on v5e; clamped to a VMEM
 budget per head dim — see _choose_blocks).
@@ -268,7 +275,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
 
 
 def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-              interpret, p_dtype=jnp.float32):
+              interpret, p_dtype=jnp.float32, causal_offset=0):
     """q [BH, T, D]; k/v [BH, S, D]; bias [B, 1, S] (mapped to the batch
     row b // n_heads by the index_map — no per-head materialization).
     Returns (out [BH,T,D], lse [BH,1,T])."""
@@ -280,7 +287,7 @@ def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
     grid = (BH, T // block_q, n_k)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale, n_k=n_k,
-                          offset=S - T, p_dtype=p_dtype),
+                          offset=S - T + causal_offset, p_dtype=p_dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -384,7 +391,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
 
 
 def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
-              g_lse=None, p_dtype=jnp.float32):
+              g_lse=None, p_dtype=jnp.float32, causal_offset=0):
     q, k, v, bias, out, lse = res
     BH, T, D = q.shape
     S = k.shape[1]
@@ -403,7 +410,7 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale, n_k=n_k,
-                          offset=S - T, p_dtype=p_dtype),
+                          offset=S - T + causal_offset, p_dtype=p_dtype),
         grid=(BH, n_q, n_k),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -424,7 +431,7 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q,
-                          offset=S - T, p_dtype=p_dtype),
+                          offset=S - T + causal_offset, p_dtype=p_dtype),
         grid=(BH, n_k, n_q),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
@@ -457,25 +464,27 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper (flat [BH, T, D] layout)
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-           interpret, p_dtype):
+           interpret, p_dtype, causal_offset):
     out, _ = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                       block_k, interpret, p_dtype)
+                       block_k, interpret, p_dtype, causal_offset)
     return out
 
 
 def _flash_fwd(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-               interpret, p_dtype):
+               interpret, p_dtype, causal_offset):
     out, lse = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                         block_k, interpret, p_dtype)
+                         block_k, interpret, p_dtype, causal_offset)
     return out, (q, k, v, bias, out, lse)
 
 
 def _flash_bwd(n_heads, causal, scale, block_q, block_k, interpret, p_dtype,
-               res, g):
+               causal_offset, res, g):
     dq, dk, dv = _bwd_call(res, g, n_heads, causal, scale, block_q, block_k,
-                           interpret, p_dtype=p_dtype)
+                           interpret, p_dtype=p_dtype,
+                           causal_offset=causal_offset)
     # pad biases come from integer lengths: no gradient flows (documented)
     return dq, dk, dv, jnp.zeros_like(res[3])
 
@@ -483,27 +492,29 @@ def _flash_bwd(n_heads, causal, scale, block_q, block_k, interpret, p_dtype,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash_lse(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-               interpret, p_dtype):
+               interpret, p_dtype, causal_offset):
     """Like _flash but also returns the per-row logsumexp — the merge
     currency of ring attention (parallel/ring_attention.py)."""
     return _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                     block_k, interpret, p_dtype)
+                     block_k, interpret, p_dtype, causal_offset)
 
 
 def _flash_lse_fwd(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-                   interpret, p_dtype):
+                   interpret, p_dtype, causal_offset):
     out, lse = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                         block_k, interpret, p_dtype)
+                         block_k, interpret, p_dtype, causal_offset)
     return (out, lse), (q, k, v, bias, out, lse)
 
 
 def _flash_lse_bwd(n_heads, causal, scale, block_q, block_k, interpret,
-                   p_dtype, res, g):
+                   p_dtype, causal_offset, res, g):
     g_out, g_lse = g
     dq, dk, dv = _bwd_call(res, g_out, n_heads, causal, scale, block_q,
-                           block_k, interpret, g_lse=g_lse, p_dtype=p_dtype)
+                           block_k, interpret, g_lse=g_lse, p_dtype=p_dtype,
+                           causal_offset=causal_offset)
     return dq, dk, dv, jnp.zeros_like(res[3])
 
 
@@ -512,7 +523,7 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 def flash_attention_with_lse(q, k, v, bias=None, causal=False, scale=None,
                              block_q=None, block_k=None, interpret=False,
-                             softmax_dtype=None):
+                             softmax_dtype=None, causal_offset=0):
     """q/k/v [B,H,T,D] → (out [B,H,T,Dv], lse [B,H,T]).
 
     Differentiable (incl. the lse output); the unnormalized-merge entry
@@ -526,7 +537,8 @@ def flash_attention_with_lse(q, k, v, bias=None, causal=False, scale=None,
         block_k or DEFAULT_BLOCK_K)
     p_dtype = jnp.dtype(softmax_dtype or _SOFTMAX_DTYPE)
     out, lse = _flash_lse(qr, kr, vr, br, H, bool(causal), scale, block_q,
-                          block_k, bool(interpret), p_dtype)
+                          block_k, bool(interpret), p_dtype,
+                          int(causal_offset))
     return out.reshape(B, H, T, vr.shape[-1]), lse.reshape(B, H, T)
 
 
@@ -577,7 +589,7 @@ def _prep(q, k, v, bias, scale, block_q, block_k):
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=False, softmax_dtype=None):
+                    interpret=False, softmax_dtype=None, causal_offset=0):
     """q/k/v: [B, H, T, D] → [B, H, T, D]. Differentiable (custom_vjp);
     bias is an additive key-padding bias [B, S] or [B,1,1,S]."""
     if not _HAS_PALLAS:
@@ -589,11 +601,12 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     # per-batch bias row is shared across heads via the kernel index_map
     p_dtype = jnp.dtype(softmax_dtype or _SOFTMAX_DTYPE)
     out = _flash(qr, kr, vr, br, H, bool(causal), scale, block_q, block_k,
-                 bool(interpret), p_dtype)
+                 bool(interpret), p_dtype, int(causal_offset))
     return out.reshape(B, H, T, vr.shape[-1])
 
 
-def flash_attention_reference(q, k, v, bias=None, causal=False, scale=None):
+def flash_attention_reference(q, k, v, bias=None, causal=False, scale=None,
+                              causal_offset=0):
     """Unfused jnp reference (for tests)."""
     D = q.shape[-1]
     scale = scale if scale is not None else D ** -0.5
@@ -603,13 +616,15 @@ def flash_attention_reference(q, k, v, bias=None, causal=False, scale=None):
         s = s + b.astype(jnp.float32)
     if causal:
         T, S = s.shape[-2], s.shape[-1]
-        cm = jnp.tril(jnp.ones((T, S), dtype=bool), k=S - T)
+        cm = jnp.tril(jnp.ones((T, S), dtype=bool),
+                      k=S - T + causal_offset)
         s = jnp.where(cm, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v).astype(q.dtype)
 
 
-def try_flash(q, k, v, bias=None, causal=False, scale=None, with_lse=False):
+def try_flash(q, k, v, bias=None, causal=False, scale=None, with_lse=False,
+              causal_offset=0):
     """THE dispatch policy, in one place (used by ops/kernels_nn.py,
     parallel/ring_attention.py, parallel/ulysses.py): returns the Pallas
     result — `out` or `(out, lse)` with `with_lse` — when the kernel is
@@ -625,6 +640,8 @@ def try_flash(q, k, v, bias=None, causal=False, scale=None, with_lse=False):
         return None
     if with_lse:
         return flash_attention_with_lse(q, k, v, bias=bias, causal=causal,
-                                        scale=scale, interpret=interpret)
+                                        scale=scale, interpret=interpret,
+                                        causal_offset=causal_offset)
     return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale,
-                           interpret=interpret)
+                           interpret=interpret,
+                           causal_offset=causal_offset)

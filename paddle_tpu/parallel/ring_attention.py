@@ -18,6 +18,18 @@ Public entry: ring_attention(mesh, q, k, v, causal=...) — call with
 GLOBAL [B, H, T, D] arrays; returns global output. Inside it shard_maps
 over sp. (Ring Attention, Liu et al. 2023 — reimplemented from the
 paper's algorithm, not from any reference code.)
+
+Causal load balancing — `striped=True` (Striped Attention, Brandon et
+al. 2023, same reimplementation caveat): with CONTIGUOUS shards the
+causal mask makes device 0 compute 1 real block and device n-1 compute
+n, but the ring runs in SPMD lockstep, so every one of the n hops costs
+a full block anyway — causal saves FLOPs, not wall time. Striping
+assigns token g to device g % n instead: every (device, hop) pair then
+sees a triangular block — inclusive diagonal when the incoming stripe
+index <= ours, strict (offset -1) otherwise — so all devices do ~half a
+block of work each hop, ~2x faster causal rings. The permutation is a
+reshape/transpose applied at the global entry (and inverted on the
+output), so callers keep contiguous semantics.
 """
 import functools
 
@@ -34,12 +46,13 @@ __all__ = ["ring_attention", "ring_attention_local"]
 _NEG_INF = -1e30
 
 
-def _block_jnp(q, k, v, causal, scale):
+def _block_jnp(q, k, v, causal, scale, causal_offset=0):
     """Fused-XLA block attention → (normalized out, lse)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         T, S = s.shape[-2], s.shape[-1]
-        cm = jnp.tril(jnp.ones((T, S), dtype=bool), k=S - T)
+        cm = jnp.tril(jnp.ones((T, S), dtype=bool),
+                      k=S - T + causal_offset)
         s = jnp.where(cm, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     m = jnp.maximum(m, _NEG_INF)
@@ -53,18 +66,22 @@ def _block_jnp(q, k, v, causal, scale):
 
 def _block_engine(q, k, v, scale):
     """Pick the per-block attention fn (causal: bool) → (out_f32, lse)."""
-    def run(causal):
+    def run(causal, causal_offset=0):
         res = _fa.try_flash(q, k, v, causal=causal, scale=scale,
-                            with_lse=True)
+                            with_lse=True, causal_offset=causal_offset)
         if res is None:
-            return _block_jnp(q, k, v, causal, scale)
+            return _block_jnp(q, k, v, causal, scale, causal_offset)
         out, lse = res
         return out.astype(jnp.float32), lse
     return run
 
 
-def ring_attention_local(q, k, v, axis_name="sp", causal=False, scale=None):
-    """Per-shard body: q/k/v are the LOCAL sequence blocks [B,H,t,D].
+def ring_attention_local(q, k, v, axis_name="sp", causal=False, scale=None,
+                         striped=False):
+    """Per-shard body: q/k/v are the LOCAL sequence blocks [B,H,t,D] —
+    contiguous shards, or stripes (token g on device g % n) with
+    `striped=True`, which load-balances the causal mask (see module
+    docstring).
 
     Must run inside shard_map with `axis_name` bound."""
     n = lax.axis_size(axis_name)
@@ -84,7 +101,20 @@ def ring_attention_local(q, k, v, axis_name="sp", causal=False, scale=None):
     def step(carry, _):
         out, lse, kk, vv, src = carry
         run = _block_engine(q, kk, vv, scale)
-        if causal:
+        if causal and striped:
+            # stripe s_q row p_q holds token p_q*n + s_q: vs stripe src,
+            # token p_k*n + src is visible iff p_k <= p_q (src <= s_q)
+            # or p_k < p_q (src > s_q) — a triangular block either way,
+            # so every device works every hop (the load balance)
+            def diag_incl(_):
+                return run(True, 0)
+
+            def diag_strict(_):
+                return run(True, -1)
+
+            branch = jnp.where(src <= idx, 0, 1)
+            o2, lse2 = lax.switch(branch, (diag_incl, diag_strict), None)
+        elif causal:
             def full(_):
                 return run(False)
 
@@ -123,12 +153,46 @@ def ring_attention_local(q, k, v, axis_name="sp", causal=False, scale=None):
     return out.astype(q.dtype)
 
 
-def ring_attention(mesh, q, k, v, causal=False, scale=None, axis_name="sp"):
-    """Global entry: q/k/v [B,H,T,D] sharded (or shardable) on T over sp."""
+def _stripe(x, n):
+    """Permute [B,H,T,D] so contiguous shards of the result are stripes
+    of the input: result position s*t + p holds token p*n + s."""
+    B, H, T, D = x.shape
+    t = T // n
+    return x.reshape(B, H, t, n, D).swapaxes(2, 3).reshape(B, H, T, D)
+
+
+def _unstripe(x, n):
+    B, H, T, D = x.shape
+    t = T // n
+    return x.reshape(B, H, n, t, D).swapaxes(2, 3).reshape(B, H, T, D)
+
+
+def ring_attention(mesh, q, k, v, causal=False, scale=None, axis_name="sp",
+                   striped=False, pre_striped=False):
+    """Global entry: q/k/v [B,H,T,D] sharded (or shardable) on T over sp.
+
+    `striped=True` load-balances causal masks (Striped Attention). By
+    default the stripe permutation and its inverse are applied HERE so
+    the caller keeps contiguous token order — but that is a cross-device
+    relayout of q/k/v (and the output) per call, roughly doubling comm
+    volume vs the K/V ring itself. Long-context training should stripe
+    ONCE at the data boundary and pass `pre_striped=True` (inputs and
+    output then live in the striped layout; positional encodings etc.
+    must already be applied or also striped)."""
+    n = mesh.shape[axis_name]
+    do_permute = striped and causal and not pre_striped
+    if striped and causal and (q.shape[2] % n or k.shape[2] % n):
+        raise ValueError("striped ring attention needs T % sp == 0")
+    if do_permute:
+        q, k, v = _stripe(q, n), _stripe(k, n), _stripe(v, n)
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale,
+                          striped=striped and causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    if do_permute:
+        out = _unstripe(out, n)
+    return out

@@ -1,0 +1,149 @@
+"""Striped ring attention (causal load balancing): numerics must equal
+the contiguous ring AND the dense reference, including gradients."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.ring_attention import (ring_attention, _stripe,
+                                                _unstripe)
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_reference
+
+
+def _qkv(rng, B=1, H=2, T=32, D=8):
+    return [jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+            for _ in range(3)]
+
+
+def test_stripe_roundtrip():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, 1, 12, 2).astype("float32"))
+    s = _stripe(x, 4)
+    np.testing.assert_array_equal(np.asarray(_unstripe(s, 4)),
+                                  np.asarray(x))
+    # stripe s of the permuted array holds tokens s, s+n, s+2n ...
+    np.testing.assert_array_equal(np.asarray(s[0, 0, :3, 0]),
+                                  np.asarray(x[0, 0, [0, 4, 8], 0]))
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_striped_causal_matches_dense(n):
+    mesh = make_mesh(sp=n, devices=jax.devices()[:n])
+    rng = np.random.RandomState(1)
+    q, k, v = _qkv(rng, T=8 * n)
+    out_s = ring_attention(mesh, q, k, v, causal=True, striped=True)
+    out_c = ring_attention(mesh, q, k, v, causal=True)
+    ref = flash_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_c),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_striped_causal_grads_match_dense():
+    n = 4
+    mesh = make_mesh(sp=n, devices=jax.devices()[:n])
+    rng = np.random.RandomState(2)
+    q, k, v = _qkv(rng, T=8 * n)
+
+    def loss_s(q, k, v):
+        out = ring_attention(mesh, q, k, v, causal=True, striped=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        out = flash_attention_reference(q, k, v, causal=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss_s, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_striped_noncausal_is_plain_ring():
+    """striped has no effect (and applies no permutation) without
+    causal masking."""
+    n = 2
+    mesh = make_mesh(sp=n, devices=jax.devices()[:n])
+    rng = np.random.RandomState(3)
+    q, k, v = _qkv(rng, T=16)
+    out_s = ring_attention(mesh, q, k, v, causal=False, striped=True)
+    ref = flash_attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_striped_requires_divisible_T():
+    mesh = make_mesh(sp=4, devices=jax.devices()[:4])
+    rng = np.random.RandomState(4)
+    q, k, v = _qkv(rng, T=30)
+    with pytest.raises(ValueError, match="sp"):
+        ring_attention(mesh, q, k, v, causal=True, striped=True)
+
+
+def test_flash_causal_offset_strict_triangle():
+    """The kernel-side causal_offset=-1 (the striped strict-triangle
+    case) matches a k=-1 tril reference — on rows that have at least
+    one visible key (row 0 is fully masked: implementation-defined out,
+    lse ~ -inf; the ring merge weights it to zero by convention)."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    rng = np.random.RandomState(5)
+    q, k, v = _qkv(rng, T=16)
+    out = fa.flash_attention(q, k, v, causal=True, causal_offset=-1,
+                             interpret=True)
+    ref = flash_attention_reference(q, k, v, causal=True,
+                                    causal_offset=-1)
+    np.testing.assert_allclose(np.asarray(out[:, :, 1:]),
+                               np.asarray(ref[:, :, 1:]),
+                               rtol=2e-5, atol=2e-5)
+    _, lse = fa.flash_attention_with_lse(
+        q, k, v, causal=True, causal_offset=-1, interpret=True)
+    assert float(lse[0, 0, 0]) < -1e29  # fully-masked row: zero weight
+
+
+def test_striped_grads_through_pallas_kernels():
+    """The backward kernels with causal_offset=-1 (_dq/_dkv via the lse
+    custom_vjp) must match dense — forced through the Pallas interpret
+    path so the kernel-side offset arithmetic is what's tested."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    n = 2
+    mesh = make_mesh(sp=n, devices=jax.devices()[:n])
+    rng = np.random.RandomState(6)
+    q, k, v = _qkv(rng, T=16 * n, D=8)
+
+    def loss_s(q, k, v):
+        out = ring_attention(mesh, q, k, v, causal=True, striped=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        out = flash_attention_reference(q, k, v, causal=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    calls0 = fa.STATS["pallas_calls"]
+    fa.set_mode("interpret")
+    try:
+        g = jax.grad(loss_s, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa.set_mode("auto")
+    assert fa.STATS["pallas_calls"] > calls0  # kernel path, not jnp
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_pre_striped_skips_relayout():
+    """pre_striped=True: inputs/outputs stay in the striped layout (the
+    once-at-the-data-boundary contract) — equal to striping manually."""
+    n = 2
+    mesh = make_mesh(sp=n, devices=jax.devices()[:n])
+    rng = np.random.RandomState(7)
+    q, k, v = _qkv(rng, T=16)
+    ref = ring_attention(mesh, q, k, v, causal=True, striped=True)
+    qs, ks, vs = _stripe(q, n), _stripe(k, n), _stripe(v, n)
+    out_s = ring_attention(mesh, qs, ks, vs, causal=True, striped=True,
+                           pre_striped=True)
+    np.testing.assert_allclose(np.asarray(_unstripe(out_s, n)),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
