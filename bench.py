@@ -451,8 +451,12 @@ def run_benchmarks(platform):
                     "transformer_tokens_per_sec")
         except Exception:
             pass
-        result["vs_baseline"] = round(tokens_per_sec / baseline, 3) \
-            if baseline else 1.0
+        if baseline:
+            ratio = tokens_per_sec / baseline
+            # keep small CPU-fallback ratios visible (0.0002, not 0.0)
+            result["vs_baseline"] = float(f"{ratio:.3g}")
+        else:
+            result["vs_baseline"] = 1.0
 
         for name, fn in (("resnet50_images_per_sec", bench_resnet),
                          ("mnist_mlp_steps_per_sec", bench_mnist)):

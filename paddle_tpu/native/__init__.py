@@ -13,23 +13,46 @@ _SO = os.path.join(_DIR, "libptpu_native.so")
 _lib = None
 
 
+def _build():
+    subprocess.run(["make", "-C", _DIR], check=True,
+                   capture_output=True, timeout=120)
+
+
 def lib():
-    """Load (building if needed) the native library; None if unavailable."""
+    """Load (building if needed) the native library; None if unavailable.
+
+    A stale .so (built before a symbol was added) is detected by the
+    signature setup below raising AttributeError — it is then deleted,
+    rebuilt, and loaded fresh (delete-first so the loader sees a new
+    inode, not the already-mapped old file)."""
     global _lib
     if _lib is not None:
         return _lib
     if not os.path.exists(_SO):
         try:
-            subprocess.run(["make", "-C", _DIR], check=True,
-                           capture_output=True, timeout=120)
+            _build()
         except Exception:
             return None
     try:
-        _lib = ctypes.CDLL(_SO)
+        loaded = ctypes.CDLL(_SO)
     except OSError:
         return None
-    # signatures
-    L = _lib
+    try:
+        _configure(loaded)
+    except AttributeError:
+        try:
+            os.remove(_SO)
+            _build()
+            loaded = ctypes.CDLL(_SO)
+            _configure(loaded)
+        except Exception:
+            return None
+    _lib = loaded
+    return _lib
+
+
+def _configure(L):
+    # signatures — raises AttributeError when the .so predates a symbol
     L.ptpu_recordio_writer_open.restype = ctypes.c_void_p
     L.ptpu_recordio_writer_open.argtypes = [ctypes.c_char_p]
     L.ptpu_recordio_write.restype = ctypes.c_int
@@ -60,4 +83,15 @@ def lib():
     L.ptpu_queue_size.argtypes = [ctypes.c_void_p]
     L.ptpu_queue_close.argtypes = [ctypes.c_void_p]
     L.ptpu_queue_destroy.argtypes = [ctypes.c_void_p]
-    return _lib
+    L.ptpu_multi_reader_open.restype = ctypes.c_void_p
+    L.ptpu_multi_reader_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32]
+    L.ptpu_multi_reader_pop.restype = ctypes.c_int64
+    L.ptpu_multi_reader_pop.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint8),
+                                        ctypes.c_uint64]
+    L.ptpu_multi_reader_errors.restype = ctypes.c_uint64
+    L.ptpu_multi_reader_errors.argtypes = [ctypes.c_void_p]
+    L.ptpu_multi_reader_close.argtypes = [ctypes.c_void_p]
+    L.ptpu_multi_reader_destroy.argtypes = [ctypes.c_void_p]

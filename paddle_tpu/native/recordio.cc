@@ -19,30 +19,15 @@
 #include <string>
 #include <vector>
 
+#include "rio_common.h"
+
 namespace {
 
-constexpr uint32_t kMagic = 0x50545243;  // "PTRC"
-
-uint32_t crc32_table[256];
-bool crc_init_done = false;
-
-void crc_init() {
-  if (crc_init_done) return;
-  for (uint32_t i = 0; i < 256; i++) {
-    uint32_t c = i;
-    for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-    crc32_table[i] = c;
-  }
-  crc_init_done = true;
-}
-
-uint32_t crc32(const uint8_t* buf, size_t len) {
-  crc_init();
-  uint32_t c = 0xFFFFFFFFu;
-  for (size_t i = 0; i < len; i++)
-    c = crc32_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
-}
+using ptpu_rio::kMagic;
+using ptpu_rio::crc32;
+using ptpu_rio::read_u32;
+using ptpu_rio::put_u32;
+using ptpu_rio::write_u32;
 
 struct Writer {
   FILE* f = nullptr;
@@ -58,27 +43,6 @@ struct Reader {
   uint32_t remaining = 0;         // records left in chunk
   bool error = false;
 };
-
-void put_u32(std::vector<uint8_t>& v, uint32_t x) {
-  v.push_back(x & 0xFF);
-  v.push_back((x >> 8) & 0xFF);
-  v.push_back((x >> 16) & 0xFF);
-  v.push_back((x >> 24) & 0xFF);
-}
-
-bool read_u32(FILE* f, uint32_t* out) {
-  uint8_t b[4];
-  if (fread(b, 1, 4, f) != 4) return false;
-  *out = (uint32_t)b[0] | ((uint32_t)b[1] << 8) | ((uint32_t)b[2] << 16) |
-         ((uint32_t)b[3] << 24);
-  return true;
-}
-
-void write_u32(FILE* f, uint32_t x) {
-  uint8_t b[4] = {(uint8_t)(x & 0xFF), (uint8_t)((x >> 8) & 0xFF),
-                  (uint8_t)((x >> 16) & 0xFF), (uint8_t)((x >> 24) & 0xFF)};
-  fwrite(b, 1, 4, f);
-}
 
 void flush_chunk(Writer* w) {
   if (w->n_records == 0) return;

@@ -1,0 +1,198 @@
+// Multithreaded sharded RecordIO reader: N C++ threads stream records
+// from a set of RecordIO files into one bounded queue, entirely off the
+// Python GIL — file IO, CRC verification, and record splitting all
+// happen in native threads while the training loop only pops bytes.
+//
+// Parity: the reference's C++ DataFeed / multi-file reader path
+// (paddle/fluid/operators/reader/open_files_op.cc + framework/
+// data_feed.cc): many files, background readers, one blocking queue.
+// Same file format as recordio.cc (MAGIC | chunks of CRC-checked
+// length-prefixed records). Corrupt chunks are counted and skipped
+// (the feed keeps flowing); ptpu_multi_reader_errors exposes the count.
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "rio_common.h"
+
+namespace {
+
+using ptpu_rio::kMagic;
+using ptpu_rio::kMaxChunkBytes;
+
+struct MultiReader {
+  std::vector<std::string> paths;
+  std::atomic<size_t> next_file{0};
+  std::atomic<uint64_t> errors{0};
+
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::deque<std::vector<uint8_t>> items;
+  size_t capacity = 64;
+  bool closed = false;          // consumer-initiated shutdown
+  size_t producers_live = 0;    // open() sets; threads decrement
+
+  std::vector<std::thread> threads;
+
+  // Blocks while full. False when closed.
+  bool push(std::vector<uint8_t>&& rec) {
+    std::unique_lock<std::mutex> lk(mu);
+    not_full.wait(lk, [&] { return items.size() < capacity || closed; });
+    if (closed) return false;
+    items.emplace_back(std::move(rec));
+    not_empty.notify_one();
+    return true;
+  }
+
+  void producer_done() {
+    std::unique_lock<std::mutex> lk(mu);
+    if (--producers_live == 0) not_empty.notify_all();
+  }
+
+  void read_file(const std::string& path) {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) {
+      errors.fetch_add(1);
+      return;
+    }
+    uint32_t magic = 0;
+    if (!ptpu_rio::read_u32(f, &magic) || magic != kMagic) {
+      errors.fetch_add(1);
+      fclose(f);
+      return;
+    }
+    std::vector<uint8_t> payload;
+    uint32_t n = 0, len = 0, crc = 0;
+    while (ptpu_rio::read_u32(f, &n)) {
+      if (!ptpu_rio::read_u32(f, &len) || !ptpu_rio::read_u32(f, &crc)) {
+        errors.fetch_add(1);
+        break;
+      }
+      if (len > kMaxChunkBytes) {
+        // headers are not CRC-protected: a flipped length byte must be
+        // treated as corruption, not a multi-GiB allocation request
+        errors.fetch_add(1);
+        break;
+      }
+      payload.resize(len);
+      if (len && fread(payload.data(), 1, len, f) != len) {
+        errors.fetch_add(1);
+        break;
+      }
+      if (ptpu_rio::crc32(payload.data(), len) != crc) {
+        // corrupt chunk: count and keep going with the next chunk
+        errors.fetch_add(1);
+        continue;
+      }
+      size_t pos = 0;
+      for (uint32_t r = 0; r < n; r++) {
+        if (pos + 4 > payload.size()) {
+          errors.fetch_add(1);
+          break;
+        }
+        uint32_t rl = (uint32_t)payload[pos] |
+                      ((uint32_t)payload[pos + 1] << 8) |
+                      ((uint32_t)payload[pos + 2] << 16) |
+                      ((uint32_t)payload[pos + 3] << 24);
+        pos += 4;
+        if (pos + rl > payload.size()) {
+          errors.fetch_add(1);
+          break;
+        }
+        std::vector<uint8_t> rec(payload.begin() + pos,
+                                 payload.begin() + pos + rl);
+        pos += rl;
+        if (!push(std::move(rec))) {
+          fclose(f);
+          return;  // consumer closed mid-stream
+        }
+      }
+    }
+    fclose(f);
+  }
+
+  void worker() {
+    for (;;) {
+      size_t i = next_file.fetch_add(1);
+      if (i >= paths.size()) break;
+      try {
+        read_file(paths[i]);
+      } catch (...) {
+        // an escaped exception in a std::thread would std::terminate
+        // the whole process; the contract is count-and-keep-flowing
+        errors.fetch_add(1);
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      if (closed) break;
+    }
+    producer_done();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_multi_reader_open(const char** paths, uint32_t n_paths,
+                             uint32_t n_threads, uint32_t capacity) {
+  auto* m = new MultiReader();
+  for (uint32_t i = 0; i < n_paths; i++) m->paths.emplace_back(paths[i]);
+  m->capacity = capacity ? capacity : 64;
+  uint32_t nt = n_threads ? n_threads : 1;
+  if (nt > n_paths && n_paths) nt = n_paths;
+  m->producers_live = nt;
+  for (uint32_t t = 0; t < nt; t++)
+    m->threads.emplace_back([m] { m->worker(); });
+  return m;
+}
+
+// Returns record length (copied into out; 0 = empty record), -3 when
+// all files are drained (matching ptpu_recordio_read's EOF sentinel),
+// -(needed) when cap is too small (record stays queued).
+int64_t ptpu_multi_reader_pop(void* handle, uint8_t* out, uint64_t cap) {
+  auto* m = static_cast<MultiReader*>(handle);
+  std::unique_lock<std::mutex> lk(m->mu);
+  m->not_empty.wait(lk, [&] {
+    return !m->items.empty() || m->producers_live == 0 || m->closed;
+  });
+  if (m->items.empty()) return -3;  // drained (or closed+empty)
+  auto& it = m->items.front();
+  if (it.size() > cap) return -(int64_t)it.size();
+  uint64_t n = it.size();
+  if (n) std::memcpy(out, it.data(), n);
+  m->items.pop_front();
+  m->not_full.notify_one();
+  return (int64_t)n;
+}
+
+uint64_t ptpu_multi_reader_errors(void* handle) {
+  return static_cast<MultiReader*>(handle)->errors.load();
+}
+
+void ptpu_multi_reader_close(void* handle) {
+  auto* m = static_cast<MultiReader*>(handle);
+  {
+    std::unique_lock<std::mutex> lk(m->mu);
+    m->closed = true;
+    m->not_full.notify_all();
+    m->not_empty.notify_all();
+  }
+  for (auto& t : m->threads)
+    if (t.joinable()) t.join();
+  m->threads.clear();
+}
+
+void ptpu_multi_reader_destroy(void* handle) {
+  auto* m = static_cast<MultiReader*>(handle);
+  ptpu_multi_reader_close(handle);
+  delete m;
+}
+
+}  // extern "C"

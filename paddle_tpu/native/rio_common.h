@@ -1,0 +1,65 @@
+// Shared RecordIO helpers for recordio.cc / recordio_multi.cc — one
+// copy of the CRC32 and little-endian u32 codecs. Header-only; the
+// CRC table is a function-local static (C++ magic static), so first
+// use from ANY thread is safe.
+#ifndef PTPU_NATIVE_RIO_COMMON_H_
+#define PTPU_NATIVE_RIO_COMMON_H_
+
+#include <array>
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+namespace ptpu_rio {
+
+constexpr uint32_t kMagic = 0x50545243;  // "PTRC"
+
+// A chunk length beyond this is treated as corruption, not an
+// allocation request (headers are not CRC-protected).
+constexpr uint32_t kMaxChunkBytes = 1u << 30;
+
+inline const std::array<uint32_t, 256>& crc_table() {
+  static const std::array<uint32_t, 256> table = [] {
+    std::array<uint32_t, 256> t{};
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+    return t;
+  }();
+  return table;
+}
+
+inline uint32_t crc32(const uint8_t* buf, size_t len) {
+  const auto& t = crc_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = t[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+inline bool read_u32(FILE* f, uint32_t* out) {
+  uint8_t b[4];
+  if (fread(b, 1, 4, f) != 4) return false;
+  *out = (uint32_t)b[0] | ((uint32_t)b[1] << 8) | ((uint32_t)b[2] << 16) |
+         ((uint32_t)b[3] << 24);
+  return true;
+}
+
+inline void put_u32(std::vector<uint8_t>& v, uint32_t x) {
+  v.push_back(x & 0xFF);
+  v.push_back((x >> 8) & 0xFF);
+  v.push_back((x >> 16) & 0xFF);
+  v.push_back((x >> 24) & 0xFF);
+}
+
+inline void write_u32(FILE* f, uint32_t x) {
+  uint8_t b[4] = {(uint8_t)(x & 0xFF), (uint8_t)((x >> 8) & 0xFF),
+                  (uint8_t)((x >> 16) & 0xFF), (uint8_t)((x >> 24) & 0xFF)};
+  fwrite(b, 1, 4, f);
+}
+
+}  // namespace ptpu_rio
+
+#endif  // PTPU_NATIVE_RIO_COMMON_H_

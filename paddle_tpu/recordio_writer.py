@@ -12,8 +12,9 @@ import zlib
 
 from . import native
 
-__all__ = ["RecordIOWriter", "RecordIOReader",
-           "convert_reader_to_recordio_file", "recordio_reader"]
+__all__ = ["RecordIOWriter", "RecordIOReader", "ShardedRecordIOReader",
+           "convert_reader_to_recordio_file", "recordio_reader",
+           "sharded_recordio_reader"]
 
 _MAGIC = 0x50545243
 _CHUNK = 1 << 20
@@ -162,6 +163,114 @@ class RecordIOReader:
             self._native = None
         else:
             self._py.close()
+
+
+class ShardedRecordIOReader:
+    """Stream records from MANY recordio files through background C++
+    reader threads (native/recordio_multi.cc): file IO, CRC checks and
+    record splitting run off the GIL while Python only pops bytes — the
+    reference's multi-file C++ DataFeed path (open_files_op +
+    data_feed.cc). Corrupt chunks are skipped and counted
+    (`.error_count`). Record order interleaves shards
+    nondeterministically (thread scheduling); within one shard, order
+    is preserved. Pure-python fallback: round-robin over per-file
+    readers (deterministic interleave), with the SAME degradation
+    contract — missing/corrupt shards and chunks are counted, not
+    raised."""
+
+    def __init__(self, paths, n_threads=2, queue_capacity=256,
+                 use_native=True):
+        self.paths = [str(p) for p in paths]
+        if not self.paths:
+            raise ValueError("ShardedRecordIOReader needs >= 1 path")
+        self._native = None
+        L = native.lib() if use_native else None
+        if L is not None:
+            arr = (ctypes.c_char_p * len(self.paths))(
+                *[p.encode() for p in self.paths])
+            h = L.ptpu_multi_reader_open(arr, len(self.paths),
+                                         int(n_threads),
+                                         int(queue_capacity))
+            if h:
+                self._native = (L, h)
+                self._cap = 1 << 16
+                self._buf = (ctypes.c_uint8 * self._cap)()
+        if self._native is None:
+            self._py_readers = []
+            self._py_errors = 0
+            for p in self.paths:
+                try:
+                    self._py_readers.append(_PyReader(p))
+                except (IOError, OSError):
+                    self._py_errors += 1  # missing/bad-magic shard
+
+    @property
+    def error_count(self):
+        if self._native:
+            L, h = self._native
+            return int(L.ptpu_multi_reader_errors(h))
+        return self._py_errors
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._native:
+            L, h = self._native
+            n = L.ptpu_multi_reader_pop(h, self._buf, self._cap)
+            if n < 0 and -n > self._cap:   # grow buffer, retry
+                self._cap = int(-n)
+                self._buf = (ctypes.c_uint8 * self._cap)()
+                n = L.ptpu_multi_reader_pop(h, self._buf, self._cap)
+            if n == -3:                    # drained
+                raise StopIteration
+            return bytes(self._buf[:n])
+        # python fallback: round-robin over the per-file readers; a
+        # corrupt chunk only skips THAT chunk (the read cursor already
+        # advanced past it), matching the native path
+        while self._py_readers:
+            r = self._py_readers[0]
+            try:
+                rec = r.read()
+            except IOError:
+                self._py_errors += 1
+                continue  # retry same reader: next chunk
+            if rec is None:
+                r.close()
+                self._py_readers.pop(0)
+                continue
+            self._py_readers.append(self._py_readers.pop(0))
+            return rec
+        raise StopIteration
+
+    def close(self):
+        if self._native:
+            L, h = self._native
+            L.ptpu_multi_reader_destroy(h)
+            self._native = None
+        else:
+            for r in self._py_readers:
+                r.close()
+            self._py_readers = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def sharded_recordio_reader(paths, n_threads=2):
+    """Reader creator over many recordio files of pickled samples,
+    streamed by background native threads."""
+    def reader():
+        r = ShardedRecordIOReader(paths, n_threads=n_threads)
+        try:
+            for rec in r:
+                yield pickle.loads(rec)
+        finally:
+            r.close()
+    return reader
 
 
 def convert_reader_to_recordio_file(filename, reader_creator,
