@@ -16,6 +16,7 @@ __all__ = ["CheckpointSaver", "latest_checkpoint",
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "save_checkpoint", "load_checkpoint",
+    "save_sharded_checkpoint", "load_sharded_checkpoint",
 ]
 
 PARAMS_FILE = "params.npz"
@@ -281,3 +282,154 @@ class CheckpointSaver:
         if self._error is not None:
             err, self._error = self._error, None
             raise RuntimeError(f"async checkpoint write failed: {err}")
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints (multi-host-scale state: per-shard files, no
+# full-array gather)
+# ---------------------------------------------------------------------------
+def _np_to_disk(a):
+    """npz has no bfloat16: view 2-byte non-numeric dtypes as uint16
+    and record the true dtype (mirrors inference.save_compiled)."""
+    a = np.asarray(a)
+    dtype = str(a.dtype)
+    if a.dtype.kind not in "biufc":
+        a = a.view(np.uint16 if a.dtype.itemsize == 2 else np.uint8)
+    return a, dtype
+
+
+def _np_from_disk(a, dtype):
+    import jax.numpy as jnp
+    if str(a.dtype) != dtype:
+        a = a.view(jnp.dtype(dtype))
+    return a
+
+
+def save_sharded_checkpoint(dirname, persist, step=0, extra=None):
+    """Write jax.Arrays shard-by-shard: each host saves only ITS
+    addressable shards (`.addressable_shards` — a device->host copy of
+    1/N of the state, never a full-array gather), plus a manifest with
+    the global shape/dtype and the mesh/PartitionSpec layout. At pod
+    scale this is what makes checkpointing feasible: the gather-based
+    save_persistables would pull the full model through every host.
+
+    `persist` is {name: jax.Array} (e.g. a ParallelExecutor scope's
+    values). Replicated-over-some-axes arrays dedupe shards by index.
+    """
+    import jax
+
+    tmp = dirname + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    manifest = {"step": int(step), "extra": extra or {}, "vars": {}}
+    pid = jax.process_index()
+    for name, arr in persist.items():
+        if not isinstance(arr, jax.Array):
+            arr = jax.numpy.asarray(arr)
+        sh = arr.sharding
+        spec = list(getattr(sh, "spec", ())) if hasattr(sh, "spec") else []
+        mesh = getattr(sh, "mesh", None)
+        entry = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "spec": [list(s) if isinstance(s, tuple) else s
+                     for s in spec],
+            "mesh_axes": list(mesh.axis_names) if mesh is not None else [],
+            "mesh_shape": [int(mesh.shape[a]) for a in mesh.axis_names]
+            if mesh is not None else [],
+            "shards": [],
+        }
+        seen = set()
+        fname_base = name.replace("/", "__")
+        for i, shard in enumerate(arr.addressable_shards):
+            # normalize slice(None) (unsharded dims) to explicit bounds
+            # so save and load key shards identically
+            key = tuple(
+                (s.start if s.start is not None else 0,
+                 s.stop if s.stop is not None else arr.shape[d])
+                for d, s in enumerate(shard.index))
+            if key in seen:
+                continue  # replicated copy of an already-saved shard
+            seen.add(key)
+            data, true_dtype = _np_to_disk(shard.data)
+            fn = f"{fname_base}.p{pid}.s{i}.npy"
+            np.save(os.path.join(tmp, fn), data)
+            entry["shards"].append({
+                "file": fn,
+                "index": [list(k) for k in key],
+                "disk_dtype": str(data.dtype),
+            })
+        entry["true_dtype"] = true_dtype
+        manifest["vars"][name] = entry
+    with open(os.path.join(tmp, f"manifest.p{pid}.json"), "w") as f:
+        json.dump(manifest, f)
+    # single-host atomic publish; multi-host callers rename on host 0
+    # after a barrier (jax.experimental.multihost_utils.sync_global_devices)
+    if pid == 0:
+        if os.path.exists(dirname):
+            import shutil
+            shutil.rmtree(dirname)
+        os.replace(tmp, dirname)
+    return manifest
+
+
+def load_sharded_checkpoint(dirname, mesh=None):
+    """Restore {name: jax.Array} with the ORIGINAL shardings: each
+    device loads only the shard file covering its index
+    (jax.make_array_from_single_device_arrays — no host ever holds a
+    full copy of a sharded array). `mesh` must provide the axis names
+    recorded in the manifest (defaults to reconstructing one from the
+    local devices in manifest order)."""
+    import glob as _glob
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    manifests = sorted(_glob.glob(os.path.join(dirname, "manifest.p*.json")))
+    if not manifests:
+        raise IOError(f"no sharded checkpoint manifests in {dirname}")
+    merged = None
+    for mpath in manifests:
+        with open(mpath) as f:
+            m = json.load(f)
+        if merged is None:
+            merged = m
+        else:
+            for n, e in m["vars"].items():
+                merged["vars"].setdefault(n, e)["shards"].extend(
+                    s for s in e["shards"]
+                    if s not in merged["vars"][n]["shards"])
+    out = {}
+    for name, e in merged["vars"].items():
+        shape = tuple(e["shape"])
+        if e["mesh_axes"]:
+            if mesh is None or list(mesh.axis_names) != e["mesh_axes"]:
+                devs = np.array(jax.devices()[:int(np.prod(
+                    e["mesh_shape"]))]).reshape(e["mesh_shape"])
+                mesh_v = Mesh(devs, tuple(e["mesh_axes"]))
+            else:
+                mesh_v = mesh
+            spec = P(*[tuple(s) if isinstance(s, list) else s
+                       for s in e["spec"]])
+            sh = NamedSharding(mesh_v, spec)
+        else:
+            sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        by_index = {}
+        for srec in e["shards"]:
+            data = np.load(os.path.join(dirname, srec["file"]))
+            data = _np_from_disk(data, e["true_dtype"])
+            key = tuple(tuple(ix) for ix in srec["index"])
+            by_index[key] = data
+        dev_map = sh.addressable_devices_indices_map(shape)
+        singles = []
+        for dev, index in dev_map.items():
+            key = tuple((s.start if s.start is not None else 0,
+                         s.stop if s.stop is not None else shape[d])
+                        for d, s in enumerate(index))
+            if key not in by_index:
+                raise IOError(
+                    f"{name}: no shard file for index {key} "
+                    f"(checkpoint saved with a different layout?)")
+            singles.append(jax.device_put(by_index[key], dev))
+        out[name] = jax.make_array_from_single_device_arrays(
+            shape, sh, singles)
+    return out, {"step": merged["step"], "extra": merged["extra"]}

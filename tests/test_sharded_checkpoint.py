@@ -1,0 +1,106 @@
+"""Sharded checkpointing: per-shard files + layout manifest, restored
+with the original shardings via make_array_from_single_device_arrays —
+no full-array gather on save, no full-copy host materialization on
+load."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.io import (save_sharded_checkpoint,
+                           load_sharded_checkpoint)
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+def _sharded_state(mesh):
+    rng = np.random.RandomState(0)
+    w_tp = jax.device_put(rng.randn(8, 16).astype("float32"),
+                          NamedSharding(mesh, P(None, "tp")))
+    w_dp = jax.device_put(rng.randn(16, 4).astype("float32"),
+                          NamedSharding(mesh, P("dp", None)))
+    w_repl = jax.device_put(rng.randn(6).astype("float32"),
+                            NamedSharding(mesh, P()))
+    w_bf16 = jax.device_put(
+        rng.randn(8, 8).astype("float32").astype(jnp.bfloat16),
+        NamedSharding(mesh, P(None, "tp")))
+    return {"w_tp": w_tp, "w_dp": w_dp, "w_repl": w_repl,
+            "w_bf16": w_bf16}
+
+
+def test_roundtrip_preserves_values_and_shardings(tmp_path):
+    mesh = make_mesh(dp=4, tp=2, devices=jax.devices()[:8])
+    state = _sharded_state(mesh)
+    d = str(tmp_path / "ckpt")
+    save_sharded_checkpoint(d, state, step=7, extra={"lr": 0.1})
+    loaded, meta = load_sharded_checkpoint(d, mesh=mesh)
+    assert meta["step"] == 7 and meta["extra"] == {"lr": 0.1}
+    assert set(loaded) == set(state)
+    for n in state:
+        np.testing.assert_array_equal(np.asarray(loaded[n]),
+                                      np.asarray(state[n]), err_msg=n)
+        assert loaded[n].dtype == state[n].dtype
+        assert loaded[n].sharding.spec == state[n].sharding.spec, n
+
+
+def test_shard_files_are_partial_not_full(tmp_path):
+    """The on-disk shard files for a tp-sharded array must each hold
+    1/tp of the data (no gather happened)."""
+    mesh = make_mesh(dp=1, tp=8, devices=jax.devices()[:8])
+    arr = jax.device_put(np.arange(64, dtype="float32").reshape(8, 8),
+                         NamedSharding(mesh, P(None, "tp")))
+    d = str(tmp_path / "ckpt")
+    save_sharded_checkpoint(d, {"w": arr})
+    files = [f for f in os.listdir(d) if f.startswith("w.")]
+    assert len(files) == 8  # one per shard, deduped none (all distinct)
+    for f in files:
+        a = np.load(os.path.join(d, f))
+        assert a.shape == (8, 1)  # 1/8 of the columns
+
+
+def test_replicated_axes_dedupe_shards(tmp_path):
+    """An array replicated over dp writes only its distinct shards."""
+    mesh = make_mesh(dp=4, tp=2, devices=jax.devices()[:8])
+    arr = jax.device_put(np.arange(16, dtype="float32").reshape(2, 8),
+                         NamedSharding(mesh, P(None, "tp")))
+    d = str(tmp_path / "ckpt")
+    save_sharded_checkpoint(d, {"w": arr})
+    files = [f for f in os.listdir(d) if f.startswith("w.")]
+    assert len(files) == 2  # tp=2 distinct shards, not 8 device copies
+
+
+def test_restore_into_fresh_process_mesh(tmp_path):
+    """mesh=None reconstructs the mesh from the manifest (fresh-restart
+    restore path)."""
+    mesh = make_mesh(dp=2, tp=4, devices=jax.devices()[:8])
+    state = _sharded_state(mesh)
+    d = str(tmp_path / "ckpt")
+    save_sharded_checkpoint(d, state)
+    loaded, _ = load_sharded_checkpoint(d)  # no mesh passed
+    for n in state:
+        np.testing.assert_array_equal(np.asarray(loaded[n]),
+                                      np.asarray(state[n]), err_msg=n)
+
+
+def test_layout_mismatch_is_loud(tmp_path):
+    mesh = make_mesh(dp=4, tp=2, devices=jax.devices()[:8])
+    state = {"w": jax.device_put(
+        np.zeros((8, 16), "float32"), NamedSharding(mesh, P(None, "tp")))}
+    d = str(tmp_path / "ckpt")
+    save_sharded_checkpoint(d, state)
+    # corrupt the manifest: claim tp=4 sharding over a tp=2 save
+    import json
+    mp = os.path.join(d, "manifest.p0.json")
+    with open(mp) as f:
+        m = json.load(f)
+    # swap the dp/tp extents: the sharding implied by the (corrupted)
+    # manifest no longer matches the shard files on disk
+    ms = m["vars"]["w"]["mesh_shape"]
+    axes = m["vars"]["w"]["mesh_axes"]
+    ms[axes.index("dp")], ms[axes.index("tp")] = 2, 4
+    with open(mp, "w") as f:
+        json.dump(m, f)
+    with pytest.raises(IOError, match="different layout"):
+        load_sharded_checkpoint(d)
