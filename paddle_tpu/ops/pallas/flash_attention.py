@@ -20,9 +20,15 @@ Supported extras (covers the flagship transformer end-to-end):
   pad-mask the NMT model builds, squeezed). Carried as [B, 1, S] so
   every block keeps Mosaic's (8,128)-or-full tiling rule; the per-head
   grid row maps onto the batch row inside the index_map (no per-head
-  materialization). Bias gradient is returned as zeros — pad biases are
-  derived from integer lengths and carry no gradient. Full [B,H,T,S]
-  biases take the caller's jnp fallback.
+  materialization). The bias is DIFFERENTIABLE: the dkv kernel row-sums
+  the recomputed ds block into a per-(batch,head) [BH,1,S] f32 output
+  (accumulated in-place across the innermost q steps) and the vjp
+  reduces it over heads — a learnable additive bias (e.g. ALiBi-style
+  per-position offsets) trains identically to the jnp reference
+  (tests/test_flash_bias_grad.py). bias=None statically compiles the
+  bias add and the db output out of every kernel, so the no-bias path
+  pays nothing for this feature. Full [B,H,T,S] biases take the
+  caller's jnp fallback.
 - `causal`: in-kernel triangular masking + whole-block skipping above
   the diagonal. `causal_offset` shifts the diagonal (offset -1 = strict
   triangle, the striped-ring case). CONVENTION for fully-masked rows
@@ -227,7 +233,7 @@ def _dot(a, b):
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
                 m_ref, l_ref, acc_ref, *, causal, scale, n_k, offset,
-                p_dtype=jnp.float32):
+                p_dtype=jnp.float32, has_bias=True):
     """Grid (B*H, n_q, n_k), k innermost. q_ref [bq, D]; k/v_ref [bk, D];
     b_ref [1, bk]; scratch m/l [bq, _LANES] (lane-replicated), acc [bq, DV].
     """
@@ -248,7 +254,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         # bf16 operands + fp32 accumulation: full-rate MXU, scale folded in
         # after the matmul
         s = _dot_t(q_ref[...], k_ref[...]) * scale
-        s = s + b_ref[0, :].astype(jnp.float32)[None, :]        # [bq, bk]
+        if has_bias:
+            s = s + b_ref[0, :].astype(jnp.float32)[None, :]    # [bq, bk]
         if causal:
             s = _causal_mask(s, q_idx, k_idx, bq, bk, offset)
         m_prev = m_ref[...][:, :1]                              # [bq, 1]
@@ -275,10 +282,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
 
 
 def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-              interpret, p_dtype=jnp.float32, causal_offset=0):
+              interpret, p_dtype=jnp.float32, causal_offset=0,
+              has_bias=True):
     """q [BH, T, D]; k/v [BH, S, D]; bias [B, 1, S] (mapped to the batch
     row b // n_heads by the index_map — no per-head materialization).
-    Returns (out [BH,T,D], lse [BH,1,T])."""
+    has_bias=False statically skips the bias add (the operand is still
+    threaded, but never read). Returns (out [BH,T,D], lse [BH,1,T])."""
     BH, T, D = q.shape
     S = k.shape[1]
     DV = v.shape[-1]
@@ -287,7 +296,8 @@ def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
     grid = (BH, T // block_q, n_k)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale, n_k=n_k,
-                          offset=S - T + causal_offset, p_dtype=p_dtype),
+                          offset=S - T + causal_offset, p_dtype=p_dtype,
+                          has_bias=has_bias),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -320,7 +330,7 @@ def _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
 # ---------------------------------------------------------------------------
 def _dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
                dq_ref, acc_ref, *, causal, scale, n_k, offset,
-               p_dtype=jnp.float32):
+               p_dtype=jnp.float32, has_bias=True):
     """Grid (B*H, n_q, n_k): recompute p block-wise, accumulate dq in
     VMEM scratch, flush on the last k step."""
     q_idx, k_idx = pl.program_id(1), pl.program_id(2)
@@ -338,7 +348,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
         lse = lse_ref[0, :][:, None]                     # [bq, 1]
         delta = dl_ref[0, :][:, None]                    # [bq, 1]
         s = _dot_t(q_ref[...], k_ref[...]) * scale
-        s = s + b_ref[0, :].astype(jnp.float32)[None, :]
+        if has_bias:
+            s = s + b_ref[0, :].astype(jnp.float32)[None, :]
         if causal:
             s = _causal_mask(s, q_idx, k_idx, bq, bk, offset)
         p = jnp.exp((s - lse).astype(p_dtype))           # [bq, bk]
@@ -353,10 +364,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale, n_q,
-                offset, p_dtype=jnp.float32):
+                *refs, causal, scale, n_q, offset,
+                p_dtype=jnp.float32, has_bias=True):
     """Grid (B*H, n_kv, n_q), q innermost: recompute p^T block-wise,
-    accumulate dk/dv in VMEM scratch."""
+    accumulate dk/dv in VMEM scratch. With has_bias, db_ref [1, bk] is
+    the per-head bias gradient row (d s / d bias = 1): its block index
+    is constant in the innermost q dim, so it stays resident in VMEM and
+    accumulates in-place across the q steps; without it, neither the
+    bias add nor the db output exists (no-bias path pays nothing)."""
+    if has_bias:
+        dk_ref, dv_ref, db_ref, dk_acc, dv_acc = refs
+    else:
+        (dk_ref, dv_ref, dk_acc, dv_acc), db_ref = refs, None
     k_idx, q_idx = pl.program_id(1), pl.program_id(2)
     bk, bq = k_ref.shape[0], q_ref.shape[0]
 
@@ -364,6 +383,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
+        if has_bias:
+            db_ref[...] = jnp.zeros_like(db_ref)
 
     # under causal masking, q blocks strictly above this k block see none of it
     run = _causal_active(q_idx, k_idx, bq, bk, offset) if causal \
@@ -374,14 +395,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
         lse = lse_ref[0, :][:, None]                     # [bq, 1]
         delta = dl_ref[0, :][:, None]
         s = _dot_t(q_ref[...], k_ref[...]) * scale
-        s = s + b_ref[0, :].astype(jnp.float32)[None, :]
+        if has_bias:
+            s = s + b_ref[0, :].astype(jnp.float32)[None, :]
         if causal:
             s = _causal_mask(s, q_idx, k_idx, bq, bk, offset)
         p = jnp.exp((s - lse).astype(p_dtype)).astype(
             q_ref.dtype)                                 # [bq, bk]
         dv_acc[...] = dv_acc[...] + _dot(p.T, do_ref[...])
         dp = _dot_t(do_ref[...], v_ref[...])             # [bq, bk]
-        ds = (p.astype(jnp.float32) * (dp - delta)).astype(q_ref.dtype)
+        ds_f = p.astype(jnp.float32) * (dp - delta)
+        if has_bias:
+            db_ref[0, :] = db_ref[0, :] + jnp.sum(ds_f, axis=0)
+        ds = ds_f.astype(q_ref.dtype)
         dk_acc[...] = dk_acc[...] + _dot(ds.T, q_ref[...]) * scale
 
     @pl.when(q_idx == n_q - 1)
@@ -391,7 +416,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
 
 
 def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
-              g_lse=None, p_dtype=jnp.float32, causal_offset=0):
+              g_lse=None, p_dtype=jnp.float32, causal_offset=0,
+              has_bias=True):
     q, k, v, bias, out, lse = res
     BH, T, D = q.shape
     S = k.shape[1]
@@ -410,7 +436,8 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale, n_k=n_k,
-                          offset=S - T + causal_offset, p_dtype=p_dtype),
+                          offset=S - T + causal_offset, p_dtype=p_dtype,
+                          has_bias=has_bias),
         grid=(BH, n_q, n_k),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -429,9 +456,22 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
         interpret=interpret,
     )(q, k, v, bias, g, lse, delta)
 
-    dk, dv = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((None, block_k, DV), lambda b, j, i: (b, j, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+        jax.ShapeDtypeStruct((BH, S, DV), v.dtype),
+    ]
+    if has_bias:
+        out_specs.append(
+            pl.BlockSpec((None, 1, block_k), lambda b, j, i: (b, 0, j)))
+        out_shape.append(jax.ShapeDtypeStruct((BH, 1, S), jnp.float32))
+    outs = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q,
-                          offset=S - T + causal_offset, p_dtype=p_dtype),
+                          offset=S - T + causal_offset, p_dtype=p_dtype,
+                          has_bias=has_bias),
         grid=(BH, n_k, n_q),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
@@ -442,14 +482,8 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
             pl.BlockSpec((None, 1, block_q), lambda b, j, i: (b, 0, i)),
             pl.BlockSpec((None, 1, block_q), lambda b, j, i: (b, 0, i)),
         ],
-        out_specs=[
-            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, block_k, DV), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, DV), v.dtype),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, DV), jnp.float32),
@@ -458,64 +492,78 @@ def _bwd_call(res, g, n_heads, causal, scale, block_q, block_k, interpret,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, bias, g, lse, delta)
-    return dq, dk, dv
+    if not has_bias:
+        dk, dv = outs
+        return dq, dk, dv, None
+    dk, dv, db_bh = outs
+    # per-head bias-grad rows → the [B, 1, S] layout the kernel consumed
+    db = db_bh.reshape(BH // H, H, S).sum(axis=1, keepdims=True)
+    return dq, dk, dv, db
 
 
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper (flat [BH, T, D] layout)
 # ---------------------------------------------------------------------------
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _flash(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-           interpret, p_dtype, causal_offset):
+           interpret, p_dtype, causal_offset, has_bias):
     out, _ = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                       block_k, interpret, p_dtype, causal_offset)
+                       block_k, interpret, p_dtype, causal_offset,
+                       has_bias)
     return out
 
 
 def _flash_fwd(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-               interpret, p_dtype, causal_offset):
+               interpret, p_dtype, causal_offset, has_bias):
     out, lse = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                         block_k, interpret, p_dtype, causal_offset)
+                         block_k, interpret, p_dtype, causal_offset,
+                         has_bias)
     return out, (q, k, v, bias, out, lse)
 
 
 def _flash_bwd(n_heads, causal, scale, block_q, block_k, interpret, p_dtype,
-               causal_offset, res, g):
-    dq, dk, dv = _bwd_call(res, g, n_heads, causal, scale, block_q, block_k,
-                           interpret, p_dtype=p_dtype,
-                           causal_offset=causal_offset)
-    # pad biases come from integer lengths: no gradient flows (documented)
-    return dq, dk, dv, jnp.zeros_like(res[3])
+               causal_offset, has_bias, res, g):
+    dq, dk, dv, db = _bwd_call(res, g, n_heads, causal, scale, block_q,
+                               block_k, interpret, p_dtype=p_dtype,
+                               causal_offset=causal_offset,
+                               has_bias=has_bias)
+    if db is None:  # fabricated zeros bias: no gradient to report
+        return dq, dk, dv, jnp.zeros_like(res[3])
+    return dq, dk, dv, db.astype(res[3].dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _flash_lse(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-               interpret, p_dtype, causal_offset):
+               interpret, p_dtype, causal_offset, has_bias):
     """Like _flash but also returns the per-row logsumexp — the merge
     currency of ring attention (parallel/ring_attention.py)."""
     return _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                     block_k, interpret, p_dtype, causal_offset)
+                     block_k, interpret, p_dtype, causal_offset, has_bias)
 
 
 def _flash_lse_fwd(q, k, v, bias, n_heads, causal, scale, block_q, block_k,
-                   interpret, p_dtype, causal_offset):
+                   interpret, p_dtype, causal_offset, has_bias):
     out, lse = _fwd_call(q, k, v, bias, n_heads, causal, scale, block_q,
-                         block_k, interpret, p_dtype, causal_offset)
+                         block_k, interpret, p_dtype, causal_offset,
+                         has_bias)
     return (out, lse), (q, k, v, bias, out, lse)
 
 
 def _flash_lse_bwd(n_heads, causal, scale, block_q, block_k, interpret,
-                   p_dtype, causal_offset, res, g):
+                   p_dtype, causal_offset, has_bias, res, g):
     g_out, g_lse = g
-    dq, dk, dv = _bwd_call(res, g_out, n_heads, causal, scale, block_q,
-                           block_k, interpret, g_lse=g_lse, p_dtype=p_dtype,
-                           causal_offset=causal_offset)
-    return dq, dk, dv, jnp.zeros_like(res[3])
+    dq, dk, dv, db = _bwd_call(res, g_out, n_heads, causal, scale, block_q,
+                               block_k, interpret, g_lse=g_lse,
+                               p_dtype=p_dtype, causal_offset=causal_offset,
+                               has_bias=has_bias)
+    if db is None:
+        return dq, dk, dv, jnp.zeros_like(res[3])
+    return dq, dk, dv, db.astype(res[3].dtype)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -538,7 +586,7 @@ def flash_attention_with_lse(q, k, v, bias=None, causal=False, scale=None,
     p_dtype = jnp.dtype(softmax_dtype or _SOFTMAX_DTYPE)
     out, lse = _flash_lse(qr, kr, vr, br, H, bool(causal), scale, block_q,
                           block_k, bool(interpret), p_dtype,
-                          int(causal_offset))
+                          int(causal_offset), bias is not None)
     return out.reshape(B, H, T, vr.shape[-1]), lse.reshape(B, H, T)
 
 
@@ -601,7 +649,8 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     # per-batch bias row is shared across heads via the kernel index_map
     p_dtype = jnp.dtype(softmax_dtype or _SOFTMAX_DTYPE)
     out = _flash(qr, kr, vr, br, H, bool(causal), scale, block_q, block_k,
-                 bool(interpret), p_dtype, int(causal_offset))
+                 bool(interpret), p_dtype, int(causal_offset),
+                 bias is not None)
     return out.reshape(B, H, T, vr.shape[-1])
 
 

@@ -1,0 +1,101 @@
+"""Learnable additive-bias gradient through the Pallas flash kernel.
+
+VERDICT r3 #6: a caller passing a *learnable* [B, S] bias used to get a
+silently-zero gradient. The dkv kernel now row-sums the recomputed ds
+block into a per-head [BH, 1, S] output and the vjp reduces it over
+heads, so d loss / d bias matches the unfused jnp reference exactly
+(up to fp accumulation order). Covers both custom_vjp entry points
+(flash_attention and flash_attention_with_lse) and a short training
+loop where only the bias is trained.
+
+Ref analog: an additive attention bias in the reference flows through
+softmax's symbolic grad ops (paddle/fluid/operators/softmax_op.cc) —
+gradients never silently vanish there either.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def _qkv(rng, B=2, H=2, T=32, S=None, D=16):
+    S = S or T
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bias_grad_matches_reference(causal):
+    rng = np.random.RandomState(0)
+    q, k, v = _qkv(rng)
+    bias = jnp.asarray(0.1 * rng.randn(2, 32).astype("float32"))
+
+    def loss_flash(b):
+        out = fa.flash_attention(q, k, v, bias=b, causal=causal,
+                                 interpret=True)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ref(b):
+        out = fa.flash_attention_reference(q, k, v, bias=b, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    g_flash = jax.grad(loss_flash)(bias)
+    g_ref = jax.grad(loss_ref)(bias)
+    assert float(jnp.max(jnp.abs(g_ref))) > 1e-3  # non-trivial gradient
+    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_bias_grad_cross_attention_and_lse():
+    """T != S, through the with_lse entry point (ring-attention path),
+    including the lse cotangent's own bias contribution."""
+    rng = np.random.RandomState(1)
+    q, k, v = _qkv(rng, T=16, S=32)
+    bias = jnp.asarray(0.1 * rng.randn(2, 32).astype("float32"))
+
+    def loss_flash(b):
+        out, lse = fa.flash_attention_with_lse(q, k, v, bias=b,
+                                               interpret=True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(b):
+        D = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * D ** -0.5
+        s = s + b[:, None, None, :]
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    g_flash = jax.grad(loss_flash)(bias)
+    g_ref = jax.grad(loss_ref)(bias)
+    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_learnable_bias_trains():
+    """SGD on the bias alone reduces the loss — the r3 hazard (silent
+    zero grad) would leave the loss flat."""
+    rng = np.random.RandomState(2)
+    q, k, v = _qkv(rng, B=1, H=2, T=32)
+    target = jnp.asarray(rng.randn(1, 2, 32, 16).astype("float32"))
+
+    def loss_fn(b):
+        out = fa.flash_attention(q, k, v, bias=b, interpret=True)
+        return jnp.mean((out - target) ** 2)
+
+    b = jnp.zeros((1, 32), jnp.float32)
+    l0 = float(loss_fn(b))
+    g = jax.grad(loss_fn)
+    g0 = g(b)
+    # the r3 hazard: gradient silently all-zero
+    assert float(jnp.max(jnp.abs(g0))) > 0.0
+    for _ in range(20):
+        b = b - 5.0 * g(b)
+    l1 = float(loss_fn(b))
+    # attention weights bound how much a bias-only train can move the
+    # loss; require a strict, non-noise decrease rather than a fixed %
+    assert l1 < l0 - 1e-6, (l0, l1)
