@@ -42,20 +42,78 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             f"loss {loss.name!r} has shape {loss.shape}; reduce it to a "
             f"scalar (e.g. layers.mean) before minimize/append_backward")
 
-    pnames = [p.name for p in params]
+    # Partition: params with a _sparse_lookup annotation (embedding
+    # is_sparse=True) get ROW gradients via their delta taps instead of
+    # a dense [V, D] gradient — the SelectedRows analog (ref
+    # paddle/fluid/operators/lookup_table_op.cc is_sparse path).
+    # A table that is ALSO consumed outside its is_sparse lookups
+    # (weight tying, a second is_sparse=False lookup) must stay dense:
+    # the row taps only see the lookup contributions, so the sparse
+    # path would silently drop the other gradients.
+    def _only_sparse_consumers(p):
+        for blk in program.blocks:
+            for op in blk.ops:
+                if op.type == "backward_macro":
+                    continue
+                for slot, names in op.inputs.items():
+                    if p.name not in names:
+                        continue
+                    is_tap = (op.attrs.get("is_sparse")
+                              and slot == "W"
+                              and op.inputs.get("SparseDelta"))
+                    if not is_tap:
+                        return False
+        return True
+
+    dense, sparse = [], []
+    for p in params:
+        if not getattr(p, "_sparse_lookup", None):
+            dense.append(p)
+        elif _only_sparse_consumers(p):
+            sparse.append(p)
+        else:
+            import warnings
+            warnings.warn(
+                f"parameter {p.name!r} has is_sparse lookups but is "
+                "also consumed by other ops; falling back to DENSE "
+                "gradients/updates so no contribution is lost")
+            p._sparse_lookup = None  # optimizer must treat it dense too
+            dense.append(p)
+
+    pnames = [p.name for p in dense]
     gnames = [grad_var_name(n) for n in pnames]
-    for p, g in zip(params, gnames):
+    for p, g in zip(dense, gnames):
         block.create_var(name=g, shape=p.shape, dtype=p.dtype,
                          stop_gradient=True)
+
+    sparse_specs = []
+    sparse_gnames = []
+    for p in sparse:
+        taps = []
+        for tap in p._sparse_lookup:
+            dvar = block.var(tap["delta"])
+            gname = grad_var_name(tap["delta"])
+            block.create_var(name=gname, shape=dvar.shape,
+                             dtype=dvar.dtype, stop_gradient=True)
+            taps.append({"ids": tap["ids"], "delta": tap["delta"],
+                         "grad": gname})
+            sparse_gnames.append(gname)
+        sparse_specs.append({"param": p.name, "taps": taps})
 
     block.append_op(
         type="backward_macro",
         inputs={"Loss": [loss.name]},
-        outputs={"Grads": gnames},
+        outputs={"Grads": gnames + sparse_gnames},
         attrs={"param_names": pnames, "loss_name": loss.name,
-               "is_backward_op": True})
-    program._backward_sections.append({"loss": loss.name, "params": pnames})
-    return [(p, block.var(g)) for p, g in zip(params, gnames)]
+               "sparse_params": sparse_specs, "is_backward_op": True})
+    program._backward_sections.append(
+        {"loss": loss.name, "params": pnames + [p.name for p in sparse]})
+    pairs = [(p, block.var(g)) for p, g in zip(dense, gnames)]
+    # sparse pairs expose the first tap's row-grad var; optimizers
+    # consult param._sparse_lookup for the full tap list
+    pairs += [(p, block.var(grad_var_name(p._sparse_lookup[0]["delta"])))
+              for p in sparse]
+    return pairs
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

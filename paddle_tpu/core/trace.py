@@ -311,6 +311,29 @@ def _prune_ops(program, ops, fetch_names):
     return list(reversed(kept))
 
 
+def _collect_sparse_deltas(program, ops):
+    """(delta_name, param_name) for every is_sparse lookup in ops,
+    recursing into control-flow sub-blocks (deltas must be seeded in
+    env before any replay touches the op)."""
+    out = []
+    seen_blocks = set()
+
+    def scan(op_list):
+        for op in op_list:
+            if op.attrs.get("is_sparse") and op.inputs.get("SparseDelta"):
+                out.append((op.inputs["SparseDelta"][0],
+                            op.inputs["W"][0]))
+            for key in ("true_block", "false_block", "cond_block",
+                        "body_block", "step_block"):
+                bidx = op.attrs.get(key)
+                if bidx is not None and bidx not in seen_blocks:
+                    seen_blocks.add(bidx)
+                    scan(program.blocks[bidx].ops)
+
+    scan(ops)
+    return out
+
+
 def build_step_fn(program, fetch_names, is_test, place):
     """Returns step(persist, feed, key) -> (fetches, new_persist).
 
@@ -319,11 +342,18 @@ def build_step_fn(program, fetch_names, is_test, place):
     ops = _prune_ops(program, list(block.ops), fetch_names)
     persist_names = [v.name for v in program.persistable_vars()]
     bi = _find_backward(ops)
+    sparse_deltas = _collect_sparse_deltas(program, ops)
 
     def step(persist, feed, key):
         env = {}
         env.update(feed)
         env.update(persist)
+        # is_sparse lookup taps: scalar zero by default (broadcasts in
+        # the lookup add); the training path below overrides the ones
+        # in its diff set with full-shape zeros so grads are ROW grads
+        for dname, wname in sparse_deltas:
+            if wname in env:
+                env[dname] = jnp.zeros((), env[wname].dtype)
         if bi is None:
             for i, op in enumerate(ops):
                 exec_op(env, op, i, key, is_test, place, block)
@@ -347,10 +377,45 @@ def build_step_fn(program, fetch_names, is_test, place):
                 fwd = jax.checkpoint(fwd)
 
             pvals = {n: env[n] for n in pnames}
+            # row-sparse embedding taps: the delta joins the diff set
+            # with the GATHERED shape (ids + [D]) — its gradient is the
+            # row gradient; the [V, D] table never densifies (the
+            # SelectedRows-grad analog, ref lookup_table_op.cc)
+            sparse_specs = bop.attrs.get("sparse_params", [])
+            tap_grads = {}  # delta name -> row-grad var name
+            ids_shapes = {}
+            missing = [t["ids"] for s in sparse_specs for t in s["taps"]
+                       if t["ids"] not in env]
+            if missing:
+                # ids produced INSIDE the forward (e.g. a cast/reshape
+                # of a feed): shapes are static, so one abstract replay
+                # of the forward segment (scalar-zero deltas already in
+                # base_env) yields them without running anything
+                def _probe(_):
+                    e = dict(base_env)
+                    for i, op in enumerate(ops[:bi]):
+                        exec_op(e, op, i, key, is_test, place, block)
+                    return {n: e[n] for n in missing}
+
+                ids_shapes = {n: v.shape for n, v in
+                              jax.eval_shape(_probe, 0).items()}
+            for spec in sparse_specs:
+                wv = env[spec["param"]]
+                for tap in spec["taps"]:
+                    ishape = tuple(env[tap["ids"]].shape
+                                   if tap["ids"] in env
+                                   else ids_shapes[tap["ids"]])
+                    if ishape and ishape[-1] == 1:
+                        ishape = ishape[:-1]
+                    pvals[tap["delta"]] = jnp.zeros(
+                        ishape + (wv.shape[-1],), wv.dtype)
+                    tap_grads[tap["delta"]] = tap["grad"]
             (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(pvals)
             for n in pnames:
                 env[grad_var_name(n)] = grads[n].astype(env[n].dtype) \
                     if hasattr(grads[n], "astype") else grads[n]
+            for dname, gname in tap_grads.items():
+                env[gname] = grads[dname]
             tail = [(op, i) for i, op in
                     enumerate(ops[bi + 1:], start=bi + 1)]
             if FUSE_OPTIMIZER_TAIL:

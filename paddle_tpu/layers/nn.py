@@ -118,8 +118,18 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32", name=None):
-    """ref layers/nn.py:embedding (lookup_table op). is_sparse is accepted
-    for API parity; dense gather is the TPU-efficient path."""
+    """ref layers/nn.py:embedding (lookup_table op, lookup_table_op.cc).
+
+    is_sparse=True enables the ROW-SPARSE update path — the XLA-native
+    analog of the reference's SelectedRows gradients: the backward
+    taps the gathered rows through a zero "delta" input (so the table
+    gradient is [..., D] row gradients, never a densified [V, D]
+    scatter-add), and the optimizer applies a lazy row-sparse update
+    (sparse_adam / sparse_sgd kernels) touching only the rows in Ids.
+    Semantics match the reference's lazy_mode (optimizer.py:697):
+    untouched rows keep their moments; regularizers/clip are not
+    applied to sparse tables. Dense (default) remains the
+    MXU-efficient path for small vocabularies."""
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(param_attr, shape=_dims(size), dtype=dtype,
                                 default_initializer=NormalInitializer(0.0, 0.02))
@@ -129,8 +139,18 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     else:
         out_shape = tuple(in_shape) + (size[1],)
     out = helper.create_variable_for_type_inference(dtype, out_shape)
-    helper.append_op("lookup_table", {"W": [w], "Ids": [input]}, {"Out": [out]},
-                     {"padding_idx": -1 if padding_idx is None else padding_idx})
+    inputs = {"W": [w], "Ids": [input]}
+    attrs = {"padding_idx": -1 if padding_idx is None else padding_idx}
+    if is_sparse:
+        # the row-grad tap: trace seeds it with zeros of the gathered
+        # shape inside the diff set; its gradient IS the row gradient
+        delta = helper.create_variable_for_type_inference(dtype, out_shape)
+        inputs["SparseDelta"] = [delta]
+        attrs["is_sparse"] = True
+        taps = getattr(w, "_sparse_lookup", None) or []
+        taps.append({"ids": input.name, "delta": delta.name})
+        w._sparse_lookup = taps
+    helper.append_op("lookup_table", inputs, {"Out": [out]}, attrs)
     return out
 
 

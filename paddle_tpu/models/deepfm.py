@@ -1,7 +1,12 @@
 """DeepFM CTR model (BASELINE.json config 5: high-dim sparse embedding +
 factorization machine + deep tower). Reference pattern: Paddle CTR
-models (pserver-era); here the embedding is a dense MXU gather and the
-whole model compiles into one XLA module.
+models (pserver-era); here the embedding gather and the whole model
+compile into one XLA module, and is_sparse=True (default, matching the
+reference CTR configs' lookup_table is_sparse) routes the giant tables
+through the row-sparse lazy-update path: gradients stay [B*F, D] row
+grads and the optimizer touches only the looked-up rows — O(batch)
+update bandwidth instead of O(vocab) (ref lookup_table_op.cc +
+optimizer.py lazy_mode, replacing the pserver sparse send/recv).
 """
 from .. import layers
 
@@ -9,10 +14,11 @@ __all__ = ["deepfm", "build_program"]
 
 
 def deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim=10,
-           deep_layers=(400, 400, 400)):
+           deep_layers=(400, 400, 400), is_sparse=True):
     """feat_ids/feat_vals: [B, num_fields(,1)] sparse-feature ids+values."""
     # ---- first-order term: w_i * x_i
-    first_w = layers.embedding(feat_ids, size=[vocab_size, 1])   # [B,F,1]
+    first_w = layers.embedding(feat_ids, size=[vocab_size, 1],
+                               is_sparse=is_sparse)               # [B,F,1]
     vals = layers.unsqueeze(feat_vals, [2]) \
         if len(feat_vals.shape) == 2 else feat_vals
     first = layers.reduce_sum(
@@ -20,7 +26,8 @@ def deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim=10,
                                layers.squeeze(vals, [2])), dim=1,
         keep_dim=True)                                            # [B,1]
     # ---- second-order FM term: 0.5*((sum v x)^2 - sum (v x)^2)
-    emb = layers.embedding(feat_ids, size=[vocab_size, embed_dim])  # [B,F,D]
+    emb = layers.embedding(feat_ids, size=[vocab_size, embed_dim],
+                           is_sparse=is_sparse)                   # [B,F,D]
     vx = layers.elementwise_mul(emb, vals)                        # broadcast
     sum_vx = layers.reduce_sum(vx, dim=1)                         # [B,D]
     sum_sq = layers.elementwise_mul(sum_vx, sum_vx)
@@ -39,12 +46,14 @@ def deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim=10,
     return logit
 
 
-def build_program(num_fields=26, vocab_size=100000, embed_dim=10):
+def build_program(num_fields=26, vocab_size=100000, embed_dim=10,
+                  is_sparse=True):
     feat_ids = layers.data("feat_ids", shape=[num_fields], dtype="int64")
     feat_vals = layers.data("feat_vals", shape=[num_fields],
                             dtype="float32")
     label = layers.data("label", shape=[1], dtype="float32")
-    logit = deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim)
+    logit = deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim,
+                   is_sparse=is_sparse)
     loss = layers.mean(
         layers.sigmoid_cross_entropy_with_logits(logit, label))
     from ..layers import ops

@@ -354,3 +354,92 @@ def _precision_recall(ctx, ins, attrs):
     return {"BatchMetrics": [batch_metrics],
             "AccumMetrics": [metrics(states)],
             "AccumStatesInfo": [states]}
+
+
+# ---------------------------------------------------------------------------
+# row-sparse (lazy) updates for embedding tables
+# ---------------------------------------------------------------------------
+def _dedup_rows(ids, rows, vocab):
+    """Static-shape duplicate-id reduction: sort ids, segment-sum their
+    rows, return (uids [N], summed [N, D]) where each distinct id
+    appears once with its rows summed and every padding position
+    carries id == vocab (dropped by the caller's scatter). This is the
+    XLA-native equivalent of merging a SelectedRows gradient's
+    duplicate rows (ref paddle/fluid/operators/math/
+    selected_rows_functor.cc:MergeAdd) — no [V, D] densification."""
+    flat = ids.reshape(-1).astype(jnp.int32)
+    # the forward lookup CLIPS out-of-range ids to [0, V-1] (see
+    # _lookup_table's jnp.clip) — the update must hit the same rows,
+    # not silently drop them
+    flat = jnp.clip(flat, 0, vocab - 1)
+    n = flat.shape[0]
+    g = rows.reshape(n, -1).astype(jnp.float32)
+    order = jnp.argsort(flat)
+    sid = jnp.take(flat, order)
+    sg = jnp.take(g, order, axis=0)
+    first = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                             (sid[1:] != sid[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(first)                     # [N] segment index
+    summed = jax.ops.segment_sum(sg, seg, num_segments=n)
+    # all positions of a segment write the same sid value
+    uids = jnp.full((n,), vocab, jnp.int32).at[seg].set(sid)
+    return uids, summed
+
+
+def _merge_taps(ins, dim):
+    """Concatenate every (Ids, Grad) tap pair — a table shared by
+    several lookups contributes one merged (ids, rows) stream so the
+    update is applied exactly once (SelectedRows MergeAdd)."""
+    ids = jnp.concatenate([i.reshape(-1) for i in ins["Ids"]])
+    rows = jnp.concatenate([g.reshape(-1, dim) for g in ins["Grad"]])
+    return ids, rows
+
+
+@kernel("sparse_sgd")
+def _sparse_sgd(ctx, ins, attrs):
+    """Row-sparse SGD: only rows named by Ids change (ref
+    lookup_table_op.cc is_sparse=True + sgd_op.cc SelectedRows path).
+    Grad holds the gathered-row gradients [..., D], never [V, D]."""
+    p = ins["Param"][0]
+    ids, g = _merge_taps(ins, p.shape[-1])
+    uids, gsum = _dedup_rows(ids, g, p.shape[0])
+    rows = jnp.take(p, jnp.clip(uids, 0, p.shape[0] - 1), axis=0)
+    new_rows = rows.astype(jnp.float32) - _lr(ins) * gsum
+    out = p.at[uids].set(new_rows.astype(p.dtype), mode="drop",
+                         indices_are_sorted=True)
+    return {"ParamOut": [out]}
+
+
+@kernel("sparse_adam")
+def _sparse_adam(ctx, ins, attrs):
+    """Lazy row-sparse Adam (ref optimizer.py:697 lazy_mode=True +
+    adam_op.h SparseAdamFunctor): moments and param update ONLY on the
+    rows present in Ids; untouched rows keep their moments (no decay),
+    matching the reference's lazy mode. Beta-pow accumulators advance
+    every step (global bias correction, same as the reference). All
+    row math in fp32 regardless of param dtype."""
+    p = ins["Param"][0]
+    ids, g = _merge_taps(ins, p.shape[-1])
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    vocab = p.shape[0]
+    uids, gsum = _dedup_rows(ids, g, vocab)
+    safe = jnp.clip(uids, 0, vocab - 1)
+    m_rows = jnp.take(m, safe, axis=0)
+    v_rows = jnp.take(v, safe, axis=0)
+    p_rows = jnp.take(p, safe, axis=0).astype(jnp.float32)
+    m_new = b1 * m_rows + (1 - b1) * gsum
+    v_new = b2 * v_rows + (1 - b2) * jnp.square(gsum)
+    b1p_new = b1p * b1
+    b2p_new = b2p * b2
+    lr_t = lr * jnp.sqrt(1 - b2p_new.reshape(())) / (1 - b1p_new.reshape(()))
+    p_new_rows = p_rows - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    kw = dict(mode="drop", indices_are_sorted=True)
+    return {"ParamOut": [p.at[uids].set(p_new_rows.astype(p.dtype), **kw)],
+            "Moment1Out": [m.at[uids].set(m_new, **kw)],
+            "Moment2Out": [v.at[uids].set(v_new, **kw)],
+            "Beta1PowOut": [b1p_new], "Beta2PowOut": [b2p_new]}

@@ -353,6 +353,11 @@ def _lookup_table(ctx, ins, attrs):
         ids = jnp.squeeze(ids, -1)
     padding_idx = attrs.get("padding_idx", -1)
     out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    if ins.get("SparseDelta"):
+        # is_sparse row-grad tap (zeros; full-shape inside the diff set,
+        # scalar zero otherwise) — added before the padding mask so
+        # padded positions carry zero row gradients
+        out = out + ins["SparseDelta"][0]
     if padding_idx is not None and padding_idx >= 0:
         mask = (ids == padding_idx)[..., None]
         out = jnp.where(mask, jnp.zeros_like(out), out)

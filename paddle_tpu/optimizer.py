@@ -76,18 +76,36 @@ class Optimizer:
                  no_grad_set=None):
         return append_backward(loss, parameter_list, no_grad_set)
 
+    def _append_sparse_optimize_op(self, block, param):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no row-sparse update; use Adam "
+            "or SGD for embedding(is_sparse=True) tables (the reference "
+            "supports SelectedRows grads for the same pair — "
+            "adam_op.h/sgd_op.h)")
+
     def apply_gradients(self, params_grads):
         block = params_grads[0][0].block.program.global_block()
-        params_grads = append_gradient_clip_ops(params_grads)
-        params_grads = append_regularization_ops(params_grads,
-                                                 self.regularization)
+        # row-sparse embedding tables bypass clip/regularization (the
+        # reference's SelectedRows path likewise skips global-norm clip
+        # and L2Decay densification) and get lazy row updates
+        sparse = [(p, g) for p, g in params_grads
+                  if getattr(p, "_sparse_lookup", None)]
+        dense = [pg for pg in params_grads
+                 if not getattr(pg[0], "_sparse_lookup", None)]
+        if dense:
+            dense = append_gradient_clip_ops(dense)
+            dense = append_regularization_ops(dense, self.regularization)
         self._create_lr_var(block)
-        self._create_accumulators(block, [p for p, _ in params_grads])
+        self._create_accumulators(block, [p for p, _ in dense + sparse])
         ops = []
-        for pg in params_grads:
+        for pg in dense:
             op = self._append_optimize_op(block, pg)
             op.attrs["is_optimizer_op"] = True
             ops.append(op)
+        for p, _ in sparse:
+            for op in self._append_sparse_optimize_op(block, p):
+                op.attrs["is_optimizer_op"] = True
+                ops.append(op)
         return ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
@@ -105,6 +123,21 @@ class SGD(Optimizer):
             "sgd",
             {"Param": [p], "Grad": [g], "LearningRate": [self._lr_var]},
             {"ParamOut": [p]}, {})
+
+    def _append_sparse_optimize_op(self, block, p):
+        # ONE op per table, all lookup taps merged: the kernel
+        # concatenates ids+row-grads before dedup, so a table shared by
+        # several lookups gets a single combined update (SelectedRows
+        # MergeAdd semantics)
+        from .core.framework import grad_var_name
+        return [block.append_op(
+            "sparse_sgd",
+            {"Param": [p],
+             "Grad": [block.var(grad_var_name(t["delta"]))
+                      for t in p._sparse_lookup],
+             "Ids": [block.var(t["ids"]) for t in p._sparse_lookup],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p]}, {})]
 
 
 class Momentum(Optimizer):
@@ -178,6 +211,32 @@ class Adam(Optimizer):
              "Beta2PowOut": [self._get_accumulator("beta2_pow", p)]},
             {"beta1": self._beta1, "beta2": self._beta2,
              "epsilon": self._epsilon})
+
+    def _append_sparse_optimize_op(self, block, p):
+        """Lazy row-sparse Adam (ref optimizer.py lazy_mode +
+        adam_op.h SparseAdamFunctor): ONE sparse_adam op per table with
+        every lookup tap's (ids, row-grads) merged by the kernel before
+        dedup — a shared table gets one combined update per step and
+        the beta-pow accumulators advance exactly once."""
+        from .core.framework import grad_var_name
+        return [block.append_op(
+            "sparse_adam",
+            {"Param": [p],
+             "Grad": [block.var(grad_var_name(t["delta"]))
+                      for t in p._sparse_lookup],
+             "Ids": [block.var(t["ids"]) for t in p._sparse_lookup],
+             "Moment1": [self._get_accumulator("moment1", p)],
+             "Moment2": [self._get_accumulator("moment2", p)],
+             "Beta1Pow": [self._get_accumulator("beta1_pow", p)],
+             "Beta2Pow": [self._get_accumulator("beta2_pow", p)],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p],
+             "Moment1Out": [self._get_accumulator("moment1", p)],
+             "Moment2Out": [self._get_accumulator("moment2", p)],
+             "Beta1PowOut": [self._get_accumulator("beta1_pow", p)],
+             "Beta2PowOut": [self._get_accumulator("beta2_pow", p)]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})]
 
 
 class Adamax(Optimizer):
