@@ -1,0 +1,74 @@
+"""Worker process for tests/test_multihost.py — NOT a test module.
+
+Run as: python _multihost_worker.py <process_id> <num_processes> <port>
+
+Initializes the real multi-process runtime (fleet.init →
+jax.distributed.initialize) on the CPU backend with 2 local virtual
+devices per process, builds a GLOBAL mesh spanning both processes, and
+runs a psum whose operand is globally sharded — the XLA collective
+actually crosses the process boundary (the reference's NCCL/gRPC
+all-reduce analog, paddle/fluid/operators/distributed/grpc_server.cc).
+Prints "RESULT <psum> <process_count> <global_devices>" on success.
+"""
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+
+    import numpy as np
+    import jax
+
+    # the TPU-relay plugin hijacks get_backend and initializes its
+    # single-client relay connection even when JAX_PLATFORMS=cpu is in
+    # the env — two workers then deadlock on the relay lease. The
+    # config knob (same antidote tests/conftest.py uses) actually stops
+    # it, so this worker runs on pure CPU like a real DCN host would.
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel import fleet
+
+    print(f"[w{pid}] imported jax, env JAX_PLATFORMS="
+          f"{os.environ.get('JAX_PLATFORMS')} XLA_FLAGS="
+          f"{os.environ.get('XLA_FLAGS')}", flush=True)
+    fleet.init(coordinator_address=f"localhost:{port}",
+               num_processes=nproc, process_id=pid)
+    print(f"[w{pid}] fleet.init done", flush=True)
+    assert fleet.worker_num() == nproc, fleet.worker_num()
+    assert fleet.worker_index() == pid
+    n_global = len(jax.devices())
+    print(f"[w{pid}] devices: {jax.devices()}", flush=True)
+    assert n_global == 2 * nproc, jax.devices()
+    assert len(jax.local_devices()) == 2
+
+    # cross-process barrier (sync_global_devices path)
+    fleet.barrier_all()
+    print(f"[w{pid}] barrier done", flush=True)
+
+    # global mesh over all processes' devices; operand sharded over it,
+    # each global device d contributing (d+1)
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    contrib = np.arange(1, n_global + 1, dtype=np.float32)
+    garr = jax.make_array_from_callback(
+        (n_global,), sharding, lambda idx: contrib[idx])
+
+    f = jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, "dp"),
+                              mesh=mesh, in_specs=P("dp"),
+                              out_specs=P()))
+    total = float(np.asarray(f(garr))[0])
+    expected = float(contrib.sum())
+    assert total == expected, (total, expected)
+    print(f"RESULT {total} {fleet.worker_num()} {n_global}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

