@@ -305,7 +305,8 @@ def _np_from_disk(a, dtype):
     return a
 
 
-def save_sharded_checkpoint(dirname, persist, step=0, extra=None):
+def save_sharded_checkpoint(dirname, persist, step=0, extra=None,
+                            publish=True):
     """Write jax.Arrays shard-by-shard: each host saves only ITS
     addressable shards (`.addressable_shards` — a device->host copy of
     1/N of the state, never a full-array gather), plus a manifest with
@@ -315,6 +316,14 @@ def save_sharded_checkpoint(dirname, persist, step=0, extra=None):
 
     `persist` is {name: jax.Array} (e.g. a ParallelExecutor scope's
     values). Replicated-over-some-axes arrays dedupe shards by index.
+
+    Publishing (the tmp -> dirname rename on host 0) happens only after
+    a cross-host barrier when process_count() > 1, so no host can still
+    be writing its shards into tmp when the rename lands (the reference
+    sequences this through the pserver checkpoint RPC instead —
+    paddle/fluid/operators/checkpoint_notify_op.cc). Pass publish=False
+    to keep the shards in `dirname + ".tmp"` and control the rename
+    yourself (returns the manifest either way).
     """
     import jax
 
@@ -362,13 +371,24 @@ def save_sharded_checkpoint(dirname, persist, step=0, extra=None):
         manifest["vars"][name] = entry
     with open(os.path.join(tmp, f"manifest.p{pid}.json"), "w") as f:
         json.dump(manifest, f)
-    # single-host atomic publish; multi-host callers rename on host 0
-    # after a barrier (jax.experimental.multihost_utils.sync_global_devices)
+    if not publish:
+        return manifest
+    if jax.process_count() > 1:
+        # every host must finish writing into tmp before host 0 renames
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(
+            f"save_sharded_checkpoint:{dirname}")
     if pid == 0:
         if os.path.exists(dirname):
             import shutil
             shutil.rmtree(dirname)
         os.replace(tmp, dirname)
+    if jax.process_count() > 1:
+        # second barrier: no host may return (and e.g. immediately
+        # load_sharded_checkpoint) until the rename has landed
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(
+            f"save_sharded_checkpoint:published:{dirname}")
     return manifest
 
 

@@ -52,7 +52,13 @@ def lib():
 
 
 def _configure(L):
-    # signatures — raises AttributeError when the .so predates a symbol
+    # signatures — raises AttributeError when the .so predates a symbol.
+    # The abi-version symbol forces a rebuild on semantic-only C changes
+    # (e.g. the v2 multi_reader_pop drained-sentinel change) that add no
+    # new function for the per-symbol checks to trip on.
+    L.ptpu_native_abi_version.restype = ctypes.c_uint64
+    if L.ptpu_native_abi_version() != 2:
+        raise AttributeError("stale libptpu_native abi")
     L.ptpu_recordio_writer_open.restype = ctypes.c_void_p
     L.ptpu_recordio_writer_open.argtypes = [ctypes.c_char_p]
     L.ptpu_recordio_write.restype = ctypes.c_int

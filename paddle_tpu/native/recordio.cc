@@ -24,6 +24,7 @@
 namespace {
 
 using ptpu_rio::kMagic;
+using ptpu_rio::kMaxChunkBytes;
 using ptpu_rio::crc32;
 using ptpu_rio::read_u32;
 using ptpu_rio::put_u32;
@@ -70,6 +71,11 @@ void* ptpu_recordio_writer_open(const char* path) {
 int ptpu_recordio_write(void* handle, const uint8_t* data, uint32_t len) {
   auto* w = static_cast<Writer*>(handle);
   if (!w || !w->f) return -1;
+  // readers treat >kMaxChunkBytes chunks as corruption: reject records
+  // that cannot fit, and flush first when appending would overflow
+  if ((uint64_t)len + 4 > kMaxChunkBytes) return -2;
+  if (w->payload.size() + (uint64_t)len + 4 > kMaxChunkBytes)
+    flush_chunk(w);
   put_u32(w->payload, len);
   w->payload.insert(w->payload.end(), data, data + len);
   w->n_records++;

@@ -140,6 +140,12 @@ struct MultiReader {
 
 extern "C" {
 
+// Bumped on any C-ABI semantic change (v2: multi_reader_pop's drained
+// sentinel moved from -3 to INT64_MIN). The Python loader configures
+// this symbol; a stale .so missing it (or any symbol) raises
+// AttributeError and triggers a delete-and-rebuild.
+uint64_t ptpu_native_abi_version() { return 2; }
+
 void* ptpu_multi_reader_open(const char** paths, uint32_t n_paths,
                              uint32_t n_threads, uint32_t capacity) {
   auto* m = new MultiReader();
@@ -153,8 +159,8 @@ void* ptpu_multi_reader_open(const char** paths, uint32_t n_paths,
   return m;
 }
 
-// Returns record length (copied into out; 0 = empty record), -3 when
-// all files are drained (matching ptpu_recordio_read's EOF sentinel),
+// Returns record length (copied into out; 0 = empty record), INT64_MIN
+// when all files are drained (v2 ABI — outside the -(needed) range),
 // -(needed) when cap is too small (record stays queued).
 int64_t ptpu_multi_reader_pop(void* handle, uint8_t* out, uint64_t cap) {
   auto* m = static_cast<MultiReader*>(handle);
@@ -162,7 +168,10 @@ int64_t ptpu_multi_reader_pop(void* handle, uint8_t* out, uint64_t cap) {
   m->not_empty.wait(lk, [&] {
     return !m->items.empty() || m->producers_live == 0 || m->closed;
   });
-  if (m->items.empty()) return -3;  // drained (or closed+empty)
+  // drained (or closed+empty): INT64_MIN cannot collide with the
+  // buffer-too-small code -(record_size) — record sizes are bounded by
+  // the 1 GiB chunk cap, so -(int64_t)size can never reach INT64_MIN
+  if (m->items.empty()) return INT64_MIN;
   auto& it = m->items.front();
   if (it.size() > cap) return -(int64_t)it.size();
   uint64_t n = it.size();

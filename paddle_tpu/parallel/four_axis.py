@@ -79,13 +79,25 @@ def four_axis_train_step(mesh, params, x, y, n_microbatch,
             out_specs=P(), check_vma=False)
         return sm(w1s, w2s, mb_x, mb_y) / np.prod(mb_x.shape[:3])
 
-    def step_fn(params, x, y):
+    def step_fn(params, x, y, lr_t):
         mb_x = x.reshape((n_mb, x.shape[0] // n_mb) + x.shape[1:])
         mb_y = y.reshape((n_mb, y.shape[0] // n_mb) + y.shape[1:])
         loss, grads = jax.value_and_grad(train_loss)(params, mb_x, mb_y)
-        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        new_params = jax.tree.map(lambda p, g: p - lr_t * g, params,
+                                  grads)
         return loss, new_params
 
-    return jax.jit(step_fn)(params, x, y)
+    # cache the jitted step per (mesh, n_mb): a fresh jax.jit wrapper
+    # every call would retrace/recompile each step when driven in a
+    # training loop (ADVICE r3); lr rides along as a traced scalar so
+    # schedules don't recompile either
+    key = (mesh, n_mb)
+    jitted = _STEP_CACHE.get(key)
+    if jitted is None:
+        jitted = _STEP_CACHE[key] = jax.jit(step_fn)
+    return jitted(params, x, y, jnp.float32(lr))
+
+
+_STEP_CACHE = {}
 
 

@@ -18,6 +18,12 @@ __all__ = ["RecordIOWriter", "RecordIOReader", "ShardedRecordIOReader",
 
 _MAGIC = 0x50545243
 _CHUNK = 1 << 20
+# native reader caps chunks at 1 GiB (rio_common.h kMaxChunkBytes); the
+# python fallback enforces the same corruption bound
+_MAX_CHUNK = 1 << 30
+# drained sentinel from ptpu_multi_reader_pop — INT64_MIN, outside the
+# -(record_size) buffer-too-small range
+_MR_EOF = -(1 << 63)
 
 
 def _crc32(b):
@@ -32,6 +38,15 @@ class _PyWriter:
         self.n = 0
 
     def write(self, data):
+        if len(data) + 4 > _MAX_CHUNK:
+            # fail at WRITE time: the reader (python and native alike)
+            # treats >1 GiB chunks as corruption, so a larger record
+            # would round-trip to an unreadable file
+            raise IOError(
+                f"record of {len(data)} bytes exceeds the 1 GiB "
+                "recordio chunk bound")
+        if len(self.payload) + len(data) + 4 > _MAX_CHUNK:
+            self._flush()  # keep every chunk under the reader bound
         self.payload += struct.pack("<I", len(data)) + data
         self.n += 1
         if len(self.payload) >= _CHUNK:
@@ -66,6 +81,12 @@ class _PyReader:
             if len(hdr) < 12:
                 return None
             n, plen, crc = struct.unpack("<III", hdr)
+            if plen > _MAX_CHUNK:
+                # corrupt/flipped length field: reject BEFORE the
+                # allocation, mirroring the native kMaxChunkBytes bound
+                raise IOError(
+                    f"recordio chunk length {plen} exceeds 1 GiB bound "
+                    "(corruption)")
             payload = self.f.read(plen)
             if _crc32(payload) != crc:
                 raise IOError("recordio chunk crc mismatch (corruption)")
@@ -102,7 +123,12 @@ class RecordIOWriter:
         if self._native:
             L, h = self._native
             buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
-            if L.ptpu_recordio_write(h, buf, len(data)) != 0:
+            rc = L.ptpu_recordio_write(h, buf, len(data))
+            if rc == -2:  # same diagnostic as the python fallback
+                raise IOError(
+                    f"record of {len(data)} bytes exceeds the 1 GiB "
+                    "recordio chunk bound")
+            if rc != 0:
                 raise IOError("recordio native write failed")
         else:
             self._py.write(data)
@@ -218,11 +244,11 @@ class ShardedRecordIOReader:
         if self._native:
             L, h = self._native
             n = L.ptpu_multi_reader_pop(h, self._buf, self._cap)
-            if n < 0 and -n > self._cap:   # grow buffer, retry
+            if n != _MR_EOF and n < 0:     # grow buffer, retry
                 self._cap = int(-n)
                 self._buf = (ctypes.c_uint8 * self._cap)()
                 n = L.ptpu_multi_reader_pop(h, self._buf, self._cap)
-            if n == -3:                    # drained
+            if n == _MR_EOF:               # drained
                 raise StopIteration
             return bytes(self._buf[:n])
         # python fallback: round-robin over the per-file readers; a
