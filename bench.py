@@ -8,15 +8,28 @@ and corroborated by device-side profiler timing. Secondary metrics
 latency — all in the same JSON line.
 
 Process structure: the axon TPU relay hangs (not errors) during init
-when it is down, and outages exceed an hour, so the parent process
-NEVER touches the TPU itself. It probes in subprocesses with backoff,
-then runs the whole TPU benchmark in a supervised child with a hard
-timeout, retrying while the budget (BENCH_TOTAL_BUDGET_S, default 45
-min) lasts; only then does it fall back to a CPU run. Never exits
-without a JSON line: on failure prints
-{"metric": ..., "value": 0, "error": ..., "stage": ...}.
+when it is down, so the parent process NEVER touches the TPU itself.
+The parent is built so its failure mode can never be silence (the
+round-3 artifact was rc=124 with EMPTY output — a driver timeout
+killed the old design before it printed anything):
+
+ 1. a bootstrap JSON line is emitted at t=0, before any backend work;
+ 2. benchmark children stream a fresh JSON line after EVERY completed
+    sub-benchmark, and the parent re-emits each improvement
+    immediately — the driver records the LAST stdout line, so a kill
+    at any moment still leaves the best result so far on record;
+ 3. SIGTERM/SIGINT re-emit the best-known line and exit;
+ 4. the whole budget (BENCH_TOTAL_BUDGET_S) defaults to 8 minutes so
+    a full run fits inside any plausible driver timeout.
+
+Fallback order: probe TPU in a subprocess (the probe is a full
+compute+readback, killable); TPU reachable → supervised TPU child;
+unreachable → supervised CPU child, then re-probe TPU with what's
+left of the budget. A line with platform "tpu"/"axon" and value>0
+always beats a CPU line, which beats the bootstrap stub.
 """
 import json
+import os
 import sys
 import time
 import traceback
@@ -26,9 +39,26 @@ import numpy as np
 _STAGE = {"stage": "import"}
 
 
-def _emit(obj):
-    print(json.dumps(obj))
-    sys.stdout.flush()
+_EMIT_LOCK = __import__("threading").Lock()
+
+
+def _emit(obj, lead=""):
+    """ONE atomic write per line: the pump threads and the SIGTERM
+    handler both emit, and an interleaved payload/newline pair would
+    corrupt the guaranteed-parseable last line."""
+    with _EMIT_LOCK:
+        sys.stdout.write(lead + json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+
+def _score(obj):
+    """Rank result lines: witnessed-TPU > any-result > stub."""
+    if not obj:
+        return -1
+    has_value = obj.get("value", 0) and obj["value"] > 0
+    if obj.get("platform") in ("tpu", "axon") and has_value:
+        return 2
+    return 1 if has_value else 0
 
 
 # Peak bf16 FLOP/s per chip by device kind (scaling-book table).
@@ -76,14 +106,6 @@ def _probe_tpu(timeout=120.0):
         if line.startswith("PLATFORM="):
             return line.split("=", 1)[1].strip()
     return None
-
-
-def _force_cpu():
-    import os
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    return jax.devices()[0].platform
 
 
 def _aot_compile(jfn, args):
@@ -372,6 +394,68 @@ def bench_inference(platform):
     return out
 
 
+def bench_deepfm(platform):
+    """DeepFM CTR at scale (ref BASELINE config 5 + lookup_table_op.cc
+    is_sparse): 8M-row embedding tables trained with lazy row-sparse
+    Adam — update bandwidth O(batch), not O(vocab). Returns
+    {examples/s, step ms, HBM peak} (VERDICT r3 #5)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.core.trace import build_step_fn
+    from paddle_tpu.models import deepfm
+
+    on_tpu = platform in ("tpu", "axon")
+    B, F = (4096, 26) if on_tpu else (64, 6)
+    vocab = 8_000_000 if on_tpu else 1000
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            feeds, loss, prob = deepfm.build_program(
+                num_fields=F, vocab_size=vocab, embed_dim=16)
+            pt.optimizer.Adam(1e-3).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        persist = {v.name: scope.get(v.name)
+                   for v in main_p.persistable_vars()}
+    rng = np.random.RandomState(0)
+    feed = {"feat_ids": jnp.asarray(
+                rng.randint(0, vocab, (B, F, 1)), jnp.int32),
+            "feat_vals": jnp.asarray(rng.rand(B, F).astype("float32")),
+            "label": jnp.asarray(
+                rng.randint(0, 2, (B, 1)).astype("float32"))}
+    key = jax.random.PRNGKey(0)
+    step_fn = build_step_fn(main_p, [loss.name], False, None)
+    jfn = jax.jit(step_fn, donate_argnums=(0,))
+    fetches, persist = jfn(persist, feed, key)
+    np.asarray(fetches[0])
+    n = 20 if on_tpu else 2
+    state = {"persist": persist, "loss": 0.0}
+
+    def window():
+        p = state["persist"]
+        for _ in range(n):
+            fetches, p = jfn(p, feed, key)
+        state["persist"] = p
+        state["loss"] = float(np.asarray(fetches[0]))
+
+    dt = _median_window_time(window, 3 if on_tpu else 1)
+    assert np.isfinite(state["loss"])
+    out = {"deepfm_examples_per_sec": round(n * B / dt, 1),
+           "deepfm_step_ms": round(dt / n * 1e3, 2),
+           "deepfm_vocab_rows": vocab}
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("peak_bytes_in_use"):
+            out["deepfm_hbm_peak_gb"] = round(
+                stats["peak_bytes_in_use"] / 2**30, 2)
+    except Exception:
+        pass
+    return out
+
+
 def bench_mnist(platform):
     """MNIST MLP train steps/sec (ref benchmark/fluid/mnist.py)."""
     import jax
@@ -418,9 +502,28 @@ def bench_mnist(platform):
     return n / dt
 
 
-def run_benchmarks(platform):
+def _load_baseline():
+    """Anchor for vs_baseline: prefer the driver-witnessed number over
+    the builder-measured `published` one (VERDICT r3 #4)."""
+    try:
+        bp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BASELINE.json")
+        with open(bp) as f:
+            b = json.load(f)
+        for block in ("witnessed", "published"):
+            v = b.get(block, {}).get("transformer_tokens_per_sec")
+            if v:
+                return float(v), block
+    except Exception:
+        pass
+    return None, None
+
+
+def run_benchmarks(platform, emit_progress=None):
     """Run every benchmark on the already-initialized backend; returns
-    the result dict (no emission — the caller owns the single line)."""
+    the result dict. When emit_progress is given, a snapshot of the
+    accumulated result is emitted after EVERY completed sub-benchmark,
+    so a kill at any moment leaves the best-so-far on stdout."""
     import jax
     result = {
         "metric": "transformer_base_train_tokens_per_sec",
@@ -428,154 +531,237 @@ def run_benchmarks(platform):
         "unit": "tokens/sec",
         "vs_baseline": 0.0,
     }
+
+    only = os.environ.get("BENCH_ONLY", "").split(",")
+    only = [s for s in only if s]
+    want = lambda name: not only or name in only
+
+    def progress():
+        if emit_progress:
+            emit_progress(dict(result, partial=True,
+                               stage=_STAGE["stage"]))
+
     try:
         result["platform"] = platform
         result["device_kind"] = getattr(jax.devices()[0],
                                         "device_kind", "")
+        progress()
 
         _STAGE["stage"] = "transformer"
-        tokens_per_sec, mfu, loss, evidence = bench_transformer(platform)
-        result["value"] = round(tokens_per_sec, 1)
-        if mfu is not None:
-            result["mfu"] = round(mfu, 4)
-        result["loss"] = round(loss, 4)
-        result["evidence"] = evidence
+        if want("transformer"):
+            tokens_per_sec, mfu, loss, evidence = \
+                bench_transformer(platform)
+            result["value"] = round(tokens_per_sec, 1)
+            if mfu is not None:
+                result["mfu"] = round(mfu, 4)
+            result["loss"] = round(loss, 4)
+            result["evidence"] = evidence
 
-        baseline = None
-        try:
-            import os
-            bp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "BASELINE.json")
-            with open(bp) as f:
-                baseline = json.load(f).get("published", {}).get(
-                    "transformer_tokens_per_sec")
-        except Exception:
-            pass
-        if baseline:
-            ratio = tokens_per_sec / baseline
-            # keep small CPU-fallback ratios visible (0.0002, not 0.0)
-            result["vs_baseline"] = float(f"{ratio:.3g}")
-        else:
-            result["vs_baseline"] = 1.0
+            baseline, block = _load_baseline()
+            if baseline:
+                ratio = tokens_per_sec / baseline
+                # keep small CPU-fallback ratios visible (0.0002, not 0.0)
+                result["vs_baseline"] = float(f"{ratio:.3g}")
+                result["baseline_block"] = block
+            else:
+                result["vs_baseline"] = 1.0
+            progress()
 
         for name, fn in (("resnet50_images_per_sec", bench_resnet),
                          ("mnist_mlp_steps_per_sec", bench_mnist)):
             _STAGE["stage"] = name
+            if not want(name.split("_")[0]):
+                continue
             try:
                 result[name] = round(fn(platform), 1)
             except Exception as e:
                 result[name + "_error"] = f"{type(e).__name__}: {e}"
+            progress()
+        _STAGE["stage"] = "deepfm"
+        if want("deepfm"):
+            try:
+                result.update(bench_deepfm(platform))
+            except Exception as e:
+                result["deepfm_error"] = f"{type(e).__name__}: {e}"
+            progress()
         _STAGE["stage"] = "inference"
-        try:
-            result.update(bench_inference(platform))
-        except Exception as e:
-            result["inference_error"] = f"{type(e).__name__}: {e}"
+        if want("inference"):
+            try:
+                result.update(bench_inference(platform))
+            except Exception as e:
+                result["inference_error"] = f"{type(e).__name__}: {e}"
+            progress()
         _STAGE["stage"] = "flash_long_context"
-        try:
-            extra = bench_flash_long_context(platform)
-            if extra:
-                result.update(extra)
-        except Exception as e:
-            result["flash_long_context_error"] = f"{type(e).__name__}: {e}"
+        if want("flash"):
+            try:
+                extra = bench_flash_long_context(platform)
+                if extra:
+                    result.update(extra)
+            except Exception as e:
+                result["flash_long_context_error"] = \
+                    f"{type(e).__name__}: {e}"
     except Exception as e:
         result["error"] = f"{type(e).__name__}: {e}"
         result["stage"] = _STAGE["stage"]
         result["traceback"] = traceback.format_exc()[-1500:]
+    result.pop("partial", None)
+    if "error" not in result:
+        result.pop("stage", None)
     return result
 
 
 def _child_main():
-    """BENCH_CHILD=1 mode: assume the default (TPU) backend, run all
-    benchmarks, print the JSON line. Any hang here is the parent's
-    problem — it holds the kill timer."""
+    """BENCH_CHILD=1 mode: assume the default backend (TPU, or CPU when
+    the parent forced JAX_PLATFORMS=cpu), stream a progress line after
+    each sub-benchmark, print the final line last. Any hang here is the
+    parent's problem — it holds the kill timer."""
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the TPU-relay plugin hijacks get_backend and initializes its
+        # relay connection even under JAX_PLATFORMS=cpu — with the
+        # relay down the "CPU" child then hangs in jax.devices(); the
+        # config knob actually stops it
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform  # may hang; parent supervises
-    _emit(run_benchmarks(platform))
+    _emit(run_benchmarks(platform, emit_progress=_emit))
 
 
-def _supervise():
-    """Parent mode: never touches the TPU in-process. Probe with
-    backoff, then run the TPU benchmark in a killable child; retry
-    until BENCH_TOTAL_BUDGET_S is spent, then CPU fallback."""
-    import os
-    import subprocess
-    budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "2700"))
-    child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT_S", "1500"))
-    t0 = time.monotonic()
-    remaining = lambda: budget - (time.monotonic() - t0)
-    attempts, runs, last_err = 0, 0, ""
-    delay = 10.0
+class _Supervisor:
+    """Parent mode: never touches a backend in-process; guarantees the
+    last stdout line is always the best complete JSON result so far."""
 
-    def backoff():
-        nonlocal delay
-        time.sleep(min(delay, max(0.0, remaining() - 60.0)))
-        delay = min(delay * 2, 180.0)
+    def __init__(self):
+        self.best = {
+            "metric": "transformer_base_train_tokens_per_sec",
+            "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0,
+            "platform": "none", "stage": "bootstrap",
+            "error": "bootstrap: no benchmark has completed yet",
+        }
+        self.t0 = time.monotonic()
 
-    while remaining() > 60.0 and runs < 5:
-        attempts += 1
-        platform = _probe_tpu(timeout=min(120.0, remaining()))
-        if platform is None:
-            last_err = "probe timeout/failure"
-            backoff()
-            continue
-        if platform not in ("tpu", "axon"):
-            # no TPU in this environment at all (e.g. CPU-only CI):
-            # don't burn the budget retrying
-            break
-        # relay reachable — run the real benchmark in a killable child
-        runs += 1
-        env = dict(os.environ, BENCH_CHILD="1")
+    def consider(self, obj):
+        """Re-emit a child line iff it is at least as good as the best
+        seen — a later equal-score line carries MORE sub-benchmarks."""
+        if _score(obj) >= _score(self.best):
+            self.best = obj
+            _emit(obj)
+
+    def _flush_and_die(self, signum, frame):
+        # guarantee the last stdout line is complete JSON even if a
+        # child write raced the kill: leading newline terminates any
+        # half-written line (a signal can interrupt a non-_emit write),
+        # and _emit's lock serializes against the pump threads
+        self.best["signal"] = signum
+        _emit(self.best, lead="\n")
+        os._exit(0)
+
+    def _stream_child(self, env, timeout):
+        """Run a benchmark child, re-emitting every improved JSON line
+        the moment it arrives. Returns (rc, stderr_tail)."""
+        import subprocess
+        import threading
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+        err_tail = [""]
+
+        def pump_out():
+            for line in p.stdout:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except Exception:
+                    continue
+                self.consider(obj)
+
+        def pump_err():
+            for line in p.stderr:
+                err_tail[0] = (err_tail[0] + line)[-800:]
+
+        threads = [threading.Thread(target=pump_out, daemon=True),
+                   threading.Thread(target=pump_err, daemon=True)]
+        for t in threads:
+            t.start()
         try:
-            p = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                capture_output=True, text=True, env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                timeout=min(child_timeout, max(remaining(), 5.0)))
+            p.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
-            last_err = f"child run {runs} hung (killed)"
-            backoff()
-            continue
-        line = next((l for l in reversed(
-            (p.stdout or "").strip().splitlines())
-            if l.startswith("{")), None)
-        if p.returncode == 0 and line:
+            # SIGTERM first: give the PJRT client a chance to close its
+            # relay session — a SIGKILLed child can leave the
+            # single-client relay lease wedged for every later probe
+            p.terminate()
             try:
-                result = json.loads(line)
-            except Exception:
-                last_err = f"child run {runs} emitted invalid JSON"
-                backoff()
+                p.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        for t in threads:
+            t.join(timeout=5.0)
+        return p.returncode, err_tail[0]
+
+    def run(self):
+        import signal
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, self._flush_and_die)
+        _emit(self.best)  # t=0: the artifact can never be empty again
+
+        budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "480"))
+        child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT_S",
+                                             "330"))
+        remaining = lambda: budget - (time.monotonic() - self.t0)
+        tpu_children, cpu_done, no_tpu_env, last_err = 0, False, False, ""
+
+        while remaining() > 30.0:
+            if not no_tpu_env and tpu_children < 2 \
+                    and remaining() > 120.0:
+                platform = _probe_tpu(
+                    timeout=max(min(90.0, remaining() - 60.0), 10.0))
+                if platform in ("tpu", "axon"):
+                    tpu_children += 1
+                    rc, err = self._stream_child(
+                        dict(os.environ, BENCH_CHILD="1"),
+                        timeout=max(min(child_timeout,
+                                        remaining() - 20.0), 5.0))
+                    if _score(self.best) >= 2:
+                        return  # witnessed TPU result is on stdout
+                    last_err = (f"tpu child {tpu_children} rc={rc}: "
+                                + err[-300:].replace("\n", " "))
+                    continue
+                if platform is None:
+                    last_err = "probe timeout/failure"
+                else:
+                    no_tpu_env = True  # CPU-only CI: stop probing
+            if not cpu_done:
+                cpu_done = True
+                rc, err = self._stream_child(
+                    dict(os.environ, BENCH_CHILD="1",
+                         JAX_PLATFORMS="cpu"),
+                    timeout=max(min(240.0, remaining() - 15.0), 5.0))
+                if no_tpu_env:
+                    break
                 continue
-            if result.get("platform") in ("tpu", "axon") \
-                    and not result.get("error"):
-                result["probe"] = {
-                    "attempts": attempts, "child_runs": runs,
-                    "seconds": round(time.monotonic() - t0, 1)}
-                _emit(result)
-                return
-            last_err = (f"child run {runs}: platform="
-                        f"{result.get('platform')} "
-                        f"error={result.get('error')!r}")
-        else:
-            last_err = (f"child run {runs} rc={p.returncode}: "
-                        + (p.stderr or "")[-300:].replace("\n", " "))
-        # failed child runs back off too — each retry pays full TPU
-        # init, and a deterministic child bug would otherwise spin
-        backoff()
-    # budget exhausted — honest CPU fallback in-process
-    platform = _force_cpu()
-    result = run_benchmarks(platform)
-    result["probe"] = {"attempts": attempts, "child_runs": runs,
-                      "seconds": round(time.monotonic() - t0, 1),
-                      "tpu_unreachable": last_err}
-    _emit(result)
+            if no_tpu_env or tpu_children >= 2 or remaining() <= 120.0:
+                # no further action is possible (the probe gate needs
+                # >120s and remaining() only decreases): emit the final
+                # line now instead of idling the clock down
+                break
+            time.sleep(min(10.0, max(remaining() - 30.0, 0.0)))
+        # budget spent: make the last line the best-known result, with
+        # the probe trail attached for the record
+        self.best["probe"] = {
+            "tpu_children": tpu_children, "cpu_fallback_ran": cpu_done,
+            "seconds": round(time.monotonic() - self.t0, 1),
+            "last_error": last_err}
+        _emit(self.best)
 
 
 def main():
-    import os
     if os.environ.get("BENCH_CHILD"):
         _child_main()
     else:
-        _supervise()
+        _Supervisor().run()
 
 
 if __name__ == "__main__":

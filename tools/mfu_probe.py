@@ -1,0 +1,107 @@
+"""On-chip MFU probe for the flagship transformer (VERDICT r3 #2).
+
+Profiles the SURVEY §5 config (B=64, T=128, transformer-base, bf16)
+with the device-side profiler and prints the xplane-derived op-family
+breakdown, total device step time, and MFU — the measurement record
+the round-3 verdict asked for. A/B knobs:
+
+  python tools/mfu_probe.py                 # current defaults
+  python tools/mfu_probe.py --no-fuse-tail  # disable stacked Adam tail
+  python tools/mfu_probe.py --steps 20
+
+Run on the real chip (axon relay). Ref: benchmark/fluid/
+machine_translation.py is the reference's equivalent headline bench.
+"""
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--no-fuse-tail", action="store_true")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seqlen", type=int, default=128)
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.core import trace as _trace
+    from paddle_tpu.core.trace import build_step_fn
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.profiler import profile_step_fn
+    import bench
+
+    if args.no_fuse_tail:
+        _trace.FUSE_OPTIMIZER_TAIL = False
+
+    B, T = args.batch, args.seqlen
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            cfg = tfm.TransformerConfig(
+                src_vocab=8000, trg_vocab=8000, max_len=T,
+                d_model=512, d_inner=2048, n_head=8, n_layer=6,
+                dropout=0.1)
+            feeds, avg_cost, tok = tfm.build_program(cfg, maxlen=T)
+            pt.optimizer.Adam(1e-3).minimize(avg_cost)
+    pt.amp.cast_program_to_bf16(main_p)
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        pt.amp.cast_params_to_bf16(main_p, scope)
+        persist = {v.name: scope.get(v.name)
+                   for v in main_p.persistable_vars()}
+
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, cfg.src_vocab, (B, T)).astype("int32")
+    trg = np.concatenate([np.zeros((B, 1), "int32"),
+                          (src[:, :-1] + 1) % cfg.trg_vocab], axis=1)
+    feed = {"src": jnp.asarray(src),
+            "src_len": jnp.full(B, T, jnp.int32),
+            "trg": jnp.asarray(trg),
+            "trg_len": jnp.full(B, T, jnp.int32),
+            "label": jnp.asarray((src + 1) % cfg.trg_vocab, jnp.int32)}
+    key = jax.random.PRNGKey(0)
+
+    step_fn = build_step_fn(main_p, [avg_cost.name], False, None)
+    jfn, flops = bench._aot_compile(jax.jit(step_fn, donate_argnums=(0,)),
+                                    (persist, feed, key))
+    flops = flops or bench._transformer_analytic_flops(cfg, B, T)
+    t0 = time.perf_counter()
+    fetches, persist = jfn(persist, feed, key)
+    loss0 = float(np.asarray(fetches[0]))
+    print(f"first step (compile+run): {time.perf_counter()-t0:.1f}s "
+          f"loss={loss0:.4f}", flush=True)
+
+    state = {"p": persist}
+
+    def one_step():
+        fetches, state["p"] = jfn(state["p"], feed, key)
+        return fetches
+
+    dev_s, fams = profile_step_fn(one_step, steps=args.steps)
+    peak = bench._peak_flops(jax.devices()[0])
+    out = {
+        "device_step_ms": round(dev_s * 1e3, 3),
+        "device_mfu": round(flops / dev_s / peak, 4),
+        "tokens_per_sec_device": round(B * T / dev_s, 1),
+        "flops_per_step": flops,
+        "fuse_tail": not args.no_fuse_tail,
+        "loss": loss0,
+        "op_families_ms": {k: round(v * 1e3, 3) for k, v in
+                           sorted(fams.items(), key=lambda kv: -kv[1])},
+    }
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()
