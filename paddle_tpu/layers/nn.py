@@ -141,6 +141,12 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     out = helper.create_variable_for_type_inference(dtype, out_shape)
     inputs = {"W": [w], "Ids": [input]}
     attrs = {"padding_idx": -1 if padding_idx is None else padding_idx}
+    if is_distributed:
+        # the pserver-partitioned table analog: DistributeTranspiler
+        # row-shards this table (and its optimizer state) over the mesh
+        # and XLA SPMD partitions the gather/scatter (ref
+        # distribute_lookup_table.py + transpiler pserver split)
+        attrs["is_distributed"] = True
     if is_sparse:
         # the row-grad tap: trace seeds it with zeros of the gathered
         # shape inside the diff set; its gradient IS the row gradient

@@ -8,12 +8,22 @@ param / optimizer-state var, and the jit'ed step gets those shardings —
 XLA inserts the collectives (grad psum ≙ NCCL allreduce; ZeRO opt-state
 sharding ≙ pserver ownership of param blocks).
 """
+import re
+
 import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..distribute_lookup_table import find_distributed_lookup_table
 from .mesh import make_mesh, local_mesh
 from .sharding import ShardingRules, megatron_rules, zero_stage
+
+# optimizer accumulator kinds (optimizer.py _add_accumulator callers) —
+# used to EXACTLY match a table's moment vars by name, never a
+# coincidentally-prefixed parameter
+_ACCUM_KINDS = ("moment1", "moment2", "moment", "velocity", "inf_norm",
+                "mean_square", "mean_grad", "squared", "linear",
+                "avg_squared_grad", "avg_squared_update")
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
 
@@ -63,14 +73,19 @@ class DistributeTranspiler:
         def fits(name, spec):
             """Spec applies only if the var's shape tiles onto the mesh
             axes (the reference's slice_variable analog: a param that
-            can't split stays replicated)."""
+            can't split stays replicated). Tuple entries mean a dim
+            sharded over SEVERAL axes (their sizes multiply)."""
             shape = shapes[name]
             if len(shape) < len(spec):
                 return False
             for dim, ax in zip(shape, spec):
                 if ax is None:
                     continue
-                if dim % self.mesh.shape[ax] != 0:
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                n = 1
+                for a in axes:
+                    n *= self.mesh.shape[a]
+                if dim % n != 0:
                     return False
             return True
 
@@ -84,6 +99,31 @@ class DistributeTranspiler:
             for n, sh in zero_stage(self.mesh, names, axis="dp").items():
                 if sh.spec == P() or fits(n, sh.spec):
                     shardings[n] = sh
+        # the distributed lookup table (ref distribute_lookup_table.py →
+        # pserver row partitioning): row-shard the table AND its
+        # optimizer accumulators over as many axes as divide the vocab
+        # — (dp, tp) combined when possible, else whichever fits; XLA
+        # SPMD partitions the lookup gather and the (sparse) update
+        # scatter — HBM per chip holds vocab/N rows, the ICI gather
+        # replaces the pserver prefetch RPC.
+        table = find_distributed_lookup_table(self.program)
+        if table is not None and table in shapes:
+            tail = [None] * (len(shapes[table]) - 1)
+            candidates = [P(("dp", "tp"), *tail)] if cfg.tp > 1 else []
+            candidates += [P("tp", *tail)] if cfg.tp > 1 else []
+            candidates += [P("dp", *tail)]
+            spec = next((s for s in candidates if fits(table, s)), None)
+            if spec is not None:
+                accum_re = re.compile(
+                    re.escape(table) + "_(" + "|".join(_ACCUM_KINDS)
+                    + r")_\d+$")
+                shardings[table] = NamedSharding(self.mesh, spec)
+                for n in names:
+                    # row-shaped accumulators follow the table; scalars
+                    # (beta pows) stay replicated via the shape check
+                    if accum_re.fullmatch(n) and shapes[n] == \
+                            shapes[table]:
+                        shardings[n] = NamedSharding(self.mesh, spec)
         self._shardings = shardings
         return self
 

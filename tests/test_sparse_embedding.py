@@ -379,3 +379,89 @@ def test_out_of_range_ids_update_clipped_row_like_dense():
     np.testing.assert_allclose(td[vocab - 1], ts[vocab - 1],
                                rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(td[3], ts[3], rtol=1e-4, atol=1e-6)
+
+
+def test_distributed_table_row_sharded_matches_replicated():
+    """embedding(is_distributed=True): the transpiler row-shards the
+    table + its Adam moments over the mesh (the pserver-partitioned
+    table analog — ref distribute_lookup_table.py); XLA SPMD partitions
+    the gather and the sparse scatter. Numerics == replicated run, and
+    each chip holds vocab/N rows."""
+    from jax.sharding import PartitionSpec as P
+    vocab, dim = 64, 8
+    rng = np.random.RandomState(21)
+    ids = rng.randint(0, vocab, (8, 4, 1)).astype("int64")
+    ys = rng.randn(8, dim).astype("float32")
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                i = layers.data("ids", shape=[4, 1], dtype="int64")
+                y = layers.data("y", shape=[dim], dtype="float32")
+                emb = layers.embedding(
+                    i, size=[vocab, dim], is_sparse=True,
+                    is_distributed=True,
+                    param_attr=pt.ParamAttr(name="big_table"))
+                loss = layers.mean(layers.square_error_cost(
+                    layers.reduce_sum(emb, dim=1), y))
+                pt.optimizer.Adam(1e-2).minimize(loss)
+        main.random_seed = startup.random_seed = 17
+        return main, startup, loss
+
+    # replicated single-device baseline
+    main_a, startup_a, loss_a = build()
+    scope_a = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope_a):
+        exe.run(startup_a)
+        base = [float(exe.run(main_a, feed={"ids": ids, "y": ys},
+                              fetch_list=[loss_a])[0]) for _ in range(3)]
+        table_a = np.asarray(scope_a.get("big_table"))
+
+    # transpiled run: table rows sharded over dp
+    main_b, startup_b, loss_b = build()
+    cfg = pt.parallel.DistributeTranspilerConfig()
+    t = pt.parallel.DistributeTranspiler(cfg)
+    t.transpile(program=main_b)
+    sh = t.shardings()
+    assert sh["big_table"].spec == P("dp", None), sh["big_table"]
+    moment_specs = [sh[n].spec for n in sh
+                    if n.startswith("big_table_moment")]
+    assert moment_specs and all(s == P("dp", None)
+                                for s in moment_specs), moment_specs
+    scope_b = pt.Scope()
+    with pt.scope_guard(scope_b):
+        exe2 = pt.Executor(pt.CPUPlace())
+        exe2.run(startup_b)
+        pexe = pt.ParallelExecutor(loss_name=loss_b.name,
+                                   main_program=main_b, transpiler=t)
+        par = [float(pexe.run(feed={"ids": ids, "y": ys},
+                              fetch_list=[loss_b])[0]) for _ in range(3)]
+        table_b = np.asarray(scope_b.get("big_table"))
+
+    np.testing.assert_allclose(base, par, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(table_a, table_b, rtol=1e-4, atol=1e-6)
+
+
+def test_distributed_table_combined_axes_spec():
+    """With tp>1 the table rows shard over (dp, tp) COMBINED when the
+    vocab divides the product — full vocab/N memory scaling."""
+    from jax.sharding import PartitionSpec as P
+    vocab, dim = 64, 8
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            i = layers.data("ids", shape=[4, 1], dtype="int64")
+            y = layers.data("y", shape=[dim], dtype="float32")
+            emb = layers.embedding(i, size=[vocab, dim], is_sparse=True,
+                                   is_distributed=True,
+                                   param_attr=pt.ParamAttr(name="t2"))
+            loss = layers.mean(layers.square_error_cost(
+                layers.reduce_sum(emb, dim=1), y))
+            pt.optimizer.Adam(1e-2).minimize(loss)
+    cfg = pt.parallel.DistributeTranspilerConfig()
+    cfg.tp = 2
+    t = pt.parallel.DistributeTranspiler(cfg)
+    t.transpile(program=main)
+    assert t.shardings()["t2"].spec == P(("dp", "tp"), None)
