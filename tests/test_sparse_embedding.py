@@ -465,3 +465,65 @@ def test_distributed_table_combined_axes_spec():
     t = pt.parallel.DistributeTranspiler(cfg)
     t.transpile(program=main)
     assert t.shardings()["t2"].spec == P(("dp", "tp"), None)
+
+
+def test_sparse_with_run_scanned():
+    """The delta tap + sparse_adam compose with the lax.scan multi-step
+    window (run_scanned): loss decreases across the scanned steps."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            ids = layers.data("ids", shape=[4, 1], dtype="int64")
+            y = layers.data("y", shape=[8], dtype="float32")
+            emb = layers.embedding(ids, size=[50, 8], is_sparse=True)
+            loss = layers.mean(layers.square_error_cost(
+                layers.reduce_sum(emb, dim=1), y))
+            pt.optimizer.Adam(5e-2).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.RandomState(0)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        S, B = 8, 3
+        one_ids = rng.randint(0, 50, (B, 4, 1)).astype("int64")
+        one_y = rng.randn(B, 8).astype("float32")
+        feed = {"ids": np.broadcast_to(one_ids,
+                                       (S,) + one_ids.shape).copy(),
+                "y": np.broadcast_to(one_y, (S,) + one_y.shape).copy()}
+        out = exe.run_scanned(main, feed=feed, fetch_list=[loss],
+                              steps=S)
+        ls = np.asarray(out[0]).ravel()
+    assert np.isfinite(ls).all() and ls[-1] < ls[0], ls
+
+
+def test_sparse_under_bf16_amp():
+    """bf16 table + fp32 sparse-Adam moments: the lazy row update keeps
+    master-weight-style fp32 math and the loss still decreases."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            ids = layers.data("ids", shape=[4, 1], dtype="int64")
+            y = layers.data("y", shape=[8], dtype="float32")
+            emb = layers.embedding(ids, size=[40, 8], is_sparse=True,
+                                   param_attr=pt.ParamAttr(name="bt"))
+            loss = layers.mean(layers.square_error_cost(
+                layers.reduce_sum(emb, dim=1), y))
+            pt.optimizer.Adam(2e-2).minimize(loss)
+    pt.amp.cast_program_to_bf16(main)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.RandomState(4)
+    feed = {"ids": rng.randint(0, 40, (3, 4, 1)).astype("int64"),
+            "y": rng.randn(3, 8).astype("float32")}
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        pt.amp.cast_params_to_bf16(main, scope)
+        ls = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(15)]
+        import jax.numpy as jnp
+        assert scope.get("bt").dtype == jnp.bfloat16
+        m1 = [v for v in (scope.get(n) for n in
+                          [v.name for v in main.persistable_vars()
+                           if "bt_moment1" in v.name])][0]
+        assert m1.dtype == jnp.float32
+    assert np.isfinite(ls).all() and ls[-1] < ls[0], ls
