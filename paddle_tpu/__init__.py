@@ -60,6 +60,14 @@ from . import reader
 from . import dataset
 from . import models
 from . import imperative
+# reference import-path aliases: paddle.fluid.{framework,executor,
+# parallel_executor,backward} are real modules there — expose the same
+# paths so `fluid.framework.Program` / `from paddle_tpu.executor
+# import Executor` work after the s/paddle.fluid/paddle_tpu/ swap
+from . import framework
+from . import executor
+from . import parallel_executor
+from .core import backward
 from .trainer import Trainer, Inferencer, CheckpointConfig
 from . import average
 from .average import WeightedAverage

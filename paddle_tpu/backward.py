@@ -1,0 +1,6 @@
+"""Alias of core.backward at the reference's import path.
+
+Parity: `from paddle.fluid.backward import append_backward`
+(python/paddle/fluid/backward.py) — implementation in core/backward.py.
+"""
+from .core.backward import append_backward, gradients  # noqa: F401

@@ -1,0 +1,12 @@
+"""Alias of core.framework at the reference's import path.
+
+Parity: `from paddle.fluid.framework import Program, Variable, ...`
+(python/paddle/fluid/framework.py) — the implementation lives in
+core/framework.py; this module re-exports it so reference imports work
+with the s/paddle.fluid/paddle_tpu/ swap.
+"""
+from .core.framework import *  # noqa: F401,F403
+from .core.framework import (Program, Block, Operator, Variable,  # noqa
+                             Parameter, default_main_program,
+                             default_startup_program, program_guard,
+                             grad_var_name)

@@ -1,0 +1,9 @@
+"""Alias of parallel.parallel_executor at the reference's import path.
+
+Parity: `from paddle.fluid.parallel_executor import ParallelExecutor`
+(python/paddle/fluid/parallel_executor.py) — implementation in
+parallel/parallel_executor.py.
+"""
+from .parallel.parallel_executor import (ParallelExecutor,  # noqa: F401
+                                         BuildStrategy,
+                                         ExecutionStrategy)

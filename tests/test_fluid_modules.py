@@ -318,3 +318,19 @@ def test_graphviz_dot_builder(tmp_path):
 def test_inferencer_shim_reexports():
     from paddle_tpu.inferencer import Inferencer
     assert Inferencer is pt.Inferencer
+
+
+def test_reference_module_import_paths():
+    """paddle.fluid.{framework,executor,parallel_executor,backward} are
+    real modules in the reference; the same import paths must work
+    after the s/paddle.fluid/paddle_tpu/ swap."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, default_main_program
+    from paddle_tpu.executor import Executor, global_scope
+    from paddle_tpu.parallel_executor import ParallelExecutor
+    from paddle_tpu.backward import append_backward
+    assert fluid.framework.Program is Program
+    assert fluid.executor.Executor is Executor
+    assert fluid.parallel_executor.ParallelExecutor is ParallelExecutor
+    assert callable(append_backward) and callable(global_scope)
+    assert default_main_program() is not None
