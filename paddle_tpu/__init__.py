@@ -67,7 +67,7 @@ from . import imperative
 from . import framework
 from . import executor
 from . import parallel_executor
-from .core import backward
+from . import backward
 from .trainer import Trainer, Inferencer, CheckpointConfig
 from . import average
 from .average import WeightedAverage
