@@ -1,0 +1,6 @@
+"""Alias of the high-level Inferencer at the contrib path.
+
+Parity: python/paddle/fluid/contrib/inferencer.py — implementation in
+paddle_tpu/trainer.py.
+"""
+from ..trainer import Inferencer  # noqa: F401

@@ -1,0 +1,6 @@
+"""Alias of the high-level Trainer at the contrib path.
+
+Parity: python/paddle/fluid/contrib/trainer.py (the reference moved the
+HighLevelAPI Trainer here) — implementation in paddle_tpu/trainer.py.
+"""
+from ..trainer import Trainer, CheckpointConfig  # noqa: F401

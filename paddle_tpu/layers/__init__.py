@@ -27,6 +27,9 @@ from . import detection
 from .detection import *     # noqa: F401,F403
 from . import layer_function_generator
 from .layer_function_generator import *  # noqa: F401,F403
+from . import device
+from .device import get_places  # noqa: F401 (deprecated, import parity)
+from . import utils
 from . import math_op_patch
 
 math_op_patch.monkey_patch_variable()

@@ -1,0 +1,24 @@
+"""Device placement helpers.
+
+Parity: python/paddle/fluid/layers/device.py — get_places was the
+multi-GPU placement list for the old ParallelDo; on TPU the mesh owns
+placement, so this returns the visible JAX devices' places (deprecated
+in the reference too, kept for import compatibility).
+"""
+from ..annotations import deprecated
+
+__all__ = []
+
+
+@deprecated(since="0.15.0", instead="ParallelExecutor")
+def get_places(device_count=None, device_type=None):
+    import jax
+    from ..core.place import CPUPlace, TPUPlace
+    devs = jax.devices()
+    if device_count is not None:
+        devs = devs[:device_count]
+    if not devs:
+        return []
+    if device_type == "CPU" or devs[0].platform == "cpu":
+        return [CPUPlace() for _ in devs]
+    return [TPUPlace(i) for i, _ in enumerate(devs)]

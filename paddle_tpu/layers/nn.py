@@ -10,6 +10,7 @@ import numpy as np
 from ..layer_helper import LayerHelper
 from ..initializer import ConstantInitializer, NormalInitializer
 from ..core.dtypes import convert_dtype
+from .utils import convert_to_list
 
 __all__ = [
     "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d", "pool2d",
@@ -203,10 +204,10 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     helper = LayerHelper("conv2d", name=name, act=act)
     dtype = input.dtype
     c_in = int(input.shape[1])
-    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
-    sh, sw = (stride, stride) if isinstance(stride, int) else stride
-    ph, pw = (padding, padding) if isinstance(padding, int) else padding
-    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    fh, fw = convert_to_list(filter_size, 2, "filter_size")
+    sh, sw = convert_to_list(stride, 2, "stride")
+    ph, pw = convert_to_list(padding, 2, "padding")
+    dh, dw = convert_to_list(dilation, 2, "dilation")
     g = groups or 1
     std = (2.0 / (fh * fw * c_in)) ** 0.5
     w = helper.create_parameter(param_attr, shape=[num_filters, c_in // g, fh, fw],
@@ -234,9 +235,9 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     helper = LayerHelper("conv2d_transpose", name=name, act=act)
     dtype = input.dtype
     c_in = int(input.shape[1])
-    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
-    sh, sw = (stride, stride) if isinstance(stride, int) else stride
-    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    fh, fw = convert_to_list(filter_size, 2, "filter_size")
+    sh, sw = convert_to_list(stride, 2, "stride")
+    ph, pw = convert_to_list(padding, 2, "padding")
     w = helper.create_parameter(param_attr, shape=[c_in, num_filters, fh, fw],
                                 dtype=dtype)
     ih, iw = int(input.shape[2]), int(input.shape[3])
@@ -260,9 +261,9 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     helper = LayerHelper("conv3d", name=name, act=act)
     dtype = input.dtype
     c_in = int(input.shape[1])
-    fs = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
-    st = [stride] * 3 if isinstance(stride, int) else list(stride)
-    pd = [padding] * 3 if isinstance(padding, int) else list(padding)
+    fs = convert_to_list(filter_size, 3, "filter_size")
+    st = convert_to_list(stride, 3, "stride")
+    pd = convert_to_list(padding, 3, "padding")
     w = helper.create_parameter(param_attr,
                                 shape=[num_filters, c_in // (groups or 1)] + fs,
                                 dtype=dtype)
@@ -280,9 +281,9 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, use_cudnn=True, ceil_mode=False,
            exclusive=True, name=None):
     helper = LayerHelper("pool2d", name=name)
-    ks = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
-    st = (pool_stride, pool_stride) if isinstance(pool_stride, int) else tuple(pool_stride)
-    pd = (pool_padding, pool_padding) if isinstance(pool_padding, int) else tuple(pool_padding)
+    ks = tuple(convert_to_list(pool_size, 2, "pool_size"))
+    st = tuple(convert_to_list(pool_stride, 2, "pool_stride"))
+    pd = tuple(convert_to_list(pool_padding, 2, "pool_padding"))
     if global_pooling:
         oh = ow = 1
     else:
@@ -303,7 +304,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
 
 def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
     helper = LayerHelper("adaptive_pool2d", name=name)
-    ks = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
+    ks = tuple(convert_to_list(pool_size, 2, "pool_size"))
     out = helper.create_variable_for_type_inference(
         input.dtype, (input.shape[0], input.shape[1]) + ks)
     helper.append_op("pool2d", {"X": [input]}, {"Out": [out]},
@@ -1343,8 +1344,8 @@ def cos_sim(X, Y, name=None):
 
 def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
     helper = LayerHelper("im2sequence", name=name)
-    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
-    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    fh, fw = convert_to_list(filter_size, 2, "filter_size")
+    sh, sw = convert_to_list(stride, 2, "stride")
     n, c, h, w = input.shape
     oh = (h - fh) // sh + 1 if h > 0 else -1
     ow = (w - fw) // sw + 1 if w > 0 else -1

@@ -1,0 +1,35 @@
+"""Layer utility helpers.
+
+Parity: python/paddle/fluid/layers/utils.py — convert_to_list
+normalizes int-or-sequence arguments (kernel sizes, strides, paddings)
+exactly like the reference's conv/pool layers expect.
+"""
+import numpy as np
+
+__all__ = ["convert_to_list"]
+
+
+def convert_to_list(value, n, name, dtype=int):
+    """int -> [value]*n; sequence -> validated list of length n.
+
+    Strict like the reference: floats/strings/bools are rejected, not
+    coerced — a typo'd conv stride must raise, not silently change the
+    geometry."""
+    if isinstance(value, bool):
+        raise ValueError(f"{name} must be an int, got bool {value!r}")
+    if isinstance(value, (int, np.integer)):
+        return [dtype(value)] * n
+    try:
+        value_list = list(value)
+    except TypeError:
+        raise ValueError(
+            f"{name} must be an int or an iterable of {n} ints; "
+            f"got {value!r}")
+    if len(value_list) != n:
+        raise ValueError(
+            f"{name} must have {n} elements; got {len(value_list)}")
+    for v in value_list:
+        if isinstance(v, bool) or not isinstance(v, (int, np.integer)):
+            raise ValueError(
+                f"{name} elements must be ints; got {v!r}")
+    return [dtype(v) for v in value_list]

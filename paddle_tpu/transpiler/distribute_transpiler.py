@@ -1,0 +1,8 @@
+"""Alias at the reference's import path.
+
+Parity: python/paddle/fluid/transpiler/distribute_transpiler.py —
+implementation in parallel/transpiler.py (SPMD sharding over the mesh
+replaces the pserver/NCCL program rewrite).
+"""
+from ..parallel.transpiler import (DistributeTranspiler,  # noqa: F401
+                                   DistributeTranspilerConfig)
