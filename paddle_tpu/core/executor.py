@@ -23,9 +23,43 @@ from .dtypes import as_jnp_dtype
 
 from .scope import scope_guard  # noqa: F401  (ref executor.py re-exports it)
 
-__all__ = ["Executor", "scope_guard"]
+__all__ = ["Executor", "scope_guard", "as_numpy"]
 
 _LOG = logging.getLogger("paddle_tpu.executor")
+
+
+def as_numpy(tensor):
+    """Convert a fetched value (device array / LoDTensor / list of
+    either) to numpy (ref executor.py:as_numpy). LoDTensors carrying
+    LoD raise, matching the reference's contract — use
+    return_numpy=False to get the tensor itself."""
+    from ..lod import LoDTensor, LoDTensorArray
+    if isinstance(tensor, (list, LoDTensorArray)):
+        return [as_numpy(t) for t in tensor]
+    if isinstance(tensor, LoDTensor):
+        if tensor.lod() and any(len(l) for l in tensor.lod()):
+            raise RuntimeError(
+                "Some of your fetched tensors hold LoD information. "
+                "They can not be completely cast to Python ndarray. "
+                "Please set the parameter 'return_numpy' as 'False' to "
+                "return LoDTensor itself directly.")
+        return np.asarray(tensor)
+    return np.asarray(tensor)
+
+
+def _fetch_var(name, scope=None, return_numpy=True):
+    """Fetch a variable's value by name from `scope` (ref
+    executor.py:_fetch_var); persistable vars live in the scope used
+    with Executor.run."""
+    from .scope import global_scope
+    assert isinstance(name, str)
+    scope = scope if scope is not None else global_scope()
+    val = scope.get(name)
+    assert val is not None, (
+        f"Cannot find {name} in scope. Perhaps you need to make the "
+        "variable persistable by using var.persistable = True in your "
+        "program.")
+    return as_numpy(val) if return_numpy else val
 
 
 def _feed_signature(feed):

@@ -4,5 +4,5 @@ Parity: `from paddle.fluid.executor import Executor, global_scope`
 (python/paddle/fluid/executor.py) — implementation in core/executor.py.
 """
 from .core.executor import *  # noqa: F401,F403
-from .core.executor import Executor  # noqa: F401
+from .core.executor import Executor, as_numpy, _fetch_var  # noqa: F401
 from .core.scope import global_scope, scope_guard, Scope  # noqa: F401

@@ -334,3 +334,29 @@ def test_reference_module_import_paths():
     assert fluid.parallel_executor.ParallelExecutor is ParallelExecutor
     assert callable(append_backward) and callable(global_scope)
     assert default_main_program() is not None
+
+
+def test_as_numpy_and_fetch_var():
+    """ref executor.py module-level helpers: as_numpy converts fetched
+    values (raising on LoD-carrying tensors) and _fetch_var reads a
+    persistable var from the scope by name."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import as_numpy, _fetch_var
+    from paddle_tpu.lod import LoDTensor
+
+    out = as_numpy([np.arange(3), LoDTensor(np.ones((2, 2)))])
+    assert isinstance(out, list) and out[1].shape == (2, 2)
+    with pytest.raises(RuntimeError):
+        as_numpy(LoDTensor(np.ones((3, 2)), seq_lens=[1, 2]))
+
+    x = fluid.layers.data("x", shape=[4])
+    fluid.layers.fc(x, size=2)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    pname = [v.name for v in
+             fluid.default_main_program().persistable_vars()][0]
+    assert _fetch_var(pname).shape == (4, 2)
+    with pytest.raises(AssertionError):
+        _fetch_var("nonexistent_var_xyz")
