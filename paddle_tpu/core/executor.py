@@ -36,14 +36,12 @@ def as_numpy(tensor):
     from ..lod import LoDTensor, LoDTensorArray
     if isinstance(tensor, (list, LoDTensorArray)):
         return [as_numpy(t) for t in tensor]
-    if isinstance(tensor, LoDTensor):
-        if tensor.lod() and any(len(l) for l in tensor.lod()):
-            raise RuntimeError(
-                "Some of your fetched tensors hold LoD information. "
-                "They can not be completely cast to Python ndarray. "
-                "Please set the parameter 'return_numpy' as 'False' to "
-                "return LoDTensor itself directly.")
-        return np.asarray(tensor)
+    if isinstance(tensor, LoDTensor) and tensor.lod():
+        raise RuntimeError(
+            "Some of your fetched tensors hold LoD information. "
+            "They can not be completely cast to Python ndarray. "
+            "Please set the parameter 'return_numpy' as 'False' to "
+            "return LoDTensor itself directly.")
     return np.asarray(tensor)
 
 

@@ -76,3 +76,36 @@ class DataFeeder:
                 f"feed_parallel got {len(batches)} minibatches for "
                 f"{num_places} places")
         return _concat_feeds(batches)
+
+    def _get_number_of_places_(self, num_places):
+        if num_places is not None:
+            return int(num_places)
+        import jax
+        return len(jax.devices())
+
+    def decorate_reader(self, reader, multi_devices, num_places=None,
+                        drop_last=True):
+        """ref data_feeder.py:decorate_reader — wrap a sample-batch
+        reader into one yielding ready feed dicts; with multi_devices,
+        group num_places batches into one global feed (the mesh shards
+        the batch axis, replacing per-device placement)."""
+
+        def __reader_creator__():
+            if not multi_devices:
+                for item in reader():
+                    yield self.feed(item)
+            else:
+                num = self._get_number_of_places_(num_places)
+                group = []
+                for batch in reader():
+                    group.append(batch)
+                    if len(group) == num:
+                        yield self.feed_parallel(group, num)
+                        group = []
+                if group and not drop_last:
+                    raise ValueError(
+                        "The data batch which cannot fit for devices "
+                        "will be dropped is not implementation. Other "
+                        "strategies are not implemented")
+
+        return __reader_creator__

@@ -360,3 +360,27 @@ def test_as_numpy_and_fetch_var():
     assert _fetch_var(pname).shape == (4, 2)
     with pytest.raises(AssertionError):
         _fetch_var("nonexistent_var_xyz")
+
+
+def test_data_feeder_decorate_reader():
+    """ref data_feeder.py:decorate_reader — single- and multi-device
+    wrapping produce ready feed dicts (mesh shards the batch axis, so
+    the multi-device variant concatenates the per-place batches)."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data("dx", shape=[3])
+    y = fluid.layers.data("dy", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+
+    def rdr():
+        for i in range(4):
+            yield [(np.full(3, i, "float32"), np.array([i])) for _ in
+                   range(2)]
+
+    single = list(feeder.decorate_reader(rdr, multi_devices=False)())
+    assert len(single) == 4 and single[0]["dx"].shape == (2, 3)
+
+    multi = list(feeder.decorate_reader(rdr, multi_devices=True,
+                                        num_places=2)())
+    assert len(multi) == 2 and multi[0]["dx"].shape == (4, 3)
