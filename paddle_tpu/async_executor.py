@@ -72,9 +72,11 @@ class AsyncExecutor:
                 out[i, :a.shape[0]] = a
             return out
 
-        def provider():
+        def parse_shard(paths):
+            """One worker's files → batches (each worker batches its own
+            samples, like the reference's per-thread DataFeed)."""
             batch = []
-            for path in filelist:
+            for path in paths:
                 for sample in self._parse_file(path, data_feed):
                     batch.append(sample)
                     if len(batch) == data_feed.batch_size:
@@ -82,6 +84,55 @@ class AsyncExecutor:
                         batch = []
             if batch:
                 yield [stack_ragged(c) for c in zip(*batch)]
+
+        def provider():
+            n = max(1, min(int(thread_num or 1), len(filelist)))
+            if n == 1:
+                yield from parse_shard(filelist)
+                return
+            # honor thread_num (ref: C++ worker threads per file shard):
+            # n parser threads fill a bounded queue; this generator
+            # drains it — parsing overlaps device steps AND other parsers
+            import queue as _q
+            import threading as _t
+            out = _q.Queue(maxsize=4 * n)
+            _DONE = object()
+            stop = _t.Event()     # consumer gone: workers must unblock
+            errors = []
+
+            def worker(paths):
+                try:
+                    for b in parse_shard(paths):
+                        while not stop.is_set():
+                            try:
+                                out.put(b, timeout=0.2)
+                                break
+                            except _q.Full:
+                                continue
+                        else:
+                            return  # provider abandoned (reset/exception)
+                except Exception as e:  # surface to the consumer — a
+                    errors.append(e)    # swallowed parse error would
+                finally:                # silently drop the shard's data
+                    try:
+                        out.put_nowait(_DONE)
+                    except _q.Full:
+                        pass  # only reachable once stop is set
+            for i in range(n):
+                _t.Thread(target=worker, args=(filelist[i::n],),
+                          daemon=True).start()
+            try:
+                live = n
+                while live:
+                    item = out.get()
+                    if item is _DONE:
+                        live -= 1
+                        if errors:
+                            raise errors[0]
+                        continue
+                    yield item
+            finally:
+                stop.set()
 
         reader._provider = provider
         reader.start()

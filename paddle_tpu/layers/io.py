@@ -43,6 +43,14 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         is_data=True, stop_gradient=stop_gradient, lod_level=lod_level)
 
 
+class _ReaderError:
+    """Provider exception carried through the feed queue to the
+    consumer (re-raised by next_feed)."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 class PyReader:
     """Host-side feed queue bound to program data variables.
 
@@ -110,6 +118,10 @@ class PyReader:
                 for item in self._provider():
                     if not put(item):
                         return          # reset() requested — exit cleanly
+            except Exception as e:
+                # surface to the consumer: swallowing here would turn a
+                # data-pipeline error into a silent truncated epoch
+                put(_ReaderError(e))
             finally:
                 put(end)
 
@@ -155,6 +167,9 @@ class PyReader:
                     "polls (capacity %d) — the producer is the "
                     "bottleneck", n, self._stats["polls"], self.capacity)
         item = self._q.get()
+        if isinstance(item, _ReaderError):
+            self._started = False
+            raise item.exc
         if item is self._END:
             self._started = False
             raise EOFException("py_reader exhausted; call reset()+start()")

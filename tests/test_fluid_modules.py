@@ -384,3 +384,66 @@ def test_data_feeder_decorate_reader():
     multi = list(feeder.decorate_reader(rdr, multi_devices=True,
                                         num_places=2)())
     assert len(multi) == 2 and multi[0]["dx"].shape == (4, 3)
+
+
+def test_async_executor_multi_thread(tmp_path):
+    """thread_num > 1: multiple parser threads feed the queue; every
+    sample from every file shard is trained on exactly once."""
+    rng = np.random.RandomState(1)
+    paths = []
+    for p in range(4):
+        path = os.path.join(tmp_path, f"part-{p}")
+        with open(path, "w") as f:
+            for i in range(4):
+                feats = " ".join(str(round(v, 3)) for v in rng.randn(4))
+                f.write(f"4 {feats} 1 {i % 2}\n")
+        paths.append(path)
+    proto_path = os.path.join(tmp_path, "data.proto")
+    with open(proto_path, "w") as f:
+        f.write('name: "MultiSlotDataFeed"\nbatch_size: 2\n'
+                'multi_slot_desc {\n'
+                '  slots { name: "mfeat" type: "float32" is_dense: true '
+                'is_used: true }\n'
+                '  slots { name: "mlab" type: "int64" is_dense: true '
+                'is_used: true }\n}\n')
+    feed = pt.DataFeedDesc(proto_path)
+    feat = layers.data("mfeat", shape=[4], append_batch_size=False)
+    lab = layers.data("mlab", shape=[1], dtype="int64",
+                      append_batch_size=False)
+    s = layers.reduce_sum(feat)
+    ae = pt.AsyncExecutor()
+    ae.executor.run(pt.default_startup_program())
+    results = ae.run(pt.default_main_program(), feed, paths,
+                     thread_num=3, fetch=[s], debug=True)
+    assert len(results) == 8         # 16 samples / batch 2
+
+
+def test_async_executor_worker_error_surfaces(tmp_path):
+    """A malformed line in one shard must raise, not silently drop the
+    shard's remaining data (worker errors propagate to the consumer)."""
+    import pytest
+    good = os.path.join(tmp_path, "good-0")
+    bad = os.path.join(tmp_path, "bad-0")
+    with open(good, "w") as f:
+        for i in range(4):
+            f.write("2 0.5 0.5 1 0\n")
+    with open(bad, "w") as f:
+        f.write("2 0.5 oops 1 0\n")
+    proto_path = os.path.join(tmp_path, "data.proto")
+    with open(proto_path, "w") as f:
+        f.write('name: "MultiSlotDataFeed"\nbatch_size: 2\n'
+                'multi_slot_desc {\n'
+                '  slots { name: "efeat" type: "float32" is_dense: true '
+                'is_used: true }\n'
+                '  slots { name: "elab" type: "int64" is_dense: true '
+                'is_used: true }\n}\n')
+    feed = pt.DataFeedDesc(proto_path)
+    feat = layers.data("efeat", shape=[2], append_batch_size=False)
+    lab = layers.data("elab", shape=[1], dtype="int64",
+                      append_batch_size=False)
+    s = layers.reduce_sum(feat)
+    ae = pt.AsyncExecutor()
+    ae.executor.run(pt.default_startup_program())
+    with pytest.raises(Exception):
+        ae.run(pt.default_main_program(), feed, [good, bad],
+               thread_num=2, fetch=[s], debug=True)
