@@ -527,3 +527,33 @@ def test_sparse_under_bf16_amp():
                            if "bt_moment1" in v.name])][0]
         assert m1.dtype == jnp.float32
     assert np.isfinite(ls).all() and ls[-1] < ls[0], ls
+
+
+def test_sparse_model_aot_inference_roundtrip(tmp_path):
+    """CTR deploy story: an is_sparse model's pruned inference program
+    exports AOT (StableHLO save_compiled), reloads, and matches the
+    jit path (the delta taps are inert scalar zeros at inference)."""
+    from paddle_tpu.inference import InferenceEngine
+    vocab, dim = 30, 4
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            ids = layers.data("ids", shape=[4, 1], dtype="int64")
+            emb = layers.embedding(ids, size=[vocab, dim],
+                                   is_sparse=True)
+            pred = layers.fc(layers.reduce_sum(emb, dim=1), size=2,
+                             act="softmax")
+    infer_p = main.clone(for_test=True)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.RandomState(30)
+    x = rng.randint(0, vocab, (3, 4, 1)).astype("int64")
+    with pt.scope_guard(scope):
+        exe.run(startup)
+    eng = InferenceEngine(infer_p, ["ids"], [pred], scope)
+    ref = np.asarray(eng.run({"ids": x})[0])
+    d = str(tmp_path / "aot")
+    eng.save_compiled(d, {"ids": (3, 4, 1)}, dtypes={"ids": "int64"})
+    eng2 = InferenceEngine.load_compiled(d)
+    out = np.asarray(eng2.run({"ids": x})[0])
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
