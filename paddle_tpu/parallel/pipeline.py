@@ -227,6 +227,14 @@ class PipelineTrainer:
 
         # per-stage trainable params (deterministic first-use order)
         persistable = {v.name: v for v in program.persistable_vars()}
+        if self._bwd_op.attrs.get("sparse_params"):
+            # the pipeline's stage-wise backward never produces the
+            # row-grad taps the sparse update ops consume — fail with
+            # the contract instead of a KeyError deep in the replay
+            raise NotImplementedError(
+                "PipelineTrainer does not support embedding("
+                "is_sparse=True) tables; build the pipeline program "
+                "with is_sparse=False (dense gather grads)")
         bwd_params = set(self._bwd_op.attrs["param_names"])
         self.stage_params = []
         for seg in segs:

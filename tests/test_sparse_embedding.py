@@ -557,3 +557,29 @@ def test_sparse_model_aot_inference_roundtrip(tmp_path):
     eng2 = InferenceEngine.load_compiled(d)
     out = np.asarray(eng2.run({"ids": x})[0])
     np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_rejects_sparse_tables():
+    """PipelineTrainer's stage-wise backward can't produce the sparse
+    row-grad taps — it must state the contract, not KeyError."""
+    import jax
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline import PipelineTrainer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            ids = layers.data("ids", shape=[4, 1], dtype="int64")
+            label = layers.data("label", shape=[8])
+            emb = layers.embedding(ids, size=[40, 8], is_sparse=True)
+            h = layers.reduce_sum(emb, dim=1)
+            h2 = layers.fc(h, size=8)
+            loss = layers.mean(layers.square_error_cost(h2, label))
+            pt.optimizer.SGD(0.05).minimize(loss)
+    mesh = make_mesh(pp=2, devices=jax.devices()[:2])
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(startup)
+    with pytest.raises(NotImplementedError, match="is_sparse"):
+        PipelineTrainer(main, loss, [h.name], mesh, n_microbatch=2,
+                        scope=scope)
