@@ -60,6 +60,7 @@ from . import reader
 from . import dataset
 from . import models
 from . import imperative
+from . import utils
 # reference import-path aliases: paddle.fluid.{framework,executor,
 # parallel_executor,backward} are real modules there — expose the same
 # paths so `fluid.framework.Program` / `from paddle_tpu.executor

@@ -1,0 +1,8 @@
+"""paddle.utils compat (the book-demo helpers).
+
+Parity: python/paddle/utils — only the pieces the fluid book/demos use
+(plot.Ploter); the v1-era converters (dump_config, torch2paddle, ...)
+predate fluid and are out of scope (SURVEY §2 covers the fluid
+framework surface).
+"""
+from . import plot  # noqa: F401

@@ -1,0 +1,68 @@
+"""Training-curve plotting helper.
+
+Parity: python/paddle/utils/plot.py:Ploter — the book chapters append
+(title, step, cost) points and draw in notebooks. Headless-safe: data
+is always recorded; drawing happens only when matplotlib imports. The
+DISABLE_PLOT=True knob is read at CALL time, like the reference.
+"""
+import os
+
+__all__ = ["Ploter", "PlotData"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {t: PlotData() for t in args}
+
+    def __plot_is_disabled__(self):
+        return os.environ.get("DISABLE_PLOT") == "True"
+
+    def _pyplot(self):
+        try:
+            import matplotlib
+            matplotlib.use("Agg")  # headless container
+            import matplotlib.pyplot as plt
+            return plt
+        except Exception:
+            return None  # record-only mode
+
+    def append(self, title, step, value):
+        assert title in self.__plot_data__, (
+            f"{title} not in the Ploter titles {self.__args__}")
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        plt = self._pyplot()
+        if plt is None:
+            return
+        titles = []
+        for title in self.__args__:
+            data = self.__plot_data__[title]
+            if len(data.step) > 0:
+                plt.plot(data.step, data.value)
+                titles.append(title)
+        plt.legend(titles, loc="upper left")
+        if path:
+            plt.savefig(path)
+        plt.clf()
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

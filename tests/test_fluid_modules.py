@@ -447,3 +447,27 @@ def test_async_executor_worker_error_surfaces(tmp_path):
     with pytest.raises(Exception):
         ae.run(pt.default_main_program(), feed, [good, bad],
                thread_num=2, fetch=[s], debug=True)
+
+
+def test_utils_ploter(tmp_path, monkeypatch):
+    """paddle.utils.plot.Ploter (book demos): record, draw headless
+    (Agg) to a file, reset — plus the call-time DISABLE_PLOT knob."""
+    import paddle_tpu as pt_pkg
+    from paddle_tpu.utils.plot import Ploter
+    assert pt_pkg.utils.plot.Ploter is Ploter  # pt.utils exposed
+    p = Ploter("train", "test")
+    for i in range(3):
+        p.append("train", i, 1.0 / (i + 1))
+    p.append("test", 0, 1.2)
+    path = os.path.join(tmp_path, "curve.png")
+    p.plot(path)
+    if p._pyplot() is not None:
+        assert os.path.exists(path)
+    p.reset()
+    assert p.__plot_data__["train"].step == []
+    # knob is read at CALL time (reference behavior)
+    monkeypatch.setenv("DISABLE_PLOT", "True")
+    p.append("train", 9, 0.1)
+    none_path = os.path.join(tmp_path, "none.png")
+    p.plot(none_path)
+    assert not os.path.exists(none_path)
