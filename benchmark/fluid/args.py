@@ -1,0 +1,36 @@
+"""CLI for the fluid benchmark runner.
+
+Parity: benchmark/fluid/args.py — same flag names/defaults so the
+reference's run commands work verbatim, with TPU added to --device
+(and accepted as the default on this stack). GPU is taken as an alias
+of TPU, matching fluid.CUDAPlace -> TPUPlace aliasing.
+"""
+import argparse
+
+BENCHMARK_MODELS = ["machine_translation", "resnet", "vgg", "mnist",
+                    "stacked_dynamic_lstm", "se_resnext"]
+
+
+def parse_args():
+    parser = argparse.ArgumentParser("Fluid model benchmarks.")
+    parser.add_argument("--model", type=str, choices=BENCHMARK_MODELS,
+                        default="resnet")
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--learning_rate", type=float, default=0.001)
+    parser.add_argument("--skip_batch_num", type=int, default=5,
+                        help="warmup minibatches excluded from timing")
+    parser.add_argument("--iterations", type=int, default=80)
+    parser.add_argument("--pass_num", type=int, default=1)
+    parser.add_argument("--data_format", type=str, default="NCHW",
+                        choices=["NCHW", "NHWC"])
+    parser.add_argument("--device", type=str, default="TPU",
+                        choices=["CPU", "GPU", "TPU"])
+    parser.add_argument("--data_set", type=str, default="cifar10",
+                        choices=["cifar10", "flowers", "imagenet"])
+    parser.add_argument("--infer_only", action="store_true")
+    parser.add_argument("--use_bf16", action="store_true",
+                        help="bf16 AMP (replaces the reference's fp16)")
+    parser.add_argument("--profile", action="store_true",
+                        help="device-side per-op profile of the steady "
+                             "state (jax.profiler xplane)")
+    return parser.parse_args()

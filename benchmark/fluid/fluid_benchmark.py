@@ -1,0 +1,91 @@
+"""Fluid benchmark runner.
+
+Parity: benchmark/fluid/fluid_benchmark.py — same CLI, same report
+(samples/sec over the timed iterations, warmup skipped), re-designed
+for the TPU stack: the whole train step (fwd+bwd+update) compiles to
+ONE XLA module via the tracing Executor; --device TPU runs on the real
+chip, CPU forces the host backend (GPU is accepted as a TPU alias).
+
+Examples:
+  python fluid_benchmark.py --model mnist --device CPU --iterations 20
+  python fluid_benchmark.py --model machine_translation --batch_size 64 \
+      --use_bf16 --iterations 40
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))  # repo root
+
+from args import parse_args
+
+
+def main():
+    args = parse_args()
+    if args.data_format == "NHWC":
+        raise ValueError("only NCHW is supported (same as the reference)")
+    if args.device == "CPU":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+
+    model_mod = __import__(f"models.{args.model}",
+                           fromlist=["get_model"])
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        with fluid.unique_name.guard():
+            loss, feed_fn = model_mod.get_model(args)
+            opt = fluid.optimizer.Adam(args.learning_rate) \
+                if args.model == "machine_translation" \
+                else fluid.optimizer.Momentum(args.learning_rate, 0.9)
+            if not args.infer_only:
+                opt.minimize(loss)
+    if args.use_bf16:
+        fluid.amp.cast_program_to_bf16(main_p)
+
+    place = fluid.CPUPlace() if args.device == "CPU" \
+        else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(startup_p)
+    if args.use_bf16:
+        fluid.amp.cast_params_to_bf16(main_p, fluid.global_scope())
+
+    rng = np.random.RandomState(0)
+    total = args.skip_batch_num + args.iterations
+    losses, t0 = [], None
+    prog = main_p.clone(for_test=True) if args.infer_only else main_p
+    for p in range(args.pass_num):
+        for it in range(total):
+            if it == args.skip_batch_num:
+                t0 = time.perf_counter()
+            out = exe.run(prog, feed=feed_fn(args.batch_size, rng),
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(out[0])))
+        dt = time.perf_counter() - t0
+        sps = args.iterations * args.batch_size / dt
+        print(f"Pass: {p}, Loss: {losses[-1]:.5f}, "
+              f"Speed: {sps:.2f} samples/s "
+              f"({dt / args.iterations * 1e3:.2f} ms/iter)")
+    if args.profile:
+        from paddle_tpu.profiler import profile_step_fn
+        feed = feed_fn(args.batch_size, rng)
+
+        def one_step():
+            return exe.run(prog, feed=feed, fetch_list=[loss])
+
+        dev_s, fams = profile_step_fn(one_step, steps=10)
+        top = sorted(fams.items(), key=lambda kv: -kv[1])[:8]
+        print(f"device step: {dev_s * 1e3:.2f} ms; top op families:")
+        for k, v in top:
+            print(f"  {k:<28} {v * 1e3:8.2f} ms")
+    assert all(np.isfinite(losses)), "non-finite loss"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Benchmark model builders (parity: benchmark/fluid/models/__init__.py).
+
+Each module exposes get_model(args) -> (loss_var, feed_fn) where
+feed_fn(batch_size, rng) returns a ready feed dict of synthetic data
+with the reference benchmark's shapes.
+"""
+__all__ = ["machine_translation", "resnet", "vgg", "mnist",
+           "stacked_dynamic_lstm", "se_resnext"]
+
+# dataset input sizes / class counts shared by the vision models
+DATA_HW = {"cifar10": 32, "flowers": 224, "imagenet": 224}
+DATA_CLASSES = {"cifar10": 10, "flowers": 102, "imagenet": 1000}

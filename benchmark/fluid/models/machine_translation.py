@@ -1,0 +1,29 @@
+"""Transformer NMT benchmark model (parity:
+benchmark/fluid/models/machine_translation.py — the reference's
+headline seq2seq benchmark, here the transformer-base from the zoo)."""
+import numpy as np
+
+from paddle_tpu.models import transformer as zoo
+
+
+def get_model(args):
+    T = 128
+    cfg = zoo.TransformerConfig(src_vocab=8000, trg_vocab=8000,
+                                max_len=T, d_model=512, d_inner=2048,
+                                n_head=8, n_layer=6, dropout=0.1)
+    feeds, avg_cost, tok = zoo.build_program(cfg, maxlen=T,
+                                             use_noam=False)
+
+    def feed_fn(batch_size, rng):
+        src = rng.randint(3, cfg.src_vocab, (batch_size, T)).astype(
+            "int32")
+        trg = np.concatenate(
+            [np.zeros((batch_size, 1), "int32"),
+             (src[:, :-1] + 1) % cfg.trg_vocab], axis=1)
+        return {"src": src,
+                "src_len": np.full(batch_size, T, "int32"),
+                "trg": trg,
+                "trg_len": np.full(batch_size, T, "int32"),
+                "label": ((src + 1) % cfg.trg_vocab).astype("int32")}
+
+    return avg_cost, feed_fn

@@ -1,0 +1,16 @@
+"""MNIST MLP benchmark model (parity: benchmark/fluid/models/mnist.py)."""
+from paddle_tpu import layers
+from paddle_tpu.models import mnist as zoo
+
+
+def get_model(args):
+    img = layers.data("pixel", shape=[784])
+    label = layers.data("label", shape=[1], dtype="int64")
+    predict = zoo.mlp(img)
+    loss = layers.mean(layers.cross_entropy(input=predict, label=label))
+
+    def feed_fn(batch_size, rng):
+        return {"pixel": rng.rand(batch_size, 784).astype("float32"),
+                "label": rng.randint(0, 10, (batch_size, 1))}
+
+    return loss, feed_fn

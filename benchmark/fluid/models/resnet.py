@@ -1,0 +1,22 @@
+"""ResNet-50 benchmark model (parity: benchmark/fluid/models/resnet.py)."""
+from paddle_tpu import layers
+from paddle_tpu.models import resnet as zoo
+
+from . import DATA_HW, DATA_CLASSES
+
+
+def get_model(args):
+    hw = DATA_HW[args.data_set]
+    classes = DATA_CLASSES[args.data_set]
+    img = layers.data("data", shape=[3, hw, hw])
+    label = layers.data("label", shape=[1], dtype="int64")
+    # ImageNet-sized inputs run the 50-layer net; 32x32 runs 18 layers
+    predict = zoo.resnet(img, class_dim=classes,
+                         depth=50 if hw == 224 else 18)
+    loss = layers.mean(layers.cross_entropy(input=predict, label=label))
+
+    def feed_fn(batch_size, rng):
+        return {"data": rng.rand(batch_size, 3, hw, hw).astype("float32"),
+                "label": rng.randint(0, classes, (batch_size, 1))}
+
+    return loss, feed_fn

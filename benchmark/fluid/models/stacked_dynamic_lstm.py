@@ -1,0 +1,22 @@
+"""Stacked LSTM benchmark model (parity:
+benchmark/fluid/models/stacked_dynamic_lstm.py — variable-length
+sentiment LM; lengths ride the seq_len vector, shapes stay static)."""
+from paddle_tpu.models import stacked_lstm as zoo
+
+_T = 128
+_DICT = 5147
+
+
+def get_model(args):
+    feeds, avg_cost, acc = zoo.build_program(dict_dim=_DICT, maxlen=_T)
+
+    def feed_fn(batch_size, rng):
+        lens = rng.randint(_T // 2, _T + 1, batch_size)
+        words = rng.randint(0, _DICT, (batch_size, _T))
+        for i, l in enumerate(lens):
+            words[i, l:] = 0
+        return {"words": words.astype("int64"),
+                "words_seq_len": lens.astype("int64"),
+                "label": rng.randint(0, 2, (batch_size, 1))}
+
+    return avg_cost, feed_fn

@@ -1,0 +1,20 @@
+"""VGG-16 benchmark model (parity: benchmark/fluid/models/vgg.py)."""
+from paddle_tpu import layers
+from paddle_tpu.models import vgg as zoo
+
+from . import DATA_HW, DATA_CLASSES
+
+
+def get_model(args):
+    hw = DATA_HW[args.data_set]
+    classes = DATA_CLASSES[args.data_set]
+    img = layers.data("data", shape=[3, hw, hw])
+    label = layers.data("label", shape=[1], dtype="int64")
+    predict = zoo.vgg16(img, class_dim=classes)
+    loss = layers.mean(layers.cross_entropy(input=predict, label=label))
+
+    def feed_fn(batch_size, rng):
+        return {"data": rng.rand(batch_size, 3, hw, hw).astype("float32"),
+                "label": rng.randint(0, classes, (batch_size, 1))}
+
+    return loss, feed_fn

@@ -2,8 +2,9 @@
 
 Parity: python/paddle/utils/plot.py:Ploter — the book chapters append
 (title, step, cost) points and draw in notebooks. Headless-safe: data
-is always recorded; drawing happens only when matplotlib imports. The
-DISABLE_PLOT=True knob is read at CALL time, like the reference.
+is always recorded; drawing happens only when matplotlib imports (its
+own backend auto-selection handles display-less hosts). DISABLE_PLOT=
+True is captured at construction, matching the reference.
 """
 import os
 
@@ -28,14 +29,13 @@ class Ploter:
     def __init__(self, *args):
         self.__args__ = args
         self.__plot_data__ = {t: PlotData() for t in args}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT")
 
     def __plot_is_disabled__(self):
-        return os.environ.get("DISABLE_PLOT") == "True"
+        return self.__disable_plot__ == "True"
 
     def _pyplot(self):
         try:
-            import matplotlib
-            matplotlib.use("Agg")  # headless container
             import matplotlib.pyplot as plt
             return plt
         except Exception:
@@ -52,16 +52,21 @@ class Ploter:
         plt = self._pyplot()
         if plt is None:
             return
-        titles = []
-        for title in self.__args__:
-            data = self.__plot_data__[title]
-            if len(data.step) > 0:
-                plt.plot(data.step, data.value)
-                titles.append(title)
-        plt.legend(titles, loc="upper left")
-        if path:
-            plt.savefig(path)
-        plt.clf()
+        try:
+            titles = []
+            for title in self.__args__:
+                data = self.__plot_data__[title]
+                if len(data.step) > 0:
+                    plt.plot(data.step, data.value)
+                    titles.append(title)
+            if not titles:
+                return  # nothing recorded yet: no empty figure/warning
+            plt.legend(titles, loc="upper left")
+            if path:
+                plt.savefig(path)
+            plt.clf()
+        except Exception:
+            return  # broken DISPLAY/backend: record-only degrade
 
     def reset(self):
         for data in self.__plot_data__.values():

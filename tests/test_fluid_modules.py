@@ -455,6 +455,7 @@ def test_utils_ploter(tmp_path, monkeypatch):
     import paddle_tpu as pt_pkg
     from paddle_tpu.utils.plot import Ploter
     assert pt_pkg.utils.plot.Ploter is Ploter  # pt.utils exposed
+    monkeypatch.delenv("DISABLE_PLOT", raising=False)
     p = Ploter("train", "test")
     for i in range(3):
         p.append("train", i, 1.0 / (i + 1))
@@ -465,9 +466,15 @@ def test_utils_ploter(tmp_path, monkeypatch):
         assert os.path.exists(path)
     p.reset()
     assert p.__plot_data__["train"].step == []
-    # knob is read at CALL time (reference behavior)
+    # plotting with nothing recorded writes no file (and no warning)
+    p3 = Ploter("empty")
+    empty_path = os.path.join(tmp_path, "empty.png")
+    p3.plot(empty_path)
+    assert not os.path.exists(empty_path)
+    # knob is captured at construction (reference behavior)
     monkeypatch.setenv("DISABLE_PLOT", "True")
-    p.append("train", 9, 0.1)
+    p2 = Ploter("x")
+    p2.append("x", 0, 1.0)
     none_path = os.path.join(tmp_path, "none.png")
-    p.plot(none_path)
+    p2.plot(none_path)
     assert not os.path.exists(none_path)
