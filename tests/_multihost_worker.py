@@ -1,14 +1,22 @@
 """Worker process for tests/test_multihost.py — NOT a test module.
 
-Run as: python _multihost_worker.py <process_id> <num_processes> <port>
+Run as: python _multihost_worker.py <pid> <nproc> <port> [mode] [dir]
 
 Initializes the real multi-process runtime (fleet.init →
 jax.distributed.initialize) on the CPU backend with 2 local virtual
 devices per process, builds a GLOBAL mesh spanning both processes, and
-runs a psum whose operand is globally sharded — the XLA collective
-actually crosses the process boundary (the reference's NCCL/gRPC
-all-reduce analog, paddle/fluid/operators/distributed/grpc_server.cc).
-Prints "RESULT <psum> <process_count> <global_devices>" on success.
+runs the selected check:
+
+- mode "psum" (default): a psum whose operand is globally sharded —
+  the XLA collective actually crosses the process boundary (the
+  reference's NCCL/gRPC all-reduce analog,
+  paddle/fluid/operators/distributed/grpc_server.cc).
+- mode "ckpt": each host saves only ITS shards of a global array via
+  save_sharded_checkpoint into <dir> (barrier before AND after the
+  host-0 publish rename), then loads it back and checks its local
+  shards — the pserver checkpoint RPC analog.
+
+Prints "RESULT ..." on success.
 """
 import os
 import sys
@@ -16,6 +24,8 @@ import sys
 
 def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "psum"
+    workdir = sys.argv[5] if len(sys.argv) > 5 else None
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
@@ -53,10 +63,32 @@ def main():
     fleet.barrier_all()
     print(f"[w{pid}] barrier done", flush=True)
 
-    # global mesh over all processes' devices; operand sharded over it,
-    # each global device d contributing (d+1)
+    # global mesh over all processes' devices
     mesh = Mesh(np.array(jax.devices()), ("dp",))
     sharding = NamedSharding(mesh, P("dp"))
+
+    if mode == "ckpt":
+        from paddle_tpu.io import (save_sharded_checkpoint,
+                                   load_sharded_checkpoint)
+        rows = np.arange(n_global * 8, dtype=np.float32).reshape(
+            n_global, 8)
+        garr = jax.make_array_from_callback(
+            rows.shape, NamedSharding(mesh, P("dp", None)),
+            lambda idx: rows[idx])
+        save_sharded_checkpoint(workdir, {"w": garr}, step=3)
+        # the post-publish barrier inside save guarantees the rename
+        # has landed for EVERY host before any host loads
+        restored, meta = load_sharded_checkpoint(workdir, mesh=mesh)
+        assert meta["step"] == 3, meta
+        w2 = restored["w"]
+        for shard in w2.addressable_shards:
+            np.testing.assert_array_equal(
+                np.asarray(shard.data), rows[shard.index])
+        print(f"RESULT ckpt-ok {fleet.worker_num()} {n_global}",
+              flush=True)
+        return
+
+    # operand sharded over the global mesh, device d contributing (d+1)
     contrib = np.arange(1, n_global + 1, dtype=np.float32)
     garr = jax.make_array_from_callback(
         (n_global,), sharding, lambda idx: contrib[idx])

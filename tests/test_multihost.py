@@ -26,7 +26,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_fleet_init_psum(tmp_path):
+def _spawn_workers(tmp_path, extra_args=()):
     port = _free_port()
     repo_root = os.path.dirname(os.path.dirname(_WORKER))
     env = dict(os.environ)
@@ -34,9 +34,11 @@ def test_two_process_fleet_init_psum(tmp_path):
     # suite's 8-device forcing so workers get exactly 2 local devices
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
-    # the TPU-relay jax plugin initializes differently when it sees
-    # pytest markers in the env, and the workers then hang inside
-    # jax.devices(); scrub them — the workers are standalone programs
+    # keep the workers' env free of pytest markers: they are standalone
+    # programs, and the TPU-relay plugin's behavior under ambient env
+    # differences was implicated while debugging worker hangs (the
+    # decisive fix was jax.config.update in the worker, but scrubbing
+    # stays as cheap insurance)
     env.pop("PYTEST_CURRENT_TEST", None)
     env.pop("PYTEST_VERSION", None)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -51,7 +53,8 @@ def test_two_process_fleet_init_psum(tmp_path):
     for i in range(_NPROC):
         with open(logs[i][0], "w") as so, open(logs[i][1], "w") as se:
             procs.append(subprocess.Popen(
-                [sys.executable, _WORKER, str(i), str(_NPROC), str(port)],
+                [sys.executable, _WORKER, str(i), str(_NPROC),
+                 str(port), *extra_args],
                 stdout=so, stderr=se, env=env, cwd=repo_root))
     try:
         deadline = time.monotonic() + 240
@@ -67,8 +70,26 @@ def test_two_process_fleet_init_psum(tmp_path):
     for rc, out, err in outs:
         assert rc == 0, \
             f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+    return outs
+
+
+def test_two_process_fleet_init_psum(tmp_path):
+    outs = _spawn_workers(tmp_path)
     # both workers saw 2 processes, 4 global devices, and the full psum
     expected = (f"RESULT {float(sum(range(1, 2 * _NPROC + 1)))} "
                 f"{_NPROC} {2 * _NPROC}")
     for rc, out, err in outs:
         assert expected in out, (expected, out, err[-500:])
+
+
+def test_two_process_sharded_checkpoint(tmp_path):
+    """Each host writes only ITS shards; host 0 publishes behind the
+    pre-rename barrier; the post-publish barrier lets every host load
+    immediately — both hosts restore their local shards bit-exact
+    (the pserver checkpoint RPC analog)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    outs = _spawn_workers(tmp_path, extra_args=("ckpt", ckpt_dir))
+    expected = f"RESULT ckpt-ok {_NPROC} {2 * _NPROC}"
+    for rc, out, err in outs:
+        assert expected in out, (expected, out, err[-500:])
+    assert os.path.isdir(ckpt_dir)  # the rename landed
