@@ -19,10 +19,15 @@ class Place:
         self.device_id = int(device_id)
 
     def jax_device(self):
-        devs = [d for d in jax.devices() if d.platform == self.platform]
+        # LOCAL devices only: under multi-process
+        # (jax.distributed.initialize) jax.devices() spans every host,
+        # and placing a single-device computation on another host's
+        # device is impossible (non-addressable)
+        devs = [d for d in jax.local_devices()
+                if d.platform == self.platform]
         if not devs:
             # graceful fallback (e.g. TPUPlace in a CPU-only test env)
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     def __eq__(self, other):
@@ -49,9 +54,10 @@ class TPUPlace(Place):
     platform = "tpu"
 
     def jax_device(self):
-        devs = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+        devs = [d for d in jax.local_devices()
+                if d.platform in ("tpu", "axon")]
         if not devs:
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 

@@ -12,6 +12,15 @@ applied to params AND optimizer state, giving Megatron tensor parallel
 (tp axis) and ZeRO-style optimizer-state sharding (mode="zero", the
 pserver analog) THROUGH this executor — the scope then holds genuinely
 sharded jax.Arrays between steps.
+
+Multi-host (after fleet.init → jax.distributed.initialize): the mesh
+spans every process's devices; each host feeds its LOCAL batch (the
+reference's per-trainer readers) and the feeds are assembled into
+global arrays (host_local_array_to_global_array), so the global batch
+is the concatenation over hosts on the dp axis; params materialize
+shard-wise from each host's identically-seeded full copy. Tested by
+tests/test_multihost.py::test_two_process_data_parallel_training
+(2-process dp == single-process global-batch numerics).
 """
 import numpy as np
 import jax
@@ -55,6 +64,9 @@ class ParallelExecutor:
         return int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
 
     def _feed_sharding(self, arr, name=None):
+        """Sharding for one HOST-LOCAL feed array (multi-process: the
+        global batch is nproc local batches, which is what dp must
+        divide)."""
         if arr.ndim == 0 or "dp" not in self.mesh.shape:
             return self._replicated
         if self.transpiler is not None:
@@ -62,8 +74,17 @@ class ParallelExecutor:
             # axis + sp time axis; see transpiler.feed_sharding)
             return self.transpiler.feed_sharding(arr.shape, name=name)
         dp = self.mesh.shape.get("dp", 1)
-        dp_ok = arr.ndim > 0 and arr.shape[0] % dp == 0
+        dp_ok = (arr.shape[0] * jax.process_count()) % dp == 0
         if not dp_ok and dp > 1:
+            if jax.process_count() > 1:
+                # replication can't represent divergent per-host
+                # batches — assembling them as "replicated" would make
+                # hosts silently compute different gradients
+                raise RuntimeError(
+                    f"feed batch {arr.shape[0]} x "
+                    f"{jax.process_count()} hosts does not divide "
+                    f"dp={dp}; pad the local batch (multi-host feeds "
+                    "cannot fall back to replication)")
             import warnings
             warnings.warn(
                 f"feed batch {arr.shape[0]} does not divide dp={dp}; "
@@ -73,6 +94,32 @@ class ParallelExecutor:
 
     def _param_sharding(self, name):
         return self._shardings.get(name, self._replicated)
+
+    def _feed_to_global(self, arr, sharding):
+        """Place one host-side feed array. Single-process: plain
+        device_put. Multi-process: `arr` is this HOST's local batch;
+        assemble the global array (global batch = hosts' batches
+        concatenated along the sharded axes — the per-trainer reader
+        semantics). Feeds whose sharding is fully replicated must be
+        host-identical (e.g. constants); that is the caller's contract,
+        like the reference's broadcast-once parameters."""
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        from jax.experimental import multihost_utils
+        return multihost_utils.host_local_array_to_global_array(
+            np.asarray(arr), self.mesh, sharding.spec)
+
+    def _param_to_global(self, val, sharding):
+        """Place one persistable. Multi-process: every host holds an
+        identically-seeded full copy; each materializes only its
+        addressable shards."""
+        if jax.process_count() == 1:
+            return jax.device_put(val, sharding)
+        if isinstance(val, jax.Array) and not val.is_fully_addressable:
+            return val
+        v = np.asarray(val)
+        return jax.make_array_from_callback(v.shape, sharding,
+                                            lambda idx: v[idx])
 
     def run(self, fetch_list=None, feed=None, feed_dict=None,
             return_numpy=True, is_test=False):
@@ -86,15 +133,27 @@ class ParallelExecutor:
         self._step += 1
 
         feed_arrays = {}
+        feed_sh = {}
         for k, v in feed.items():
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                # already a global array (e.g. a return_numpy=False
+                # fetch): pass through with its own sharding
+                feed_arrays[k] = v
+                feed_sh[k] = v.sharding
+                continue
             var = program.global_block().vars.get(k)
             dt = as_jnp_dtype(var.dtype) if var is not None else None
-            arr = jnp.asarray(np.asarray(v), dtype=dt)
-            # non-divisible batches fall back to replication inside
-            # feed_sharding (slice_variable remainder analog) rather
-            # than erroring — XLA still computes the correct math
-            feed_arrays[k] = jax.device_put(
-                arr, self._feed_sharding(arr, name=k))
+            # stay on host until placement — a jnp cast here would add
+            # a device->host round-trip before the global assembly
+            arr = np.asarray(v)
+            if dt is not None and arr.dtype != np.dtype(dt):
+                arr = arr.astype(dt)
+            # single-process non-divisible batches fall back to
+            # replication inside feed_sharding (slice_variable
+            # remainder analog); multi-process they raise there
+            sh = self._feed_sharding(arr, name=k)
+            feed_sh[k] = sh
+            feed_arrays[k] = self._feed_to_global(arr, sh)
 
         persist = {}
         persist_sh = {}
@@ -106,7 +165,7 @@ class ParallelExecutor:
                     f"startup program on a plain Executor first")
             sh = self._param_sharding(v.name)
             persist_sh[v.name] = sh
-            persist[v.name] = jax.device_put(val, sh)
+            persist[v.name] = self._param_to_global(val, sh)
 
         sig = tuple(sorted((k, v.shape, str(v.dtype))
                            for k, v in feed_arrays.items()))
@@ -131,11 +190,8 @@ class ParallelExecutor:
 
             fn = jax.jit(
                 wrapped,
-                in_shardings=(
-                    persist_sh,
-                    {n: self._feed_sharding(feed_arrays[n], name=n)
-                     for n in feed_arrays},
-                    self._replicated),
+                in_shardings=(persist_sh, dict(feed_sh),
+                              self._replicated),
                 donate_argnums=(0,))
             self._cache[ckey] = fn
 

@@ -163,10 +163,20 @@ class DistributeTranspiler:
         # a batch that doesn't tile onto dp stays replicated (the
         # reference's slice_variable remainder handling analog) — an
         # uneven device_put would hard-error. Loud: silently disabled
-        # data parallelism is an n-times throughput loss.
+        # data parallelism is an n-times throughput loss. Multi-host:
+        # the shape is the host-LOCAL batch; dp must divide the global
+        # batch (nproc local batches concatenated).
         dp = self.mesh.shape.get("dp", 1)
-        dp_ok = shape[0] % dp == 0
+        dp_ok = (shape[0] * jax.process_count()) % dp == 0
         if not dp_ok and dp > 1:
+            if jax.process_count() > 1:
+                # replication cannot represent divergent per-host
+                # batches (see ParallelExecutor._feed_sharding)
+                raise RuntimeError(
+                    f"feed batch {shape[0]} x {jax.process_count()} "
+                    f"hosts does not divide dp={dp}; pad the local "
+                    "batch (multi-host feeds cannot fall back to "
+                    "replication)")
             import warnings
             warnings.warn(
                 f"feed batch {shape[0]} does not divide dp={dp}; "

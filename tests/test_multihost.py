@@ -93,3 +93,18 @@ def test_two_process_sharded_checkpoint(tmp_path):
     for rc, out, err in outs:
         assert expected in out, (expected, out, err[-500:])
     assert os.path.isdir(ckpt_dir)  # the rename landed
+
+
+def test_two_process_data_parallel_training(tmp_path):
+    """FULL multi-host data-parallel training through ParallelExecutor:
+    2 processes × 2 devices, each host feeding its local batch; the
+    per-step losses must equal a single-process run on the
+    concatenated global batch (same seeds), and decrease."""
+    outs = _spawn_workers(tmp_path, extra_args=("train",))
+    for rc, out, err in outs:
+        assert f"RESULT train-ok {_NPROC} {2 * _NPROC}" in out, \
+            (out, err[-500:])
+    # both hosts report identical loss sequences (replicated outputs)
+    seqs = {line.split(" ", 4)[-1] for rc, out, _ in outs
+            for line in out.splitlines() if line.startswith("RESULT train-ok")}
+    assert len(seqs) == 1, seqs
