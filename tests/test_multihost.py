@@ -108,3 +108,13 @@ def test_two_process_data_parallel_training(tmp_path):
     seqs = {line.split(" ", 4)[-1] for rc, out, _ in outs
             for line in out.splitlines() if line.startswith("RESULT train-ok")}
     assert len(seqs) == 1, seqs
+
+
+def test_two_process_tensor_parallel_training(tmp_path):
+    """dp x tp on the 2-process mesh (tp intra-host, dp across hosts):
+    Megatron-sharded weights + cross-host grad all-reduce must equal
+    the single-process numerics."""
+    outs = _spawn_workers(tmp_path, extra_args=("tp",))
+    for rc, out, err in outs:
+        assert f"RESULT tp-ok {_NPROC} {2 * _NPROC}" in out, \
+            (out, err[-500:])
