@@ -9,3 +9,19 @@ class EOFException(Exception):
     """Raised by Executor.run when a py_reader/file reader is exhausted
     (parity: paddle.fluid.core.EOFException from the C++ reader queue)."""
 
+
+def is_compiled_with_cuda():
+    """CUDA-availability compat (ref core.is_compiled_with_cuda):
+    reference programs branch on this to pick CUDAPlace, and CUDAPlace
+    aliases TPUPlace here (MIGRATING.md) — so this answers "is an
+    accelerator backend available", WITHOUT initializing any backend
+    (a relay probe could hang): False only when the platform is forced
+    to cpu."""
+    import jax
+    platforms = jax.config.jax_platforms or ""
+    return "cpu" not in platforms.split(",")[:1]
+
+
+# the accelerator here IS the TPU; same answer, honest name
+is_compiled_with_tpu = is_compiled_with_cuda
+

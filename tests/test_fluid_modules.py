@@ -478,3 +478,13 @@ def test_utils_ploter(tmp_path, monkeypatch):
     none_path = os.path.join(tmp_path, "none.png")
     p2.plot(none_path)
     assert not os.path.exists(none_path)
+
+
+def test_is_compiled_with_cuda_compat():
+    """ref core.is_compiled_with_cuda: the device-branch predicate;
+    False under the forced-CPU test config (no backend init involved),
+    so reference programs branch to CPUPlace here and to
+    CUDAPlace→TPUPlace when the accelerator platform is active."""
+    from paddle_tpu import core
+    assert core.is_compiled_with_cuda() is False  # conftest forces cpu
+    assert core.is_compiled_with_tpu() is False
