@@ -547,10 +547,13 @@ def run_benchmarks(platform, emit_progress=None):
                                         "device_kind", "")
         progress()
 
+        stage_s = result.setdefault("stage_seconds", {})
         _STAGE["stage"] = "transformer"
         if want("transformer"):
+            _t0 = time.perf_counter()
             tokens_per_sec, mfu, loss, evidence = \
                 bench_transformer(platform)
+            stage_s["transformer"] = round(time.perf_counter() - _t0, 1)
             result["value"] = round(tokens_per_sec, 1)
             if mfu is not None:
                 result["mfu"] = round(mfu, 4)
@@ -567,39 +570,41 @@ def run_benchmarks(platform, emit_progress=None):
                 result["vs_baseline"] = 1.0
             progress()
 
-        for name, fn in (("resnet50_images_per_sec", bench_resnet),
-                         ("mnist_mlp_steps_per_sec", bench_mnist)):
-            _STAGE["stage"] = name
-            if not want(name.split("_")[0]):
-                continue
+        # priority order under the fixed budget: the stages a verdict
+        # still lacks a witnessed number for (inference AOT latency,
+        # DeepFM-at-scale) run BEFORE the slower secondary axes, so a
+        # budget kill costs the least-important tail, not them
+        def run_stage(stage, names, fn, scalar_key=None, err_key=None):
+            """`names`: accepted BENCH_ONLY selector tokens (first is
+            the stage_seconds label); `err_key` preserves the error-key
+            names earlier BENCH artifacts used."""
+            _STAGE["stage"] = stage
+            if only and not any(n in only for n in names):
+                return
+            t0 = time.perf_counter()
             try:
-                result[name] = round(fn(platform), 1)
+                out = fn(platform)
+                if scalar_key:
+                    result[scalar_key] = round(out, 1)
+                elif out:
+                    result.update(out)
             except Exception as e:
-                result[name + "_error"] = f"{type(e).__name__}: {e}"
-            progress()
-        _STAGE["stage"] = "deepfm"
-        if want("deepfm"):
-            try:
-                result.update(bench_deepfm(platform))
-            except Exception as e:
-                result["deepfm_error"] = f"{type(e).__name__}: {e}"
-            progress()
-        _STAGE["stage"] = "inference"
-        if want("inference"):
-            try:
-                result.update(bench_inference(platform))
-            except Exception as e:
-                result["inference_error"] = f"{type(e).__name__}: {e}"
-            progress()
-        _STAGE["stage"] = "flash_long_context"
-        if want("flash"):
-            try:
-                extra = bench_flash_long_context(platform)
-                if extra:
-                    result.update(extra)
-            except Exception as e:
-                result["flash_long_context_error"] = \
+                result[err_key or f"{names[0]}_error"] = \
                     f"{type(e).__name__}: {e}"
+            stage_s[names[0]] = round(time.perf_counter() - t0, 1)
+            progress()
+
+        run_stage("inference", ("inference",), bench_inference)
+        run_stage("deepfm", ("deepfm",), bench_deepfm)
+        run_stage("resnet50_images_per_sec", ("resnet", "resnet50"),
+                  bench_resnet, scalar_key="resnet50_images_per_sec",
+                  err_key="resnet50_images_per_sec_error")
+        run_stage("mnist_mlp_steps_per_sec", ("mnist",), bench_mnist,
+                  scalar_key="mnist_mlp_steps_per_sec",
+                  err_key="mnist_mlp_steps_per_sec_error")
+        run_stage("flash_long_context", ("flash",),
+                  bench_flash_long_context,
+                  err_key="flash_long_context_error")
     except Exception as e:
         result["error"] = f"{type(e).__name__}: {e}"
         result["stage"] = _STAGE["stage"]
@@ -610,12 +615,33 @@ def run_benchmarks(platform, emit_progress=None):
     return result
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache shared across bench runs: the
+    stage budget is dominated by first-compile time through the relay
+    (~20-40s per executable), and the driver's run typically follows a
+    builder run of the identical configs on the same machine — a warm
+    cache turns most of that into milliseconds. Best-effort: backends
+    that can't serialize executables just ignore the cache."""
+    import jax
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_compile_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:
+        pass
+
+
 def _child_main():
     """BENCH_CHILD=1 mode: assume the default backend (TPU, or CPU when
     the parent forced JAX_PLATFORMS=cpu), stream a progress line after
     each sub-benchmark, print the final line last. Any hang here is the
     parent's problem — it holds the kill timer."""
     import jax
+    _enable_compile_cache()
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # the TPU-relay plugin hijacks get_backend and initializes its
         # relay connection even under JAX_PLATFORMS=cpu — with the

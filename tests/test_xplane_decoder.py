@@ -1,0 +1,95 @@
+"""The profiler's xplane parsing must survive images where the TF
+xplane proto moved (tensorflow.core.profiler → tensorflow.tsl) or is
+absent entirely: `_decode_xspace_minimal` is a dependency-free wire
+decoder of the fields `device_op_times` aggregates. Cross-check it
+against the real protobuf encoder when one is importable, and against
+a hand-encoded buffer always.
+
+Ref: platform/profiler.cc is the reference's device-event recorder;
+here the xplane trace is the device-side record (SURVEY §2.8).
+"""
+import pytest
+
+from paddle_tpu.profiler import (_decode_xspace_minimal, _find_xplane_pb2,
+                                 _pb_fields)
+
+
+def _varint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _ld(field, payload):  # length-delimited field
+    return _varint(field << 3 | 2) + _varint(len(payload)) + payload
+
+
+def _vi(field, value):  # varint field
+    return _varint(field << 3 | 0) + _varint(value)
+
+
+def _hand_encoded_space():
+    meta = _ld(4, _vi(1, 7) + _ld(2, _vi(1, 7) + _ld(2, b"fusion.12")))
+    ev1 = _ld(4, _vi(1, 7) + _vi(3, 1500000))
+    ev2 = _ld(4, _vi(1, 7) + _vi(3, 2**35))  # >32-bit duration
+    line = _ld(3, _ld(2, b"XLA Ops") + ev1 + ev2)
+    plane = _ld(1, _ld(2, b"/device:TPU:0") + line + meta)
+    return plane
+
+
+def test_hand_encoded_roundtrip():
+    planes = _decode_xspace_minimal(_hand_encoded_space())
+    assert planes == [("/device:TPU:0", {7: "fusion.12"},
+                       [("XLA Ops", [(7, 1500000), (7, 2**35)])])]
+
+
+def test_truncated_input_is_loud():
+    # a partially-flushed trace file must raise, not decode to a subset
+    # whose total device time silently understates the step
+    full = _hand_encoded_space()
+    with pytest.raises((ValueError, IndexError)):
+        _decode_xspace_minimal(full[:len(full) - 4])
+    with pytest.raises(ValueError):
+        list(_pb_fields(_ld(1, b"x" * 10)[:-8]))
+
+
+def test_skips_fixed_width_fields():
+    # unknown fixed64 (wire type 1) and fixed32 (type 5) fields must be
+    # skipped with correct framing, not corrupt the stream
+    buf = (_varint(9 << 3 | 1) + b"\x00" * 8 +
+           _varint(10 << 3 | 5) + b"\x00" * 4 + _vi(1, 42))
+    fields = [(f, w, v) for f, w, v in _pb_fields(buf)]
+    assert fields == [(1, 0, 42)]
+
+
+def test_matches_real_protobuf_encoder():
+    xplane_pb2 = _find_xplane_pb2()
+    if xplane_pb2 is None:
+        pytest.skip("no xplane_pb2 in this image")
+    sp = xplane_pb2.XSpace()
+    pl = sp.planes.add()
+    pl.name = "/device:TPU:0"
+    pl.event_metadata[7].id = 7
+    pl.event_metadata[7].name = "fusion.123"
+    pl.event_metadata[9].id = 9
+    pl.event_metadata[9].name = "dot_general.4"
+    ln = pl.lines.add()
+    ln.name = "XLA Ops on chip"
+    for mid, dur in ((7, 1500000), (9, 2500000), (7, 500000)):
+        e = ln.events.add()
+        e.metadata_id = mid
+        e.duration_ps = dur
+    host = sp.planes.add()
+    host.name = "/host:CPU"
+    planes = _decode_xspace_minimal(sp.SerializeToString())
+    assert planes[0] == ("/device:TPU:0",
+                        {7: "fusion.123", 9: "dot_general.4"},
+                        [("XLA Ops on chip",
+                          [(7, 1500000), (9, 2500000), (7, 500000)])])
+    assert planes[1][0] == "/host:CPU"
