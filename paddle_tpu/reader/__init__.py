@@ -11,7 +11,7 @@ import threading
 import queue as _queue
 
 __all__ = ["batch", "shuffle", "buffered", "map_readers", "xmap_readers",
-           "chain", "compose", "firstn", "cache", "Pipeline"]
+           "chain", "compose", "firstn", "cache", "Pipeline", "creator"]
 
 
 def batch(reader, batch_size, drop_last=True):
@@ -192,3 +192,6 @@ class Pipeline:
             if item is END:
                 return
             yield item
+
+
+from . import creator  # noqa: E402  (ref python/paddle/reader/creator.py)

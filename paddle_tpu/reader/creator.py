@@ -1,0 +1,47 @@
+"""Reader creators.
+
+Parity: python/paddle/reader/creator.py — turn an in-memory array, a
+text file, or RecordIO files into reader callables consumable by the
+decorators in `paddle_tpu.reader`. Original implementations: the
+recordio creator rides the repo's own chunked RecordIO reader (native
+C++ with python fallback) instead of the reference's C++ scanner.
+"""
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    """Yield the rows of a numpy array (ref creator.np_array)."""
+    def reader():
+        for row in x:
+            yield row
+    return reader
+
+
+def text_file(path):
+    """Yield lines of a UTF-8 text file, trailing newline stripped
+    (ref creator.text_file)."""
+    def reader():
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                yield line.rstrip("\n")
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Yield records from RecordIO file(s). `paths` is a path, a
+    comma-separated string of paths, or an iterable of paths (ref
+    creator.recordio, which shelled out to the C++ scanner; here the
+    sharded native reader already multiplexes files and `buf_size` is
+    its queue depth)."""
+    from ..recordio_writer import sharded_recordio_reader
+
+    if isinstance(paths, str):
+        path_list = [p for p in paths.split(",") if p]
+    else:
+        path_list = list(paths)
+
+    def reader():
+        for rec in sharded_recordio_reader(path_list)():
+            yield rec
+    return reader

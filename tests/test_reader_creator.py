@@ -1,0 +1,39 @@
+"""reader.creator parity (ref python/paddle/reader/creator.py):
+np_array rows, text_file lines, recordio records — each returns a
+reader callable composable with the decorators."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.reader import creator
+from paddle_tpu.recordio_writer import convert_reader_to_recordio_file
+
+
+def test_np_array_rows():
+    x = np.arange(12).reshape(4, 3)
+    rows = list(creator.np_array(x)())
+    assert len(rows) == 4
+    np.testing.assert_array_equal(rows[2], [6, 7, 8])
+
+
+def test_text_file_lines(tmp_path):
+    p = tmp_path / "t.txt"
+    p.write_text("alpha\nbeta\n\ngamma\n")
+    assert list(creator.text_file(str(p))()) == \
+        ["alpha", "beta", "", "gamma"]
+
+
+def test_recordio_roundtrip(tmp_path):
+    paths = []
+    for i in range(2):
+        f = str(tmp_path / f"part-{i}.recordio")
+        convert_reader_to_recordio_file(
+            f, lambda i=i: iter([(i, "a"), (i, "b")]))
+        paths.append(f)
+    recs = sorted(creator.recordio(",".join(paths))())
+    assert recs == [(0, "a"), (0, "b"), (1, "a"), (1, "b")]
+
+
+def test_composes_with_decorators():
+    r = pt.reader.batch(creator.np_array(np.arange(10)), batch_size=4)
+    batches = list(r())
+    assert [len(b) for b in batches] == [4, 4]  # drop_last default
