@@ -110,6 +110,16 @@ def test_two_process_data_parallel_training(tmp_path):
     assert len(seqs) == 1, seqs
 
 
+def test_two_process_ring_attention(tmp_path):
+    """Causal ring attention with the sp axis spanning both processes:
+    the K/V ppermute ring crosses the host boundary every hop; forward
+    and q/k/v grads == dense reference (the DCN long-context leg)."""
+    outs = _spawn_workers(tmp_path, extra_args=("sp",))
+    for rc, out, err in outs:
+        assert f"RESULT sp-ok {_NPROC} {2 * _NPROC}" in out, \
+            (out, err[-500:])
+
+
 def test_two_process_tensor_parallel_training(tmp_path):
     """dp x tp on the 2-process mesh (tp intra-host, dp across hosts):
     Megatron-sharded weights + cross-host grad all-reduce must equal
