@@ -11,7 +11,14 @@ import threading
 import queue as _queue
 
 __all__ = ["batch", "shuffle", "buffered", "map_readers", "xmap_readers",
-           "chain", "compose", "firstn", "cache", "Pipeline", "creator"]
+           "chain", "compose", "firstn", "cache", "Pipeline", "creator",
+           "ComposeNotAligned", "PipeReader", "multiprocess_reader",
+           "Fake"]
+
+
+class ComposeNotAligned(ValueError):
+    """Raised by compose(check_alignment=True) when the input readers
+    yield different numbers of samples (ref decorator.py)."""
 
 
 def batch(reader, batch_size, drop_last=True):
@@ -131,11 +138,22 @@ def chain(*readers):
     return reader
 
 
-def compose(*readers):
+def compose(*readers, check_alignment=True):
+    """Flatten N readers' outputs into one tuple stream. With
+    check_alignment (the reference default) a reader running short
+    raises ComposeNotAligned; without it, trailing output is dropped."""
+    _SHORT = object()
+
     def reader():
-        for vals in zip(*[r() for r in readers]):
+        its = [r() for r in readers]
+        zipper = (itertools.zip_longest(*its, fillvalue=_SHORT)
+                  if check_alignment else zip(*its))
+        for vals in zipper:
             out = []
             for v in vals:
+                if v is _SHORT:
+                    raise ComposeNotAligned(
+                        "outputs of composed readers are not aligned")
                 if isinstance(v, tuple):
                     out.extend(v)
                 else:
@@ -161,6 +179,139 @@ def cache(reader):
         else:
             yield from data
     return cached
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run each reader in its own OS process, merging samples into one
+    stream (ref decorator.py:338 — the data-loading analog of the
+    reference's multi-process reader; order across readers is arrival
+    order). Both modes carry pickled samples: `use_pipe=True` uses one
+    multiprocessing.Pipe per reader (no /dev/shm requirement),
+    otherwise a shared bounded Queue."""
+    import multiprocessing
+
+    if not isinstance(readers, list) or not readers:
+        raise ValueError("readers must be a non-empty list")
+
+    def _pump_queue(r, q):
+        for sample in r():
+            if sample is None:
+                raise ValueError("multiprocess_reader sample is None")
+            q.put(sample)
+        q.put(None)
+
+    def queue_reader():
+        q = multiprocessing.Queue(queue_size)
+        procs = [multiprocessing.Process(target=_pump_queue,
+                                         args=(r, q), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        live = len(readers)
+        try:
+            while live:
+                sample = q.get()
+                if sample is None:
+                    live -= 1
+                else:
+                    yield sample
+        finally:
+            for p in procs:
+                p.join()
+
+    def _pump_pipe(r, conn):
+        for sample in r():
+            if sample is None:
+                raise ValueError("multiprocess_reader sample is None")
+            conn.send(sample)
+        conn.send(None)
+        conn.close()
+
+    def pipe_reader():
+        conns, procs = [], []
+        for r in readers:
+            parent, child = multiprocessing.Pipe(duplex=False)
+            conns.append(parent)
+            p = multiprocessing.Process(target=_pump_pipe,
+                                        args=(r, child), daemon=True)
+            procs.append(p)
+            p.start()
+            child.close()
+        try:
+            while conns:
+                for conn in multiprocessing.connection.wait(conns):
+                    sample = conn.recv()
+                    if sample is None:
+                        conn.close()
+                        conns.remove(conn)
+                    else:
+                        yield sample
+        finally:
+            for p in procs:
+                p.join()
+
+    return pipe_reader if use_pipe else queue_reader
+
+
+class PipeReader:
+    """Stream a shell command's stdout ("cat part.gz", "hadoop fs -cat
+    ...") and yield decoded lines (ref decorator.py:438). file_type
+    "plain" or "gzip" (gzip decompressed incrementally)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import shlex
+        import subprocess
+        import zlib
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        if file_type not in ("plain", "gzip"):
+            raise TypeError(f"file_type {file_type} is not allowed")
+        if file_type == "gzip":
+            # wbits offset 32: accept gzip or zlib headers
+            self._dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        self.file_type = file_type
+        self.bufsize = bufsize
+        self.process = subprocess.Popen(shlex.split(command),
+                                        bufsize=bufsize,
+                                        stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        pending = ""
+        while True:
+            chunk = self.process.stdout.read(self.bufsize)
+            if not chunk:
+                break
+            if self.file_type == "gzip":
+                chunk = self._dec.decompress(chunk)
+            text = chunk.decode("utf-8", "replace")
+            if not cut_lines:
+                yield text
+                continue
+            pending += text
+            *lines, pending = pending.split(line_break)
+            yield from lines
+        if cut_lines and pending:
+            yield pending
+
+
+class Fake:
+    """Cache the first sample of a real reader and replay it data_num
+    times — isolates input cost from compute for speed testing (ref
+    decorator.py:509)."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_num < data_num:
+                yield self.data
+                self.yield_num += 1
+            self.yield_num = 0
+        return fake_reader
 
 
 class Pipeline:

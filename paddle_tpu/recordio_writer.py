@@ -13,7 +13,8 @@ import zlib
 from . import native
 
 __all__ = ["RecordIOWriter", "RecordIOReader", "ShardedRecordIOReader",
-           "convert_reader_to_recordio_file", "recordio_reader",
+           "convert_reader_to_recordio_file",
+           "convert_reader_to_recordio_files", "recordio_reader",
            "sharded_recordio_reader"]
 
 _MAGIC = 0x50545243
@@ -308,6 +309,37 @@ def convert_reader_to_recordio_file(filename, reader_creator,
             w.write(pickle.dumps(sample, protocol=4))
             count += 1
     return count
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, feeder=None, **kw):
+    """ref recordio_writer.py:91 — like convert_reader_to_recordio_file
+    but splits into `<stem>-%05d.recordio` shards of at most
+    `batch_per_file` records each (the sharded-reader producer side).
+    Returns the list of paths written."""
+    import os
+    stem, ext = os.path.splitext(filename)
+    if ext != ".recordio":
+        raise ValueError(f"filename must end in .recordio, got {ext!r}")
+    paths = []
+    w = None
+    count = 0
+    try:
+        for sample in reader_creator():
+            if w is None:
+                path = f"{stem}-{len(paths):05d}{ext}"
+                paths.append(path)
+                w = RecordIOWriter(path)
+            w.write(pickle.dumps(sample, protocol=4))
+            count += 1
+            if count == batch_per_file:
+                w.close()
+                w = None
+                count = 0
+    finally:
+        if w is not None:
+            w.close()
+    return paths
 
 
 def recordio_reader(filename):

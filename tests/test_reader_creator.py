@@ -1,6 +1,8 @@
 """reader.creator parity (ref python/paddle/reader/creator.py):
 np_array rows, text_file lines, recordio records — each returns a
 reader callable composable with the decorators."""
+import os
+
 import numpy as np
 
 import paddle_tpu as pt
@@ -37,3 +39,58 @@ def test_composes_with_decorators():
     r = pt.reader.batch(creator.np_array(np.arange(10)), batch_size=4)
     batches = list(r())
     assert [len(b) for b in batches] == [4, 4]  # drop_last default
+
+
+def test_compose_alignment():
+    import pytest
+    a = creator.np_array(np.arange(3))
+    b = creator.np_array(np.arange(5))
+    with pytest.raises(pt.reader.ComposeNotAligned):
+        list(pt.reader.compose(a, b)())
+    # unchecked: trailing output dropped
+    assert len(list(pt.reader.compose(a, b, check_alignment=False)())) == 3
+    # aligned tuple-flattening
+    c = lambda: iter([(1, 2), (3, 4)])
+    d = lambda: iter([10, 20])
+    assert list(pt.reader.compose(c, d)()) == [(1, 2, 10), (3, 4, 20)]
+
+
+def test_multiprocess_reader_both_modes():
+    r0 = lambda: iter([1, 2, 3])
+    r1 = lambda: iter([10, 20])
+    for use_pipe in (True, False):
+        got = sorted(pt.reader.multiprocess_reader(
+            [r0, r1], use_pipe=use_pipe, queue_size=4)())
+        assert got == [1, 2, 3, 10, 20], (use_pipe, got)
+
+
+def test_pipe_reader_plain_and_gzip(tmp_path):
+    p = tmp_path / "x.txt"
+    p.write_text("l1\nl2\nl3")
+    lines = list(pt.reader.PipeReader(f"cat {p}").get_line())
+    assert lines == ["l1", "l2", "l3"]
+    import gzip
+    g = tmp_path / "x.gz"
+    with gzip.open(g, "wt") as f:
+        f.write("a\nbb\n")
+    lines = list(pt.reader.PipeReader(f"cat {g}",
+                                      file_type="gzip").get_line())
+    assert lines == ["a", "bb"]
+
+
+def test_fake_reader():
+    def r():
+        yield from range(10)
+    fake = pt.reader.Fake()(r, 4)
+    assert list(fake()) == [0, 0, 0, 0]
+    assert list(fake()) == [0, 0, 0, 0]  # counter resets
+
+
+def test_convert_reader_to_recordio_files(tmp_path):
+    from paddle_tpu.recordio_writer import (
+        convert_reader_to_recordio_files)
+    paths = convert_reader_to_recordio_files(
+        str(tmp_path / "d.recordio"), 4, lambda: iter(range(10)))
+    assert [os.path.basename(p) for p in paths] == \
+        ["d-00000.recordio", "d-00001.recordio", "d-00002.recordio"]
+    assert sorted(creator.recordio(paths)()) == list(range(10))
