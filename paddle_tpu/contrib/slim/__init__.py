@@ -1,16 +1,25 @@
 """Model compression (slim).
 
-Parity: python/paddle/fluid/contrib/slim — Compressor/strategy pass
-framework (core.py: Context/Strategy/CompressPass/ConfigFactory),
-magnitude pruner (prune.py, ref slim/prune/pruner.py), and pruning
-strategies (prune_strategy.py) including a SensitivePruneStrategy that
-genuinely measures per-parameter sensitivity (the reference's is an
-argument holder, prune_strategy.py:24-36).
+Parity: python/paddle/fluid/contrib/slim — the core/graph/prune package
+layout and export surface of the reference: the epoch/batch-hook
+CompressPass (core), Program-backed graphs + executors + a pruning pass
+that actually prunes (graph), magnitude/ratio pruners and strategies
+(prune) including a SensitivePruneStrategy that genuinely measures
+per-parameter sensitivity (the reference's is an argument holder,
+slim/prune/prune_strategy.py:24-36).
 """
-from .prune import Pruner, MagnitudePruner, prune_program
-from .core import Context, Strategy, CompressPass, ConfigFactory
-from .prune_strategy import PruneStrategy, SensitivePruneStrategy
+from .core import (Strategy, Context, CompressPass, ConfigFactory,
+                   build_compressor)
+from .graph import (Graph, ImitationGraph, IRGraph, GraphPass,
+                    PruneParameterPass, get_executor)
+from .prune import (Pruner, MagnitudePruner, RatioPruner, prune_program,
+                    PruneStrategy, SensitivePruneStrategy)
 
-__all__ = ["Pruner", "MagnitudePruner", "prune_program", "Context",
-           "Strategy", "CompressPass", "ConfigFactory", "PruneStrategy",
-           "SensitivePruneStrategy"]
+__all__ = [
+    "build_compressor", "CompressPass", "ImitationGraph",
+    "SensitivePruneStrategy", "MagnitudePruner", "RatioPruner",
+    # beyond the reference __all__, kept public for direct use
+    "Strategy", "Context", "ConfigFactory", "Graph", "IRGraph",
+    "GraphPass", "PruneParameterPass", "get_executor", "Pruner",
+    "prune_program", "PruneStrategy",
+]

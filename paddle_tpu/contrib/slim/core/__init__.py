@@ -1,0 +1,7 @@
+from .strategy import Strategy
+from .compress_pass import Context, CompressPass
+from .config import ConfigFactory
+from .pass_builder import build_compressor
+
+__all__ = ["Strategy", "Context", "CompressPass", "ConfigFactory",
+           "build_compressor"]

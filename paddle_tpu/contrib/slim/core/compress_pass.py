@@ -6,11 +6,11 @@ executor of the reference collapses into the ordinary whole-program
 Executor here; strategies mutate scope arrays directly (device-resident
 jnp values) instead of building side programs with assign ops.
 """
-from ...core.executor import Executor
-from ...core.scope import global_scope
-from ...core.place import CPUPlace
+from ....core.executor import Executor
+from ....core.scope import global_scope
+from ....core.place import CPUPlace
 
-__all__ = ["Context", "Strategy", "CompressPass", "ConfigFactory"]
+__all__ = ["Context", "CompressPass"]
 
 
 class Context:
@@ -29,30 +29,7 @@ class Context:
         self.last_results = None
 
 
-class Strategy:
-    """Base strategy with epoch/batch hooks (ref core/strategy.py)."""
-
-    def __init__(self, start_epoch=0, end_epoch=10):
-        self.start_epoch = start_epoch
-        self.end_epoch = end_epoch
-
-    def on_compress_begin(self, context):
-        pass
-
-    def on_epoch_begin(self, context):
-        pass
-
-    def on_epoch_end(self, context):
-        pass
-
-    def on_batch_begin(self, context):
-        pass
-
-    def on_batch_end(self, context):
-        pass
-
-    def on_compress_end(self, context):
-        pass
+from .strategy import Strategy  # noqa: F401  (re-export)
 
 
 class CompressPass:
@@ -76,7 +53,7 @@ class CompressPass:
 
     def apply(self, program):
         """Run `epoch` epochs of the program while strategies fire."""
-        from ...core.scope import scope_guard
+        from ....core.scope import scope_guard
         exe = self.program_exe if self.program_exe is not None \
             else Executor(self.place)
         scope = self.scope if self.scope is not None else global_scope()
@@ -107,45 +84,3 @@ class CompressPass:
             for s in self.strategies:
                 s.on_compress_end(ctx)
         return ctx
-
-
-class ConfigFactory:
-    """Build a CompressPass + strategies from a config dict (ref
-    core/config.py reads the same structure from yaml; pass the parsed
-    dict — or a yaml path if pyyaml is importable). Any registered class
-    (strategies AND pruners) can be referenced by section name."""
-
-    _STRATEGY_REGISTRY = {}
-
-    @classmethod
-    def register_strategy(cls, name, ctor):
-        """Register a constructible class for configs (strategies,
-        pruners, or any other component a config section names)."""
-        cls._STRATEGY_REGISTRY[name] = ctor
-
-    register_class = register_strategy   # clearer alias
-
-    def __init__(self, config):
-        if isinstance(config, str):
-            import yaml   # optional dependency, matching the reference
-            with open(config) as f:
-                config = yaml.safe_load(f)
-        self.config = config
-
-    def instance(self, name):
-        spec = dict(self.config[name])
-        kind = spec.pop("class")
-        if kind == "CompressPass":
-            compress = CompressPass(**{k: v for k, v in spec.items()
-                                       if k != "strategies"})
-            for sname in spec.get("strategies", []):
-                compress.add_strategy(self.instance(sname))
-            return compress
-        ctor = self._STRATEGY_REGISTRY.get(kind)
-        if ctor is None:
-            raise ValueError(f"unknown config class {kind!r}; register it "
-                             f"with ConfigFactory.register_class")
-        for key, val in list(spec.items()):
-            if isinstance(val, str) and val in self.config:
-                spec[key] = self.instance(val)
-        return ctor(**spec)

@@ -1,0 +1,55 @@
+"""Compression config factory.
+
+Parity: python/paddle/fluid/contrib/slim/core/config.py — build a
+CompressPass + strategies/pruners from a parsed-yaml dict (or a yaml
+path when pyyaml is importable, as in the reference).
+"""
+from .compress_pass import CompressPass
+
+__all__ = ["ConfigFactory"]
+
+
+class ConfigFactory:
+    """Build a CompressPass + strategies from a config dict (ref
+    core/config.py reads the same structure from yaml; pass the parsed
+    dict — or a yaml path if pyyaml is importable). Any registered class
+    (strategies AND pruners) can be referenced by section name."""
+
+    _STRATEGY_REGISTRY = {}
+
+    @classmethod
+    def register_strategy(cls, name, ctor):
+        """Register a constructible class for configs (strategies,
+        pruners, or any other component a config section names)."""
+        cls._STRATEGY_REGISTRY[name] = ctor
+
+    register_class = register_strategy   # clearer alias
+
+    def __init__(self, config):
+        if isinstance(config, str):
+            import yaml   # optional dependency, matching the reference
+            with open(config) as f:
+                config = yaml.safe_load(f)
+        self.config = config
+
+    def instance(self, name):
+        spec = dict(self.config[name])
+        kind = spec.pop("class")
+        if kind == "CompressPass":
+            compress = CompressPass(**{k: v for k, v in spec.items()
+                                       if k != "strategies"})
+            for sname in spec.get("strategies", []):
+                compress.add_strategy(self.instance(sname))
+            return compress
+        ctor = self._STRATEGY_REGISTRY.get(kind)
+        if ctor is None:
+            raise ValueError(f"unknown config class {kind!r}; register it "
+                             f"with ConfigFactory.register_class")
+        for key, val in list(spec.items()):
+            if isinstance(val, str) and val in self.config:
+                spec[key] = self.instance(val)
+        return ctor(**spec)
+
+    def get_compress_pass(self):
+        """The conventional entry section name (ref config.py)."""
+        return self.instance("compress_pass")

@@ -1,0 +1,31 @@
+"""Compressor builder.
+
+Parity: python/paddle/fluid/contrib/slim/core/pass_builder.py — the
+one-call entry that wires place/reader/feeder/scope/metrics into a
+CompressPass, from a config file/dict when given.
+"""
+from .compress_pass import CompressPass
+from .config import ConfigFactory
+
+__all__ = ["build_compressor"]
+
+
+def build_compressor(place=None, data_reader=None, data_feeder=None,
+                     scope=None, metrics=None, epoch=None, config=None):
+    if config is not None:
+        comp = ConfigFactory(config).get_compress_pass()
+    else:
+        comp = CompressPass()
+    if place is not None:
+        comp.place = place
+    if data_reader is not None:
+        comp.data_reader = data_reader
+    if data_feeder is not None:
+        comp.data_feeder = data_feeder
+    if scope is not None:
+        comp.scope = scope
+    if metrics is not None:
+        comp.metrics = dict(metrics)
+    if epoch is not None:
+        comp.epoch = epoch
+    return comp

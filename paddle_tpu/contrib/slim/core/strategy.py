@@ -1,0 +1,33 @@
+"""Strategy base class.
+
+Parity: python/paddle/fluid/contrib/slim/core/strategy.py — the
+epoch/batch hook surface CompressPass drives.
+"""
+
+__all__ = ["Strategy"]
+
+
+class Strategy:
+    """Base strategy with epoch/batch hooks (ref core/strategy.py)."""
+
+    def __init__(self, start_epoch=0, end_epoch=10):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compress_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compress_end(self, context):
+        pass

@@ -1,0 +1,6 @@
+from .graph import Graph, ImitationGraph, IRGraph
+from .graph_pass import GraphPass, PruneParameterPass
+from .executor import get_executor
+
+__all__ = ["Graph", "ImitationGraph", "IRGraph", "GraphPass",
+           "PruneParameterPass", "get_executor"]

@@ -1,0 +1,28 @@
+"""Graph executors.
+
+Parity: python/paddle/fluid/contrib/slim/graph/executor.py — run a
+(Imitation)Graph through the ordinary whole-program Executor.
+"""
+from ....core.executor import Executor
+
+__all__ = ["get_executor"]
+
+
+class GraphExecutor:
+    def __init__(self, place):
+        self.place = place
+        self.exe = Executor(place)
+
+    def run(self, graph, scope=None, fetches=None, feed=None):
+        fetch_list = list(fetches) if fetches else None
+        return self.exe.run(graph.program, feed=feed,
+                            fetch_list=fetch_list, scope=scope)
+
+
+# one executor serves both graph flavors (single IR, see graph.py)
+ImitationGraphExecutor = GraphExecutor
+IRGraphExecutor = GraphExecutor
+
+
+def get_executor(graph, place):
+    return GraphExecutor(place)

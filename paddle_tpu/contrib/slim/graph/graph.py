@@ -1,0 +1,30 @@
+"""Graph wrappers for the compression framework.
+
+Parity: python/paddle/fluid/contrib/slim/graph/graph.py. ImitationGraph
+wraps a Program (the rebuild's whole-program IR); IRGraph, which in the
+reference wraps the C++ SSA graph, has no separate representation here
+— the Program IS the graph XLA compiles — so it subclasses with the
+same Program backing.
+"""
+from ....core.framework import Program
+
+__all__ = ["Graph", "ImitationGraph", "IRGraph"]
+
+
+class Graph:
+    """Base class (ref graph.py:Graph)."""
+
+    def all_parameters(self):
+        raise NotImplementedError
+
+
+class ImitationGraph(Graph):
+    def __init__(self, program=None):
+        self.program = Program() if program is None else program
+
+    def all_parameters(self):
+        return self.program.global_block().all_parameters()
+
+
+class IRGraph(ImitationGraph):
+    """The reference's C++-IR variant; one IR here (see module doc)."""

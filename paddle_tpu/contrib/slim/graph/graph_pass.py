@@ -1,0 +1,42 @@
+"""Graph passes.
+
+Parity: python/paddle/fluid/contrib/slim/graph/graph_pass.py. The
+reference's PruneParameterPass.apply is an empty stub; here it performs
+the prune for real — thresholds applied to each named parameter's scope
+value via the magnitude pruner.
+"""
+from ..prune.pruner import MagnitudePruner
+
+__all__ = ["GraphPass", "PruneParameterPass"]
+
+
+class GraphPass:
+    def apply(self, graph):
+        raise NotImplementedError
+
+
+class PruneParameterPass(GraphPass):
+    """Zero entries of `pruned_params` whose |w| falls below the
+    per-param threshold ({name: thr} with '*' default)."""
+
+    def __init__(self, pruned_params, thresholds):
+        self.pruned_params = pruned_params
+        self.thresholds = thresholds
+        self.default_threshold = thresholds.get("*")
+
+    def apply(self, graph, scope=None):
+        import numpy as np
+        import jax.numpy as jnp
+        from ....core.scope import global_scope
+        scope = scope or global_scope()
+        masks = {}
+        for name in self.pruned_params:
+            thr = self.thresholds.get(name, self.default_threshold)
+            if thr is None:
+                continue
+            pruned, mask = MagnitudePruner(threshold=thr).prune(
+                scope.get(name))
+            scope.set(name, jnp.asarray(
+                pruned, dtype=str(np.asarray(pruned).dtype)))
+            masks[name] = mask
+        return masks

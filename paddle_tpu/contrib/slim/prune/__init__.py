@@ -1,0 +1,5 @@
+from .pruner import Pruner, MagnitudePruner, RatioPruner, prune_program
+from .prune_strategy import PruneStrategy, SensitivePruneStrategy
+
+__all__ = ["Pruner", "MagnitudePruner", "RatioPruner", "prune_program",
+           "PruneStrategy", "SensitivePruneStrategy"]

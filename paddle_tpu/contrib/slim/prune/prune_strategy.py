@@ -12,9 +12,9 @@ probe ratio) and allocates per-parameter ratios toward a global target
 import numpy as np
 import jax.numpy as jnp
 
-from .core import Strategy
-from .core import ConfigFactory
-from .prune import MagnitudePruner, prune_program
+from ..core.strategy import Strategy
+from ..core.config import ConfigFactory
+from .pruner import MagnitudePruner, prune_program
 
 __all__ = ["PruneStrategy", "SensitivePruneStrategy"]
 

@@ -8,7 +8,7 @@ update (or use the returned masks with layers.elementwise_mul).
 """
 import numpy as np
 
-__all__ = ["Pruner", "MagnitudePruner", "prune_program"]
+__all__ = ["Pruner", "MagnitudePruner", "RatioPruner", "prune_program"]
 
 
 class Pruner:
@@ -43,7 +43,7 @@ def prune_program(program, ratios, scope=None, pruner=None):
     ratios: {param_name: sparsity_ratio} or a single float for all
     parameters. Returns {param_name: mask ndarray}.
     """
-    from ...core.scope import global_scope
+    from ....core.scope import global_scope
     import jax.numpy as jnp
     scope = scope or global_scope()
     pruner = pruner or MagnitudePruner()
@@ -58,3 +58,28 @@ def prune_program(program, ratios, scope=None, pruner=None):
         scope.set(name, jnp.asarray(pruned, dtype=str(np.asarray(val).dtype)))
         masks[name] = mask
     return masks
+
+
+class RatioPruner(Pruner):
+    """Keep the top `ratio` fraction of entries per parameter, zeroing
+    the rest (ref slim/prune/pruner.py:RatioPruner — "ratio=40%" keeps
+    40%). Ratios come per-param from a {name: ratio} dict with a '*'
+    default, or from the explicit `ratio` argument. Selection is by
+    |w| (the reference thresholds raw values, which under-keeps
+    negative weights; magnitude is the intended semantics)."""
+
+    def __init__(self, ratios=None):
+        self.ratios = ratios or {}
+
+    def prune(self, param_array, ratio=None, name=None):
+        w = np.asarray(param_array)
+        if ratio is None:
+            ratio = self.ratios.get(name, self.ratios.get("*", 1.0))
+        ratio = float(ratio)
+        if ratio >= 1.0:
+            return w, np.ones_like(w, dtype=bool)
+        keep = max(int(w.size * ratio), 1)
+        a = np.abs(w).reshape(-1)
+        thresh = np.partition(a, w.size - keep)[w.size - keep]
+        mask = np.abs(w) >= thresh
+        return w * mask, mask

@@ -3,4 +3,5 @@
 Parity: python/paddle/fluid/contrib/trainer.py (the reference moved the
 HighLevelAPI Trainer here) — implementation in paddle_tpu/trainer.py.
 """
-from ..trainer import Trainer, CheckpointConfig  # noqa: F401
+from ..trainer import (Trainer, CheckpointConfig, BeginEpochEvent,  # noqa: F401
+                       EndEpochEvent, BeginStepEvent, EndStepEvent)

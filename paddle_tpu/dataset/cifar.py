@@ -10,7 +10,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train10", "test10", "train100", "test100"]
+__all__ = ["train10", "test10", "train100", "test100", "convert"]
 
 _IMG = 3 * 32 * 32
 
@@ -65,3 +65,12 @@ def test100(n_synthetic=512):
     if os.path.exists(p):
         return _tar_reader(p, b"fine_labels", "test")
     return _synthetic(n_synthetic, 100, seed=1)
+
+
+def convert(path):
+    """Write the cifar splits as sharded RecordIO (ref cifar.py:149)."""
+    from . import common
+    common.convert(path, train100(), 1000, "cifar_train100")
+    common.convert(path, test100(), 1000, "cifar_test100")
+    common.convert(path, train10(), 1000, "cifar_train10")
+    common.convert(path, test10(), 1000, "cifar_test10")

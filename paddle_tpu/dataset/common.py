@@ -21,3 +21,74 @@ def download(url, module_name, md5sum=None, save_name=None):
     raise IOError(
         f"dataset file {path} not present and downloads are disabled; "
         f"synthetic fallback should have been used")
+
+
+def md5file(fname):
+    """Hex md5 of a file, streamed (ref common.py:58)."""
+    import hashlib
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's samples into files of `line_count` each (ref
+    common.py:137). Returns the list of paths written."""
+    import pickle
+    dumper = dumper or pickle.dump
+    if "%" not in suffix:
+        raise ValueError("suffix must contain a %d-style slot")
+    paths, buf, idx = [], [], 0
+
+    def flush():
+        nonlocal buf, idx
+        if not buf:
+            return
+        path = suffix % idx
+        with open(path, "wb") as f:
+            dumper(buf, f)
+        paths.append(path)
+        buf, idx = [], idx + 1
+
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == line_count:
+            flush()
+    flush()
+    return paths
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Reader over this trainer's strided share of split() files (ref
+    common.py:175): file i belongs to trainer i % trainer_count."""
+    import glob as _glob
+    import pickle
+    loader = loader or pickle.load
+
+    def reader():
+        if not callable(loader):
+            raise TypeError("loader should be callable")
+        file_list = sorted(_glob.glob(files_pattern))
+        for i, path in enumerate(file_list):
+            if i % trainer_count != trainer_id:
+                continue
+            with open(path, "rb") as f:
+                yield from loader(f)
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Write a reader's samples as sharded RecordIO files
+    `<output_path>/<name_prefix>-%05d.recordio` of at most `line_count`
+    records each (ref common.py:210, which used the C++ recordio
+    module; here the repo's own native-backed writer). Returns the
+    paths written."""
+    from ..recordio_writer import convert_reader_to_recordio_files
+    if line_count < 1:
+        raise ValueError("line_count must be >= 1")
+    return convert_reader_to_recordio_files(
+        os.path.join(output_path, name_prefix + ".recordio"),
+        line_count, reader)

@@ -23,7 +23,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["test", "get_dict", "get_embedding"]
+__all__ = ["test", "get_dict", "get_embedding", "convert"]
 
 WORD_DICT_LEN = 4000
 PRED_DICT_LEN = 300
@@ -228,3 +228,10 @@ def train(n_synthetic=1024):
     """The reference only ships test() publicly; train() is provided for
     the synthetic corpus so SRL models can fit something."""
     return _synthetic(n_synthetic, seed=0)
+
+
+def convert(path):
+    """Write the conll05 test split as sharded RecordIO (ref
+    conll05.py:253 — the reference, too, only ships the test split)."""
+    from . import common
+    common.convert(path, test(), 1000, "conl105_test")

@@ -19,7 +19,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["build_dict", "train", "test", "word_dict"]
+__all__ = ["build_dict", "train", "test", "word_dict", "convert"]
 
 _VOCAB = 5147  # matches ref default vocab cutoff order of magnitude
 _ARCHIVE = "aclImdb_v1.tar.gz"
@@ -116,3 +116,11 @@ def test(word_idx=None, n_synthetic=256):
                             re.compile(r"aclImdb/test/neg/.*\.txt$"),
                             word_idx, path)
     return _synthetic(n_synthetic, seed=1)
+
+
+def convert(path):
+    """Write the imdb splits as sharded RecordIO (ref imdb.py:145)."""
+    from . import common
+    w = word_dict()
+    common.convert(path, lambda: train(w), 1000, "imdb_train")
+    common.convert(path, lambda: test(w), 1000, "imdb_test")

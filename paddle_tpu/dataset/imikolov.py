@@ -16,7 +16,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test", "build_dict", "DataType"]
+__all__ = ["train", "test", "build_dict", "DataType", "convert"]
 
 _VOCAB = 2048
 _ARCHIVE = "simple-examples.tgz"
@@ -116,3 +116,13 @@ def test(word_idx=None, n=5, data_type=DataType.NGRAM, n_synthetic=512):
     if _archive_path() and word_idx:
         return _real_reader(_VALID, word_idx, n, data_type)
     return _synthetic(n_synthetic, n, seed=1)
+
+
+def convert(path):
+    """Write the imikolov splits as sharded RecordIO (ref
+    imikolov.py:157)."""
+    from . import common
+    n = 5
+    w = build_dict()
+    common.convert(path, train(w, n), 1000, "imikolov_train")
+    common.convert(path, test(w, n), 1000, "imikolov_test")

@@ -12,7 +12,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test"]
+__all__ = ["train", "test", "convert"]
 
 _IMG = 784
 
@@ -70,3 +70,12 @@ def test(n_synthetic=512):
     if os.path.exists(ip) and os.path.exists(lp):
         return _idx_reader(ip, lp)
     return _synthetic(n_synthetic, seed=1)
+
+
+def convert(path):
+    """Write the mnist splits as sharded RecordIO (ref mnist.py:133;
+    the reference's "minist" prefix typo is kept for artifact-name
+    compatibility)."""
+    from . import common
+    common.convert(path, train(), 1000, "minist_train")
+    common.convert(path, test(), 1000, "minist_test")

@@ -17,7 +17,8 @@ import numpy as np
 from . import common
 
 __all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
-           "age_table", "movie_categories", "get_movie_title_dict"]
+           "age_table", "movie_categories", "get_movie_title_dict",
+           "user_info", "movie_info", "MovieInfo", "UserInfo", "convert"]
 
 _USERS, _MOVIES, _JOBS = 6040, 3952, 21
 age_table = [1, 18, 25, 35, 45, 50, 56]
@@ -55,6 +56,71 @@ def _load_meta():
                 movies[int(mid)] = (title, cats.split("|"))
     _meta = (users, movies, categories, title_words)
     return _meta
+
+
+class MovieInfo:
+    """Movie id, categories and title (ref movielens.py:48); value()
+    encodes categories/title words through the module dicts."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        cats = movie_categories()
+        words = get_movie_title_dict()
+        return [self.index,
+                [cats[c] for c in self.categories],
+                [words[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    """User id, gender, age bucket, job (ref movielens.py:75)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), gender({'M' if self.is_male else 'F'}), "
+                f"age({age_table[self.age]}), job({self.job_id})>")
+
+
+def user_info():
+    """{user_id: UserInfo} (ref movielens.py:233). Synthetic fallback:
+    deterministic per-id attributes consistent across calls."""
+    if _archive_path():
+        users = _load_meta()[0]
+        return {u: UserInfo(u, "M" if g == 0 else "F", age_table[a], j)
+                for u, (g, a, j) in users.items()}
+    return {u: UserInfo(u, "M" if (u * 7) % 2 == 0 else "F",
+                        age_table[(u * 11) % len(age_table)],
+                        (u * 13) % _JOBS)
+            for u in range(1, _USERS + 1)}
+
+
+def movie_info():
+    """{movie_id: MovieInfo} (ref movielens.py:241)."""
+    if _archive_path():
+        movies = _load_meta()[1]
+        return {m: MovieInfo(m, cats, title)
+                for m, (title, cats) in movies.items()}
+    cats = sorted(movie_categories())
+    words = sorted(get_movie_title_dict())
+    return {m: MovieInfo(m, [cats[(m * 5) % len(cats)]],
+                         words[(m * 3) % len(words)])
+            for m in range(1, _MOVIES + 1)}
 
 
 def _real_reader(is_test, test_ratio=0.1, rand_seed=0):
@@ -132,3 +198,11 @@ def test(n_synthetic=512):
     if _archive_path():
         return _real_reader(is_test=True)
     return _synthetic(n_synthetic, seed=1)
+
+
+def convert(path):
+    """Write the movielens splits as sharded RecordIO (ref
+    movielens.py:262)."""
+    from . import common
+    common.convert(path, train(), 1000, "movielens_train")
+    common.convert(path, test(), 1000, "movielens_test")

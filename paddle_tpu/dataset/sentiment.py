@@ -6,7 +6,7 @@ class-conditional vocab skew makes the task learnable offline.
 """
 import numpy as np
 
-__all__ = ["train", "test", "get_word_dict"]
+__all__ = ["train", "test", "get_word_dict", "convert"]
 
 _VOCAB = 2048
 
@@ -37,3 +37,11 @@ def train(n_synthetic=800):
 
 def test(n_synthetic=200):
     return _synthetic(n_synthetic, seed=1)
+
+
+def convert(path):
+    """Write the sentiment splits as sharded RecordIO (ref
+    sentiment.py:139)."""
+    from . import common
+    common.convert(path, train(), 1000, "sentiment_train")
+    common.convert(path, test(), 1000, "sentiment_test")

@@ -8,7 +8,7 @@ converge offline.
 """
 import numpy as np
 
-__all__ = ["train", "test", "get_dict"]
+__all__ = ["train", "test", "get_dict", "convert"]
 
 START = "<s>"
 END = "<e>"
@@ -52,3 +52,11 @@ def test(dict_size=1000, n_synthetic=256):
 
 def gen(dict_size=1000, n_synthetic=128):
     return _synthetic(n_synthetic, dict_size, seed=2)
+
+
+def convert(path):
+    """Write the wmt14 splits as sharded RecordIO (ref wmt14.py:172)."""
+    from . import common
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")

@@ -17,11 +17,14 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test", "validation", "get_dict"]
+__all__ = ["train", "test", "validation", "get_dict", "fetch", "convert"]
 
 BOS, EOS, UNK = 0, 1, 2
 _BOS_MARK, _EOS_MARK, _UNK_MARK = "<s>", "<e>", "<unk>"
 _ARCHIVE = "wmt16.tar.gz"
+# canonical source the reference downloads from (fetch() only
+# checks the cache here — zero egress)
+_URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
 
 
 def _archive_path():
@@ -128,3 +131,21 @@ def validation(src_dict_size=10000, trg_dict_size=10000, src_lang="en",
         return _real_reader("wmt16/val", src_dict_size, trg_dict_size,
                             src_lang)
     return _synthetic(n_synthetic, src_dict_size, trg_dict_size, seed=2)
+
+
+def convert(path, src_dict_size=30000, trg_dict_size=30000,
+            src_lang="en"):
+    """Write the wmt16 splits as sharded RecordIO (ref wmt16.py:331)."""
+    from . import common
+    common.convert(path, train(src_dict_size, trg_dict_size, src_lang),
+                   1000, "wmt16_train")
+    common.convert(path, test(src_dict_size, trg_dict_size, src_lang),
+                   1000, "wmt16_test")
+
+
+def fetch():
+    """Ensure the wmt16 archive is in the dataset cache (ref
+    wmt16.py:324 downloads it; this environment is zero-egress, so
+    fetch only verifies presence and raises with placement
+    instructions otherwise)."""
+    return common.download(_URL, "wmt16", save_name=_ARCHIVE)

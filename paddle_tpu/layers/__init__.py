@@ -45,3 +45,42 @@ __all__ += io.__all__
 __all__ += sequence.__all__
 __all__ += detection.__all__
 __all__ += layer_function_generator.__all__
+
+# --- reference-location aliases -------------------------------------
+# The reference's layers/nn.py (9.7k LoC) holds ops that live in
+# sibling modules here (sequence.py, struct.py, vision.py, ...).
+# Package-level imports (`fluid.layers.sequence_pool`) already work;
+# these aliases also honor the reference SUBMODULE paths
+# (`fluid.layers.nn.sequence_pool`, `layers.tensor.cast`, ...), pinned
+# to the reference's export lists and enforced by
+# tests/test_api_parity.py::test_layers_submodule_location_parity.
+_REF_NN_EXTRA = [
+    "linear_chain_crf", "crf_decoding", "chunk_eval", "sequence_conv",
+    "sequence_pool", "sequence_softmax", "pool3d", "adaptive_pool3d",
+    "beam_search_decode", "conv3d_transpose", "sequence_expand",
+    "sequence_expand_as", "sequence_pad", "sequence_unpad",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "ctc_greedy_decoder", "edit_distance", "warpctc",
+    "sequence_reshape", "hsigmoid", "beam_search", "row_conv",
+    "multiplex", "autoincreased_step_counter", "lrn",
+    "pad_constant_like", "roi_pool", "roi_align", "dice_loss",
+    "sequence_scatter", "random_crop", "mean_iou", "relu", "selu",
+    "log", "crop", "rank_loss", "elu", "stanh", "sequence_mask",
+    "sequence_enumerate", "sequence_concat",
+    "uniform_random_batch_size_like", "gaussian_random", "sampling_id",
+    "gaussian_random_batch_size_like", "sum", "shape", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "space_to_depth",
+    "affine_grid", "sequence_reverse", "similarity_focus", "hash",
+    "merge_selected_rows", "get_tensor_from_selected_rows", "py_func",
+    "psroi_pool",
+]
+_REF_TENSOR_EXTRA = ["cast", "tensor_array_to_tensor", "argmin",
+                     "argmax", "argsort", "has_inf", "has_nan",
+                     "isfinite"]
+_REF_CONTROL_FLOW_EXTRA = ["increment"]
+for _mod, _names in ((nn, _REF_NN_EXTRA), (tensor, _REF_TENSOR_EXTRA),
+                     (control_flow, _REF_CONTROL_FLOW_EXTRA)):
+    for _n in _names:
+        if not hasattr(_mod, _n):
+            setattr(_mod, _n, globals()[_n])
+del _mod, _names, _n

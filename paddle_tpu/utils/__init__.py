@@ -1,8 +1,13 @@
 """paddle.utils compat (the book-demo helpers).
 
-Parity: python/paddle/utils — only the pieces the fluid book/demos use
-(plot.Ploter); the v1-era converters (dump_config, torch2paddle, ...)
+Parity: python/paddle/utils — the pieces with a live export surface:
+plot.Ploter (book demos), dump_v2_config (topology dumping, rebuilt
+over Program desc), image_multiproc (process-pool image transforms).
+The remaining v1-era converters (torch2paddle, merge_model, ...)
 predate fluid and are out of scope (SURVEY §2 covers the fluid
 framework surface).
 """
 from . import plot  # noqa: F401
+from . import dump_v2_config  # noqa: F401
+from . import image_multiproc  # noqa: F401
+from .dump_v2_config import dump_v2_config as _dump  # noqa: F401

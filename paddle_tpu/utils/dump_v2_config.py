@@ -1,0 +1,42 @@
+"""Topology dumping.
+
+Parity: python/paddle/utils/dump_v2_config.py — the reference walks a
+legacy-v2 layer graph back to its data layers and serializes the
+TrainerConfig proto. Here topology is Variable(s) of a Program (the
+rebuild's only graph form): the program is pruned to the ops feeding
+the given outputs and its desc is written to `save_path` (JSON text, or
+pickled bytes with binary=True — the C-API-serialized analog).
+"""
+import collections.abc
+import json
+import pickle
+
+__all__ = ["dump_v2_config"]
+
+
+def dump_v2_config(topology, save_path, binary=False):
+    from ..core.framework import Variable
+
+    if isinstance(topology, Variable):
+        topology = [topology]
+    elif isinstance(topology, collections.abc.Sequence):
+        for out in topology:
+            if not isinstance(out, Variable):
+                raise TypeError(
+                    "each element of topology must be a Variable, got "
+                    f"{type(out).__name__}")
+    else:
+        raise TypeError(
+            "topology must be a Variable or a sequence of Variables")
+    program = topology[0].block.program
+    from ..io import _prune_for_inference
+    pruned = _prune_for_inference(program, [],
+                                  [v.name for v in topology])
+    desc = pruned.to_desc()
+    if binary:
+        with open(save_path, "wb") as f:
+            pickle.dump(desc, f, protocol=4)
+    else:
+        with open(save_path, "w") as f:
+            json.dump(desc, f, indent=1, default=str)
+    return save_path

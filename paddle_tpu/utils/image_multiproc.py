@@ -1,0 +1,106 @@
+"""Multi-process image preprocessing.
+
+Parity: python/paddle/utils/image_multiproc.py — transform a batch of
+images in a process pool. The reference offers cv2- and PIL-backed
+transformers; here ONE numpy implementation (paddle_tpu.dataset.image,
+PIL for decoding) serves both names, with the same knob surface
+(resize/crop/transpose/channel_swap/mean/flip-in-train).
+"""
+import numpy as np
+
+from ..dataset import image as _img
+
+__all__ = ["CvTransformer", "PILTransformer",
+           "MultiProcessImageTransformer"]
+
+
+class _Transformer:
+    def __init__(self, resize_size=None, crop_size=None,
+                 transpose=(2, 0, 1), channel_swap=None, mean=None,
+                 is_train=True, is_color=True):
+        self.resize_size = resize_size
+        self.crop_size = crop_size
+        self.transpose = transpose
+        self.channel_swap = channel_swap
+        self.mean = mean
+        self.is_train = is_train
+        self.is_color = is_color
+
+    def transform(self, im):
+        if self.resize_size is not None:
+            im = _img.resize_short(im, self.resize_size)
+        if self.crop_size is not None:
+            if self.is_train:
+                im = _img.random_crop(im, self.crop_size,
+                                      is_color=self.is_color)
+                if np.random.randint(2):
+                    im = _img.left_right_flip(im, self.is_color)
+            else:
+                im = _img.center_crop(im, self.crop_size,
+                                      is_color=self.is_color)
+        if im.ndim == 3:
+            if self.channel_swap is not None:
+                im = im[:, :, list(self.channel_swap)]
+            if self.transpose is not None:
+                im = im.transpose(self.transpose)
+        im = im.astype("float32")
+        if self.mean is not None:
+            mean = np.asarray(self.mean, "float32")
+            im -= mean if mean.ndim != 1 else mean[:, None, None]
+        return im
+
+    def transform_from_string(self, data):
+        return self.transform(_img.load_image_bytes(data, self.is_color))
+
+    def transform_from_file(self, file_name):
+        return self.transform(_img.load_image(file_name, self.is_color))
+
+
+class CvTransformer(_Transformer):
+    """ref image_multiproc.py:36 (cv2-backed there; see module doc)."""
+
+
+class PILTransformer(_Transformer):
+    """ref image_multiproc.py:118."""
+
+
+def _job(args):
+    is_img_string, transformer, im, label = args
+    if is_img_string:
+        return transformer.transform_from_string(im), label
+    return transformer.transform_from_file(im), label
+
+
+class MultiProcessImageTransformer:
+    """Transform (image, label) pairs in a process pool; `run(data,
+    labels)` yields results as they complete (ref
+    image_multiproc.py:199)."""
+
+    def __init__(self, procnum=10, resize_size=None, crop_size=None,
+                 transpose=(2, 0, 1), channel_swap=None, mean=None,
+                 is_train=True, is_color=True, is_img_string=True):
+        self.procnum = procnum
+        self.is_img_string = is_img_string
+        self.transformer = CvTransformer(
+            resize_size=resize_size, crop_size=crop_size,
+            transpose=transpose, channel_swap=channel_swap, mean=mean,
+            is_train=is_train, is_color=is_color)
+        self._pool = None
+
+    @property
+    def pool(self):
+        import multiprocessing
+        if self._pool is None:
+            self._pool = multiprocessing.Pool(processes=self.procnum)
+        return self._pool
+
+    def run(self, data, label):
+        args = [(self.is_img_string, self.transformer, im, lab)
+                for im, lab in zip(data, label)]
+        return self.pool.imap(_job, args)
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.close()
+            self._pool.join()
+            self._pool = None

@@ -114,6 +114,85 @@ def test_reader_package_parity():
     assert not missing, f"missing reader exports: {missing}"
 
 
+def test_layers_submodule_location_parity():
+    """Names must resolve at the reference's SUBMODULE path too
+    (`fluid.layers.nn.sequence_pool`), not only on the package."""
+    import importlib
+    missing = []
+    for f in glob.glob(REF + "/layers/*.py"):
+        mod = os.path.basename(f)[:-3]
+        if mod == "__init__":
+            continue
+        try:
+            ours = importlib.import_module(f"paddle_tpu.layers.{mod}")
+        except ImportError:
+            continue  # module-name parity is covered by the package test
+        missing += [f"layers.{mod}.{n}" for n in literal_all(f)
+                    if not hasattr(ours, n)]
+    assert not missing, f"missing submodule-path exports: {missing}"
+
+
+def test_dataset_and_contrib_export_parity():
+    """Sweep python/paddle/dataset/*.py and fluid/contrib/** __all__s:
+    the same-path paddle_tpu module must export every name."""
+    import importlib
+    refroot = os.path.dirname(REF)  # python/paddle
+    # conll05's reference __all__ contains the single malformed string
+    # 'test, get_dict' (a missing quote in the reference source); both
+    # names are exported individually and checked via the sweep below
+    MALFORMED = {"dataset.conll05": {"test, get_dict"}}
+    missing = []
+    for f in sorted(glob.glob(refroot + "/dataset/*.py")):
+        mod = os.path.basename(f)[:-3]
+        if mod in ("__init__", "setup"):
+            continue
+        names = set(literal_all(f)) - MALFORMED.get(f"dataset.{mod}",
+                                                    set())
+        if not names:
+            continue
+        try:
+            ours = importlib.import_module(f"paddle_tpu.dataset.{mod}")
+        except ImportError:
+            missing.append(f"dataset.{mod} (module)")
+            continue
+        missing += [f"dataset.{mod}.{n}" for n in sorted(names)
+                    if not hasattr(ours, n)]
+    croot = REF + "/contrib"
+    for f in sorted(glob.glob(croot + "/**/*.py", recursive=True)):
+        rel = os.path.relpath(f, croot)[:-3].replace(os.sep, ".")
+        if rel.endswith("__init__"):
+            rel = rel[:-len(".__init__")] if "." in rel else ""
+        if ".tests." in rel or rel.startswith("tests"):
+            continue
+        names = literal_all(f)
+        if not names:
+            continue
+        target = "paddle_tpu.contrib" + ("." + rel if rel else "")
+        try:
+            ours = importlib.import_module(target)
+        except ImportError:
+            missing.append(f"{target} (module)")
+            continue
+        missing += [f"{target}.{n}" for n in sorted(names)
+                    if not hasattr(ours, n)]
+    assert not missing, f"missing exports: {missing}"
+
+
+def test_utils_export_parity():
+    """python/paddle/utils modules the rebuild ships (plot,
+    dump_v2_config, image_multiproc); the v1-era converters predate
+    fluid and are documented out of scope in paddle_tpu/utils."""
+    import importlib
+    refroot = os.path.dirname(REF)
+    missing = []
+    for mod in ("plot", "dump_v2_config", "image_multiproc"):
+        names = literal_all(os.path.join(refroot, "utils", mod + ".py"))
+        ours = importlib.import_module(f"paddle_tpu.utils.{mod}")
+        missing += [f"utils.{mod}.{n}" for n in names
+                    if not hasattr(ours, n)]
+    assert not missing, f"missing utils exports: {missing}"
+
+
 def test_optimizer_and_initializer_parity():
     missing = []
     for n in literal_all(os.path.join(REF, "optimizer.py")):

@@ -379,3 +379,41 @@ class TestConll05Tar:
         assert mark == [1, 1, 1, 1]  # ±2 window around index 2
         assert label_idx == [ld["B-A0"], ld["I-A0"], ld["B-V"],
                              ld["B-AM-TMP"]]
+
+
+def test_dataset_convert_writes_recordio(tmp_path):
+    """convert() (ref each dataset module's convert) produces sharded
+    recordio files readable through reader.creator."""
+    from paddle_tpu.dataset import mnist
+    from paddle_tpu.reader import creator
+    mnist.convert(str(tmp_path))
+    import os
+    names = sorted(os.listdir(tmp_path))
+    assert any(n.startswith("minist_train") for n in names)
+    first = [n for n in names if n.startswith("minist_train")][0]
+    img, lbl = next(iter(creator.recordio(str(tmp_path / first))()))
+    assert len(img) == 784 and 0 <= lbl <= 9
+
+
+def test_common_split_and_cluster_reader(tmp_path):
+    from paddle_tpu.dataset import common
+    paths = common.split(lambda: iter(range(10)), 3,
+                         suffix=str(tmp_path / "part-%05d.pickle"))
+    assert len(paths) == 4  # 3+3+3+1
+    r0 = common.cluster_files_reader(str(tmp_path / "part-*.pickle"),
+                                     trainer_count=2, trainer_id=0)
+    r1 = common.cluster_files_reader(str(tmp_path / "part-*.pickle"),
+                                     trainer_count=2, trainer_id=1)
+    assert sorted(list(r0()) + list(r1())) == list(range(10))
+    assert set(r0()).isdisjoint(set(r1()))
+
+
+def test_movielens_info_dicts():
+    from paddle_tpu.dataset import movielens
+    ui = movielens.user_info()
+    mi = movielens.movie_info()
+    u = ui[1]
+    assert u.value()[0] == 1 and u.value()[1] in (0, 1)
+    v = mi[2].value()
+    assert v[0] == 2 and isinstance(v[1], list) and isinstance(v[2], list)
+    assert movielens.max_user_id() >= max(ui) - 1

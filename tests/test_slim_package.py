@@ -1,0 +1,134 @@
+"""slim package layout + the newly completed surface (ref
+python/paddle/fluid/contrib/slim/*): build_compressor wiring,
+ImitationGraph over a Program, RatioPruner keep-ratio semantics,
+PruneParameterPass actually pruning scope values, and the reference
+import paths resolving."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim import (build_compressor, CompressPass,
+                                     ImitationGraph, RatioPruner,
+                                     MagnitudePruner, PruneParameterPass,
+                                     get_executor)
+
+
+def _mlp_program(seed=3):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = layers.data("x", shape=[8])
+            y = layers.data("y", shape=[1], dtype="int64")
+            h = layers.fc(x, size=16, act="relu")
+            pred = layers.fc(h, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, y))
+            pt.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def test_reference_import_paths():
+    import importlib
+    for mod in ("paddle_tpu.contrib.slim.core.compress_pass",
+                "paddle_tpu.contrib.slim.core.config",
+                "paddle_tpu.contrib.slim.core.pass_builder",
+                "paddle_tpu.contrib.slim.core.strategy",
+                "paddle_tpu.contrib.slim.graph.executor",
+                "paddle_tpu.contrib.slim.graph.graph",
+                "paddle_tpu.contrib.slim.graph.graph_pass",
+                "paddle_tpu.contrib.slim.prune.pruner",
+                "paddle_tpu.contrib.slim.prune.prune_strategy"):
+        importlib.import_module(mod)
+
+
+def test_ratio_pruner_keeps_ratio():
+    w = np.arange(1, 101, dtype="float32") * np.where(
+        np.arange(100) % 2, 1, -1)  # mixed signs, distinct |w|
+    pruned, mask = RatioPruner({"*": 0.4}).prune(w)
+    assert mask.sum() == 40
+    # the kept entries are exactly the top-40 by |w|, signs preserved
+    assert set(np.abs(pruned[mask])) == set(np.abs(w)[60:])
+    # per-name ratio beats the default
+    _, mask2 = RatioPruner({"p": 0.1, "*": 0.9}).prune(w, name="p")
+    assert mask2.sum() == 10
+    # ratio >= 1 keeps everything
+    _, mask3 = RatioPruner().prune(w, ratio=1.0)
+    assert mask3.all()
+
+
+def test_prune_parameter_pass_prunes_scope():
+    main, startup, _ = _mlp_program()
+    graph = ImitationGraph(main)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        names = [p.name for p in graph.all_parameters()
+                 if len(p.shape) == 2]
+        w_before = np.asarray(scope.get(names[0]))
+        thr = float(np.median(np.abs(w_before)))
+        masks = PruneParameterPass(names[:1], {"*": thr}).apply(
+            graph, scope=scope)
+        w_after = np.asarray(scope.get(names[0]))
+    assert names[0] in masks
+    assert (w_after[~masks[names[0]]] == 0).all()
+    # roughly half survives a median threshold
+    frac = masks[names[0]].mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_build_compressor_runs_epochs():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4, 8, 8).astype("float32")
+    ys = rng.randint(0, 4, (4, 8, 1))
+
+    def reader():
+        for i in range(4):
+            yield {"x": xs[i], "y": ys[i]}
+
+    main, startup, loss = _mlp_program()
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    events = []
+
+    class Probe(pt.contrib.slim.Strategy):
+        def on_epoch_begin(self, ctx):
+            events.append(("epoch", ctx.epoch_id))
+
+        def on_batch_end(self, ctx):
+            events.append(("batch", ctx.batch_id))
+
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        comp = build_compressor(place=pt.CPUPlace(), data_reader=reader,
+                                scope=scope,
+                                metrics={"loss": loss}, epoch=2)
+        assert isinstance(comp, CompressPass)
+        probe = Probe()
+        probe.end_epoch = 2
+        comp.add_strategy(probe)
+        ctx = comp.apply(main)
+    assert ("epoch", 1) in events
+    assert sum(1 for e in events if e[0] == "batch") == 8
+    assert np.isfinite(float(np.asarray(ctx.last_results[0])))
+
+
+def test_graph_executor_runs_program():
+    main, startup, loss = _mlp_program()
+    graph = ImitationGraph(main)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor(pt.CPUPlace()).run(startup)
+        gexe = get_executor(graph, pt.CPUPlace())
+        out = gexe.run(graph, scope=scope, fetches=[loss],
+                       feed={"x": np.zeros((2, 8), "float32"),
+                             "y": np.zeros((2, 1), "int64")})
+    assert np.isfinite(float(np.asarray(out[0])))
+
+
+def test_magnitude_pruner_threshold_mode():
+    w = np.array([-3.0, -0.1, 0.05, 2.0], dtype="float32")
+    pruned, mask = MagnitudePruner(threshold=0.5).prune(w)
+    np.testing.assert_array_equal(mask, [True, False, False, True])
+    np.testing.assert_array_equal(pruned, [-3.0, 0.0, 0.0, 2.0])
