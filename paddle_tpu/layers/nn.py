@@ -1392,16 +1392,46 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
 def multi_head_attention(queries, keys, values, attn_bias=None, d_key=64,
                          d_value=64, d_model=512, n_head=8, dropout_rate=0.0,
                          causal=False, param_attr=None, name=None,
-                         cache=None, use_flash=True):
+                         cache=None, use_flash=True, fused_qkv=None):
     """Transformer MHA (ref book machine_translation + nets.py). q/k/v:
-    [B, T, d_model]; attn_bias broadcastable to [B, n_head, Tq, Tk]."""
+    [B, T, d_model]; attn_bias broadcastable to [B, n_head, Tq, Tk].
+
+    fused_qkv: project q/k/v with ONE [d_model, (2*d_key+d_value)*H]
+    matmul when queries/keys/values are the same tensor (else a fused
+    [d_model, d_key*H + d_value*H] k/v projection when keys is values
+    — the cross-attention case): bigger MXU tiles, fewer fusion
+    boundaries than three separate [d_model, d_head*H] matmuls. Default
+    (None) auto-enables when d_key == d_value and no explicit
+    param_attr forces shared weight naming; parameter NAMES then differ
+    from the unfused layout (one `..._qkv`/`..._kv` weight), so
+    checkpoints are not interchangeable between the two layouts."""
     from . import tensor as _t
-    q = fc(queries, d_key * n_head, num_flatten_dims=2, param_attr=param_attr,
-           bias_attr=False, name=f"{name}_q" if name else None)
-    k = fc(keys, d_key * n_head, num_flatten_dims=2, param_attr=param_attr,
-           bias_attr=False, name=f"{name}_k" if name else None)
-    v = fc(values, d_value * n_head, num_flatten_dims=2, param_attr=param_attr,
-           bias_attr=False, name=f"{name}_v" if name else None)
+    if fused_qkv is None:
+        fused_qkv = param_attr is None and d_key == d_value
+    if fused_qkv and d_key == d_value and queries is keys \
+            and keys is values:
+        qkv = fc(queries, 3 * d_key * n_head, num_flatten_dims=2,
+                 param_attr=param_attr, bias_attr=False,
+                 name=f"{name}_qkv" if name else None)
+        q, k, v = split(qkv, 3, dim=2)
+    elif fused_qkv and d_key == d_value and keys is values:
+        q = fc(queries, d_key * n_head, num_flatten_dims=2,
+               param_attr=param_attr, bias_attr=False,
+               name=f"{name}_q" if name else None)
+        kv = fc(keys, 2 * d_key * n_head, num_flatten_dims=2,
+                param_attr=param_attr, bias_attr=False,
+                name=f"{name}_kv" if name else None)
+        k, v = split(kv, 2, dim=2)
+    else:
+        q = fc(queries, d_key * n_head, num_flatten_dims=2,
+               param_attr=param_attr, bias_attr=False,
+               name=f"{name}_q" if name else None)
+        k = fc(keys, d_key * n_head, num_flatten_dims=2,
+               param_attr=param_attr, bias_attr=False,
+               name=f"{name}_k" if name else None)
+        v = fc(values, d_value * n_head, num_flatten_dims=2,
+               param_attr=param_attr, bias_attr=False,
+               name=f"{name}_v" if name else None)
 
     # heads stay in [B, T, H, Dh] layout end-to-end: the reshape is free
     # and the attention dots contract with H as a batch dim, so no head
