@@ -17,9 +17,9 @@ import jax.numpy as jnp
 
 from .ops.registry import get_kernel, KernelCtx
 
-__all__ = ["guard", "to_variable", "Layer", "FC", "Conv2D", "Pool2D",
-           "BatchNorm", "Embedding", "value_and_grad", "sgd_step",
-           "enabled"]
+__all__ = ["guard", "to_variable", "Layer", "PyLayer", "FC", "Conv2D",
+           "Pool2D", "BatchNorm", "Embedding", "value_and_grad",
+           "sgd_step", "enabled"]
 
 _in_guard = [False]
 
@@ -283,3 +283,7 @@ def sgd_step(model, grads, lr):
     optimizer.minimize analog)."""
     params = model.parameters()
     model.set_parameters({k: params[k] - lr * grads[k] for k in params})
+
+
+# reference name for the eager layer base (ref imperative/layers.py)
+PyLayer = Layer

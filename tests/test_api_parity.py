@@ -178,6 +178,19 @@ def test_dataset_and_contrib_export_parity():
     assert not missing, f"missing exports: {missing}"
 
 
+def test_imperative_export_parity():
+    """fluid/imperative package exports (base/layers/nn submodules) all
+    resolve on paddle_tpu.imperative (single-module rebuild)."""
+    from paddle_tpu import imperative
+    missing = []
+    for sub in ("base", "layers", "nn"):
+        for n in literal_all(os.path.join(REF, "imperative",
+                                          sub + ".py")):
+            if not hasattr(imperative, n):
+                missing.append(f"imperative.{sub}.{n}")
+    assert not missing, f"missing imperative exports: {missing}"
+
+
 def test_utils_export_parity():
     """python/paddle/utils modules the rebuild ships (plot,
     dump_v2_config, image_multiproc); the v1-era converters predate
