@@ -122,5 +122,5 @@ def convert(path):
     """Write the imdb splits as sharded RecordIO (ref imdb.py:145)."""
     from . import common
     w = word_dict()
-    common.convert(path, lambda: train(w), 1000, "imdb_train")
-    common.convert(path, lambda: test(w), 1000, "imdb_test")
+    common.convert(path, train(w), 1000, "imdb_train")
+    common.convert(path, test(w), 1000, "imdb_test")

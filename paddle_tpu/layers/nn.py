@@ -1400,14 +1400,19 @@ def multi_head_attention(queries, keys, values, attn_bias=None, d_key=64,
     matmul when queries/keys/values are the same tensor (else a fused
     [d_model, d_key*H + d_value*H] k/v projection when keys is values
     — the cross-attention case): bigger MXU tiles, fewer fusion
-    boundaries than three separate [d_model, d_head*H] matmuls. Default
-    (None) auto-enables when d_key == d_value and no explicit
-    param_attr forces shared weight naming; parameter NAMES then differ
-    from the unfused layout (one `..._qkv`/`..._kv` weight), so
-    checkpoints are not interchangeable between the two layouts."""
+    boundaries than three separate [d_model, d_head*H] matmuls.
+    Parameter NAMES differ from the unfused layout (one
+    `..._qkv`/`..._kv` weight), so checkpoints are not interchangeable
+    between the two layouts — therefore OPT-IN (default off keeps every
+    existing model's names and checkpoints stable); the flagship
+    transformer passes fused_qkv=True."""
     from . import tensor as _t
     if fused_qkv is None:
-        fused_qkv = param_attr is None and d_key == d_value
+        fused_qkv = False
+    if fused_qkv and param_attr is not None:
+        raise ValueError(
+            "fused_qkv shares one weight across q/k/v and cannot honor "
+            "an explicit param_attr naming; pass fused_qkv=False")
     if fused_qkv and d_key == d_value and queries is keys \
             and keys is values:
         qkv = fc(queries, 3 * d_key * n_head, num_flatten_dims=2,

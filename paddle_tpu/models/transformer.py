@@ -16,7 +16,7 @@ __all__ = ["transformer", "build_program", "TransformerConfig"]
 class TransformerConfig:
     def __init__(self, src_vocab=10000, trg_vocab=10000, max_len=256,
                  d_model=512, d_inner=2048, n_head=8, n_layer=6,
-                 dropout=0.1, label_smooth_eps=0.1):
+                 dropout=0.1, label_smooth_eps=0.1, fused_qkv=True):
         self.src_vocab = src_vocab
         self.trg_vocab = trg_vocab
         self.max_len = max_len
@@ -26,6 +26,9 @@ class TransformerConfig:
         self.n_layer = n_layer
         self.dropout = dropout
         self.label_smooth_eps = label_smooth_eps
+        # one [d, 3HDh] qkv matmul (MXU tiling) — flagship default; set
+        # False to keep the reference's per-projection weight names
+        self.fused_qkv = fused_qkv
 
     @staticmethod
     def base():
@@ -77,7 +80,8 @@ def encoder(src_emb, src_bias, cfg):
             d_key=cfg.d_model // cfg.n_head,
             d_value=cfg.d_model // cfg.n_head,
             d_model=cfg.d_model, n_head=cfg.n_head,
-            dropout_rate=cfg.dropout, name=f"enc{i}")
+            dropout_rate=cfg.dropout, name=f"enc{i}",
+            fused_qkv=cfg.fused_qkv)
         x = _res_norm(attn, x, cfg)
         ff = _ffn(x, cfg, f"enc{i}_ffn")
         x = _res_norm(ff, x, cfg)
@@ -92,14 +96,16 @@ def decoder(trg_emb, enc_out, trg_bias, src_bias, cfg):
             d_key=cfg.d_model // cfg.n_head,
             d_value=cfg.d_model // cfg.n_head,
             d_model=cfg.d_model, n_head=cfg.n_head,
-            dropout_rate=cfg.dropout, name=f"dec{i}_self")
+            dropout_rate=cfg.dropout, name=f"dec{i}_self",
+            fused_qkv=cfg.fused_qkv)
         x = _res_norm(self_attn, x, cfg)
         cross = layers.multi_head_attention(
             x, enc_out, enc_out, attn_bias=src_bias,
             d_key=cfg.d_model // cfg.n_head,
             d_value=cfg.d_model // cfg.n_head,
             d_model=cfg.d_model, n_head=cfg.n_head,
-            dropout_rate=cfg.dropout, name=f"dec{i}_cross")
+            dropout_rate=cfg.dropout, name=f"dec{i}_cross",
+            fused_qkv=cfg.fused_qkv)
         x = _res_norm(cross, x, cfg)
         ff = _ffn(x, cfg, f"dec{i}_ffn")
         x = _res_norm(ff, x, cfg)

@@ -194,13 +194,19 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         raise ValueError("readers must be a non-empty list")
 
     def _pump_queue(r, q):
-        for sample in r():
-            if sample is None:
-                raise ValueError("multiprocess_reader sample is None")
-            q.put(sample)
-        q.put(None)
+        try:
+            for sample in r():
+                if sample is None:
+                    raise ValueError(
+                        "multiprocess_reader sample is None")
+                q.put(sample)
+        finally:
+            # ALWAYS enqueue the end sentinel — a child that raised
+            # without it would leave the consumer blocked forever
+            q.put(None)
 
     def queue_reader():
+        import queue as _q
         q = multiprocessing.Queue(queue_size)
         procs = [multiprocessing.Process(target=_pump_queue,
                                          args=(r, q), daemon=True)
@@ -210,7 +216,19 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         live = len(readers)
         try:
             while live:
-                sample = q.get()
+                try:
+                    sample = q.get(timeout=5.0)
+                except _q.Empty:
+                    # sentinel can be lost to a SIGKILLed child; detect
+                    # dead producers instead of blocking forever
+                    if all(not p.is_alive() for p in procs):
+                        dead = [p.exitcode for p in procs]
+                        if any(code not in (0, None) for code in dead):
+                            raise RuntimeError(
+                                "multiprocess_reader child died "
+                                f"(exit codes {dead})")
+                        live = 0
+                    continue
                 if sample is None:
                     live -= 1
                 else:
@@ -218,6 +236,12 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         finally:
             for p in procs:
                 p.join()
+            # a child that raised exits nonzero AFTER its sentinel —
+            # surface the failure instead of silently truncating data
+            bad = [p.exitcode for p in procs if p.exitcode]
+            if bad:
+                raise RuntimeError(
+                    f"multiprocess_reader child failed (exit {bad})")
 
     def _pump_pipe(r, conn):
         for sample in r():

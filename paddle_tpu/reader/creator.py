@@ -34,14 +34,18 @@ def recordio(paths, buf_size=100):
     creator.recordio, which shelled out to the C++ scanner; here the
     sharded native reader already multiplexes files and `buf_size` is
     its queue depth)."""
-    from ..recordio_writer import sharded_recordio_reader
-
     if isinstance(paths, str):
         path_list = [p for p in paths.split(",") if p]
     else:
         path_list = list(paths)
 
     def reader():
-        for rec in sharded_recordio_reader(path_list)():
-            yield rec
+        from ..recordio_writer import ShardedRecordIOReader
+        import pickle
+        r = ShardedRecordIOReader(path_list, queue_capacity=buf_size)
+        try:
+            for rec in r:
+                yield pickle.loads(rec)
+        finally:
+            r.close()
     return reader

@@ -20,6 +20,9 @@ def dump_v2_config(topology, save_path, binary=False):
     if isinstance(topology, Variable):
         topology = [topology]
     elif isinstance(topology, collections.abc.Sequence):
+        if not topology:
+            raise ValueError("topology must contain at least one "
+                             "output Variable")
         for out in topology:
             if not isinstance(out, Variable):
                 raise TypeError(

@@ -94,3 +94,30 @@ def test_convert_reader_to_recordio_files(tmp_path):
     assert [os.path.basename(p) for p in paths] == \
         ["d-00000.recordio", "d-00001.recordio", "d-00002.recordio"]
     assert sorted(creator.recordio(paths)()) == list(range(10))
+
+
+def test_multiprocess_reader_child_failure_is_loud():
+    import pytest
+
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+
+    # queue mode must neither deadlock nor silently truncate
+    with pytest.raises(RuntimeError, match="child"):
+        list(pt.reader.multiprocess_reader([bad], use_pipe=False,
+                                           queue_size=4)())
+
+
+def test_dump_v2_config_rejects_empty():
+    import pytest
+    from paddle_tpu.utils.dump_v2_config import dump_v2_config
+    with pytest.raises(ValueError, match="at least one"):
+        dump_v2_config([], "/tmp/never.json")
+
+
+def test_imdb_convert_roundtrip(tmp_path):
+    from paddle_tpu.dataset import imdb
+    imdb.convert(str(tmp_path))
+    files = sorted(os.listdir(tmp_path))
+    assert any(f.startswith("imdb_train") for f in files)
