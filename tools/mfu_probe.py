@@ -7,6 +7,7 @@ the round-3 verdict asked for. A/B knobs:
 
   python tools/mfu_probe.py                 # current defaults
   python tools/mfu_probe.py --no-fuse-tail  # disable stacked Adam tail
+  python tools/mfu_probe.py --no-fused-qkv # unfused q/k/v matmuls
   python tools/mfu_probe.py --steps 20
 
 Run on the real chip (axon relay). Ref: benchmark/fluid/
@@ -24,6 +25,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--no-fuse-tail", action="store_true")
+    ap.add_argument("--no-fused-qkv", action="store_true")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--seqlen", type=int, default=128)
     args = ap.parse_args()
@@ -48,7 +50,7 @@ def main():
             cfg = tfm.TransformerConfig(
                 src_vocab=8000, trg_vocab=8000, max_len=T,
                 d_model=512, d_inner=2048, n_head=8, n_layer=6,
-                dropout=0.1)
+                dropout=0.1, fused_qkv=not args.no_fused_qkv)
             feeds, avg_cost, tok = tfm.build_program(cfg, maxlen=T)
             pt.optimizer.Adam(1e-3).minimize(avg_cost)
     pt.amp.cast_program_to_bf16(main_p)
