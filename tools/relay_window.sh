@@ -1,0 +1,8 @@
+#!/bin/bash
+# One relay window: probe; if the chip answers, immediately capture a
+# full bench run (short budget fits this window) + stamp the output.
+cd /root/repo
+P=$(python -c "import bench; print(bench._probe_tpu(timeout=100) or '')")
+if [ -z "$P" ]; then echo "RELAY DOWN $(date +%H:%M:%S)"; exit 0; fi
+echo "RELAY UP ($P) $(date +%H:%M:%S) — capturing bench"
+BENCH_TOTAL_BUDGET_S=400 timeout 430 python bench.py 2>/tmp/relay_bench.err | tee /tmp/relay_bench.jsonl | tail -1
