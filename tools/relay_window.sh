@@ -1,8 +1,26 @@
 #!/bin/bash
-# One relay window: probe; if the chip answers, immediately capture a
-# full bench run (short budget fits this window) + stamp the output.
+# One relay window per invocation: probe; if the chip answers, run the
+# next uncaptured measurement stage (bench -> mfu A/B -> flash A/B).
 cd /root/repo
 P=$(python -c "import bench; print(bench._probe_tpu(timeout=100) or '')")
 if [ -z "$P" ]; then echo "RELAY DOWN $(date +%H:%M:%S)"; exit 0; fi
-echo "RELAY UP ($P) $(date +%H:%M:%S) — capturing bench"
-BENCH_TOTAL_BUDGET_S=400 timeout 430 python bench.py 2>/tmp/relay_bench.err | tee /tmp/relay_bench.jsonl | tail -1
+echo "RELAY UP ($P) $(date +%H:%M:%S)"
+if [ ! -s /tmp/relay_bench.jsonl ]; then
+  echo "— capturing bench"
+  BENCH_TOTAL_BUDGET_S=400 timeout 430 python bench.py \
+    2>/tmp/relay_bench.err | tee /tmp/relay_bench.jsonl | tail -1
+elif [ ! -s /tmp/relay_mfu_fused.out ]; then
+  echo "— capturing mfu_probe (fused)"
+  timeout 430 python tools/mfu_probe.py --steps 10 \
+    >/tmp/relay_mfu_fused.out 2>/tmp/relay_mfu_fused.err
+  tail -5 /tmp/relay_mfu_fused.out
+elif [ ! -s /tmp/relay_mfu_unfused.out ]; then
+  echo "— capturing mfu_probe (unfused A/B)"
+  timeout 430 python tools/mfu_probe.py --steps 10 --no-fused-qkv \
+    >/tmp/relay_mfu_unfused.out 2>/tmp/relay_mfu_unfused.err
+  tail -5 /tmp/relay_mfu_unfused.out
+else
+  echo "— all stages captured; rerunning bench to warm caches"
+  BENCH_TOTAL_BUDGET_S=400 timeout 430 python bench.py \
+    2>/dev/null | tail -1
+fi
