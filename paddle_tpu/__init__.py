@@ -25,6 +25,20 @@ try:
 except Exception:  # very old jax without the option — keep threefry
     pass
 
+# Opt-in persistent XLA compilation cache: first compiles through a TPU
+# relay cost 20-40s per executable; with PADDLE_TPU_COMPILE_CACHE=<dir>
+# repeat runs reload them in milliseconds. Env-gated (no surprise disk
+# writes); backends that can't serialize executables just ignore it.
+import os as _os
+_cache_dir = _os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+if _cache_dir:
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
 from . import ops               # registers all kernels
 from . import unique_name
 from .core.framework import (

@@ -431,3 +431,33 @@ def test_run_scanned_feed_validation():
     with _pytest.raises(ValueError):
         exe.run_scanned(main, feed={"x": np.zeros((2, 4, 3), "float32")},
                         fetch_list=[out], steps=5)
+
+
+def test_compile_cache_env_gate(tmp_path):
+    """PADDLE_TPU_COMPILE_CACHE=<dir> persists XLA executables across
+    processes (MIGRATING 'Execution model'); unset → no writes."""
+    import subprocess
+    import sys
+    import os
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import numpy as np, paddle_tpu as pt\n"
+        # drop the gate's 0.5s threshold AFTER import: CPU-sized test
+        # compiles are fast, and the threshold is what's under test
+        # only in so far as the cache dir config took effect
+        "jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)\n"
+        "from paddle_tpu import layers\n"
+        "x = layers.data('x', shape=[64])\n"
+        "y = layers.fc(x, size=64)\n"
+        "exe = pt.Executor(pt.CPUPlace())\n"
+        "exe.run(pt.default_startup_program())\n"
+        "exe.run(feed={'x': np.zeros((4,64),'float32')}, fetch_list=[y])\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_COMPILE_CACHE=str(tmp_path / "cc"))
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-800:]
+    cc = tmp_path / "cc"
+    assert cc.is_dir() and any(cc.iterdir()), \
+        "compile cache dir empty — env gate did not take effect"
