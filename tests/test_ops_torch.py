@@ -339,3 +339,80 @@ def test_dynamic_gru_vs_torch_gru():
         got, = [np.asarray(o) for o in exe.run(feed={"x": x},
                                                fetch_list=[h])]
     _cmp(got, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("stride,pad,opad", [(2, 0, None), (2, 1, None),
+                                             (3, 2, None)])
+def test_conv2d_transpose_vs_torch(stride, pad, opad):
+    """Deconv output-size/padding semantics vs torch.nn.functional.
+    conv_transpose2d (ref conv2d_transpose_op.cc)."""
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(7)
+    B, Cin, Cout, H, W, K = 2, 3, 5, 9, 11, 4
+    x = rng.randn(B, Cin, H, W).astype("float32")
+    # paddle weight layout for transpose conv: [Cin, Cout, Kh, Kw]
+    w = rng.randn(Cin, Cout, K, K).astype("float32") * 0.3
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            xin = layers.data("x", shape=[Cin, H, W])
+            out = layers.conv2d_transpose(
+                xin, Cout, filter_size=K, stride=stride, padding=pad,
+                bias_attr=False,
+                param_attr=pt.ParamAttr(
+                    name="w_t",
+                    initializer=pt.initializer.NumpyArrayInitializer(w)))
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        got = np.asarray(exe.run(main, feed={"x": x},
+                                 fetch_list=[out])[0])
+    ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                             stride=stride, padding=pad).numpy()
+    assert got.shape == ref.shape, (got.shape, ref.shape)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_warpctc_vs_torch_ctc_loss():
+    """CTC loss per sequence vs torch.nn.functional.ctc_loss (the
+    reference wraps the warp-ctc CUDA lib; ours is pure XLA in log
+    space — ref warpctc_op.cc). Includes repeated labels (forces the
+    blank-transition rules) and ragged label lengths."""
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(5)
+    B, T, C, L = 3, 12, 6, 4  # C includes blank=0
+    logits = rng.randn(B, T, C).astype("float32")
+    labels = np.array([[1, 2, 2, 3],      # repeat → needs blank
+                       [4, 5, 0, 0],      # shorter (len 2)
+                       [3, 3, 3, 0]],     # heavy repeats (len 3)
+                      dtype="int64")
+    lab_len = np.array([4, 2, 3], dtype="int64")
+    in_len = np.array([12, 10, 12], dtype="int64")
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            lg = layers.data("lg", shape=[T, C])
+            lb = layers.data("lb", shape=[L], dtype="int64")
+            il = layers.data("il", shape=[1], dtype="int64")
+            ll = layers.data("ll", shape=[1], dtype="int64")
+            loss = layers.warpctc(lg, lb, blank=0,
+                                  input_length=il, label_length=ll)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        got = np.asarray(exe.run(
+            main, feed={"lg": logits, "lb": labels,
+                        "il": in_len[:, None], "ll": lab_len[:, None]},
+            fetch_list=[loss])[0]).reshape(-1)
+
+    lp = F.log_softmax(torch.tensor(logits), dim=-1).transpose(0, 1)
+    ref = F.ctc_loss(lp, torch.tensor(labels),
+                     torch.tensor(in_len), torch.tensor(lab_len),
+                     blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
