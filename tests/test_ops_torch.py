@@ -416,3 +416,43 @@ def test_warpctc_vs_torch_ctc_loss():
                      torch.tensor(in_len), torch.tensor(lab_len),
                      blank=0, reduction="none").numpy()
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_conv3d_and_pool3d_vs_torch():
+    """Volumetric conv + max/avg pool vs torch (ref conv3d_op,
+    pool3d_op — the video-model path)."""
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(3)
+    B, Cin, Cout, D, H, W, K = 2, 2, 4, 6, 7, 8, 3
+    x = rng.randn(B, Cin, D, H, W).astype("float32")
+    w = rng.randn(Cout, Cin, K, K, K).astype("float32") * 0.2
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            xin = layers.data("x", shape=[Cin, D, H, W])
+            c = layers.conv3d(
+                xin, Cout, filter_size=K, stride=2, padding=1,
+                bias_attr=False,
+                param_attr=pt.ParamAttr(
+                    name="w3", initializer=pt.initializer
+                    .NumpyArrayInitializer(w)))
+            pm = layers.pool3d(c, pool_size=2, pool_type="max",
+                               pool_stride=2)
+            pa = layers.pool3d(c, pool_size=2, pool_type="avg",
+                               pool_stride=2)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        got_c, got_m, got_a = [np.asarray(v) for v in exe.run(
+            main, feed={"x": x}, fetch_list=[c, pm, pa])]
+    ref_c = F.conv3d(torch.tensor(x), torch.tensor(w), stride=2,
+                     padding=1)
+    np.testing.assert_allclose(got_c, ref_c.numpy(), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(
+        got_m, F.max_pool3d(ref_c, 2, 2).numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        got_a, F.avg_pool3d(ref_c, 2, 2).numpy(), rtol=2e-4, atol=2e-4)
