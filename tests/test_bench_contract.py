@@ -1,0 +1,66 @@
+"""bench.py's survival contract (VERDICT r3 #1): whatever happens to
+the backend or the driver's timer, stdout's last line is valid JSON
+with the headline metric schema. Three rounds of BENCH artifacts died
+to violations of this; it is load-bearing enough to pin with tests.
+
+Runs the real bench.py as a subprocess on the CPU backend with the TPU
+probe short-circuited (BENCH_TOTAL_BUDGET_S small, BENCH_ONLY=mnist)
+— ~40s total.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _env():
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", BENCH_ONLY="mnist",
+               BENCH_TOTAL_BUDGET_S="120")
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _parse_last(stdout):
+    lines = [l for l in stdout.strip().splitlines() if l.strip()]
+    assert lines, "bench printed nothing"
+    return json.loads(lines[-1])
+
+
+def test_final_line_schema_on_cpu():
+    p = subprocess.run([sys.executable, BENCH], env=_env(),
+                       capture_output=True, text=True, timeout=400)
+    assert p.returncode == 0, p.stderr[-800:]
+    obj = _parse_last(p.stdout)
+    for key in ("metric", "value", "unit", "vs_baseline", "platform"):
+        assert key in obj, (key, obj)
+    assert obj["metric"] == "transformer_base_train_tokens_per_sec"
+    assert obj["platform"] == "cpu"
+    assert obj["mnist_mlp_steps_per_sec"] > 0
+    # the probe record must say WHY this is a CPU line
+    assert obj["probe"]["cpu_fallback_ran"] is True
+
+
+def test_sigterm_flushes_parseable_line():
+    """Kill bench mid-run (the driver-timeout scenario): the last
+    stdout line must still parse — the t=0 bootstrap guarantees it."""
+    proc = subprocess.Popen([sys.executable, BENCH], env=_env(),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    time.sleep(6)  # inside backend bring-up, before any result
+    proc.send_signal(signal.SIGTERM)
+    try:
+        out, _ = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        pytest.fail("bench did not exit after SIGTERM")
+    obj = _parse_last(out)
+    assert obj["metric"] == "transformer_base_train_tokens_per_sec"
+    assert "value" in obj and "platform" in obj
