@@ -103,6 +103,9 @@ def main():
     if mode == "sp":
         _sp_mode(pid, nproc, n_global)
         return
+    if mode == "pp":
+        _pp_mode(pid, nproc, n_global)
+        return
 
     # operand sharded over the global mesh, device d contributing (d+1)
     contrib = np.arange(1, n_global + 1, dtype=np.float32)
@@ -293,6 +296,78 @@ def _sp_mode(pid, nproc, n_global):
                                        eg[shard.index],
                                        rtol=2e-4, atol=2e-4)
     print(f"RESULT sp-ok {nproc} {n_global}", flush=True)
+
+
+def _pp_mode(pid, nproc, n_global):
+    """PIPELINE parallelism across the host boundary: a 4-stage MLP on
+    a pp=4 mesh spanning both processes — the stage-2→stage-3 activation
+    ppermute crosses hosts every microbatch (the DCN pipeline story).
+    GPipe losses must equal the single-device dense run."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline import PipelineTrainer
+
+    D = 8
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 5
+        bnames = []
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                x = layers.data("x", shape=[D])
+                label = layers.data("label", shape=[D])
+                h = x
+                for i in range(4):
+                    h = layers.fc(
+                        h, size=D, act="relu" if i < 3 else None,
+                        param_attr=pt.ParamAttr(name=f"mh_fc{i}.w"),
+                        bias_attr=pt.ParamAttr(name=f"mh_fc{i}.b"))
+                    if i < 3:
+                        bnames.append(h.name)
+                loss = layers.mean(layers.square_error_cost(h, label))
+                pt.optimizer.SGD(0.05).minimize(loss)
+        return main, startup, loss, bnames
+
+    main, startup, loss, bnames = build()
+    exe = pt.Executor(pt.CPUPlace())
+    scope0 = pt.Scope()
+    with pt.scope_guard(scope0):
+        exe.run(startup)
+    snapshot = {v.name: np.asarray(scope0.get(v.name))
+                for v in main.persistable_vars()}
+
+    rng = np.random.RandomState(3)
+    # one fixed batch repeated: parity AND monotone loss decrease
+    batch = {"x": rng.randn(8, D).astype("float32"),
+             "label": rng.randn(8, D).astype("float32")}
+    feeds = [batch] * 3
+
+    mesh = make_mesh(pp=4, devices=jax.devices())
+    scope = pt.Scope()
+    for n, v in snapshot.items():
+        scope.set(n, jnp.asarray(v))
+    trainer = PipelineTrainer(main, loss, bnames, mesh,
+                              n_microbatch=4, scope=scope)
+    got = [float(np.asarray(trainer.run(f))) for f in feeds]
+
+    main2, startup2, loss2, _ = build()
+    scope2 = pt.Scope()
+    with pt.scope_guard(scope2):
+        exe2 = pt.Executor(pt.CPUPlace())
+        exe2.run(startup2)
+        for n, v in snapshot.items():
+            scope2.set(n, jnp.asarray(v))
+        expect = [float(np.asarray(exe2.run(
+            main2, feed=f, fetch_list=[loss2])[0])) for f in feeds]
+
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    assert got[-1] < got[0]
+    print(f"RESULT pp-ok {nproc} {n_global}", flush=True)
 
 
 if __name__ == "__main__":

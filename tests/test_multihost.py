@@ -120,6 +120,16 @@ def test_two_process_ring_attention(tmp_path):
             (out, err[-500:])
 
 
+def test_two_process_pipeline_training(tmp_path):
+    """GPipe over a pp=4 mesh spanning both processes: the mid-network
+    activation ppermute crosses the host boundary every microbatch;
+    losses == single-device dense run and decrease."""
+    outs = _spawn_workers(tmp_path, extra_args=("pp",))
+    for rc, out, err in outs:
+        assert f"RESULT pp-ok {_NPROC} {2 * _NPROC}" in out, \
+            (out, err[-500:])
+
+
 def test_two_process_tensor_parallel_training(tmp_path):
     """dp x tp on the 2-process mesh (tp intra-host, dp across hosts):
     Megatron-sharded weights + cross-host grad all-reduce must equal
