@@ -32,7 +32,11 @@ class DistributeTranspilerConfig:
     """ref transpiler config (slice_var_up etc. → sharding knobs)."""
 
     def __init__(self):
-        self.mode = "collective"        # "collective" | "zero" (pserver analog)
+        # "collective" | "zero" (opt-state sharded over dp — the
+        # pserver analog) | "zero3" (params AND opt state sharded over
+        # dp on dim 0; XLA GSPMD inserts the use-site all-gathers and
+        # grad reduce-scatters — full-parameter memory scaling)
+        self.mode = "collective"
         self.dp = None                  # default: all devices
         self.tp = 1
         self.sp = 1
@@ -99,6 +103,20 @@ class DistributeTranspiler:
             for n, sh in zero_stage(self.mesh, names, axis="dp").items():
                 if sh.spec == P() or fits(n, sh.spec):
                     shardings[n] = sh
+        elif cfg.mode == "zero3":
+            # dim-0 shard everything replicated so far whose leading
+            # dim tiles on dp (params, moments, accumulators alike);
+            # non-tiling vars and scalars (lr, beta pows) replicate —
+            # same fallback contract as the tp rules above
+            for n in names:
+                if shardings[n].spec != P():
+                    continue  # tp/table rules take precedence
+                shape = shapes[n]
+                if not shape:
+                    continue
+                spec = P("dp", *([None] * (len(shape) - 1)))
+                if fits(n, spec):
+                    shardings[n] = NamedSharding(self.mesh, spec)
         # the distributed lookup table (ref distribute_lookup_table.py →
         # pserver row partitioning): row-shard the table AND its
         # optimizer accumulators over as many axes as divide the vocab
