@@ -302,7 +302,8 @@ def _pp_mode(pid, nproc, n_global):
     """PIPELINE parallelism across the host boundary: a 4-stage MLP on
     a pp=4 mesh spanning both processes — the stage-2→stage-3 activation
     ppermute crosses hosts every microbatch (the DCN pipeline story).
-    GPipe losses must equal the single-device dense run."""
+    GPipe losses must equal the single-device dense run; the 1F1B
+    schedule must match GPipe bit-for-bit."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -348,12 +349,19 @@ def _pp_mode(pid, nproc, n_global):
     feeds = [batch] * 3
 
     mesh = make_mesh(pp=4, devices=jax.devices())
-    scope = pt.Scope()
-    for n, v in snapshot.items():
-        scope.set(n, jnp.asarray(v))
-    trainer = PipelineTrainer(main, loss, bnames, mesh,
-                              n_microbatch=4, scope=scope)
-    got = [float(np.asarray(trainer.run(f))) for f in feeds]
+
+    def run_schedule(schedule):
+        scope = pt.Scope()
+        for n, v in snapshot.items():
+            scope.set(n, jnp.asarray(v))
+        trainer = PipelineTrainer(main, loss, bnames, mesh,
+                                  n_microbatch=4, scope=scope,
+                                  schedule=schedule)
+        return [float(np.asarray(trainer.run(f))) for f in feeds]
+
+    got = run_schedule("gpipe")
+    got_1f1b = run_schedule("1f1b")
+    np.testing.assert_allclose(got_1f1b, got, rtol=1e-6, atol=1e-7)
 
     main2, startup2, loss2, _ = build()
     scope2 = pt.Scope()

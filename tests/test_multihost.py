@@ -121,9 +121,10 @@ def test_two_process_ring_attention(tmp_path):
 
 
 def test_two_process_pipeline_training(tmp_path):
-    """GPipe over a pp=4 mesh spanning both processes: the mid-network
-    activation ppermute crosses the host boundary every microbatch;
-    losses == single-device dense run and decrease."""
+    """GPipe AND 1F1B over a pp=4 mesh spanning both processes: the
+    mid-network activation ppermute crosses the host boundary every
+    microbatch; both schedules == single-device dense run, decrease,
+    and match each other."""
     outs = _spawn_workers(tmp_path, extra_args=("pp",))
     for rc, out, err in outs:
         assert f"RESULT pp-ok {_NPROC} {2 * _NPROC}" in out, \
