@@ -21,6 +21,10 @@ runs the selected check:
 - mode "tp": dp x tp over the multi-host mesh (Megatron-sharded
   weights, tp intra-host, dp across hosts) == single-process
   numerics.
+- mode "sp": causal ring attention with the sp axis spanning both
+  processes; fwd + q/k/v grads == dense reference.
+- mode "pp": GPipe AND 1F1B pipeline training with the pp axis
+  spanning both processes; == single-device dense run.
 
 Prints "RESULT ..." on success.
 """
