@@ -170,7 +170,7 @@ def bench_transformer(platform, batch=None, profile=True):
             cfg = tfm.TransformerConfig(
                 src_vocab=8000, trg_vocab=8000, max_len=T,
                 d_model=512, d_inner=2048, n_head=8, n_layer=6,
-                dropout=0.1)
+                dropout=0.1, fused_qkv=True)
             feeds, avg_cost, tok = tfm.build_program(cfg, maxlen=T)
             pt.optimizer.Adam(1e-3).minimize(avg_cost)
     # bf16 matmuls on the MXU, fp32 optimizer state (SURVEY §5 target)

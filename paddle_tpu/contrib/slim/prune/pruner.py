@@ -80,6 +80,11 @@ class RatioPruner(Pruner):
             return w, np.ones_like(w, dtype=bool)
         keep = max(int(w.size * ratio), 1)
         a = np.abs(w).reshape(-1)
-        thresh = np.partition(a, w.size - keep)[w.size - keep]
-        mask = np.abs(w) >= thresh
+        # select EXACTLY `keep` indices (a >=threshold mask over-keeps
+        # whenever magnitudes tie at the threshold, e.g. quantized or
+        # zero-heavy tensors)
+        idx = np.argpartition(a, w.size - keep)[w.size - keep:]
+        mask = np.zeros(w.size, dtype=bool)
+        mask[idx] = True
+        mask = mask.reshape(w.shape)
         return w * mask, mask

@@ -1404,8 +1404,8 @@ def multi_head_attention(queries, keys, values, attn_bias=None, d_key=64,
     Parameter NAMES differ from the unfused layout (one
     `..._qkv`/`..._kv` weight), so checkpoints are not interchangeable
     between the two layouts — therefore OPT-IN (default off keeps every
-    existing model's names and checkpoints stable); the flagship
-    transformer passes fused_qkv=True."""
+    existing model's names and checkpoints stable); the perf paths
+    (bench.py, tools/mfu_probe.py) opt in with fused_qkv=True."""
     from . import tensor as _t
     if fused_qkv is None:
         fused_qkv = False
@@ -1428,6 +1428,18 @@ def multi_head_attention(queries, keys, values, attn_bias=None, d_key=64,
                 name=f"{name}_kv" if name else None)
         k, v = split(kv, 2, dim=2)
     else:
+        if fused_qkv:
+            import warnings
+            warnings.warn(
+                "fused_qkv=True requested but the fused projection needs "
+                "d_key == d_value and q/k/v (or at least k/v) to be the "
+                "SAME tensor object"
+                f" (got d_key={d_key}, d_value={d_value}, "
+                f"queries is keys={queries is keys}, "
+                f"keys is values={keys is values}); falling back to the "
+                "UNFUSED per-projection weights — parameter names and the "
+                "checkpoint layout are the unfused ones",
+                stacklevel=2)
         q = fc(queries, d_key * n_head, num_flatten_dims=2,
                param_attr=param_attr, bias_attr=False,
                name=f"{name}_q" if name else None)

@@ -16,7 +16,7 @@ __all__ = ["transformer", "build_program", "TransformerConfig"]
 class TransformerConfig:
     def __init__(self, src_vocab=10000, trg_vocab=10000, max_len=256,
                  d_model=512, d_inner=2048, n_head=8, n_layer=6,
-                 dropout=0.1, label_smooth_eps=0.1, fused_qkv=True):
+                 dropout=0.1, label_smooth_eps=0.1, fused_qkv=False):
         self.src_vocab = src_vocab
         self.trg_vocab = trg_vocab
         self.max_len = max_len
@@ -26,8 +26,11 @@ class TransformerConfig:
         self.n_layer = n_layer
         self.dropout = dropout
         self.label_smooth_eps = label_smooth_eps
-        # one [d, 3HDh] qkv matmul (MXU tiling) — flagship default; set
-        # False to keep the reference's per-projection weight names
+        # one [d, 3HDh] qkv matmul (MXU tiling) — OPT-IN: the default
+        # False keeps the reference's per-projection weight names, so
+        # checkpoints from prior builds / converted reference models
+        # load unchanged; the perf paths (bench.py, tools/mfu_probe.py)
+        # pass fused_qkv=True explicitly
         self.fused_qkv = fused_qkv
 
     @staticmethod

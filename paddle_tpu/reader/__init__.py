@@ -252,19 +252,30 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         conn.close()
 
     def pipe_reader():
-        conns, procs = [], []
-        for r in readers:
+        conns, procs, owner = [], [], {}
+        broken = []
+        for i, r in enumerate(readers):
             parent, child = multiprocessing.Pipe(duplex=False)
             conns.append(parent)
             p = multiprocessing.Process(target=_pump_pipe,
                                         args=(r, child), daemon=True)
             procs.append(p)
+            owner[parent] = (i, p)
             p.start()
             child.close()
         try:
             while conns:
                 for conn in multiprocessing.connection.wait(conns):
-                    sample = conn.recv()
+                    try:
+                        sample = conn.recv()
+                    except EOFError:
+                        # child died mid-stream (raised or was killed)
+                        # without sending its end sentinel — record it
+                        # and keep draining the healthy pipes
+                        conn.close()
+                        conns.remove(conn)
+                        broken.append(owner[conn])
+                        continue
                     if sample is None:
                         conn.close()
                         conns.remove(conn)
@@ -273,6 +284,18 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         finally:
             for p in procs:
                 p.join()
+            # mirror queue mode: a child that raised exits nonzero (or
+            # closed its pipe early) — surface it, never truncate data
+            # silently
+            failed = [f"reader[{i}] (exit {p.exitcode})"
+                      for i, p in broken]
+            failed += [f"reader[{i}] (exit {p.exitcode})"
+                       for i, p in enumerate(procs)
+                       if p.exitcode and (i, p) not in broken]
+            if failed:
+                raise RuntimeError(
+                    "multiprocess_reader child failed: "
+                    + ", ".join(failed))
 
     return pipe_reader if use_pipe else queue_reader
 
@@ -299,6 +322,19 @@ class PipeReader:
                                         bufsize=bufsize,
                                         stdout=subprocess.PIPE)
 
+    def _gunzip(self, chunk):
+        """Incrementally decompress, handling MULTI-MEMBER gzip (e.g.
+        `cat part1.gz part2.gz` or pigz output): when one member's
+        trailer lands mid-chunk, re-feed the remainder to a fresh
+        decompressobj instead of dropping it."""
+        import zlib
+        out = self._dec.decompress(chunk)
+        while self._dec.eof and self._dec.unused_data:
+            rest = self._dec.unused_data
+            self._dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+            out += self._dec.decompress(rest)
+        return out
+
     def get_line(self, cut_lines=True, line_break="\n"):
         pending = ""
         while True:
@@ -306,7 +342,7 @@ class PipeReader:
             if not chunk:
                 break
             if self.file_type == "gzip":
-                chunk = self._dec.decompress(chunk)
+                chunk = self._gunzip(chunk)
             text = chunk.decode("utf-8", "replace")
             if not cut_lines:
                 yield text
@@ -314,6 +350,30 @@ class PipeReader:
             pending += text
             *lines, pending = pending.split(line_break)
             yield from lines
+        # reap the command FIRST: a failing `cat`/`hadoop fs -cat`
+        # must surface as a command error, not be misdiagnosed as a
+        # truncated gzip stream (and must never leak unreaped)
+        rc = self.process.wait()
+        if rc:
+            raise IOError(
+                f"PipeReader: command exited with status {rc}")
+        if self.file_type == "gzip":
+            # flush whatever the decompressor still buffers, and detect
+            # a truncated stream (missing gzip trailer) instead of
+            # silently yielding a short line stream
+            tail = self._dec.flush()
+            if not self._dec.eof:
+                raise IOError(
+                    "PipeReader: gzip stream ended before the trailer "
+                    "(truncated input)")
+            if tail:
+                text = tail.decode("utf-8", "replace")
+                if not cut_lines:
+                    yield text
+                else:
+                    pending += text
+                    *lines, pending = pending.split(line_break)
+                    yield from lines
         if cut_lines and pending:
             yield pending
 

@@ -108,6 +108,44 @@ def test_multiprocess_reader_child_failure_is_loud():
         list(pt.reader.multiprocess_reader([bad], use_pipe=False,
                                            queue_size=4)())
 
+    # pipe mode (the default) must be just as loud: the child raising
+    # mid-stream closes its pipe, which must surface as a RuntimeError
+    # naming the failed child, not a bare EOFError or silent truncation
+    with pytest.raises(RuntimeError, match=r"reader\[0\]"):
+        list(pt.reader.multiprocess_reader([bad], use_pipe=True)())
+
+
+def test_pipe_reader_failure_paths(tmp_path):
+    import gzip
+    import pytest
+
+    # a failing command must raise, not end the stream quietly
+    r = pt.reader.PipeReader("false")
+    with pytest.raises(IOError, match="status"):
+        list(r.get_line())
+
+    # truncated gzip stream must raise, not yield short data
+    blob = gzip.compress(b"a\nb\nc\n")
+    trunc = tmp_path / "t.gz"
+    trunc.write_bytes(blob[:-6])
+    r = pt.reader.PipeReader(f"cat {trunc}", file_type="gzip")
+    with pytest.raises(IOError, match="truncated|trailer"):
+        list(r.get_line())
+
+    # healthy gzip roundtrip still works, including the flushed tail
+    ok = tmp_path / "ok.gz"
+    ok.write_bytes(gzip.compress(b"x\ny\nz"))
+    r = pt.reader.PipeReader(f"cat {ok}", file_type="gzip")
+    assert list(r.get_line()) == ["x", "y", "z"]
+
+    # multi-member gzip (cat part1.gz part2.gz / pigz output) must
+    # decode EVERY member, not stop at the first trailer
+    p1, p2 = tmp_path / "p1.gz", tmp_path / "p2.gz"
+    p1.write_bytes(gzip.compress(b"a\nb\n"))
+    p2.write_bytes(gzip.compress(b"c\nd\n"))
+    r = pt.reader.PipeReader(f"cat {p1} {p2}", file_type="gzip")
+    assert [l for l in r.get_line() if l] == ["a", "b", "c", "d"]
+
 
 def test_dump_v2_config_rejects_empty():
     import pytest
