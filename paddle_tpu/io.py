@@ -102,13 +102,45 @@ def _prune_for_inference(program, feed_names, fetch_names):
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None, export_for_deployment=True):
-    """ref io.py:save_inference_model — pruned program desc + params."""
+                         params_filename=None, export_for_deployment=True,
+                         program_format="json"):
+    """ref io.py:save_inference_model — pruned program desc + params.
+
+    program_format: "json" (native desc + params.npz) or "fluid"
+    (the reference's binary ProgramDesc `__model__` + LoDTensor-stream
+    parameter files, loadable by real Fluid — core/fluid_proto.py)."""
     from .core.framework import default_main_program
     program = main_program or default_main_program()
     fetch_names = [v.name if hasattr(v, "name") else v for v in target_vars]
     pruned = _prune_for_inference(program, feeded_var_names, fetch_names)
     os.makedirs(dirname, exist_ok=True)
+    if program_format == "fluid":
+        from .core import fluid_proto
+        blob = fluid_proto.program_to_fluid(
+            pruned, feed_names=list(feeded_var_names),
+            fetch_names=fetch_names)
+        with open(os.path.join(dirname, model_filename or "__model__"),
+                  "wb") as f:
+            f.write(blob)
+        scope = global_scope()
+        arrays = _collect(pruned, lambda v: v.persistable, scope)
+        # every persistable var in the emitted desc must have a value:
+        # the load side walks ALL of them, and a silent gap would shift
+        # every later tensor in a combined stream
+        missing = [v.name for v in pruned.persistable_vars()
+                   if v.name not in arrays]
+        if missing:
+            raise RuntimeError(
+                "fluid export: persistable vars have no value in the "
+                f"scope (run the startup program first?): {missing}")
+        # combined-file order must equal the load side's walk of the
+        # program's persistable vars (load_combine_op semantics)
+        order = [v.name for v in pruned.persistable_vars()
+                 if v.name in arrays]
+        fluid_proto.save_fluid_params(dirname, arrays,
+                                      filename=params_filename,
+                                      order=order)
+        return fetch_names
     desc = pruned.to_desc()
     desc["feed_names"] = list(feeded_var_names)
     desc["fetch_names"] = fetch_names
@@ -122,15 +154,48 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
-    """Returns (program, feed_names, fetch_vars) like the reference."""
-    with open(os.path.join(dirname, model_filename or DESC_FILE)) as f:
-        desc = json.load(f)
+    """Returns (program, feed_names, fetch_vars) like the reference.
+
+    Accepts BOTH model layouts: the native JSON desc + params.npz, and
+    a directory saved by real Fluid (binary protobuf `__model__` +
+    LoDTensor-stream param files / combined file — core/fluid_proto.py),
+    auto-detected from what's on disk."""
+    path = os.path.join(dirname, model_filename or DESC_FILE)
+    if model_filename is None and not os.path.exists(path) \
+            and os.path.exists(os.path.join(dirname, "__model__")):
+        path = os.path.join(dirname, "__model__")
+    with open(path, "rb") as f:
+        raw = f.read()
+    # NO lstrip: a ProgramDesc blob starts with tag 0x0A, which
+    # bytes.lstrip() would eat as whitespace; json.dump output starts
+    # with '{' at byte 0
+    if raw[:1] != b"{":
+        return _load_fluid_inference_model(dirname, raw, params_filename)
+    desc = json.loads(raw.decode("utf-8"))
     program = Program.from_desc(desc)
     program._is_test = True
     load_params(executor, dirname, program, filename=params_filename)
     block = program.global_block()
     fetch_vars = [block.var(n) for n in desc["fetch_names"]]
     return program, desc["feed_names"], fetch_vars
+
+
+def _load_fluid_inference_model(dirname, blob, params_filename):
+    """Load a reference-format (binary ProgramDesc) model directory."""
+    from .core import fluid_proto
+    program, feed_names, fetch_names = fluid_proto.program_from_fluid(blob)
+    program._is_test = True
+    # load_combine order = the program's persistable var order (the
+    # reference's load_vars iterates list_vars() the same way)
+    names = [v.name for v in program.persistable_vars()]
+    arrays = fluid_proto.load_fluid_params(dirname, names,
+                                           filename=params_filename)
+    scope = global_scope()
+    for name, arr in arrays.items():
+        scope.set(name, arr)
+    block = program.global_block()
+    fetch_vars = [block.var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,145 @@
+"""Generate tests/fixtures/fluid_fc_model — a model directory in the
+reference's on-disk inference-model format (binary protobuf `__model__`
++ one LoDTensor-stream file per parameter), as real Fluid's
+save_inference_model would lay it out
+(/root/reference/python/paddle/fluid/io.py, framework.proto,
+lod_tensor.cc:245).
+
+Deliberately does NOT use paddle_tpu.core.fluid_proto: the ProgramDesc
+bytes come from the OFFICIAL protobuf runtime (protoc-compiled
+framework.proto) and the tensor streams from explicit struct packing,
+so the fixture is an independent witness the interop code is tested
+against, not a product of it.
+
+Usage: python tools/make_fluid_fixture.py
+"""
+import os
+import struct
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROTO = "/root/reference/paddle/fluid/framework/framework.proto"
+OUT = os.path.join(REPO, "tests", "fixtures", "fluid_fc_model")
+
+
+def compile_proto(tmp):
+    import shutil
+    shutil.copy(PROTO, os.path.join(tmp, "framework.proto"))
+    subprocess.run(["protoc", f"--python_out={tmp}", f"-I{tmp}",
+                    os.path.join(tmp, "framework.proto")], check=True)
+    sys.path.insert(0, tmp)
+    import framework_pb2
+    return framework_pb2
+
+
+def write_ref_lod_tensor(path, arr):
+    """tensor_util.cc TensorToStream layout, packed by hand."""
+    arr = np.ascontiguousarray(arr)
+    dt = {"float32": 5, "float64": 6, "int64": 3, "int32": 2}[str(arr.dtype)]
+    # TensorDesc proto by hand: field1 varint data_type, field2 dims
+    desc = bytes([0x08, dt])
+    for d in arr.shape:
+        desc += bytes([0x10]) + _varint(d)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<I", 0))   # LoDTensor version
+        f.write(struct.pack("<Q", 0))   # lod_level = 0
+        f.write(struct.pack("<I", 0))   # Tensor version
+        f.write(struct.pack("<i", len(desc)))
+        f.write(desc)
+        f.write(arr.tobytes())
+
+
+def _varint(val):
+    if val < 0:
+        val += 1 << 64
+    out = bytearray()
+    while True:
+        b = val & 0x7F
+        val >>= 7
+        if val:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def main():
+    tmp = tempfile.mkdtemp()
+    fp = compile_proto(tmp)
+    d = fp.ProgramDesc()
+    b = d.blocks.add()
+    b.idx, b.parent_idx = 0, -1
+
+    def lod_var(name, dims, persistable=False, dtype=fp.VarType.FP32):
+        v = b.vars.add()
+        v.name = name
+        v.type.type = fp.VarType.LOD_TENSOR
+        v.type.lod_tensor.tensor.data_type = dtype
+        v.type.lod_tensor.tensor.dims.extend(dims)
+        v.persistable = persistable
+        return v
+
+    feed = b.vars.add()
+    feed.name = "feed"
+    feed.type.type = fp.VarType.FEED_MINIBATCH
+    feed.persistable = True
+    fetch = b.vars.add()
+    fetch.name = "fetch"
+    fetch.type.type = fp.VarType.FETCH_LIST
+    fetch.persistable = True
+    lod_var("img", [-1, 784])
+    lod_var("fc_0.w_0", [784, 10], persistable=True)
+    lod_var("fc_0.b_0", [10], persistable=True)
+    lod_var("fc_0.tmp_0", [-1, 10])
+    lod_var("fc_0.tmp_1", [-1, 10])
+    lod_var("prob", [-1, 10])
+
+    def op(type_, inputs, outputs, attrs=()):
+        o = b.ops.add()
+        o.type = type_
+        for p, args in inputs:
+            iv = o.inputs.add()
+            iv.parameter = p
+            iv.arguments.extend(args)
+        for p, args in outputs:
+            ov = o.outputs.add()
+            ov.parameter = p
+            ov.arguments.extend(args)
+        for name, atype, val in attrs:
+            a = o.attrs.add()
+            a.name, a.type = name, atype
+            if atype == fp.INT:
+                a.i = val
+            elif atype == fp.FLOAT:
+                a.f = val
+        return o
+
+    op("feed", [("X", ["feed"])], [("Out", ["img"])],
+       [("col", fp.INT, 0)])
+    op("mul", [("X", ["img"]), ("Y", ["fc_0.w_0"])],
+       [("Out", ["fc_0.tmp_0"])],
+       [("x_num_col_dims", fp.INT, 1), ("y_num_col_dims", fp.INT, 1)])
+    op("elementwise_add", [("X", ["fc_0.tmp_0"]), ("Y", ["fc_0.b_0"])],
+       [("Out", ["fc_0.tmp_1"])], [("axis", fp.INT, 1)])
+    op("softmax", [("X", ["fc_0.tmp_1"])], [("Out", ["prob"])])
+    op("fetch", [("X", ["prob"])], [("Out", ["fetch"])],
+       [("col", fp.INT, 0)])
+    d.version.version = 0
+
+    os.makedirs(OUT, exist_ok=True)
+    with open(os.path.join(OUT, "__model__"), "wb") as f:
+        f.write(d.SerializeToString())
+    rng = np.random.RandomState(7)
+    write_ref_lod_tensor(os.path.join(OUT, "fc_0.w_0"),
+                         rng.randn(784, 10).astype("float32") * 0.05)
+    write_ref_lod_tensor(os.path.join(OUT, "fc_0.b_0"),
+                         rng.randn(10).astype("float32") * 0.05)
+    print("fixture written to", OUT)
+
+
+if __name__ == "__main__":
+    main()
