@@ -91,24 +91,51 @@ def _conv_shift(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 def _pool_with_index(x, ks, strides, pads):
     """Max pool returning (values, flat argmax index within each image's
-    H*W plane) — ref max_pool_with_index_op; indices feed unpool."""
-    spatial = x.ndim - 2
+    spatial plane) — ref max_pool_with_index_op; indices feed unpool.
+
+    Implemented as a stack of strided SLICES (one per window offset,
+    prod(ks) of them) + max/argmax over the offset axis, NOT as a
+    pair-carrying lax.reduce_window with a custom combiner: that
+    variadic form has no JAX linearization rule, so any program that
+    trains through this op (the mask is among the traced outputs even
+    when unused by the loss) failed to differentiate. Slices and max
+    are plain differentiable primitives; the integer mask comes from a
+    precomputed geometric index grid, outside the gradient path."""
+    import itertools
+    nd = len(ks)
     dims = x.shape[2:]
-    flat_idx = jnp.arange(int(np.prod(dims)), dtype=jnp.int32).reshape(dims)
-    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
-    window = (1, 1) + tuple(ks)
-    strd = (1, 1) + tuple(strides)
-    pad = [(0, 0), (0, 0)] + [(p, p) for p in pads]
-
-    def select(a, b):
-        av, ai = a
-        bv, bi = b
-        take_b = bv > av
-        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
-
-    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1, jnp.int32))
-    vals, idxs = jax.lax.reduce_window(
-        (x, flat_idx), init, select, window, strd, pad)
+    out_dims = [(dims[i] + 2 * pads[i] - ks[i]) // strides[i] + 1
+                for i in range(nd)]
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    xp = jnp.pad(x, pad_cfg, constant_values=-jnp.inf)
+    grids = np.meshgrid(*[np.arange(o) * s
+                          for o, s in zip(out_dims, strides)],
+                        indexing="ij")
+    patches, idx_planes = [], []
+    for off in itertools.product(*[range(k) for k in ks]):
+        sl = [slice(None), slice(None)]
+        pos = []
+        for i in range(nd):
+            start = off[i]
+            stop = start + (out_dims[i] - 1) * strides[i] + 1
+            sl.append(slice(start, stop, strides[i]))
+            pos.append(grids[i] + off[i] - pads[i])
+        patches.append(xp[tuple(sl)])
+        flat = pos[0]
+        valid = (pos[0] >= 0) & (pos[0] < dims[0])
+        for i in range(1, nd):
+            flat = flat * dims[i] + pos[i]
+            valid &= (pos[i] >= 0) & (pos[i] < dims[i])
+        # padded (out-of-bounds) offsets hold -inf so they never win
+        idx_planes.append(np.where(valid, flat, -1).astype(np.int32))
+    stack = jnp.stack(patches)                       # [K, B, C, *out]
+    vals = jnp.max(stack, axis=0)
+    k_star = jnp.argmax(stack, axis=0)               # [B, C, *out]
+    idx_grid = jnp.asarray(np.stack(idx_planes))     # [K, *out]
+    idx_b = jnp.broadcast_to(
+        idx_grid[(slice(None), None, None) + (slice(None),) * nd],
+        stack.shape)
+    idxs = jnp.take_along_axis(idx_b, k_star[None], axis=0)[0]
     return vals, idxs
 
 
