@@ -98,6 +98,7 @@ from .async_executor import AsyncExecutor
 from .data_feed_desc import DataFeedDesc
 from . import default_scope_funcs
 from . import distribute_lookup_table
+from . import distributed
 from . import net_drawer
 from . import op
 from .core import EOFException

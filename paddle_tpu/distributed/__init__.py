@@ -1,0 +1,30 @@
+"""fluid.distributed parity package (downpour async-pserver surface).
+
+Parity: python/paddle/fluid/distributed/__init__.py (+ downpour.py,
+node.py, helper.py, ps_instance.py in the same directory of the
+reference). The reference implements Google-style Downpour SGD over an
+MPI gang of brpc parameter-server processes; the TPU-native mapping
+collapses the whole pserver tier into device memory:
+
+  - the big sparse table lives ROW-SHARDED across chips (the
+    transpiler's distributed-lookup-table rule — parallel/transpiler.py
+    — using XLA's SPMD gather/scatter over ICI instead of the pserver
+    prefetch RPC);
+  - dense parameters ride the data-parallel all-reduce;
+  - the MPI process gang maps onto the jax.distributed process model
+    (every process is a worker; there are no separate server
+    processes).
+
+The classes below keep the reference call shapes so a downpour script
+ports by changing imports only; where semantics genuinely cannot map
+(brpc service knobs, hadoop FS client auth) the method says so in its
+docstring and raises with the replacement's name rather than silently
+doing nothing.
+"""
+from .downpour import DownpourSGD
+from .helper import FileSystem, MPIHelper
+from .node import DownpourServer, DownpourWorker, Server, Worker
+from .ps_instance import PaddlePSInstance
+
+__all__ = ["DownpourSGD", "PaddlePSInstance", "MPIHelper", "FileSystem",
+           "Server", "Worker", "DownpourServer", "DownpourWorker"]

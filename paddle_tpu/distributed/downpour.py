@@ -1,0 +1,83 @@
+"""DownpourSGD (ref distributed/downpour.py).
+
+The reference's minimize() appends backward ops, locates the single
+distributed lookup table, and emits a ps_pb2 PSParameter configuring
+sparse/dense pserver tables; workers then skip lookup_table ops locally
+and prefetch rows over brpc.
+
+TPU-native: the same call produces the same (params_grads, table
+discovery, desc) bookkeeping, but the execution plan is in-graph — the
+sparse table row-shards across the mesh (transpiler rule), its grads
+update via the row-sparse scatter path (sparse_adam/sparse_sgd
+kernels), and dense grads all-reduce over dp. No op is skipped: there
+is no worker/server split to skip FOR, which is why worker_skipped_ops
+is returned EMPTY (a deliberate, documented divergence — honoring the
+reference's ["lookup_table", "lookup_table_grad"] here would drop the
+embedding update from the compiled step).
+"""
+from ..core.backward import append_backward
+from ..distribute_lookup_table import (
+    find_distributed_lookup_table,
+    find_distributed_lookup_table_inputs,
+    find_distributed_lookup_table_outputs,
+)
+from .node import DownpourServer, DownpourWorker
+
+
+class DownpourSGD:
+    """ref downpour.py:DownpourSGD — distributed downpour optimizer.
+
+    Example:
+        downpour = fluid.distributed.DownpourSGD(learning_rate=0.2)
+        ps_param, skipped = downpour.minimize(cost)
+    """
+
+    def __init__(self, learning_rate=0.001, window=1):
+        self.learning_rate_ = learning_rate
+        self.window_ = window
+        self.type = "downpour"
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """Append backward + sgd update ops and return
+        [ps_param_desc, worker_skipped_ops] like the reference
+        (downpour.py:minimize). ps_param is a plain-dict desc (see
+        node.py for why it is not a ps_pb2 protobuf)."""
+        from .. import optimizer as opt
+        program = loss.block.program
+        params_grads = sorted(
+            append_backward(loss, parameter_list, no_grad_set),
+            key=lambda x: x[0].name)
+        table_name = find_distributed_lookup_table(program)
+        if table_name is not None:
+            prefetch_slots = find_distributed_lookup_table_inputs(
+                program, table_name)
+            prefetch_slots_emb = find_distributed_lookup_table_outputs(
+                program, table_name)
+        else:
+            prefetch_slots, prefetch_slots_emb = [], []
+
+        server = DownpourServer()
+        worker = DownpourWorker(self.window_)
+        sparse_table_index, dense_table_index = 0, 1
+        params = [p for p, _ in params_grads]
+        grads = [g for _, g in params_grads]
+        server.add_sparse_table(sparse_table_index, self.learning_rate_,
+                                prefetch_slots, prefetch_slots_emb)
+        server.add_dense_table(dense_table_index, self.learning_rate_,
+                               params, grads)
+        worker.add_sparse_table(sparse_table_index, self.learning_rate_,
+                                prefetch_slots, prefetch_slots_emb)
+        worker.add_dense_table(dense_table_index, self.learning_rate_,
+                               params, grads)
+
+        # the actual update plan: plain SGD over the collected
+        # (param, grad) pairs — the row-sparse table rides the
+        # sparse_sgd scatter path via the optimizer's lazy-row handling
+        opt.SGD(self.learning_rate_).apply_gradients(params_grads)
+
+        ps_param = {"server_param": server.get_desc(),
+                    "trainer_param": worker.get_desc()}
+        worker_skipped_ops = []  # see module docstring
+        ps_param["trainer_param"]["skip_op"] = worker_skipped_ops
+        return [ps_param, worker_skipped_ops]

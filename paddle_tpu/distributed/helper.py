@@ -1,0 +1,51 @@
+"""Process-gang helpers (ref distributed/helper.py).
+
+The reference wraps mpi4py's COMM_WORLD; here the gang is the
+jax.distributed process model (jax.process_index/process_count) — the
+same model the multi-host tests drive with two OS processes. Single
+process (no jax.distributed.initialize) degrades to rank 0 of 1.
+"""
+
+
+class MPIHelper:
+    """ref distributed/helper.py:MPIHelper — rank/size/ip/hostname of
+    this process in the gang. `comm` collective splitting has no analog
+    (XLA collectives are compiled into the program, not issued on a
+    communicator), so there is no `.comm` attribute."""
+
+    def get_rank(self):
+        import jax
+        return jax.process_index()
+
+    def get_size(self):
+        import jax
+        return jax.process_count()
+
+    def get_ip(self):
+        import socket
+        return socket.gethostbyname(socket.gethostname())
+
+    def get_hostname(self):
+        import socket
+        return socket.gethostname()
+
+    def finalize(self):
+        """MPI_Finalize analog: nothing to tear down — the XLA runtime
+        owns the gang's lifetime."""
+
+
+class FileSystem:
+    """ref distributed/helper.py:FileSystem — hadoop/afs client desc for
+    the async executor. Stored as a plain dict desc; the data path that
+    consumes it here is reader.PipeReader('hadoop fs -cat ...')."""
+
+    def __init__(self, fs_type="afs", uri="afs://xx", user=None,
+                 passwd=None, hadoop_bin=""):
+        if user is None or passwd is None or hadoop_bin is None:
+            raise ValueError("user/passwd/hadoop_bin are required "
+                             "(ref helper.py asserts the same)")
+        self.fs_client = {"fs_type": fs_type, "uri": uri, "user": user,
+                          "passwd": passwd, "hadoop_bin": hadoop_bin}
+
+    def get_desc(self):
+        return self.fs_client

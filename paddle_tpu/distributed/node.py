@@ -1,0 +1,93 @@
+"""Server/worker table descs (ref distributed/node.py).
+
+The reference builds ps_pb2 protobuf descs naming brpc service classes
+("DownpourBrpcPsServer", "DownpourFeatureValueAccessor", ...). Those
+wire formats configure a server tier that does not exist on TPU — the
+tables live sharded in HBM — so the descs here are plain dicts carrying
+the SAME information content (table ids, learning rates, slot/param
+variable names) for program construction and debugging, and
+`table_class` records the TPU placement that replaces the brpc class.
+"""
+
+
+class Server:
+    """Base server desc (ref node.py:Server)."""
+
+    def __init__(self):
+        self._desc = {"service": "xla-spmd (no server processes: "
+                                 "tables are sharded device state)",
+                      "downpour_server_param": {"downpour_table_param": []}}
+
+
+class Worker:
+    """Base worker desc (ref node.py:Worker)."""
+
+    def __init__(self):
+        self._desc = {"downpour_table_param": [], "skip_op": []}
+
+
+def _names(vars_):
+    return [v.name if hasattr(v, "name") else str(v) for v in vars_]
+
+
+class DownpourServer(Server):
+    """ref node.py:DownpourServer — accumulates table descs."""
+
+    def add_sparse_table(self, table_id, learning_rate, slot_key_vars,
+                         slot_value_vars):
+        self._desc["downpour_server_param"]["downpour_table_param"].append({
+            "table_id": table_id,
+            "table_class": "row-sharded HBM table (transpiler "
+                           "distributed-lookup-table rule)",
+            "type": "sparse",
+            "learning_rate": learning_rate,
+            "slot_key_vars": _names(slot_key_vars),
+            "slot_value_vars": _names(slot_value_vars),
+        })
+
+    def add_dense_table(self, table_id, learning_rate, param_vars,
+                        grad_vars):
+        self._desc["downpour_server_param"]["downpour_table_param"].append({
+            "table_id": table_id,
+            "table_class": "replicated params + dp all-reduce grads",
+            "type": "dense",
+            "learning_rate": learning_rate,
+            "param_vars": _names(param_vars),
+            "grad_vars": _names(grad_vars),
+        })
+
+    def get_desc(self):
+        return self._desc
+
+
+class DownpourWorker(Worker):
+    """ref node.py:DownpourWorker(window) — window is the reference's
+    async communication interval; on TPU the "push" is the in-graph
+    sparse/dense update applied every step, so window is recorded for
+    desc parity but steps are always synchronous."""
+
+    def __init__(self, window):
+        super().__init__()
+        self.window = window
+        self._desc["window"] = window
+
+    def add_sparse_table(self, table_id, learning_rate, slot_key_vars,
+                         slot_value_vars):
+        self._desc["downpour_table_param"].append({
+            "table_id": table_id, "type": "sparse",
+            "learning_rate": learning_rate,
+            "slot_key_vars": _names(slot_key_vars),
+            "slot_value_vars": _names(slot_value_vars),
+        })
+
+    def add_dense_table(self, table_id, learning_rate, param_vars,
+                        grad_vars):
+        self._desc["downpour_table_param"].append({
+            "table_id": table_id, "type": "dense",
+            "learning_rate": learning_rate,
+            "param_vars": _names(param_vars),
+            "grad_vars": _names(grad_vars),
+        })
+
+    def get_desc(self):
+        return self._desc

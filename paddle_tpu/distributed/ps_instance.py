@@ -1,0 +1,67 @@
+"""PaddlePSInstance (ref distributed/ps_instance.py).
+
+The reference splits an MPI gang into interleaved worker and server
+ranks (server_worker_mode 0/1) and gives each side its own
+communicator. On TPU there IS no server role: every process is a
+worker and the "servers" are HBM shards of the same gang, so the
+instance reports every rank as a worker, worker_index == process rank,
+and the barrier methods hit the gang-wide barrier. The constructor
+keeps the reference's (server_worker_mode, proc_per_node) signature so
+launch scripts port unchanged; the mode only affects the bookkeeping
+numbers it reports, never process roles.
+"""
+from .helper import MPIHelper
+
+
+class PaddlePSInstance:
+    def __init__(self, server_worker_mode=1, proc_per_node=2):
+        self.dh = MPIHelper()
+        self._rankid = self.dh.get_rank()
+        self._server_worker_mode = server_worker_mode
+        self._proc_per_node = proc_per_node
+        self._nodes = self.dh.get_size()
+        self._ip = self.dh.get_ip()
+        # reference arithmetic, reported for parity/debugging only
+        self._worker_num = self._nodes * self._proc_per_node // 2
+        self._server_num = self._nodes * self._proc_per_node // 2
+        self._total_server_worker = self._worker_num + self._server_num
+
+    # -- roles: every process is a worker (see module docstring) -------
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._rankid == 0
+
+    def get_worker_index(self):
+        return self._rankid
+
+    def get_server_index(self):
+        return self._rankid
+
+    def get_node_cnt(self):
+        return self._nodes
+
+    def set_ip(self, ip):
+        self._ip = ip
+
+    def gather_ips(self):
+        """All processes' ips. The reference allgathers over MPI; here
+        the gang is the jax.distributed process set, and only the local
+        ip is known without a collective — multi-host discovery is the
+        launcher's job (fleet.init), so return the local ip per rank."""
+        self._ips = [self._ip] * self._nodes
+        return self._ips
+
+    def barrier_all(self):
+        from ..parallel import fleet
+        fleet.barrier_all()
+
+    def barrier_worker(self):
+        self.barrier_all()
+
+    def finalize(self):
+        self.dh.finalize()

@@ -1,0 +1,113 @@
+"""fluid.distributed parity (VERDICT r4 #5): the downpour/pserver API
+surface exists, is mechanically swept against the reference so it can't
+silently regress, and the DownpourSGD path actually trains.
+"""
+import ast
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+REF_DIR = "/root/reference/python/paddle/fluid/distributed"
+
+# reference modules swept class-by-class; ps_pb2 is protoc-generated
+# brpc wire format for the pserver tier that does not exist on TPU
+# (node.py docstring records the replacement), so it is excluded.
+SWEPT = ["downpour.py", "node.py", "helper.py", "ps_instance.py"]
+EXCLUDED_METHODS = {
+    # reference-internal helpers of the MPI split that have no meaning
+    # without server ranks (module docstrings carry the why)
+    ("ps_instance", "_set_nodetype"), ("ps_instance", "_split_comm"),
+}
+
+
+def _ref_classes(path):
+    """{class_name: {public methods}} for top-level classes of a file."""
+    tree = ast.parse(open(path).read())
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            methods = {n.name for n in node.body
+                       if isinstance(n, ast.FunctionDef)
+                       and not n.name.startswith("_")}
+            out[node.name] = methods
+    return out
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DIR),
+                    reason="reference tree unavailable")
+def test_distributed_surface_sweep():
+    import paddle_tpu.distributed as dist
+    missing = []
+    for fname in SWEPT:
+        for cls, methods in _ref_classes(os.path.join(REF_DIR,
+                                                      fname)).items():
+            if not hasattr(dist, cls):
+                missing.append(f"{fname}:{cls}")
+                continue
+            have = set(dir(getattr(dist, cls)))
+            mod = fname[:-3]
+            for m in methods:
+                if (mod, m) in EXCLUDED_METHODS:
+                    continue
+                if m not in have:
+                    missing.append(f"{fname}:{cls}.{m}")
+    assert not missing, f"distributed surface gaps: {missing}"
+
+
+def test_downpour_sgd_trains_sparse_model():
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1])
+    emb = layers.embedding(ids, size=[50, 8], is_sparse=True,
+                           is_distributed=True)
+    pred = layers.fc(layers.reshape(emb, [-1, 8]), 1)
+    loss = layers.mean(layers.square_error_cost(pred, label))
+
+    downpour = pt.distributed.DownpourSGD(learning_rate=0.1, window=1)
+    ps_param, skipped = downpour.minimize(loss)
+
+    # desc parity: sparse table 0 names the embedding's slots, dense
+    # table 1 carries every (param, grad) pair; no skipped ops on TPU
+    tables = ps_param["server_param"]["downpour_server_param"][
+        "downpour_table_param"]
+    assert tables[0]["type"] == "sparse"
+    assert tables[0]["slot_key_vars"] == ["ids"]
+    assert tables[1]["type"] == "dense" and tables[1]["param_vars"]
+    assert skipped == []
+    assert ps_param["trainer_param"]["window"] == 1
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 50, (32, 1)).astype("int64")
+    y = (x % 5).astype("float32")
+    losses = [float(np.asarray(exe.run(
+        feed={"ids": x, "label": y}, fetch_list=[loss])[0]))
+        for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_ps_instance_single_process():
+    inst = pt.distributed.PaddlePSInstance(server_worker_mode=1,
+                                           proc_per_node=2)
+    assert inst.is_worker() and not inst.is_server()
+    assert inst.is_first_worker()
+    assert inst.get_worker_index() == 0
+    assert inst.get_node_cnt() >= 1
+    assert inst.gather_ips()
+    inst.barrier_all()
+    inst.finalize()
+
+
+def test_mpi_helper_and_filesystem():
+    mh = pt.distributed.MPIHelper()
+    assert mh.get_rank() == 0 and mh.get_size() >= 1
+    assert mh.get_ip() and mh.get_hostname()
+    with pytest.raises(ValueError):
+        pt.distributed.FileSystem(user=None, passwd="x")
+    fs = pt.distributed.FileSystem(user="u", passwd="p",
+                                   hadoop_bin="/bin/hadoop")
+    assert fs.get_desc()["uri"].startswith("afs")
