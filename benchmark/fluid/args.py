@@ -27,6 +27,10 @@ def parse_args():
                         choices=["CPU", "GPU", "TPU"])
     parser.add_argument("--data_set", type=str, default="cifar10",
                         choices=["cifar10", "flowers", "imagenet"])
+    parser.add_argument("--data_dir", type=str, default=None,
+                        help="real dataset root (imagenet layout: "
+                             "train/ train.txt val/ val.txt); default "
+                             "synthetic feeds")
     parser.add_argument("--infer_only", action="store_true")
     parser.add_argument("--use_bf16", action="store_true",
                         help="bf16 AMP (replaces the reference's fp16)")

@@ -60,11 +60,32 @@ def main():
     total = args.skip_batch_num + args.iterations
     losses, t0 = [], None
     prog = main_p.clone(for_test=True) if args.infer_only else main_p
+    next_feed = lambda: feed_fn(args.batch_size, rng)
+    if args.data_set == "imagenet" and args.data_dir:
+        # real-data path: stream + preprocess through the threaded
+        # imagenet reader instead of synthetic feeds
+        import imagenet_reader
+        from paddle_tpu.reader import batch as batch_reader
+        _batched = batch_reader(imagenet_reader.train(args.data_dir),
+                                batch_size=args.batch_size)
+        _stream = [_batched()]
+
+        def next_feed():
+            # cycle the reader across epochs — a benchmark run is
+            # allowed to outlast one pass over the data
+            try:
+                samples = next(_stream[0])
+            except StopIteration:
+                _stream[0] = _batched()
+                samples = next(_stream[0])
+            imgs, labels = zip(*samples)
+            return {"data": np.stack(imgs).astype("float32"),
+                    "label": np.asarray(labels).reshape(-1, 1)}
     for p in range(args.pass_num):
         for it in range(total):
             if it == args.skip_batch_num:
                 t0 = time.perf_counter()
-            out = exe.run(prog, feed=feed_fn(args.batch_size, rng),
+            out = exe.run(prog, feed=next_feed(),
                           fetch_list=[loss])
             losses.append(float(np.asarray(out[0])))
         dt = time.perf_counter() - t0

@@ -1,0 +1,113 @@
+"""ImageNet reader for the benchmark suite.
+
+Parity: benchmark/fluid/imagenet_reader.py — file-list driven train/val
+readers with resize-short(256) → 224 crop (random+flip for train,
+center for val) → CHW float32 → per-channel mean/std normalization, and
+a threaded preprocessing pipeline (the reference uses a hand-rolled
+Queue+thread pool; here reader.xmap_readers provides the same shape).
+
+Layout expected under --data_dir (same as the reference):
+    train/ train.txt val/ val.txt     ("<relpath> <label>" per line)
+
+Offline stand-in: when the directory is absent or lists are missing,
+`train`/`val` fall back to a deterministic synthetic stream with the
+exact output spec ([3,224,224] float32 normalized + int label) so the
+benchmark CLI stays runnable end-to-end — consistent with
+paddle_tpu/dataset's documented synthetic policy.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))  # repo root
+
+from paddle_tpu.dataset import image
+from paddle_tpu.reader import xmap_readers
+
+DATA_DIM = 224
+RESIZE_DIM = 256
+THREAD = int(os.getenv("PREPROCESS_THREADS", "10"))
+BUF_SIZE = 1024
+
+img_mean = np.array([0.485, 0.456, 0.406], "float32")
+img_std = np.array([0.229, 0.224, 0.225], "float32")
+
+
+def _normalize(chw):
+    chw = chw / 255.0
+    chw -= img_mean[:, None, None]
+    chw /= img_std[:, None, None]
+    return chw
+
+
+def _mapper(is_train):
+    def process(sample):
+        path, label = sample
+        im = image.load_image(path)
+        im = image.simple_transform(im, RESIZE_DIM, DATA_DIM, is_train)
+        return _normalize(im), label
+    return process
+
+
+def _file_list(data_dir, list_name, sub_dir):
+    entries = []
+    with open(os.path.join(data_dir, list_name)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split()  # whitespace: tabs and spaces both
+            if len(parts) != 2:
+                raise ValueError(f"bad {list_name} line: {line!r}")
+            entries.append((os.path.join(data_dir, sub_dir, parts[0]),
+                            int(parts[1])))
+    return entries
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            im = rng.randint(0, 256, (3, DATA_DIM, DATA_DIM))
+            yield _normalize(im.astype("float32")), \
+                int(rng.randint(0, 1000))
+    return reader
+
+
+def _make(data_dir, list_name, sub_dir, is_train, shuffle, n_synth,
+          seed):
+    if data_dir is None \
+            or not os.path.exists(os.path.join(data_dir, list_name)):
+        return _synthetic(n_synth, seed)
+    entries = _file_list(data_dir, list_name, sub_dir)
+    if shuffle:
+        np.random.RandomState(0).shuffle(entries)
+
+    def raw_reader():
+        return iter(entries)
+
+    # eval keeps stream order (stable metrics pairing); train doesn't
+    # need it and unordered drains the pool faster
+    return xmap_readers(_mapper(is_train), raw_reader,
+                        process_num=THREAD, buffer_size=BUF_SIZE,
+                        order=not is_train)
+
+
+def train(data_dir=None, n_synthetic=256):
+    """[3,224,224] float32 normalized image + int label, shuffled,
+    random-crop + flip augmentation (ref imagenet_reader.py:train)."""
+    return _make(data_dir, "train.txt", "train", True, True,
+                 n_synthetic, seed=11)
+
+
+def val(data_dir=None, n_synthetic=64):
+    """Center-crop evaluation stream (ref imagenet_reader.py:val)."""
+    return _make(data_dir, "val.txt", "val", False, False,
+                 n_synthetic, seed=13)
+
+
+# reference aliases (recordio_converter.py imports these names)
+imagenet_train = train
+imagenet_test = val

@@ -1,0 +1,90 @@
+"""Dataset → RecordIO converter for the benchmark suite.
+
+Parity: benchmark/fluid/recordio_converter.py — prepare_mnist /
+prepare_cifar10 / prepare_flowers batch a dataset reader through a
+DataFeeder and write `.recordio` shards the benchmark's reader-op path
+(and the native sharded C++ reader, native/recordio_multi.cc) can
+stream. Same flow here over the repo's own pieces: dataset readers →
+paddle_tpu.batch → DataFeeder → recordio_writer.
+
+CLI:
+  python recordio_converter.py --dataset mnist --out /tmp/rio \
+      --batch_size 32 [--batch_per_file 64]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))  # repo root
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.dataset import cifar, flowers, mnist
+from paddle_tpu.reader import batch as batch_reader
+from paddle_tpu.recordio_writer import (
+    convert_reader_to_recordio_file, convert_reader_to_recordio_files)
+
+
+def convert_2_recordio(py_reader, outfilepath, batch_size, shape_data,
+                       shape_label, batch_per_file=None):
+    """ref recordio_converter.py:convert_2_recordio — returns the
+    number of records (batches) written."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        reader = batch_reader(py_reader(), batch_size=batch_size)
+        feeder = fluid.DataFeeder(
+            feed_list=[
+                layers.data(name="image", shape=shape_data),
+                layers.data(name="label", shape=shape_label,
+                            dtype="int64"),
+            ],
+            place=fluid.CPUPlace())
+        feed_reader = lambda: map(feeder.feed, reader())
+        if batch_per_file:
+            paths = convert_reader_to_recordio_files(
+                outfilepath, batch_per_file, feed_reader, feeder)
+            return len(paths)
+        return convert_reader_to_recordio_file(outfilepath, feed_reader,
+                                               feeder)
+
+
+def prepare_mnist(outpath, batch_size, **kw):
+    out = os.path.join(outpath, "mnist.recordio")
+    return convert_2_recordio(mnist.train, out, batch_size, [784], [1],
+                              **kw)
+
+
+def prepare_cifar10(outpath, batch_size, **kw):
+    out = os.path.join(outpath, "cifar.recordio")
+    return convert_2_recordio(cifar.train10, out, batch_size,
+                              [3, 32, 32], [1], **kw)
+
+
+def prepare_flowers(outpath, batch_size, **kw):
+    out = os.path.join(outpath, "flowers.recordio")
+    return convert_2_recordio(flowers.train, out, batch_size,
+                              [3, 224, 224], [1], **kw)
+
+
+PREPARE = {"mnist": prepare_mnist, "cifar10": prepare_cifar10,
+           "flowers": prepare_flowers}
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dataset", choices=sorted(PREPARE), default="mnist")
+    p.add_argument("--out", required=True)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--batch_per_file", type=int, default=None,
+                   help="shard into files of N batches (sharded "
+                        "multithreaded reader input)")
+    args = p.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    n = PREPARE[args.dataset](args.out, args.batch_size,
+                              batch_per_file=args.batch_per_file)
+    print(f"wrote {n} {'files' if args.batch_per_file else 'records'} "
+          f"to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
