@@ -168,7 +168,65 @@ class InferenceEngine:
                        "dtypes": {k: str(v.dtype) for k, v in feed.items()},
                        "param_dtypes": param_dtypes,
                        "fetches": self.fetch_names}, f)
+        try:
+            self._save_native_artifact(dirname, feed, step)
+        except Exception as e:  # pragma: no cover - version drift guard
+            # the native artifact rides private jax internals for the
+            # CompileOptions proto; its failure must never take down
+            # the primary (module.stablehlo + params) artifact
+            import warnings
+            warnings.warn(f"native artifact not written: {e!r}")
         return dirname
+
+    def _save_native_artifact(self, dirname, feed, step):
+        """The NATIVE deployment artifact (consumed by the C predictor,
+        native/predictor.cc — the analog of the reference's C++
+        inference API, paddle/fluid/inference/api/analysis_predictor.h):
+
+        - module.mlir: textual StableHLO of the inference function with
+          the parameters baked in as CONSTANTS, so the module's only
+          arguments are the feeds (sorted by name) and its results are
+          the fetches (fetch_names order) — no param plumbing in C;
+        - native_manifest.txt: line-based io spec (no JSON parser
+          needed in C);
+        - compile_options.pb: serialized CompileOptionsProto for
+          PJRT_Client_Compile, written here where the XLA python is
+          available so the C side stays proto-free.
+        """
+        import os
+        from jax._src import compiler as jcompiler
+        persist_const = {k: np.asarray(v) for k, v in self._persist.items()}
+        feed_names = sorted(feed)
+
+        def flat_infer(*args):
+            # step returns fetches already ordered by fetch_names
+            fetches, _ = step(persist_const, dict(zip(feed_names, args)),
+                              jax.random.PRNGKey(0))
+            return tuple(fetches)
+
+        args = [feed[n] for n in feed_names]
+        lowered = jax.jit(flat_infer).lower(*args)
+        with open(os.path.join(dirname, "module.mlir"), "w") as f:
+            f.write(str(lowered.compiler_ir(dialect="stablehlo")))
+        try:  # the lowering already knows its output avals
+            out_shapes = [o.aval for o in lowered.out_info]
+        except Exception:
+            out_shapes = jax.eval_shape(flat_infer, *args)
+        lines = ["format ptpu-native-v1", f"inputs {len(feed_names)}"]
+        for n in feed_names:
+            a = feed[n]
+            lines.append(f"{n} {a.dtype} {a.ndim} "
+                         + " ".join(str(d) for d in a.shape))
+        lines.append(f"outputs {len(self.fetch_names)}")
+        for n, s in zip(self.fetch_names, out_shapes):
+            lines.append(f"{n} {s.dtype} {len(s.shape)} "
+                         + " ".join(str(d) for d in s.shape))
+        with open(os.path.join(dirname, "native_manifest.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+        opts = jcompiler.get_compile_options(num_replicas=1,
+                                             num_partitions=1)
+        with open(os.path.join(dirname, "compile_options.pb"), "wb") as f:
+            f.write(opts.SerializeAsString())
 
     @staticmethod
     def load_compiled(dirname):

@@ -1,0 +1,120 @@
+// Standalone native inference demo — no Python in the process.
+//
+// The analog of the reference's C++ train/infer demos
+// (/root/reference/paddle/fluid/train/demo/demo_trainer.cc,
+// inference/api tests): load a save_compiled model dir, feed
+// deterministic inputs, print per-output checksums.
+//
+// Usage: ptpu_predict <model_dir> <pjrt_plugin.so> [--probe-only]
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+const char* ptpu_last_error();
+int ptpu_plugin_probe(const char*, int*, int*, int*);
+void* ptpu_predictor_load(const char*, const char*);
+int ptpu_predictor_num_inputs(void*);
+int ptpu_predictor_num_outputs(void*);
+long ptpu_predictor_output_bytes(void*, int);
+int ptpu_predictor_io_info(void*, int, int, int, char*, int, char*,
+                           int*, int64_t*);
+int ptpu_predictor_run(void*, const void**, void**);
+void ptpu_predictor_destroy(void*);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <model_dir> <pjrt_plugin.so> [--probe-only]\n",
+                 argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  const char* plugin = argv[2];
+  int major = -1, minor = -1, ndev = -1;
+  int prc = ptpu_plugin_probe(plugin, &major, &minor, &ndev);
+  std::printf("plugin %s: probe rc=%d api v%d.%d devices=%d\n", plugin,
+              prc, major, minor, ndev);
+  if (prc != 0) std::printf("probe detail: %s\n", ptpu_last_error());
+  if (argc > 3 && std::strcmp(argv[3], "--probe-only") == 0) {
+    return prc == -1 ? 1 : 0;
+  }
+
+  void* pred = ptpu_predictor_load(plugin, model_dir);
+  if (pred == nullptr) {
+    std::fprintf(stderr, "load failed: %s\n", ptpu_last_error());
+    return 1;
+  }
+  int ni = ptpu_predictor_num_inputs(pred);
+  int no = ptpu_predictor_num_outputs(pred);
+  std::printf("model: %d inputs, %d outputs\n", ni, no);
+
+  std::vector<std::vector<uint8_t>> in_store(ni), out_store(no);
+  std::vector<const void*> ins(ni);
+  std::vector<void*> outs(no);
+  for (int i = 0; i < ni; ++i) {
+    char name[128], dtype[32];
+    int rank = 0;
+    int64_t dims[16];
+    if (ptpu_predictor_io_info(pred, 1, i, sizeof(name), name,
+                               sizeof(dtype), dtype, &rank, dims)) {
+      std::fprintf(stderr, "io_info: %s\n", ptpu_last_error());
+      return 1;
+    }
+    size_t elems = 1;
+    for (int r = 0; r < rank; ++r) elems *= (size_t)dims[r];
+    std::printf("  input %s %s rank=%d elems=%zu\n", name, dtype, rank,
+                elems);
+    // deterministic pseudo-input: works for float32/int32/int64 demos
+    if (std::strcmp(dtype, "float32") == 0) {
+      in_store[i].resize(elems * 4);
+      float* p = reinterpret_cast<float*>(in_store[i].data());
+      for (size_t k = 0; k < elems; ++k)
+        p[k] = 0.01f * (float)((k * 37 + i * 11) % 100) - 0.5f;
+    } else if (std::strcmp(dtype, "int64") == 0) {
+      in_store[i].resize(elems * 8);
+      int64_t* p = reinterpret_cast<int64_t*>(in_store[i].data());
+      for (size_t k = 0; k < elems; ++k) p[k] = (int64_t)(k % 7);
+    } else if (std::strcmp(dtype, "int32") == 0) {
+      in_store[i].resize(elems * 4);
+      int32_t* p = reinterpret_cast<int32_t*>(in_store[i].data());
+      for (size_t k = 0; k < elems; ++k) p[k] = (int32_t)(k % 7);
+    } else {
+      std::fprintf(stderr, "demo cannot synthesize dtype %s\n", dtype);
+      return 1;
+    }
+    ins[i] = in_store[i].data();
+  }
+  for (int i = 0; i < no; ++i) {
+    long nb = ptpu_predictor_output_bytes(pred, i);
+    out_store[i].resize((size_t)nb);
+    outs[i] = out_store[i].data();
+  }
+  if (ptpu_predictor_run(pred, ins.data(), outs.data())) {
+    std::fprintf(stderr, "run failed: %s\n", ptpu_last_error());
+    ptpu_predictor_destroy(pred);
+    return 1;
+  }
+  for (int i = 0; i < no; ++i) {
+    char name[128], dtype[32];
+    int rank = 0;
+    int64_t dims[16];
+    ptpu_predictor_io_info(pred, 0, i, sizeof(name), name,
+                           sizeof(dtype), dtype, &rank, dims);
+    double sum = 0.0;
+    if (std::strcmp(dtype, "float32") == 0) {
+      const float* p = reinterpret_cast<const float*>(out_store[i].data());
+      for (size_t k = 0; k < out_store[i].size() / 4; ++k) sum += p[k];
+    }
+    std::printf("output %s %s bytes=%zu sum=%.6f\n", name, dtype,
+                out_store[i].size(), sum);
+  }
+  ptpu_predictor_destroy(pred);
+  std::printf("OK\n");
+  return 0;
+}

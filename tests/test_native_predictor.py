@@ -1,0 +1,122 @@
+"""Native PJRT predictor (VERDICT r4 #6): the C entry that loads a
+save_compiled artifact and runs it without Python
+(native/predictor.cc + ptpu_predict demo).
+
+What CAN be verified on this machine (no directly-attached chip, no
+CPU PJRT C-API plugin in the image): the artifact is complete and
+well-formed, the C library builds against the official pjrt_c_api.h,
+the plugin loads from C and reports its API version, NamedValue create
+options reach the plugin (the axon relay's error advances from
+"missing NamedValue args" to "requires session_id" when options are
+passed), and every failure surfaces as a clean message, never a crash.
+The full compile+execute path needs a live PJRT device: run
+`ptpu_predict <model_dir> <plugin>` on a TPU host (or set
+PTPU_NATIVE_RUN=1 with a working plugin) — the same binary, no code
+changes.
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.inference import InferenceEngine
+from paddle_tpu.native import predictor as npred
+
+NATIVE_DIR = os.path.dirname(os.path.abspath(npred.__file__))
+
+
+@pytest.fixture(scope="module")
+def built():
+    if npred.find_pjrt_include() is None:
+        pytest.skip("pjrt_c_api.h not available in this image")
+    if npred.lib() is None:
+        pytest.skip("toolchain unavailable to build libptpu_predictor")
+    return npred.lib()
+
+
+@pytest.fixture()
+def model_dir(tmp_path):
+    img = layers.data("img", shape=[8])
+    pred_v = layers.fc(layers.fc(img, 16, act="relu"), 4, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    eng = InferenceEngine(
+        pt.default_main_program(), feed_names=["img"],
+        fetch_vars=[pred_v], scope=pt.global_scope())
+    eng.save_compiled(str(tmp_path), {"img": (2, 8)})
+    return str(tmp_path)
+
+
+def test_artifact_is_complete(model_dir):
+    for f in ["module.mlir", "native_manifest.txt",
+              "compile_options.pb", "module.stablehlo", "params.npz"]:
+        assert os.path.exists(os.path.join(model_dir, f)), f
+    manifest = open(os.path.join(model_dir,
+                                 "native_manifest.txt")).read().split()
+    assert manifest[:2] == ["format", "ptpu-native-v1"]
+    i = manifest.index("inputs")
+    assert manifest[i + 1] == "1"
+    assert manifest[i + 2:i + 7] == ["img", "float32", "2", "2", "8"]
+    o = manifest.index("outputs")
+    assert manifest[o + 1] == "1"
+    # params are baked into the module as constants: the fc weights
+    # must appear as dense literals, and the module takes ONE argument
+    mlir = open(os.path.join(model_dir, "module.mlir")).read()
+    assert "stablehlo.constant" in mlir or "dense<" in mlir
+
+
+def test_probe_reports_version_and_clean_errors(built):
+    plugin = npred.find_plugin()
+    if plugin is None:
+        pytest.skip("no PJRT plugin .so on this machine")
+    rc, major, minor, ndev, err = npred.probe(plugin)
+    # rc 0 = full client; 1 = plugin loaded, client create failed with
+    # a clean error (the axon relay without session options, or libtpu
+    # without a chip); -1 (load failure) is the only unacceptable case
+    assert rc in (0, 1), err
+    assert major >= 0 and minor > 0
+    if rc == 1:
+        assert err  # the failure carries a message, not a crash
+
+
+def test_probe_nonexistent_plugin_fails_cleanly(built):
+    res = npred.probe("/nonexistent/plugin.so")
+    assert res[0] == -1
+    assert "dlopen" in res[4]
+
+
+def test_predictor_load_bad_model_dir(built):
+    plugin = npred.find_plugin()
+    if plugin is None:
+        pytest.skip("no PJRT plugin .so on this machine")
+    with pytest.raises(RuntimeError, match="manifest|open"):
+        npred.NativePredictor("/nonexistent/model", plugin)
+
+
+def test_cli_probe_only(built, model_dir):
+    plugin = npred.find_plugin()
+    if plugin is None:
+        pytest.skip("no PJRT plugin .so on this machine")
+    exe = os.path.join(NATIVE_DIR, "ptpu_predict")
+    p = subprocess.run([exe, model_dir, plugin, "--probe-only"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr
+    assert "api v" in p.stdout
+
+
+@pytest.mark.skipif(not os.environ.get("PTPU_NATIVE_RUN"),
+                    reason="needs a live PJRT device (set "
+                           "PTPU_NATIVE_RUN=1 on a TPU host)")
+def test_native_run_matches_python(model_dir):
+    plugin = npred.find_plugin()
+    pred = npred.NativePredictor(model_dir, plugin)
+    x = np.random.RandomState(0).randn(2, 8).astype("float32")
+    raw = pred.run([x])
+    out = raw[0].view(np.float32).reshape(2, 4)
+    ref = InferenceEngine.load_compiled(model_dir).run(
+        {"img": x})[0]
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5)
+    pred.close()
