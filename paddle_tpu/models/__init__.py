@@ -15,5 +15,7 @@ from . import deepfm
 from . import word2vec
 from . import srl
 from . import recommender
+from . import sentiment
+from . import fit_a_line
 from . import seq2seq
 from . import resnet_with_preprocess

@@ -1,0 +1,19 @@
+"""Linear regression on UCI housing — the reference book's opening
+chapter (/root/reference/python/paddle/fluid/tests/book/test_fit_a_line.py):
+a single fc from the 13 features to the price, SGD on mean squared
+cost. Kept as a named model for book-parity and as the smallest
+end-to-end smoke of the whole stack.
+"""
+from .. import layers
+
+__all__ = ["build_program"]
+
+
+def build_program():
+    """(feeds, avg_cost, prediction)."""
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    y_predict = layers.fc(input=x, size=1, act=None)
+    cost = layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = layers.mean(cost)
+    return ["x", "y"], avg_cost, y_predict

@@ -1,0 +1,41 @@
+"""Sentiment classification nets — the reference book's
+"understand_sentiment" chapter
+(/root/reference/python/paddle/fluid/tests/book/notest_understand_sentiment.py).
+
+Two nets over padded [B,T] int sequences + lengths (the LoD → padded +
+mask TPU representation):
+  - convolution_net: embedding → parallel sequence_conv_pool (filter
+    sizes 3 and 4, sqrt pooling) → 2-way softmax fc over BOTH conv
+    outputs (the reference's multi-input fc);
+  - stacked_lstm_net lives in models/stacked_lstm.py (same chapter).
+"""
+from .. import layers, nets
+
+__all__ = ["convolution_net", "build_program"]
+
+
+def convolution_net(data, seq_len, input_dim, class_dim=2, emb_dim=32,
+                    hid_dim=32):
+    emb = layers.embedding(data, size=[input_dim, emb_dim],
+                           is_sparse=True)
+    conv_3 = nets.sequence_conv_pool(emb, num_filters=hid_dim,
+                                     filter_size=3, seq_len=seq_len,
+                                     act="tanh", pool_type="sqrt")
+    conv_4 = nets.sequence_conv_pool(emb, num_filters=hid_dim,
+                                     filter_size=4, seq_len=seq_len,
+                                     act="tanh", pool_type="sqrt")
+    return layers.fc([conv_3, conv_4], size=class_dim, act="softmax")
+
+
+def build_program(dict_dim=5147, maxlen=128, class_dim=2):
+    """(feeds, avg_cost, accuracy, prediction) like the book's train()."""
+    data = layers.data("words", shape=[maxlen], dtype="int64")
+    seq_len = layers.data("words_seq_len", shape=[], dtype="int32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    prediction = convolution_net(data, seq_len, dict_dim,
+                                 class_dim=class_dim)
+    cost = layers.cross_entropy(input=prediction, label=label)
+    avg_cost = layers.mean(cost)
+    accuracy = layers.accuracy(input=prediction, label=label)
+    return ["words", "words_seq_len", "label"], avg_cost, accuracy, \
+        prediction

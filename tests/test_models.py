@@ -323,3 +323,43 @@ def test_data_feeder_feed_parallel():
     assert out["x"].shape == (4, 3)
     assert out["x"][0, 0] == 1.0 and out["x"][2, 0] == 2.0
     assert out["y"].shape == (4, 1)
+
+
+def test_sentiment_convolution_net_trains():
+    from paddle_tpu.models import sentiment
+    B, T, V = 16, 24, 200
+    feeds, avg_cost, acc, pred = sentiment.build_program(
+        dict_dim=V, maxlen=T)
+    rng = np.random.RandomState(0)
+
+    def feed(i):
+        words = rng.randint(10, V, (B, T)).astype("int64")
+        # learnable rule: a marker token (5 vs 6) repeated at the
+        # sequence head decides the class — detectable by the pooled
+        # conv filters anywhere in the window
+        label = rng.randint(0, 2, (B, 1)).astype("int64")
+        words[:, :4] = 5 + label
+        return {"words": words,
+                "words_seq_len": rng.randint(T // 2, T, B).astype("int32"),
+                "label": label}
+
+    losses = _run_steps(feeds, avg_cost, feed, steps=25,
+                        opt=pt.optimizer.Adam(1e-2))
+    assert min(losses[-3:]) < losses[0], losses
+
+
+def test_fit_a_line_converges():
+    from paddle_tpu.models import fit_a_line
+    from paddle_tpu.dataset import uci_housing
+    feeds, avg_cost, y_pred = fit_a_line.build_program()
+    data = list(uci_housing.train(n_synthetic=256)())
+    xs = np.asarray([d[0] for d in data], "float32")
+    ys = np.asarray([d[1] for d in data], "float32").reshape(-1, 1)
+
+    def feed(i):
+        sl = slice((i * 32) % 224, (i * 32) % 224 + 32)
+        return {"x": xs[sl], "y": ys[sl]}
+
+    losses = _run_steps(feeds, avg_cost, feed, steps=80,
+                        opt=pt.optimizer.SGD(0.03))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
