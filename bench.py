@@ -111,6 +111,28 @@ _PROBE_CODE = {
         "print('DEVICES=' + ';'.join(x.platform + '/' + "
         "str(getattr(x, 'device_kind', '?')) for x in d), flush=True); "
         "print('PLATFORM=' + d[0].platform, flush=True)"),
+    # C-level PJRT probe through the native predictor: dlopen the axon
+    # plugin directly, pass the SAME NamedValue session options the jax
+    # registration carries, and call PJRT_Client_Create from C — if
+    # this hangs/errors where jax also hangs, the stall is proven to be
+    # relay-side (below jax); if it succeeds, the fault is in the jax
+    # layer. Pure diagnosis; never gates a benchmark child.
+    "cprobe": (
+        "import json, os; print('IMPORTING', flush=True); "
+        "import jax; from jax._src import xla_bridge as xb; "
+        "print('IMPORTED', flush=True); "
+        "fac = xb._backend_factories.get('axon'); "
+        "opts = getattr(getattr(fac, 'factory', None), 'keywords', {})"
+        ".get('options', {}) if fac else {}; "
+        "os.environ['PTPU_PJRT_CREATE_OPTIONS'] = "
+        "';'.join(f'{k}={v}' for k, v in opts.items()); "
+        "print('OPTIONS_SET=' + str(sorted(opts)), flush=True); "
+        "from paddle_tpu.native import predictor as _np; "
+        "plug = _np.find_plugin(); "
+        "print('PLUGIN=' + str(plug), flush=True); "
+        "r = _np.probe(plug) if plug else None; "
+        "print('CPROBE=' + json.dumps(r), flush=True); "
+        "print('PLATFORM=none', flush=True)"),
     # full compute+readback — the relay has been observed to answer
     # jax.devices() while hanging on any real dispatch, so only this
     # green-lights a benchmark child
@@ -874,8 +896,12 @@ class _Supervisor:
             elif rec["outcome"] == "timeout":
                 # diagnosis only: a listing probe separates "jax import
                 # / plugin load hangs" from "device init hangs" from
-                # "listing works but dispatch hangs" via stage markers
+                # "listing works but dispatch hangs" via stage markers,
+                # and the C-level probe (native predictor + real axon
+                # session options) localizes a hang to the relay itself
+                # when PJRT_Client_Create stalls below jax too
                 probe("list", 40.0)
+                probe("cprobe", 45.0)
         # B: guarantee a result line regardless — CPU fallback child.
         if not done and remaining() > 40.0:
             cpu_done = True

@@ -57,7 +57,7 @@ def _configure(L):
     # (e.g. the v2 multi_reader_pop drained-sentinel change) that add no
     # new function for the per-symbol checks to trip on.
     L.ptpu_native_abi_version.restype = ctypes.c_uint64
-    if L.ptpu_native_abi_version() != 2:
+    if L.ptpu_native_abi_version() != 3:
         raise AttributeError("stale libptpu_native abi")
     L.ptpu_recordio_writer_open.restype = ctypes.c_void_p
     L.ptpu_recordio_writer_open.argtypes = [ctypes.c_char_p]
@@ -101,3 +101,18 @@ def _configure(L):
     L.ptpu_multi_reader_errors.argtypes = [ctypes.c_void_p]
     L.ptpu_multi_reader_close.argtypes = [ctypes.c_void_p]
     L.ptpu_multi_reader_destroy.argtypes = [ctypes.c_void_p]
+    L.ptpu_ms_parse.restype = ctypes.c_void_p
+    L.ptpu_ms_parse.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_int),
+                                ctypes.POINTER(ctypes.c_int)]
+    L.ptpu_ms_num_samples.restype = ctypes.c_int64
+    L.ptpu_ms_num_samples.argtypes = [ctypes.c_void_p]
+    L.ptpu_ms_error.restype = ctypes.c_char_p
+    L.ptpu_ms_error.argtypes = [ctypes.c_void_p]
+    L.ptpu_ms_slot_total.restype = ctypes.c_int64
+    L.ptpu_ms_slot_total.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    L.ptpu_ms_slot_lengths.restype = ctypes.POINTER(ctypes.c_int32)
+    L.ptpu_ms_slot_lengths.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    L.ptpu_ms_slot_values.restype = ctypes.c_void_p
+    L.ptpu_ms_slot_values.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    L.ptpu_ms_free.argtypes = [ctypes.c_void_p]

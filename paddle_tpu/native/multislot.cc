@@ -1,0 +1,182 @@
+// Native MultiSlot text parser — the C++ half of AsyncExecutor's input
+// side (ref paddle/fluid/framework/async_executor.cc +
+// data_feed.cc MultiSlotDataFeed: C++ worker threads parse
+// `<len> v1 .. vlen` slot groups per line). On TPU the compute is one
+// XLA module, so the native win is exactly this parse path: one call
+// ingests a whole file into contiguous per-slot value/length buffers
+// that numpy views zero-copy.
+//
+// C surface (ctypes):
+//   ptpu_ms_parse(path, n_slots, is_used[n], is_float[n]) -> handle
+//   ptpu_ms_num_samples(h)
+//   ptpu_ms_slot_total(h, used_idx)     // total values in that slot
+//   ptpu_ms_slot_lengths(h, used_idx)   // int32[num_samples]
+//   ptpu_ms_slot_values(h, used_idx)    // float* or int64* (is_float)
+//   ptpu_ms_error(h)                    // "" when clean
+//   ptpu_ms_free(h)
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct SlotBuf {
+  bool used = false;
+  bool is_float = false;
+  std::vector<int32_t> lengths;
+  std::vector<float> fvals;
+  std::vector<int64_t> ivals;
+};
+
+struct MSFile {
+  std::vector<SlotBuf> slots;
+  std::vector<int> used_index;  // used_idx -> slot index
+  int64_t num_samples = 0;
+  std::string error;
+};
+
+// Parse one whitespace-separated token starting at *p; advances *p.
+// Returns false at end of line/buffer.
+inline bool next_token(const char** p, const char* end, const char** tok,
+                       size_t* len) {
+  const char* q = *p;
+  while (q < end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+  if (q >= end || *q == '\n') {
+    *p = q;
+    return false;
+  }
+  const char* start = q;
+  while (q < end && !std::isspace((unsigned char)*q)) ++q;
+  *tok = start;
+  *len = (size_t)(q - start);
+  *p = q;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_ms_parse(const char* path, int n_slots, const int* is_used,
+                    const int* is_float) {
+  auto* f = new MSFile();
+  f->slots.resize(n_slots);
+  for (int i = 0; i < n_slots; ++i) {
+    f->slots[i].used = is_used[i] != 0;
+    f->slots[i].is_float = is_float[i] != 0;
+    if (f->slots[i].used) f->used_index.push_back(i);
+  }
+  FILE* fp = std::fopen(path, "rb");
+  if (fp == nullptr) {
+    f->error = std::string("cannot open ") + path;
+    return f;
+  }
+  std::fseek(fp, 0, SEEK_END);
+  long sz = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  // sz+1 with a NUL terminator: the strto* calls on the FINAL token
+  // must not scan past the allocation when the file lacks a trailing
+  // newline
+  std::vector<char> buf((size_t)sz + 1);
+  if (sz > 0 && std::fread(buf.data(), 1, (size_t)sz, fp) != (size_t)sz) {
+    f->error = std::string("short read on ") + path;
+    std::fclose(fp);
+    return f;
+  }
+  std::fclose(fp);
+  buf[(size_t)sz] = '\0';
+
+  const char* p = buf.data();
+  const char* end = p + (size_t)sz;
+  int64_t line_no = 0;
+  while (p < end) {
+    // skip empty lines
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    ++line_no;
+    for (int s = 0; s < n_slots; ++s) {
+      const char* tok;
+      size_t len;
+      if (!next_token(&p, end, &tok, &len)) {
+        f->error = "line " + std::to_string(line_no) +
+                   ": missing length for slot " + std::to_string(s);
+        return f;
+      }
+      char* endp = nullptr;
+      long n = std::strtol(tok, &endp, 10);
+      if (endp != tok + len || n < 0) {
+        f->error = "line " + std::to_string(line_no) +
+                   ": bad slot length token";
+        return f;
+      }
+      SlotBuf& sb = f->slots[s];
+      if (sb.used) sb.lengths.push_back((int32_t)n);
+      for (long k = 0; k < n; ++k) {
+        if (!next_token(&p, end, &tok, &len)) {
+          f->error = "line " + std::to_string(line_no) +
+                     ": slot " + std::to_string(s) + " truncated";
+          return f;
+        }
+        if (!sb.used) continue;
+        // endptr check: strtof("oops") would silently yield 0.0 — a
+        // malformed token must raise exactly like the python parser
+        char* vend = nullptr;
+        if (sb.is_float) {
+          sb.fvals.push_back(std::strtof(tok, &vend));
+        } else {
+          sb.ivals.push_back((int64_t)std::strtoll(tok, &vend, 10));
+        }
+        if (vend != tok + len) {
+          f->error = "line " + std::to_string(line_no) + ": slot " +
+                     std::to_string(s) + " bad value token '" +
+                     std::string(tok, len) + "'";
+          return f;
+        }
+      }
+    }
+    // to end of line
+    while (p < end && *p != '\n') ++p;
+    f->num_samples += 1;
+  }
+  return f;
+}
+
+int64_t ptpu_ms_num_samples(void* h) {
+  return static_cast<MSFile*>(h)->num_samples;
+}
+
+const char* ptpu_ms_error(void* h) {
+  return static_cast<MSFile*>(h)->error.c_str();
+}
+
+int64_t ptpu_ms_slot_total(void* h, int used_idx) {
+  auto* f = static_cast<MSFile*>(h);
+  if (used_idx < 0 || used_idx >= (int)f->used_index.size()) return -1;
+  SlotBuf& sb = f->slots[f->used_index[used_idx]];
+  return sb.is_float ? (int64_t)sb.fvals.size()
+                     : (int64_t)sb.ivals.size();
+}
+
+const int32_t* ptpu_ms_slot_lengths(void* h, int used_idx) {
+  auto* f = static_cast<MSFile*>(h);
+  if (used_idx < 0 || used_idx >= (int)f->used_index.size())
+    return nullptr;
+  return f->slots[f->used_index[used_idx]].lengths.data();
+}
+
+const void* ptpu_ms_slot_values(void* h, int used_idx) {
+  auto* f = static_cast<MSFile*>(h);
+  if (used_idx < 0 || used_idx >= (int)f->used_index.size())
+    return nullptr;
+  SlotBuf& sb = f->slots[f->used_index[used_idx]];
+  return sb.is_float ? (const void*)sb.fvals.data()
+                     : (const void*)sb.ivals.data();
+}
+
+void ptpu_ms_free(void* h) { delete static_cast<MSFile*>(h); }
+
+}  // extern "C"
