@@ -1,0 +1,9 @@
+#!/bin/bash
+# Background watcher: try a relay window every INTERVAL seconds, logging
+# to /tmp/relay_watch.log. Start once per round:
+#   nohup bash tools/relay_watch.sh > /dev/null 2>&1 &
+INTERVAL=${INTERVAL:-1200}
+while true; do
+  bash /root/repo/tools/relay_window.sh >> /tmp/relay_watch.log 2>&1
+  sleep "$INTERVAL"
+done

@@ -2,7 +2,11 @@
 # One relay window per invocation: probe; if the chip answers, run the
 # next uncaptured measurement stage (bench -> mfu A/B -> flash A/B).
 cd /root/repo
-P=$(python -c "import bench; print(bench._probe_tpu(timeout=100) or '')")
+P=$(python -c "
+import bench
+r = bench._probe_tpu(timeout=100)
+ok = r['outcome'] == 'ok' and r.get('platform') in ('tpu', 'axon')
+print(r['platform'] if ok else '')")
 if [ -z "$P" ]; then echo "RELAY DOWN $(date +%H:%M:%S)"; exit 0; fi
 echo "RELAY UP ($P) $(date +%H:%M:%S)"
 if [ ! -s /tmp/relay_bench.jsonl ]; then
