@@ -1,0 +1,13 @@
+"""Transpiler helper utilities (ref
+python/paddle/fluid/transpiler/details/: program_utils.py, ufind.py,
+checkport.py). Internal to the reference's distribute transpiler but
+imported by downstream code, so kept name-for-name; implementations
+are original over this framework's Program IR.
+"""
+from .checkport import wait_server_ready
+from .program_utils import (delete_ops, find_op_by_input_arg,
+                            find_op_by_output_arg)
+from .ufind import UnionFind
+
+__all__ = ["delete_ops", "find_op_by_input_arg", "find_op_by_output_arg",
+           "UnionFind", "wait_server_ready"]

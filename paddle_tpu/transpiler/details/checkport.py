@@ -1,0 +1,36 @@
+"""wait_server_ready (ref transpiler/details/checkport.py).
+
+The reference polls pserver endpoints before trainers start. There are
+no pservers here, but the SAME need exists for the multi-host
+coordinator (`fleet.init` → jax.distributed): trainers on other hosts
+can poll the coordinator endpoint with this exact call.
+"""
+import socket
+import sys
+import time
+from contextlib import closing
+
+
+def wait_server_ready(endpoints, timeout_s=None, poll_interval=3.0):
+    """Block until every "ip:port" endpoint accepts TCP connections.
+    timeout_s (extension): give up and raise after this many seconds —
+    the reference spins forever, which in a gang-scheduled TPU job
+    turns a dead peer into a silent hang."""
+    deadline = None if timeout_s is None else time.time() + timeout_s
+    while True:
+        not_ready = []
+        for ep in endpoints:
+            ip, port = ep.rsplit(":", 1)
+            with closing(socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)) as sock:
+                sock.settimeout(2)
+                if sock.connect_ex((ip, int(port))) != 0:
+                    not_ready.append(ep)
+        if not not_ready:
+            return
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f"servers not ready after {timeout_s}s: {not_ready}")
+        sys.stderr.write(f"pending server endpoints: {not_ready}\n")
+        sys.stderr.flush()
+        time.sleep(poll_interval)

@@ -1,0 +1,31 @@
+"""Program surgery helpers (ref transpiler/details/program_utils.py)
+over this framework's Block/Operator IR."""
+
+
+def delete_ops(block, ops):
+    """Remove the given Operator objects from the block (identity
+    match), ignoring ones already gone — ref delete_ops, without the
+    reference's print-and-continue on errors."""
+    keep = [op for op in block.ops if all(op is not o for o in ops)]
+    block.ops = keep
+    block.program._bump_version()
+
+
+def find_op_by_input_arg(block, arg_name):
+    """Index of the first op consuming arg_name, else -1."""
+    for index, op in enumerate(block.ops):
+        if arg_name in op.input_names():
+            return index
+    return -1
+
+
+def find_op_by_output_arg(block, arg_name, reverse=False):
+    """Index of the first (or last, reverse=True) op producing
+    arg_name, else -1."""
+    ops = list(enumerate(block.ops))
+    if reverse:
+        ops = reversed(ops)
+    for index, op in ops:
+        if arg_name in op.output_names():
+            return index
+    return -1

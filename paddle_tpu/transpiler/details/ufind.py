@@ -1,0 +1,35 @@
+"""Union-find (ref transpiler/details/ufind.py — used by the reference
+to group variables that must share a pserver placement; kept for API
+parity and generally useful for graph partitioning)."""
+
+
+class UnionFind:
+    def __init__(self, elements=None):
+        self._parents = {}
+        for e in elements or []:
+            self._parents[e] = e
+
+    def _root(self, x):
+        if x not in self._parents:
+            return None
+        while self._parents[x] != x:
+            self._parents[x] = self._parents[self._parents[x]]
+            x = self._parents[x]
+        return x
+
+    def find(self, x):
+        """Root of x's set (the reference returns -1 for unknowns)."""
+        r = self._root(x)
+        return -1 if r is None else r
+
+    def union(self, x, y):
+        for e in (x, y):
+            if e not in self._parents:
+                self._parents[e] = e
+        rx, ry = self._root(x), self._root(y)
+        if rx != ry:
+            self._parents[rx] = ry
+
+    def is_connected(self, x, y):
+        rx = self._root(x)
+        return rx is not None and rx == self._root(y)

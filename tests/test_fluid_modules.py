@@ -590,3 +590,44 @@ def test_async_executor_batch_stream_native_vs_python(tmp_path):
     for nb, pb in zip(native_r, python_r):
         np.testing.assert_allclose(np.asarray(nb[0]), np.asarray(pb[0]),
                                    rtol=1e-6)
+
+
+def test_transpiler_details_helpers(tmp_path):
+    """ref transpiler/details/{program_utils,ufind,checkport}."""
+    from paddle_tpu.transpiler import details as D
+
+    x = layers.data("dx", shape=[4])
+    h = layers.fc(x, 3)
+    out = layers.relu(h)
+    block = pt.default_main_program().global_block()
+    i_h = D.find_op_by_output_arg(block, h.name)
+    assert i_h >= 0
+    assert D.find_op_by_input_arg(block, h.name) > i_h
+    assert D.find_op_by_output_arg(block, "nope") == -1
+    relu_ops = [op for op in block.ops if op.type == "relu"]
+    n_before = len(block.ops)
+    D.delete_ops(block, relu_ops)
+    assert len(block.ops) == n_before - 1
+    assert all(op.type != "relu" for op in block.ops)
+
+    uf = D.UnionFind(["a", "b", "c"])
+    assert not uf.is_connected("a", "b")
+    uf.union("a", "b")
+    uf.union("b", "c")
+    assert uf.is_connected("a", "c")
+    assert uf.find("zzz") == -1
+    uf.union("new1", "new2")
+    assert uf.is_connected("new1", "new2")
+
+    # checkport: a live local listener is detected; a dead port times out
+    import socket
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    D.wait_server_ready([f"127.0.0.1:{port}"], timeout_s=5)
+    srv.close()
+    import pytest
+    with pytest.raises(TimeoutError):
+        D.wait_server_ready(["127.0.0.1:1"], timeout_s=0.1,
+                            poll_interval=0.05)

@@ -2,7 +2,15 @@
 # Background watcher: try a relay window every INTERVAL seconds, logging
 # to /tmp/relay_watch.log. Start once per round:
 #   nohup bash tools/relay_watch.sh > /dev/null 2>&1 &
+# flock single-instance guard: stacked watchers (or a concurrent manual
+# relay_window.sh) would otherwise race the shared stage files and run
+# concurrent benches against the one chip.
 INTERVAL=${INTERVAL:-1200}
+exec 9>/tmp/relay_watch.lock
+if ! flock -n 9; then
+  echo "relay_watch already running; exiting" >&2
+  exit 0
+fi
 while true; do
   bash /root/repo/tools/relay_window.sh >> /tmp/relay_watch.log 2>&1
   sleep "$INTERVAL"
