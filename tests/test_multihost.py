@@ -131,6 +131,22 @@ def test_two_process_pipeline_training(tmp_path):
             (out, err[-500:])
 
 
+def test_two_process_distributed_table_training(tmp_path):
+    """embedding(is_distributed=True) with table rows sharded over the
+    dp axis SPANNING BOTH PROCESSES — row gathers and sparse updates
+    cross the host boundary (the pserver prefetch/push analog), and
+    each host materializes only vocab/n_global rows."""
+    outs = _spawn_workers(tmp_path, extra_args=("table",))
+    for rc, out, err in outs:
+        assert f"RESULT table-ok {_NPROC} {2 * _NPROC}" in out, \
+            (out, err[-500:])
+    # both hosts agree on the loss sequence (replicated fetches)
+    seqs = {line.split(" ", 4)[-1] for rc, out, _ in outs
+            for line in out.splitlines()
+            if line.startswith("RESULT table-ok")}
+    assert len(seqs) == 1, seqs
+
+
 def test_two_process_tensor_parallel_training(tmp_path):
     """dp x tp on the 2-process mesh (tp intra-host, dp across hosts):
     Megatron-sharded weights + cross-host grad all-reduce must equal
