@@ -513,6 +513,96 @@ SPECS["roi_align"] = S(
      "sampling_ratio": 2}, diff=["X"])
 
 # ---------------------------------------------------------------------------
+# DIFF_ONLY tier: ops whose output involves discrete selection/matching
+# (finite differences would straddle the decision boundaries, so an FD
+# comparison is meaningless) but which sit on TRAINING paths — the
+# detection losses chiefly. For these the sweep checks exactly the
+# property the executor needs: jax.value_and_grad runs through the
+# kernel (with every output live — the max_pool_with_index crash
+# class) and yields finite gradients.
+# ---------------------------------------------------------------------------
+
+_PRIORS = np.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                    [0.2, 0.6, 0.45, 0.95], [0.55, 0.1, 0.95, 0.45]],
+                   np.float64)
+
+DIFF_ONLY = {
+    "ssd_loss": S(
+        {"Loc": (2, 4, 4), "Conf": (2, 4, 3),
+         "GtBox": np.array([[[0.12, 0.1, 0.42, 0.38],
+                             [0.5, 0.52, 0.88, 0.9]],
+                            [[0.2, 0.62, 0.44, 0.93],
+                             [0.0, 0.0, 0.0, 0.0]]], np.float64),
+         "GtLabel": np.array([[1, 2], [1, -1]], np.int32),
+         "PriorBox": _PRIORS, "PriorVar": np.full((4, 4), 0.1)},
+        {"overlap_threshold": 0.5}, diff=["Loc", "Conf"]),
+    "yolov3_loss": S(
+        {"X": (1, 2 * 7, 4, 4),
+         "GTBox": np.array([[[0.3, 0.3, 0.2, 0.25],
+                             [0.7, 0.6, 0.3, 0.2]]], np.float64),
+         "GTLabel": np.array([[0, 1]], np.int32)},
+        {"anchors": [10, 13, 16, 30], "class_num": 2,
+         "ignore_thresh": 0.7}, diff=["X"]),
+    "roi_pool": S(
+        {"X": (1, 2, 6, 6),
+         "ROIs": np.array([[0.5, 0.5, 4.0, 4.0]], np.float64)},
+        {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+        diff=["X"]),
+    "psroi_pool": S(
+        {"X": (1, 8, 6, 6),
+         "ROIs": np.array([[0.5, 0.5, 4.0, 4.0]], np.float64)},
+        {"pooled_height": 2, "pooled_width": 2, "output_channels": 2,
+         "spatial_scale": 1.0}, diff=["X"]),
+    "iou_similarity": S({"X": _PRIORS[:2], "Y": _PRIORS},
+                        diff=["X", "Y"]),
+    "box_coder": S(
+        {"PriorBox": _PRIORS, "PriorBoxVar": np.full((4, 4), 0.1),
+         "TargetBox": ("pos", (4, 4))},
+        {"code_type": "encode_center_size"}, diff=["TargetBox"]),
+}
+
+
+def _run_diff_only_check(op, spec):
+    """value_and_grad through the kernel with all outputs live; finite
+    grads required, no FD comparison (discrete selection inside)."""
+    rng = np.random.RandomState(
+        _RNG_SEED + zlib.crc32(op.encode()) % 1000)
+    with jax.enable_x64():
+        ins = {slot: [jnp.asarray(_make_value(v, rng))
+                      for v in (vs if isinstance(vs, list) else [vs])]
+               for slot, vs in spec["ins"].items()}
+        ctx = KernelCtx(key=jax.random.PRNGKey(7), is_test=False)
+        fn = get_kernel(op)
+        diff_slots = spec["diff"]
+        flat = [(slot, i) for slot in diff_slots
+                for i in range(len(ins[slot]))]
+
+        def scalar_fn(*args):
+            ins2 = {k: list(v) for k, v in ins.items()}
+            for (slot, i), a in zip(flat, args):
+                ins2[slot][i] = a
+            outs = fn(ctx, ins2, spec["attrs"])
+            total = 0.0
+            for oslot in sorted(outs):
+                for o in outs[oslot]:
+                    if o is None or not getattr(o, "size", 0):
+                        continue
+                    if _is_float(o):
+                        total = total + jnp.sum(o)
+                    else:
+                        total = total + 0.0 * jnp.sum(
+                            jnp.asarray(o).astype(jnp.float32))
+            return total
+
+        args0 = [ins[slot][i] for slot, i in flat]
+        val, grads = jax.value_and_grad(
+            scalar_fn, argnums=tuple(range(len(args0))))(*args0)
+        assert np.isfinite(float(val)), f"{op}: non-finite output"
+        for (slot, i), g in zip(flat, grads):
+            assert np.all(np.isfinite(np.asarray(g))),                 f"{op}: non-finite grad for {slot}[{i}]"
+
+
+# ---------------------------------------------------------------------------
 # exclusions — closed list, every entry carries its reason
 # ---------------------------------------------------------------------------
 
@@ -594,24 +684,14 @@ EXCLUDE = {
     "prior_box": "constant box grid",
     "density_prior_box": "constant box grid",
     "bipartite_match": "discrete matching",
-    "box_coder": "piecewise box transform (exercised in "
-        "test_detection numerics)",
-    "iou_similarity": "piecewise boundaries at box intersections",
     "multiclass_nms": "discrete suppression",
     "mine_hard_examples": "discrete mining",
     "generate_proposals": "discrete proposal selection",
     "generate_proposal_labels": "discrete label assignment",
     "rpn_target_assign": "discrete assignment",
     "target_assign": "discrete assignment",
-    "ssd_loss": "discrete matching inside (loss numerics pinned in "
-        "test_detection)",
-    "yolov3_loss": "discrete best-anchor matching inside (numerics "
-        "pinned in test_detection)",
     "polygon_box_transform": "geometry decode, not a training path",
-    "roi_pool": "max selection over bins (roi_align covers the "
-        "differentiable variant)",
     "roi_perspective_transform": "discrete geometric resampling",
-    "psroi_pool": "position-sensitive bin selection",
     # IR / runtime plumbing
     "alloc_array": "TensorArray allocation",
     "array_read": "TensorArray plumbing",
@@ -636,22 +716,31 @@ EXCLUDE = {
 # ---------------------------------------------------------------------------
 
 def test_registry_fully_classified():
-    """Every registered kernel is either grad-checked or excluded with a
-    reason — and neither list carries stale or double entries."""
+    """Every registered kernel is grad-checked, diff-only-checked, or
+    excluded with a reason — and the three lists are disjoint with no
+    stale entries."""
     reg = set(KERNELS)
-    spec, excl = set(SPECS), set(EXCLUDE)
-    assert not (spec & excl), f"double-classified: {sorted(spec & excl)}"
-    assert not (spec - reg), f"stale specs: {sorted(spec - reg)}"
-    assert not (excl - reg), f"stale exclusions: {sorted(excl - reg)}"
-    missing = reg - spec - excl
+    spec, donly, excl = set(SPECS), set(DIFF_ONLY), set(EXCLUDE)
+    for a, b in [(spec, donly), (spec, excl), (donly, excl)]:
+        assert not (a & b), f"double-classified: {sorted(a & b)}"
+    for name, grp in [("specs", spec), ("diff-only", donly),
+                      ("exclusions", excl)]:
+        assert not (grp - reg), f"stale {name}: {sorted(grp - reg)}"
+    missing = reg - spec - donly - excl
     assert not missing, (
-        f"{len(missing)} kernels are neither grad-checked nor "
-        f"excluded-with-reason: {sorted(missing)}")
+        f"{len(missing)} kernels are neither grad-checked, "
+        f"diff-only-checked, nor excluded-with-reason: "
+        f"{sorted(missing)}")
 
 
 @pytest.mark.parametrize("op", sorted(SPECS))
 def test_op_grad(op):
     _run_grad_check(op, SPECS[op])
+
+
+@pytest.mark.parametrize("op", sorted(DIFF_ONLY))
+def test_op_differentiable(op):
+    _run_diff_only_check(op, DIFF_ONLY[op])
 
 
 def test_train_through_max_pool_with_index():
