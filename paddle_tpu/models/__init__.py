@@ -17,5 +17,6 @@ from . import srl
 from . import recommender
 from . import sentiment
 from . import fit_a_line
+from . import ssd
 from . import seq2seq
 from . import resnet_with_preprocess

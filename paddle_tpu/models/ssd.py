@@ -1,0 +1,81 @@
+"""SSD single-shot detector (ref: the fluid SSD pipeline —
+layers.multi_box_head + ssd_loss + detection_output, detection.py:779/
+1259 in the reference; the MobileNet-SSD configuration of the
+PaddlePaddle models suite, scaled down).
+
+TPU-native notes: priors are compile-time constants per feature-map
+shape; ssd_loss is ONE fused kernel (iou → matching → target encode →
+smooth-L1 + mined softmax CE) so the whole train step stays a single
+XLA module; detection_output's NMS runs on fixed top_k candidates
+(static shapes).
+"""
+from .. import layers
+from ..layers import detection as det
+
+__all__ = ["SSDConfig", "build_program", "build_infer_program"]
+
+
+class SSDConfig:
+    def __init__(self, image_size=64, num_classes=4, max_gt=8,
+                 channels=3):
+        self.image_size = image_size
+        self.num_classes = num_classes  # includes background 0
+        self.max_gt = max_gt
+        self.channels = channels
+
+
+def _conv_block(x, filters, name):
+    h = layers.conv2d(x, num_filters=filters, filter_size=3, padding=1,
+                      act="relu", name=f"{name}_a")
+    h = layers.conv2d(h, num_filters=filters, filter_size=3, padding=1,
+                      act="relu", name=f"{name}_b")
+    return layers.pool2d(h, pool_size=2, pool_type="max", pool_stride=2)
+
+
+def _backbone(img):
+    """Four stride-2 stages → two detection feature maps
+    (image_size/8 and image_size/16)."""
+    h = _conv_block(img, 16, "ssd_s1")          # /2
+    h = _conv_block(h, 32, "ssd_s2")            # /4
+    f1 = _conv_block(h, 64, "ssd_s3")           # /8  → head 0
+    f2 = _conv_block(f1, 64, "ssd_s4")          # /16 → head 1
+    return [f1, f2]
+
+
+def _heads(img, cfg):
+    feats = _backbone(img)
+    s = cfg.image_size
+    return det.multi_box_head(
+        inputs=feats, image=img, base_size=s,
+        num_classes=cfg.num_classes,
+        aspect_ratios=[[2.0], [2.0]],
+        min_sizes=[s * 0.2, s * 0.5],
+        max_sizes=[s * 0.5, s * 0.9],
+        offset=0.5, flip=True)
+
+
+def build_program(cfg=None):
+    """Training graph: (feed_names, avg_loss)."""
+    cfg = cfg or SSDConfig()
+    img = layers.data(
+        "image", shape=[cfg.channels, cfg.image_size, cfg.image_size])
+    gt_box = layers.data("gt_box", shape=[cfg.max_gt, 4])
+    gt_label = layers.data("gt_label", shape=[cfg.max_gt],
+                           dtype="int64")
+    locs, confs, boxes, box_vars = _heads(img, cfg)
+    loss = det.ssd_loss(locs, confs, gt_box, gt_label, boxes, box_vars)
+    avg_loss = layers.mean(loss)
+    return ["image", "gt_box", "gt_label"], avg_loss
+
+
+def build_infer_program(cfg=None):
+    """Inference graph: (feed_names, nmsed_out) via detection_output."""
+    cfg = cfg or SSDConfig()
+    img = layers.data(
+        "image", shape=[cfg.channels, cfg.image_size, cfg.image_size])
+    locs, confs, boxes, box_vars = _heads(img, cfg)
+    scores = layers.softmax(confs)
+    out = det.detection_output(locs, scores, boxes, box_vars,
+                               nms_threshold=0.45, nms_top_k=32,
+                               keep_top_k=16, score_threshold=0.01)
+    return ["image"], out
