@@ -18,5 +18,6 @@ from . import recommender
 from . import sentiment
 from . import fit_a_line
 from . import ssd
+from . import crnn_ctc
 from . import seq2seq
 from . import resnet_with_preprocess

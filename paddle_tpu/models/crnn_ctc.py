@@ -1,0 +1,78 @@
+"""CRNN-CTC OCR model (the PaddlePaddle models-suite OCR recognition
+pipeline over this reference's ops: conv feature extractor →
+height-collapsed sequence → bidirectional GRU → per-timestep logits →
+warpctc loss / ctc_greedy_decoder inference; ref operators:
+warpctc_op, ctc_align_op, gru_op, im2sequence_op).
+
+TPU-native notes: the image is a fixed [C, H, W]; the width axis
+becomes the (static) time axis, so the whole train step — conv stack,
+bidirectional lax.scan GRUs, and the log-space CTC forward — compiles
+into one XLA module with no dynamic shapes.
+"""
+from .. import layers
+
+__all__ = ["CRNNConfig", "build_program", "build_infer_program"]
+
+
+class CRNNConfig:
+    def __init__(self, num_classes=16, image_h=32, image_w=64,
+                 channels=1, hidden=48, max_label=8):
+        self.num_classes = num_classes      # excluding the CTC blank
+        self.image_h = image_h
+        self.image_w = image_w
+        self.channels = channels
+        self.hidden = hidden
+        self.max_label = max_label
+        self.blank = num_classes            # blank is the last id
+
+
+def _feature_sequence(img, cfg):
+    """Conv stack then collapse height: [B,C,H,W] → [B, T=W/4, D]."""
+    h = layers.conv2d(img, num_filters=16, filter_size=3, padding=1,
+                      act="relu", name="crnn_c1")
+    h = layers.pool2d(h, pool_size=2, pool_type="max", pool_stride=2)
+    h = layers.conv2d(h, num_filters=32, filter_size=3, padding=1,
+                      act="relu", name="crnn_c2")
+    h = layers.pool2d(h, pool_size=2, pool_type="max", pool_stride=2)
+    # [B, 32, H/4, W/4] → time-major sequence over the width axis;
+    # D stays static so the GRU input projection has a resolved shape
+    h = layers.transpose(h, perm=[0, 3, 1, 2])        # [B, W', 32, H']
+    t = cfg.image_w // 4
+    d = 32 * (cfg.image_h // 4)
+    return layers.reshape(h, [0, t, d])               # [B, T, D]
+
+
+def _logits(img, cfg):
+    seq = _feature_sequence(img, cfg)
+    fwd = layers.dynamic_gru(seq, cfg.hidden, name="crnn_gru_f")
+    bwd = layers.dynamic_gru(seq, cfg.hidden, is_reverse=True,
+                             name="crnn_gru_b")
+    rnn = layers.concat([fwd, bwd], axis=2)
+    # +1 output column for the CTC blank
+    return layers.fc(rnn, cfg.num_classes + 1, num_flatten_dims=2,
+                     name="crnn_logits")
+
+
+def build_program(cfg=None):
+    """Training graph: (feed_names, avg_ctc_loss)."""
+    cfg = cfg or CRNNConfig()
+    img = layers.data(
+        "image", shape=[cfg.channels, cfg.image_h, cfg.image_w])
+    label = layers.data("label", shape=[cfg.max_label], dtype="int64")
+    label_len = layers.data("label_len", shape=[], dtype="int64")
+    logits = _logits(img, cfg)
+    loss = layers.warpctc(logits, label, blank=cfg.blank,
+                          label_length=label_len)
+    avg_loss = layers.mean(loss)
+    return ["image", "label", "label_len"], avg_loss
+
+
+def build_infer_program(cfg=None):
+    """Inference graph: (feed_names, decoded_ids, decoded_lengths)."""
+    cfg = cfg or CRNNConfig()
+    img = layers.data(
+        "image", shape=[cfg.channels, cfg.image_h, cfg.image_w])
+    logits = _logits(img, cfg)
+    probs = layers.softmax(logits)
+    ids, lens = layers.ctc_greedy_decoder(probs, blank=cfg.blank)
+    return ["image"], ids, lens
