@@ -19,5 +19,6 @@ from . import sentiment
 from . import fit_a_line
 from . import ssd
 from . import crnn_ctc
+from . import faster_rcnn
 from . import seq2seq
 from . import resnet_with_preprocess

@@ -1,0 +1,153 @@
+"""Faster R-CNN two-stage detector (ref pipeline: layers
+rpn_target_assign + generate_proposals + generate_proposal_labels +
+roi_align, detection.py:54/2670 region — the fluid rcnn configuration,
+scaled down).
+
+TPU-native notes: every stage has FIXED shapes — the RPN sample set
+(rpn_batch_size_per_im), the proposal set (post_nms_top_n), and the
+RCNN sample set (batch_size_per_im) are static sizes with validity
+masks, so the full two-stage train step (backbone, RPN losses,
+proposal generation + label assignment, RoIAlign head losses) compiles
+to ONE XLA module. The proposal/assignment boundaries are
+stop-gradient (matching the reference: proposals are data), while
+gradients flow to the RPN head through its sampled loc/score and to
+the backbone through RoIAlign.
+"""
+import numpy as np
+
+from .. import layers
+from ..layers import detection as det
+
+__all__ = ["FasterRCNNConfig", "build_program"]
+
+
+class FasterRCNNConfig:
+    def __init__(self, image_size=64, num_classes=4, max_gt=4,
+                 channels=3, anchor_sizes=(16.0, 32.0),
+                 aspect_ratios=(1.0, 2.0), rpn_samples=32,
+                 proposals=24, rcnn_samples=16):
+        self.image_size = image_size
+        self.num_classes = num_classes   # includes background 0
+        self.max_gt = max_gt
+        self.channels = channels
+        self.anchor_sizes = list(anchor_sizes)
+        self.aspect_ratios = list(aspect_ratios)
+        self.rpn_samples = rpn_samples
+        self.proposals = proposals
+        self.rcnn_samples = rcnn_samples
+
+
+def _backbone(img):
+    """Three stride-2 stages → [B, 64, s/8, s/8]."""
+    h = layers.conv2d(img, num_filters=16, filter_size=3, padding=1,
+                      act="relu", name="frcnn_c1")
+    h = layers.pool2d(h, pool_size=2, pool_type="max", pool_stride=2)
+    h = layers.conv2d(h, num_filters=32, filter_size=3, padding=1,
+                      act="relu", name="frcnn_c2")
+    h = layers.pool2d(h, pool_size=2, pool_type="max", pool_stride=2)
+    h = layers.conv2d(h, num_filters=64, filter_size=3, padding=1,
+                      act="relu", name="frcnn_c3")
+    return layers.pool2d(h, pool_size=2, pool_type="max", pool_stride=2)
+
+
+def build_program(cfg=None, batch_size=2):
+    """Training graph → (feed_names, total_loss, loss_dict)."""
+    cfg = cfg or FasterRCNNConfig()
+    s = cfg.image_size
+    img = layers.data("image", shape=[cfg.channels, s, s])
+    gt_box = layers.data("gt_box", shape=[cfg.max_gt, 4])
+    gt_label = layers.data("gt_label", shape=[cfg.max_gt],
+                           dtype="int32")
+    im_info = layers.data("im_info", shape=[3])
+
+    feat = _backbone(img)                      # [B, 64, s/8, s/8]
+    stride = 8.0
+    A = len(cfg.anchor_sizes) * len(cfg.aspect_ratios)
+    anchors, avar = det.anchor_generator(
+        feat, anchor_sizes=cfg.anchor_sizes,
+        aspect_ratios=cfg.aspect_ratios, stride=[stride, stride])
+
+    # RPN head
+    rpn = layers.conv2d(feat, num_filters=64, filter_size=3, padding=1,
+                        act="relu", name="frcnn_rpn")
+    cls_conv = layers.conv2d(rpn, num_filters=A, filter_size=1,
+                             name="frcnn_rpn_cls")     # [B, A, H, W]
+    bbox_conv = layers.conv2d(rpn, num_filters=4 * A, filter_size=1,
+                              name="frcnn_rpn_bbox")   # [B, 4A, H, W]
+    hw = s // 8
+    M = hw * hw * A
+    # [B, A, H, W] → [B, M, 1] / [B, 4A, H, W] → [B, M, 4] in the same
+    # (H, W, A) flattening order generate_proposals/anchors use
+    cls_flat = layers.reshape(
+        layers.transpose(cls_conv, perm=[0, 2, 3, 1]), [0, M, 1])
+    bbox_t = layers.reshape(
+        layers.transpose(bbox_conv, perm=[0, 2, 3, 1]), [0, M, 4])
+    anchors_flat = layers.reshape(anchors, [M, 4])
+    avar_flat = layers.reshape(avar, [M, 4])
+
+    # --- RPN losses over the fixed sampled set ------------------------
+    loc, score, lab, tgt, inw = det.rpn_target_assign(
+        bbox_t, cls_flat, anchors_flat, avar_flat, gt_box,
+        im_info=im_info, rpn_batch_size_per_im=cfg.rpn_samples)
+    lab_f = layers.cast(layers.reshape(lab, [0, cfg.rpn_samples, 1]),
+                        "float32")
+    w3 = layers.reshape(inw, [0, cfg.rpn_samples, 1])   # validity mask
+    one = layers.fill_constant([], "float32", 1.0)
+    # cls loss over VALID samples only (unfilled fg slots carry label 1
+    # for arbitrary anchors — they must not train objectness)
+    ce = layers.elementwise_mul(
+        layers.sigmoid_cross_entropy_with_logits(score, lab_f), w3)
+    rpn_cls_loss = layers.elementwise_div(
+        layers.reduce_sum(ce),
+        layers.elementwise_add(layers.reduce_sum(inw), one))
+    # reg loss over valid POSITIVES only (the reference regresses fg
+    # anchors; valid bg rows have tgt=0 and must not pull deltas to 0)
+    fg_w = layers.elementwise_mul(w3, lab_f)
+    diff = layers.elementwise_mul(
+        layers.elementwise_sub(loc, tgt), fg_w)
+    rpn_reg_loss = layers.elementwise_div(
+        layers.reduce_sum(layers.elementwise_mul(diff, diff)),
+        layers.elementwise_add(layers.reduce_sum(fg_w), one))
+
+    # --- proposals + RCNN head (stop-gradient boundaries) -------------
+    rois, _probs = det.generate_proposals(
+        layers.sigmoid(cls_conv), bbox_conv, im_info, anchors, avar,
+        pre_nms_top_n=M, post_nms_top_n=cfg.proposals, nms_thresh=0.7,
+        min_size=2.0)
+    srois, slabels, stgts, sinw, soutw = det.generate_proposal_labels(
+        rois, gt_label, gt_boxes=gt_box, im_info=im_info,
+        batch_size_per_im=cfg.rcnn_samples, fg_thresh=0.5,
+        class_nums=cfg.num_classes)
+
+    # RoIAlign expects flat [N, 5] rois with a batch-index column
+    P, C = cfg.rcnn_samples, cfg.num_classes
+    bidx = layers.assign(
+        np.repeat(np.arange(batch_size, dtype=np.float32),
+                  P).reshape(-1, 1))
+    flat_rois = layers.concat(
+        [bidx, layers.reshape(srois, [batch_size * P, 4])], axis=1)
+    pooled = det.roi_align(feat, flat_rois, pooled_height=4,
+                           pooled_width=4, spatial_scale=1.0 / stride)
+    head = layers.fc(
+        layers.reshape(pooled, [batch_size * P, 64 * 4 * 4]),
+        128, act="relu", name="frcnn_head")
+    cls_score = layers.reshape(
+        layers.fc(head, C, name="frcnn_cls"), [batch_size, P, C])
+    bbox_pred = layers.reshape(
+        layers.fc(head, 4 * C, name="frcnn_bbox"),
+        [batch_size, P, 4 * C])
+
+    rcnn_cls_loss = layers.mean(layers.softmax_with_cross_entropy(
+        cls_score, layers.reshape(slabels, [0, P, 1])))
+    rdiff = layers.elementwise_mul(
+        layers.elementwise_sub(bbox_pred, stgts), sinw)
+    rcnn_reg_loss = layers.elementwise_div(
+        layers.reduce_sum(layers.elementwise_mul(rdiff, rdiff)),
+        layers.elementwise_add(layers.reduce_sum(sinw),
+                               layers.fill_constant([], "float32", 1.0)))
+
+    total = layers.sum([rpn_cls_loss, rpn_reg_loss, rcnn_cls_loss,
+                        rcnn_reg_loss])
+    losses = {"rpn_cls": rpn_cls_loss, "rpn_reg": rpn_reg_loss,
+              "rcnn_cls": rcnn_cls_loss, "rcnn_reg": rcnn_reg_loss}
+    return ["image", "gt_box", "gt_label", "im_info"], total, losses

@@ -440,3 +440,38 @@ def test_crnn_ctc_trains_and_decodes():
         and (out_ids[b, :label_len[b]] == label[b, :label_len[b]]).all()
         for b in range(B))
     assert hits >= 3, (hits, out_ids, out_lens, label)
+
+
+def test_faster_rcnn_two_stage_trains():
+    """Faster R-CNN: the full two-stage step (RPN losses + proposal
+    generation + label assignment + RoIAlign head losses) compiles to
+    one XLA module and trains — all four loss components finite, total
+    decreasing."""
+    from paddle_tpu.models import faster_rcnn as fr
+    cfg = fr.FasterRCNNConfig(image_size=32, num_classes=3, max_gt=2,
+                              rpn_samples=16, proposals=12,
+                              rcnn_samples=8)
+    feeds, total, parts = fr.build_program(cfg, batch_size=2)
+    rng = np.random.RandomState(0)
+    feed_d = {
+        "image": rng.randn(2, 3, 32, 32).astype("float32"),
+        "gt_box": np.tile(np.array(
+            [[[4, 4, 14, 14], [18, 16, 30, 28]]], "float32"), (2, 1, 1)),
+        "gt_label": np.tile(np.array([[1, 2]], "int32"), (2, 1)),
+        "im_info": np.tile(np.array([[32, 32, 1.0]], "float32"),
+                           (2, 1)),
+    }
+    (pt.optimizer.Adam(1e-3)).minimize(total)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    names = list(parts)
+    totals = []
+    for _ in range(8):
+        out = exe.run(feed=feed_d,
+                      fetch_list=[total] + [parts[n] for n in names])
+        totals.append(float(np.asarray(out[0])))
+        comps = {n: float(np.asarray(v))
+                 for n, v in zip(names, out[1:])}
+        for n, v in comps.items():
+            assert np.isfinite(v) and v >= 0, (n, v)
+    assert totals[-1] < totals[0], totals
