@@ -20,5 +20,6 @@ from . import fit_a_line
 from . import ssd
 from . import crnn_ctc
 from . import faster_rcnn
+from . import dcgan
 from . import seq2seq
 from . import resnet_with_preprocess

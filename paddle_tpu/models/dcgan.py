@@ -1,0 +1,112 @@
+"""DCGAN (the fluid models-suite GAN configuration, scaled down) —
+exercises the alternating two-program training pattern: discriminator
+and generator steps are SEPARATE Programs sharing one Scope through
+identical parameter names, each optimizer restricted to its network
+via minimize(parameter_list=...) (ref backward.py parameter_list
+semantics). Each program still compiles to its own single XLA module.
+"""
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+__all__ = ["DCGANConfig", "build_programs"]
+
+
+class DCGANConfig:
+    def __init__(self, z_dim=16, image_size=16, channels=1, gf=16,
+                 df=16):
+        self.z_dim = z_dim
+        self.image_size = image_size
+        self.channels = channels
+        self.gf = gf
+        self.df = df
+
+
+def _generator(z, cfg):
+    """z [B, z_dim] → tanh image [B, C, s, s]; explicit param names so
+    both programs bind the same scope entries."""
+    s4 = cfg.image_size // 4
+    h = layers.fc(z, cfg.gf * 2 * s4 * s4, act="relu",
+                  param_attr=ParamAttr(name="g_fc_w"),
+                  bias_attr=ParamAttr(name="g_fc_b"))
+    h = layers.reshape(h, [0, cfg.gf * 2, s4, s4])
+    h = layers.conv2d_transpose(
+        h, num_filters=cfg.gf, filter_size=4, stride=2, padding=1,
+        act="relu", param_attr=ParamAttr(name="g_dc1_w"),
+        bias_attr=ParamAttr(name="g_dc1_b"))
+    return layers.conv2d_transpose(
+        h, num_filters=cfg.channels, filter_size=4, stride=2, padding=1,
+        act="tanh", param_attr=ParamAttr(name="g_dc2_w"),
+        bias_attr=ParamAttr(name="g_dc2_b"))
+
+
+def _discriminator(img, cfg):
+    h = layers.conv2d(img, num_filters=cfg.df, filter_size=4, stride=2,
+                      padding=1, act="leaky_relu",
+                      param_attr=ParamAttr(name="d_c1_w"),
+                      bias_attr=ParamAttr(name="d_c1_b"))
+    h = layers.conv2d(h, num_filters=cfg.df * 2, filter_size=4,
+                      stride=2, padding=1, act="leaky_relu",
+                      param_attr=ParamAttr(name="d_c2_w"),
+                      bias_attr=ParamAttr(name="d_c2_b"))
+    s4 = cfg.image_size // 4
+    flat = layers.reshape(h, [0, cfg.df * 2 * s4 * s4])
+    return layers.fc(flat, 1, param_attr=ParamAttr(name="d_fc_w"),
+                     bias_attr=ParamAttr(name="d_fc_b"))
+
+
+G_PARAMS = ["g_fc_w", "g_fc_b", "g_dc1_w", "g_dc1_b",
+            "g_dc2_w", "g_dc2_b"]
+D_PARAMS = ["d_c1_w", "d_c1_b", "d_c2_w", "d_c2_b",
+            "d_fc_w", "d_fc_b"]
+
+
+def _bce(logit, target_value):
+    lab = layers.fill_constant_batch_size_like(
+        logit, logit.shape, "float32", target_value)
+    return layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, lab))
+
+
+def build_programs(cfg=None, lr=2e-4, seed=7):
+    """Returns (d_program, g_program, startups, d_loss, g_loss).
+
+    Run BOTH programs in `startups` once, IN ORDER: the second
+    re-initializes every shared parameter (the final init values come
+    from g_startup — both startups cover the full shared set, so the
+    result is consistent, but NOT order-independent) and adds the
+    g-optimizer's moment accumulators. Then alternate
+    exe.run(d_program, feed={'z':…, 'real':…}) and
+    exe.run(g_program, feed={'z':…}). The d step updates only
+    D_PARAMS, the g step only G_PARAMS (verified under test).
+    """
+    import paddle_tpu as pt
+    cfg = cfg or DCGANConfig()
+
+    d_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(d_prog, startup):
+        with pt.unique_name.guard():
+            z = layers.data("z", shape=[cfg.z_dim])
+            real = layers.data(
+                "real",
+                shape=[cfg.channels, cfg.image_size, cfg.image_size])
+            fake = _generator(z, cfg)
+            d_loss = layers.elementwise_add(
+                _bce(_discriminator(real, cfg), 1.0),
+                _bce(_discriminator(fake, cfg), 0.0))
+            pt.optimizer.Adam(lr, beta1=0.5).minimize(
+                d_loss, parameter_list=D_PARAMS)
+
+    g_prog, g_startup = pt.Program(), pt.Program()
+    with pt.program_guard(g_prog, g_startup):
+        with pt.unique_name.guard():
+            z = layers.data("z", shape=[cfg.z_dim])
+            fake = _generator(z, cfg)
+            g_loss = _bce(_discriminator(fake, cfg), 1.0)
+            pt.optimizer.Adam(lr, beta1=0.5).minimize(
+                g_loss, parameter_list=G_PARAMS)
+
+    for prog in (d_prog, g_prog, startup, g_startup):
+        prog.random_seed = seed
+    return d_prog, g_prog, (startup, g_startup), d_loss, g_loss

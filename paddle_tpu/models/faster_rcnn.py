@@ -137,8 +137,17 @@ def build_program(cfg=None, batch_size=2):
         layers.fc(head, 4 * C, name="frcnn_bbox"),
         [batch_size, P, 4 * C])
 
-    rcnn_cls_loss = layers.mean(layers.softmax_with_cross_entropy(
-        cls_score, layers.reshape(slabels, [0, P, 1])))
+    # invalid sample slots carry label -1 (unfilled quotas): mask them
+    # out of the cls loss and renormalize by the valid count
+    lab3 = layers.reshape(slabels, [0, P, 1])
+    valid = layers.cast(layers.greater_equal(
+        lab3, layers.fill_constant([], "int32", 0)), "float32")
+    ce_all = layers.softmax_with_cross_entropy(
+        cls_score, layers.elementwise_max(
+            lab3, layers.fill_constant([], "int32", 0)))
+    rcnn_cls_loss = layers.elementwise_div(
+        layers.reduce_sum(layers.elementwise_mul(ce_all, valid)),
+        layers.elementwise_add(layers.reduce_sum(valid), one))
     rdiff = layers.elementwise_mul(
         layers.elementwise_sub(bbox_pred, stgts), sinw)
     rcnn_reg_loss = layers.elementwise_div(

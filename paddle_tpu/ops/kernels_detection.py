@@ -676,7 +676,13 @@ def _generate_proposal_labels(ctx, ins, attrs):
         idx = jnp.concatenate([fg_i, bg_i])
         ok = jnp.concatenate([fg_ok, bg_ok])
         is_fg = jnp.concatenate([fg_ok, jnp.zeros((n_bg,), bool)])
-        labels = jnp.where(is_fg, gc[gidx[idx]], 0)
+        # INVALID (unfilled-quota) slots get label -1 so the head's
+        # cls loss can ignore them — their fallback idx points at an
+        # arbitrary roi and training it as background would feed the
+        # classifier contradictory supervision. (The reference's LoD
+        # output has no invalid slots; -1 is this fixed-shape port's
+        # validity channel, matching rpn-style ignore conventions.)
+        labels = jnp.where(ok, jnp.where(is_fg, gc[gidx[idx]], 0), -1)
         pvar = jnp.broadcast_to(1.0 / weights, (P, 4))
         enc = _encode_boxes(gb[gidx[idx]], rs[idx], pvar)
         # scatter into per-class slots [P, 4*n_cls]

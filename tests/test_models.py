@@ -475,3 +475,47 @@ def test_faster_rcnn_two_stage_trains():
         for n, v in comps.items():
             assert np.isfinite(v) and v >= 0, (n, v)
     assert totals[-1] < totals[0], totals
+
+
+def test_dcgan_alternating_two_program_training():
+    """DCGAN: the alternating two-program pattern — d and g steps are
+    separate Programs sharing one scope by parameter name, each
+    optimizer restricted via minimize(parameter_list=...). Verifies
+    the isolation (a d step must NOT touch G params and vice versa)
+    and that both losses stay finite with D learning."""
+    from paddle_tpu.models import dcgan
+    cfg = dcgan.DCGANConfig()
+    d_prog, g_prog, startups, d_loss, g_loss = dcgan.build_programs(
+        cfg, lr=1e-3)
+    exe = pt.Executor(pt.CPUPlace())
+    for st in startups:
+        exe.run(st)
+    rng = np.random.RandomState(0)
+    real = np.tanh(rng.randn(16, 1, 16, 16)).astype("float32")
+
+    def gp():
+        return np.asarray(pt.global_scope().get("g_fc_w")).copy()
+
+    def dp():
+        return np.asarray(pt.global_scope().get("d_fc_w")).copy()
+
+    g0, d0 = gp(), dp()
+    z = rng.randn(16, cfg.z_dim).astype("float32")
+    exe.run(d_prog, feed={"z": z, "real": real}, fetch_list=[d_loss])
+    assert np.array_equal(g0, gp()), "d step leaked into G params"
+    assert not np.array_equal(d0, dp()), "d step did not update D"
+    d1 = dp()
+    exe.run(g_prog, feed={"z": z}, fetch_list=[g_loss])
+    assert np.array_equal(d1, dp()), "g step leaked into D params"
+    assert not np.array_equal(g0, gp()), "g step did not update G"
+
+    dls, gls = [], []
+    for _ in range(10):
+        z = rng.randn(16, cfg.z_dim).astype("float32")
+        dls.append(float(np.asarray(exe.run(
+            d_prog, feed={"z": z, "real": real},
+            fetch_list=[d_loss])[0])))
+        gls.append(float(np.asarray(exe.run(
+            g_prog, feed={"z": z}, fetch_list=[g_loss])[0])))
+    assert np.isfinite(dls).all() and np.isfinite(gls).all()
+    assert dls[-1] < dls[0], dls
