@@ -10,7 +10,8 @@ import numpy as np
 
 from .. import layers
 
-__all__ = ["transformer", "build_program", "TransformerConfig"]
+__all__ = ["transformer", "build_program", "build_infer_program",
+           "greedy_decode", "TransformerConfig"]
 
 
 class TransformerConfig:
@@ -163,3 +164,63 @@ def build_program(cfg=None, maxlen=None, use_noam=True, warmup=4000,
                                           layers.fill_constant([], "float32", 1.0)))
     feeds = [src, src_len, trg, trg_len, label]
     return feeds, avg_cost, token_count
+
+
+def build_infer_program(cfg=None, maxlen=None):
+    """Inference graph (no labels/loss): (feeds, logits [B,T,V]).
+
+    Same parameter names as build_program (build under a fresh
+    unique_name.guard in a fresh program so the trained scope binds),
+    the book's machine_translation inference surface."""
+    cfg = cfg or TransformerConfig.base()
+    T = maxlen or cfg.max_len
+    src = layers.data("src", shape=[T], dtype="int64")
+    src_len = layers.data("src_len", shape=[], dtype="int64",
+                          append_batch_size=True)
+    trg = layers.data("trg", shape=[T], dtype="int64")
+    trg_len = layers.data("trg_len", shape=[], dtype="int64",
+                          append_batch_size=True)
+    logits = transformer(src, src_len, trg, trg_len, cfg)
+    return ["src", "src_len", "trg", "trg_len"], logits
+
+
+def greedy_decode(exe, infer_program, logits_var, src, src_len, bos=0,
+                  eos=None):
+    """Autoregressive greedy decode through the compiled inference
+    program: ONE executable (static [B, T] shapes) run T-1 times, the
+    argmax at step t-1 fed back as token t. Returns ids [B, T]
+    (position 0 is `bos`). Stops early when every row has emitted
+    `eos` (the emitted tail after eos is garbage by construction —
+    mask on eos downstream, like the reference's post-processing).
+
+    T comes from src.shape[1] and must equal the maxlen the infer
+    program was built with (the graph bakes it into the attention
+    bias shapes). Fetching the [B,T,V] logits per step costs O(T*V)
+    host transfer; for production decode fetch an in-graph argmax
+    instead — this helper keeps the raw logits to stay usable for
+    sampling/beam scoring experiments at tiny configs."""
+    T = int(src.shape[1])
+    B = src.shape[0]
+    pvars = infer_program.global_block().vars
+    built_T = int(pvars["trg"].shape[-1])
+    if built_T != T:
+        raise ValueError(
+            f"src length {T} != infer program's built length "
+            f"{built_T}; rebuild build_infer_program(maxlen={T})")
+    ids = np.zeros((B, T), dtype=np.int64)
+    ids[:, 0] = bos
+    done = np.zeros((B,), bool)
+    for t in range(1, T):
+        out = exe.run(infer_program,
+                      feed={"src": src, "src_len": src_len,
+                            "trg": ids,
+                            "trg_len": np.full((B,), t, np.int64)},
+                      fetch_list=[logits_var], is_test=True)
+        step = np.asarray(out[0])[:, t - 1, :]        # [B, V]
+        nxt = step.argmax(-1)
+        ids[:, t] = nxt
+        if eos is not None:
+            done |= nxt == eos
+            if done.all():
+                break
+    return ids

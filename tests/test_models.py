@@ -519,3 +519,45 @@ def test_dcgan_alternating_two_program_training():
             g_prog, feed={"z": z}, fetch_list=[g_loss])[0])))
     assert np.isfinite(dls).all() and np.isfinite(gls).all()
     assert dls[-1] < dls[0], dls
+
+
+def test_transformer_greedy_decode_learns_copy_shift():
+    """Train the tiny transformer on the deterministic trg = src + 1
+    task until the loss is low, then greedy_decode from scratch (no
+    teacher forcing) must reproduce the shifted sequence."""
+    cfg = tfm.TransformerConfig(src_vocab=32, trg_vocab=32, max_len=8,
+                                d_model=32, d_inner=64, n_head=2,
+                                n_layer=1, dropout=0.0,
+                                label_smooth_eps=0.0)
+    T, B = 8, 16
+    feeds, avg_cost, tok = tfm.build_program(cfg, maxlen=T,
+                                             use_noam=False)
+    pt.optimizer.Adam(3e-3).minimize(avg_cost)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    src = rng.randint(2, 30, (B, T)).astype("int64")
+    trg = np.concatenate([np.zeros((B, 1), "int64"),
+                          (src[:, :-1] + 1)], axis=1)
+    label = src + 1
+    feed = {"src": src, "src_len": np.full(B, T, "int64"),
+            "trg": trg, "trg_len": np.full(B, T, "int64"),
+            "label": label}
+    loss = None
+    for i in range(300):
+        loss = float(np.asarray(exe.run(feed=feed,
+                                        fetch_list=[avg_cost])[0]))
+        if loss < 0.15:
+            break
+    assert loss < 0.5, loss
+
+    from paddle_tpu.core import framework as fw
+    infer = fw.Program()
+    with pt.program_guard(infer, fw.Program()):
+        with pt.unique_name.guard():
+            feeds_i, logits = tfm.build_infer_program(cfg, maxlen=T)
+    ids = tfm.greedy_decode(exe, infer, logits, src,
+                            np.full(B, T, "int64"), bos=0)
+    # positions 1..T-1 must reproduce src[:, :-1] + 1
+    acc = float((ids[:, 1:] == label[:, :-1]).mean())
+    assert acc > 0.9, (acc, ids[:2], label[:2])
