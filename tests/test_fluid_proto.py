@@ -284,3 +284,27 @@ def test_fluid_export_conv_roundtrip(tmp_path):
         str(tmp_path), pt.Executor())
     out, = pt.Executor().run(prog, feed={"img": x}, fetch_list=fetch_vars)
     np.testing.assert_allclose(np.asarray(out), ref_out, atol=1e-5)
+
+
+def test_fluid_export_ssd_inference_roundtrip(tmp_path):
+    """Cross-feature integration: the SSD inference graph (detection
+    ops with list/float attrs, prior boxes, NMS) survives the
+    reference-format export → import → execute roundtrip."""
+    from paddle_tpu.models import ssd
+    cfg = ssd.SSDConfig(image_size=32, num_classes=3, max_gt=4)
+    feeds_i, out = ssd.build_infer_program(cfg)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32")
+    ref_out = np.asarray(exe.run(feed={"image": x}, fetch_list=[out],
+                                 is_test=True)[0])
+    pt.io.save_inference_model(str(tmp_path), ["image"], [out], exe,
+                               program_format="fluid",
+                               params_filename="__params__")
+    _fresh()
+    prog, feeds2, fetch_vars = pt.io.load_inference_model(
+        str(tmp_path), pt.Executor(), params_filename="__params__")
+    assert feeds2 == ["image"]
+    got = np.asarray(pt.Executor().run(prog, feed={"image": x},
+                                       fetch_list=fetch_vars)[0])
+    np.testing.assert_allclose(got, ref_out, rtol=1e-5, atol=1e-6)
