@@ -11,7 +11,8 @@ import numpy as np
 from .. import layers
 
 __all__ = ["transformer", "build_program", "build_infer_program",
-           "greedy_decode", "TransformerConfig"]
+           "greedy_decode", "convert_qkv_checkpoint",
+           "TransformerConfig"]
 
 
 class TransformerConfig:
@@ -224,3 +225,36 @@ def greedy_decode(exe, infer_program, logits_var, src, src_len, bos=0,
             if done.all():
                 break
     return ids
+
+
+def convert_qkv_checkpoint(arrays, cfg, to_fused):
+    """Convert a parameter dict between the UNFUSED (per-projection
+    enc{i}_q.w_0 / _k / _v — the reference layout and this model's
+    default) and FUSED (enc{i}_qkv.w_0, dec{i}_cross_kv.w_0 — the perf
+    layout bench.py opts into) checkpoint layouts, in either
+    direction. Returns a new dict; non-attention entries pass through
+    unchanged. Fusion order matches multi_head_attention's split:
+    [q | k | v] (or [k | v]) along the output axis."""
+    out = dict(arrays)
+
+    def fuse(base, parts, fused_name):
+        names = [f"{base}_{p}.w_0" for p in parts]
+        if not all(n in out for n in names):
+            return
+        ws = [out.pop(n) for n in names]
+        out[fused_name] = np.concatenate(ws, axis=1)
+
+    def split(base, parts, fused_name):
+        if fused_name not in out:
+            return
+        w = out.pop(fused_name)
+        pieces = np.split(w, len(parts), axis=1)
+        for p, piece in zip(parts, pieces):
+            out[f"{base}_{p}.w_0"] = piece
+
+    op = fuse if to_fused else split
+    for i in range(cfg.n_layer):
+        op(f"enc{i}", ("q", "k", "v"), f"enc{i}_qkv.w_0")
+        op(f"dec{i}_self", ("q", "k", "v"), f"dec{i}_self_qkv.w_0")
+        op(f"dec{i}_cross", ("k", "v"), f"dec{i}_cross_kv.w_0")
+    return out

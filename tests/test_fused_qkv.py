@@ -105,3 +105,54 @@ def test_explicit_unfused_keeps_reference_names():
     names = " ".join(p.name for p in main.all_parameters())
     for tag in ("_q.w", "_k.w", "_v.w"):
         assert tag in names
+
+
+def test_convert_qkv_checkpoint_both_directions():
+    """A checkpoint saved in either q/k/v layout loads into the other
+    via convert_qkv_checkpoint with identical model outputs — the
+    checkpoint-stability story behind the fused_qkv opt-in."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import framework as fw, scope as sc
+    from paddle_tpu.models import transformer as tfm
+
+    T, B = 8, 4
+    rng = np.random.RandomState(0)
+    src = rng.randint(2, 30, (B, T)).astype("int64")
+    feed = {"src": src, "src_len": np.full(B, T, "int64"),
+            "trg": np.concatenate([np.zeros((B, 1), "int64"),
+                                   src[:, :-1] + 1], 1),
+            "trg_len": np.full(B, T, "int64")}
+
+    def build_and_logits(fused, params=None):
+        fw._main_program, fw._startup_program = fw.Program(), fw.Program()
+        sc._global_scope = sc.Scope()
+        cfg = tfm.TransformerConfig(
+            src_vocab=32, trg_vocab=32, max_len=T, d_model=16,
+            d_inner=32, n_head=2, n_layer=2, dropout=0.0,
+            fused_qkv=fused)
+        with pt.unique_name.guard():
+            feeds, logits = tfm.build_infer_program(cfg, maxlen=T)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        scope = pt.global_scope()
+        if params is not None:
+            for k, v in params.items():
+                scope.set(k, v)
+        names = [p.name for p in
+                 pt.default_main_program().all_parameters()]
+        vals = {n: np.asarray(scope.get(n)) for n in names}
+        out = np.asarray(exe.run(feed=feed, fetch_list=[logits],
+                                 is_test=True)[0])
+        return cfg, vals, out
+
+    cfg, unfused_params, ref_out = build_and_logits(fused=False)
+    fused_params = tfm.convert_qkv_checkpoint(unfused_params, cfg,
+                                              to_fused=True)
+    assert any(k.endswith("qkv.w_0") for k in fused_params)
+    _, _, fused_out = build_and_logits(fused=True, params=fused_params)
+    np.testing.assert_allclose(fused_out, ref_out, rtol=1e-5, atol=1e-5)
+
+    back = tfm.convert_qkv_checkpoint(fused_params, cfg, to_fused=False)
+    assert set(back) == set(unfused_params)
+    for k in back:
+        np.testing.assert_allclose(back[k], unfused_params[k])
