@@ -114,7 +114,7 @@ def build_program(cfg=None, batch_size=2):
         layers.sigmoid(cls_conv), bbox_conv, im_info, anchors, avar,
         pre_nms_top_n=M, post_nms_top_n=cfg.proposals, nms_thresh=0.7,
         min_size=2.0)
-    srois, slabels, stgts, sinw, soutw = det.generate_proposal_labels(
+    srois, slabels, stgts, sinw, _outw = det.generate_proposal_labels(
         rois, gt_label, gt_boxes=gt_box, im_info=im_info,
         batch_size_per_im=cfg.rcnn_samples, fg_thresh=0.5,
         class_nums=cfg.num_classes)
