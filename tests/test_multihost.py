@@ -147,6 +147,20 @@ def test_two_process_distributed_table_training(tmp_path):
     assert len(seqs) == 1, seqs
 
 
+def test_two_process_expert_parallel_moe(tmp_path):
+    """Switch-MoE with one expert per device over an ep axis spanning
+    both processes: the dispatch/combine all-to-alls cross the host
+    boundary; loss+grads finite and equal to a local-mesh reference of
+    the same expert count."""
+    outs = _spawn_workers(tmp_path, extra_args=("ep",))
+    vals = set()
+    for rc, out, err in outs:
+        assert f"RESULT ep-ok {_NPROC} {2 * _NPROC}" in out,             (out, err[-500:])
+        vals |= {line.split()[-1] for line in out.splitlines()
+                 if line.startswith("RESULT ep-ok")}
+    assert len(vals) == 1, vals   # both hosts agree on the loss
+
+
 def test_two_process_tensor_parallel_training(tmp_path):
     """dp x tp on the 2-process mesh (tp intra-host, dp across hosts):
     Megatron-sharded weights + cross-host grad all-reduce must equal
