@@ -26,6 +26,9 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--no-fuse-tail", action="store_true")
     ap.add_argument("--no-fused-qkv", action="store_true")
+    ap.add_argument("--flash-bf16-softmax", action="store_true",
+                    help="A/B the unvalidated bf16 flash softmax "
+                         "escape (ops/pallas/flash_attention.py)")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--seqlen", type=int, default=128)
     args = ap.parse_args()
@@ -42,6 +45,9 @@ def main():
 
     if args.no_fuse_tail:
         _trace.FUSE_OPTIMIZER_TAIL = False
+    if args.flash_bf16_softmax:
+        from paddle_tpu.ops.pallas import flash_attention as _fa
+        _fa.set_softmax_dtype(jnp.bfloat16)
 
     B, T = args.batch, args.seqlen
     main_p, startup = pt.Program(), pt.Program()

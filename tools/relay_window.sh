@@ -23,6 +23,11 @@ elif [ ! -s /tmp/relay_mfu_unfused.out ]; then
   timeout 430 python tools/mfu_probe.py --steps 10 --no-fused-qkv \
     >/tmp/relay_mfu_unfused.out 2>/tmp/relay_mfu_unfused.err
   tail -5 /tmp/relay_mfu_unfused.out
+elif [ ! -s /tmp/relay_mfu_bf16sm.out ]; then
+  echo "— capturing mfu_probe (bf16 flash softmax A/B)"
+  timeout 430 python tools/mfu_probe.py --steps 10 --flash-bf16-softmax \
+    >/tmp/relay_mfu_bf16sm.out 2>/tmp/relay_mfu_bf16sm.err
+  tail -5 /tmp/relay_mfu_bf16sm.out
 else
   echo "— all stages captured; rerunning bench to warm caches"
   BENCH_TOTAL_BUDGET_S=400 timeout 430 python bench.py \
