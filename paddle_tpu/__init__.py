@@ -12,6 +12,43 @@ the design map). Usage:
 """
 import jax as _jax
 
+# jax<0.5 compat shims (no-ops on newer jax): this codebase uses the
+# current public names; older images alias them back to their
+# pre-graduation homes so the package imports and runs on both.
+if not hasattr(_jax, "shard_map"):
+    # shard_map lived in jax.experimental, with check_rep instead of
+    # the renamed check_vma kwarg
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+        import functools as _functools
+
+        @_functools.wraps(_shard_map)
+        def _shard_map_compat(*args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(*args, **kwargs)
+
+        _jax.shard_map = _shard_map_compat
+    except ImportError:
+        pass
+if not hasattr(_jax.lax, "axis_size"):
+    # lax.axis_size(name) predates this jax; psum(1, name) is the
+    # classic spelling of the same (static) quantity
+    _jax.lax.axis_size = lambda axis_name: _jax.lax.psum(1, axis_name)
+if not hasattr(_jax, "enable_x64"):
+    try:
+        from jax.experimental import enable_x64 as _enable_x64
+        _jax.enable_x64 = _enable_x64
+    except ImportError:
+        pass
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+    if not hasattr(_pltpu, "CompilerParams") \
+            and hasattr(_pltpu, "TPUCompilerParams"):
+        _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+except ImportError:
+    pass
+
 # TPU-native PRNG: XLA's RngBitGenerator ("rbg") instead of JAX's default
 # threefry. threefry lowers to a long scalar-ish VPU program that costs
 # ~40% of a dropout-heavy train step on TPU; rbg is a hardware RNG
@@ -93,6 +130,7 @@ from . import transpiler
 from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
                          InferenceTranspiler, memory_optimize,
                          release_memory, HashName, RoundRobin)
+from . import analysis
 from . import contrib
 from .async_executor import AsyncExecutor
 from .data_feed_desc import DataFeedDesc

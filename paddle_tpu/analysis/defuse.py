@@ -1,0 +1,143 @@
+"""Def-use graph over Program/Block, following control-flow sub-blocks.
+
+The reference walks a C++ SSA graph (paddle/fluid/framework/ir); here the
+Program is a flat op list whose control-flow ops (cond / while_loop /
+scan / static_rnn) reference sub-blocks through attrs, so def-use edges
+must follow those attrs: a sub-block's free variables are reads of the
+owning op, and names the op binds inside the sub-block (loop carries,
+scan slices) are local definitions there, not parent reads.
+"""
+
+__all__ = ["OpNode", "DefUseGraph", "build_defuse",
+           "CONTROL_FLOW_TYPES", "MACRO_TYPES", "SUB_BLOCK_ATTRS",
+           "sub_block_indices", "sub_block_bound_names",
+           "control_flow_free_vars"]
+
+CONTROL_FLOW_TYPES = ("cond", "while_loop", "scan", "static_rnn")
+# op types executed by core/trace.py itself rather than a registry kernel
+MACRO_TYPES = CONTROL_FLOW_TYPES + ("backward_macro",)
+SUB_BLOCK_ATTRS = ("true_block", "false_block", "cond_block",
+                   "body_block", "step_block")
+
+
+def sub_block_indices(op):
+    """Block indices an op's attrs point at (empty for plain ops)."""
+    out = []
+    for key in SUB_BLOCK_ATTRS:
+        bidx = op.attrs.get(key)
+        if bidx is not None:
+            out.append(bidx)
+    return out
+
+
+def sub_block_bound_names(op):
+    """Names the control-flow op binds inside its sub-blocks (defined by
+    the op's execution machinery, not by any sub-block op)."""
+    a = op.attrs
+    bound = set()
+    if op.type == "while_loop":
+        bound |= set(a.get("carry_names", ()))
+    elif op.type == "scan":
+        for k in ("init_name", "x_name"):
+            if a.get(k):
+                bound.add(a[k])
+    elif op.type == "static_rnn":
+        bound |= {step for _, step in a.get("x_map", ())}
+        bound |= {prev for _, prev, _ in a.get("mem_map", ())}
+    return bound
+
+
+def control_flow_free_vars(program, op, _seen=None):
+    """Names `op`'s sub-blocks read but neither produce nor bind —
+    these are reads of the op itself at its position in the parent
+    block (mirrors core/trace.py:_sub_block_free_vars, plus the bound
+    names which trace.py seeds through env)."""
+    free = set()
+    seen = _seen if _seen is not None else set()
+    for bidx in sub_block_indices(op):
+        if bidx in seen or bidx >= len(program.blocks):
+            continue
+        seen.add(bidx)
+        sub = program.blocks[bidx]
+        produced = {n for o in sub.ops for n in o.output_names()}
+        produced |= sub_block_bound_names(op)
+        for o in sub.ops:
+            sub_free = set(o.input_names())
+            if o.type in CONTROL_FLOW_TYPES:
+                sub_free |= control_flow_free_vars(program, o, seen)
+            free |= sub_free - produced
+    return free
+
+
+class OpNode:
+    """One op occurrence with resolved read/write name sets."""
+
+    __slots__ = ("op", "block_idx", "op_idx", "reads", "writes")
+
+    def __init__(self, op, block_idx, op_idx, reads, writes):
+        self.op = op
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.reads = reads
+        self.writes = writes
+
+    def __repr__(self):
+        return (f"OpNode({self.op.type} @ b{self.block_idx}/{self.op_idx}, "
+                f"reads={sorted(self.reads)}, writes={sorted(self.writes)})")
+
+
+def _node_reads(program, op):
+    reads = set(op.input_names())
+    if op.type in CONTROL_FLOW_TYPES:
+        reads |= control_flow_free_vars(program, op)
+    if op.type == "backward_macro":
+        reads.add(op.attrs.get("loss_name"))
+        reads |= set(op.attrs.get("param_names", ()))
+        reads.discard(None)
+    return reads
+
+
+class DefUseGraph:
+    """Per-block op nodes + name -> defs/uses indices.
+
+    nodes[block_idx] is the block's ops in program order; defs/uses map a
+    var name to the OpNodes that write/read it anywhere in the program.
+    """
+
+    def __init__(self, program):
+        self.program = program
+        self.nodes = []
+        self.defs = {}
+        self.uses = {}
+        for b in program.blocks:
+            block_nodes = []
+            for i, op in enumerate(b.ops):
+                node = OpNode(op, b.idx, i, _node_reads(program, op),
+                              set(op.output_names()))
+                block_nodes.append(node)
+                for n in node.writes:
+                    self.defs.setdefault(n, []).append(node)
+                for n in node.reads:
+                    self.uses.setdefault(n, []).append(node)
+            self.nodes.append(block_nodes)
+
+    def block_nodes(self, block_idx=0):
+        return self.nodes[block_idx]
+
+    def defining_ops(self, name):
+        return list(self.defs.get(name, ()))
+
+    def consuming_ops(self, name):
+        return list(self.uses.get(name, ()))
+
+    def leaf_outputs(self, block_idx=0):
+        """Names written in `block_idx` but never read anywhere — the
+        implied fetch set when the caller gives none."""
+        written = set()
+        for node in self.nodes[block_idx]:
+            written |= node.writes
+        return {n for n in written if not self.uses.get(n)}
+
+
+def build_defuse(program):
+    return DefUseGraph(program)

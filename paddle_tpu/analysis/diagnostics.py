@@ -1,0 +1,119 @@
+"""Diagnostic model for the static program verifier (proglint).
+
+The reference surfaces IR-level problems through C++ `InferShape` /
+`OpDesc::Check` errors at op-append time; here a malformed Program would
+otherwise only fail deep inside JAX tracing (core/trace.py) with an XLA
+stack trace. Every analysis pass reports through this one Diagnostic
+shape so the CLI, the Executor gate, and graphviz annotation all consume
+the same records.
+"""
+
+__all__ = ["Diagnostic", "ProgramVerificationError",
+           "SEVERITIES", "ERROR", "WARNING", "INFO",
+           "format_diagnostics", "max_severity", "has_errors"]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+SEVERITIES = (ERROR, WARNING, INFO)
+_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+class Diagnostic:
+    """One finding from one analysis pass.
+
+    Fields:
+        severity   "error" | "warning" | "info"
+        pass_name  the analysis pass that produced it
+        message    human-readable statement of the defect
+        block_idx  block the finding anchors to (None = program-level)
+        op_idx     op index within the block (None = var-level finding)
+        op_type    op type string when op_idx is set
+        var_names  variable names involved
+        hint       one-line fix suggestion (may be "")
+    """
+
+    __slots__ = ("severity", "pass_name", "message", "block_idx",
+                 "op_idx", "op_type", "var_names", "hint")
+
+    def __init__(self, severity, pass_name, message, block_idx=None,
+                 op_idx=None, op_type=None, var_names=(), hint=""):
+        if severity not in SEVERITIES:
+            raise ValueError(f"bad severity {severity!r}")
+        self.severity = severity
+        self.pass_name = pass_name
+        self.message = message
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var_names = tuple(var_names)
+        self.hint = hint
+
+    def location(self):
+        parts = []
+        if self.block_idx is not None:
+            parts.append(f"block {self.block_idx}")
+        if self.op_idx is not None:
+            op = f"op {self.op_idx}"
+            if self.op_type:
+                op += f" ({self.op_type})"
+            parts.append(op)
+        return ", ".join(parts)
+
+    def sort_key(self):
+        return (_RANK[self.severity],
+                self.block_idx if self.block_idx is not None else -1,
+                self.op_idx if self.op_idx is not None else -1)
+
+    def to_dict(self):
+        return {"severity": self.severity, "pass": self.pass_name,
+                "message": self.message, "block_idx": self.block_idx,
+                "op_idx": self.op_idx, "op_type": self.op_type,
+                "var_names": list(self.var_names), "hint": self.hint}
+
+    def __str__(self):
+        loc = self.location()
+        s = f"[{self.severity}] {self.pass_name}"
+        if loc:
+            s += f" @ {loc}"
+        s += f": {self.message}"
+        if self.hint:
+            s += f"  (hint: {self.hint})"
+        return s
+
+    __repr__ = __str__
+
+
+def max_severity(diagnostics):
+    """Most severe level present, or None for a clean list."""
+    best = None
+    for d in diagnostics:
+        if best is None or _RANK[d.severity] < _RANK[best]:
+            best = d.severity
+    return best
+
+
+def has_errors(diagnostics):
+    return any(d.severity == ERROR for d in diagnostics)
+
+
+def format_diagnostics(diagnostics, limit=None):
+    """Multi-line report, most severe first."""
+    diags = sorted(diagnostics, key=Diagnostic.sort_key)
+    shown = diags if limit is None else diags[:limit]
+    lines = [str(d) for d in shown]
+    if limit is not None and len(diags) > limit:
+        lines.append(f"... and {len(diags) - limit} more")
+    return "\n".join(lines)
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised when verification finds error-severity diagnostics
+    (Program.verify(raise_on_error=True) / Executor.run(validate=True))."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        errors = [d for d in self.diagnostics if d.severity == ERROR]
+        super().__init__(
+            f"program verification failed with {len(errors)} error(s):\n"
+            + format_diagnostics(self.diagnostics, limit=20))

@@ -1,0 +1,80 @@
+"""Analysis pass pipeline: run registered passes over a Program.
+
+Entry points:
+    run_passes(program, fetch_list=..., ...)  -> [Diagnostic]
+    verify_program(program, ...)              -> [Diagnostic], raising
+        ProgramVerificationError on error-severity findings when asked.
+
+Passes register via @analysis_pass (passes.py); callers can restrict to
+a subset by name, and new passes (later PRs: layout lint, collective
+deadlock checks, ...) join the pipeline by registering.
+"""
+from .defuse import build_defuse
+from .diagnostics import (Diagnostic, ProgramVerificationError, INFO,
+                          has_errors)
+from . import passes as _passes
+
+__all__ = ["AnalysisContext", "run_passes", "verify_program"]
+
+
+class AnalysisContext:
+    """Shared read-only state handed to every pass."""
+
+    def __init__(self, program, fetch_names=(), feed_names=()):
+        self.program = program
+        self.fetch_names = tuple(fetch_names or ())
+        self.feed_names = tuple(feed_names or ())
+        self._graph = None
+
+    @property
+    def graph(self):
+        """Def-use graph, built lazily (passes that don't need it keep
+        verification cheap on huge programs)."""
+        if self._graph is None:
+            self._graph = build_defuse(self.program)
+        return self._graph
+
+
+def _normalize_names(items):
+    return [x.name if hasattr(x, "name") else x for x in (items or ())]
+
+
+def run_passes(program, fetch_list=None, feed_names=None, passes=None):
+    """Run the analysis pipeline; returns diagnostics sorted most
+    severe first. `passes` restricts to a subset of pass names. A pass
+    that itself crashes becomes an info diagnostic instead of killing
+    verification — the verifier must never be the thing that breaks a
+    run."""
+    ctx = AnalysisContext(program,
+                          fetch_names=_normalize_names(fetch_list),
+                          feed_names=_normalize_names(feed_names))
+    selected = list(_passes.PASSES)
+    if passes is not None:
+        wanted = set(passes)
+        unknown = wanted - {n for n, _ in selected}
+        if unknown:
+            raise ValueError(
+                f"unknown analysis pass(es): {sorted(unknown)} "
+                f"(available: {_passes.pass_names()})")
+        selected = [(n, f) for n, f in selected if n in wanted]
+    diags = []
+    for name, fn in selected:
+        try:
+            diags.extend(fn(ctx) or [])
+        except Exception as e:
+            diags.append(Diagnostic(
+                INFO, name,
+                f"analysis pass crashed: {type(e).__name__}: {e}",
+                hint="report this — a verifier pass should handle any "
+                     "well-formed Program"))
+    diags.sort(key=Diagnostic.sort_key)
+    return diags
+
+
+def verify_program(program, fetch_list=None, feed_names=None, passes=None,
+                   raise_on_error=False):
+    diags = run_passes(program, fetch_list=fetch_list,
+                       feed_names=feed_names, passes=passes)
+    if raise_on_error and has_errors(diags):
+        raise ProgramVerificationError(diags)
+    return diags

@@ -9,6 +9,7 @@ happen in-place in HBM, and one compiled module per step replaces per-op
 kernel launches (BASELINE.json north-star).
 """
 import logging
+import os
 import time
 
 import numpy as np
@@ -209,8 +210,28 @@ class Executor:
                     f"NaN/Inf detected in fetched var {name!r}")
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _validate_requested(validate):
+        """Resolve the run(validate=...) tri-state: None defers to the
+        PADDLE_TPU_VALIDATE env toggle."""
+        if validate is not None:
+            return bool(validate)
+        return os.environ.get("PADDLE_TPU_VALIDATE", "").lower() \
+            not in ("", "0", "false", "off")
+
+    @staticmethod
+    def _pre_trace_validate(program, fetch_names, feed_names):
+        """Run the static verifier (paddle_tpu/analysis) before tracing;
+        error-severity diagnostics raise ProgramVerificationError with
+        IR-level locations instead of letting the trace die inside JAX
+        with an XLA stack trace."""
+        from ..analysis import verify_program
+        verify_program(program, fetch_list=fetch_names,
+                       feed_names=feed_names, raise_on_error=True)
+
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, use_program_cache=True, is_test=None):
+            return_numpy=True, use_program_cache=True, is_test=None,
+            validate=None):
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
@@ -245,6 +266,11 @@ class Executor:
         first_run = ckey not in self._seen_keys
         self._seen_keys.add(ckey)
         if fn is None:
+            # opt-in pre-trace verification gate: pay it once per compile
+            # (cache hits skip it), catching IR defects before JAX does
+            if self._validate_requested(validate):
+                self._pre_trace_validate(program, fetch_names,
+                                         list(feed_arrays))
             step_fn = build_step_fn(program, fetch_names, is_test, self.place)
 
             # the PRNG key is derived ON DEVICE from a donated step

@@ -91,16 +91,22 @@ class Operator:
     def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
         self.block = block
         self.type = type
-        self.inputs = {k: list(v) if isinstance(v, (list, tuple)) else [v]
-                       for k, v in (inputs or {}).items()}
-        self.outputs = {k: list(v) if isinstance(v, (list, tuple)) else [v]
-                        for k, v in (outputs or {}).items()}
+        # store names, not Variable objects, for serialization; None
+        # entries (optional slots, e.g. bias_attr=False) are dropped so
+        # slot lists are clean for the analysis def-use builder
+        self.inputs = self._normalize_slots(inputs)
+        self.outputs = self._normalize_slots(outputs)
         self.attrs = dict(attrs or {})
-        # store names, not Variable objects, for serialization
-        self.inputs = {k: [v.name if isinstance(v, Variable) else v for v in vs]
-                       for k, vs in self.inputs.items()}
-        self.outputs = {k: [v.name if isinstance(v, Variable) else v for v in vs]
-                        for k, vs in self.outputs.items()}
+
+    @staticmethod
+    def _normalize_slots(slots):
+        out = {}
+        for k, vs in (slots or {}).items():
+            if not isinstance(vs, (list, tuple)):
+                vs = (vs,)
+            out[k] = [v.name if isinstance(v, Variable) else v
+                      for v in vs if v is not None]
+        return out
 
     def input_names(self):
         return [n for vs in self.inputs.values() for n in vs]
@@ -224,6 +230,21 @@ class Program:
             if v.persistable:
                 seen[v.name] = v
         return list(seen.values())
+
+    # -- static analysis (paddle_tpu/analysis — proglint) ------------------
+    def verify(self, fetch_list=None, feed_names=None, passes=None,
+               raise_on_error=False):
+        """Run the static verifier/lint pipeline over this program and
+        return a list of analysis.Diagnostic (most severe first).
+
+        fetch_list enables dead-code reachability; feed_names are names
+        guaranteed materialized at step start (is_data/persistable vars
+        are always assumed). With raise_on_error=True, error-severity
+        findings raise analysis.ProgramVerificationError."""
+        from ..analysis import verify_program
+        return verify_program(self, fetch_list=fetch_list,
+                              feed_names=feed_names, passes=passes,
+                              raise_on_error=raise_on_error)
 
     # -- cloning (ref Program.clone(for_test=True)) ------------------------
     def clone(self, for_test=False):

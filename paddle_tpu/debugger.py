@@ -27,19 +27,43 @@ def pprint_program(program, show_vars=False):
     return "\n".join(lines)
 
 
-def draw_block_graphviz(block, highlights=None, path="./graph.dot"):
-    """Emit a graphviz dot file of the op/var graph (ref debugger.py)."""
-    from .graphviz import Graph
+def draw_block_graphviz(block, highlights=None, path="./graph.dot",
+                        diagnostics=None):
+    """Emit a graphviz dot file of the op/var graph (ref debugger.py).
+
+    `diagnostics` (analysis.Diagnostic list, e.g. from Program.verify)
+    paints flagged ops/vars by severity — red errors, orange warnings,
+    blue infos — and appends the pass name to flagged op labels, so
+    `tools/proglint.py --dot` produces annotated graphs."""
+    from .graphviz import Graph, severity_style
     highlights = set(highlights or ())
+    op_sev = {}    # op idx -> [severity], var name -> [severity]
+    var_sev = {}
+    op_passes = {}
+    for d in (diagnostics or ()):
+        if d.block_idx is not None and d.block_idx != block.idx:
+            continue
+        if d.op_idx is not None:
+            op_sev.setdefault(d.op_idx, []).append(d.severity)
+            op_passes.setdefault(d.op_idx, []).append(d.pass_name)
+        for name in d.var_names:
+            var_sev.setdefault(name, []).append(d.severity)
     g = Graph("G", rankdir="TB")
 
     def var_node(name):
-        return g.add_unique_node(name, prefix="var", shape="ellipse")
+        attrs = dict(shape="ellipse")
+        attrs.update(severity_style(var_sev.get(name, ())))
+        return g.add_unique_node(name, prefix="var", **attrs)
 
-    for op in block.ops:
-        op_node = g.add_node(
-            op.type, prefix="op", shape="box", style="filled",
-            fillcolor="yellow" if op.type in highlights else "lightgray")
+    for i, op in enumerate(block.ops):
+        attrs = dict(shape="box", style="filled",
+                     fillcolor="yellow" if op.type in highlights
+                     else "lightgray")
+        attrs.update(severity_style(op_sev.get(i, ())))
+        label = op.type
+        if i in op_passes:
+            label += "\\n!" + ",".join(sorted(set(op_passes[i])))
+        op_node = g.add_node(label, prefix="op", **attrs)
         for name in op.input_names():
             g.add_edge(var_node(name), op_node)
         for name in op.output_names():

@@ -10,7 +10,31 @@ import os
 import shutil
 import subprocess
 
-__all__ = ["Graph", "Node", "Edge", "GraphPreviewGenerator"]
+__all__ = ["Graph", "Node", "Edge", "GraphPreviewGenerator",
+           "SEVERITY_COLORS", "severity_style"]
+
+# analysis.Diagnostic severity -> fill color for annotated graphs
+# (tools/proglint.py --dot); error outranks warning outranks info
+SEVERITY_COLORS = {
+    "error": "#e41a1c",    # red
+    "warning": "#ff9f36",  # orange
+    "info": "#8ecbff",     # light blue
+}
+_SEVERITY_RANK = {"error": 0, "warning": 1, "info": 2}
+
+
+def severity_style(severities):
+    """Node style attrs for the most severe level in `severities`
+    (a Diagnostic severity string or an iterable of them); {} when
+    nothing is flagged."""
+    if isinstance(severities, str):
+        severities = (severities,)
+    levels = [s for s in severities if s in _SEVERITY_RANK]
+    if not levels:
+        return {}
+    worst = min(levels, key=_SEVERITY_RANK.__getitem__)
+    return {"style": "filled", "fillcolor": SEVERITY_COLORS[worst],
+            "penwidth": 2}
 
 
 def _quote(s):

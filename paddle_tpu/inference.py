@@ -126,6 +126,9 @@ class InferenceEngine:
             cost = compiled.cost_analysis()
         except Exception:
             cost = {}
+        if isinstance(cost, (list, tuple)):
+            # older jax wraps the per-executable dict in a list
+            cost = cost[0] if cost else {}
         return {"flops": cost.get("flops"),
                 "bytes accessed": cost.get("bytes accessed"),
                 "signature": sorted(feed_shapes.items())}

@@ -90,18 +90,56 @@ def lib():
     return L
 
 
-def probe(plugin_path):
-    """(rc, major, minor, num_devices, error) for a plugin .so."""
+# child body for the isolated probe: raw ctypes against the built .so,
+# no paddle_tpu/jax import (keeps the sacrificial process cheap)
+_PROBE_CHILD = """
+import ctypes, json, sys
+L = ctypes.CDLL(sys.argv[1])
+L.ptpu_last_error.restype = ctypes.c_char_p
+L.ptpu_plugin_probe.argtypes = [ctypes.c_char_p] + \
+    [ctypes.POINTER(ctypes.c_int)] * 3
+major = ctypes.c_int(-1); minor = ctypes.c_int(-1); ndev = ctypes.c_int(-1)
+rc = L.ptpu_plugin_probe(sys.argv[2].encode(), ctypes.byref(major),
+                         ctypes.byref(minor), ctypes.byref(ndev))
+err = L.ptpu_last_error().decode("utf-8", "replace") if rc else ""
+print(json.dumps([rc, major.value, minor.value, ndev.value, err]))
+"""
+
+
+def probe(plugin_path, isolate=True):
+    """(rc, major, minor, num_devices, error) for a plugin .so.
+
+    rc 0 = full client; 1 = plugin loaded, client create failed with a
+    clean error; -1 = load failure; -2 = the plugin CRASHED during the
+    probe. By default the probe runs in a sacrificial subprocess: a
+    plugin that abort()s while loading (observed with relay plugins
+    probed without a session) must report as rc=-2, not take the whole
+    caller process down."""
     L = lib()
     if L is None:
         return None
-    major = ctypes.c_int(-1)
-    minor = ctypes.c_int(-1)
-    ndev = ctypes.c_int(-1)
-    rc = L.ptpu_plugin_probe(plugin_path.encode(), ctypes.byref(major),
-                             ctypes.byref(minor), ctypes.byref(ndev))
-    err = L.ptpu_last_error().decode("utf-8", "replace") if rc else ""
-    return rc, major.value, minor.value, ndev.value, err
+    if not isolate:
+        major = ctypes.c_int(-1)
+        minor = ctypes.c_int(-1)
+        ndev = ctypes.c_int(-1)
+        rc = L.ptpu_plugin_probe(plugin_path.encode(),
+                                 ctypes.byref(major), ctypes.byref(minor),
+                                 ctypes.byref(ndev))
+        err = L.ptpu_last_error().decode("utf-8", "replace") if rc else ""
+        return rc, major.value, minor.value, ndev.value, err
+    import json
+    import sys
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CHILD, _SO, plugin_path],
+            capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        return -2, -1, -1, -1, "plugin probe timed out"
+    if proc.returncode == 0 and proc.stdout.strip():
+        return tuple(json.loads(proc.stdout.strip().splitlines()[-1]))
+    return (-2, -1, -1, -1,
+            f"plugin crashed during probe (exit {proc.returncode}): "
+            f"{proc.stderr.strip()[-300:]}")
 
 
 class NativePredictor:

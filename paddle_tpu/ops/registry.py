@@ -15,8 +15,8 @@ Kernel signature:
     .place    the target Place
 """
 
-__all__ = ["kernel", "get_kernel", "has_kernel", "KernelCtx", "KERNELS",
-           "autocast"]
+__all__ = ["kernel", "get_kernel", "has_kernel", "closest_kernels",
+           "KernelCtx", "KERNELS", "autocast"]
 
 KERNELS = {}
 
@@ -56,12 +56,24 @@ def kernel(*types):
     return deco
 
 
+def closest_kernels(type, n=3, cutoff=0.6):
+    """Closest registered op type names to `type` (difflib ratio) —
+    shared by get_kernel's error message and the analysis unknown-op
+    pass."""
+    import difflib
+    return difflib.get_close_matches(type, list(KERNELS), n=n,
+                                     cutoff=cutoff)
+
+
 def get_kernel(type):
     fn = KERNELS.get(type)
     if fn is None:
+        suggestions = closest_kernels(type)
+        hint = (f"; did you mean {', '.join(map(repr, suggestions))}?"
+                if suggestions else "")
         raise NotImplementedError(
             f"no kernel registered for op type {type!r} "
-            f"(registered: {len(KERNELS)} ops)")
+            f"(registered: {len(KERNELS)} ops){hint}")
     return fn
 
 

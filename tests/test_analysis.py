@@ -1,0 +1,314 @@
+"""Static program verifier (paddle_tpu.analysis) — seeded-defect
+fixtures assert each pass fires exactly once with the right location,
+plus clean-program negative cases and the executor/graphviz wiring."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.analysis import (Diagnostic, ProgramVerificationError,
+                                 build_defuse, has_errors, pass_names,
+                                 run_passes)
+
+
+def _of_pass(diags, name):
+    return [d for d in diags if d.pass_name == name]
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity == "error"]
+
+
+def _mlp_program():
+    """A small clean train program: data -> fc -> loss -> sgd."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = layers.fc(img, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# seeded defects: each pass fires exactly once, at the right op
+# ---------------------------------------------------------------------------
+def test_use_before_def_fires_once():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        blk.create_var(name="ghost", shape=(-1, 8), dtype="float32")
+        out = blk.create_var(name="out", shape=(-1, 8), dtype="float32")
+        blk.append_op("elementwise_add", {"X": [x], "Y": ["ghost"]},
+                      {"Out": [out]})
+    diags = _of_pass(main.verify(fetch_list=["out"]), "use-before-def")
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.severity == "error" and d.op_idx == 0
+    assert d.var_names == ("ghost",)
+
+
+def test_use_before_def_clean_when_fed():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        blk.create_var(name="extra", shape=(-1, 8), dtype="float32")
+        out = blk.create_var(name="out", shape=(-1, 8), dtype="float32")
+        blk.append_op("elementwise_add", {"X": [x], "Y": ["extra"]},
+                      {"Out": [out]})
+    diags = main.verify(fetch_list=["out"], feed_names=["extra"])
+    assert not _of_pass(diags, "use-before-def")
+
+
+def test_unknown_op_fires_once_with_suggestion():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        out = blk.create_var(name="out", shape=(-1, 8))
+        blk.append_op("reluu", {"X": [x]}, {"Out": [out]})
+    diags = _of_pass(main.verify(fetch_list=["out"]), "unknown-op")
+    assert len(diags) == 1
+    assert diags[0].severity == "error" and diags[0].op_idx == 0
+    assert "relu" in diags[0].hint
+
+
+def test_dead_code_fires_once():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        live = blk.create_var(name="live", shape=(-1, 8))
+        dead = blk.create_var(name="dead", shape=(-1, 8))
+        blk.append_op("relu", {"X": [x]}, {"Out": [live]})
+        blk.append_op("sigmoid", {"X": [x]}, {"Out": [dead]})
+    diags = _of_pass(main.verify(fetch_list=["live"]), "dead-code")
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.severity == "warning" and d.op_idx == 1
+    assert "dead" in d.var_names
+    # without a fetch set, reachability is undefined — pass stays quiet
+    assert not _of_pass(main.verify(), "dead-code")
+
+
+def test_dtype_mismatch_fires_once():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        out = blk.create_var(name="out", shape=(-1, 8), dtype="int32")
+        blk.append_op("relu", {"X": [x]}, {"Out": [out]})
+    diags = _of_pass(main.verify(fetch_list=["out"]), "shape-dtype")
+    assert len(diags) == 1
+    assert diags[0].severity == "error" and diags[0].op_idx == 0
+    assert "int32" in diags[0].message
+
+
+def test_shape_mismatch_fires_once():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        out = blk.create_var(name="out", shape=(-1, 16), dtype="float32")
+        blk.append_op("relu", {"X": [x]}, {"Out": [out]})
+    diags = _of_pass(main.verify(fetch_list=["out"]), "shape-dtype")
+    assert len(diags) == 1
+    assert diags[0].severity == "error" and diags[0].op_idx == 0
+
+
+def test_waw_hazard_fires_once():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        out = blk.create_var(name="out", shape=(-1, 8), dtype="float32")
+        blk.append_op("relu", {"X": [x]}, {"Out": [out]})
+        blk.append_op("sigmoid", {"X": [x]}, {"Out": [out]})
+    diags = _of_pass(main.verify(fetch_list=["out"]), "waw-hazard")
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.severity == "warning" and d.op_idx == 1
+    assert d.var_names == ("out",)
+
+
+def test_waw_inplace_update_is_clean():
+    """ParamOut == Param (optimizer-style in-place write) must pass."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        blk = main.global_block()
+        p = blk.create_var(name="p", shape=(8,), dtype="float32",
+                           persistable=True)
+        blk.append_op("scale", {"X": [p]}, {"Out": [p]}, {"scale": 0.5})
+        blk.append_op("scale", {"X": [p]}, {"Out": [p]}, {"scale": 2.0})
+    assert not _of_pass(main.verify(), "waw-hazard")
+
+
+def test_recompile_hazard_callable_attr():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        out = blk.create_var(name="out", shape=(-1, 8))
+        blk.append_op("relu", {"X": [x]}, {"Out": [out]},
+                      {"cb": lambda a: a})
+    diags = _of_pass(main.verify(fetch_list=["out"]), "recompile-hazard")
+    assert len(diags) == 1
+    assert diags[0].severity == "warning" and diags[0].op_idx == 0
+    assert "callable" in diags[0].message
+
+
+def test_recompile_hazard_array_attr_and_feed_dims():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        # non-leading unknown dim: one warning
+        x = layers.data("x", shape=[8, -1], append_batch_size=False)
+        blk = main.global_block()
+        out = blk.create_var(name="out", shape=(8, -1))
+        # 100-element array baked into attrs: one warning
+        blk.append_op("relu", {"X": [x]}, {"Out": [out]},
+                      {"table": np.zeros(100, np.float32)})
+    diags = _of_pass(main.verify(), "recompile-hazard")
+    assert len(diags) == 2
+    msgs = " | ".join(d.message for d in diags)
+    assert "array" in msgs and "non-leading" in msgs
+
+
+# ---------------------------------------------------------------------------
+# clean-program negative cases
+# ---------------------------------------------------------------------------
+def test_clean_train_program_has_no_findings():
+    main, startup, loss = _mlp_program()
+    assert main.verify(fetch_list=[loss]) == []
+    assert not has_errors(startup.verify())
+
+
+def test_clean_inference_clone_has_no_errors():
+    main, _, loss = _mlp_program()
+    infer = main.clone(for_test=True)
+    assert not has_errors(infer.verify(fetch_list=[loss.name]))
+
+
+# ---------------------------------------------------------------------------
+# pipeline plumbing
+# ---------------------------------------------------------------------------
+def test_pass_selection_and_unknown_pass():
+    main, _, loss = _mlp_program()
+    assert run_passes(main, fetch_list=[loss],
+                      passes=["unknown-op"]) == []
+    with pytest.raises(ValueError, match="unknown analysis pass"):
+        run_passes(main, passes=["nope"])
+    assert "shape-dtype" in pass_names()
+
+
+def test_verify_raise_on_error():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        out = blk.create_var(name="out", shape=(-1, 8))
+        blk.append_op("not_an_op", {"X": [x]}, {"Out": [out]})
+    with pytest.raises(ProgramVerificationError) as ei:
+        main.verify(fetch_list=["out"], raise_on_error=True)
+    assert any(d.pass_name == "unknown-op" for d in ei.value.diagnostics)
+
+
+def test_diagnostic_ordering_and_dict():
+    d_err = Diagnostic("error", "p", "m", block_idx=0, op_idx=3)
+    d_warn = Diagnostic("warning", "p", "m", block_idx=0, op_idx=1)
+    assert sorted([d_warn, d_err], key=Diagnostic.sort_key)[0] is d_err
+    rec = d_err.to_dict()
+    assert rec["severity"] == "error" and rec["op_idx"] == 3
+
+
+def test_defuse_graph_structure():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        mid = blk.create_var(name="mid", shape=(-1, 8))
+        out = blk.create_var(name="out", shape=(-1, 8))
+        blk.append_op("relu", {"X": [x]}, {"Out": [mid]})
+        blk.append_op("sigmoid", {"X": [mid]}, {"Out": [out]})
+    g = build_defuse(main)
+    assert [n.op.type for n in g.block_nodes(0)] == ["relu", "sigmoid"]
+    assert g.defining_ops("mid")[0].op_idx == 0
+    assert g.consuming_ops("mid")[0].op_idx == 1
+    assert g.leaf_outputs(0) == {"out"}
+
+
+# ---------------------------------------------------------------------------
+# executor gate
+# ---------------------------------------------------------------------------
+def _broken_program():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[8])
+        blk = main.global_block()
+        out = blk.create_var(name="out", shape=(-1, 8))
+        blk.append_op("reluu", {"X": [x]}, {"Out": [out]})
+    return main
+
+
+def test_executor_validate_gate_raises():
+    exe = fluid.Executor(fluid.CPUPlace())
+    main = _broken_program()
+    feed = {"x": np.zeros((2, 8), np.float32)}
+    with pytest.raises(ProgramVerificationError):
+        exe.run(main, feed=feed, fetch_list=["out"], validate=True)
+
+
+def test_executor_validate_env_gate(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "1")
+    exe = fluid.Executor(fluid.CPUPlace())
+    main = _broken_program()
+    feed = {"x": np.zeros((2, 8), np.float32)}
+    with pytest.raises(ProgramVerificationError):
+        exe.run(main, feed=feed, fetch_list=["out"])
+
+
+def test_executor_validate_clean_program_runs():
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup, loss = _mlp_program()
+    exe.run(startup)
+    feed = {"img": np.random.rand(4, 8).astype(np.float32),
+            "label": np.random.randint(0, 4, (4, 1))}
+    out = exe.run(main, feed=feed, fetch_list=[loss], validate=True)
+    assert np.isfinite(out[0]).all()
+
+
+# ---------------------------------------------------------------------------
+# satellites: registry suggestions, Operator normalization, graphviz
+# ---------------------------------------------------------------------------
+def test_get_kernel_suggests_closest():
+    from paddle_tpu.ops.registry import get_kernel, closest_kernels
+    assert "relu" in closest_kernels("reluu")
+    with pytest.raises(NotImplementedError, match="did you mean"):
+        get_kernel("sofmax")
+
+
+def test_operator_slot_normalization():
+    main = fluid.Program()
+    blk = main.global_block()
+    v = blk.create_var(name="v", shape=(2,))
+    op = blk.append_op("relu",
+                       inputs={"X": v, "Opt": [None, "kept", None]},
+                       outputs={"Out": ["o"]})
+    assert op.inputs["X"] == ["v"]          # scalar -> list, Var -> name
+    assert op.inputs["Opt"] == ["kept"]     # None entries dropped
+    assert op.output_names() == ["o"]
+
+
+def test_draw_block_graphviz_diagnostics(tmp_path):
+    from paddle_tpu.debugger import draw_block_graphviz
+    from paddle_tpu.graphviz import SEVERITY_COLORS
+    main = _broken_program()
+    diags = main.verify(fetch_list=["out"])
+    assert has_errors(diags)
+    path = draw_block_graphviz(main.global_block(), diagnostics=diags,
+                               path=str(tmp_path / "g.dot"))
+    dot = open(path).read()
+    assert SEVERITY_COLORS["error"] in dot
+    assert "unknown-op" in dot

@@ -73,6 +73,12 @@ def test_probe_reports_version_and_clean_errors(built):
     if plugin is None:
         pytest.skip("no PJRT plugin .so on this machine")
     rc, major, minor, ndev, err = npred.probe(plugin)
+    # rc -2 = the plugin itself crashes while loading on this host; the
+    # probe's subprocess isolation turned that into a clean result
+    # (which is the property under test), but version/device assertions
+    # are unreachable — skip rather than blame the probe
+    if rc == -2:
+        pytest.skip(f"plugin crashes during probe on this host: {err}")
     # rc 0 = full client; 1 = plugin loaded, client create failed with
     # a clean error (the axon relay without session options, or libtpu
     # without a chip); -1 (load failure) is the only unacceptable case
@@ -100,6 +106,9 @@ def test_cli_probe_only(built, model_dir):
     plugin = npred.find_plugin()
     if plugin is None:
         pytest.skip("no PJRT plugin .so on this machine")
+    rc = npred.probe(plugin)[0]
+    if rc == -2:
+        pytest.skip("plugin crashes during probe on this host")
     exe = os.path.join(NATIVE_DIR, "ptpu_predict")
     p = subprocess.run([exe, model_dir, plugin, "--probe-only"],
                        capture_output=True, text=True, timeout=120)

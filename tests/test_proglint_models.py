@@ -1,0 +1,42 @@
+"""proglint over every benchmark model (tier-1, CPU-only): each
+benchmark/fluid/models/ program must verify with zero error-severity
+diagnostics, and the CLI must exit 0 over all of them."""
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import proglint  # noqa: E402
+
+
+@pytest.mark.parametrize("model", proglint.ALL_MODELS)
+def test_model_program_verifies_clean(model):
+    diags, n_ops = proglint.lint_model(model)
+    assert n_ops > 0
+    errors = [d for d in diags if d.severity == "error"]
+    assert not errors, "\n".join(str(d) for d in errors)
+
+
+def test_cli_exits_zero_over_all_models(capsys):
+    rc = proglint.main(["--quiet"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for model in proglint.ALL_MODELS:
+        assert model in out
+
+
+def test_cli_strict_flags_warnings():
+    # stacked_dynamic_lstm builds accuracy ops that are dead relative to
+    # a loss-only fetch set — warnings, so default passes, strict fails
+    assert proglint.main(["stacked_dynamic_lstm", "--quiet"]) == 0
+    assert proglint.main(["stacked_dynamic_lstm", "--strict",
+                          "--quiet"]) == 1
+
+
+def test_cli_dot_output(tmp_path):
+    rc = proglint.main(["mnist", "--dot", str(tmp_path), "--quiet"])
+    assert rc == 0
+    assert (tmp_path / "mnist.dot").exists()

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""proglint — static verifier CLI over the benchmark model programs.
+
+Builds every model in benchmark/fluid/models/ (forward + backward +
+optimizer, exactly like fluid_benchmark.py) and runs the
+paddle_tpu.analysis pass pipeline over the resulting Programs. Exit
+status is non-zero when any error-severity diagnostic fires (or any
+warning with --strict), so this doubles as a CI gate.
+
+Examples:
+  python tools/proglint.py                      # all models
+  python tools/proglint.py mnist resnet         # a subset
+  python tools/proglint.py --dot /tmp/lint      # annotated .dot graphs
+  python tools/proglint.py --json               # machine-readable
+"""
+import argparse
+import json
+import os
+import sys
+import types
+
+# static analysis never needs an accelerator; also keeps the CLI usable
+# on machines whose TPU is held by a training job
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "benchmark", "fluid"))
+
+ALL_MODELS = ["machine_translation", "resnet", "vgg", "mnist",
+              "stacked_dynamic_lstm", "se_resnext"]
+
+
+def model_args(batch_size=32):
+    """The slice of benchmark/fluid/args.py defaults the model builders
+    read (vision models look at data_set; the rest take none)."""
+    return types.SimpleNamespace(
+        batch_size=batch_size, data_set="cifar10", data_format="NCHW",
+        learning_rate=0.001, infer_only=False, use_bf16=False)
+
+
+def build_model_programs(name, args=None):
+    """(main_program, startup_program, loss_var) for one benchmark
+    model, built the same way fluid_benchmark.py builds it."""
+    import paddle_tpu as fluid
+    args = args or model_args()
+    model_mod = __import__(f"models.{name}", fromlist=["get_model"])
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        with fluid.unique_name.guard():
+            loss, _ = model_mod.get_model(args)
+            opt = fluid.optimizer.Adam(args.learning_rate) \
+                if name == "machine_translation" \
+                else fluid.optimizer.Momentum(args.learning_rate, 0.9)
+            if not args.infer_only:
+                opt.minimize(loss)
+    return main_p, startup_p, loss
+
+
+def lint_model(name, dot_dir=None):
+    """Verify one model; returns (diagnostics, op_count)."""
+    main_p, startup_p, loss = build_model_programs(name)
+    diags = main_p.verify(fetch_list=[loss])
+    # the startup program initializes state: its fetch set is empty by
+    # design, so skip dead-code there (every op writes persistables)
+    diags += startup_p.verify()
+    if dot_dir:
+        from paddle_tpu.debugger import draw_block_graphviz
+        os.makedirs(dot_dir, exist_ok=True)
+        draw_block_graphviz(main_p.global_block(), diagnostics=diags,
+                            path=os.path.join(dot_dir, f"{name}.dot"))
+    n_ops = sum(len(b.ops) for b in main_p.blocks)
+    return diags, n_ops
+
+
+def main(argv=None):
+    from paddle_tpu.analysis import format_diagnostics, pass_names
+
+    p = argparse.ArgumentParser(
+        description="static program verifier over the benchmark models")
+    p.add_argument("models", nargs="*", default=None,
+                   help=f"models to lint (default: all of {ALL_MODELS})")
+    p.add_argument("--dot", metavar="DIR", default=None,
+                   help="write annotated graphviz .dot per model")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable diagnostics on stdout")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also fail the exit status")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress info-severity diagnostics")
+    p.add_argument("--list-passes", action="store_true",
+                   help="print registered pass names and exit")
+    args = p.parse_args(argv)
+
+    if args.list_passes:
+        print("\n".join(pass_names()))
+        return 0
+
+    models = args.models or ALL_MODELS
+    bad = [m for m in models if m not in ALL_MODELS]
+    if bad:
+        p.error(f"unknown model(s) {bad}; choose from {ALL_MODELS}")
+
+    failed = False
+    report = {}
+    for name in models:
+        diags, n_ops = lint_model(name, dot_dir=args.dot)
+        if args.quiet:
+            diags = [d for d in diags if d.severity != "info"]
+        report[name] = [d.to_dict() for d in diags]
+        n_err = sum(d.severity == "error" for d in diags)
+        n_warn = sum(d.severity == "warning" for d in diags)
+        if n_err or (args.strict and n_warn):
+            failed = True
+        if not args.as_json:
+            status = "FAIL" if n_err else ("warn" if n_warn else "ok")
+            print(f"{name:<24} {n_ops:>4} ops  {n_err} error(s), "
+                  f"{n_warn} warning(s)  [{status}]")
+            if diags:
+                print("  " + format_diagnostics(diags).replace(
+                    "\n", "\n  "))
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
