@@ -76,6 +76,7 @@ if _cache_dir:
     except Exception:
         pass
 
+from . import telemetry         # runtime metrics/spans (dep-free; first)
 from . import ops               # registers all kernels
 from . import unique_name
 from .core.framework import (

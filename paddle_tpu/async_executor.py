@@ -13,6 +13,7 @@ MultiSlot text format (one sample per line, per slot:
 """
 import numpy as np
 
+from . import telemetry as _tm
 from .core.executor import Executor
 from .core.framework import default_main_program
 from .layers.io import PyReader, _register_reader
@@ -181,6 +182,9 @@ class AsyncExecutor:
 
         def provider():
             n = max(1, min(int(thread_num or 1), len(filelist)))
+            if _tm.enabled():
+                _tm.gauge("async_executor.parser_threads").set(n)
+                _tm.gauge("async_executor.files").set(len(filelist))
             if n == 1:
                 yield from parse_shard(filelist)
                 return
@@ -232,10 +236,14 @@ class AsyncExecutor:
         reader.start()
         results = []
         try:
-            while True:
-                out = self.executor.run(program, fetch_list=fetch)
-                if debug or fetch:
-                    results.append(out)
+            with _tm.span("async_executor.run", files=len(filelist),
+                          threads=thread_num):
+                while True:
+                    out = self.executor.run(program, fetch_list=fetch)
+                    if debug or fetch:
+                        results.append(out)
+                    if _tm.enabled():
+                        _tm.counter("async_executor.batches").inc()
         except EOFException:
             pass
         finally:

@@ -21,6 +21,7 @@ from .place import core_place_of
 from .scope import global_scope
 from .trace import build_step_fn
 from .dtypes import as_jnp_dtype
+from .. import telemetry as _tm
 
 from .scope import scope_guard  # noqa: F401  (ref executor.py re-exports it)
 
@@ -92,6 +93,12 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._scan_gate_cache.clear()
+        self._seen_keys.clear()
+        self._step_counters.clear()
+        # final flush so a closed executor's run leaves its metrics on
+        # record (writes PADDLE_TPU_TELEMETRY_DIR artifacts when set)
+        _tm.flush()
 
     def _put_feeds(self, program, feed, dev):
         """Feed values → device arrays with ONE transfer each: dtype
@@ -251,8 +258,13 @@ class Executor:
         seed = program.random_seed if program.random_seed else self._seed
         self._step += 1
 
+        # telemetry: one flag check on the disabled path (snapshot must
+        # stay empty — pinned by tests/test_bench_contract.py); spans are
+        # shared no-op singletons when off
+        tm_on = _tm.enabled()
         dev = self.place.jax_device()
-        feed_arrays = self._put_feeds(program, feed, dev)
+        with _tm.span("executor.feed_put", feeds=len(feed)):
+            feed_arrays = self._put_feeds(program, feed, dev)
 
         persist = self._collect_persist(program, scope)
         self._unalias_feeds(feed_arrays, persist)
@@ -266,26 +278,34 @@ class Executor:
         first_run = ckey not in self._seen_keys
         self._seen_keys.add(ckey)
         if fn is None:
-            # opt-in pre-trace verification gate: pay it once per compile
-            # (cache hits skip it), catching IR defects before JAX does
-            if self._validate_requested(validate):
-                self._pre_trace_validate(program, fetch_names,
-                                         list(feed_arrays))
-            step_fn = build_step_fn(program, fetch_names, is_test, self.place)
+            if tm_on:
+                _tm.counter("executor.compile_count").inc()
+            with _tm.span("executor.compile", program=program._version,
+                          fetches=len(fetch_names)):
+                # opt-in pre-trace verification gate: pay it once per
+                # compile (cache hits skip it), catching IR defects
+                # before JAX does
+                if self._validate_requested(validate):
+                    self._pre_trace_validate(program, fetch_names,
+                                             list(feed_arrays))
+                step_fn = build_step_fn(program, fetch_names, is_test,
+                                        self.place)
 
-            # the PRNG key is derived ON DEVICE from a donated step
-            # counter rather than host-side fold_in: through a remote
-            # TPU relay every host-side jax.random call is an extra
-            # round-trip per step (measured 82 → 9 ms/step on MNIST)
-            def stepped(persist, feed, step):
-                key = jax.random.fold_in(
-                    jax.random.PRNGKey(seed), step.astype(jnp.uint32))
-                fetches, new_persist = step_fn(persist, feed, key)
-                return fetches, new_persist, step + 1
+                # the PRNG key is derived ON DEVICE from a donated step
+                # counter rather than host-side fold_in: through a remote
+                # TPU relay every host-side jax.random call is an extra
+                # round-trip per step (measured 82 → 9 ms/step on MNIST)
+                def stepped(persist, feed, step):
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(seed), step.astype(jnp.uint32))
+                    fetches, new_persist = step_fn(persist, feed, key)
+                    return fetches, new_persist, step + 1
 
-            fn = jax.jit(stepped, donate_argnums=(0, 2))
+                fn = jax.jit(stepped, donate_argnums=(0, 2))
             if use_program_cache:
                 self._cache[ckey] = fn
+        elif tm_on:
+            _tm.counter("executor.cache_hit_count").inc()
 
         step_dev = self._step_counters.get(dev)
         if step_dev is None:
@@ -296,8 +316,10 @@ class Executor:
             step_dev = jnp.asarray(self._step - 1, jnp.int32)
         t0 = time.perf_counter()
         try:
-            fetches, new_persist, step_dev = fn(persist, feed_arrays,
-                                                step_dev)
+            with _tm.span("executor.step", step=self._step - 1,
+                          compile_run=first_run):
+                fetches, new_persist, step_dev = fn(persist, feed_arrays,
+                                                    step_dev)
         except Exception:
             # the counter was donated into the failed execution — drop
             # it so the next run() re-seeds instead of passing a deleted
@@ -311,8 +333,16 @@ class Executor:
             jax.block_until_ready(fetches)
         dt = time.perf_counter() - t0
         self.last_step_time = dt
+        if tm_on:
+            _tm.counter("executor.steps").inc()
+            _tm.histogram("executor.step_seconds").observe(dt)
+            # watermark gauges; a no-op on backends without allocator
+            # stats (capability probed once — see telemetry.memory)
+            _tm.sample_device_memory()
         if (self.step_timeout is not None and not first_run
                 and dt > self.step_timeout):
+            if tm_on:
+                _tm.counter("executor.stall_warnings").inc()
             _LOG.warning(
                 "executor stall: step %d took %.2fs (timeout %.2fs) — "
                 "program version %s, %d feeds", self._step - 1, dt,
@@ -321,10 +351,21 @@ class Executor:
             scope.set(name, val)
 
         if self.check_nan_inf and fetches:
-            self._check_fetches_finite(fetch_names, fetches)
+            t_fc = time.perf_counter()
+            with _tm.span("executor.finite_check"):
+                self._check_fetches_finite(fetch_names, fetches)
+            if tm_on:
+                _tm.histogram("executor.finite_check_seconds").observe(
+                    time.perf_counter() - t_fc)
 
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            t_rb = time.perf_counter()
+            with _tm.span("executor.fetch_readback", n=len(fetches)):
+                out = [np.asarray(f) for f in fetches]
+            if tm_on:
+                _tm.histogram("executor.fetch_readback_seconds").observe(
+                    time.perf_counter() - t_rb)
+            return out
         return fetches
 
     # ------------------------------------------------------------------
@@ -392,6 +433,11 @@ class Executor:
         # steps == 0 dispatches nothing either way; the scan path
         # returns the correct empty (0, ...)-shaped fetches
         self.last_scan_fallback = steps > 0 and self._scan_pathological(dev)
+        if _tm.enabled():
+            _tm.counter("executor.scan_windows").inc()
+            _tm.counter("executor.scan_steps").inc(steps)
+            if self.last_scan_fallback:
+                _tm.counter("executor.scan_fallbacks").inc()
         if self.last_scan_fallback:
             _LOG.warning(
                 "run_scanned: backend %r re-dispatches scan bodies per "
@@ -425,10 +471,11 @@ class Executor:
             keys = jax.random.split(key, steps)
             outs = []
             p = persist
-            for i in range(steps):
-                step_fetches, p = fn(p, feed_arrays, keys,
-                                     jnp.asarray(i, jnp.int32))
-                outs.append(step_fetches)
+            with _tm.span("executor.scan_window_fallback", steps=steps):
+                for i in range(steps):
+                    step_fetches, p = fn(p, feed_arrays, keys,
+                                         jnp.asarray(i, jnp.int32))
+                    outs.append(step_fetches)
             new_persist = p
             fetches = [jnp.stack([o[j] for o in outs])
                        for j in range(len(fetch_names))]
@@ -458,7 +505,8 @@ class Executor:
                 fn = jax.jit(scanned, donate_argnums=(0,))
                 self._cache[ckey] = fn
 
-            fetches, new_persist = fn(persist, feed_arrays, key)
+            with _tm.span("executor.scan_window", steps=steps):
+                fetches, new_persist = fn(persist, feed_arrays, key)
         for name, val in new_persist.items():
             scope.set(name, val)
         if self.check_nan_inf and fetches:

@@ -6,10 +6,13 @@ pruned inference Program is jitted once per input signature with donated
 output buffers disabled (read-only params), bf16 precision optional, and
 an AOT serialize/deserialize path via jax.jit(...).lower().compile().
 """
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import telemetry as _tm
 from .core.executor import Executor
 from .core.place import core_place_of
 from .core.scope import Scope, scope_guard
@@ -81,27 +84,38 @@ class InferenceEngine:
         sig = self._signature(feed)
         fn = self._cache.get(sig)
         if fn is None:
-            step = build_step_fn(self.program, self.fetch_names,
-                                 is_test=True, place=self.place)
+            if _tm.enabled():
+                _tm.counter("inference.compile_count").inc()
+            with _tm.span("inference.compile", signatures=len(self._cache)):
+                step = build_step_fn(self.program, self.fetch_names,
+                                     is_test=True, place=self.place)
 
-            def infer(persist, feed_arrays):
-                fetches, _ = step(persist, feed_arrays,
-                                  jax.random.PRNGKey(0))
-                return fetches
+                def infer(persist, feed_arrays):
+                    fetches, _ = step(persist, feed_arrays,
+                                      jax.random.PRNGKey(0))
+                    return fetches
 
-            fn = jax.jit(infer)
+                fn = jax.jit(infer)
             self._cache[sig] = fn
+        elif _tm.enabled():
+            _tm.counter("inference.cache_hit_count").inc()
         return fn
 
     def run(self, feed, return_numpy=True):
-        feed_arrays = {}
-        for k, v in feed.items():
-            var = self.program.global_block().vars.get(k)
-            dt = as_jnp_dtype(var.dtype) if var is not None else None
-            feed_arrays[k] = jnp.asarray(np.asarray(v), dtype=dt)
-        outs = self._get_fn(feed_arrays)(self._persist, feed_arrays)
-        if return_numpy:
-            return [np.asarray(o) for o in outs]
+        t0 = time.perf_counter()
+        with _tm.span("inference.run", feeds=len(feed)):
+            feed_arrays = {}
+            for k, v in feed.items():
+                var = self.program.global_block().vars.get(k)
+                dt = as_jnp_dtype(var.dtype) if var is not None else None
+                feed_arrays[k] = jnp.asarray(np.asarray(v), dtype=dt)
+            outs = self._get_fn(feed_arrays)(self._persist, feed_arrays)
+            if return_numpy:
+                outs = [np.asarray(o) for o in outs]
+        if _tm.enabled():
+            _tm.counter("inference.requests").inc()
+            _tm.histogram("inference.latency_seconds").observe(
+                time.perf_counter() - t0)
         return outs
 
     # ------------------------------------------------------------------
@@ -259,11 +273,17 @@ class CompiledPredictor:
             self._persist[k] = jnp.asarray(a)
 
     def run(self, feed, return_numpy=True):
-        feed_arrays = {
-            k: jnp.asarray(np.asarray(v),
-                           dtype=self.signature["dtypes"].get(k))
-            for k, v in feed.items()}
-        outs = self._exported.call(self._persist, feed_arrays)
-        if return_numpy:
-            return [np.asarray(o) for o in outs]
+        t0 = time.perf_counter()
+        with _tm.span("inference.compiled_run", feeds=len(feed)):
+            feed_arrays = {
+                k: jnp.asarray(np.asarray(v),
+                               dtype=self.signature["dtypes"].get(k))
+                for k, v in feed.items()}
+            outs = self._exported.call(self._persist, feed_arrays)
+            if return_numpy:
+                outs = [np.asarray(o) for o in outs]
+        if _tm.enabled():
+            _tm.counter("inference.compiled_requests").inc()
+            _tm.histogram("inference.compiled_latency_seconds").observe(
+                time.perf_counter() - t0)
         return outs

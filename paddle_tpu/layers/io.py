@@ -10,11 +10,13 @@ like the reference.
 """
 import logging
 import threading
+import time
 import queue as _queue
 
 import numpy as np
 
 from .. import unique_name
+from .. import telemetry as _tm
 from ..core.framework import default_main_program
 from ..core.dtypes import convert_dtype
 from ..core import EOFException
@@ -105,9 +107,17 @@ class PyReader:
         q, end, stop = self._q, self._END, self._stop
 
         def put(item):
+            # producer-side backpressure wait: time blocked on a full
+            # queue (telemetry on only — the clock reads stay off the
+            # disabled path)
+            t0 = time.perf_counter() if _tm.enabled() else None
             while not stop.is_set():
                 try:
                     q.put(item, timeout=0.1)
+                    if t0 is not None:
+                        _tm.histogram(
+                            "reader.producer_wait_seconds").observe(
+                            time.perf_counter() - t0)
                     return True
                 except _queue.Full:
                     continue
@@ -166,7 +176,18 @@ class PyReader:
                     "py_reader feed starvation: queue empty on %d/%d "
                     "polls (capacity %d) — the producer is the "
                     "bottleneck", n, self._stats["polls"], self.capacity)
-        item = self._q.get()
+        if _tm.enabled():
+            _tm.gauge("reader.queue_depth").set(depth)
+            _tm.gauge("reader.queue_capacity").set(self.capacity)
+            _tm.counter("reader.polls").inc()
+            if depth == 0:
+                _tm.counter("reader.starved_polls").inc()
+            t0 = time.perf_counter()
+            item = self._q.get()
+            _tm.histogram("reader.consumer_wait_seconds").observe(
+                time.perf_counter() - t0)
+        else:
+            item = self._q.get()
         if isinstance(item, _ReaderError):
             self._started = False
             raise item.exc

@@ -165,11 +165,23 @@ class NativePredictor:
         self.num_outputs = L.ptpu_predictor_num_outputs(self._h)
 
     def run(self, input_arrays):
-        np = self._np
+        import time
+        from .. import telemetry as _tm
         if len(input_arrays) != self.num_inputs:
             raise ValueError(
                 f"model takes {self.num_inputs} inputs, "
                 f"got {len(input_arrays)}")
+        t0 = time.perf_counter()
+        with _tm.span("native_predictor.run", inputs=len(input_arrays)):
+            outs = self._run_impl(input_arrays)
+        if _tm.enabled():
+            _tm.counter("native_predictor.requests").inc()
+            _tm.histogram("native_predictor.latency_seconds").observe(
+                time.perf_counter() - t0)
+        return outs
+
+    def _run_impl(self, input_arrays):
+        np = self._np
         ins = [np.ascontiguousarray(a) for a in input_arrays]
         in_ptrs = (ctypes.c_void_p * len(ins))(
             *[a.ctypes.data_as(ctypes.c_void_p).value for a in ins])

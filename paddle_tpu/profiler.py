@@ -10,6 +10,8 @@ from collections import defaultdict
 
 import jax
 
+from . import telemetry as _tm
+
 __all__ = ["cuda_profiler", "profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "record_event", "summary", "device_op_times", "profile_step_fn"]
 
@@ -52,16 +54,22 @@ def profiler(state="All", sorted_key="total", profile_path=None,
 
 @contextlib.contextmanager
 def record_event(name):
-    """Host-side timing + device annotation (jax named scope)."""
+    """Host-side timing + device annotation (jax named scope). With
+    telemetry enabled the same region is also a telemetry span, so
+    profiler annotations land on the unified Chrome-trace timeline
+    next to the executor's own spans instead of only in _records."""
     t0 = time.perf_counter()
     try:
-        with jax.profiler.TraceAnnotation(name):
+        with _tm.span(name, cat="profiler"), \
+                jax.profiler.TraceAnnotation(name):
             yield
     finally:
         dt = time.perf_counter() - t0
         rec = _records[name]
         rec[0] += 1
         rec[1] += dt
+        if _tm.enabled():
+            _tm.histogram("profiler.event_seconds").observe(dt)
 
 
 def summary(sorted_key="total"):
@@ -267,13 +275,14 @@ def profile_step_fn(fn, steps=10, trace_dir=None, readback=None):
     fn()  # warm the compile cache outside the trace
     jax.profiler.start_trace(trace_dir)
     try:
-        out = None
-        for _ in range(steps):
-            out = fn()
-        if readback is not None:
-            readback(out)
-        elif out is not None:
-            np.asarray(jax.tree_util.tree_leaves(out)[0])
+        with _tm.span("profiler.profile_step_fn", steps=steps):
+            out = None
+            for _ in range(steps):
+                out = fn()
+            if readback is not None:
+                readback(out)
+            elif out is not None:
+                np.asarray(jax.tree_util.tree_leaves(out)[0])
     finally:
         jax.profiler.stop_trace()
     ops = device_op_times(trace_dir)
@@ -284,6 +293,11 @@ def profile_step_fn(fn, steps=10, trace_dir=None, readback=None):
         raise RuntimeError(
             f"no device-plane 'XLA Ops' events found in {trace_dir}; "
             "trace layout unrecognized for this backend")
+    if _tm.enabled():
+        # device op times join the host spans on one timeline (per-step
+        # durations, laid back-to-back on a synthetic device track)
+        _tm.merge_device_ops(ops, scale=steps)
+        _tm.gauge("profiler.device_step_seconds").set(total / steps)
     return total / steps, {k: v / steps for k, v in ops.items()}
 
 

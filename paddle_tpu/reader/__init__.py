@@ -8,7 +8,10 @@ prefetch pipeline (pipeline.py) replacing the reference's double-buffer
 import itertools
 import random
 import threading
+import time
 import queue as _queue
+
+from .. import telemetry as _tm
 
 __all__ = ["batch", "shuffle", "buffered", "map_readers", "xmap_readers",
            "chain", "compose", "firstn", "cache", "Pipeline", "creator",
@@ -417,15 +420,33 @@ class Pipeline:
         def worker():
             try:
                 for batch_data in self.reader():
-                    q.put(self.feeder.feed(batch_data))
+                    fed = self.feeder.feed(batch_data)
+                    if _tm.enabled():
+                        t0 = time.perf_counter()
+                        q.put(fed)
+                        _tm.histogram(
+                            "pipeline.producer_wait_seconds").observe(
+                            time.perf_counter() - t0)
+                    else:
+                        q.put(fed)
             finally:
                 q.put(END)
 
         threading.Thread(target=worker, daemon=True).start()
         while True:
-            item = q.get()
+            if _tm.enabled():
+                _tm.gauge("pipeline.queue_depth").set(q.qsize())
+                t0 = time.perf_counter()
+                item = q.get()
+                _tm.histogram(
+                    "pipeline.consumer_wait_seconds").observe(
+                    time.perf_counter() - t0)
+            else:
+                item = q.get()
             if item is END:
                 return
+            if _tm.enabled():
+                _tm.counter("pipeline.batches").inc()
             yield item
 
 

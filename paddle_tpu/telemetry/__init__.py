@@ -1,0 +1,111 @@
+"""paddle_tpu.telemetry — always-on runtime metrics and spans.
+
+proglint (PR 1) made the IR visible *before* tracing; this package
+makes the runtime visible *while it runs*: the executor's compile vs
+cache-hit split, feed-put and fetch-readback time, reader queue
+depth/starvation, inference latency, and device-memory watermarks all
+land in one process-global registry, and host spans + device op times
+land on one Chrome-trace timeline.
+
+Enablement
+----------
+Off by default. `PADDLE_TPU_TELEMETRY=1` (or `enable()`) turns it on;
+disabled mode is the contract the hot paths are built around: every
+instrumented site is gated on one flag check, no metric is ever
+registered, and `snapshot()` stays `{}` (pinned by
+tests/test_bench_contract.py).
+
+Surfaces
+--------
+- `snapshot()` — plain dict of every metric
+- `prometheus_text()` — text exposition format
+- `chrome_trace()` / `write_chrome_trace(path)` — trace-event JSON;
+  `merge_device_ops(profiler.device_op_times(dir))` adds device time
+- `flush()` — log a summary; with `PADDLE_TPU_TELEMETRY_DIR=<dir>`
+  also write metrics.json / metrics.prom / trace.json there
+- `tools/tpustat.py` — CLI: run a benchmark model N steps and print
+  the table
+
+No jax / paddle_tpu imports at module level: the executor, readers,
+and the native predictor all pull this in during package init.
+"""
+import json
+import logging
+import os
+
+from . import registry as _registry
+from . import spans as _spans
+from . import memory as _memory
+from .registry import (Counter, Gauge, Histogram, counter, gauge,
+                       histogram, snapshot, prometheus_text,
+                       DEFAULT_TIME_BUCKETS)
+from .spans import (span, iter_spans, chrome_trace, write_chrome_trace,
+                    merge_device_ops, SpanRecord)
+from .memory import device_memory_supported, sample_device_memory
+
+__all__ = ["enabled", "enable", "disable", "counter", "gauge",
+           "histogram", "span", "snapshot", "prometheus_text",
+           "chrome_trace", "write_chrome_trace", "merge_device_ops",
+           "iter_spans", "sample_device_memory",
+           "device_memory_supported", "reset", "flush", "Counter",
+           "Gauge", "Histogram", "SpanRecord", "DEFAULT_TIME_BUCKETS"]
+
+_LOG = logging.getLogger("paddle_tpu.telemetry")
+
+
+def _env_truthy(val):
+    return (val or "").strip().lower() not in ("", "0", "false", "off",
+                                               "no")
+
+
+_ENABLED = _env_truthy(os.environ.get("PADDLE_TPU_TELEMETRY"))
+
+
+def enabled():
+    """One-flag gate every instrumented hot path checks first."""
+    return _ENABLED
+
+
+def enable():
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+# span() consults the same flag without importing this module back
+_spans._span_enabled = enabled
+
+
+def reset():
+    """Drop all metrics, spans, and merged device events (not the
+    enabled flag). Used by tpustat to scope metrics to the steady-state
+    loop, and by tests."""
+    _registry.reset_metrics()
+    _spans.clear_spans()
+
+
+def flush(log=True):
+    """Final export: log a one-line summary and, when
+    PADDLE_TPU_TELEMETRY_DIR is set, write metrics.json, metrics.prom,
+    and trace.json there. Returns the snapshot (None when disabled) —
+    Executor.close() calls this so a run's metrics outlive it."""
+    if not _ENABLED:
+        return None
+    snap = snapshot()
+    n_spans = len(iter_spans())
+    if log:
+        _LOG.info("telemetry flush: %d metrics, %d spans", len(snap),
+                  n_spans)
+    out_dir = os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "metrics.json"), "w") as f:
+            json.dump(snap, f, indent=2, default=str)
+        with open(os.path.join(out_dir, "metrics.prom"), "w") as f:
+            f.write(prometheus_text())
+        write_chrome_trace(os.path.join(out_dir, "trace.json"))
+    return snap

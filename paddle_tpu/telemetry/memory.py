@@ -1,0 +1,78 @@
+"""Device-memory watermark gauges.
+
+jax exposes per-device allocator stats through `Device.memory_stats()`,
+but support varies by backend and version: TPU returns a populated
+dict, this image's CPU devices (jax 0.4.37) return None, and some
+plugin backends raise. A one-shot capability probe (cached per
+process) classifies the backend so tier-1 CPU runs degrade to no-op
+sampling — no gauges registered, no exceptions — instead of failing.
+
+jax is imported lazily: the telemetry package must stay importable
+(and cheap) from modules that load before the backend is up.
+"""
+import threading
+
+from . import registry as _registry
+
+__all__ = ["device_memory_supported", "sample_device_memory",
+           "reset_memory_probe"]
+
+_probe = None          # None = not probed; False/True = cached verdict
+_probe_lock = threading.Lock()
+
+
+def reset_memory_probe():
+    """Testing hook: force the next sample to re-probe."""
+    global _probe
+    with _probe_lock:
+        _probe = None
+
+
+def device_memory_supported():
+    """True when the local backend reports allocator stats. Probes the
+    first local device once; any exception, None, or empty dict means
+    unsupported (the capability is all-or-nothing per backend)."""
+    global _probe
+    if _probe is not None:
+        return _probe
+    with _probe_lock:
+        if _probe is not None:
+            return _probe
+        try:
+            import jax
+            devs = jax.local_devices()
+            stats = devs[0].memory_stats() if devs else None
+            verdict = bool(stats) and "bytes_in_use" in stats
+        except Exception:
+            verdict = False
+        _probe = verdict
+    return _probe
+
+
+def sample_device_memory():
+    """Update `device.<platform>:<id>.bytes_in_use` (gauge) and
+    `.peak_bytes_in_use` (high-watermark gauge) for every local device.
+    Returns {device_label: bytes_in_use}, empty when unsupported."""
+    if not device_memory_supported():
+        return {}
+    import jax
+    out = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            continue
+        if not stats:
+            continue
+        in_use = stats.get("bytes_in_use")
+        if in_use is None:
+            continue
+        label = f"device.{d.platform}:{d.id}"
+        _registry.gauge(f"{label}.bytes_in_use").set(in_use)
+        _registry.gauge(f"{label}.peak_bytes_in_use").set_max(
+            stats.get("peak_bytes_in_use", in_use))
+        limit = stats.get("bytes_limit")
+        if limit:
+            _registry.gauge(f"{label}.bytes_limit").set(limit)
+        out[label] = in_use
+    return out

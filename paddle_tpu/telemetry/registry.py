@@ -1,0 +1,219 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+The registry is process-global and thread-safe. Metrics are created
+lazily at use sites (`counter(name).inc()`); instrumented hot paths
+guard creation on `telemetry.enabled()`, so with telemetry off nothing
+is ever registered and `snapshot()` stays `{}` — the disabled mode
+costs one flag check per site, no allocation, no locking.
+
+Deliberately dependency-free (no jax, no paddle_tpu imports): the
+executor, readers, and the native predictor all import this during
+package init.
+"""
+import math
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
+           "histogram", "snapshot", "reset_metrics", "prometheus_text",
+           "DEFAULT_TIME_BUCKETS"]
+
+# exponential wall-time buckets, 100µs .. 2min (seconds); the spread
+# covers a cached CPU step (~1ms) through a cold TPU-relay compile
+DEFAULT_TIME_BUCKETS = (
+    1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+_metrics = {}           # name -> metric
+_registry_lock = threading.Lock()
+
+
+class Counter:
+    """Monotonically increasing count."""
+    kind = "counter"
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def to_value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-written value, with a set_max helper for watermarks."""
+    kind = "gauge"
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def set_max(self, v):
+        with self._lock:
+            if v > self._value:
+                self._value = v
+
+    def add(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def to_value(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram: per-bucket counts plus sum/min/max.
+
+    `buckets` are inclusive upper bounds; an implicit +Inf bucket
+    catches the tail. Bucket edges are frozen at creation — a second
+    `histogram(name)` call with different edges raises, so two call
+    sites can never silently split one metric.
+    """
+    kind = "histogram"
+    __slots__ = ("name", "buckets", "_lock", "_counts", "_sum",
+                 "_count", "_min", "_max")
+
+    def __init__(self, name, buckets=None):
+        self.name = name
+        bs = tuple(float(b) for b in (buckets or DEFAULT_TIME_BUCKETS))
+        if list(bs) != sorted(bs) or len(set(bs)) != len(bs):
+            raise ValueError(f"histogram {name!r}: buckets must be "
+                             f"strictly increasing, got {bs}")
+        self.buckets = bs
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bs) + 1)   # [+Inf] is the last slot
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v):
+        v = float(v)
+        i = 0
+        for i, edge in enumerate(self.buckets):
+            if v <= edge:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def to_value(self):
+        with self._lock:
+            d = {"count": self._count, "sum": self._sum,
+                 "buckets": {le: c for le, c in
+                             zip(self.buckets, self._counts)}}
+            d["buckets"]["+Inf"] = self._counts[-1]
+            if self._count:
+                d["min"] = self._min
+                d["max"] = self._max
+                d["mean"] = self._sum / self._count
+        return d
+
+
+def _get(name, cls, **kwargs):
+    m = _metrics.get(name)
+    if m is None:
+        with _registry_lock:
+            m = _metrics.get(name)
+            if m is None:
+                m = cls(name, **kwargs)
+                _metrics[name] = m
+    if not isinstance(m, cls):
+        raise TypeError(f"metric {name!r} is a {m.kind}, not a "
+                        f"{cls.kind}")
+    if kwargs.get("buckets") is not None \
+            and m.buckets != tuple(float(b) for b in kwargs["buckets"]):
+        raise ValueError(f"histogram {name!r} already registered with "
+                         f"buckets {m.buckets}")
+    return m
+
+
+def counter(name):
+    return _get(name, Counter)
+
+
+def gauge(name):
+    return _get(name, Gauge)
+
+
+def histogram(name, buckets=None):
+    return _get(name, Histogram, buckets=buckets)
+
+
+def snapshot():
+    """{metric_name: value} — counters/gauges as numbers, histograms as
+    {count, sum, min, max, mean, buckets}. Empty when nothing was ever
+    recorded (the disabled-mode contract)."""
+    with _registry_lock:
+        metrics = list(_metrics.values())
+    return {m.name: m.to_value() for m in metrics}
+
+
+def reset_metrics():
+    with _registry_lock:
+        _metrics.clear()
+
+
+def _prom_name(name):
+    out = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def prometheus_text():
+    """Prometheus text exposition of the current registry. Histogram
+    buckets are emitted cumulatively with the closing `+Inf` bucket
+    equal to `_count`, per the format spec."""
+    with _registry_lock:
+        metrics = sorted(_metrics.values(), key=lambda m: m.name)
+    lines = []
+    for m in metrics:
+        pname = _prom_name(m.name)
+        lines.append(f"# TYPE {pname} {m.kind}")
+        if m.kind == "histogram":
+            v = m.to_value()
+            cum = 0
+            for le in m.buckets:
+                cum += v["buckets"][le]
+                lines.append(f'{pname}_bucket{{le="{le:g}"}} {cum}')
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {v["count"]}')
+            lines.append(f"{pname}_sum {v['sum']:g}")
+            lines.append(f"{pname}_count {v['count']}")
+        else:
+            lines.append(f"{pname} {m.to_value():g}")
+    return "\n".join(lines) + ("\n" if lines else "")

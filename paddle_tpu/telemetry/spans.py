@@ -1,0 +1,159 @@
+"""Host-side span tracer with Chrome trace-event export.
+
+Spans nest (a thread-local depth counter tags each record) and land in
+a bounded process-global ring; `chrome_trace()` renders them as
+complete-duration ("X") events that load directly in chrome://tracing
+or Perfetto. Device op durations from `profiler.device_op_times()`
+merge onto the same timeline via `merge_device_ops` — the xplane
+decode yields durations only, so device events are laid out
+back-to-back on their own synthetic track starting at the host
+timeline origin.
+
+Timestamps are perf_counter_ns relative to this module's import, in
+microseconds (the trace-event format's native unit).
+"""
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ["span", "iter_spans", "clear_spans", "chrome_trace",
+           "write_chrome_trace", "merge_device_ops", "SpanRecord"]
+
+_EPOCH_NS = time.perf_counter_ns()
+_MAX_SPANS = 200_000
+
+SpanRecord = collections.namedtuple(
+    "SpanRecord", ["name", "cat", "ts_us", "dur_us", "tid", "depth",
+                   "args"])
+
+_spans = collections.deque(maxlen=_MAX_SPANS)
+_device_events = []          # laid-out events from merge_device_ops
+_lock = threading.Lock()
+_tls = threading.local()
+
+
+def _now_us():
+    return (time.perf_counter_ns() - _EPOCH_NS) / 1e3
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "_t0")
+
+    def __init__(self, name, cat, args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        _tls.depth = depth = getattr(_tls, "depth", 0)
+        _tls.depth = depth + 1
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = _now_us()
+        _tls.depth -= 1
+        rec = SpanRecord(self.name, self.cat, self._t0, t1 - self._t0,
+                         threading.get_ident(), _tls.depth,
+                         self.args or None)
+        with _lock:
+            _spans.append(rec)
+        return False
+
+
+class _NullSpan:
+    """Singleton no-op context manager for the disabled path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _span_enabled():
+    # rebound by telemetry/__init__ to the real flag accessor; the
+    # default keeps this module importable standalone
+    return True
+
+
+def span(name, cat="host", **args):
+    """Context manager timing a host-side region. No-op (a shared
+    singleton, no allocation) when telemetry is disabled."""
+    if not _span_enabled():
+        return _NULL_SPAN
+    return _Span(name, cat, args)
+
+
+def iter_spans():
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans():
+    with _lock:
+        _spans.clear()
+        del _device_events[:]
+
+
+def merge_device_ops(op_times, origin_us=None, track="device ops",
+                     scale=1.0):
+    """Lay `{op_name: seconds}` (profiler.device_op_times output) onto
+    the trace as back-to-back X events on a synthetic device track.
+    `scale` divides durations (pass `steps` to show per-step time);
+    `origin_us` anchors the track (default: first host span, else 0).
+    Returns the number of events added."""
+    if origin_us is None:
+        with _lock:
+            origin_us = min((s.ts_us for s in _spans), default=0.0)
+    t = float(origin_us)
+    events = []
+    for name, secs in sorted(op_times.items(), key=lambda kv: -kv[1]):
+        dur = secs * 1e6 / scale
+        events.append({"name": name, "cat": "device", "ph": "X",
+                       "ts": t, "dur": dur, "pid": os.getpid(),
+                       "tid": track,
+                       "args": {"total_s": secs, "scale": scale}})
+        t += dur
+    with _lock:
+        _device_events.extend(events)
+    return len(events)
+
+
+def chrome_trace():
+    """The timeline as a Chrome trace-event dict:
+    {"traceEvents": [...], "displayTimeUnit": "ms"} — json.dump it (or
+    use write_chrome_trace) and load in chrome://tracing/Perfetto."""
+    pid = os.getpid()
+    with _lock:
+        spans = list(_spans)
+        device = list(_device_events)
+    events = []
+    tids = set()
+    for s in spans:
+        tids.add(s.tid)
+        ev = {"name": s.name, "cat": s.cat, "ph": "X", "ts": s.ts_us,
+              "dur": s.dur_us, "pid": pid, "tid": s.tid}
+        args = dict(s.args) if s.args else {}
+        args["depth"] = s.depth
+        ev["args"] = args
+        events.append(ev)
+    for tid in sorted(tids):
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid,
+                       "args": {"name": f"host thread {tid}"}})
+    events.extend(device)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path):
+    trace = chrome_trace()
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path

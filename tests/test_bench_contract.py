@@ -48,6 +48,35 @@ def test_final_line_schema_on_cpu():
     assert obj["probe"]["cpu_fallback_ran"] is True
 
 
+def test_telemetry_off_cached_fast_path():
+    """Telemetry's disabled-mode contract on the hot path: a cached
+    Executor.run must register NO metrics (snapshot stays {}) and stay
+    fast — the instrumentation is one flag check per site, so 100
+    cached iterations of a trivial program fit a generous wall-clock
+    bound even on a loaded CI box."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu import telemetry as tm
+
+    tm.disable()
+    tm.reset()
+    img = layers.data("img", shape=[8])
+    out = layers.reduce_mean(layers.fc(img, size=4))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    x = np.random.rand(2, 8).astype("float32")
+    exe.run(feed={"img": x}, fetch_list=[out])      # compile off-clock
+    t0 = time.perf_counter()
+    for _ in range(100):
+        exe.run(feed={"img": x}, fetch_list=[out])
+    dt = time.perf_counter() - t0
+    assert tm.snapshot() == {}, "telemetry-off run registered metrics"
+    assert tm.iter_spans() == [], "telemetry-off run recorded spans"
+    assert tm.chrome_trace()["traceEvents"] == []
+    assert dt < 20.0, f"100 cached steps took {dt:.1f}s (bound 20s)"
+
+
 def test_sigterm_flushes_parseable_line():
     """Kill bench mid-run (the driver-timeout scenario): the last
     stdout line must still parse — the t=0 bootstrap guarantees it."""

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""tpustat — run a benchmark model N steps with telemetry on and print
+the runtime metrics (the dynamic counterpart of tools/proglint.py).
+
+Builds a model from benchmark/fluid/models/ exactly like
+fluid_benchmark.py, runs the startup program, then runs N training
+steps with `paddle_tpu.telemetry` enabled and metrics scoped to the
+steady-state loop (the startup compile is excluded). Prints a metrics
+table (or one JSON line with --json) and writes the merged Chrome
+trace-event timeline, loadable in chrome://tracing / Perfetto.
+
+--json validates the snapshot (counter arithmetic, histogram
+consistency, trace well-formedness) and exits non-zero when the
+metrics are malformed, so it doubles as a CI gate.
+
+Examples:
+  python tools/tpustat.py --model mnist --steps 20 --json
+  python tools/tpustat.py --model resnet --steps 10 --prom
+  python tools/tpustat.py --model mnist --platform env   # real backend
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "benchmark", "fluid"))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from proglint import ALL_MODELS, model_args  # noqa: E402
+
+
+def build_model(name, args=None):
+    """(main_program, startup_program, loss, feed_fn) — the proglint
+    builder plus the model's synthetic feed generator, which tpustat
+    needs to actually run the steps."""
+    import paddle_tpu as fluid
+    args = args or model_args()
+    model_mod = __import__(f"models.{name}", fromlist=["get_model"])
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        with fluid.unique_name.guard():
+            loss, feed_fn = model_mod.get_model(args)
+            opt = fluid.optimizer.Adam(args.learning_rate) \
+                if name == "machine_translation" \
+                else fluid.optimizer.Momentum(args.learning_rate, 0.9)
+            opt.minimize(loss)
+    return main_p, startup_p, loss, feed_fn
+
+
+def validate_metrics(snap, steps):
+    """Structural checks over a telemetry snapshot from a `steps`-long
+    cached run. Returns a list of problem strings (empty = healthy)."""
+    problems = []
+
+    def need(name):
+        if name not in snap:
+            problems.append(f"missing metric {name!r}")
+            return None
+        return snap[name]
+
+    compiles = need("executor.compile_count")
+    hits = need("executor.cache_hit_count") \
+        if "executor.cache_hit_count" in snap else 0
+    n_steps = need("executor.steps")
+    for name, v in snap.items():
+        if isinstance(v, dict):       # histogram
+            bucket_total = sum(v.get("buckets", {}).values())
+            if bucket_total != v.get("count"):
+                problems.append(
+                    f"histogram {name!r}: bucket total {bucket_total} "
+                    f"!= count {v.get('count')}")
+            if v.get("count", 0) < 0 or v.get("sum", 0) < 0:
+                problems.append(f"histogram {name!r}: negative count/sum")
+        elif isinstance(v, bool) or not isinstance(v, (int, float)):
+            problems.append(f"metric {name!r}: non-numeric value {v!r}")
+    if isinstance(compiles, int) and isinstance(n_steps, int):
+        if n_steps != steps:
+            problems.append(
+                f"executor.steps {n_steps} != requested steps {steps}")
+        if compiles + hits != steps:
+            problems.append(
+                f"compile_count {compiles} + cache_hit_count {hits} "
+                f"!= steps {steps}")
+        if compiles < 1:
+            problems.append("no compile recorded")
+    h = snap.get("executor.step_seconds")
+    if isinstance(h, dict) and h.get("count") != steps:
+        problems.append(
+            f"executor.step_seconds count {h.get('count')} != {steps}")
+    return problems
+
+
+def _fmt_value(v):
+    if isinstance(v, dict):
+        m = f" mean={v['mean']:.4g}s max={v['max']:.4g}s" \
+            if v.get("count") else ""
+        return f"hist count={v['count']}{m}"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="runtime telemetry over a benchmark model")
+    p.add_argument("--model", default="mnist", choices=ALL_MODELS)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--platform", default="cpu",
+                   help="JAX_PLATFORMS to force before backend init "
+                        "('env' keeps the environment's value; default "
+                        "cpu so the CLI never hangs on a down relay)")
+    p.add_argument("--trace", metavar="PATH", default=None,
+                   help="Chrome trace output "
+                        "(default /tmp/tpustat_<model>.trace.json)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="one machine-readable JSON line; exit non-zero "
+                        "on malformed metrics")
+    p.add_argument("--prom", action="store_true",
+                   help="also print the Prometheus text exposition")
+    p.add_argument("--profile-device", action="store_true",
+                   help="run a short device trace and merge per-op "
+                        "device times onto the timeline (needs a "
+                        "backend whose xplane layout we can decode)")
+    args = p.parse_args(argv)
+
+    if args.platform != "env":
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import telemetry
+
+    telemetry.enable()
+    main_p, startup_p, loss, feed_fn = build_model(
+        args.model, model_args(batch_size=args.batch_size))
+    exe = fluid.Executor()
+    exe.run(startup_p, feed={}, fetch_list=[])
+    # scope the metrics to the steady-state loop: the startup compile
+    # is one-off noise next to `steps` worth of hit/miss accounting
+    telemetry.reset()
+
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(args.steps):
+        feed = feed_fn(args.batch_size, rng)
+        out = exe.run(main_p, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).ravel()[0]))
+
+    device_profile = None
+    if args.profile_device:
+        from paddle_tpu import profiler
+        feed = feed_fn(args.batch_size, rng)
+        try:
+            per_step, ops = profiler.profile_step_fn(
+                lambda: exe.run(main_p, feed=feed, fetch_list=[loss]),
+                steps=3)
+            device_profile = {"device_step_seconds": per_step,
+                              "top_ops": dict(sorted(
+                                  ops.items(),
+                                  key=lambda kv: -kv[1])[:10])}
+        except Exception as e:
+            device_profile = {"error": f"{type(e).__name__}: {e}"}
+
+    snap = telemetry.snapshot()
+    problems = validate_metrics(snap, args.steps)
+
+    trace_path = args.trace or f"/tmp/tpustat_{args.model}.trace.json"
+    telemetry.write_chrome_trace(trace_path)
+    try:
+        with open(trace_path) as f:
+            trace = json.loads(f.read())
+        span_events = sum(1 for e in trace.get("traceEvents", [])
+                          if e.get("ph") == "X")
+        for e in trace.get("traceEvents", []):
+            if e.get("ph") == "X" and ("ts" not in e or "dur" not in e):
+                problems.append("trace X event missing ts/dur")
+                break
+        if span_events < args.steps:
+            problems.append(
+                f"trace has {span_events} span events < steps "
+                f"{args.steps}")
+    except (OSError, ValueError) as e:
+        span_events = 0
+        problems.append(f"trace does not round-trip: {e}")
+
+    import jax
+    result = {
+        "model": args.model,
+        "steps": args.steps,
+        "batch_size": args.batch_size,
+        "platform": jax.devices()[0].platform,
+        "final_loss": losses[-1] if losses else None,
+        "metrics": snap,
+        "trace": {"path": trace_path, "span_events": span_events},
+        "problems": problems,
+        "ok": not problems,
+    }
+    if device_profile is not None:
+        result["device_profile"] = device_profile
+
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    else:
+        print(f"tpustat: {args.model} x {args.steps} steps "
+              f"(batch {args.batch_size}) on "
+              f"{result['platform']}")
+        width = max((len(k) for k in snap), default=10)
+        for name in sorted(snap):
+            print(f"  {name:<{width}}  {_fmt_value(snap[name])}")
+        print(f"trace: {trace_path} ({span_events} span events)")
+        if device_profile:
+            print(f"device profile: {device_profile}")
+        for prob in problems:
+            print(f"MALFORMED: {prob}", file=sys.stderr)
+    if args.prom:
+        print(telemetry.prometheus_text(), end="")
+    return 2 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
