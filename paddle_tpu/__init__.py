@@ -145,6 +145,8 @@ from . import annotations
 from . import compat
 from . import graphviz
 from . import inferencer
+from . import inference
+from . import serving
 from .batch import batch
 from . import recordio_writer
 from .core import backward

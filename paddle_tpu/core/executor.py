@@ -280,6 +280,8 @@ class Executor:
         if fn is None:
             if tm_on:
                 _tm.counter("executor.compile_count").inc()
+                _tm.gauge("executor.signature_count").set(
+                    len(self._seen_keys))
             with _tm.span("executor.compile", program=program._version,
                           fetches=len(fetch_names)):
                 # opt-in pre-trace verification gate: pay it once per

@@ -6,6 +6,7 @@ pruned inference Program is jitted once per input signature with donated
 output buffers disabled (read-only params), bf16 precision optional, and
 an AOT serialize/deserialize path via jax.jit(...).lower().compile().
 """
+import threading
 import time
 
 import numpy as np
@@ -20,7 +21,73 @@ from .core.trace import build_step_fn
 from .core.dtypes import as_jnp_dtype
 from . import io as _io
 
-__all__ = ["InferenceEngine", "AnalysisConfig", "CompiledPredictor"]
+__all__ = ["InferenceEngine", "AnalysisConfig", "CompiledPredictor",
+           "bucket_feed", "next_bucket", "default_buckets"]
+
+
+def default_buckets(max_batch_size):
+    """Power-of-two batch buckets up to (and including) max_batch_size:
+    64 -> (1, 2, 4, 8, 16, 32, 64). On TPU every distinct feed shape is
+    a fresh XLA compile, so bounding the batch dim to this set bounds
+    the number of compiled signatures to log2(max)+1."""
+    max_batch_size = int(max_batch_size)
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1")
+    out, b = [], 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return tuple(out)
+
+
+def next_bucket(n, buckets):
+    """Smallest bucket >= n, or raise when n exceeds every bucket."""
+    for b in sorted(buckets):
+        if n <= b:
+            return int(b)
+    raise ValueError(
+        f"batch of {n} rows exceeds the largest bucket {max(buckets)}")
+
+
+def bucket_feed(feed, buckets, axis=0):
+    """Pad every array's batch dim up to the next shape bucket.
+
+    Returns ``(padded_feed, true_rows, mask)`` where `mask` is a bool
+    vector of length `bucket` that is True for real rows. Padding is
+    zeros, so row-wise inference graphs (fc/conv/softmax over the
+    feature axes) produce identical results for the real rows; callers
+    slice fetches back with ``out[:true_rows]``.
+
+    This is the standalone half of the serving batcher's recompile fix:
+    direct `InferenceEngine.run(feed, batch_bucket=buckets)` callers go
+    through the same helper, so the per-signature jit cache sees at
+    most `len(buckets)` batch shapes instead of one per request size.
+    """
+    if not feed:
+        return {}, 0, np.zeros((0,), dtype=bool)
+    arrays = {k: np.asarray(v) for k, v in feed.items()}
+    rows = {k: (a.shape[axis] if a.ndim > axis else None)
+            for k, a in arrays.items()}
+    sizes = set(r for r in rows.values() if r is not None)
+    if len(sizes) != 1:
+        raise ValueError(f"feed arrays disagree on batch dim {axis}: "
+                         f"{rows}")
+    n = sizes.pop()
+    bucket = next_bucket(n, buckets)
+    mask = np.arange(bucket) < n
+    if bucket == n:
+        return arrays, n, mask
+    padded = {}
+    for k, a in arrays.items():
+        if rows[k] is None:
+            padded[k] = a
+            continue
+        pad_shape = list(a.shape)
+        pad_shape[axis] = bucket - n
+        padded[k] = np.concatenate(
+            [a, np.zeros(pad_shape, dtype=a.dtype)], axis=axis)
+    return padded, n, mask
 
 
 class AnalysisConfig:
@@ -60,6 +127,11 @@ class InferenceEngine:
         self.scope = scope
         self.place = core_place_of(place)
         self._cache = {}
+        # single-flight compile guard: _lock protects _cache/_inflight
+        # membership; _inflight maps signature -> Event the compiling
+        # thread sets when its entry lands in _cache (see _get_fn)
+        self._lock = threading.Lock()
+        self._inflight = {}
         if use_bf16:
             from .amp import cast_program_to_bf16, cast_params_to_bf16
             cast_program_to_bf16(self.program)
@@ -80,29 +152,98 @@ class InferenceEngine:
     def _signature(self, feed):
         return tuple(sorted((k, tuple(np.shape(v))) for k, v in feed.items()))
 
+    def signature_count(self):
+        """Number of distinct compiled feed signatures (jit entries)."""
+        return len(self._cache)
+
+    def feed_specs(self):
+        """{feed_name: (shape, dtype_str)} from the program's data vars
+        (batch dim reported as -1). Serving uses this to build warmup
+        feeds and to coerce JSON tensors."""
+        specs = {}
+        block = self.program.global_block()
+        for n in self.feed_names:
+            var = block.vars.get(n)
+            if var is None:
+                specs[n] = ((-1,), "float32")
+            else:
+                shape = tuple(var.shape) if var.shape else (-1,)
+                specs[n] = (shape, var.dtype)
+        return specs
+
+    def _compile_fn(self, sig):
+        """Build + cache the jitted step for `sig`; caller holds the
+        single-flight leadership for this signature."""
+        if _tm.enabled():
+            _tm.counter("inference.compile_count").inc()
+        with _tm.span("inference.compile", signatures=len(self._cache)):
+            step = build_step_fn(self.program, self.fetch_names,
+                                 is_test=True, place=self.place)
+
+            def infer(persist, feed_arrays):
+                fetches, _ = step(persist, feed_arrays,
+                                  jax.random.PRNGKey(0))
+                return fetches
+
+            fn = jax.jit(infer)
+        self._cache[sig] = fn
+        if _tm.enabled():
+            _tm.gauge("inference.signature_count").set(len(self._cache))
+        return fn
+
     def _get_fn(self, feed):
         sig = self._signature(feed)
         fn = self._cache.get(sig)
-        if fn is None:
+        if fn is not None:
             if _tm.enabled():
-                _tm.counter("inference.compile_count").inc()
-            with _tm.span("inference.compile", signatures=len(self._cache)):
-                step = build_step_fn(self.program, self.fetch_names,
-                                     is_test=True, place=self.place)
+                _tm.counter("inference.cache_hit_count").inc()
+            return fn
+        # single-flight: exactly one thread traces/compiles a new
+        # signature; concurrent callers of the same signature wait on
+        # its Event instead of duplicate-compiling (the plain-dict race
+        # this replaces compiled once per racing thread)
+        while True:
+            with self._lock:
+                fn = self._cache.get(sig)
+                if fn is not None:
+                    if _tm.enabled():
+                        _tm.counter("inference.cache_hit_count").inc()
+                    return fn
+                event = self._inflight.get(sig)
+                if event is None:
+                    event = threading.Event()
+                    self._inflight[sig] = event
+                    leader = True
+                else:
+                    leader = False
+            if leader:
+                try:
+                    return self._compile_fn(sig)
+                finally:
+                    with self._lock:
+                        self._inflight.pop(sig, None)
+                    event.set()
+            if _tm.enabled():
+                _tm.counter("inference.compile_dedup_count").inc()
+            event.wait()
+            # leader either cached the fn (normal path, next loop
+            # iteration returns it) or raised — then the first waiter
+            # to re-take the lock becomes the new leader and retries
 
-                def infer(persist, feed_arrays):
-                    fetches, _ = step(persist, feed_arrays,
-                                      jax.random.PRNGKey(0))
-                    return fetches
+    def run(self, feed, return_numpy=True, batch_bucket=None):
+        """Run one inference request.
 
-                fn = jax.jit(infer)
-            self._cache[sig] = fn
-        elif _tm.enabled():
-            _tm.counter("inference.cache_hit_count").inc()
-        return fn
-
-    def run(self, feed, return_numpy=True):
+        batch_bucket: optional sequence of batch-size buckets. The feed
+        is padded up to the next bucket (see `bucket_feed`) before the
+        jit-cache lookup and fetches are sliced back to the true row
+        count, so arbitrary request sizes reuse at most len(buckets)
+        compiled signatures.
+        """
         t0 = time.perf_counter()
+        true_rows = bucket = None
+        if batch_bucket is not None:
+            feed, true_rows, _mask = bucket_feed(feed, batch_bucket)
+            bucket = len(_mask)
         with _tm.span("inference.run", feeds=len(feed)):
             feed_arrays = {}
             for k, v in feed.items():
@@ -112,6 +253,12 @@ class InferenceEngine:
             outs = self._get_fn(feed_arrays)(self._persist, feed_arrays)
             if return_numpy:
                 outs = [np.asarray(o) for o in outs]
+        if true_rows is not None and true_rows != bucket:
+            # slice padded rows off every batch-major fetch; fetches
+            # without the batch dim (reductions) pass through whole
+            outs = [o[:true_rows]
+                    if getattr(o, "ndim", 0) >= 1 and o.shape[0] == bucket
+                    else o for o in outs]
         if _tm.enabled():
             _tm.counter("inference.requests").inc()
             _tm.histogram("inference.latency_seconds").observe(

@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — dynamic-batching model server.
+
+The TF-Serving-shaped answer to "every distinct input signature is a
+fresh XLA compile": requests are coalesced by a `DynamicBatcher` into
+batches padded to a fixed set of **shape buckets** (so the
+per-signature jit cache in `paddle_tpu.inference` is actually hit),
+run by `ModelServer` worker threads against a `ModelRegistry` of
+`InferenceEngine`s, and exposed over a stdlib HTTP frontend
+(`POST /v1/models/<name>:predict`, `GET /healthz`, `GET /metrics`).
+
+Overload is handled by **admission control**, not by queueing: the
+request queue is bounded (submits beyond it fail fast with
+`RejectedError`) and every request can carry a deadline (expired
+requests are dropped with `DeadlineExceeded` instead of being
+computed). Every queue/batch/reject/warmup event lands in the
+`paddle_tpu.telemetry` registry when telemetry is enabled.
+
+`tools/tpuserve.py` is the CLI: serve a `save_inference_model` dir,
+load-test it (`--bench`), or run the CI self-test (`--selftest`).
+"""
+from .batcher import (BatchConfig, DynamicBatcher, Future,
+                      RejectedError, DeadlineExceeded, ServerClosed)
+from .server import ModelRegistry, ModelServer, ServerConfig
+from .http import HttpFrontend
+
+__all__ = ["BatchConfig", "DynamicBatcher", "Future", "RejectedError",
+           "DeadlineExceeded", "ServerClosed", "ModelRegistry",
+           "ModelServer", "ServerConfig", "HttpFrontend"]

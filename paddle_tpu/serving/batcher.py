@@ -1,0 +1,346 @@
+"""Dynamic batcher: bounded queue + shape-bucket coalescing.
+
+Requests (each a {name: array} feed with a leading batch dim) enter a
+bounded FIFO queue; worker threads pull a *batch* of compatible
+requests — same feed names, feature shapes, and dtypes — coalesced up
+to `max_batch_size` rows or `max_wait_ms` of age, whichever comes
+first. The assembled batch is padded up to the next configured shape
+bucket (`inference.bucket_feed`), run once, and the fetch rows are
+scattered back to callers in submission order.
+
+Admission control is the point, not an afterthought:
+
+- the queue is bounded (`max_queue_requests`); a submit against a full
+  queue raises `RejectedError` immediately — overload sheds load in
+  microseconds instead of growing an unbounded backlog;
+- every request may carry a deadline; `Future.result` stops waiting at
+  the deadline and workers drop already-expired requests without
+  running them (`DeadlineExceeded`).
+
+The batcher is engine-agnostic: it never imports jax and can be unit
+tested with a fake "engine" that echoes its input.
+"""
+import collections
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry as _tm
+from ..inference import bucket_feed, default_buckets
+
+__all__ = ["BatchConfig", "DynamicBatcher", "Batch", "Future",
+           "RejectedError", "DeadlineExceeded", "ServerClosed"]
+
+# fixed edges for the batch-size histogram: the registry freezes bucket
+# edges at first creation, so this must not vary with BatchConfig
+_ROWS_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+class RejectedError(RuntimeError):
+    """Request refused by admission control (queue full / oversized)."""
+
+
+class ServerClosed(RejectedError):
+    """Request refused because the server is draining or stopped."""
+
+
+class DeadlineExceeded(RejectedError):
+    """Request deadline expired before a result was produced."""
+
+
+class BatchConfig:
+    """Knobs for one model's batcher.
+
+    buckets defaults to powers of two up to max_batch_size, so the
+    compiled-signature count is bounded by log2(max_batch_size)+1.
+    """
+
+    def __init__(self, max_batch_size=64, max_wait_ms=5.0, buckets=None,
+                 max_queue_requests=256):
+        self.max_batch_size = int(max_batch_size)
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.max_wait_ms = float(max_wait_ms)
+        self.buckets = tuple(sorted(int(b) for b in (
+            buckets or default_buckets(self.max_batch_size))))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"bad buckets {self.buckets}")
+        if self.buckets[-1] < self.max_batch_size:
+            # a full batch must land in some bucket
+            self.max_batch_size = self.buckets[-1]
+        self.max_queue_requests = int(max_queue_requests)
+
+    def __repr__(self):
+        return (f"BatchConfig(max_batch_size={self.max_batch_size}, "
+                f"max_wait_ms={self.max_wait_ms}, "
+                f"buckets={self.buckets}, "
+                f"max_queue_requests={self.max_queue_requests})")
+
+
+class Future:
+    """Caller-side handle for one queued request."""
+
+    __slots__ = ("_event", "_result", "_error", "_deadline")
+
+    def __init__(self, deadline):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self._deadline = deadline          # monotonic seconds or None
+
+    def done(self):
+        return self._event.is_set()
+
+    def set_result(self, result):
+        self._result = result
+        self._event.set()
+
+    def set_error(self, exc):
+        self._error = exc
+        self._event.set()
+
+    def result(self, timeout=None):
+        """Block for the fetch rows. Respects the request deadline:
+        waiting never outlives it by more than a scheduling tick."""
+        wait = timeout
+        if self._deadline is not None:
+            to_deadline = max(0.0, self._deadline - time.monotonic())
+            # small grace so a worker that *just* made the deadline can
+            # still deliver instead of racing the waiter
+            to_deadline += 0.05
+            wait = to_deadline if wait is None else min(wait, to_deadline)
+        if not self._event.wait(wait):
+            if self._deadline is not None \
+                    and time.monotonic() >= self._deadline:
+                raise DeadlineExceeded("request deadline expired while "
+                                       "waiting for a worker")
+            raise TimeoutError("timed out waiting for result")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "group", "deadline", "enqueue_t",
+                 "future")
+
+    def __init__(self, feed, rows, group, deadline):
+        self.feed = feed
+        self.rows = rows
+        self.group = group
+        self.deadline = deadline
+        self.enqueue_t = time.monotonic()
+        self.future = Future(deadline)
+
+    def expired(self, now=None):
+        return self.deadline is not None \
+            and (now or time.monotonic()) >= self.deadline
+
+
+def _group_key(feed):
+    """Requests are batchable iff they agree on everything but the
+    batch dim: feed names, per-feed feature shapes, and dtypes."""
+    return tuple(sorted(
+        (k, tuple(np.shape(v)[1:]), str(np.asarray(v).dtype))
+        for k, v in feed.items()))
+
+
+class Batch:
+    """A coalesced group of requests plus scatter-back bookkeeping."""
+
+    __slots__ = ("requests", "group", "formed_t")
+
+    def __init__(self, requests):
+        self.requests = list(requests)
+        self.group = requests[0].group
+        self.formed_t = time.monotonic()
+
+    @property
+    def rows(self):
+        return sum(r.rows for r in self.requests)
+
+    def drop_expired(self, now=None):
+        """Fail requests whose deadline passed while queued; returns
+        the number dropped. Never runs compute for a dead caller."""
+        now = now or time.monotonic()
+        live, dropped = [], 0
+        for r in self.requests:
+            if r.expired(now):
+                r.future.set_error(DeadlineExceeded(
+                    "deadline expired in queue"))
+                dropped += 1
+            else:
+                live.append(r)
+        self.requests = live
+        if dropped and _tm.enabled():
+            _tm.counter("serving.rejected_deadline").inc(dropped)
+        return dropped
+
+    def assemble(self, buckets):
+        """Concatenate request feeds row-wise and pad to the bucket.
+        Returns (padded_feed, true_rows, bucket)."""
+        names = [k for k, _shape, _dt in self.group]
+        arrays = {
+            k: np.concatenate(
+                [np.asarray(r.feed[k]) for r in self.requests], axis=0)
+            for k in names}
+        padded, true_rows, mask = bucket_feed(arrays, buckets)
+        return padded, true_rows, len(mask)
+
+    def scatter(self, outs, bucket):
+        """Slice each caller's rows back out of the batch fetches, in
+        submission order. Fetches without a leading batch dim (e.g.
+        scalar reductions) are handed to every caller whole."""
+        off = 0
+        for r in self.requests:
+            rows = []
+            for o in outs:
+                if getattr(o, "ndim", 0) >= 1 and o.shape[0] == bucket:
+                    rows.append(o[off:off + r.rows])
+                else:
+                    rows.append(o)
+            r.future.set_result(rows)
+            off += r.rows
+
+    def fail(self, exc):
+        for r in self.requests:
+            if not r.future.done():
+                r.future.set_error(exc)
+
+
+class DynamicBatcher:
+    """Bounded request queue with shape-bucket batch formation."""
+
+    def __init__(self, config=None, name="model"):
+        self.config = config or BatchConfig()
+        self.name = name
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # ---------------------------------------------------- caller side
+    def submit(self, feed, deadline_ms=None):
+        """Enqueue one request; returns a Future. Raises RejectedError
+        (queue full / oversized / closed) instead of blocking — the
+        caller learns about overload immediately."""
+        if not feed:
+            raise ValueError("empty feed")
+        rows_set = {int(np.shape(v)[0]) if np.ndim(v) >= 1 else None
+                    for v in feed.values()}
+        rows_set.discard(None)
+        if len(rows_set) != 1:
+            raise ValueError(
+                f"feed arrays disagree on the batch dim: "
+                f"{ {k: np.shape(v) for k, v in feed.items()} }")
+        rows = rows_set.pop()
+        if rows > self.config.max_batch_size:
+            raise RejectedError(
+                f"request of {rows} rows exceeds max_batch_size "
+                f"{self.config.max_batch_size}")
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1e3
+        req = _Request(feed, rows, _group_key(feed), deadline)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("server is draining; not accepting "
+                                   "new requests")
+            if len(self._queue) >= self.config.max_queue_requests:
+                if _tm.enabled():
+                    _tm.counter("serving.rejected_queue_full").inc()
+                raise RejectedError(
+                    f"queue full ({self.config.max_queue_requests} "
+                    f"requests); retry later")
+            self._queue.append(req)
+            if _tm.enabled():
+                _tm.counter("serving.requests").inc()
+                _tm.gauge("serving.queue_depth").set(len(self._queue))
+            self._cond.notify()
+        return req.future
+
+    # ---------------------------------------------------- worker side
+    def next_batch(self, timeout=None):
+        """Block up to `timeout` for work, then coalesce one batch.
+
+        The batch closes when it reaches max_batch_size rows or when
+        the oldest member has waited max_wait_ms — classic TF-Serving
+        batching. Returns None on timeout or when closed and drained.
+        """
+        arrival_deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                while not self._queue:
+                    if self._closed:
+                        return None
+                    if arrival_deadline is None:
+                        self._cond.wait()
+                    else:
+                        remaining = arrival_deadline - time.monotonic()
+                        if remaining <= 0:
+                            return None
+                        self._cond.wait(remaining)
+                head = self._queue[0]
+                close_t = head.enqueue_t + self.config.max_wait_ms / 1e3
+                while self._queue and self._queue[0] is head:
+                    ready = sum(r.rows for r in self._queue
+                                if r.group == head.group)
+                    if ready >= self.config.max_batch_size \
+                            or self._closed:
+                        break
+                    remaining = close_t - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                if self._queue and self._queue[0] is head:
+                    break
+                # another worker drained our head while we waited —
+                # start over against the current queue front
+            take, skipped, total = [], [], 0
+            for r in self._queue:
+                if r.group == head.group \
+                        and total + r.rows <= self.config.max_batch_size:
+                    take.append(r)
+                    total += r.rows
+                else:
+                    skipped.append(r)
+            self._queue = collections.deque(skipped)
+            if _tm.enabled():
+                _tm.gauge("serving.queue_depth").set(len(self._queue))
+            if skipped:
+                self._cond.notify()  # leftover work for another worker
+        batch = Batch(take)
+        if _tm.enabled():
+            _tm.counter("serving.batches").inc()
+            _tm.histogram("serving.batch_rows",
+                          buckets=_ROWS_BUCKETS).observe(batch.rows)
+            _tm.histogram("serving.batch_form_seconds").observe(
+                batch.formed_t - head.enqueue_t)
+        return batch
+
+    # --------------------------------------------------------- control
+    def pending(self):
+        with self._cond:
+            return len(self._queue)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def close(self):
+        """Stop admitting; queued work stays drainable by workers."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def fail_pending(self, exc=None):
+        """Complete every queued request with an error (non-drain
+        shutdown). Returns the number failed."""
+        exc = exc or ServerClosed("server shut down before this "
+                                  "request ran")
+        with self._cond:
+            dropped = list(self._queue)
+            self._queue.clear()
+        for r in dropped:
+            r.future.set_error(exc)
+        return len(dropped)

@@ -1,0 +1,163 @@
+"""Stdlib HTTP frontend for ModelServer.
+
+Endpoints (TF-Serving-shaped):
+
+- ``POST /v1/models/<name>:predict`` — body
+  ``{"inputs": {feed: nested list}, "deadline_ms": opt, "version": opt}``
+  (also ``/v1/models/<name>/versions/<v>:predict``); response
+  ``{"outputs": [...], "model": name, "version": v}``.
+- ``GET /healthz`` — 200 ``{"status": "ok"}`` while serving, 503 while
+  draining (load balancers stop routing before shutdown completes).
+- ``GET /metrics`` — the telemetry registry in Prometheus text format.
+- ``GET /v1/models`` — registered names and versions.
+
+Error mapping keeps overload semantics visible to clients: queue-full
+and oversized requests are 429 (back off / retry elsewhere), expired
+deadlines are 504, unknown models 404, malformed bodies 400. A
+`ThreadingHTTPServer` thread-per-connection model is plenty here: the
+handler only parses JSON and blocks on the batcher future; the real
+concurrency story is the batcher, not the socket layer.
+"""
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import telemetry as _tm
+from .batcher import DeadlineExceeded, RejectedError, ServerClosed
+
+__all__ = ["HttpFrontend"]
+
+_PREDICT_RE = re.compile(
+    r"^/v1/models/(?P<name>[^/:]+)"
+    r"(?:/versions/(?P<version>\d+))?:predict$")
+
+
+def _coerce_inputs(engine, inputs):
+    """JSON nested lists -> numpy arrays with the program's dtypes."""
+    if not isinstance(inputs, dict):
+        raise ValueError('"inputs" must be an object of '
+                         '{feed_name: tensor}')
+    specs = engine.feed_specs()
+    feed = {}
+    for k, v in inputs.items():
+        dt = specs.get(k, ((-1,), "float32"))[1]
+        feed[k] = np.asarray(v, dtype=np.dtype(dt))
+    return feed
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set by HttpFrontend subclassing
+    model_server = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):      # quiet by default
+        pass
+
+    def _reply(self, code, payload, content_type="application/json"):
+        body = payload if isinstance(payload, bytes) \
+            else json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code, msg):
+        if _tm.enabled():
+            _tm.counter("serving.http_errors").inc()
+        self._reply(code, {"error": msg})
+
+    def do_GET(self):
+        if _tm.enabled():
+            _tm.counter("serving.http_requests").inc()
+        if self.path == "/healthz":
+            if self.model_server.healthy:
+                self._reply(200, {"status": "ok"})
+            else:
+                self._reply(503, {"status": "draining"})
+        elif self.path == "/metrics":
+            self._reply(200, _tm.prometheus_text().encode("utf-8"),
+                        content_type="text/plain; version=0.0.4")
+        elif self.path == "/v1/models":
+            self._reply(200, {"models":
+                              self.model_server.registry.models()})
+        else:
+            self._error(404, f"no route {self.path!r}")
+
+    def do_POST(self):
+        if _tm.enabled():
+            _tm.counter("serving.http_requests").inc()
+        m = _PREDICT_RE.match(self.path)
+        if not m:
+            self._error(404, f"no route {self.path!r} (want "
+                        f"/v1/models/<name>:predict)")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            version = body.get("version", m.group("version"))
+            engine, version = self.model_server.registry.get(
+                m.group("name"), version)
+            feed = _coerce_inputs(engine, body.get("inputs") or {})
+            outs = self.model_server.predict(
+                m.group("name"), feed, version=version,
+                deadline_ms=body.get("deadline_ms"))
+        except KeyError as e:
+            self._error(404, str(e))
+        except DeadlineExceeded as e:
+            self._error(504, str(e))
+        except (ServerClosed, RejectedError) as e:
+            self._error(429 if not isinstance(e, ServerClosed) else 503,
+                        str(e))
+        except (ValueError, TypeError) as e:
+            self._error(400, f"bad request: {e}")
+        except Exception as e:              # noqa: BLE001 — last resort
+            self._error(500, f"{type(e).__name__}: {e}")
+        else:
+            self._reply(200, {
+                "outputs": [np.asarray(o).tolist() for o in outs],
+                "model": m.group("name"), "version": version})
+
+
+class HttpFrontend:
+    """Owns a ThreadingHTTPServer bound to (host, port); port=0 picks
+    an ephemeral port (exposed as `.port` once constructed)."""
+
+    def __init__(self, model_server, host="127.0.0.1", port=8500):
+        handler = type("BoundHandler", (_Handler,),
+                       {"model_server": model_server})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"tpuserve-http:{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._httpd.serve_forever()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,218 @@
+"""ModelServer: registry + worker threads + warmup + graceful drain.
+
+One `DynamicBatcher` and a pool of worker threads per registered
+(model, version). Workers drain the batcher, drop requests whose
+deadline expired while queued, pad the batch to its shape bucket, run
+the `InferenceEngine` once, and scatter fetch rows back to callers.
+
+Warmup runs at model load: one zero-feed inference per configured
+bucket, so every serving-path signature is compiled *before* the first
+real request — traffic never eats a compile stall, and the selftest
+gate "compile_count <= bucket count" follows from serving only ever
+presenting bucket-shaped batches.
+"""
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry as _tm
+from ..inference import InferenceEngine
+from .batcher import (BatchConfig, DynamicBatcher, ServerClosed)
+
+__all__ = ["ModelRegistry", "ModelServer", "ServerConfig"]
+
+
+class ServerConfig:
+    def __init__(self, batch=None, workers=2, default_deadline_ms=None,
+                 warmup=True):
+        self.batch = batch or BatchConfig()
+        self.workers = max(1, int(workers))
+        self.default_deadline_ms = default_deadline_ms
+        self.warmup = bool(warmup)
+
+
+class ModelRegistry:
+    """name -> version -> InferenceEngine; thread-safe."""
+
+    def __init__(self):
+        self._models = {}
+        self._lock = threading.Lock()
+
+    def register(self, name, engine, version=None):
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = max(versions, default=0) + 1
+            version = int(version)
+            if version in versions:
+                raise ValueError(f"model {name!r} version {version} "
+                                 f"already registered")
+            versions[version] = engine
+        return version
+
+    def get(self, name, version=None):
+        """Latest version when `version` is None. KeyError with the
+        available names/versions on a miss (the HTTP 404 payload)."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError(f"no model {name!r}; serving "
+                               f"{sorted(self._models)}")
+            if version is None:
+                version = max(versions)
+            engine = versions.get(int(version))
+            if engine is None:
+                raise KeyError(f"model {name!r} has versions "
+                               f"{sorted(versions)}, not {version}")
+        return engine, int(version)
+
+    def models(self):
+        with self._lock:
+            return {n: sorted(v) for n, v in self._models.items()}
+
+
+class _Served:
+    """One (name, version)'s batcher + workers."""
+
+    __slots__ = ("name", "version", "engine", "batcher", "threads")
+
+    def __init__(self, name, version, engine, batch_config):
+        self.name = name
+        self.version = version
+        self.engine = engine
+        self.batcher = DynamicBatcher(batch_config,
+                                      name=f"{name}/{version}")
+        self.threads = []
+
+
+class ModelServer:
+    """Serve registered InferenceEngines with dynamic batching."""
+
+    def __init__(self, config=None):
+        self.config = config or ServerConfig()
+        self.registry = ModelRegistry()
+        self._served = {}            # (name, version) -> _Served
+        self._lock = threading.Lock()
+        self._stopping = False
+        self._draining = False
+
+    # ------------------------------------------------------- lifecycle
+    def load(self, name, dirname, version=None, place=None,
+             analysis_config=None):
+        """Load a save_inference_model dir and serve it."""
+        engine = InferenceEngine.from_dir(dirname, place=place,
+                                          config=analysis_config)
+        return self.register(name, engine, version=version)
+
+    def register(self, name, engine, version=None):
+        """Register an engine, warm it up, start its workers. Returns
+        the assigned version."""
+        if self._stopping:
+            raise ServerClosed("server is shutting down")
+        version = self.registry.register(name, engine, version=version)
+        served = _Served(name, version, engine, self.config.batch)
+        with self._lock:
+            self._served[(name, version)] = served
+        if self.config.warmup:
+            self.warmup(name, version)
+        for i in range(self.config.workers):
+            t = threading.Thread(
+                target=self._worker, args=(served,),
+                name=f"tpuserve-{name}/{version}-{i}", daemon=True)
+            t.start()
+            served.threads.append(t)
+        return version
+
+    def warmup(self, name, version=None):
+        """Pre-compile every shape bucket with a zero feed. Returns the
+        engine's signature count afterwards — with warmup as the first
+        caller this equals len(buckets)."""
+        engine, version = self.registry.get(name, version)
+        specs = engine.feed_specs()
+        for b in self.config.batch.buckets:
+            shapes = {n: (b,) + tuple(
+                d if d != -1 else 1 for d in shape[1:])
+                for n, (shape, _dt) in specs.items()}
+            with _tm.span("serving.warmup", model=name, bucket=b):
+                engine.run(engine._zero_feed(shapes))
+            if _tm.enabled():
+                _tm.counter("serving.warmup_runs").inc()
+        return engine.signature_count()
+
+    def shutdown(self, drain=True, timeout=30.0):
+        """Stop accepting; optionally drain queued work, then join
+        workers. With drain=False pending requests fail fast."""
+        with self._lock:
+            self._stopping = True
+            self._draining = drain
+            served = list(self._served.values())
+        for s in served:
+            s.batcher.close()
+            if not drain:
+                s.batcher.fail_pending()
+        deadline = time.monotonic() + timeout
+        for s in served:
+            for t in s.threads:
+                t.join(max(0.0, deadline - time.monotonic()))
+
+    @property
+    def healthy(self):
+        return not self._stopping
+
+    # --------------------------------------------------------- serving
+    def submit(self, name, feed, version=None, deadline_ms=None):
+        """Async path: returns (Future, version)."""
+        if self._stopping:
+            raise ServerClosed("server is draining")
+        engine, version = self.registry.get(name, version)
+        served = self._served[(name, version)]
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        return served.batcher.submit(feed, deadline_ms=deadline_ms), \
+            version
+
+    def predict(self, name, feed, version=None, deadline_ms=None,
+                timeout=None):
+        """Blocking convenience: submit + wait. Returns the fetch list
+        (numpy arrays, rows matching the request's batch dim)."""
+        t0 = time.perf_counter()
+        future, _version = self.submit(name, feed, version=version,
+                                       deadline_ms=deadline_ms)
+        outs = future.result(timeout=timeout)
+        if _tm.enabled():
+            _tm.histogram("serving.request_latency_seconds").observe(
+                time.perf_counter() - t0)
+        return outs
+
+    # ---------------------------------------------------------- worker
+    def _worker(self, served):
+        batcher = served.batcher
+        while True:
+            batch = batcher.next_batch(timeout=0.05)
+            if batch is None:
+                if batcher.closed and batcher.pending() == 0:
+                    return
+                continue
+            self._run_batch(served, batch)
+
+    def _run_batch(self, served, batch):
+        batch.drop_expired()
+        if not batch.requests:
+            return
+        try:
+            padded, true_rows, bucket = batch.assemble(
+                served.batcher.config.buckets)
+            with _tm.span("serving.batch", model=served.name,
+                          rows=true_rows, bucket=bucket,
+                          requests=len(batch.requests)):
+                outs = served.engine.run(padded)
+            if _tm.enabled():
+                _tm.counter("serving.batch_rows_total").inc(true_rows)
+                _tm.counter("serving.pad_rows_total").inc(
+                    bucket - true_rows)
+            batch.scatter(outs, bucket)
+        except Exception as e:            # noqa: BLE001 — to callers
+            if _tm.enabled():
+                _tm.counter("serving.batch_errors").inc()
+            batch.fail(e)

@@ -1,0 +1,368 @@
+"""paddle_tpu.serving: bucket padding round-trips, batcher
+ordering/admission control, warmup precompilation, the HTTP frontend,
+single-flight compile-once concurrency, and the tpuserve --selftest
+subprocess CI gate."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import telemetry as tm
+from paddle_tpu.inference import (InferenceEngine, bucket_feed,
+                                  default_buckets, next_bucket)
+from paddle_tpu.serving import (BatchConfig, DeadlineExceeded,
+                                DynamicBatcher, HttpFrontend,
+                                ModelServer, RejectedError, ServerClosed,
+                                ServerConfig)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Start disabled/empty, leave nothing behind (the bench-contract
+    fast-path test asserts an empty global registry)."""
+    tm.disable()
+    tm.reset()
+    yield
+    tm.disable()
+    tm.reset()
+
+
+def _save_small_model(dirname, feature=8, classes=4):
+    img = layers.data("img", shape=[feature])
+    pred = layers.fc(layers.fc(img, 16, act="relu"), classes,
+                     act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.io.save_inference_model(str(dirname), ["img"], [pred], exe)
+    return str(dirname)
+
+
+# ------------------------------------------------------------ bucket_feed
+
+def test_default_buckets_cover_max():
+    assert default_buckets(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert default_buckets(48) == (1, 2, 4, 8, 16, 32, 48)
+    assert default_buckets(1) == (1,)
+    assert next_bucket(5, (4, 16)) == 16
+    assert next_bucket(4, (4, 16)) == 4
+    with pytest.raises(ValueError):
+        next_bucket(17, (4, 16))
+
+
+def test_bucket_feed_pad_unpad_roundtrip():
+    x = np.arange(10).reshape(5, 2).astype("float32")
+    padded, true_rows, mask = bucket_feed({"x": x}, (2, 8))
+    assert padded["x"].shape == (8, 2)
+    assert true_rows == 5
+    assert mask.tolist() == [True] * 5 + [False] * 3
+    np.testing.assert_array_equal(padded["x"][:true_rows], x)
+    assert (padded["x"][true_rows:] == 0).all()
+    # exact bucket hit: no copy semantics change, full mask
+    padded2, n2, mask2 = bucket_feed({"x": x[:2]}, (2, 8))
+    assert padded2["x"].shape == (2, 2) and n2 == 2 and mask2.all()
+
+
+def test_bucket_feed_validates():
+    with pytest.raises(ValueError):      # rows disagree across feeds
+        bucket_feed({"a": np.zeros((3, 2)), "b": np.zeros((4, 2))},
+                    (4,))
+    with pytest.raises(ValueError):      # exceeds largest bucket
+        bucket_feed({"a": np.zeros((9, 2))}, (4, 8))
+
+
+def test_run_batch_bucket_reuses_one_signature(tmp_path):
+    d = _save_small_model(tmp_path)
+    ref = InferenceEngine.from_dir(d)
+    rng = np.random.RandomState(0)
+    x3 = rng.randn(3, 8).astype("float32")
+    plain = ref.run({"img": x3})[0]
+    eng = InferenceEngine.from_dir(d)    # fresh jit cache for counting
+    bucketed = eng.run({"img": x3}, batch_bucket=(4,))[0]
+    assert bucketed.shape == plain.shape
+    np.testing.assert_allclose(bucketed, plain, rtol=1e-5)
+    eng.run({"img": rng.randn(1, 8).astype("float32")},
+            batch_bucket=(4,))
+    eng.run({"img": rng.randn(4, 8).astype("float32")},
+            batch_bucket=(4,))
+    # 3, 1, and 4-row requests all pad to the single bucket shape
+    assert eng.signature_count() == 1
+
+
+# ---------------------------------------------------------------- batcher
+
+def test_batcher_scatter_preserves_order_and_rows():
+    b = DynamicBatcher(BatchConfig(max_batch_size=8, buckets=(8,),
+                                   max_wait_ms=20.0))
+    sizes = [2, 3, 1]
+    futures = [b.submit({"x": np.full((n, 2), i, dtype="float32")})
+               for i, n in enumerate(sizes)]
+    batch = b.next_batch(timeout=1.0)
+    assert batch is not None and batch.rows == 6
+    padded, true_rows, bucket = batch.assemble((8,))
+    assert padded["x"].shape == (8, 2) and true_rows == 6 and bucket == 8
+    batch.scatter([padded["x"]], bucket)     # echo "engine"
+    for i, n in enumerate(sizes):
+        out = futures[i].result(timeout=1.0)[0]
+        assert out.shape == (n, 2)
+        assert (out == i).all()              # own rows, in order
+
+
+def test_batcher_closes_batch_at_max_rows():
+    b = DynamicBatcher(BatchConfig(max_batch_size=4, buckets=(4,),
+                                   max_wait_ms=10_000.0))
+    futures = [b.submit({"x": np.zeros((2, 1))}) for _ in range(3)]
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=5.0)
+    # full batch forms immediately despite the huge max_wait
+    assert time.monotonic() - t0 < 1.0
+    assert batch.rows == 4 and len(batch.requests) == 2
+    assert b.pending() == 1                  # third request left queued
+    batch.fail(RuntimeError("x"))
+    with pytest.raises(RuntimeError):
+        futures[0].result(timeout=1.0)
+
+
+def test_batcher_separates_incompatible_shapes():
+    b = DynamicBatcher(BatchConfig(max_batch_size=8, buckets=(8,),
+                                   max_wait_ms=1.0))
+    b.submit({"x": np.zeros((2, 4))})
+    b.submit({"x": np.zeros((2, 5))})        # different feature dim
+    first = b.next_batch(timeout=1.0)
+    second = b.next_batch(timeout=1.0)
+    assert len(first.requests) == 1 and len(second.requests) == 1
+    assert first.requests[0].feed["x"].shape != \
+        second.requests[0].feed["x"].shape
+
+
+def test_admission_control_stalled_worker():
+    """No worker attached = a permanently stalled worker: the queue
+    bound rejects fast and deadlines fire while queued."""
+    b = DynamicBatcher(BatchConfig(max_batch_size=4, buckets=(4,),
+                                   max_queue_requests=2))
+    f1 = b.submit({"x": np.zeros((1, 2))}, deadline_ms=50)
+    b.submit({"x": np.zeros((1, 2))})
+    t0 = time.perf_counter()
+    with pytest.raises(RejectedError):
+        b.submit({"x": np.zeros((1, 2))})
+    assert time.perf_counter() - t0 < 0.5    # fail-fast, not queued
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceeded):
+        f1.result()
+    assert time.perf_counter() - t0 < 2.0
+    # oversized requests are rejected outright
+    with pytest.raises(RejectedError):
+        b.submit({"x": np.zeros((5, 2))})
+
+
+def test_worker_drops_expired_requests():
+    b = DynamicBatcher(BatchConfig(max_batch_size=4, buckets=(4,),
+                                   max_wait_ms=0.0))
+    f = b.submit({"x": np.zeros((1, 2))}, deadline_ms=10)
+    time.sleep(0.05)                         # expire while queued
+    batch = b.next_batch(timeout=1.0)
+    assert batch.drop_expired() == 1
+    assert not batch.requests                # nothing left to compute
+    with pytest.raises(DeadlineExceeded):
+        f.result(timeout=1.0)
+
+
+# ----------------------------------------------------------- ModelServer
+
+def test_warmup_precompiles_exactly_the_bucket_set(tmp_path):
+    d = _save_small_model(tmp_path)
+    server = ModelServer(ServerConfig(
+        batch=BatchConfig(max_batch_size=4, buckets=(2, 4),
+                          max_wait_ms=1.0), workers=1))
+    try:
+        server.load("m", d)
+        eng, _ = server.registry.get("m")
+        assert eng.signature_count() == 2    # one per bucket, no more
+        out = server.predict(
+            "m", {"img": np.random.RandomState(0)
+                  .randn(3, 8).astype("float32")}, deadline_ms=10_000)
+        assert out[0].shape == (3, 4)
+        assert eng.signature_count() == 2    # traffic adds none
+    finally:
+        server.shutdown(timeout=5.0)
+
+
+def test_server_matches_unbatched_engine(tmp_path):
+    d = _save_small_model(tmp_path)
+    server = ModelServer(ServerConfig(
+        batch=BatchConfig(max_batch_size=8, buckets=(2, 8),
+                          max_wait_ms=2.0), workers=2))
+    try:
+        server.load("m", d)
+        ref = InferenceEngine.from_dir(d)
+        rng = np.random.RandomState(1)
+        feeds = [{"img": rng.randn(1 + i % 8, 8).astype("float32")}
+                 for i in range(24)]
+        expected = [ref.run(f)[0] for f in feeds]
+        got = [None] * len(feeds)
+
+        def call(i):
+            got[i] = server.predict("m", feeds[i],
+                                    deadline_ms=30_000)[0]
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(feeds))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, exp in enumerate(expected):
+            np.testing.assert_allclose(got[i], exp, rtol=1e-5,
+                                       err_msg=f"request {i}")
+    finally:
+        server.shutdown(timeout=5.0)
+
+
+def test_shutdown_drains_then_rejects(tmp_path):
+    d = _save_small_model(tmp_path)
+    server = ModelServer(ServerConfig(
+        batch=BatchConfig(max_batch_size=4, buckets=(4,),
+                          max_wait_ms=1.0), workers=1))
+    server.load("m", d)
+    x = {"img": np.zeros((1, 8), dtype="float32")}
+    futures = [server.submit("m", x)[0] for _ in range(5)]
+    server.shutdown(drain=True, timeout=10.0)
+    for f in futures:                        # drained, not dropped
+        assert len(f.result(timeout=1.0)) == 1
+    with pytest.raises(ServerClosed):
+        server.submit("m", x)
+    assert not server.healthy
+
+
+def test_registry_versions(tmp_path):
+    d = _save_small_model(tmp_path)
+    server = ModelServer(ServerConfig(
+        batch=BatchConfig(max_batch_size=2, buckets=(2,)), workers=1))
+    try:
+        v1 = server.load("m", d)
+        v2 = server.load("m", d)
+        assert (v1, v2) == (1, 2)
+        _eng, latest = server.registry.get("m")
+        assert latest == 2                   # default = newest version
+        with pytest.raises(KeyError):
+            server.registry.get("nope")
+        with pytest.raises(KeyError):
+            server.registry.get("m", version=9)
+    finally:
+        server.shutdown(timeout=5.0)
+
+
+# -------------------------------------------------------------- frontend
+
+def test_http_predict_healthz_metrics_roundtrip(tmp_path):
+    tm.enable()
+    d = _save_small_model(tmp_path)
+    server = ModelServer(ServerConfig(
+        batch=BatchConfig(max_batch_size=4, buckets=(4,),
+                          max_wait_ms=1.0), workers=1))
+    server.load("m", d)
+    ref = InferenceEngine.from_dir(d)
+    x = np.random.RandomState(2).randn(3, 8).astype("float32")
+    with HttpFrontend(server, port=0) as fe:     # ephemeral port
+        req = urllib.request.Request(
+            fe.url + "/v1/models/m:predict",
+            data=json.dumps({"inputs": {"img": x.tolist()}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body["model"] == "m" and body["version"] == 1
+        np.testing.assert_allclose(
+            np.asarray(body["outputs"][0], dtype="float32"),
+            ref.run({"img": x})[0], rtol=1e-4, atol=1e-6)
+
+        with urllib.request.urlopen(fe.url + "/healthz",
+                                    timeout=10) as resp:
+            assert json.loads(resp.read())["status"] == "ok"
+        with urllib.request.urlopen(fe.url + "/metrics",
+                                    timeout=10) as resp:
+            prom = resp.read().decode()
+        assert "serving_batches" in prom
+        assert "inference_signature_count" in prom
+        with urllib.request.urlopen(fe.url + "/v1/models",
+                                    timeout=10) as resp:
+            assert json.loads(resp.read())["models"] == {"m": [1]}
+
+        with pytest.raises(urllib.error.HTTPError) as e404:
+            urllib.request.urlopen(urllib.request.Request(
+                fe.url + "/v1/models/ghost:predict", data=b"{}"),
+                timeout=10)
+        assert e404.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e400:
+            urllib.request.urlopen(urllib.request.Request(
+                fe.url + "/v1/models/m:predict",
+                data=b'{"inputs": "not an object"}'), timeout=10)
+        assert e400.value.code == 400
+    server.shutdown(timeout=5.0)
+
+
+# ------------------------------------------------- single-flight compile
+
+def test_concurrent_same_signature_compiles_once(tmp_path):
+    tm.enable()
+    d = _save_small_model(tmp_path)
+    eng = InferenceEngine.from_dir(d)
+    tm.reset()                               # drop load-time metrics
+    x = np.random.RandomState(3).randn(2, 8).astype("float32")
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+    outs, errs = [None] * n_threads, []
+
+    def racer(i):
+        try:
+            barrier.wait(timeout=10)
+            outs[i] = eng.run({"img": x})[0]
+        except Exception as e:               # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=racer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for o in outs[1:]:
+        np.testing.assert_array_equal(o, outs[0])
+    snap = tm.snapshot()
+    # the dict-race this guards against compiled once per racing thread
+    assert snap["inference.compile_count"] == 1
+    assert snap["inference.signature_count"] == 1
+    assert eng.signature_count() == 1
+    dedup = snap.get("inference.compile_dedup_count", 0)
+    hits = snap.get("inference.cache_hit_count", 0)
+    assert dedup + hits == n_threads - 1
+
+
+# ----------------------------------------------------- tpuserve CI gate
+
+def test_tpuserve_selftest_subprocess():
+    """The acceptance path: mixed-shape concurrent load over HTTP with
+    compile_count <= bucket count, zero mismatches vs unbatched run,
+    and fast overload rejection — as a CPU-only subprocess."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpuserve.py"),
+         "--selftest", "--json"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["ok"] is True and obj["problems"] == []
+    assert obj["warmup_signatures"] == len(obj["buckets"])
+    assert obj["signatures_after_traffic"] <= len(obj["buckets"])
+    assert obj["mismatches"] == 0
+    assert obj["overload"]["rejected"] >= 1

@@ -1,0 +1,476 @@
+#!/usr/bin/env python
+"""tpuserve — serve a save_inference_model dir with dynamic batching.
+
+The serving counterpart of tools/tpustat.py: loads a model directory
+into `paddle_tpu.serving.ModelServer` (shape-bucketed dynamic batching,
+admission control, warmup) and exposes the TF-Serving-shaped HTTP API:
+
+  POST /v1/models/<name>:predict   {"inputs": {feed: tensor}, ...}
+  GET  /healthz
+  GET  /metrics                    (telemetry prometheus_text)
+
+Modes:
+  serve (default)  python tools/tpuserve.py MODEL_DIR --port 8500
+  --bench          closed-loop load generator against the served model:
+                   reports p50/p99 latency, throughput, compile count,
+                   reject rate (one JSON line with --json)
+  --selftest       CI gate in the tpustat --json style: builds an mnist
+                   model, serves it, fires mixed-shape concurrent
+                   requests over HTTP, and exits non-zero unless
+                   compile_count <= bucket count, every response matches
+                   unbatched InferenceEngine.run, and overload requests
+                   are rejected within their deadline.
+
+Examples:
+  python tools/tpuserve.py /models/mnist --name mnist --port 8500
+  python tools/tpuserve.py /models/mnist --bench --duration 5 --json
+  python tools/tpuserve.py --selftest --json
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _post_json(url, payload, timeout=30.0):
+    """(status_code, decoded_body) — errors returned, not raised."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            body = {"error": str(e)}
+        return e.code, body
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _build_server(args, dirname, name):
+    from paddle_tpu.serving import (BatchConfig, HttpFrontend,
+                                    ModelServer, ServerConfig)
+    buckets = tuple(int(b) for b in args.buckets.split(",")) \
+        if args.buckets else None
+    cfg = ServerConfig(
+        batch=BatchConfig(max_batch_size=args.max_batch_size,
+                          max_wait_ms=args.max_wait_ms,
+                          buckets=buckets,
+                          max_queue_requests=args.max_queue),
+        workers=args.workers,
+        default_deadline_ms=args.deadline_ms)
+    server = ModelServer(cfg)
+    server.load(name, dirname)
+    frontend = HttpFrontend(server, host=args.host, port=args.port)
+    return server, frontend
+
+
+def _mixed_feeds(engine, count, max_rows, seed=0):
+    """`count` random feeds with batch sizes cycling over a mixed set
+    (1..max_rows), dtypes/shapes from the engine's feed specs."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    sizes = [1, 2, 3, max(1, max_rows // 2), max_rows,
+             max(1, max_rows - 1), max(1, max_rows // 4), 2]
+    specs = engine.feed_specs()
+    feeds = []
+    for i in range(count):
+        n = sizes[i % len(sizes)]
+        feed = {}
+        for fname, (shape, dt) in specs.items():
+            full = (n,) + tuple(d if d != -1 else 1 for d in shape[1:])
+            if np.dtype(dt).kind in "iu":
+                feed[fname] = rng.randint(0, 10, full).astype(dt)
+            else:
+                feed[fname] = rng.rand(*full).astype(dt)
+        feeds.append(feed)
+    return feeds
+
+
+# ----------------------------------------------------------------- bench
+def run_bench(args):
+    from paddle_tpu import telemetry
+    telemetry.enable()
+    name = args.name
+    server, frontend = _build_server(args, args.model_dir, name)
+    frontend.start()
+    engine, _ = server.registry.get(name)
+    warm_sigs = engine.signature_count()
+    telemetry.reset()        # scope metrics to the measured loop
+
+    feeds = _mixed_feeds(engine, 64, args.max_batch_size)
+    url = f"{frontend.url}/v1/models/{name}:predict"
+    stop_t = time.monotonic() + args.duration
+    lock = threading.Lock()
+    lat, rejects, errors, rows_done = [], [0], [0], [0]
+
+    def worker(wid):
+        i = wid
+        while time.monotonic() < stop_t:
+            feed = feeds[i % len(feeds)]
+            i += args.concurrency
+            payload = {"inputs": {k: v.tolist() for k, v in feed.items()}}
+            if args.deadline_ms:
+                payload["deadline_ms"] = args.deadline_ms
+            t0 = time.perf_counter()
+            status, body = _post_json(url, payload)
+            dt = time.perf_counter() - t0
+            rows = next(iter(feed.values())).shape[0]
+            with lock:
+                if status == 200:
+                    lat.append(dt)
+                    rows_done[0] += rows
+                elif status in (429, 504):
+                    rejects[0] += 1
+                else:
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(args.concurrency)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t_start
+
+    frontend.stop()
+    server.shutdown()
+    lat.sort()
+    snap = telemetry.snapshot()
+    total = len(lat) + rejects[0] + errors[0]
+    result = {
+        "mode": "bench", "model": name,
+        "duration_s": round(elapsed, 3),
+        "concurrency": args.concurrency,
+        "requests_ok": len(lat), "rejected": rejects[0],
+        "errors": errors[0],
+        "reject_rate": round(rejects[0] / total, 4) if total else 0.0,
+        "throughput_rps": round(len(lat) / elapsed, 2),
+        "throughput_rows_per_s": round(rows_done[0] / elapsed, 1),
+        "latency_p50_ms": round(1e3 * _percentile(lat, 0.50), 3)
+        if lat else None,
+        "latency_p99_ms": round(1e3 * _percentile(lat, 0.99), 3)
+        if lat else None,
+        "compile_count_warmup": warm_sigs,
+        "compile_count_steady": snap.get("inference.compile_count", 0),
+        "signature_count": engine.signature_count(),
+        "batches": snap.get("serving.batches", 0),
+        "mean_rows_per_batch": round(
+            rows_done[0] / snap["serving.batches"], 2)
+        if snap.get("serving.batches") else None,
+    }
+    if args.as_json:
+        print(json.dumps(result))
+    else:
+        for k, v in result.items():
+            print(f"  {k:<24} {v}")
+    return 1 if errors[0] else 0
+
+
+# -------------------------------------------------------------- selftest
+def _build_mnist_dir(tmpdir):
+    """Train-free mnist MLP -> save_inference_model dir."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.models import mnist as zoo
+    img = layers.data("pixel", shape=[784])
+    predict = zoo.mlp(img)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.io.save_inference_model(tmpdir, ["pixel"], [predict], exe)
+    return tmpdir
+
+
+class _StallEngine:
+    """Duck-typed engine whose run() stalls — overload on demand."""
+
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+
+    def feed_specs(self):
+        return {"pixel": ((-1, 4), "float32")}
+
+    def signature_count(self):
+        return 0
+
+    def run(self, feed, return_numpy=True):
+        import numpy as np
+        time.sleep(self.delay_s)
+        return [np.zeros((next(iter(feed.values())).shape[0], 1),
+                         dtype="float32")]
+
+
+def run_selftest(args):
+    import numpy as np
+    from paddle_tpu import telemetry
+    from paddle_tpu.inference import InferenceEngine
+    from paddle_tpu.serving import (BatchConfig, DynamicBatcher,
+                                    DeadlineExceeded, HttpFrontend,
+                                    ModelServer, RejectedError,
+                                    ServerConfig)
+
+    telemetry.enable()
+    problems = []
+    buckets = (4, 16)
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        model_dir = _build_mnist_dir(tmpdir)
+        cfg = ServerConfig(
+            batch=BatchConfig(max_batch_size=16, max_wait_ms=2.0,
+                              buckets=buckets, max_queue_requests=256),
+            workers=3)
+        server = ModelServer(cfg)
+        server.load("mnist", model_dir)
+        engine, _ = server.registry.get("mnist")
+        warm_sigs = engine.signature_count()
+        if warm_sigs != len(buckets):
+            problems.append(
+                f"warmup compiled {warm_sigs} signatures, expected "
+                f"exactly {len(buckets)} (one per bucket)")
+
+        # mixed-shape concurrent traffic over HTTP vs unbatched reference
+        ref = InferenceEngine.from_dir(model_dir)
+        feeds = _mixed_feeds(engine, 48, 16, seed=7)
+        expected = [ref.run(f)[0] for f in feeds]
+        frontend = HttpFrontend(server, port=0).start()
+        url = f"{frontend.url}/v1/models/mnist:predict"
+        statuses = [None] * len(feeds)
+        outputs = [None] * len(feeds)
+
+        def fire(i):
+            statuses[i], body = _post_json(url, {
+                "inputs": {k: v.tolist() for k, v in feeds[i].items()},
+                "deadline_ms": 30000})
+            if statuses[i] == 200:
+                outputs[i] = np.asarray(body["outputs"][0],
+                                        dtype="float32")
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(len(feeds))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        mismatches = 0
+        for i, exp in enumerate(expected):
+            if statuses[i] != 200:
+                problems.append(f"request {i} failed: HTTP {statuses[i]}")
+            elif not np.allclose(outputs[i], exp, rtol=1e-4, atol=1e-6):
+                mismatches += 1
+        if mismatches:
+            problems.append(f"{mismatches} responses differ from "
+                            f"unbatched InferenceEngine.run")
+        sigs = engine.signature_count()
+        if sigs > len(buckets):
+            problems.append(
+                f"compile_count {sigs} exceeds bucket count "
+                f"{len(buckets)} — shape bucketing is not containing "
+                f"signature explosion")
+
+        # healthz + metrics surfaces
+        with urllib.request.urlopen(frontend.url + "/healthz") as r:
+            if json.loads(r.read()).get("status") != "ok":
+                problems.append("healthz not ok while serving")
+        with urllib.request.urlopen(frontend.url + "/metrics") as r:
+            metrics_text = r.read().decode()
+        for needle in ("serving_batches", "inference_signature_count"):
+            if needle not in metrics_text:
+                problems.append(f"/metrics missing {needle}")
+
+        # overload over HTTP: one stalled worker, bounded queue, short
+        # deadlines — rejections must come back fast, not queue forever
+        slow = ModelServer(ServerConfig(
+            batch=BatchConfig(max_batch_size=4, max_wait_ms=0.0,
+                              buckets=(4,), max_queue_requests=2),
+            workers=1, warmup=False))
+        slow.register("slow", _StallEngine(0.3))
+        sfront = HttpFrontend(slow, port=0).start()
+        surl = f"{sfront.url}/v1/models/slow:predict"
+        deadline_ms = 200.0
+        reject_lat, ok_n, late = [], [0], [0]
+
+        def flood(i):
+            t0 = time.perf_counter()
+            status, _body = _post_json(surl, {
+                "inputs": {"pixel": [[0.0] * 4]},
+                "deadline_ms": deadline_ms})
+            dt = time.perf_counter() - t0
+            if status == 200:
+                ok_n[0] += 1
+            else:
+                reject_lat.append(dt)
+                # client-observed: deadline + generous slack for 24
+                # client threads contending on the GIL; the hard bound
+                # on *server-side* queueing is the flood-duration check
+                if dt > deadline_ms / 1e3 + 2.0:
+                    late[0] += 1
+
+        flooders = [threading.Thread(target=flood, args=(i,))
+                    for i in range(24)]
+        t_flood = time.monotonic()
+        for t in flooders:
+            t.start()
+        for t in flooders:
+            t.join()
+        flood_s = time.monotonic() - t_flood
+        if not reject_lat:
+            problems.append("overload produced zero rejections "
+                            "(queue grew unboundedly?)")
+        if late[0]:
+            problems.append(f"{late[0]} overload rejections took "
+                            f"longer than deadline+2s")
+        # had the 24 requests queued unboundedly behind the 0.3s/batch
+        # stalled worker they would serialize to ~7s; load shedding
+        # must finish the whole flood far sooner
+        if flood_s > 5.0:
+            problems.append(
+                f"overload flood took {flood_s:.1f}s — requests piled "
+                f"up behind the stalled worker instead of being shed")
+        sfront.stop()
+        slow.shutdown(drain=False, timeout=5.0)
+
+        # admission control at the batcher level, deterministically:
+        # no worker attached = a permanently stalled worker
+        b = DynamicBatcher(BatchConfig(max_batch_size=4, buckets=(4,),
+                                       max_queue_requests=2))
+        f1 = b.submit({"x": np.zeros((1, 2))}, deadline_ms=100)
+        b.submit({"x": np.zeros((1, 2))})
+        t0 = time.perf_counter()
+        try:
+            b.submit({"x": np.zeros((1, 2))})
+            problems.append("queue-full submit was admitted")
+        except RejectedError:
+            if time.perf_counter() - t0 > 0.1:
+                problems.append("queue-full rejection was not fast")
+        t0 = time.perf_counter()
+        try:
+            f1.result()
+            problems.append("stalled request returned a result")
+        except DeadlineExceeded:
+            if time.perf_counter() - t0 > 1.0:
+                problems.append("deadline enforcement took > 1s on a "
+                                "stalled worker")
+
+        snap = telemetry.snapshot()
+        frontend.stop()
+        server.shutdown()
+
+    result = {
+        "mode": "selftest",
+        "buckets": list(buckets),
+        "warmup_signatures": warm_sigs,
+        "signatures_after_traffic": sigs,
+        "requests": len(feeds),
+        "mismatches": mismatches,
+        "overload": {"sent": 24, "ok": ok_n[0],
+                     "rejected": len(reject_lat),
+                     "duration_s": round(flood_s, 3),
+                     "max_reject_latency_s":
+                     round(max(reject_lat), 3) if reject_lat else None},
+        "metrics": {k: v for k, v in sorted(snap.items())
+                    if not isinstance(v, dict)},
+        "problems": problems,
+        "ok": not problems,
+    }
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    else:
+        print(f"tpuserve selftest: warmup {warm_sigs} sigs for "
+              f"{len(buckets)} buckets; {len(feeds)} mixed-shape "
+              f"requests, {mismatches} mismatches; overload "
+              f"{len(reject_lat)}/24 rejected "
+              f"(max {result['overload']['max_reject_latency_s']}s)")
+        for prob in problems:
+            print(f"FAIL: {prob}", file=sys.stderr)
+    return 2 if problems else 0
+
+
+# ------------------------------------------------------------------ serve
+def run_serve(args):
+    from paddle_tpu import telemetry
+    telemetry.enable()      # /metrics should always have data
+    server, frontend = _build_server(args, args.model_dir, args.name)
+    engine, version = server.registry.get(args.name)
+    print(f"tpuserve: serving {args.name!r} v{version} from "
+          f"{args.model_dir} at {frontend.url} "
+          f"({engine.signature_count()} signatures warm, buckets "
+          f"{server.config.batch.buckets})")
+    try:
+        frontend.serve_forever()
+    except KeyboardInterrupt:
+        print("draining...")
+    finally:
+        server.shutdown()
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="dynamic-batching model server over a "
+                    "save_inference_model dir")
+    p.add_argument("model_dir", nargs="?",
+                   help="save_inference_model directory (not needed "
+                        "with --selftest)")
+    p.add_argument("--name", default="default",
+                   help="model name in the /v1/models/<name> route")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8500,
+                   help="0 picks an ephemeral port")
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated batch buckets, e.g. 1,8,32 "
+                        "(default: powers of two up to max batch)")
+    p.add_argument("--max-batch-size", type=int, default=32)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request deadline")
+    p.add_argument("--platform", default="cpu",
+                   help="JAX_PLATFORMS to force ('env' keeps the "
+                        "environment's value)")
+    p.add_argument("--bench", action="store_true",
+                   help="closed-loop load generator; implies no "
+                        "serve-forever")
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="--bench wall-clock seconds")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="--bench closed-loop client threads")
+    p.add_argument("--selftest", action="store_true",
+                   help="CI gate: serve mnist, mixed-shape concurrent "
+                        "load, exit non-zero on compile explosion / "
+                        "result mismatch / unbounded overload")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="one machine-readable JSON line")
+    args = p.parse_args(argv)
+
+    if args.platform != "env":
+        os.environ["JAX_PLATFORMS"] = args.platform
+    if args.selftest:
+        return run_selftest(args)
+    if not args.model_dir:
+        p.error("model_dir is required unless --selftest")
+    if args.bench:
+        return run_bench(args)
+    return run_serve(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

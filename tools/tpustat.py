@@ -187,11 +187,17 @@ def main(argv=None):
         problems.append(f"trace does not round-trip: {e}")
 
     import jax
+    # signature explosion at a glance: distinct compiled signatures
+    # across the executor and inference engines (each gauge is set at
+    # compile time — see executor.run / InferenceEngine._get_fn)
+    signatures = int(max(snap.get("executor.signature_count", 0),
+                         snap.get("inference.signature_count", 0)))
     result = {
         "model": args.model,
         "steps": args.steps,
         "batch_size": args.batch_size,
         "platform": jax.devices()[0].platform,
+        "signatures": signatures,
         "final_loss": losses[-1] if losses else None,
         "metrics": snap,
         "trace": {"path": trace_path, "span_events": span_events},
@@ -206,7 +212,8 @@ def main(argv=None):
     else:
         print(f"tpustat: {args.model} x {args.steps} steps "
               f"(batch {args.batch_size}) on "
-              f"{result['platform']}")
+              f"{result['platform']}, {signatures} compiled "
+              f"signature{'s' if signatures != 1 else ''}")
         width = max((len(k) for k in snap), default=10)
         for name in sorted(snap):
             print(f"  {name:<{width}}  {_fmt_value(snap[name])}")
