@@ -66,6 +66,41 @@ def _score(obj):
     return 1 if has_value else 0
 
 
+# The driver's tail capture records the LAST stdout line; round-5
+# VERDICT showed an embedded probe trail blowing past it (parsed:
+# null). The final line must stay under this budget — the full
+# forensic trail goes to the BENCH_probe.json artifact instead.
+_FINAL_LINE_BUDGET = 2048
+
+
+def _compact_final(obj, limit=_FINAL_LINE_BUDGET):
+    """Shrink a result line under `limit` bytes by dropping forensic
+    bulk (largest first), never the headline schema keys."""
+    obj = dict(obj)
+    if isinstance(obj.get("probe"), dict):
+        obj["probe"] = dict(obj["probe"])
+    fits = lambda: len(json.dumps(obj)) < limit
+    if fits():
+        return obj
+    for key in ("traceback", "attempts", "children", "evidence",
+                "stage_seconds", "device_profile"):
+        obj.pop(key, None)
+        if isinstance(obj.get("probe"), dict):
+            obj["probe"].pop(key, None)
+        if fits():
+            return obj
+    keep = {"metric", "value", "unit", "vs_baseline", "platform",
+            "probe", "mnist_mlp_steps_per_sec", "error", "signal"}
+    for key in sorted(obj, key=lambda k: -len(json.dumps(obj[k],
+                                                         default=str))):
+        if key in keep:
+            continue
+        obj.pop(key, None)
+        if fits():
+            return obj
+    return obj
+
+
 # Peak bf16 FLOP/s per chip by device kind (scaling-book table).
 _PEAK_BF16 = (
     ("v6", 918e12), ("trillium", 918e12),
@@ -785,7 +820,7 @@ class _Supervisor:
         # half-written line (a signal can interrupt a non-_emit write),
         # and _emit's lock serializes against the pump threads
         self.best["signal"] = signum
-        _emit(self.best, lead="\n")
+        _emit(_compact_final(self.best), lead="\n")
         os._exit(0)
 
     def _stream_child(self, env, timeout):
@@ -924,16 +959,32 @@ class _Supervisor:
             rec = probe("compute", remaining() - 50.0)
             if probe_hit(rec):
                 done = tpu_child(None)
-        # Make the last line the best-known result, with the complete
-        # probe/child forensic trail attached for the record.
-        self.best["probe"] = {
+        # Make the last line the best-known result with a COMPACT probe
+        # summary; the complete attempt/child forensic trail goes to the
+        # BENCH_probe.json artifact (the round-5 embedded trail overflowed
+        # the driver's tail capture and killed the whole artifact).
+        probe_summary = {
             "witnessed_tpu": bool(done), "no_tpu_plugin": no_tpu,
             "cpu_fallback_ran": cpu_done,
             "tpu_children": sum(1 for c in children
                                 if c["kind"] == "tpu"),
-            "attempts": attempts, "children": children,
-            "seconds": round(time.monotonic() - self.t0, 1)}
-        _emit(self.best)
+            "attempts": len(attempts), "children": len(children),
+            "seconds": round(time.monotonic() - self.t0, 1),
+            "trail": "BENCH_probe.json"}
+        trail_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_probe.json")
+        try:
+            with open(trail_path, "w") as f:
+                json.dump({"probe": dict(probe_summary,
+                                         attempts=attempts,
+                                         children=children),
+                           "best": self.best}, f, indent=1,
+                          default=str)
+        except OSError:
+            probe_summary["trail"] = "(unwritable)"
+        self.best["probe"] = probe_summary
+        _emit(_compact_final(self.best))
 
 
 def main():

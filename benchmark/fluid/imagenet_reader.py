@@ -82,11 +82,18 @@ def _make(data_dir, list_name, sub_dir, is_train, shuffle, n_synth,
             or not os.path.exists(os.path.join(data_dir, list_name)):
         return _synthetic(n_synth, seed)
     entries = _file_list(data_dir, list_name, sub_dir)
-    if shuffle:
-        np.random.RandomState(0).shuffle(entries)
+    epoch = [0]
 
     def raw_reader():
-        return iter(entries)
+        # reshuffle per PASS with a per-epoch seed: one construction-time
+        # shuffle would feed every epoch the identical order (and the
+        # same batch composition), quietly hurting convergence —
+        # deterministic across runs, different across epochs
+        order = list(entries)
+        if shuffle:
+            np.random.RandomState(seed + epoch[0]).shuffle(order)
+            epoch[0] += 1
+        return iter(order)
 
     # eval keeps stream order (stable metrics pairing); train doesn't
     # need it and unordered drains the pool faster

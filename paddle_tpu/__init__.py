@@ -132,6 +132,7 @@ from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
                          InferenceTranspiler, memory_optimize,
                          release_memory, HashName, RoundRobin)
 from . import analysis
+from . import diagnostics
 from . import contrib
 from .async_executor import AsyncExecutor
 from .data_feed_desc import DataFeedDesc

@@ -74,6 +74,10 @@ class Executor:
         self._step = 0
         self._seed = 0
         self.check_nan_inf = False   # failure-detection flag (SURVEY §2.8)
+        # diagnostics bookkeeping: how many runs took the pre-step state
+        # snapshot (must stay 0 with all diag flags off — bench contract)
+        self.diag_snapshot_count = 0
+        self.last_numerics_report = None
         # stall detection (SURVEY §2.8): a step (excluding its first-run
         # XLA compile) exceeding this wall-clock budget logs a warning —
         # the race/stall analog of the reference's distributed watchdogs.
@@ -208,13 +212,81 @@ class Executor:
         t_scan = time.perf_counter() - t0
         return t_scan > ratio * max(t_unroll, 1e-6)
 
-    def _check_fetches_finite(self, fetch_names, fetches):
-        for name, val in zip(fetch_names, fetches):
+    @staticmethod
+    def _nonfinite_names(named_values):
+        """Names whose (host-read) values contain NaN/Inf. Handles
+        bfloat16 etc. (numpy kind 'V': issubdtype(floating) is False
+        but np.isfinite works on the ml_dtypes array directly)."""
+        bad = []
+        for name, val in named_values:
             arr = np.asarray(val)
-            if np.issubdtype(arr.dtype, np.floating) \
-                    and not np.all(np.isfinite(arr)):
-                raise FloatingPointError(
-                    f"NaN/Inf detected in fetched var {name!r}")
+            if arr.dtype.kind in "fc" or arr.dtype.kind == "V":
+                try:
+                    ok = bool(np.all(np.isfinite(arr)))
+                except TypeError:      # non-float void dtype
+                    continue
+                if not ok:
+                    bad.append(name)
+        return bad
+
+    def _check_fetches_finite(self, fetch_names, fetches):
+        bad = self._nonfinite_names(zip(fetch_names, fetches))
+        if bad:
+            raise FloatingPointError(
+                f"NaN/Inf detected in fetched var {bad[0]!r}")
+
+    # ------------------------------------------------------------------
+    def _check_requested(self, check_nan_inf):
+        """Resolve the run(check_nan_inf=...) tri-state: explicit arg >
+        the executor attribute > the PADDLE_TPU_CHECK_NAN_INF env
+        toggle. Returns "all", "fetches", or False."""
+        val = check_nan_inf if check_nan_inf is not None \
+            else (self.check_nan_inf or None)
+        if val is None:
+            from .. import diagnostics as _dg
+            if not _dg.check_nan_inf_requested():
+                return False
+            return _dg.check_mode()
+        if not val:
+            return False
+        return val if val in ("all", "fetches") else "all"
+
+    def _diagnose_nan_inf(self, program, feed_arrays, pre_state,
+                          fetch_names, is_test, seed, step_val,
+                          detail):
+        """A finite check tripped: localize the culprit op by bisection
+        and raise NanInfError carrying the NumericsReport (plus a
+        flight-recorder dump when the recorder is armed)."""
+        from .. import diagnostics as _dg
+        if _tm.enabled():
+            _tm.counter("diagnostics.nan_inf_count").inc()
+        report = None
+        if pre_state is not None:
+            try:
+                report = _dg.localize(
+                    program, feed_arrays, pre_state, fetch_names,
+                    is_test=is_test, place=self.place, seed=seed,
+                    step=step_val)
+            except Exception as e:   # diagnosis must not mask the trip
+                _LOG.warning("NaN localization failed: %s: %s",
+                             type(e).__name__, e)
+        if report is None:
+            report = _dg.NumericsReport(
+                "unknown", step=step_val, seed=seed,
+                program_version=program._version,
+                detail=detail + "; re-execution did not reproduce a "
+                "non-finite value (non-determinism, or the failure "
+                "is outside the traced step)")
+        else:
+            report.detail = (report.detail + "; trigger: " + detail) \
+                if report.detail else detail
+        self.last_numerics_report = report
+        rec = _dg.recorder.active()
+        if rec is not None:
+            rec.event("nan_inf", step=step_val,
+                      op=report.op_type, op_idx=report.op_idx)
+            rec.dump(reason="nan_inf", report=report)
+        raise _dg.NanInfError(report)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -238,7 +310,7 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, is_test=None,
-            validate=None):
+            validate=None, check_nan_inf=None):
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
@@ -262,6 +334,12 @@ class Executor:
         # stay empty — pinned by tests/test_bench_contract.py); spans are
         # shared no-op singletons when off
         tm_on = _tm.enabled()
+        # diagnostics gates: both resolve to a cached None/False when the
+        # env flags are unset — zero extra fetches or device work then
+        # (pinned by the bench contract)
+        check = self._check_requested(check_nan_inf)
+        from ..diagnostics import recorder as _fr
+        flight = _fr.active()
         dev = self.place.jax_device()
         with _tm.span("executor.feed_put", feeds=len(feed)):
             feed_arrays = self._put_feeds(program, feed, dev)
@@ -278,6 +356,9 @@ class Executor:
         first_run = ckey not in self._seen_keys
         self._seen_keys.add(ckey)
         if fn is None:
+            if flight is not None:
+                flight.event("compile", program=program._version,
+                             fetches=len(fetch_names))
             if tm_on:
                 _tm.counter("executor.compile_count").inc()
                 _tm.gauge("executor.signature_count").set(
@@ -316,6 +397,17 @@ class Executor:
             # device, poisoning later mesh-sharded use of the scope
             # (e.g. startup → PipelineTrainer over a pp mesh)
             step_dev = jnp.asarray(self._step - 1, jnp.int32)
+        pre_state = None
+        step_val = None
+        if check:
+            # host snapshot of the donated state + the PRNG step counter
+            # so a trip can re-execute this exact step eagerly (np.array
+            # copy: np.asarray may alias a CPU buffer that donation is
+            # about to invalidate)
+            pre_state = {k: np.array(v, copy=True)
+                         for k, v in persist.items()}
+            step_val = int(np.asarray(step_dev))
+            self.diag_snapshot_count += 1
         t0 = time.perf_counter()
         try:
             with _tm.span("executor.step", step=self._step - 1,
@@ -335,6 +427,11 @@ class Executor:
             jax.block_until_ready(fetches)
         dt = time.perf_counter() - t0
         self.last_step_time = dt
+        if flight is not None:
+            flight.record(step=self._step - 1,
+                          program=program._version, compile=first_run,
+                          step_s=round(dt, 5),
+                          fetches=len(fetch_names))
         if tm_on:
             _tm.counter("executor.steps").inc()
             _tm.histogram("executor.step_seconds").observe(dt)
@@ -352,13 +449,26 @@ class Executor:
         for name, val in new_persist.items():
             scope.set(name, val)
 
-        if self.check_nan_inf and fetches:
+        if check and (fetches or check == "all"):
             t_fc = time.perf_counter()
             with _tm.span("executor.finite_check"):
-                self._check_fetches_finite(fetch_names, fetches)
+                bad = self._nonfinite_names(zip(fetch_names, fetches))
+                where = "fetched vars"
+                if not bad and check == "all":
+                    # the reference's FLAGS_check_nan_inf checks every
+                    # op output; the whole-program analog is the full
+                    # updated state (params + optimizer accumulators)
+                    bad = self._nonfinite_names(new_persist.items())
+                    where = "updated persistable state"
             if tm_on:
                 _tm.histogram("executor.finite_check_seconds").observe(
                     time.perf_counter() - t_fc)
+            if bad:
+                self._diagnose_nan_inf(
+                    program, feed_arrays, pre_state, fetch_names,
+                    bool(is_test), seed, step_val,
+                    detail=f"non-finite {where}: "
+                           f"{bad[:4]}{'...' if len(bad) > 4 else ''}")
 
         if return_numpy:
             t_rb = time.perf_counter()
@@ -367,6 +477,12 @@ class Executor:
             if tm_on:
                 _tm.histogram("executor.fetch_readback_seconds").observe(
                     time.perf_counter() - t_rb)
+            if flight is not None and out \
+                    and getattr(out[0], "size", 0) == 1 \
+                    and np.asarray(out[0]).dtype.kind in "fV":
+                flight.annotate(
+                    loss=float(np.asarray(out[0]).astype(
+                        np.float32).ravel()[0]))
             return out
         return fetches
 
@@ -512,7 +628,16 @@ class Executor:
         for name, val in new_persist.items():
             scope.set(name, val)
         if self.check_nan_inf and fetches:
-            self._check_fetches_finite(fetch_names, fetches)
+            try:
+                self._check_fetches_finite(fetch_names, fetches)
+            except FloatingPointError as e:
+                # scanned windows donate state per window, not per
+                # step — no pre-step snapshot exists to bisect against
+                raise FloatingPointError(
+                    f"{e} (in a {steps}-step scanned window; replay "
+                    "the window with per-step Executor.run("
+                    "check_nan_inf=True) to localize the culprit op)"
+                ) from None
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -74,8 +74,14 @@ def draw_block_graphviz(block, highlights=None, path="./graph.dot",
 
 
 def scope_summary(scope=None, top=20):
-    """Largest live vars + NaN/Inf flags (memory introspection aid)."""
+    """Largest live vars + NaN/Inf flags (memory introspection aid).
+
+    Stats come from diagnostics.tensor_stats — the same record the
+    numerics doctor puts in a NumericsReport, so this view also counts
+    NaN/Inf occurrences and handles bfloat16 (plain np.issubdtype
+    misses it)."""
     from .core.scope import global_scope
+    from .diagnostics import tensor_stats
     scope = scope or global_scope()
     rows = []
     for name in scope.keys():
@@ -83,9 +89,8 @@ def scope_summary(scope=None, top=20):
         if v is None or not hasattr(v, "shape"):
             continue
         arr = np.asarray(v)
-        nbytes = arr.nbytes
-        bad = (not np.all(np.isfinite(arr))
-               if np.issubdtype(arr.dtype, np.floating) else False)
-        rows.append((name, tuple(arr.shape), str(arr.dtype), nbytes, bad))
+        st = tensor_stats(arr, name)
+        rows.append((name, st.shape, str(arr.dtype), arr.nbytes,
+                     not st.finite, st))
     rows.sort(key=lambda r: -r[3])
     return rows[:top]

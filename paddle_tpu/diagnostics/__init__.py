@@ -1,0 +1,66 @@
+"""paddle_tpu.diagnostics — the training-numerics doctor (tpudoctor).
+
+Three pillars on top of PR 2's telemetry plumbing:
+
+  numerics / bisect   NaN/Inf culprit localization: when a finite
+                      check trips (Executor.run(check_nan_inf=True) or
+                      PADDLE_TPU_CHECK_NAN_INF=1), the traced program
+                      is re-executed as op-prefix slices under a
+                      binary search and the failure is pinned to one
+                      op, raising NanInfError with a NumericsReport
+                      (op type, block/op index, tensor stats, feed
+                      fingerprint, fix hint).
+  health              opt-in in-graph vitals appended at
+                      optimizer.minimize(..., health=True) time:
+                      global grad norm, param norm, update ratio —
+                      plus rolling-window divergence heuristics.
+  recorder            a crash flight recorder: per-step ring buffer
+                      dumped as a JSON post-mortem on NaN, uncaught
+                      exception, or exit; PADDLE_TPU_FLIGHT_RECORDER
+                      gates it, tools/tpudoctor.py prints it.
+
+Everything is off by default: with no env flags and no explicit
+opt-in, Executor.run issues zero extra fetches, device work, or host
+readbacks (pinned by tests/test_bench_contract.py).
+"""
+from .numerics import (TensorStats, tensor_stats, NumericsReport,
+                       NanInfError, feed_fingerprint, fix_hint)
+from .bisect import localize
+from .health import HealthMonitor
+from . import recorder
+from .recorder import FlightRecorder
+
+__all__ = ["TensorStats", "tensor_stats", "NumericsReport",
+           "NanInfError", "feed_fingerprint", "fix_hint", "localize",
+           "HealthMonitor", "FlightRecorder", "recorder",
+           "check_nan_inf_requested", "status"]
+
+import os as _os
+
+_FALSY = ("", "0", "false", "off", "no")
+
+
+def check_nan_inf_requested():
+    """The PADDLE_TPU_CHECK_NAN_INF env gate; "all" additionally
+    checks updated persistable state (params/optimizer accumulators),
+    any other truthy value checks fetches + updated state too (the
+    cheap fetches-only mode is spelled "fetches")."""
+    val = (_os.environ.get("PADDLE_TPU_CHECK_NAN_INF") or "").strip()
+    return val.lower() not in _FALSY
+
+
+def check_mode():
+    """"all" (fetches + updated persistables, the default) or
+    "fetches"."""
+    val = (_os.environ.get("PADDLE_TPU_CHECK_NAN_INF") or "").strip()
+    return "fetches" if val.lower() == "fetches" else "all"
+
+
+def status():
+    """One-line status dict for CLIs (tpustat header, tpudoctor)."""
+    return {
+        "nan_check": check_nan_inf_requested(),
+        "flight_recorder": recorder.enabled(),
+        "flight_recorder_dir":
+            recorder.active().out_dir if recorder.enabled() else None,
+    }

@@ -1,0 +1,309 @@
+"""NaN/Inf culprit localization by re-execution under bisection.
+
+The jitted step is one opaque XLA module; when its outputs go
+non-finite, this module re-executes the SAME traced op list eagerly
+(core.trace.exec_op, same pruning, same per-op PRNG folds, same
+pre-step state) as op-prefix slices under a binary search, and returns
+a NumericsReport naming the first op whose outputs go non-finite.
+
+Three phases, mirroring where non-finiteness can originate:
+
+  forward   P(k) = "any output of ops[:k] is non-finite" is monotone in
+            k (env only grows, values never change), so a binary search
+            over prefix length finds the exact first bad op.
+  backward  forward values are finite but a gradient is not. S(j) =
+            "grads of the loss w.r.t. the free inputs of the op suffix
+            ops[j:] contain a non-finite value" is monotone in j under
+            the standard propagation assumption (a bad gradient does
+            not cancel to a finite one upstream); the boundary where
+            S flips is the op whose BACKWARD first emits non-finite
+            gradients from finite inputs.
+  update    forward and gradients are finite: the optimizer tail is
+            short, so it is replayed op-by-op (exact attribution; the
+            stacked-adam fusion in trace.py is arithmetic-identical to
+            this per-op replay modulo ~1 ULP).
+
+Determinism caveat: re-execution folds the same (seed, step, op index)
+PRNG keys the compiled step used, so dropout streams match; backends
+whose RNG is not bit-stable across jit/eager can in principle fail to
+reproduce, in which case localize() returns None and the caller falls
+back to an unlocalized report.
+"""
+import logging
+
+import numpy as np
+
+from ..core.trace import (exec_op, _prune_ops, _find_backward,
+                          _collect_sparse_deltas)
+from ..core.framework import grad_var_name
+from .numerics import (NumericsReport, tensor_stats, feed_fingerprint)
+
+__all__ = ["localize"]
+
+_LOG = logging.getLogger("paddle_tpu.diagnostics")
+
+
+def _nonfinite(v):
+    import jax.numpy as jnp
+    dt = getattr(v, "dtype", None)
+    if dt is None or not (jnp.issubdtype(dt, jnp.floating)
+                          or jnp.issubdtype(dt, jnp.complexfloating)):
+        return False
+    return not bool(jnp.all(jnp.isfinite(v)))
+
+
+def _float_names(names, env):
+    import jax.numpy as jnp
+    out = []
+    for n in names:
+        v = env.get(n)
+        if v is not None and jnp.issubdtype(
+                getattr(v, "dtype", np.dtype("O")), jnp.floating):
+            out.append(n)
+    return out
+
+
+def _op_stats(op, env, which="inputs"):
+    stats = []
+    slots = op.inputs if which == "inputs" else op.outputs
+    for slot, names in slots.items():
+        for n in names:
+            if n in env:
+                stats.append(tensor_stats(env[n], f"{slot}:{n}"))
+    return stats
+
+
+class _Session:
+    """One localization run: the frozen op list + base env + PRNG key,
+    with prefix execution as the shared primitive."""
+
+    def __init__(self, program, feed, persist, fetch_names, is_test,
+                 place, seed, step):
+        import jax
+        import jax.numpy as jnp
+        self.program = program
+        self.block = program.global_block()
+        all_ops = list(self.block.ops)
+        self.orig_idx = {id(op): i for i, op in enumerate(all_ops)}
+        self.ops = _prune_ops(program, all_ops, fetch_names)
+        self.bi = _find_backward(self.ops)
+        self.is_test = is_test
+        self.place = place
+        # mirror Executor.run's stepped(): key folded from (seed, step)
+        self.base_key = jax.random.fold_in(
+            jax.random.PRNGKey(seed), jnp.uint32(step))
+        env = {}
+        env.update(feed)
+        env.update(persist)
+        for dname, wname in _collect_sparse_deltas(program, self.ops):
+            if wname in env:
+                env[dname] = jnp.zeros((), env[wname].dtype)
+        self.env0 = env
+        self.meta = dict(feed_fingerprint=feed_fingerprint(feed),
+                         step=step, program_version=program._version,
+                         seed=seed)
+
+    def report(self, phase, op=None, pruned_idx=None, **kw):
+        kw.setdefault("op_type", op.type if op is not None else None)
+        kw.setdefault("op_idx", self.orig_idx.get(id(op))
+                      if op is not None else None)
+        return NumericsReport(phase, pruned_idx=pruned_idx,
+                              block_idx=self.block.idx, **self.meta,
+                              **kw)
+
+    def run_prefix(self, k, env=None):
+        """env after executing ops[:k] (or extend a given env from its
+        recorded length — callers pass disjoint ranges)."""
+        env = dict(self.env0) if env is None else env
+        start = env.pop("__len__", 0)
+        for i in range(start, k):
+            exec_op(env, self.ops[i], i, self.base_key, self.is_test,
+                    self.place, self.block)
+        env["__len__"] = k
+        return env
+
+    def bad_outputs(self, env, lo, hi):
+        """Names of non-finite outputs of ops[lo:hi] present in env."""
+        bad = []
+        for i in range(lo, hi):
+            for n in self.ops[i].output_names():
+                if n in env and _nonfinite(env[n]):
+                    bad.append(n)
+        return bad
+
+    # ------------------------------------------------- forward phase
+    def forward_culprit(self, n_fwd):
+        """Binary search the smallest prefix with a non-finite output;
+        returns a report or None when the whole forward is clean."""
+        env_full = self.run_prefix(n_fwd)
+        if not self.bad_outputs(env_full, 0, n_fwd):
+            return None
+        lo, hi = 0, n_fwd        # P(lo)=False (inputs checked), P(hi)=True
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            env = self.run_prefix(mid)
+            # outputs of ops[:lo] are known clean — check only (lo, mid]
+            if self.bad_outputs(env, lo, mid):
+                hi = mid
+            else:
+                lo = mid
+        c = hi - 1
+        op = self.ops[c]
+        env_before = self.run_prefix(c)
+        env_after = self.run_prefix(c + 1, env=dict(env_before,
+                                                    __len__=c))
+        bad = self.bad_outputs(env_after, c, c + 1)
+        return self.report(
+            "forward", op, pruned_idx=c,
+            input_stats=_op_stats(op, env_before, "inputs"),
+            output_stats=_op_stats(op, env_after, "outputs"),
+            nonfinite_vars=bad,
+            detail=f"first non-finite output after executing "
+                   f"{c + 1}/{len(self.ops)} traced ops")
+
+    # ------------------------------------------------ backward phase
+    def _suffix_free_inputs(self, j, env):
+        """Float vars the suffix ops[j:bi] reads but does not produce —
+        the differentiation cut for S(j)."""
+        produced = set()
+        free = []
+        seen = set()
+        for op in self.ops[j:self.bi]:
+            for n in op.input_names():
+                if n not in produced and n not in seen:
+                    seen.add(n)
+                    free.append(n)
+            produced.update(op.output_names())
+        return _float_names(free, env)
+
+    def _suffix_grads(self, j, loss_name):
+        """Grads of the loss w.r.t. the free inputs of ops[j:bi]
+        (None, {}) when the cut has nothing to differentiate."""
+        import jax
+        import jax.numpy as jnp
+        env_j = self.run_prefix(j)
+        names = self._suffix_free_inputs(j, env_j)
+        if not names:
+            return None, {}
+
+        def f(vals):
+            e = {k: v for k, v in env_j.items() if k != "__len__"}
+            e.update(zip(names, vals))
+            for i in range(j, self.bi):
+                exec_op(e, self.ops[i], i, self.base_key, self.is_test,
+                        self.place, self.block)
+            return jnp.sum(e[loss_name].astype(jnp.float32))
+
+        grads = jax.grad(f)([env_j[n] for n in names])
+        return dict(zip(names, grads)), env_j
+
+    def backward_culprit(self):
+        """Param grads are non-finite: binary search the op suffix whose
+        backward first emits them. Returns a report (never None — at
+        minimum it blames the whole backward section)."""
+        loss_name = self.ops[self.bi].attrs["loss_name"]
+        lo, hi = 0, self.bi       # S(0)=True (full grads known bad)
+        lo_grads, lo_env = self._suffix_grads(0, loss_name)
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            grads, env = self._suffix_grads(mid, loss_name)
+            if grads is not None and any(_nonfinite(g)
+                                         for g in grads.values()):
+                lo, lo_grads, lo_env = mid, grads, env
+            else:
+                hi = mid
+        op = self.ops[lo]
+        bad = [n for n, g in (lo_grads or {}).items() if _nonfinite(g)]
+        grad_stats = [tensor_stats(lo_grads[n], f"d(loss)/d({n})")
+                      for n in bad]
+        env_in = self.run_prefix(lo)
+        return self.report(
+            "backward", op, pruned_idx=lo,
+            input_stats=_op_stats(op, env_in, "inputs"),
+            output_stats=grad_stats,
+            nonfinite_vars=[f"{n}@GRAD" for n in bad],
+            detail="forward values are finite; the gradient first "
+                   "goes non-finite in this op's backward "
+                   "(non-finite grads w.r.t. its inputs, finite grads "
+                   "w.r.t. its outputs)")
+
+    def full_grads(self):
+        """(grads, env_after_forward) exactly as trace.build_step_fn
+        computes them: value_and_grad over the param diff set."""
+        import jax
+        import jax.numpy as jnp
+        bop = self.ops[self.bi]
+        pnames = bop.attrs["param_names"]
+        loss_name = bop.attrs["loss_name"]
+        base_env = {k: v for k, v in self.env0.items()}
+
+        def fwd(pvals):
+            e = dict(base_env)
+            e.update(pvals)
+            for i in range(self.bi):
+                exec_op(e, self.ops[i], i, self.base_key, self.is_test,
+                        self.place, self.block)
+            return jnp.sum(e[loss_name].astype(jnp.float32)), e
+
+        pvals = {n: self.env0[n] for n in pnames
+                 if n in self.env0}
+        (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(pvals)
+        return grads, env
+
+    # -------------------------------------------------- update phase
+    def update_culprit(self, grads, env):
+        """Replay the optimizer tail per-op; first bad output wins."""
+        env = dict(env)
+        for n, g in grads.items():
+            env[grad_var_name(n)] = g.astype(env[n].dtype) \
+                if hasattr(g, "astype") else g
+        for i in range(self.bi + 1, len(self.ops)):
+            op = self.ops[i]
+            env_before = dict(env)
+            exec_op(env, op, i, self.base_key, self.is_test,
+                    self.place, self.block)
+            bad = self.bad_outputs(env, i, i + 1)
+            if bad:
+                return self.report(
+                    "update", op, pruned_idx=i,
+                    input_stats=_op_stats(op, env_before, "inputs"),
+                    output_stats=_op_stats(op, env, "outputs"),
+                    nonfinite_vars=bad,
+                    detail="forward and gradients are finite; this "
+                           "optimizer-tail op produced the first "
+                           "non-finite state")
+        return None
+
+
+def localize(program, feed, persist, fetch_names, is_test=False,
+             place=None, seed=0, step=0):
+    """Find the first op of `program` whose execution goes non-finite
+    when re-run against the given pre-step state.
+
+    feed/persist: {name: array} as of BEFORE the failing step (the
+    executor snapshots donated persistables when check mode is on).
+    Returns a NumericsReport, or None when re-execution stays finite
+    (e.g. the failure was not reproducible).
+    """
+    from .. import telemetry as _tm
+    with _tm.span("diagnostics.localize"):
+        s = _Session(program, feed, persist, fetch_names, is_test,
+                     place, seed, step)
+        # phase 0: state that was bad before any op ran
+        bad_in = [k for k, v in s.env0.items() if _nonfinite(v)]
+        if bad_in:
+            return s.report(
+                "input", None,
+                input_stats=[tensor_stats(s.env0[k], k)
+                             for k in bad_in[:16]],
+                nonfinite_vars=bad_in,
+                detail="feeds/persistable state were non-finite "
+                       "before the step executed a single op")
+        n_fwd = s.bi if s.bi is not None else len(s.ops)
+        rep = s.forward_culprit(n_fwd)
+        if rep is not None or s.bi is None:
+            return rep
+        grads, env = s.full_grads()
+        if any(_nonfinite(g) for g in grads.values()):
+            return s.backward_culprit()
+        return s.update_culprit(grads, env)

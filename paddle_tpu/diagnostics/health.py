@@ -1,0 +1,226 @@
+"""Training-health monitor: in-graph vitals + rolling divergence checks.
+
+The reference exposes training health only through what the user
+fetches; production TF experience (Abadi et al.) is that grad-norm /
+update-ratio style vitals plus cheap divergence heuristics catch most
+runs that are ABOUT to NaN long before they do. Here the vitals are
+appended as ordinary ops at `optimizer.minimize(..., health=True)`
+time, so they ride the same compiled step:
+
+  health_grad_norm    sqrt(sum_p ||grad_p||^2)   (pre-clip, fp32)
+  health_param_norm   sqrt(sum_p ||p||^2)        (pre-update values)
+  health_update_ratio lr * grad_norm / (param_norm + eps) — the
+                      classic "how big is this step relative to the
+                      weights" vital (exact for SGD, a proxy for
+                      adaptive optimizers)
+
+Cost model: the vars are NOT persistable, so trace._prune_ops drops
+every health op from any step that does not fetch them — a run that
+never fetches monitor.fetch_list compiles the identical module it
+would have without the monitor (pinned by tests/test_diagnostics.py).
+
+observe() feeds the fetched values into rolling windows, exports
+telemetry gauges when telemetry is on, and fires warnings (loss spike,
+exploding/vanishing gradients) through logging + the telemetry
+registry + the flight recorder.
+"""
+import collections
+import logging
+import math
+
+import numpy as np
+
+from .. import unique_name
+
+__all__ = ["HealthMonitor"]
+
+_LOG = logging.getLogger("paddle_tpu.diagnostics")
+
+
+def _scalar(v):
+    return float(np.asarray(v).ravel()[0])
+
+
+class HealthMonitor:
+    """Built by `Optimizer.minimize(loss, health=True)` (then available
+    as `optimizer.health_monitor`) or attached manually via
+    HealthMonitor.attach(loss, params_grads)."""
+
+    def __init__(self, loss_var, grad_norm_var, param_norm_var,
+                 window=20, loss_spike_factor=4.0,
+                 grad_explode_threshold=1e3, grad_explode_factor=10.0,
+                 grad_vanish_threshold=1e-8):
+        self.loss_var = loss_var
+        self.grad_norm_var = grad_norm_var
+        self.param_norm_var = param_norm_var
+        self.update_ratio_var = None        # set once the LR var exists
+        self.window = window
+        self.loss_spike_factor = loss_spike_factor
+        self.grad_explode_threshold = grad_explode_threshold
+        self.grad_explode_factor = grad_explode_factor
+        self.grad_vanish_threshold = grad_vanish_threshold
+        self._losses = collections.deque(maxlen=window)
+        self._gnorms = collections.deque(maxlen=window)
+        self.steps_observed = 0
+        self.warnings = []                  # [{kind, message, step}]
+
+    # ------------------------------------------------- graph building
+    @staticmethod
+    def _norm_over(block, vars_, tag):
+        """Append sqrt(sum_i ||v_i||^2) ops; returns the scalar var."""
+        sq_vars = []
+        for v in vars_:
+            sq = block.create_var(
+                name=unique_name.generate(f"health_{tag}_sq"),
+                shape=[1], dtype="float32", stop_gradient=True)
+            block.append_op("squared_l2_norm", {"X": [v]},
+                            {"Out": [sq]}, {})
+            sq_vars.append(sq)
+        total = block.create_var(
+            name=unique_name.generate(f"health_{tag}_sumsq"),
+            shape=[1], dtype="float32", stop_gradient=True)
+        block.append_op("sum", {"X": sq_vars}, {"Out": [total]}, {})
+        norm = block.create_var(
+            name=unique_name.generate(f"health_{tag}_norm"),
+            shape=[1], dtype="float32", stop_gradient=True)
+        block.append_op("sqrt", {"X": [total]}, {"Out": [norm]}, {})
+        return norm
+
+    @classmethod
+    def attach(cls, loss, params_grads, **options):
+        """Append the vitals ops for `params_grads` (call AFTER
+        append_backward, BEFORE the update ops are appended, so the
+        param norm reads pre-update values)."""
+        if not params_grads:
+            raise ValueError("health monitor needs at least one "
+                             "(param, grad) pair")
+        block = params_grads[0][0].block.program.global_block()
+        grad_norm = cls._norm_over(
+            block, [g for _, g in params_grads], "grad")
+        param_norm = cls._norm_over(
+            block, [p for p, _ in params_grads], "param")
+        return cls(loss, grad_norm, param_norm, **options)
+
+    def _append_update_ratio(self, lr_var):
+        """lr * grad_norm / (param_norm + eps); called by minimize()
+        once apply_gradients has created the LR var."""
+        if lr_var is None or self.update_ratio_var is not None:
+            return
+        block = self.grad_norm_var.block
+        num = block.create_var(
+            name=unique_name.generate("health_upd_num"),
+            shape=[1], dtype="float32", stop_gradient=True)
+        block.append_op("elementwise_mul",
+                        {"X": [self.grad_norm_var], "Y": [lr_var]},
+                        {"Out": [num]}, {"axis": -1})
+        den = block.create_var(
+            name=unique_name.generate("health_upd_den"),
+            shape=[1], dtype="float32", stop_gradient=True)
+        block.append_op("scale", {"X": [self.param_norm_var]},
+                        {"Out": [den]}, {"scale": 1.0, "bias": 1e-12})
+        ratio = block.create_var(
+            name=unique_name.generate("health_update_ratio"),
+            shape=[1], dtype="float32", stop_gradient=True)
+        block.append_op("elementwise_div", {"X": [num], "Y": [den]},
+                        {"Out": [ratio]}, {"axis": -1})
+        self.update_ratio_var = ratio
+
+    # ------------------------------------------------------ observing
+    @property
+    def fetch_list(self):
+        """Auxiliary fetches to append to Executor.run's fetch_list
+        (the loss itself is usually already fetched)."""
+        out = [self.grad_norm_var, self.param_norm_var]
+        if self.update_ratio_var is not None:
+            out.append(self.update_ratio_var)
+        return out
+
+    def observe_fetches(self, values, loss=None):
+        """`values` = the run() results for self.fetch_list (same
+        order); returns the warnings fired for this step."""
+        values = list(values)
+        grad_norm = _scalar(values[0])
+        param_norm = _scalar(values[1]) if len(values) > 1 else None
+        ratio = _scalar(values[2]) if len(values) > 2 else None
+        return self.observe(loss=loss, grad_norm=grad_norm,
+                            param_norm=param_norm, update_ratio=ratio)
+
+    def _warn(self, kind, message):
+        from .. import telemetry as _tm
+        rec = {"kind": kind, "message": message,
+               "step": self.steps_observed}
+        self.warnings.append(rec)
+        _LOG.warning("health: %s (step %d): %s", kind,
+                     self.steps_observed, message)
+        if _tm.enabled():
+            _tm.counter("health.warnings").inc()
+            _tm.counter(f"health.warning.{kind}").inc()
+        from . import recorder as _rec
+        r = _rec.active()
+        if r is not None:
+            r.event("health_warning", **rec)
+        return rec
+
+    def observe(self, loss=None, grad_norm=None, param_norm=None,
+                update_ratio=None):
+        """Feed one step's vitals; returns warnings fired this step."""
+        from .. import telemetry as _tm
+        self.steps_observed += 1
+        fired = []
+        if _tm.enabled():
+            if loss is not None:
+                _tm.gauge("health.loss").set(float(loss))
+            if grad_norm is not None:
+                _tm.gauge("health.grad_norm").set(float(grad_norm))
+            if update_ratio is not None:
+                _tm.gauge("health.update_ratio").set(
+                    float(update_ratio))
+        from . import recorder as _rec
+        r = _rec.active()
+        if r is not None:
+            r.annotate(**{k: v for k, v in
+                          dict(loss=loss, grad_norm=grad_norm,
+                               update_ratio=update_ratio).items()
+                          if v is not None})
+
+        if loss is not None:
+            loss = float(loss)
+            if not math.isfinite(loss):
+                fired.append(self._warn(
+                    "nonfinite_loss", f"loss is {loss}"))
+            elif len(self._losses) >= 5:
+                med = sorted(self._losses)[len(self._losses) // 2]
+                if abs(loss) > self.loss_spike_factor * max(
+                        abs(med), 1e-12):
+                    fired.append(self._warn(
+                        "loss_spike",
+                        f"loss {loss:.4g} is >{self.loss_spike_factor}"
+                        f"x the rolling median {med:.4g}"))
+            self._losses.append(loss)
+        if grad_norm is not None:
+            grad_norm = float(grad_norm)
+            if not math.isfinite(grad_norm) \
+                    or grad_norm > self.grad_explode_threshold:
+                fired.append(self._warn(
+                    "exploding_gradients",
+                    f"global grad norm {grad_norm:.4g} exceeds "
+                    f"{self.grad_explode_threshold:.4g}"))
+            elif len(self._gnorms) >= 5:
+                med = sorted(self._gnorms)[len(self._gnorms) // 2]
+                if grad_norm > self.grad_explode_factor * max(med,
+                                                              1e-30):
+                    fired.append(self._warn(
+                        "exploding_gradients",
+                        f"global grad norm {grad_norm:.4g} is "
+                        f">{self.grad_explode_factor}x the rolling "
+                        f"median {med:.4g}"))
+            self._gnorms.append(grad_norm)
+            if len(self._gnorms) == self.window and all(
+                    g < self.grad_vanish_threshold
+                    for g in self._gnorms):
+                fired.append(self._warn(
+                    "vanishing_gradients",
+                    f"global grad norm < "
+                    f"{self.grad_vanish_threshold:g} for "
+                    f"{self.window} consecutive steps"))
+        return fired

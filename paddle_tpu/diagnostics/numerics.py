@@ -1,0 +1,267 @@
+"""Numerics primitives for the training doctor (tpudoctor).
+
+The reference runtime's `FLAGS_check_nan_inf` aborts inside the exact
+kernel that produced a NaN (paddle/fluid/framework/operator.cc:
+CheckNanInf); our whole-program XLA compile erases that per-op boundary,
+so the doctor reconstructs it after the fact: tensor statistics,
+a structured `NumericsReport` naming the culprit op, and `NanInfError`
+carrying the report for programmatic consumers (CI gates, the flight
+recorder, tools/tpudoctor.py).
+"""
+import hashlib
+import json
+
+import numpy as np
+
+__all__ = ["TensorStats", "tensor_stats", "NumericsReport",
+           "NanInfError", "feed_fingerprint", "fix_hint",
+           "nonfinite_count"]
+
+
+def nonfinite_count(arr):
+    """(nan_count, inf_count) for a host array; (0, 0) for non-floats."""
+    arr = np.asarray(arr)
+    if arr.dtype.kind not in "fc":
+        return 0, 0
+    return int(np.isnan(arr).sum()), int(np.isinf(arr).sum())
+
+
+class TensorStats:
+    """Summary statistics of one tensor (the per-op record the reference
+    prints from CheckNanInf, plus the counts it lacks)."""
+
+    __slots__ = ("name", "shape", "dtype", "min", "max", "absmax",
+                 "mean", "nan_count", "inf_count", "size")
+
+    def __init__(self, name, shape, dtype, min, max, absmax, mean,
+                 nan_count, inf_count, size):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+        self.min, self.max = min, max
+        self.absmax, self.mean = absmax, mean
+        self.nan_count, self.inf_count = nan_count, inf_count
+        self.size = size
+
+    @property
+    def finite(self):
+        return self.nan_count == 0 and self.inf_count == 0
+
+    def to_dict(self):
+        return {s: getattr(self, s) for s in self.__slots__}
+
+    def __str__(self):
+        s = (f"{self.name}: {self.dtype}{list(self.shape)} "
+             f"min={self.min:.4g} max={self.max:.4g} "
+             f"absmax={self.absmax:.4g} mean={self.mean:.4g}")
+        if not self.finite:
+            s += f"  ** nan={self.nan_count} inf={self.inf_count} **"
+        return s
+
+
+def tensor_stats(arr, name=""):
+    """Host-side TensorStats of `arr` (device arrays are read back —
+    this only runs on the diagnosis path, never in the hot loop)."""
+    arr = np.asarray(arr)
+    if arr.dtype.kind not in "biufc":      # bfloat16 etc.: view-cast up
+        arr = arr.astype(np.float32)
+    size = int(arr.size)
+    if size == 0:
+        return TensorStats(name, arr.shape, arr.dtype, 0.0, 0.0, 0.0,
+                           0.0, 0, 0, 0)
+    with np.errstate(all="ignore"):     # stats OF an overflow must not warn
+        if arr.dtype.kind in "fc":
+            nan_c, inf_c = nonfinite_count(arr)
+            finite = arr[np.isfinite(arr)]
+            if finite.size:
+                mn, mx = float(finite.min()), float(finite.max())
+                absmax = float(np.abs(finite).max())
+                mean = float(finite.astype(np.float64).mean())
+            else:
+                mn = mx = absmax = mean = float("nan")
+        else:
+            nan_c = inf_c = 0
+            mn, mx = float(arr.min()), float(arr.max())
+            absmax = float(np.abs(arr).max())
+            mean = float(arr.mean())
+    return TensorStats(name, arr.shape, arr.dtype, mn, mx, absmax,
+                       mean, nan_c, inf_c, size)
+
+
+def feed_fingerprint(feed):
+    """Stable digest of a feed dict: names, shapes, dtypes, and a
+    content hash — lets a NumericsReport say "THIS batch diverged" so
+    the failing input can be replayed from a data log."""
+    h = hashlib.sha256()
+    for k in sorted(feed):
+        arr = np.asarray(feed[k])
+        h.update(k.encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        if arr.dtype.kind not in "biufc":
+            arr = arr.astype(np.float32)
+        h.update(np.ascontiguousarray(arr).tobytes()[:1 << 16])
+    return h.hexdigest()[:16]
+
+
+# proglint-style fix hints by culprit op type; backward/update phases
+# get phase-level fallbacks. Keyed on substrings so e.g.
+# softmax_with_cross_entropy and cross_entropy both match.
+_HINTS = (
+    (("cross_entropy", "log"),
+     "log of a zero/negative probability — clip inputs away from 0 "
+     "(layers.clip) or use softmax_with_cross_entropy, whose fused "
+     "form is stable"),
+    (("softmax", "exp"),
+     "exp overflow — inputs too large; normalize/scale activations or "
+     "subtract the row max before exp"),
+    (("sqrt", "rsqrt"),
+     "sqrt/rsqrt at <= 0 — add an epsilon inside the sqrt (its "
+     "gradient at 0 is infinite even when the forward value is fine)"),
+    (("elementwise_div", "div", "mean_grad"),
+     "division by zero — add an epsilon to the denominator"),
+    (("pow",),
+     "pow with a negative base or huge exponent — clip the base or "
+     "lower the exponent"),
+    (("adam", "sgd", "momentum", "rmsprop", "adagrad", "lamb", "ftrl",
+      "adadelta", "adamax"),
+     "optimizer update went non-finite — lower the learning rate or "
+     "add clip.GradientClipByGlobalNorm before minimize()"),
+    (("batch_norm", "layer_norm"),
+     "normalization variance collapsed — check for constant inputs or "
+     "raise the epsilon attr"),
+    (("matmul", "mul", "conv"),
+     "overflow in a matmul/conv — activations or weights too large; "
+     "consider loss scaling (amp) or weight-decay/clipping"),
+)
+
+_PHASE_HINTS = {
+    "backward": "gradient explosion — add gradient clipping "
+                "(clip.GradientClipByGlobalNorm) or lower the "
+                "learning rate",
+    "update": "optimizer state went non-finite — lower the learning "
+              "rate, or reset stale accumulators from a checkpoint",
+    "input": "a feed or persistable var was already non-finite BEFORE "
+             "the step — check the data pipeline, initializers, or "
+             "the previous step's update",
+}
+
+
+def fix_hint(op_type, phase="forward"):
+    """One-line remediation suggestion (same contract as
+    analysis.Diagnostic.hint)."""
+    for keys, hint in _HINTS:
+        if any(k in (op_type or "") for k in keys):
+            return hint
+    return _PHASE_HINTS.get(
+        phase, "inspect the input stats above; if inputs are finite "
+               "the op's own math overflowed — consider fp32 for this "
+               "op or rescaling")
+
+
+class NumericsReport:
+    """Structured culprit record produced by diagnostics.bisect.
+
+    phase: "forward" (op output went non-finite), "backward" (the op's
+    GRADIENT went non-finite while its forward output was fine),
+    "update" (an optimizer-tail op corrupted state), or "input"
+    (feeds/persistables were already bad before the step ran).
+    """
+
+    def __init__(self, phase, op_type=None, block_idx=0, op_idx=None,
+                 pruned_idx=None, input_stats=(), output_stats=(),
+                 nonfinite_vars=(), feed_fingerprint="", step=None,
+                 program_version=None, seed=None, hint=None,
+                 detail=""):
+        self.phase = phase
+        self.op_type = op_type
+        self.block_idx = block_idx
+        self.op_idx = op_idx          # index in the ORIGINAL block
+        self.pruned_idx = pruned_idx  # index in the executed (pruned) list
+        self.input_stats = list(input_stats)
+        self.output_stats = list(output_stats)
+        self.nonfinite_vars = list(nonfinite_vars)
+        self.feed_fingerprint = feed_fingerprint
+        self.step = step
+        self.program_version = program_version
+        self.seed = seed
+        self.hint = hint if hint is not None else fix_hint(op_type, phase)
+        self.detail = detail
+
+    def location(self):
+        if self.op_idx is None:
+            return "(no single op)"
+        return (f"block {self.block_idx}, op {self.op_idx} "
+                f"({self.op_type})")
+
+    def to_dict(self):
+        return {
+            "phase": self.phase, "op_type": self.op_type,
+            "block_idx": self.block_idx, "op_idx": self.op_idx,
+            "pruned_idx": self.pruned_idx,
+            "input_stats": [s.to_dict() for s in self.input_stats],
+            "output_stats": [s.to_dict() for s in self.output_stats],
+            "nonfinite_vars": self.nonfinite_vars,
+            "feed_fingerprint": self.feed_fingerprint,
+            "step": self.step, "program_version": self.program_version,
+            "seed": self.seed, "hint": self.hint, "detail": self.detail,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        rep = cls(d["phase"], d.get("op_type"), d.get("block_idx", 0),
+                  d.get("op_idx"), d.get("pruned_idx"),
+                  nonfinite_vars=d.get("nonfinite_vars", ()),
+                  feed_fingerprint=d.get("feed_fingerprint", ""),
+                  step=d.get("step"),
+                  program_version=d.get("program_version"),
+                  seed=d.get("seed"), hint=d.get("hint"),
+                  detail=d.get("detail", ""))
+        rep.input_stats = [TensorStats(**s)
+                           for s in d.get("input_stats", ())]
+        rep.output_stats = [TensorStats(**s)
+                            for s in d.get("output_stats", ())]
+        return rep
+
+    def to_json(self):
+        return json.dumps(self.to_dict(), default=str)
+
+    def format(self):
+        lines = [f"NumericsReport [{self.phase}] @ {self.location()}"]
+        if self.step is not None:
+            lines.append(f"  step {self.step}, program version "
+                         f"{self.program_version}, seed {self.seed}")
+        if self.feed_fingerprint:
+            lines.append(f"  feed fingerprint {self.feed_fingerprint}")
+        if self.detail:
+            lines.append(f"  {self.detail}")
+        if self.nonfinite_vars:
+            lines.append("  non-finite vars: "
+                         + ", ".join(self.nonfinite_vars[:8]))
+        if self.input_stats:
+            lines.append("  inputs:")
+            lines += [f"    {s}" for s in self.input_stats]
+        if self.output_stats:
+            lines.append("  outputs:")
+            lines += [f"    {s}" for s in self.output_stats]
+        if self.hint:
+            lines.append(f"  hint: {self.hint}")
+        return "\n".join(lines)
+
+    __str__ = format
+
+    def __repr__(self):
+        return (f"<NumericsReport {self.phase} op={self.op_type!r} "
+                f"idx={self.op_idx}>")
+
+
+class NanInfError(FloatingPointError):
+    """The doctor's verdict: a FloatingPointError (so existing
+    `except FloatingPointError` callers keep working — the pre-doctor
+    Executor raised exactly that) carrying the localization report."""
+
+    def __init__(self, report, message=None):
+        self.report = report
+        super().__init__(
+            message if message is not None else
+            "NaN/Inf detected; culprit localized:\n" + report.format())

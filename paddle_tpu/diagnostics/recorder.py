@@ -1,0 +1,180 @@
+"""Crash flight recorder: a ring buffer of per-step records with a
+JSON post-mortem dump on failure.
+
+A diverging run used to leave nothing behind but a stack trace; with
+`PADDLE_TPU_FLIGHT_RECORDER=<dir>` (or `=1` for ./flight_recorder) the
+executor appends one small record per step (step index, loss when
+fetchable, step wall time, compile events, program fingerprint) into a
+fixed-size ring, and the last `capacity` records are dumped as JSON
+when:
+
+  - a NaN/Inf check trips (the executor dumps before raising
+    NanInfError, attaching the NumericsReport),
+  - an uncaught exception unwinds the process (sys.excepthook chain),
+  - the process exits with records still in the ring (atexit — the
+    black box always lands), or
+  - a fatal signal kills the interpreter (faulthandler writes the
+    C-level traceback to <dir>/flight_fault.log; the JSON ring from
+    the previous dump/exit remains alongside it).
+
+`tools/tpudoctor.py postmortem <dump.json>` pretty-prints a dump.
+Overhead when the env var is unset: one cached None check per step.
+"""
+import atexit
+import collections
+import json
+import os
+import sys
+import time
+import traceback
+
+__all__ = ["FlightRecorder", "active", "enable", "disable", "enabled"]
+
+DEFAULT_CAPACITY = 256
+_TRUTHY = ("1", "true", "on", "yes")
+_FALSY = ("", "0", "false", "off", "no")
+
+_RECORDER = None
+_RESOLVED = False
+
+
+class FlightRecorder:
+    def __init__(self, out_dir, capacity=DEFAULT_CAPACITY):
+        self.out_dir = out_dir
+        self.capacity = capacity
+        self.records = collections.deque(maxlen=capacity)
+        self.events = collections.deque(maxlen=64)
+        self.last_dump_path = None
+        self.dump_count = 0
+        self._start = time.time()
+        self._hooks_installed = False
+        self._fault_file = None
+
+    # -------------------------------------------------------- recording
+    def record(self, **fields):
+        """Append one per-step record (executor hot path — keep cheap)."""
+        fields.setdefault("t", round(time.time() - self._start, 4))
+        self.records.append(fields)
+
+    def annotate(self, **fields):
+        """Merge fields into the most recent record (health vitals)."""
+        if self.records:
+            self.records[-1].update(fields)
+
+    def event(self, kind, **fields):
+        """Out-of-band event (compile, health warning, ...)."""
+        e = dict(kind=kind, t=round(time.time() - self._start, 4))
+        e.update(fields)
+        self.events.append(e)
+
+    # ---------------------------------------------------------- dumping
+    def dump(self, path=None, reason="manual", report=None, error=None):
+        """Write the ring as a JSON post-mortem; returns the path."""
+        payload = {
+            "version": 1,
+            "reason": reason,
+            "time": time.time(),
+            "uptime_s": round(time.time() - self._start, 3),
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "capacity": self.capacity,
+            "records": list(self.records),
+            "events": list(self.events),
+        }
+        if report is not None:
+            payload["report"] = report.to_dict() \
+                if hasattr(report, "to_dict") else report
+        if error is not None:
+            payload["error"] = str(error)
+        if path is None:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir,
+                                f"flight_{os.getpid()}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=1, default=str)
+        except OSError:
+            return None     # a dying process must not die again here
+        self.last_dump_path = path
+        self.dump_count += 1
+        return path
+
+    # ------------------------------------------------------------ hooks
+    def install(self):
+        """atexit + excepthook chain + faulthandler (idempotent)."""
+        if self._hooks_installed:
+            return self
+        self._hooks_installed = True
+        atexit.register(_atexit_dump)
+        prev_hook = sys.excepthook
+
+        def hook(etype, value, tb):
+            r = active()
+            if r is not None:
+                r.dump(reason="uncaught_exception",
+                       error="".join(traceback.format_exception(
+                           etype, value, tb))[-4000:])
+            prev_hook(etype, value, tb)
+
+        sys.excepthook = hook
+        try:
+            import faulthandler
+            os.makedirs(self.out_dir, exist_ok=True)
+            self._fault_file = open(
+                os.path.join(self.out_dir, "flight_fault.log"), "w")
+            faulthandler.enable(file=self._fault_file)
+        except (OSError, ImportError, ValueError):
+            pass
+        return self
+
+
+def _atexit_dump():
+    r = _RECORDER
+    if r is not None and r.records and r.dump_count == 0:
+        r.dump(reason="atexit")
+
+
+def _env_dir():
+    val = (os.environ.get("PADDLE_TPU_FLIGHT_RECORDER") or "").strip()
+    if val.lower() in _FALSY:
+        return None
+    if val.lower() in _TRUTHY:
+        return os.path.join(os.getcwd(), "flight_recorder")
+    return val
+
+
+def active():
+    """The process flight recorder, or None when disabled. Resolves the
+    env gate once; `enable()`/`disable()` override it."""
+    global _RECORDER, _RESOLVED
+    if not _RESOLVED:
+        _RESOLVED = True
+        d = _env_dir()
+        if d is not None:
+            cap = int(os.environ.get(
+                "PADDLE_TPU_FLIGHT_RECORDER_STEPS",
+                str(DEFAULT_CAPACITY)))
+            _RECORDER = FlightRecorder(d, capacity=cap).install()
+    return _RECORDER
+
+
+def enabled():
+    return active() is not None
+
+
+def enable(out_dir=None, capacity=DEFAULT_CAPACITY, install_hooks=True):
+    """Programmatic enablement (tests / notebooks)."""
+    global _RECORDER, _RESOLVED
+    _RESOLVED = True
+    _RECORDER = FlightRecorder(
+        out_dir or os.path.join(os.getcwd(), "flight_recorder"),
+        capacity=capacity)
+    if install_hooks:
+        _RECORDER.install()
+    return _RECORDER
+
+
+def disable():
+    global _RECORDER, _RESOLVED
+    _RESOLVED = True
+    _RECORDER = None

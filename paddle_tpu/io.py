@@ -134,9 +134,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                 "fluid export: persistable vars have no value in the "
                 f"scope (run the startup program first?): {missing}")
         # combined-file order must equal the load side's walk of the
-        # program's persistable vars (load_combine_op semantics)
-        order = [v.name for v in pruned.persistable_vars()
-                 if v.name in arrays]
+        # program's persistable vars (load_combine_op semantics) — and
+        # that walk is SORTED BY NAME on both sides, the reference's
+        # save_vars/load_vars convention (io.py sorts the var list
+        # before save_combine). Declaration order is builder-dependent,
+        # so a combined file exchanged with real Fluid would otherwise
+        # bind tensors to the wrong variables.
+        order = sorted(v.name for v in pruned.persistable_vars()
+                       if v.name in arrays)
         fluid_proto.save_fluid_params(dirname, arrays,
                                       filename=params_filename,
                                       order=order)
@@ -185,9 +190,11 @@ def _load_fluid_inference_model(dirname, blob, params_filename):
     from .core import fluid_proto
     program, feed_names, fetch_names = fluid_proto.program_from_fluid(blob)
     program._is_test = True
-    # load_combine order = the program's persistable var order (the
-    # reference's load_vars iterates list_vars() the same way)
-    names = [v.name for v in program.persistable_vars()]
+    # load_combine order = persistable vars SORTED BY NAME (the
+    # reference's save_vars/load_vars convention — must mirror
+    # save_inference_model's fluid export exactly, or a combined
+    # stream binds tensors to the wrong variables)
+    names = sorted(v.name for v in program.persistable_vars())
     arrays = fluid_proto.load_fluid_params(dirname, names,
                                            filename=params_filename)
     scope = global_scope()

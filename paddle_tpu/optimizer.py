@@ -29,6 +29,7 @@ __all__ = [
 
 class Optimizer:
     op_type = None
+    health_monitor = None   # set by minimize(health=True)
 
     def __init__(self, learning_rate, regularization=None, name=None):
         self._lr = learning_rate
@@ -109,10 +110,26 @@ class Optimizer:
         return ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, health=False):
+        """`health=True` (or a dict of HealthMonitor options) appends
+        the training-vitals fetches (global grad norm, param norm,
+        update ratio) between the backward section and the update ops —
+        see diagnostics/health.py; the monitor lands on
+        `self.health_monitor`. Steps that don't fetch the vitals prune
+        them away, so the option costs nothing until observed."""
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
+        monitor = None
+        if health:
+            from .diagnostics.health import HealthMonitor
+            opts = dict(health) if isinstance(health, dict) else {}
+            # pre-update, pre-clip vitals: appended before the update
+            # ops so the param norm reads this step's pre-step weights
+            monitor = HealthMonitor.attach(loss, params_grads, **opts)
         opt_ops = self.apply_gradients(params_grads)
+        if monitor is not None:
+            monitor._append_update_ratio(self._lr_var)
+            self.health_monitor = monitor
         return opt_ops, params_grads
 
 

@@ -1,0 +1,356 @@
+"""paddle_tpu.diagnostics: NaN/Inf culprit bisection (forward,
+backward, update, and input phases), the training-health monitor's
+hand-checkable vitals + divergence heuristics, flight-recorder ring
+semantics and dump round-trip through tpudoctor's printer, and the
+disabled-mode zero-overhead contract."""
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import diagnostics as dg
+from paddle_tpu import telemetry as tm
+from paddle_tpu.diagnostics import (NanInfError, NumericsReport,
+                                    tensor_stats)
+from paddle_tpu.diagnostics import recorder as flight
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_diagnostics():
+    """No recorder, no telemetry, no env flags leaking between tests."""
+    flight.disable()
+    tm.disable()
+    tm.reset()
+    yield
+    flight.disable()
+    tm.disable()
+    tm.reset()
+
+
+def _first_op_idx(program, op_type):
+    return next(i for i, op in enumerate(program.global_block().ops)
+                if op.type == op_type)
+
+
+def _mlp_program():
+    """mnist-shaped MLP + Adam; returns (main, startup, loss, opt)."""
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        img = layers.data("img", shape=[8])
+        lbl = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, 16, act="relu")
+        pred = layers.fc(h, 4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=pred, label=lbl))
+        opt = pt.optimizer.Adam(1e-3)
+        opt.minimize(loss, health=True)
+    return main_p, startup_p, loss, opt
+
+
+def _feed(rng, n=4, fill=None):
+    img = np.full((n, 8), fill, "float32") if fill is not None \
+        else rng.rand(n, 8).astype("float32")
+    return {"img": img,
+            "label": rng.randint(0, 4, (n, 1)).astype("int64")}
+
+
+# ------------------------------------------------------------- numerics
+
+def test_tensor_stats_counts_and_bf16():
+    st = tensor_stats(np.array([1.0, -2.0, np.nan, np.inf, -np.inf],
+                               "float32"), "x")
+    assert (st.nan_count, st.inf_count) == (1, 2)
+    assert not st.finite
+    assert st.min == -2.0 and st.max == 1.0 and st.absmax == 2.0
+    import ml_dtypes
+    st2 = tensor_stats(np.array([1.0, np.nan], dtype=ml_dtypes.bfloat16))
+    assert st2.nan_count == 1 and not st2.finite
+    clean = tensor_stats(np.arange(4, dtype="float32"))
+    assert clean.finite and clean.mean == 1.5
+
+
+def test_report_roundtrip_and_hint():
+    rep = NumericsReport(
+        "forward", op_type="mul", op_idx=3, pruned_idx=2,
+        input_stats=[tensor_stats(np.ones(3, "float32"), "a")],
+        output_stats=[tensor_stats(np.array([np.inf]), "b")],
+        nonfinite_vars=["b"], feed_fingerprint="abcd", step=7,
+        program_version=9, seed=1)
+    back = NumericsReport.from_dict(
+        json.loads(json.dumps(rep.to_dict())))
+    assert back.op_type == "mul" and back.op_idx == 3
+    assert back.output_stats[0].inf_count == 1
+    assert "matmul" in back.hint
+    text = back.format()
+    assert "block 0, op 3 (mul)" in text and "abcd" in text
+    err = NanInfError(rep)
+    assert isinstance(err, FloatingPointError)
+    assert err.report is rep
+
+
+# ------------------------------------------------------------ bisection
+
+def test_forward_bisection_exact_op():
+    main_p, startup_p, loss, _ = _mlp_program()
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    exe.run(startup_p)
+    exe.run(main_p, feed=_feed(rng), fetch_list=[loss])   # healthy
+    with pytest.raises(NanInfError) as ei:
+        exe.run(main_p, feed=_feed(rng, fill=3e38),
+                fetch_list=[loss], check_nan_inf=True)
+    rep = ei.value.report
+    assert rep.phase == "forward"
+    assert rep.op_type == "mul"
+    assert rep.block_idx == 0
+    assert rep.op_idx == _first_op_idx(main_p, "mul")
+    assert rep.nonfinite_vars
+    assert any(not s.finite for s in rep.output_stats)
+    assert all(s.finite for s in rep.input_stats)
+    assert rep.feed_fingerprint and rep.hint
+    assert exe.last_numerics_report is rep
+
+
+def test_backward_bisection_exact_op():
+    """sqrt(fc(0)) = 0 is finite forward; d sqrt/dx at 0 is inf — the
+    doctor must blame the sqrt op's BACKWARD, not the forward."""
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        x = layers.data("x", shape=[8])
+        h = layers.fc(x, 4, bias_attr=False)
+        loss = layers.mean(layers.sqrt(h))
+        pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup_p)
+    with pytest.raises(NanInfError) as ei:
+        exe.run(main_p, feed={"x": np.zeros((4, 8), "float32")},
+                fetch_list=[loss], check_nan_inf=True)
+    rep = ei.value.report
+    assert rep.phase == "backward"
+    assert rep.op_type == "sqrt"
+    assert rep.op_idx == _first_op_idx(main_p, "sqrt")
+    assert any(n.endswith("@GRAD") for n in rep.nonfinite_vars)
+    assert "sqrt" in rep.hint
+
+
+def test_update_phase_localizes_optimizer_op():
+    """Finite forward + finite grads, but grad^2 overflows Adam's
+    second moment — the culprit is the update op itself."""
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        x = layers.data("x", shape=[4])
+        h = layers.fc(x, 2, bias_attr=False)
+        loss = layers.mean(h)
+        pt.optimizer.Adam(1e-3).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup_p)
+    with pytest.raises(NanInfError) as ei:
+        exe.run(main_p, feed={"x": np.full((2, 4), 1e20, "float32")},
+                fetch_list=[loss], check_nan_inf=True)
+    rep = ei.value.report
+    assert rep.phase == "update"
+    assert rep.op_type == "adam"
+    assert rep.op_idx == _first_op_idx(main_p, "adam")
+    assert "learning rate" in rep.hint
+
+
+def test_input_phase_names_poisoned_param():
+    main_p, startup_p, loss, _ = _mlp_program()
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    exe.run(startup_p)
+    scope = pt.global_scope()
+    wname = main_p.global_block().all_parameters()[0].name
+    w = np.array(scope.get(wname))
+    w[0, 0] = np.nan
+    scope.set(wname, w)
+    with pytest.raises(NanInfError) as ei:
+        exe.run(main_p, feed=_feed(rng), fetch_list=[loss],
+                check_nan_inf=True)
+    rep = ei.value.report
+    assert rep.phase == "input"
+    assert wname in rep.nonfinite_vars
+
+
+def test_env_flag_enables_check(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NAN_INF", "1")
+    main_p, startup_p, loss, _ = _mlp_program()
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    exe.run(startup_p)
+    with pytest.raises(NanInfError):
+        exe.run(main_p, feed=_feed(rng, fill=3e38), fetch_list=[loss])
+    assert exe.diag_snapshot_count > 0
+
+
+# --------------------------------------------------------------- health
+
+def test_health_fetches_match_hand_computed_norms():
+    """loss = mean(x @ W): dL/dW has a closed form; the in-graph
+    grad/param norms and update ratio must match numpy to fp32."""
+    B, D, C, lr = 4, 6, 3, 0.01
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        x = layers.data("x", shape=[D])
+        h = layers.fc(x, C, bias_attr=False)
+        loss = layers.mean(h)
+        opt = pt.optimizer.SGD(lr)
+        opt.minimize(loss, health=True)
+    mon = opt.health_monitor
+    assert mon is not None and mon.update_ratio_var is not None
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup_p)
+    scope = pt.global_scope()
+    wname = main_p.global_block().all_parameters()[0].name
+    W = np.array(scope.get(wname))              # pre-update weights
+    xv = np.random.RandomState(3).rand(B, D).astype("float32")
+    out = exe.run(main_p, feed={"x": xv},
+                  fetch_list=[loss] + mon.fetch_list)
+    grad_norm, param_norm, ratio = [float(np.ravel(v)[0])
+                                    for v in out[1:]]
+    G = xv.T @ np.ones((B, C), "float32") / (B * C)
+    assert grad_norm == pytest.approx(np.linalg.norm(G), rel=1e-5)
+    assert param_norm == pytest.approx(np.linalg.norm(W), rel=1e-5)
+    assert ratio == pytest.approx(lr * grad_norm / (param_norm + 1e-12),
+                                  rel=1e-5)
+    # and the weights were updated AFTER the vitals read them
+    W2 = np.array(scope.get(wname))
+    np.testing.assert_allclose(W2, W - lr * G, rtol=1e-5)
+
+
+def test_health_monitor_heuristics():
+    from paddle_tpu.diagnostics.health import HealthMonitor
+    mon = HealthMonitor(None, None, None, window=6,
+                        grad_explode_threshold=100.0,
+                        grad_vanish_threshold=1e-6)
+    for _ in range(6):
+        assert mon.observe(loss=1.0, grad_norm=1.0) == []
+    fired = mon.observe(loss=50.0, grad_norm=1.0)
+    assert [w["kind"] for w in fired] == ["loss_spike"]
+    fired = mon.observe(loss=1.0, grad_norm=500.0)
+    assert [w["kind"] for w in fired] == ["exploding_gradients"]
+    fired = mon.observe(loss=float("nan"), grad_norm=1.0)
+    assert [w["kind"] for w in fired] == ["nonfinite_loss"]
+    mon2 = HealthMonitor(None, None, None, window=4)
+    fired = []
+    for _ in range(4):
+        fired += mon2.observe(grad_norm=1e-12)
+    assert "vanishing_gradients" in [w["kind"] for w in fired]
+
+
+def test_health_gauges_reach_telemetry():
+    from paddle_tpu.diagnostics.health import HealthMonitor
+    tm.enable()
+    tm.reset()
+    mon = HealthMonitor(None, None, None, window=4,
+                        grad_explode_threshold=10.0)
+    mon.observe(loss=2.0, grad_norm=99.0, update_ratio=0.5)
+    snap = tm.snapshot()
+    assert snap["health.loss"] == 2.0
+    assert snap["health.grad_norm"] == 99.0
+    assert snap["health.update_ratio"] == 0.5
+    assert snap["health.warnings"] == 1
+    assert snap["health.warning.exploding_gradients"] == 1
+
+
+def test_health_ops_pruned_when_not_fetched():
+    """The zero-cost contract: a step that doesn't fetch the vitals
+    executes the exact op set it would have without the monitor."""
+    from paddle_tpu.core.trace import _prune_ops
+    main_p, startup_p, loss, opt = _mlp_program()
+    ops = _prune_ops(main_p, list(main_p.global_block().ops),
+                     [loss.name])
+    health_ops = {"squared_l2_norm", "sqrt"}
+    assert not [op for op in ops if op.type in health_ops]
+    # fetched → present
+    mon = opt.health_monitor
+    ops2 = _prune_ops(main_p, list(main_p.global_block().ops),
+                      [loss.name] + [v.name for v in mon.fetch_list])
+    assert [op for op in ops2 if op.type == "squared_l2_norm"]
+
+
+# ------------------------------------------------------ flight recorder
+
+def test_ring_semantics_and_dump_roundtrip(tmp_path):
+    rec = flight.enable(str(tmp_path), capacity=4, install_hooks=False)
+    for i in range(10):
+        rec.record(step=i, loss=float(i))
+    assert len(rec.records) == 4
+    assert [r["step"] for r in rec.records] == [6, 7, 8, 9]
+    rec.annotate(grad_norm=3.5)
+    assert rec.records[-1]["grad_norm"] == 3.5
+    rec.event("compile", program=2)
+    rep = NumericsReport("forward", op_type="mul", op_idx=1)
+    path = rec.dump(reason="nan_inf", report=rep)
+    payload = json.loads(open(path).read())
+    assert payload["reason"] == "nan_inf"
+    assert [r["step"] for r in payload["records"]] == [6, 7, 8, 9]
+    assert payload["report"]["op_type"] == "mul"
+    # round-trip through the tpudoctor postmortem printer
+    from tpudoctor import format_dump
+    text = format_dump(payload)
+    assert "nan_inf" in text and "compile" in text
+    assert "(mul)" in text and "grad_norm" in text
+
+
+def test_executor_records_steps_and_dumps_on_nan(tmp_path):
+    rec = flight.enable(str(tmp_path), capacity=16,
+                        install_hooks=False)
+    main_p, startup_p, loss, _ = _mlp_program()
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    exe.run(startup_p)
+    for _ in range(3):
+        exe.run(main_p, feed=_feed(rng), fetch_list=[loss])
+    steps = [r for r in rec.records if "step" in r]
+    assert len(steps) >= 3
+    assert any(r.get("compile") for r in rec.records)
+    assert any("loss" in r for r in steps)       # scalar fetch annotated
+    with pytest.raises(NanInfError):
+        exe.run(main_p, feed=_feed(rng, fill=3e38), fetch_list=[loss],
+                check_nan_inf=True)
+    assert rec.last_dump_path and os.path.exists(rec.last_dump_path)
+    payload = json.loads(open(rec.last_dump_path).read())
+    assert payload["reason"] == "nan_inf"
+    assert payload["report"]["op_type"] == "mul"
+
+
+def test_disabled_mode_zero_snapshots():
+    main_p, startup_p, loss, _ = _mlp_program()
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    exe.run(startup_p)
+    for _ in range(3):
+        exe.run(main_p, feed=_feed(rng), fetch_list=[loss])
+    assert exe.diag_snapshot_count == 0
+    assert flight.active() is None
+    assert exe.last_numerics_report is None
+
+
+# --------------------------------------------------------- CI gate
+
+def test_tpudoctor_selftest_subprocess():
+    """The acceptance path (pattern of tests/test_serving.py): injected
+    NaN localized to the exact op, complete report, dump round-trip —
+    as a CPU-only subprocess."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    env.pop("PADDLE_TPU_FLIGHT_RECORDER", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpudoctor.py"),
+         "--selftest", "--json"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["ok"] is True and obj["problems"] == []
+    assert obj["culprit"]["op_type"] == "mul"
+    assert obj["culprit"]["phase"] == "forward"
+    assert obj["culprit"]["op_idx"] == 0

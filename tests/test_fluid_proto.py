@@ -308,3 +308,44 @@ def test_fluid_export_ssd_inference_roundtrip(tmp_path):
     got = np.asarray(pt.Executor().run(prog, feed={"image": x},
                                        fetch_list=fetch_vars)[0])
     np.testing.assert_allclose(got, ref_out, rtol=1e-5, atol=1e-6)
+
+
+def test_fluid_combined_params_sorted_by_name(tmp_path):
+    """Interop regression (ADVICE): the combined param stream must be
+    written AND read in sorted-by-name order (the reference
+    save_vars/load_vars convention), not declaration order — a model
+    whose declaration order differs would otherwise bind tensors to
+    the wrong variables when exchanged with real Fluid."""
+    x = np.random.RandomState(7).randn(3, 16).astype("float32")
+    img = layers.data("img", shape=[16])
+    # declaration order (z_param, a_param) != sorted (a_param, z_param),
+    # with distinct shapes so any order mix-up is visible in the stream
+    h = layers.fc(img, 4, param_attr=pt.ParamAttr(name="z_param"),
+                  bias_attr=False)
+    out_v = layers.fc(h, 2, param_attr=pt.ParamAttr(name="a_param"),
+                      bias_attr=False)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    ref_out = np.asarray(exe.run(feed={"img": x},
+                                 fetch_list=[out_v])[0])
+    ref_vals = {n: np.asarray(pt.global_scope().get(n))
+                for n in ("a_param", "z_param")}
+    pt.io.save_inference_model(str(tmp_path), ["img"], [out_v], exe,
+                               program_format="fluid",
+                               params_filename="__params__")
+    # the raw stream is self-describing: reading it sequentially must
+    # yield a_param's [4, 2] FIRST, then z_param's [16, 4]
+    stream = fpr.load_fluid_params(str(tmp_path), ["first", "second"],
+                                   filename="__params__")
+    assert stream["first"].shape == (4, 2)
+    assert stream["second"].shape == (16, 4)
+
+    _fresh()
+    prog, feeds, fetch_vars = pt.io.load_inference_model(
+        str(tmp_path), pt.Executor(), params_filename="__params__")
+    for name, want in ref_vals.items():
+        np.testing.assert_array_equal(
+            np.asarray(pt.global_scope().get(name)), want)
+    got = np.asarray(pt.Executor().run(prog, feed={"img": x},
+                                       fetch_list=fetch_vars)[0])
+    np.testing.assert_allclose(got, ref_out, atol=1e-6)

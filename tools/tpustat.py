@@ -192,11 +192,14 @@ def main(argv=None):
     # compile time — see executor.run / InferenceEngine._get_fn)
     signatures = int(max(snap.get("executor.signature_count", 0),
                          snap.get("inference.signature_count", 0)))
+    from paddle_tpu import diagnostics
+    diag = diagnostics.status()
     result = {
         "model": args.model,
         "steps": args.steps,
         "batch_size": args.batch_size,
         "platform": jax.devices()[0].platform,
+        "diagnostics": diag,
         "signatures": signatures,
         "final_loss": losses[-1] if losses else None,
         "metrics": snap,
@@ -213,7 +216,10 @@ def main(argv=None):
         print(f"tpustat: {args.model} x {args.steps} steps "
               f"(batch {args.batch_size}) on "
               f"{result['platform']}, {signatures} compiled "
-              f"signature{'s' if signatures != 1 else ''}")
+              f"signature{'s' if signatures != 1 else ''}, "
+              f"nan_check={'on' if diag['nan_check'] else 'off'} "
+              f"flight_recorder="
+              f"{'on' if diag['flight_recorder'] else 'off'}")
         width = max((len(k) for k in snap), default=10)
         for name in sorted(snap):
             print(f"  {name:<{width}}  {_fmt_value(snap[name])}")
