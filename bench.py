@@ -777,6 +777,33 @@ def _enable_compile_cache():
         pass
 
 
+def _write_telemetry_artifact(path=None):
+    """BENCH_telemetry.json alongside BENCH_probe.json: the full metric
+    snapshot (+ span count) of the bench run when telemetry is on.
+    Telemetry off (the default): returns None, writes NOTHING, and
+    touches no stdout — the bench-contract final-line pins stay intact
+    (tests/test_bench_contract.py)."""
+    try:
+        from paddle_tpu import telemetry
+    except Exception:
+        return None
+    if not telemetry.enabled():
+        return None
+    snap = telemetry.snapshot()
+    path = path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_telemetry.json")
+    try:
+        with open(path, "w") as f:
+            json.dump({"schema": "paddle_tpu.bench.telemetry.v1",
+                       "metrics": snap,
+                       "spans": len(telemetry.iter_spans())},
+                      f, indent=1, default=str)
+    except OSError:
+        return None
+    return path
+
+
 def _child_main():
     """BENCH_CHILD=1 mode: assume the default backend (TPU, or CPU when
     the parent forced JAX_PLATFORMS=cpu), stream a progress line after
@@ -791,7 +818,11 @@ def _child_main():
         # config knob actually stops it
         jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform  # may hang; parent supervises
-    _emit(run_benchmarks(platform, emit_progress=_emit))
+    result = run_benchmarks(platform, emit_progress=_emit)
+    # artifact write happens BEFORE the final emit: the last stdout
+    # line must stay the result line no matter what the write does
+    _write_telemetry_artifact()
+    _emit(result)
 
 
 class _Supervisor:

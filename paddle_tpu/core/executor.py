@@ -438,6 +438,11 @@ class Executor:
             # watermark gauges; a no-op on backends without allocator
             # stats (capability probed once — see telemetry.memory)
             _tm.sample_device_memory()
+            # fleet spool heartbeat: a no-op until a rank is configured
+            # (fleet.init / PADDLE_TPU_FLEET_RANK); with a spool dir it
+            # periodically flushes this rank's snapshot for the
+            # coordinator-side FleetCollector merge
+            _tm.fleet.on_step(dt)
         if (self.step_timeout is not None and not first_run
                 and dt > self.step_timeout):
             if tm_on:

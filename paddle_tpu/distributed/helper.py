@@ -33,6 +33,27 @@ class MPIHelper:
         """MPI_Finalize analog: nothing to tear down — the XLA runtime
         owns the gang's lifetime."""
 
+    def describe(self):
+        """One-dict identity summary (rank/size/hostname/ip), each
+        field best-effort. telemetry.fleet stamps this into every rank
+        snapshot envelope so the straggler hint can name the slow HOST,
+        not just a rank number."""
+        out = {}
+        try:
+            out["rank"] = self.get_rank()
+            out["size"] = self.get_size()
+        except Exception:
+            pass
+        try:
+            out["hostname"] = self.get_hostname()
+        except OSError:
+            pass
+        try:
+            out["ip"] = self.get_ip()
+        except OSError:
+            pass
+        return out
+
 
 class FileSystem:
     """ref distributed/helper.py:FileSystem — hadoop/afs client desc for

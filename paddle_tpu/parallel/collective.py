@@ -9,27 +9,57 @@ scheduling/overlap).
 
 Functions are meant to be called INSIDE shard_map-ped functions (axis
 names bound by the enclosing mesh).
+
+Telemetry: each wrapper records `collective.<op>.count` / `.bytes`
+counters and a span on the unified timeline. These fire at TRACE time
+— in the XLA world a collective exists once per compiled signature,
+not once per step, so runtime occurrences = count x steps of that
+program (the per-step cost shows up in device profiles, not here).
+The raw psum/pmean/pmax aliases stay uninstrumented.
 """
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from .. import telemetry as _tm
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
            "all_to_all", "ppermute", "barrier", "psum", "pmean", "pmax",
            "axis_index"]
 
 
+def _traced(op, x, axis_name):
+    """Trace-time accounting for one collective call; returns the span
+    context (the shared no-op singleton when telemetry is off)."""
+    if not _tm.enabled():
+        return _tm.span(op)
+    nbytes = 0
+    try:
+        size = 1
+        for d in getattr(x, "shape", ()):
+            size *= int(d)
+        nbytes = size * np.dtype(x.dtype).itemsize
+    except Exception:
+        pass
+    _tm.counter(f"collective.{op}.count").inc()
+    _tm.counter(f"collective.{op}.bytes").inc(nbytes)
+    return _tm.span(f"collective.{op}", cat="collective",
+                    axis=str(axis_name), bytes=nbytes)
+
+
 def all_reduce(x, op="sum", axis_name="dp"):
-    if op == "sum":
-        return lax.psum(x, axis_name)
-    if op == "mean":
-        return lax.pmean(x, axis_name)
-    if op == "max":
-        return lax.pmax(x, axis_name)
-    if op == "min":
-        return lax.pmin(x, axis_name)
-    if op == "prod":
-        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+    with _traced("all_reduce", x, axis_name):
+        if op == "sum":
+            return lax.psum(x, axis_name)
+        if op == "mean":
+            return lax.pmean(x, axis_name)
+        if op == "max":
+            return lax.pmax(x, axis_name)
+        if op == "min":
+            return lax.pmin(x, axis_name)
+        if op == "prod":
+            return jnp.exp(lax.psum(jnp.log(x), axis_name))
     raise ValueError(f"unsupported all_reduce op {op!r}")
 
 
@@ -39,29 +69,35 @@ pmax = lambda x, axis_name="dp": lax.pmax(x, axis_name)
 
 
 def all_gather(x, axis_name="dp", axis=0, tiled=True):
-    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    with _traced("all_gather", x, axis_name):
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name="dp", scatter_axis=0):
-    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
-                            tiled=True)
+    with _traced("reduce_scatter", x, axis_name):
+        return lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_axis,
+                                tiled=True)
 
 
 def broadcast(x, root=0, axis_name="dp"):
     """Root's value on every member: psum of the root-masked value —
     no gathered 8x buffer, lowers to one collective."""
-    idx = lax.axis_index(axis_name)
-    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
-    return lax.psum(masked, axis_name)
+    with _traced("broadcast", x, axis_name):
+        idx = lax.axis_index(axis_name)
+        masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis_name)
 
 
 def all_to_all(x, axis_name="sp", split_axis=0, concat_axis=0):
-    return lax.all_to_all(x, axis_name, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    with _traced("all_to_all", x, axis_name):
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
 
 
 def ppermute(x, perm, axis_name="sp"):
-    return lax.ppermute(x, axis_name, perm)
+    with _traced("ppermute", x, axis_name):
+        return lax.ppermute(x, axis_name, perm)
 
 
 def axis_index(axis_name="dp"):
@@ -70,4 +106,5 @@ def axis_index(axis_name="dp"):
 
 def barrier(axis_name="dp"):
     """psum of a scalar — the XLA equivalent of a device barrier."""
-    return lax.psum(jnp.ones(()), axis_name)
+    with _traced("barrier", jnp.ones(()), axis_name):
+        return lax.psum(jnp.ones(()), axis_name)

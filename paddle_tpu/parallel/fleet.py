@@ -6,6 +6,7 @@ jax.distributed world.
 """
 import jax
 
+from .. import telemetry as _tm
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 
 __all__ = ["init", "distributed_optimizer", "worker_num", "worker_index",
@@ -22,6 +23,13 @@ def init(role_maker=None, coordinator_address=None, num_processes=None,
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id)
     _state["initialized"] = True
+    # fleet observability: from here on every metric/span this process
+    # exports carries its rank (registry default-labels hook; zero cost
+    # while telemetry is off, and snapshot() gains process.index/count)
+    try:
+        _tm.fleet.configure_from_jax()
+    except Exception:
+        pass   # observability must never block gang bring-up
 
 
 def worker_num():
@@ -39,21 +47,31 @@ def is_first_worker():
 def barrier_all():
     """Blocking barrier: a real psum collective over ALL devices (and a
     host-level sync across processes when running multi-host) — the
-    NCCL/gRPC barrier analog, not a single-device no-op."""
+    NCCL/gRPC barrier analog, not a single-device no-op.
+
+    With telemetry on, the moment the barrier RETURNS is stamped as a
+    fleet clock marker: every rank's marker corresponds to (nearly) the
+    same true instant, which is what lets stitch_traces put all ranks'
+    span timelines on one clock."""
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("fleet_barrier_all")
-        return
-    devs = jax.devices()
-    mesh = Mesh(np.array(devs), ("all",))
-    f = jax.jit(
-        jax.shard_map(lambda x: jax.lax.psum(x, "all"), mesh=mesh,
-                      in_specs=P("all"), out_specs=P()),
-        in_shardings=NamedSharding(mesh, P("all")))
-    jax.block_until_ready(f(jnp.ones(len(devs))))
+    with _tm.span("fleet.barrier_all", cat="fleet"):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("fleet_barrier_all")
+        else:
+            devs = jax.devices()
+            mesh = Mesh(np.array(devs), ("all",))
+            f = jax.jit(
+                jax.shard_map(lambda x: jax.lax.psum(x, "all"),
+                              mesh=mesh, in_specs=P("all"),
+                              out_specs=P()),
+                in_shardings=NamedSharding(mesh, P("all")))
+            jax.block_until_ready(f(jnp.ones(len(devs))))
+    if _tm.enabled():
+        _tm.counter("fleet.barriers").inc()
+        _tm.fleet.mark_clock()
 
 
 def distributed_optimizer(optimizer, strategy=None):

@@ -22,6 +22,8 @@ shard-wise from each host's identically-seeded full copy. Tested by
 tests/test_multihost.py::test_two_process_data_parallel_training
 (2-process dp == single-process global-batch numerics).
 """
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -31,6 +33,7 @@ from ..core.framework import default_main_program
 from ..core.scope import global_scope
 from ..core.trace import build_step_fn
 from ..core.dtypes import as_jnp_dtype
+from .. import telemetry as _tm
 from .mesh import local_mesh
 
 from ..core.compiler import BuildStrategy, ExecutionStrategy  # noqa: F401
@@ -127,6 +130,11 @@ class ParallelExecutor:
         fetch_names = [f.name if hasattr(f, "name") else f
                        for f in (fetch_list or [])]
         program = self.program
+        # per-rank telemetry (one flag check when off): pexe.* metrics
+        # carry the process-index label via the registry default-labels
+        # hook fleet.init installs — same metric names on every rank
+        tm_on = _tm.enabled()
+        t_run0 = time.perf_counter()
 
         seed = program.random_seed
         key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
@@ -175,6 +183,9 @@ class ParallelExecutor:
                 _trace.FUSE_MAX_ELEMS)
         fn = self._cache.get(ckey)
         if fn is None:
+            if tm_on:
+                _tm.counter("pexe.compile_count").inc()
+                _tm.gauge("pexe.device_count").set(self.device_count)
             step_fn = build_step_fn(program, fetch_names, is_test, None)
 
             def wrapped(persist_in, feed_in, key_in, _step=step_fn,
@@ -194,10 +205,19 @@ class ParallelExecutor:
                               self._replicated),
                 donate_argnums=(0,))
             self._cache[ckey] = fn
+        elif tm_on:
+            _tm.counter("pexe.cache_hit_count").inc()
 
-        fetches, new_persist = fn(persist, feed_arrays, key)
+        with _tm.span("pexe.step", step=self._step - 1,
+                      devices=self.device_count):
+            fetches, new_persist = fn(persist, feed_arrays, key)
         for name, val in new_persist.items():
             self.scope.set(name, val)
+        if tm_on:
+            dt = time.perf_counter() - t_run0
+            _tm.counter("pexe.steps").inc()
+            _tm.histogram("pexe.step_seconds").observe(dt)
+            _tm.fleet.on_step(dt)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -19,14 +19,18 @@ Two layers of API:
   optimizer ops then apply the updates.
 """
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _tm
+from ..telemetry import spans as _tspans
+
 __all__ = ["pipeline_forward", "gpipe_schedule", "one_f_one_b_schedule",
-           "PipelineTrainer"]
+           "bubble_fraction", "record_bubble", "PipelineTrainer"]
 
 
 def pipeline_forward(mesh, stage_fn, params_per_stage, x, n_microbatch,
@@ -152,6 +156,32 @@ def one_f_one_b_schedule(n_microbatch, n_stages, n_slots=None):
     return act, mbi
 
 
+def bubble_fraction(schedule, n_microbatch, n_stages, n_slots=None):
+    """Idle fraction of the (tick, stage) schedule grid — the classic
+    pipeline bubble. GPipe's forward grid has (S-1)/(n_mb+S-1) idle
+    ticks per stage in closed form; 1F1B is read off the simulated
+    schedule table (idle cells / total cells)."""
+    if schedule == "gpipe":
+        total = (n_microbatch + n_stages - 1) * n_stages
+        busy = n_microbatch * n_stages
+        return 1.0 - busy / total
+    if schedule == "1f1b":
+        act, _ = one_f_one_b_schedule(n_microbatch, n_stages, n_slots)
+        cells = [a for row in act for a in row]
+        return cells.count(0) / len(cells)
+    raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+
+def record_bubble(schedule, n_microbatch, n_stages, n_slots=None):
+    """Compute the bubble fraction AND publish the
+    `pipeline.bubble_fraction` gauge (the same gauge
+    PipelineTrainer.run sets) when telemetry is enabled."""
+    bf = bubble_fraction(schedule, n_microbatch, n_stages, n_slots)
+    if _tm.enabled():
+        _tm.gauge("pipeline.bubble_fraction").set(bf)
+    return bf
+
+
 class PipelineTrainer:
     """GPipe training of a Program over the `pp` mesh axis.
 
@@ -257,6 +287,8 @@ class PipelineTrainer:
         self._grad_name = grad_var_name
         self._jit_cache = {}
         self._step = 0
+        self._bubble = None            # computed lazily on first run
+        self._schedule_emitted = False
 
     # ------------------------------------------------------------------
     def _stage_branch(self, si, feed_names):
@@ -502,6 +534,36 @@ class PipelineTrainer:
         return step_fn
 
     # ------------------------------------------------------------------
+    def _emit_schedule_spans(self, step_seconds):
+        """Lay the (tick, stage) schedule grid onto the trace ONCE per
+        trainer, each cell scaled to the measured step time: a visual
+        per-microbatch/per-stage breakdown (fwd/bwd/idle) on its own
+        synthetic tracks — the bubble, drawn. Host-side estimate (ticks
+        are assumed uniform), labeled as such via cat="pipeline"."""
+        if self._schedule_emitted:
+            return
+        self._schedule_emitted = True
+        if self.schedule == "gpipe":
+            table = gpipe_schedule(self.n_mb, self.n_stages)
+            n_ticks = self.n_mb + self.n_stages - 1
+            cells = [(t, s, "fwd", m) for (t, s), m in table.items()]
+        else:
+            act, mbi = one_f_one_b_schedule(self.n_mb, self.n_stages)
+            n_ticks = len(act)
+            cells = [(t, s, {1: "fwd", 2: "bwd"}[a], mbi[t][s])
+                     for t in range(n_ticks)
+                     for s, a in enumerate(act[t]) if a]
+        tick_us = step_seconds * 1e6 / max(n_ticks, 1)
+        t0 = _tspans.now_us() - step_seconds * 1e6
+        for t, s, kind, m in cells:
+            _tspans.append_span(
+                f"{kind} mb{m}", cat="pipeline",
+                ts_us=t0 + t * tick_us, dur_us=tick_us,
+                tid=f"pp stage {s}",
+                args={"tick": t, "stage": s, "microbatch": m,
+                      "schedule": self.schedule})
+
+    # ------------------------------------------------------------------
     def run(self, feed, fetch_loss=True):
         """One GPipe training step over the microbatched feed."""
         import numpy as np
@@ -534,13 +596,31 @@ class PipelineTrainer:
         ck = tuple((k, tuple(a.shape), str(a.dtype))
                    for k, a in zip(feed_names, feed_mb))
         fn = self._jit_cache.get(ck)
+        tm_on = _tm.enabled()
         if fn is None:
+            if tm_on:
+                _tm.counter("pipeline.compile_count").inc()
             step = (self._build_fn_1f1b(feed_names)
                     if self.schedule == "1f1b"
                     else self._build_fn(feed_names))
             fn = jax.jit(step)
             self._jit_cache[ck] = fn
-        loss, new_persist = fn(persist, feed_mb, key)
+        t0 = time.perf_counter()
+        with _tm.span("pipeline.step", schedule=self.schedule,
+                      stages=self.n_stages, microbatches=self.n_mb):
+            loss, new_persist = fn(persist, feed_mb, key)
+            loss = float(np.asarray(loss))   # completion barrier
+        if tm_on:
+            dt = time.perf_counter() - t0
+            if self._bubble is None:
+                self._bubble = bubble_fraction(
+                    self.schedule, self.n_mb, self.n_stages)
+            _tm.counter("pipeline.steps").inc()
+            _tm.counter("pipeline.microbatches").inc(self.n_mb)
+            _tm.histogram("pipeline.step_seconds").observe(dt)
+            _tm.gauge("pipeline.bubble_fraction").set(self._bubble)
+            self._emit_schedule_spans(dt)
+            _tm.fleet.on_step(dt)
         for n, v in new_persist.items():
             self.scope.set(n, v)
-        return float(np.asarray(loss))
+        return loss

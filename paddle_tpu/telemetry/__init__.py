@@ -23,6 +23,9 @@ Surfaces
   `merge_device_ops(profiler.device_op_times(dir))` adds device time
 - `flush()` — log a summary; with `PADDLE_TPU_TELEMETRY_DIR=<dir>`
   also write metrics.json / metrics.prom / trace.json there
+- `fleet` — multi-rank layer: rank labels on every export, a per-rank
+  snapshot spool, coordinator-side merge (FleetCollector), straggler
+  detection, and multi-rank trace stitching (`tpustat --fleet`)
 - `tools/tpustat.py` — CLI: run a benchmark model N steps and print
   the table
 
@@ -36,18 +39,20 @@ import os
 from . import registry as _registry
 from . import spans as _spans
 from . import memory as _memory
+from . import fleet
 from .registry import (Counter, Gauge, Histogram, counter, gauge,
-                       histogram, snapshot, prometheus_text,
+                       histogram, prometheus_text,
                        DEFAULT_TIME_BUCKETS)
 from .spans import (span, iter_spans, chrome_trace, write_chrome_trace,
-                    merge_device_ops, SpanRecord)
+                    merge_device_ops, SpanRecord, append_span, now_us)
 from .memory import device_memory_supported, sample_device_memory
 
 __all__ = ["enabled", "enable", "disable", "counter", "gauge",
            "histogram", "span", "snapshot", "prometheus_text",
            "chrome_trace", "write_chrome_trace", "merge_device_ops",
            "iter_spans", "sample_device_memory",
-           "device_memory_supported", "reset", "flush", "Counter",
+           "device_memory_supported", "reset", "flush", "fleet",
+           "append_span", "now_us", "Counter",
            "Gauge", "Histogram", "SpanRecord", "DEFAULT_TIME_BUCKETS"]
 
 _LOG = logging.getLogger("paddle_tpu.telemetry")
@@ -76,8 +81,21 @@ def disable():
     _ENABLED = False
 
 
-# span() consults the same flag without importing this module back
+# span()/fleet consult the same flag without importing this module back
 _spans._span_enabled = enabled
+fleet._enabled = enabled
+
+
+def snapshot():
+    """{metric_name: value} — counters/gauges as numbers, histograms as
+    {count, sum, min, max, mean, buckets}. Empty when nothing was ever
+    recorded (the disabled-mode contract). Once a fleet rank is known
+    (parallel.fleet.init / telemetry.fleet.configure), a non-empty
+    snapshot also carries "process.index"/"process.count"."""
+    snap = _registry.snapshot()
+    if snap:
+        snap.update(fleet.process_meta())
+    return snap
 
 
 def reset():
@@ -92,7 +110,12 @@ def flush(log=True):
     """Final export: log a one-line summary and, when
     PADDLE_TPU_TELEMETRY_DIR is set, write metrics.json, metrics.prom,
     and trace.json there. Returns the snapshot (None when disabled) —
-    Executor.close() calls this so a run's metrics outlive it."""
+    Executor.close() calls this so a run's metrics outlive it.
+
+    Fleet mode (a rank configured): every rank also writes its spool
+    envelope (fleet.write_rank_snapshot); the single-artifact files are
+    written by rank 0 only, so N ranks sharing one directory don't
+    clobber each other's metrics.json."""
     if not _ENABLED:
         return None
     snap = snapshot()
@@ -100,12 +123,18 @@ def flush(log=True):
     if log:
         _LOG.info("telemetry flush: %d metrics, %d spans", len(snap),
                   n_spans)
+    r = fleet.rank()
     out_dir = os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
-    if out_dir:
+    if out_dir and r in (None, 0):
         os.makedirs(out_dir, exist_ok=True)
         with open(os.path.join(out_dir, "metrics.json"), "w") as f:
             json.dump(snap, f, indent=2, default=str)
         with open(os.path.join(out_dir, "metrics.prom"), "w") as f:
             f.write(prometheus_text())
         write_chrome_trace(os.path.join(out_dir, "trace.json"))
+    if r is not None and fleet.spool_dir() is not None:
+        try:
+            fleet.write_rank_snapshot()
+        except OSError as e:
+            _LOG.warning("fleet spool flush failed: %s", e)
     return snap

@@ -1,0 +1,605 @@
+"""Fleet observability: per-rank telemetry spool + coordinator merge.
+
+PR 2's registry and spans are strictly single-process; a multihost run
+(fleet.init → jax.distributed.initialize) was a black box — no rank
+labels, no cross-host metric aggregation, no way to tell a straggler
+host from a slow program. This module adds the missing layer, in the
+spirit of the reference's per-device SSA-graph timers and pserver logs:
+
+- `configure(rank, world, spool_dir)` tags every metric/span this
+  process exports with its rank via ONE registry-level default-labels
+  hook (metric names stay identical across ranks — that is what makes
+  the merge line up). `parallel.fleet.init` calls `configure_from_jax`
+  so real multihost runs get this for free.
+- `write_rank_snapshot()` flushes an atomic (tmp + rename, so a reader
+  never sees a torn file) JSON envelope — metrics WITH kinds, recent
+  spans, clock info — to a spool directory; `on_step()` drives a
+  periodic flush from the instrumented step loops (flush-on-step: no
+  background thread to leak).
+- `FleetCollector` merges the spool coordinator-side: counters sum,
+  gauges keep per-rank values plus min/max, histograms merge
+  bucket-wise (same edges required). Envelopes are keyed by rank, so
+  re-merging the same file is idempotent.
+- `detect_stragglers` flags ranks whose mean step wall-time sits more
+  than k·MAD above the fleet median (small fleets, n<4 or MAD=0, fall
+  back to a 1.5x-median ratio test), publishing `fleet.straggler.*`
+  gauges and a tpudoctor-style hint naming the slow host.
+- `stitch_traces` merges per-rank span dumps into one Chrome trace —
+  one `pid` per rank, clock offsets aligned on the shared barrier
+  marker (`mark_clock`, stamped by `parallel.fleet.barrier_all`), with
+  a wall-clock fallback when no marker exists.
+
+Env knobs: PADDLE_TPU_FLEET_RANK / _WORLD (configure without jax),
+PADDLE_TPU_FLEET_DIR (spool; defaults to $PADDLE_TPU_TELEMETRY_DIR/
+fleet once a rank is configured), PADDLE_TPU_FLEET_FLUSH_S (periodic
+flush interval, default 30, 0 disables).
+
+Everything is inert until `configure()` (or the env) names a rank, and
+costs nothing at all while telemetry is disabled — the single-process
+zero-cost contract of PR 2 is untouched.
+
+No jax / paddle_tpu imports at module level (same rule as the rest of
+the telemetry package); jax and distributed.helper are pulled in
+lazily and best-effort.
+"""
+import glob
+import json
+import math
+import os
+import statistics
+import threading
+import time
+
+from . import registry as _registry
+from . import spans as _spans
+
+__all__ = ["configure", "configure_from_jax", "configured", "rank",
+           "world", "spool_dir", "process_meta", "mark_clock",
+           "on_step", "write_rank_snapshot", "build_envelope",
+           "FleetCollector", "detect_stragglers", "stitch_traces",
+           "merge_histograms", "SCHEMA"]
+
+SCHEMA = "paddle_tpu.fleet.snapshot.v1"
+REPORT_SCHEMA = "paddle_tpu.fleet.report.v1"
+CLOCK_MARKER = "fleet.clock_marker"
+
+ENV_RANK = "PADDLE_TPU_FLEET_RANK"
+ENV_WORLD = "PADDLE_TPU_FLEET_WORLD"
+ENV_SPOOL = "PADDLE_TPU_FLEET_DIR"
+ENV_FLUSH_S = "PADDLE_TPU_FLEET_FLUSH_S"
+
+_MAX_SPANS_PER_SNAPSHOT = 20_000
+_DEFAULT_FLUSH_S = 30.0
+_DEFAULT_K_MAD = 3.0
+_RATIO_FALLBACK = 1.5
+
+_lock = threading.Lock()
+_state = {"rank": None, "world": None, "spool_dir": None,
+          "marker_us": None, "marker_id": 0, "last_flush": 0.0}
+_env_checked = False
+
+
+def _enabled():
+    # rebound by telemetry/__init__ to the real flag accessor (same
+    # pattern spans.py uses); the default keeps this module importable
+    # standalone
+    return True
+
+
+# ---------------------------------------------------------------- identity
+
+def configure(rank, world=None, spool_dir=None):
+    """Name this process's rank (and optionally fleet size + spool).
+    Installs the registry default-labels hook so every metric exported
+    from here on carries the process index — no call-site churn."""
+    with _lock:
+        _state["rank"] = int(rank)
+        if world is not None:
+            _state["world"] = int(world)
+        if spool_dir is not None:
+            _state["spool_dir"] = spool_dir
+    labels = {"process_index": int(rank)}
+    if _state["world"] is not None:
+        labels["process_count"] = _state["world"]
+    _registry.set_default_labels(labels)
+
+
+def configure_from_jax():
+    """configure() from the live jax.distributed world — called by
+    parallel.fleet.init once the gang exists (jax is certainly
+    importable there)."""
+    import jax
+    configure(jax.process_index(), jax.process_count())
+
+
+def _maybe_env_configure():
+    """Lazy one-shot env configuration (PADDLE_TPU_FLEET_RANK/_WORLD)
+    so subprocess workers don't need an API call before the first
+    instrumented step."""
+    global _env_checked
+    if _env_checked:
+        return _state["rank"] is not None
+    _env_checked = True
+    r = os.environ.get(ENV_RANK)
+    if r is not None and r.strip() != "":
+        w = os.environ.get(ENV_WORLD)
+        configure(int(r), int(w) if w else None,
+                  os.environ.get(ENV_SPOOL))
+    return _state["rank"] is not None
+
+
+def configured():
+    return _state["rank"] is not None
+
+
+def rank():
+    return _state["rank"]
+
+
+def world():
+    return _state["world"]
+
+
+def spool_dir():
+    """Resolved spool directory: explicit configure() > env > the
+    `fleet/` subdir of PADDLE_TPU_TELEMETRY_DIR (only once a rank is
+    configured — single-process runs never grow a spool)."""
+    if _state["spool_dir"]:
+        return _state["spool_dir"]
+    d = os.environ.get(ENV_SPOOL)
+    if d:
+        return d
+    base = os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
+    if base and _state["rank"] is not None:
+        return os.path.join(base, "fleet")
+    return None
+
+
+def process_meta():
+    """{"process.index": r, "process.count": w} once a rank is known,
+    else {} — merged into telemetry.snapshot() output."""
+    r = _state["rank"]
+    if r is None:
+        return {}
+    meta = {"process.index": r}
+    if _state["world"] is not None:
+        meta["process.count"] = _state["world"]
+    return meta
+
+
+def _reset_for_tests():
+    global _env_checked
+    with _lock:
+        _state.update(rank=None, world=None, spool_dir=None,
+                      marker_us=None, marker_id=0, last_flush=0.0)
+    _env_checked = False
+    _registry.set_default_labels({})
+
+
+# ------------------------------------------------------------ clock marker
+
+def mark_clock():
+    """Stamp a clock-alignment marker on this rank's span timeline.
+    Called right after a fleet-wide barrier returns (barrier_all), the
+    markers of all ranks correspond to (nearly) the same true instant —
+    stitch_traces subtracts the per-rank marker timestamps to put every
+    rank on one clock. Returns the local timestamp (µs)."""
+    ts = _spans.now_us()
+    with _lock:
+        _state["marker_us"] = ts
+        _state["marker_id"] += 1
+        mid = _state["marker_id"]
+    _spans.append_span(CLOCK_MARKER, cat="fleet", ts_us=ts, dur_us=0.0,
+                       tid="fleet", args={"marker": mid})
+    return ts
+
+
+# -------------------------------------------------------------- rank spool
+
+def _flush_interval():
+    raw = os.environ.get(ENV_FLUSH_S)
+    if raw is None or raw.strip() == "":
+        return _DEFAULT_FLUSH_S
+    try:
+        return float(raw)
+    except ValueError:
+        return _DEFAULT_FLUSH_S
+
+
+def on_step(dt=None):
+    """Per-step hook from the instrumented step loops (executor / pexe /
+    pipeline); callers gate on telemetry.enabled() so the disabled path
+    never reaches here. Cheap no-op until a rank is configured; with a
+    spool dir it drives the periodic rank-snapshot flush."""
+    if _state["rank"] is None and not _maybe_env_configure():
+        return
+    interval = _flush_interval()
+    if interval <= 0:
+        return
+    spool = spool_dir()
+    if spool is None:
+        return
+    now = time.monotonic()
+    if now - _state["last_flush"] < interval:
+        return
+    # stamp before writing: a persistently failing spool must not turn
+    # into a write attempt on every step
+    with _lock:
+        _state["last_flush"] = now
+    try:
+        write_rank_snapshot()
+    except OSError:
+        pass
+
+
+def _host_info():
+    """Best-effort host identity for the envelope — lets the straggler
+    hint name the slow HOST, not just the rank number."""
+    try:
+        from ..distributed.helper import MPIHelper
+        return MPIHelper().describe()
+    except Exception:
+        try:
+            import socket
+            return {"hostname": socket.gethostname()}
+        except Exception:
+            return {}
+
+
+def build_envelope(rank_override=None):
+    """The per-rank snapshot envelope: metrics WITH kinds (merge
+    semantics need them), recent spans, rank labels, and clock info for
+    stitching."""
+    r = _state["rank"] if rank_override is None else int(rank_override)
+    spans = _spans.iter_spans()[-_MAX_SPANS_PER_SNAPSHOT:]
+    return {
+        "schema": SCHEMA,
+        "rank": 0 if r is None else r,
+        "process_count": _state["world"],
+        "labels": _registry.default_labels(),
+        "host": _host_info(),
+        "flush_unix_us": time.time_ns() // 1000,
+        "flush_perf_us": _spans.now_us(),
+        "clock_marker_us": _state["marker_us"],
+        "metrics": _registry.snapshot_with_kinds(),
+        "spans": [list(s) for s in spans],
+    }
+
+
+def write_rank_snapshot(spool=None, rank_override=None):
+    """Atomically write this rank's envelope to the spool as
+    rank<NNNNN>.snap.json (overwrite-in-place: the newest snapshot per
+    rank is the one that counts, which also makes re-merges of the same
+    spool idempotent). Returns the path."""
+    spool = spool or spool_dir()
+    if spool is None:
+        raise ValueError(
+            "no spool directory: pass one, configure(spool_dir=...), or "
+            f"set {ENV_SPOOL} / PADDLE_TPU_TELEMETRY_DIR")
+    env = build_envelope(rank_override)
+    os.makedirs(spool, exist_ok=True)
+    path = os.path.join(spool, f"rank{env['rank']:05d}.snap.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(env, f, default=str)
+    os.replace(tmp, path)
+    with _lock:
+        _state["last_flush"] = time.monotonic()
+    return path
+
+
+# --------------------------------------------------------- merge semantics
+
+def _norm_buckets(buckets):
+    """JSON round-trips float dict keys to strings ('0.1'); normalize
+    back to floats (plus the '+Inf' sentinel) so bucket-wise merges of
+    spooled and in-memory histograms line up."""
+    out = {}
+    for k, v in buckets.items():
+        if isinstance(k, str) and k.strip().lstrip("+") in ("Inf",
+                                                            "Infinity",
+                                                            "inf"):
+            out["+Inf"] = int(v)
+        else:
+            out[float(k)] = int(v)
+    return out
+
+
+def _norm_hist(h):
+    out = dict(h)
+    out["buckets"] = _norm_buckets(h.get("buckets", {}))
+    out["count"] = int(h.get("count", 0))
+    out["sum"] = float(h.get("sum", 0.0))
+    return out
+
+
+def merge_histograms(a, b, name=""):
+    """Bucket-wise merge of two histogram snapshot dicts. Edges must
+    match — the same instrumented code runs on every rank, so a
+    mismatch means two different metrics collided on one name."""
+    a, b = _norm_hist(a), _norm_hist(b)
+    ea = sorted(k for k in a["buckets"] if k != "+Inf")
+    eb = sorted(k for k in b["buckets"] if k != "+Inf")
+    if ea != eb:
+        raise ValueError(
+            f"histogram {name or '?'}: bucket edges differ across "
+            f"ranks ({ea} vs {eb}); refusing a lossy merge")
+    buckets = {k: a["buckets"].get(k, 0) + b["buckets"].get(k, 0)
+               for k in a["buckets"]}
+    out = {"count": a["count"] + b["count"],
+           "sum": a["sum"] + b["sum"], "buckets": buckets}
+    mins = [x["min"] for x in (a, b) if "min" in x]
+    maxs = [x["max"] for x in (a, b) if "max" in x]
+    if out["count"]:
+        if mins:
+            out["min"] = min(mins)
+        if maxs:
+            out["max"] = max(maxs)
+        out["mean"] = out["sum"] / out["count"]
+    return out
+
+
+def detect_stragglers(per_rank_seconds, k=_DEFAULT_K_MAD):
+    """Flag ranks whose step wall-time sits > k·MAD above the fleet
+    median. MAD is robust to the outliers we're hunting, but degenerates
+    for tiny fleets (n<4) and perfectly uniform fleets (MAD=0) — both
+    fall back to a 1.5x-median ratio test. Publishes fleet.straggler.*
+    gauges when telemetry is enabled and returns the full analysis with
+    a tpudoctor-style hint."""
+    if not per_rank_seconds:
+        return {"verdict": "no step timing data", "flagged": [],
+                "method": "none"}
+    ranks = sorted(per_rank_seconds)
+    vals = [float(per_rank_seconds[r]) for r in ranks]
+    med = statistics.median(vals)
+    mad = statistics.median([abs(v - med) for v in vals])
+    if len(vals) >= 4 and mad > 0:
+        method = "mad"
+        threshold = med + k * mad
+    else:
+        method = "ratio"
+        threshold = _RATIO_FALLBACK * med
+    flagged = [r for r, v in zip(ranks, vals) if v > threshold]
+    worst = max(ranks, key=lambda r: per_rank_seconds[r])
+    worst_v = float(per_rank_seconds[worst])
+    skew = (worst_v / med) if med > 0 else math.inf
+    out = {"method": method, "k": k, "median_seconds": med,
+           "mad_seconds": mad, "threshold_seconds": threshold,
+           "per_rank_seconds": {str(r): float(per_rank_seconds[r])
+                                for r in ranks},
+           "flagged": flagged, "worst_rank": worst,
+           "skew_ratio": skew}
+    if flagged:
+        out["verdict"] = ("straggler: rank" +
+                          ("s " if len(flagged) > 1 else " ") +
+                          ", ".join(str(r) for r in flagged))
+        out["hint"] = (
+            f"rank {worst} mean step {worst_v * 1e3:.1f} ms is "
+            f"{skew:.1f}x the fleet median {med * 1e3:.1f} ms "
+            f"({method} threshold {threshold * 1e3:.1f} ms). A slow "
+            "rank serializes every collective in the step — check that "
+            "host's input pipeline (reader.starved_polls), shared-"
+            "tenant CPU load, thermal throttling, or NIC/DCN link "
+            "before blaming the program.")
+    else:
+        out["verdict"] = "balanced"
+    if _enabled():
+        _registry.gauge("fleet.straggler.count").set(len(flagged))
+        _registry.gauge("fleet.straggler.threshold_seconds").set(
+            threshold)
+        _registry.gauge("fleet.straggler.worst_skew").set(
+            0.0 if math.isinf(skew) else skew)
+    return out
+
+
+# ------------------------------------------------------------- trace stitch
+
+def stitch_traces(envelopes, align="auto"):
+    """Merge per-rank envelopes into ONE Chrome trace: every rank
+    becomes a `pid` (named after its host), and per-rank clocks are
+    aligned by subtracting each rank's barrier-marker timestamp
+    (`align="marker"`). With no marker on every rank, falls back to the
+    flush wall-clock (each rank's perf timeline is pinned to unix time
+    at flush; coarser — NTP-level — but always available). Rank 0's
+    timeline is the reference frame."""
+    envs = sorted(envelopes, key=lambda e: int(e.get("rank", 0)))
+    if not envs:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "fleetAlignment": "empty"}
+    have_marker = all(e.get("clock_marker_us") is not None for e in envs)
+    have_wall = all(e.get("flush_unix_us") is not None
+                    and e.get("flush_perf_us") is not None for e in envs)
+    if align == "marker" or (align == "auto" and have_marker):
+        if not have_marker:
+            raise ValueError("align='marker' but a rank has no "
+                             "clock marker (call fleet.mark_clock / "
+                             "barrier_all on every rank)")
+        base = float(envs[0]["clock_marker_us"])
+        offsets = {int(e["rank"]): base - float(e["clock_marker_us"])
+                   for e in envs}
+        method = "marker"
+    elif align in ("auto", "wall") and have_wall:
+        # unix time at each rank's perf-timeline origin; rebase on rank0
+        origin = {int(e["rank"]):
+                  float(e["flush_unix_us"]) - float(e["flush_perf_us"])
+                  for e in envs}
+        base = origin[int(envs[0]["rank"])]
+        offsets = {r: o - base for r, o in origin.items()}
+        method = "wall"
+    else:
+        offsets = {int(e["rank"]): 0.0 for e in envs}
+        method = "none"
+
+    events = []
+    for e in envs:
+        pid = int(e.get("rank", 0))
+        off = offsets[pid]
+        host = (e.get("host") or {}).get("hostname")
+        label = f"rank {pid}" + (f" ({host})" if host else "")
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": label}})
+        events.append({"name": "process_sort_index", "ph": "M",
+                       "pid": pid, "args": {"sort_index": pid}})
+        for s in e.get("spans", []):
+            name, cat, ts, dur, tid, depth, args = s
+            ev_args = dict(args) if args else {}
+            ev_args["depth"] = depth
+            ev_args["rank"] = pid
+            events.append({"name": name, "cat": cat, "ph": "X",
+                           "ts": float(ts) + off, "dur": float(dur),
+                           "pid": pid, "tid": tid, "args": ev_args})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "fleetAlignment": method}
+
+
+# ---------------------------------------------------------------- collector
+
+class FleetCollector:
+    """Coordinator-side merge of a rank spool. Envelopes are keyed by
+    rank — adding the same file (or the same rank's newer snapshot)
+    again replaces the previous contribution, so re-merges are
+    idempotent, and a periodic spool converges to the latest state."""
+
+    def __init__(self, k_mad=_DEFAULT_K_MAD):
+        self.k_mad = k_mad
+        self._ranks = {}        # rank -> envelope
+
+    # -- ingest --------------------------------------------------------
+    def add_snapshot(self, envelope):
+        if envelope.get("schema") != SCHEMA:
+            raise ValueError(
+                f"not a fleet snapshot (schema="
+                f"{envelope.get('schema')!r}, want {SCHEMA!r})")
+        self._ranks[int(envelope["rank"])] = envelope
+        return self
+
+    def add_file(self, path):
+        with open(path) as f:
+            return self.add_snapshot(json.load(f))
+
+    def collect(self, spool):
+        paths = sorted(glob.glob(os.path.join(spool, "rank*.snap.json")))
+        if not paths:
+            raise FileNotFoundError(
+                f"no rank*.snap.json files in {spool!r}")
+        for p in paths:
+            self.add_file(p)
+        return self
+
+    @property
+    def ranks(self):
+        return sorted(self._ranks)
+
+    def envelope(self, rank):
+        return self._ranks[rank]
+
+    # -- merge ---------------------------------------------------------
+    def merged_metrics(self):
+        """{name: {"kind": ..., ...}}: counters sum into "value";
+        gauges keep {"per_rank", "min", "max"}; histograms merge
+        bucket-wise into "value"."""
+        merged = {}
+        for r in self.ranks:
+            for name, ent in self._ranks[r].get("metrics", {}).items():
+                kind, val = ent["kind"], ent["value"]
+                slot = merged.get(name)
+                if slot is None:
+                    slot = merged[name] = {"kind": kind}
+                    if kind == "counter":
+                        slot["value"] = 0
+                    elif kind == "gauge":
+                        slot["per_rank"] = {}
+                elif slot["kind"] != kind:
+                    raise ValueError(
+                        f"metric {name!r} is a {slot['kind']} on one "
+                        f"rank and a {kind} on rank {r}")
+                if kind == "counter":
+                    slot["value"] += val
+                elif kind == "gauge":
+                    slot["per_rank"][str(r)] = val
+                    slot["min"] = min(val, slot.get("min", val))
+                    slot["max"] = max(val, slot.get("max", val))
+                else:
+                    slot["value"] = (_norm_hist(val)
+                                     if "value" not in slot else
+                                     merge_histograms(slot["value"],
+                                                      val, name))
+        return merged
+
+    # -- derived views -------------------------------------------------
+    _STEP_HISTS = ("executor.step_seconds", "pexe.step_seconds",
+                   "pipeline.step_seconds")
+
+    def _rank_step_hist(self, r):
+        m = self._ranks[r].get("metrics", {})
+        for cand in self._STEP_HISTS:
+            if cand in m and m[cand]["kind"] == "histogram":
+                return _norm_hist(m[cand]["value"])
+        return None
+
+    def per_rank_step_seconds(self):
+        """{rank: mean step wall-time} from whichever step histogram
+        each rank recorded (plain executor, ParallelExecutor, or
+        PipelineTrainer)."""
+        out = {}
+        for r in self.ranks:
+            h = self._rank_step_hist(r)
+            if h and h.get("count"):
+                out[r] = h["sum"] / h["count"]
+        return out
+
+    def straggler_report(self, k=None):
+        return detect_stragglers(self.per_rank_step_seconds(),
+                                 k=self.k_mad if k is None else k)
+
+    def stitched_trace(self, align="auto"):
+        return stitch_traces(self._ranks.values(), align=align)
+
+    def report(self):
+        """The one-command fleet view tpustat --fleet renders: per-rank
+        step time / collective volume / bubble fraction, merged
+        metrics, collective totals, and the straggler verdict."""
+        merged = self.merged_metrics()
+        per_rank = {}
+        for r in self.ranks:
+            env = self._ranks[r]
+            m = env.get("metrics", {})
+            h = self._rank_step_hist(r)
+            calls = sum(int(e["value"]) for n, e in m.items()
+                        if n.startswith("collective.")
+                        and n.endswith(".count"))
+            nbytes = sum(int(e["value"]) for n, e in m.items()
+                         if n.startswith("collective.")
+                         and n.endswith(".bytes"))
+            coll_us = sum(float(s[3]) for s in env.get("spans", [])
+                          if s[1] == "collective")
+            bubble = m.get("pipeline.bubble_fraction")
+            per_rank[str(r)] = {
+                "steps": h["count"] if h else 0,
+                "step_seconds_mean": (h["sum"] / h["count"])
+                if h and h.get("count") else None,
+                "step_seconds_max": h.get("max") if h else None,
+                "collective_calls": calls,
+                "collective_bytes": nbytes,
+                "collective_host_us": coll_us,
+                "bubble_fraction": bubble["value"] if bubble else None,
+                "hostname": (env.get("host") or {}).get("hostname"),
+                "labels": env.get("labels", {}),
+            }
+        collectives = {}
+        for name, ent in merged.items():
+            if name.startswith("collective.") and ent["kind"] == "counter":
+                op, _, what = name[len("collective."):].rpartition(".")
+                if op:
+                    collectives.setdefault(op, {})[what] = ent["value"]
+        return {
+            "schema": REPORT_SCHEMA,
+            "ranks": self.ranks,
+            "process_count": max(
+                [e.get("process_count") or 0
+                 for e in self._ranks.values()] + [len(self._ranks)]),
+            "per_rank": per_rank,
+            "merged": merged,
+            "collectives": collectives,
+            "straggler": self.straggler_report(),
+        }

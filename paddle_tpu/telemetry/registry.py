@@ -14,8 +14,9 @@ import math
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
-           "histogram", "snapshot", "reset_metrics", "prometheus_text",
-           "DEFAULT_TIME_BUCKETS"]
+           "histogram", "snapshot", "snapshot_with_kinds",
+           "reset_metrics", "prometheus_text", "set_default_labels",
+           "default_labels", "DEFAULT_TIME_BUCKETS"]
 
 # exponential wall-time buckets, 100µs .. 2min (seconds); the spread
 # covers a cached CPU step (~1ms) through a cold TPU-relay compile
@@ -25,6 +26,25 @@ DEFAULT_TIME_BUCKETS = (
 
 _metrics = {}           # name -> metric
 _registry_lock = threading.Lock()
+
+# Registry-level default labels (e.g. {"process_index": 3}): one hook
+# tags EVERY metric this process exports without touching call sites —
+# metric names stay identical across ranks (which is what makes the
+# fleet merge line up), the labels ride along in the export envelope
+# (telemetry.fleet.build_envelope) instead of being baked into names.
+_default_labels = {}
+
+
+def set_default_labels(labels):
+    with _registry_lock:
+        _default_labels.clear()
+        _default_labels.update(
+            {str(k): v for k, v in (labels or {}).items()})
+
+
+def default_labels():
+    with _registry_lock:
+        return dict(_default_labels)
 
 
 class Counter:
@@ -45,10 +65,16 @@ class Counter:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
     def to_value(self):
-        return self._value
+        # lock audit (fleet merge hardening): reads go through the
+        # metric lock like writes do — a bare int read is atomic in
+        # CPython today, but snapshot()/flush() running concurrently
+        # with inc() must stay correct by contract, not by accident
+        with self._lock:
+            return self._value
 
 
 class Gauge:
@@ -76,10 +102,12 @@ class Gauge:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
     def to_value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Histogram:
@@ -110,6 +138,12 @@ class Histogram:
 
     def observe(self, v):
         v = float(v)
+        # the bucket search reads only the immutable edge tuple, so it
+        # stays outside the lock; every mutable field (_counts, _sum,
+        # _count, _min, _max) is updated in ONE critical section, and
+        # to_value() reads them under the same lock — a snapshot/flush
+        # racing observe() therefore always sees a consistent histogram
+        # (bucket totals == count), never a torn multi-field update
         i = 0
         for i, edge in enumerate(self.buckets):
             if v <= edge:
@@ -183,6 +217,18 @@ def snapshot():
     with _registry_lock:
         metrics = list(_metrics.values())
     return {m.name: m.to_value() for m in metrics}
+
+
+def snapshot_with_kinds():
+    """{name: {"kind": "counter"|"gauge"|"histogram", "value": ...}} —
+    the merge-safe export: a plain snapshot() can't distinguish a
+    counter from a gauge, but cross-rank merge semantics differ
+    (counters sum, gauges keep per-rank values), so the fleet spool
+    envelope carries the kind with every value."""
+    with _registry_lock:
+        metrics = list(_metrics.values())
+    return {m.name: {"kind": m.kind, "value": m.to_value()}
+            for m in metrics}
 
 
 def reset_metrics():

@@ -19,7 +19,8 @@ import threading
 import time
 
 __all__ = ["span", "iter_spans", "clear_spans", "chrome_trace",
-           "write_chrome_trace", "merge_device_ops", "SpanRecord"]
+           "write_chrome_trace", "merge_device_ops", "SpanRecord",
+           "now_us", "append_span"]
 
 _EPOCH_NS = time.perf_counter_ns()
 _MAX_SPANS = 200_000
@@ -36,6 +37,30 @@ _tls = threading.local()
 
 def _now_us():
     return (time.perf_counter_ns() - _EPOCH_NS) / 1e3
+
+
+def now_us():
+    """Current timestamp on THIS process's span timeline (µs since
+    module import). Fleet clock markers are stamped with this so
+    per-rank timelines can be offset-aligned when stitched."""
+    return _now_us()
+
+
+def append_span(name, cat="host", ts_us=None, dur_us=0.0, tid=None,
+                depth=0, args=None):
+    """Record a pre-built span (no timing context) — used for synthetic
+    timeline tracks (fleet clock markers, pipeline schedule cells).
+    No-op when telemetry is disabled."""
+    if not _span_enabled():
+        return None
+    rec = SpanRecord(name, cat,
+                     _now_us() if ts_us is None else float(ts_us),
+                     float(dur_us),
+                     threading.get_ident() if tid is None else tid,
+                     depth, args or None)
+    with _lock:
+        _spans.append(rec)
+    return rec
 
 
 class _Span:
@@ -144,10 +169,13 @@ def chrome_trace():
         args["depth"] = s.depth
         ev["args"] = args
         events.append(ev)
-    for tid in sorted(tids):
+    # synthetic tracks (pipeline schedule cells, fleet markers) use
+    # string tids alongside integer thread idents — sort by str so the
+    # mix never TypeErrors, and keep their own names as track labels
+    for tid in sorted(tids, key=str):
+        name = f"host thread {tid}" if isinstance(tid, int) else str(tid)
         events.append({"name": "thread_name", "ph": "M", "pid": pid,
-                       "tid": tid,
-                       "args": {"name": f"host thread {tid}"}})
+                       "tid": tid, "args": {"name": name}})
     events.extend(device)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

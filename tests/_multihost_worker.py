@@ -62,6 +62,13 @@ def main():
     fleet.init(coordinator_address=f"localhost:{port}",
                num_processes=nproc, process_id=pid)
     print(f"[w{pid}] fleet.init done", flush=True)
+    # fleet observability: init tagged this process's telemetry with
+    # its rank; flush the rank snapshot spool on exit so a run with
+    # PADDLE_TPU_TELEMETRY=1 (+ PADDLE_TPU_FLEET_DIR) is mergeable via
+    # `tpustat --fleet`. No-op when telemetry is off.
+    import atexit
+    from paddle_tpu import telemetry
+    atexit.register(lambda: telemetry.flush(log=False))
     assert fleet.worker_num() == nproc, fleet.worker_num()
     assert fleet.worker_index() == pid
     n_global = len(jax.devices())

@@ -25,6 +25,7 @@ def _env():
     env.update(JAX_PLATFORMS="cpu", BENCH_ONLY="mnist",
                BENCH_TOTAL_BUDGET_S="120")
     env.pop("XLA_FLAGS", None)
+    env.pop("PADDLE_TPU_TELEMETRY", None)
     return env
 
 
@@ -35,9 +36,16 @@ def _parse_last(stdout):
 
 
 def test_final_line_schema_on_cpu():
+    # telemetry is off in _env(): the run must not grow a telemetry
+    # artifact (and, via the assertions below, stdout stays pinned)
+    tele_artifact = os.path.join(REPO, "BENCH_telemetry.json")
+    if os.path.exists(tele_artifact):
+        os.remove(tele_artifact)
     p = subprocess.run([sys.executable, BENCH], env=_env(),
                        capture_output=True, text=True, timeout=400)
     assert p.returncode == 0, p.stderr[-800:]
+    assert not os.path.exists(tele_artifact), \
+        "telemetry-off bench wrote BENCH_telemetry.json"
     last_line = [l for l in p.stdout.strip().splitlines()
                  if l.strip()][-1]
     # round-5 VERDICT: an embedded probe trail overflowed the driver's
@@ -97,6 +105,33 @@ def test_telemetry_off_cached_fast_path():
     assert flight.active() is None
     assert exe.last_numerics_report is None
     assert dt < 20.0, f"100 cached steps took {dt:.1f}s (bound 20s)"
+
+
+def test_telemetry_artifact_helper(tmp_path):
+    """bench writes BENCH_telemetry.json iff telemetry is on — the
+    helper direct (no 40s bench subprocess): off → None and no file;
+    on → a parseable artifact with the snapshot."""
+    import importlib.util
+    from paddle_tpu import telemetry as tm
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    out = tmp_path / "BENCH_telemetry.json"
+    tm.disable()
+    tm.reset()
+    assert bench._write_telemetry_artifact(str(out)) is None
+    assert not out.exists()
+    tm.enable()
+    try:
+        tm.counter("bench.test_metric").inc(7)
+        path = bench._write_telemetry_artifact(str(out))
+        assert path == str(out)
+        obj = json.loads(out.read_text())
+        assert obj["schema"] == "paddle_tpu.bench.telemetry.v1"
+        assert obj["metrics"]["bench.test_metric"] == 7
+    finally:
+        tm.disable()
+        tm.reset()
 
 
 def test_sigterm_flushes_parseable_line():

@@ -1,0 +1,437 @@
+"""Fleet observability (paddle_tpu.telemetry.fleet): merge semantics
+(counter sum, per-rank gauge retention, bucket-wise histogram merge,
+idempotent re-merge), clock-offset trace stitching, the MAD straggler
+detector, the registry default-labels hook, instrumentation of the
+parallel stack, and the tpustat --fleet CI gate."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import telemetry as tm
+from paddle_tpu.telemetry import fleet as tf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Telemetry off + empty + fleet unconfigured before and after
+    every test (the bench-contract fast-path test asserts the global
+    registry is empty, and a leaked rank would tag later snapshots)."""
+    tm.disable()
+    tm.reset()
+    tf._reset_for_tests()
+    yield
+    tm.disable()
+    tm.reset()
+    tf._reset_for_tests()
+
+
+def _env(rank, metrics, spans=(), marker=None, world=2, unix_us=None,
+         perf_us=0.0, host=None):
+    """Synthetic rank envelope (what write_rank_snapshot produces)."""
+    return {"schema": tf.SCHEMA, "rank": rank, "process_count": world,
+            "labels": {"process_index": rank, "process_count": world},
+            "host": host or {"hostname": f"host{rank}"},
+            "flush_unix_us": (1_000_000 + rank if unix_us is None
+                              else unix_us),
+            "flush_perf_us": perf_us,
+            "clock_marker_us": marker,
+            "metrics": metrics, "spans": [list(s) for s in spans]}
+
+
+def _hist(values, buckets=(0.1, 1.0)):
+    h = tm.Histogram("tmp", buckets=buckets)
+    for v in values:
+        h.observe(v)
+    return h.to_value()
+
+
+# ------------------------------------------------------- merge semantics
+
+def test_counter_merge_sums():
+    c = tf.FleetCollector()
+    c.add_snapshot(_env(0, {"x.c": {"kind": "counter", "value": 3}}))
+    c.add_snapshot(_env(1, {"x.c": {"kind": "counter", "value": 5}}))
+    assert c.merged_metrics()["x.c"] == {"kind": "counter", "value": 8}
+
+
+def test_gauge_merge_keeps_per_rank_and_min_max():
+    c = tf.FleetCollector()
+    c.add_snapshot(_env(0, {"g": {"kind": "gauge", "value": 2.0}}))
+    c.add_snapshot(_env(1, {"g": {"kind": "gauge", "value": 7.0}}))
+    c.add_snapshot(_env(2, {"g": {"kind": "gauge", "value": 4.0}}))
+    m = c.merged_metrics()["g"]
+    assert m["per_rank"] == {"0": 2.0, "1": 7.0, "2": 4.0}
+    assert m["min"] == 2.0 and m["max"] == 7.0
+
+
+def test_histogram_bucketwise_merge():
+    ha = _hist([0.05, 0.5])          # one in 0.1, one in 1.0
+    hb = _hist([0.5, 5.0])           # one in 1.0, one in +Inf
+    c = tf.FleetCollector()
+    c.add_snapshot(_env(0, {"h": {"kind": "histogram", "value": ha}}))
+    c.add_snapshot(_env(1, {"h": {"kind": "histogram", "value": hb}}))
+    m = c.merged_metrics()["h"]["value"]
+    assert m["count"] == 4
+    assert m["sum"] == pytest.approx(6.05)
+    assert m["buckets"][0.1] == 1
+    assert m["buckets"][1.0] == 2
+    assert m["buckets"]["+Inf"] == 1
+    assert m["min"] == 0.05 and m["max"] == 5.0
+    assert m["mean"] == pytest.approx(6.05 / 4)
+
+
+def test_histogram_merge_survives_json_roundtrip(tmp_path):
+    """JSON stringifies float bucket keys; the merge must normalize
+    them back so spooled files merge identically to live dicts."""
+    ha, hb = _hist([0.05]), _hist([0.5])
+    for r, h in ((0, ha), (1, hb)):
+        path = tmp_path / f"rank{r:05d}.snap.json"
+        path.write_text(json.dumps(
+            _env(r, {"h": {"kind": "histogram", "value": h}})))
+    c = tf.FleetCollector().collect(str(tmp_path))
+    m = c.merged_metrics()["h"]["value"]
+    assert m["count"] == 2
+    assert m["buckets"][0.1] == 1 and m["buckets"][1.0] == 1
+    assert m["buckets"]["+Inf"] == 0
+
+
+def test_histogram_merge_mismatched_buckets_raises():
+    c = tf.FleetCollector()
+    c.add_snapshot(_env(0, {"h": {"kind": "histogram",
+                                  "value": _hist([0.5], (0.1, 1.0))}}))
+    c.add_snapshot(_env(1, {"h": {"kind": "histogram",
+                                  "value": _hist([0.5], (0.2, 2.0))}}))
+    with pytest.raises(ValueError, match="bucket edges differ"):
+        c.merged_metrics()
+
+
+def test_kind_conflict_across_ranks_raises():
+    c = tf.FleetCollector()
+    c.add_snapshot(_env(0, {"m": {"kind": "counter", "value": 1}}))
+    c.add_snapshot(_env(1, {"m": {"kind": "gauge", "value": 1.0}}))
+    with pytest.raises(ValueError, match="counter"):
+        c.merged_metrics()
+
+
+def test_idempotent_remerge_of_same_spool_file(tmp_path):
+    path = tmp_path / "rank00000.snap.json"
+    path.write_text(json.dumps(
+        _env(0, {"x.c": {"kind": "counter", "value": 3},
+                 "h": {"kind": "histogram", "value": _hist([0.5])}})))
+    c = tf.FleetCollector()
+    c.add_file(str(path))
+    once = c.merged_metrics()
+    c.add_file(str(path))            # same rank → replaces, not doubles
+    c.collect(str(tmp_path))         # and again via collect()
+    assert c.merged_metrics() == once
+    assert c.merged_metrics()["x.c"]["value"] == 3
+
+
+def test_collector_rejects_wrong_schema():
+    with pytest.raises(ValueError, match="schema"):
+        tf.FleetCollector().add_snapshot({"schema": "bogus", "rank": 0})
+
+
+# ------------------------------------------------------------- stitching
+
+_SPAN = ["executor.step", "host", 100.0, 50.0, 1, 0, {"step": 0}]
+
+
+def _shift(span, us):
+    s = list(span)
+    s[2] += us
+    return s
+
+
+def test_stitch_aligns_on_clock_marker():
+    """Rank 1's local clock runs 1234µs ahead; after stitching, events
+    that happened at the same true instant land on the same ts."""
+    e0 = _env(0, {}, spans=[_SPAN], marker=90.0)
+    e1 = _env(1, {}, spans=[_shift(_SPAN, 1234.0)],
+              marker=90.0 + 1234.0)
+    trace = tf.stitch_traces([e0, e1])
+    assert trace["fleetAlignment"] == "marker"
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    by_pid = {e["pid"]: e for e in xs}
+    assert set(by_pid) == {0, 1}
+    assert by_pid[0]["ts"] == pytest.approx(by_pid[1]["ts"])
+    assert by_pid[1]["args"]["rank"] == 1
+    # per-rank process metadata present
+    names = {(e["pid"], e["args"]["name"])
+             for e in trace["traceEvents"] if e["name"] == "process_name"}
+    assert (0, "rank 0 (host0)") in names
+    assert (1, "rank 1 (host1)") in names
+
+
+def test_stitch_wallclock_fallback_and_roundtrip():
+    """No markers: per-rank perf timelines are pinned to the flush
+    wall-clock instead; the result survives a JSON round-trip."""
+    # rank1 flushed at the same unix instant but its perf clock reads
+    # 500µs less → offset +500 relative to rank 0
+    e0 = _env(0, {}, spans=[_SPAN], unix_us=10_000_000, perf_us=1000.0)
+    e1 = _env(1, {}, spans=[_shift(_SPAN, -500.0)],
+              unix_us=10_000_000, perf_us=500.0)
+    trace = json.loads(json.dumps(tf.stitch_traces([e0, e1])))
+    assert trace["fleetAlignment"] == "wall"
+    xs = {e["pid"]: e for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert xs[0]["ts"] == pytest.approx(xs[1]["ts"])
+
+
+def test_stitch_marker_required_raises_without_markers():
+    e0 = _env(0, {}, spans=[_SPAN], marker=None)
+    with pytest.raises(ValueError, match="marker"):
+        tf.stitch_traces([e0], align="marker")
+
+
+# ------------------------------------------------------------- straggler
+
+def test_straggler_mad_path_flags_outlier():
+    per = {0: 0.100, 1: 0.102, 2: 0.098, 3: 0.101, 4: 0.099, 5: 0.500}
+    rep = tf.detect_stragglers(per, k=3.0)
+    assert rep["method"] == "mad"
+    assert rep["flagged"] == [5]
+    assert rep["worst_rank"] == 5
+    assert rep["verdict"].startswith("straggler")
+    assert "rank 5" in rep["hint"]
+
+
+def test_straggler_ratio_fallback_small_fleet():
+    # n=2 degenerates MAD (|v - median| == MAD exactly for both ranks);
+    # the 1.5x-median ratio fallback still catches a 6x-slower rank
+    rep = tf.detect_stragglers({0: 0.1, 1: 0.6})
+    assert rep["method"] == "ratio"
+    assert rep["flagged"] == [1]
+
+
+def test_straggler_balanced_fleet_and_gauges():
+    tm.enable()
+    rep = tf.detect_stragglers({0: 0.1, 1: 0.1, 2: 0.1, 3: 0.1})
+    assert rep["flagged"] == [] and rep["verdict"] == "balanced"
+    snap = tm.snapshot()
+    assert snap["fleet.straggler.count"] == 0
+    assert "fleet.straggler.worst_skew" in snap
+
+
+def test_straggler_no_data():
+    assert tf.detect_stragglers({})["flagged"] == []
+
+
+# ------------------------------------------- rank identity / default labels
+
+def test_configure_sets_default_labels_and_snapshot_meta():
+    from paddle_tpu.telemetry import registry
+    tf.configure(rank=3, world=8)
+    assert registry.default_labels() == {"process_index": 3,
+                                         "process_count": 8}
+    tm.counter("some.c").inc()
+    snap = tm.snapshot()
+    assert snap["process.index"] == 3
+    assert snap["process.count"] == 8
+    # disabled-mode contract intact: empty registry → strictly {}
+    tm.reset()
+    assert tm.snapshot() == {}
+
+
+def test_env_configures_rank_lazily(monkeypatch):
+    monkeypatch.setenv(tf.ENV_RANK, "2")
+    monkeypatch.setenv(tf.ENV_WORLD, "4")
+    tf._reset_for_tests()
+    monkeypatch.setenv(tf.ENV_RANK, "2")   # reset cleared the cache
+    monkeypatch.setenv(tf.ENV_WORLD, "4")
+    tf.on_step(0.01)                       # triggers the lazy check
+    assert tf.rank() == 2 and tf.world() == 4
+
+
+def test_envelope_roundtrip_through_real_registry(tmp_path):
+    """The full write path: real metrics + spans + marker → spool file
+    → collector; labels, kinds, and the marker survive."""
+    tm.enable()
+    tf.configure(rank=1, world=2, spool_dir=str(tmp_path))
+    tm.counter("e.c").inc(4)
+    tm.histogram("e.h", buckets=(0.5,)).observe(0.1)
+    with tm.span("e.work"):
+        pass
+    tf.mark_clock()
+    path = tf.write_rank_snapshot()
+    assert os.path.basename(path) == "rank00001.snap.json"
+    c = tf.FleetCollector().collect(str(tmp_path))
+    env = c.envelope(1)
+    assert env["labels"]["process_index"] == 1
+    assert env["clock_marker_us"] is not None
+    assert env["metrics"]["e.c"] == {"kind": "counter", "value": 4}
+    span_names = {s[0] for s in env["spans"]}
+    assert {"e.work", tf.CLOCK_MARKER} <= span_names
+
+
+def test_flush_routes_fleet_ranks_to_spool(tmp_path, monkeypatch):
+    """telemetry.flush() in fleet mode: every rank writes its spool
+    envelope; only rank 0 writes the shared single-process artifacts
+    (rank 1 must not clobber metrics.json)."""
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    tm.enable()
+    tf.configure(rank=1, world=2)
+    tm.counter("f.c").inc()
+    tm.flush(log=False)
+    assert not (tmp_path / "metrics.json").exists()
+    spool = tmp_path / "fleet"
+    assert (spool / "rank00001.snap.json").exists()
+    tf.configure(rank=0, world=2)
+    tm.flush(log=False)
+    assert (tmp_path / "metrics.json").exists()
+    assert (spool / "rank00000.snap.json").exists()
+
+
+def test_zero_cost_when_unconfigured(tmp_path, monkeypatch):
+    """Telemetry ON but no fleet rank: on_step never writes a spool
+    (and snapshot carries no process meta) — the single-process
+    fast-path contract of the acceptance criteria."""
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    tm.enable()
+    img = layers.data("img", shape=[8])
+    out = layers.reduce_mean(layers.fc(img, size=4))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    x = np.random.rand(2, 8).astype("float32")
+    for _ in range(3):
+        exe.run(feed={"img": x}, fetch_list=[out])
+    assert tf.rank() is None
+    assert not (tmp_path / "fleet").exists()
+    assert "process.index" not in tm.snapshot()
+
+
+# -------------------------------------------------- stack instrumentation
+
+def test_collective_instrumentation_counts_bytes_at_trace_time():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.parallel import collective
+    tm.enable()
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    f = jax.jit(jax.shard_map(
+        lambda v: collective.all_reduce(v, axis_name="dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False))
+    np.asarray(f(jnp.ones((10, 4), jnp.float32)))
+    snap = tm.snapshot()
+    assert snap["collective.all_reduce.count"] == 1
+    # bytes are the per-member shard: (10/2) x 4 x float32
+    assert snap["collective.all_reduce.bytes"] == 5 * 4 * 4
+    assert any(s.name == "collective.all_reduce" and s.cat == "collective"
+               for s in tm.iter_spans())
+    # cached re-execution does NOT re-trace: trace-time semantics
+    np.asarray(f(jnp.ones((10, 4), jnp.float32)))
+    assert tm.snapshot()["collective.all_reduce.count"] == 1
+
+
+def test_collective_disabled_is_noop():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.parallel import collective
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    f = jax.jit(jax.shard_map(
+        lambda v: collective.all_gather(v, axis_name="dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P(None),
+        check_vma=False))
+    np.asarray(f(jnp.ones((4, 2), jnp.float32)))
+    assert tm.snapshot() == {}
+
+
+def test_parallel_executor_step_metrics():
+    from jax.sharding import Mesh, PartitionSpec  # noqa: F401
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = layers.data("x", shape=[6])
+            y = layers.data("y", shape=[4])
+            pred = layers.fc(x, size=4)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    pexe = pt.ParallelExecutor(loss_name=loss.name, main_program=main)
+    tm.enable()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 6).astype("float32"),
+            "y": rng.randn(8, 4).astype("float32")}
+    for _ in range(3):
+        pexe.run(feed=feed, fetch_list=[loss])
+    snap = tm.snapshot()
+    assert snap["pexe.steps"] == 3
+    assert snap["pexe.compile_count"] == 1
+    assert snap["pexe.cache_hit_count"] == 2
+    assert snap["pexe.step_seconds"]["count"] == 3
+    assert snap["pexe.device_count"] == pexe.device_count
+    assert sum(1 for s in tm.iter_spans() if s.name == "pexe.step") == 3
+
+
+def test_bubble_fraction_math_and_gauge():
+    from paddle_tpu.parallel import pipeline
+    # GPipe closed form: (S-1)/(n_mb+S-1)
+    assert pipeline.bubble_fraction("gpipe", 4, 2) == pytest.approx(0.2)
+    assert pipeline.bubble_fraction("gpipe", 8, 4) == pytest.approx(
+        3 / 11)
+    # 1F1B from the simulated schedule: idle cells / total cells
+    act, _ = pipeline.one_f_one_b_schedule(4, 2)
+    cells = [a for row in act for a in row]
+    assert pipeline.bubble_fraction("1f1b", 4, 2) == pytest.approx(
+        cells.count(0) / len(cells))
+    with pytest.raises(ValueError):
+        pipeline.bubble_fraction("nope", 4, 2)
+    tm.enable()
+    assert pipeline.record_bubble("gpipe", 4, 2) == pytest.approx(0.2)
+    assert tm.snapshot()["pipeline.bubble_fraction"] == pytest.approx(
+        0.2)
+
+
+def test_barrier_all_records_marker():
+    from paddle_tpu.parallel import fleet as pfleet
+    tm.enable()
+    pfleet.barrier_all()
+    snap = tm.snapshot()
+    assert snap["fleet.barriers"] == 1
+    names = [s.name for s in tm.iter_spans()]
+    assert "fleet.barrier_all" in names
+    assert tf.CLOCK_MARKER in names
+    # barrier_all runs fleet.init's configure path in multihost; here
+    # the marker alone must be enough to stitch this rank
+    env = tf.build_envelope(rank_override=0)
+    assert env["clock_marker_us"] is not None
+
+
+def test_mpihelper_describe():
+    from paddle_tpu.distributed.helper import MPIHelper
+    d = MPIHelper().describe()
+    assert d["rank"] == 0 and d["size"] == 1
+    assert isinstance(d.get("hostname"), str)
+
+
+# --------------------------------------------------------------- CI gate
+
+def test_tpustat_fleet_selftest_subprocess():
+    """The acceptance path (pattern of tests/test_serving.py /
+    test_diagnostics.py): two local rank workers, spool merge, per-rank
+    step time, merged collective counters, bubble fraction, straggler
+    verdict, marker-aligned stitched trace — one command."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("PADDLE_TPU_TELEMETRY", "PADDLE_TPU_TELEMETRY_DIR",
+              "PADDLE_TPU_FLEET_RANK", "PADDLE_TPU_FLEET_DIR"):
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpustat.py"),
+         "--fleet", "--selftest", "--json"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["ok"] is True and obj["problems"] == []
+    assert obj["ranks"] == [0, 1]
+    assert obj["straggler"].startswith("straggler: rank 1")

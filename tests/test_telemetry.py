@@ -104,6 +104,56 @@ def test_thread_safety_smoke():
     assert snap["t.th"]["buckets"][0.5] == 8000
 
 
+def test_snapshot_consistent_while_writers_hammer():
+    """Regression (fleet satellite): snapshot()/flush() racing
+    observe() must always see internally consistent metrics — bucket
+    totals equal the count, nothing torn — and once writers join, the
+    final snapshot accounts for every single write. This is what makes
+    the periodic fleet spool flush safe while step loops keep
+    recording."""
+    import paddle_tpu.telemetry.fleet as tf
+    tm.enable()
+    stop = threading.Event()
+    wrote = [0] * 4
+
+    def writer(i):
+        n = 0
+        while not stop.is_set():
+            tm.counter("race.c").inc()
+            tm.histogram("race.h", buckets=(0.5, 1.5)).observe(n % 2)
+            n += 1
+        wrote[i] = n
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    torn = []
+    for _ in range(150):
+        snap = tm.snapshot()
+        h = snap.get("race.h")
+        if not h:
+            continue
+        if sum(h["buckets"].values()) != h["count"]:
+            torn.append(h)
+        # the spool envelope takes the same read path; it must never
+        # raise mid-hammer either
+        env = tf.build_envelope(rank_override=0)
+        hk = env["metrics"].get("race.h")
+        if hk and sum(hk["value"]["buckets"].values()) \
+                != hk["value"]["count"]:
+            torn.append(hk)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not torn, f"{len(torn)} torn snapshots, e.g. {torn[0]}"
+    snap = tm.snapshot()
+    assert snap["race.c"] == sum(wrote)
+    assert snap["race.h"]["count"] == sum(wrote)
+    assert snap["race.h"]["buckets"][0.5] \
+        + snap["race.h"]["buckets"][1.5] == sum(wrote)
+
+
 def test_env_enable_parsing():
     assert tm._env_truthy("1") and tm._env_truthy("true")
     assert not tm._env_truthy("") and not tm._env_truthy("0")

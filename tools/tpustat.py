@@ -13,10 +13,22 @@ trace-event timeline, loadable in chrome://tracing / Perfetto.
 consistency, trace well-formedness) and exits non-zero when the
 metrics are malformed, so it doubles as a CI gate.
 
+Fleet mode (--fleet): the multi-rank view. Reads a rank-snapshot spool
+(telemetry.fleet — every multihost worker flushes rank*.snap.json
+there), merges it coordinator-side, and prints per-rank step time,
+collective volume, pipeline bubble %, and the straggler verdict from
+one command; --trace writes the STITCHED multi-rank Chrome trace (one
+pid per rank, clocks aligned on the shared barrier marker).
+--fleet --selftest spawns two local single-process workers, merges
+their spool, and validates the whole path — the CI gate
+tests/test_fleet.py runs.
+
 Examples:
   python tools/tpustat.py --model mnist --steps 20 --json
   python tools/tpustat.py --model resnet --steps 10 --prom
   python tools/tpustat.py --model mnist --platform env   # real backend
+  python tools/tpustat.py --fleet /run/spool --trace fleet.json
+  python tools/tpustat.py --fleet --selftest --json      # CI gate
 """
 import argparse
 import json
@@ -102,6 +114,267 @@ def _fmt_value(v):
     return str(v)
 
 
+# ------------------------------------------------------------------ fleet
+
+def _fleet_worker(rank, spool):
+    """Hidden mode: one local single-process 'rank' for the selftest —
+    runs a tiny training loop with telemetry + fleet configured, records
+    one instrumented collective and the pipeline bubble gauge, then
+    flushes its rank snapshot to the spool. Rank 1 injects synthetic
+    slow-step observations so the straggler detector has a
+    deterministic culprit regardless of CI box load."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, telemetry
+    from paddle_tpu.parallel import collective, pipeline
+
+    telemetry.enable()
+    telemetry.fleet.configure(rank=rank, world=2, spool_dir=spool)
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        with fluid.unique_name.guard():
+            x = layers.data("x", shape=[8])
+            y = layers.data("y", shape=[4])
+            pred = layers.fc(x, size=4)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup_p, feed={}, fetch_list=[])
+    telemetry.reset()               # steady state: startup compile off
+    telemetry.fleet.mark_clock()    # the shared-barrier marker analog
+
+    rng = np.random.RandomState(rank)
+    for _ in range(5):
+        feed = {"x": rng.randn(8, 8).astype("float32"),
+                "y": rng.randn(8, 4).astype("float32")}
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+
+    # one collective through the instrumented wrappers (trace-time
+    # accounting; a 1-device axis is enough for the counters)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    f = jax.jit(jax.shard_map(
+        lambda v: collective.all_reduce(v, axis_name="dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False))
+    np.asarray(f(jnp.ones((4, 8), jnp.float32)))
+
+    # pipeline bubble gauge via the same helper PipelineTrainer uses
+    pipeline.record_bubble("gpipe", n_microbatch=4, n_stages=2)
+
+    if rank == 1:
+        # synthetic straggler: this "host" reports pathologically slow
+        # steps, so the detector path is exercised deterministically
+        h = telemetry.histogram("executor.step_seconds")
+        for _ in range(10):
+            h.observe(2.0)
+
+    path = telemetry.fleet.write_rank_snapshot()
+    print(json.dumps({"rank": rank, "snapshot": path, "ok": True}))
+    return 0
+
+
+def _validate_fleet_report(rep, collector):
+    """Structural checks over a merged fleet report (CI-gate grade)."""
+    problems = []
+    if len(rep["ranks"]) < 1:
+        problems.append("no ranks in spool")
+    for r in rep["ranks"]:
+        pr = rep["per_rank"].get(str(r))
+        if pr is None:
+            problems.append(f"rank {r} missing from per_rank")
+            continue
+        if pr["step_seconds_mean"] is None:
+            problems.append(f"rank {r}: no step timing")
+    merged = rep["merged"]
+    for name, ent in merged.items():
+        if ent["kind"] == "histogram":
+            v = ent["value"]
+            if sum(v.get("buckets", {}).values()) != v.get("count"):
+                problems.append(
+                    f"merged histogram {name!r}: bucket total != count")
+        elif ent["kind"] == "gauge":
+            if len(ent.get("per_rank", {})) == 0:
+                problems.append(f"merged gauge {name!r}: no per-rank "
+                                "values retained")
+    strag = rep.get("straggler") or {}
+    if "verdict" not in strag:
+        problems.append("no straggler verdict")
+    try:
+        trace = json.loads(json.dumps(collector.stitched_trace()))
+        pids = {e.get("pid") for e in trace["traceEvents"]
+                if e.get("ph") == "X"}
+        if not pids.issuperset(set(rep["ranks"])):
+            problems.append(
+                f"stitched trace pids {sorted(pids)} do not cover "
+                f"ranks {rep['ranks']}")
+        for e in trace["traceEvents"]:
+            if e.get("ph") == "X" and ("ts" not in e or "dur" not in e):
+                problems.append("stitched X event missing ts/dur")
+                break
+    except (ValueError, KeyError) as e:
+        problems.append(f"stitched trace does not round-trip: {e}")
+    return problems
+
+
+def _print_fleet_table(rep):
+    strag = rep.get("straggler") or {}
+    flagged = set(strag.get("flagged") or [])
+    print(f"tpufleet: {len(rep['ranks'])} ranks "
+          f"(declared process_count {rep['process_count']}), "
+          f"verdict: {strag.get('verdict', '?')}")
+    hdr = (f"  {'rank':<5} {'host':<12} {'steps':>5} {'step_ms':>9} "
+           f"{'coll#':>6} {'coll_KB':>8} {'bubble%':>8}  verdict")
+    print(hdr)
+    for r in rep["ranks"]:
+        pr = rep["per_rank"][str(r)]
+        mean = pr["step_seconds_mean"]
+        bubble = pr["bubble_fraction"]
+        print(f"  {r:<5} {str(pr.get('hostname') or '-')[:12]:<12} "
+              f"{pr['steps']:>5} "
+              f"{(mean * 1e3 if mean else 0):>9.2f} "
+              f"{pr['collective_calls']:>6} "
+              f"{pr['collective_bytes'] / 1024:>8.1f} "
+              f"{(bubble * 100 if bubble is not None else 0):>8.1f}  "
+              f"{'STRAGGLER' if r in flagged else 'ok'}")
+    if rep["collectives"]:
+        parts = [f"{op} x{d.get('count', 0)} "
+                 f"({d.get('bytes', 0) / 1024:.1f} KB)"
+                 for op, d in sorted(rep["collectives"].items())]
+        print("  collectives (trace-time): " + ", ".join(parts))
+    if strag.get("hint"):
+        print(f"  hint: {strag['hint']}")
+
+
+def _fleet_report(spool, as_json, trace_path):
+    """tpustat --fleet SPOOL_DIR: merge the rank spool and report."""
+    from paddle_tpu.telemetry import fleet as tfleet
+    coll = tfleet.FleetCollector()
+    try:
+        coll.collect(spool)
+    except (OSError, ValueError) as e:
+        print(f"tpustat --fleet: {e}", file=sys.stderr)
+        return 2
+    rep = coll.report()
+    problems = _validate_fleet_report(rep, coll)
+    if trace_path:
+        with open(trace_path, "w") as f:
+            json.dump(coll.stitched_trace(), f)
+    if as_json:
+        print(json.dumps(dict(rep, problems=problems,
+                              ok=not problems), default=str))
+    else:
+        _print_fleet_table(rep)
+        if trace_path:
+            print(f"  stitched trace: {trace_path}")
+        for prob in problems:
+            print(f"MALFORMED: {prob}", file=sys.stderr)
+    return 2 if problems else 0
+
+
+def _fleet_selftest(as_json, trace_path):
+    """tpustat --fleet --selftest: spawn 2 local worker subprocesses,
+    merge their spool, validate the merged snapshot + stitched trace.
+    Exit 0 iff everything is well-formed — the tier-1 CI gate."""
+    import subprocess
+    import tempfile
+    spool = tempfile.mkdtemp(prefix="tpufleet_selftest_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("PADDLE_TPU_TELEMETRY", "PADDLE_TPU_TELEMETRY_DIR",
+              "PADDLE_TPU_FLEET_RANK", "PADDLE_TPU_FLEET_WORLD",
+              "PADDLE_TPU_FLEET_DIR", "XLA_FLAGS"):
+        env.pop(k, None)
+    me = os.path.abspath(__file__)
+    problems = []
+    logs, procs = [], []
+    for r in (0, 1):
+        log = os.path.join(spool, f"worker{r}.log")
+        logs.append(log)
+        with open(log, "w") as lf:
+            procs.append(subprocess.Popen(
+                [sys.executable, me, "--fleet-worker", str(r),
+                 "--spool", spool],
+                stdout=lf, stderr=subprocess.STDOUT, env=env,
+                cwd=_REPO))
+    for r, p in enumerate(procs):
+        try:
+            rc = p.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            rc = -9
+        if rc != 0:
+            tail = open(logs[r]).read()[-1200:]
+            problems.append(f"worker {r} rc={rc}: {tail}")
+
+    from paddle_tpu.telemetry import fleet as tfleet
+    rep, strag = {}, {}
+    if not problems:
+        coll = tfleet.FleetCollector()
+        try:
+            coll.collect(spool)
+            rep = coll.report()
+            strag = rep["straggler"]
+            problems += _validate_fleet_report(rep, coll)
+            # the selftest knows exactly what the workers did — pin it
+            if rep["ranks"] != [0, 1]:
+                problems.append(f"expected ranks [0, 1], got "
+                                f"{rep['ranks']}")
+            ar = rep["merged"].get("collective.all_reduce.count")
+            if not ar or ar["value"] != 2:
+                problems.append(
+                    f"merged collective.all_reduce.count != 2: {ar}")
+            ab = rep["merged"].get("collective.all_reduce.bytes")
+            if not ab or ab["value"] != 2 * 4 * 8 * 4:
+                problems.append(
+                    f"merged collective.all_reduce.bytes != 256: {ab}")
+            for r in (0, 1):
+                bub = rep["per_rank"][str(r)]["bubble_fraction"]
+                if bub is None or abs(bub - 0.2) > 1e-9:
+                    problems.append(
+                        f"rank {r} bubble_fraction != 0.2: {bub}")
+            if strag.get("flagged") != [1]:
+                problems.append(
+                    f"straggler detector should flag rank 1, got "
+                    f"{strag.get('flagged')}")
+            st = coll.stitched_trace()
+            if st["fleetAlignment"] != "marker":
+                problems.append(
+                    f"expected marker clock alignment, got "
+                    f"{st['fleetAlignment']}")
+            # idempotent re-merge: same spool again, same totals
+            coll.collect(spool)
+            ar2 = coll.report()["merged"]["collective.all_reduce.count"]
+            if ar2["value"] != 2:
+                problems.append(
+                    f"re-merge not idempotent: count {ar2['value']}")
+            if trace_path:
+                with open(trace_path, "w") as f:
+                    json.dump(st, f)
+        except (OSError, ValueError, KeyError) as e:
+            problems.append(f"collect/report failed: "
+                            f"{type(e).__name__}: {e}")
+
+    result = {"selftest": "fleet", "spool": spool,
+              "ranks": rep.get("ranks"),
+              "straggler": strag.get("verdict"),
+              "problems": problems, "ok": not problems}
+    if as_json:
+        print(json.dumps(result, default=str))
+    else:
+        if rep:
+            _print_fleet_table(rep)
+        for prob in problems:
+            print(f"SELFTEST FAIL: {prob}", file=sys.stderr)
+        if not problems:
+            print("fleet selftest OK")
+    return 2 if problems else 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="runtime telemetry over a benchmark model")
@@ -124,10 +397,35 @@ def main(argv=None):
                    help="run a short device trace and merge per-op "
                         "device times onto the timeline (needs a "
                         "backend whose xplane layout we can decode)")
+    p.add_argument("--fleet", nargs="?", const="", default=None,
+                   metavar="SPOOL_DIR",
+                   help="fleet mode: merge a telemetry.fleet rank "
+                        "spool and print per-rank step time, "
+                        "collective volume, bubble %%, and the "
+                        "straggler verdict (--trace writes the "
+                        "stitched multi-rank timeline)")
+    p.add_argument("--selftest", action="store_true",
+                   help="with --fleet: spawn 2 local workers, merge "
+                        "their spool, validate everything (CI gate)")
+    p.add_argument("--fleet-worker", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--spool", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     if args.platform != "env":
         os.environ["JAX_PLATFORMS"] = args.platform
+
+    if args.fleet_worker is not None:
+        return _fleet_worker(args.fleet_worker, args.spool)
+    if args.selftest and args.fleet is None:
+        p.error("--selftest is a fleet-mode flag; use --fleet "
+                "--selftest")
+    if args.fleet is not None:
+        if args.selftest:
+            return _fleet_selftest(args.as_json, args.trace)
+        if not args.fleet:
+            p.error("--fleet needs a SPOOL_DIR (or --selftest)")
+        return _fleet_report(args.fleet, args.as_json, args.trace)
 
     import numpy as np
     import paddle_tpu as fluid
