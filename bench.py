@@ -1018,7 +1018,119 @@ class _Supervisor:
         _emit(_compact_final(self.best))
 
 
+def _grad_sync_mode(steps=10, n_devices=8, mode="int8"):
+    """`bench.py --grad-sync=MODE`: A/B the gradient-sync policy layer
+    (parallel/gradsync.py) against fp32 sync on the data-parallel stage
+    — the round-4 `--flash-bf16-softmax` pattern for ROADMAP item 2.
+    Runs the MNIST-MLP DP stage over an 8-virtual-device CPU mesh (the
+    policy layer is wire-format logic; trace-time byte accounting is
+    identical on any backend), measures `collective.all_reduce.bytes`,
+    the gradsync raw/wire counters, steps/sec, and final loss per
+    policy, and prints ONE JSON line + the BENCH_gradsync.json
+    artifact. The acceptance bar: int8 cuts all-reduce bytes >= 3.5x
+    vs fp32."""
+    import __graft_entry__ as graft
+    restore = graft._force_cpu_mesh(n_devices)
+    try:
+        import jax
+        import paddle_tpu as pt
+        from paddle_tpu import layers, telemetry
+
+        def build():
+            img = layers.data("img", shape=[64])
+            label = layers.data("label", shape=[1], dtype="int64")
+            h = layers.fc(img, size=256, act="relu")
+            h = layers.fc(h, size=128, act="relu")
+            pred = layers.fc(h, size=10, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            return loss
+
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.randn(64, 64).astype("float32"),
+                "label": rng.randint(0, 10, (64, 1)).astype("int64")}
+        policies = ["fp32"] + ([mode] if mode != "fp32" else [])
+        per_policy = {}
+        was_on = telemetry.enabled()
+        for pol in policies:
+            main_p, startup_p = pt.Program(), pt.Program()
+            with pt.program_guard(main_p, startup_p):
+                with pt.unique_name.guard():
+                    loss = build()
+            main_p.random_seed = startup_p.random_seed = 7
+            scope = pt.Scope()
+            telemetry.enable()
+            telemetry.reset()
+            try:
+                with pt.scope_guard(scope):
+                    exe = pt.Executor(pt.CPUPlace())
+                    exe.run(startup_p)
+                    pexe = pt.ParallelExecutor(
+                        loss_name=loss.name, main_program=main_p,
+                        scope=scope, grad_sync=pol)
+                    last = float(np.asarray(pexe.run(
+                        feed=feed, fetch_list=[loss])[0]))  # compile
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        last = float(np.asarray(pexe.run(
+                            feed=feed, fetch_list=[loss])[0]))
+                    dt = time.perf_counter() - t0
+                snap = telemetry.snapshot()
+            finally:
+                telemetry.reset()
+                if not was_on:
+                    telemetry.disable()
+            per_policy[pol] = {
+                "all_reduce_bytes": snap.get(
+                    "collective.all_reduce.bytes", 0),
+                "all_reduce_count": snap.get(
+                    "collective.all_reduce.count", 0),
+                "gradsync_raw_bytes": snap.get("gradsync.raw_bytes", 0),
+                "gradsync_wire_bytes": snap.get("gradsync.wire_bytes",
+                                                0),
+                "gradsync_buckets": snap.get("gradsync.buckets", 0),
+                "steps_per_sec": round(steps / dt, 1),
+                "final_loss": round(last, 5),
+            }
+        a, b = per_policy["fp32"], per_policy[policies[-1]]
+        ratio = (a["all_reduce_bytes"] / b["all_reduce_bytes"]
+                 if b["all_reduce_bytes"] else None)
+        result = {
+            "metric": "grad_sync_all_reduce_bytes_ratio",
+            "value": round(ratio, 3) if ratio else 0.0,
+            "unit": "x (fp32 bytes / policy bytes)",
+            "vs_baseline": round(ratio, 3) if ratio else 0.0,
+            "platform": "cpu",
+            "grad_sync_mode": mode,
+            "n_devices": n_devices,
+            "steps": steps,
+            "per_policy": per_policy,
+            "loss_abs_delta": round(
+                abs(a["final_loss"] - b["final_loss"]), 5),
+            "pass_3p5x": bool(ratio and ratio >= 3.5),
+        }
+        try:
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_gradsync.json")
+            with open(path, "w") as f:
+                json.dump({"schema": "paddle_tpu.bench.gradsync.v1",
+                           **result}, f, indent=1)
+        except OSError:
+            pass
+        _emit(result)
+        return 0 if mode == "fp32" or result["pass_3p5x"] else 1
+    finally:
+        restore()
+
+
 def main():
+    for i, arg in enumerate(sys.argv[1:], start=1):
+        if arg.startswith("--grad-sync"):
+            _, eq, v = arg.partition("=")
+            mode = v if eq else (sys.argv[i + 1]
+                                 if len(sys.argv) > i + 1 else "int8")
+            sys.exit(_grad_sync_mode(mode=mode or "int8"))
     if os.environ.get("BENCH_CHILD"):
         _child_main()
     else:

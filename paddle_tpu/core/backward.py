@@ -12,13 +12,24 @@ __all__ = ["append_backward", "gradients"]
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
-                    callbacks=None):
+                    callbacks=None, grad_sync=None):
     """Append the backward section for `loss`; returns [(param, grad_var)].
 
     parameter_list: optional list of names/Parameters to restrict to.
     no_grad_set: names excluded from differentiation.
+    grad_sync: optional gradient-sync policy spec (parallel/gradsync.py,
+    e.g. "int8" or "bf16:bucket_mb=2") recorded as the program's
+    default — ParallelExecutor picks it up unless overridden by its own
+    grad_sync= arg or PADDLE_TPU_GRAD_SYNC. None leaves the program
+    untouched (implicit XLA all-reduce, today's behavior).
     """
     program = loss.block.program
+    if grad_sync is not None:
+        # validate eagerly so a typo surfaces at minimize() time, not
+        # at the first ParallelExecutor.run
+        from ..parallel.gradsync import parse_policy
+        parse_policy(grad_sync)
+        program._grad_sync = grad_sync
     block = program.global_block()
     no_grad = set()
     for n in (no_grad_set or ()):  # names or variables
@@ -100,12 +111,15 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             sparse_gnames.append(gname)
         sparse_specs.append({"param": p.name, "taps": taps})
 
+    attrs = {"param_names": pnames, "loss_name": loss.name,
+             "sparse_params": sparse_specs, "is_backward_op": True}
+    if grad_sync is not None:        # IR-visible policy hint only when set
+        attrs["grad_sync"] = str(grad_sync)
     block.append_op(
         type="backward_macro",
         inputs={"Loss": [loss.name]},
         outputs={"Grads": gnames + sparse_gnames},
-        attrs={"param_names": pnames, "loss_name": loss.name,
-               "sparse_params": sparse_specs, "is_backward_op": True})
+        attrs=attrs)
     program._backward_sections.append(
         {"loss": loss.name, "params": pnames + [p.name for p in sparse]})
     pairs = [(p, block.var(g)) for p, g in zip(dense, gnames)]

@@ -334,10 +334,21 @@ def _collect_sparse_deltas(program, ops):
     return out
 
 
-def build_step_fn(program, fetch_names, is_test, place):
+def build_step_fn(program, fetch_names, is_test, place,
+                  grad_transform=None):
     """Returns step(persist, feed, key) -> (fetches, new_persist).
 
-    Pure and jittable; the op list/attrs are closed over (static)."""
+    Pure and jittable; the op list/attrs are closed over (static).
+
+    grad_transform: optional hook applied at the point where data-
+    parallel gradients are summed — called as
+    `grad_transform(dense_grads, env) -> (synced_grads, extra_persist)`
+    right after jax.value_and_grad, before the optimizer tail, with the
+    dense param grads (sparse row-grads excluded) and the full env.
+    `extra_persist` entries (e.g. gradsync error-feedback residuals)
+    join new_persist even though they are not program vars. The
+    parallel gradsync policy layer threads through here; None keeps the
+    step bit-identical to before the hook existed."""
     block = program.global_block()
     ops = _prune_ops(program, list(block.ops), fetch_names)
     persist_names = [v.name for v in program.persistable_vars()]
@@ -348,6 +359,7 @@ def build_step_fn(program, fetch_names, is_test, place):
         env = {}
         env.update(feed)
         env.update(persist)
+        extra_persist = {}
         # is_sparse lookup taps: scalar zero by default (broadcasts in
         # the lookup add); the training path below overrides the ones
         # in its diff set with full-shape zeros so grads are ROW grads
@@ -411,6 +423,10 @@ def build_step_fn(program, fetch_names, is_test, place):
                         ishape + (wv.shape[-1],), wv.dtype)
                     tap_grads[tap["delta"]] = tap["grad"]
             (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(pvals)
+            if grad_transform is not None:
+                dense, extra_persist = grad_transform(
+                    {n: grads[n] for n in pnames}, env)
+                grads = dict(grads, **dense)
             for n in pnames:
                 env[grad_var_name(n)] = grads[n].astype(env[n].dtype) \
                     if hasattr(grads[n], "astype") else grads[n]
@@ -430,6 +446,7 @@ def build_step_fn(program, fetch_names, is_test, place):
                 for op, i in tail:
                     exec_op(env, op, i, key, is_test, place, block)
         new_persist = {n: env[n] for n in persist_names if n in env}
+        new_persist.update(extra_persist)
         fetches = [env[n] for n in fetch_names]
         return fetches, new_persist
 

@@ -74,8 +74,9 @@ class Optimizer:
 
     # ------------------------------------------------------------------
     def backward(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
-        return append_backward(loss, parameter_list, no_grad_set)
+                 no_grad_set=None, grad_sync=None):
+        return append_backward(loss, parameter_list, no_grad_set,
+                               grad_sync=grad_sync)
 
     def _append_sparse_optimize_op(self, block, param):
         raise NotImplementedError(
@@ -110,15 +111,20 @@ class Optimizer:
         return ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None, health=False):
+                 no_grad_set=None, health=False, grad_sync=None):
         """`health=True` (or a dict of HealthMonitor options) appends
         the training-vitals fetches (global grad norm, param norm,
         update ratio) between the backward section and the update ops —
         see diagnostics/health.py; the monitor lands on
         `self.health_monitor`. Steps that don't fetch the vitals prune
-        them away, so the option costs nothing until observed."""
+        them away, so the option costs nothing until observed.
+
+        `grad_sync` records a gradient-synchronization policy (e.g.
+        "int8", "bf16:bucket_mb=2" — parallel/gradsync.py) as the
+        program's default for ParallelExecutor; None (the default)
+        keeps the implicit XLA all-reduce."""
         params_grads = self.backward(loss, startup_program, parameter_list,
-                                     no_grad_set)
+                                     no_grad_set, grad_sync=grad_sync)
         monitor = None
         if health:
             from .diagnostics.health import HealthMonitor

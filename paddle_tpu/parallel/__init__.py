@@ -8,6 +8,8 @@ and XLA collectives over ICI — see SURVEY §2.4/§6.
 from . import mesh
 from .mesh import make_mesh, local_mesh, axis_size
 from . import collective
+from . import gradsync
+from .gradsync import GradSyncPolicy
 from . import parallel_executor
 from .parallel_executor import ParallelExecutor
 from . import transpiler

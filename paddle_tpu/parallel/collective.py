@@ -24,28 +24,37 @@ from jax import lax
 
 from .. import telemetry as _tm
 
-__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+__all__ = ["all_reduce", "all_reduce_bf16", "all_reduce_int8_blockwise",
+           "all_gather", "reduce_scatter", "broadcast",
            "all_to_all", "ppermute", "barrier", "psum", "pmean", "pmax",
-           "axis_index"]
+           "pmin", "axis_index"]
 
 
-def _traced(op, x, axis_name):
-    """Trace-time accounting for one collective call; returns the span
-    context (the shared no-op singleton when telemetry is off)."""
-    if not _tm.enabled():
-        return _tm.span(op)
-    nbytes = 0
+def _nbytes(x):
     try:
         size = 1
         for d in getattr(x, "shape", ()):
             size *= int(d)
-        nbytes = size * np.dtype(x.dtype).itemsize
+        return size * np.dtype(x.dtype).itemsize
     except Exception:
-        pass
+        return 0
+
+
+def _traced_bytes(op, nbytes, axis_name, **meta):
+    """Trace-time accounting for one collective with a known wire
+    payload; returns the span context (the shared no-op singleton when
+    telemetry is off)."""
+    if not _tm.enabled():
+        return _tm.span(op)
     _tm.counter(f"collective.{op}.count").inc()
     _tm.counter(f"collective.{op}.bytes").inc(nbytes)
     return _tm.span(f"collective.{op}", cat="collective",
-                    axis=str(axis_name), bytes=nbytes)
+                    axis=str(axis_name), bytes=nbytes, **meta)
+
+
+def _traced(op, x, axis_name):
+    return _traced_bytes(op, _nbytes(x) if _tm.enabled() else 0,
+                         axis_name)
 
 
 def all_reduce(x, op="sum", axis_name="dp"):
@@ -59,13 +68,53 @@ def all_reduce(x, op="sum", axis_name="dp"):
         if op == "min":
             return lax.pmin(x, axis_name)
         if op == "prod":
-            return jnp.exp(lax.psum(jnp.log(x), axis_name))
+            # exp(psum(log|x|)) alone NaNs on negatives and poisons the
+            # whole reduction with -inf on zeros; decompose into
+            # sign (psum of negative-counts mod 2), zero mask (pmax of
+            # is-zero), and log-magnitude psum instead
+            mag = jnp.abs(x)
+            is_zero = (mag == 0)
+            n_neg = lax.psum((x < 0).astype(jnp.int32), axis_name)
+            any_zero = lax.pmax(is_zero.astype(jnp.int32), axis_name)
+            log_mag = jnp.log(jnp.where(is_zero, 1.0, mag)
+                              .astype(jnp.float32))
+            sign = 1.0 - 2.0 * (n_neg % 2).astype(jnp.float32)
+            res = jnp.where(any_zero > 0, 0.0,
+                            sign * jnp.exp(lax.psum(log_mag, axis_name)))
+            return res.astype(x.dtype)
     raise ValueError(f"unsupported all_reduce op {op!r}")
+
+
+def all_reduce_bf16(x, axis_name="dp"):
+    """Cast-reduce-cast sum: the bf16 payload is what crosses the wire
+    (half the fp32 bytes), the result comes back in x's dtype. Lossy —
+    gradsync's bf16 policy is the intended caller."""
+    sent = x.astype(jnp.bfloat16)
+    with _traced("all_reduce", sent, axis_name):
+        return lax.psum(sent, axis_name).astype(x.dtype)
+
+
+def all_reduce_int8_blockwise(q, scales, axis_name="dp"):
+    """Blockwise-quantized all-reduce body (EQuARX-style): each member
+    contributes int8 codes `q` [n_blocks, block] with per-block fp32
+    `scales` [n_blocks, 1]; the wire carries 1 byte/element plus the
+    scale sidecar, and the sum is accumulated in fp32 after per-member
+    dequantize. Accounted under `collective.all_reduce` (it is one
+    logical all-reduce; the internal gathers stay uninstrumented so the
+    payload is not double-counted). Returns the fp32 global sum
+    [n_blocks, block]."""
+    nbytes = _nbytes(q) + _nbytes(scales)
+    with _traced_bytes("all_reduce", nbytes, axis_name,
+                       wire="int8-blockwise"):
+        qg = lax.all_gather(q, axis_name, axis=0, tiled=False)
+        sg = lax.all_gather(scales, axis_name, axis=0, tiled=False)
+        return jnp.sum(qg.astype(jnp.float32) * sg, axis=0)
 
 
 psum = lambda x, axis_name="dp": lax.psum(x, axis_name)
 pmean = lambda x, axis_name="dp": lax.pmean(x, axis_name)
 pmax = lambda x, axis_name="dp": lax.pmax(x, axis_name)
+pmin = lambda x, axis_name="dp": lax.pmin(x, axis_name)
 
 
 def all_gather(x, axis_name="dp", axis=0, tiled=True):

@@ -45,7 +45,8 @@ class ParallelExecutor:
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None,
                  build_strategy=None, num_trainers=1, trainer_id=0,
-                 scope=None, mesh=None, use_tpu=None, transpiler=None):
+                 scope=None, mesh=None, use_tpu=None, transpiler=None,
+                 grad_sync=None):
         self.program = main_program or default_main_program()
         self.loss_name = loss_name
         self.scope = scope or global_scope()
@@ -58,6 +59,25 @@ class ParallelExecutor:
         else:
             self.mesh = mesh if mesh is not None else local_mesh("dp")
             self._shardings = {}
+        # gradient-sync policy (parallel/gradsync.py): explicit arg >
+        # PADDLE_TPU_GRAD_SYNC > minimize(grad_sync=...) program hint.
+        # None keeps the implicit-XLA-all-reduce path bit-identical
+        # (zero new fetches, state, collectives, or compile-key
+        # entries — pinned by tests/test_gradsync.py).
+        from . import gradsync as _gradsync
+        self.grad_sync = _gradsync.resolve_policy(grad_sync,
+                                                  program=self.program)
+        if self.grad_sync is not None:
+            if transpiler is not None:
+                raise ValueError(
+                    "grad_sync policies require pure data parallelism; "
+                    "a DistributeTranspiler shards params/optimizer "
+                    "state, which the explicit shard_map sync path "
+                    "does not support — drop grad_sync or the "
+                    "transpiler")
+            if "dp" not in self.mesh.shape:
+                raise ValueError(
+                    "grad_sync policies need a 'dp' axis on the mesh")
         self._cache = {}
         self._step = 0
         self._replicated = NamedSharding(self.mesh, P())
@@ -124,6 +144,139 @@ class ParallelExecutor:
         return jax.make_array_from_callback(v.shape, sharding,
                                             lambda idx: v[idx])
 
+    def _gradsync_prepare(self, program, persist, persist_sh):
+        """Bucket plan + error-feedback state for the active grad_sync
+        policy. Seeds `gradsync.ef.<bucket>` residuals (zeros) in the
+        scope on first use and adds them to the persist set with dp
+        sharding, so they ride the executor's existing donate/sharding
+        path like any other state."""
+        from . import gradsync
+        policy = self.grad_sync
+        bops = [op for op in program.global_block().ops
+                if op.type == "backward_macro"]
+        if not bops:
+            return []
+        bop = bops[0]
+        if bop.attrs.get("sparse_params"):
+            raise ValueError(
+                "grad_sync policies do not support is_sparse embedding "
+                "gradients (row grads are member-local under the "
+                "explicit sync path); use dense embeddings or disable "
+                "grad_sync")
+        named = [(n, tuple(persist[n].shape), persist[n].dtype)
+                 for n in bop.attrs["param_names"]]
+        plan = gradsync.plan_buckets(named, policy.bucket_bytes,
+                                     policy.block_size)
+        dp = self.mesh.shape.get("dp", 1)
+        sh = NamedSharding(self.mesh, P("dp"))
+        for name, local_len in gradsync.state_entries(plan, policy):
+            val = self.scope.get(name)
+            if val is None or tuple(val.shape) != (dp * local_len,):
+                val = np.zeros((dp * local_len,), np.float32)
+                self.scope.set(name, val)
+            persist_sh[name] = sh
+            persist[name] = self._param_to_global(val, sh)
+        return plan
+
+    def _build_gradsync_fn(self, program, fetch_names, is_test,
+                           feed_arrays, feed_sh, persist, persist_sh,
+                           plan):
+        """The explicit-sync path: the SAME traced step runs under
+        shard_map over the dp axis (per-member local compute) and
+        gradsync.sync_gradients performs the dp reduction with
+        explicit — bucketed / quantized / overlappable — collectives.
+
+        Fetch semantics: fetches whose leading dim is the local batch
+        stay dp-sharded (reassembling to the global batch axis, exactly
+        like the implicit path); other fetches are globalized with
+        pmean for floats (exact for the batch-`mean` losses this path
+        assumes — set reduce=sum in the policy for sum losses) and psum
+        for integers (count-like fetches). Per-member RNG is
+        decorrelated by folding the dp index into the step key (the
+        reference's per-trainer seeds)."""
+        from . import gradsync
+        policy = self.grad_sync
+        mesh = self.mesh
+        dp = mesh.shape.get("dp", 1)
+
+        step = build_step_fn(
+            program, fetch_names, is_test, None,
+            grad_transform=gradsync.make_grad_transform(policy, plan,
+                                                        dp))
+
+        persist_specs = {n: persist_sh[n].spec for n in persist}
+        feed_specs = {k: feed_sh[k].spec for k in feed_arrays}
+
+        def local_aval(arr, spec):
+            shape = list(arr.shape)
+            for i, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                for nm in (ax if isinstance(ax, tuple) else (ax,)):
+                    shape[i] //= mesh.shape[nm]
+            return jax.ShapeDtypeStruct(tuple(shape), arr.dtype)
+
+        la_persist = {n: local_aval(persist[n], persist_specs[n])
+                      for n in persist}
+        la_feed = {k: local_aval(feed_arrays[k], feed_specs[k])
+                   for k in feed_arrays}
+
+        # classify fetches via an axis-free structural probe: the real
+        # transform's collectives need the dp axis bound, so eval_shape
+        # runs with a shape-preserving stand-in instead
+        ef_entries = gradsync.state_entries(plan, policy)
+
+        def probe_transform(grads, env):
+            return grads, {n: jnp.zeros((l,), jnp.float32)
+                           for n, l in ef_entries}
+
+        probe = build_step_fn(program, fetch_names, is_test, None,
+                              grad_transform=probe_transform)
+        f_avals, p_avals = jax.eval_shape(probe, la_persist, la_feed,
+                                          jax.random.PRNGKey(0))
+
+        batch_dims = set()
+        for k in feed_arrays:
+            ents = list(feed_specs[k])
+            if ents and ents[0] is not None and "dp" in (
+                    ents[0] if isinstance(ents[0], tuple)
+                    else (ents[0],)):
+                batch_dims.add(la_feed[k].shape[0])
+        fetch_specs = []
+        fetch_kind = []
+        for av in f_avals:
+            if av.ndim >= 1 and av.shape[0] in batch_dims:
+                fetch_specs.append(P(*(["dp"] + [None] * (av.ndim - 1))))
+                fetch_kind.append("batch")
+            elif jnp.issubdtype(av.dtype, jnp.floating):
+                fetch_specs.append(P())
+                fetch_kind.append("mean")
+            else:
+                fetch_specs.append(P())
+                fetch_kind.append("sum")
+        out_persist_specs = {
+            n: (P("dp") if n.startswith(gradsync.EF_PREFIX) else P())
+            for n in p_avals}
+
+        def mapped(persist_in, feed_in, key_in):
+            key_in = jax.random.fold_in(key_in,
+                                        jax.lax.axis_index("dp"))
+            fetches, new_persist = step(persist_in, feed_in, key_in)
+            out = []
+            for f, kind in zip(fetches, fetch_kind):
+                if kind == "mean":
+                    f = jax.lax.pmean(f, "dp")
+                elif kind == "sum" and f.dtype != jnp.bool_:
+                    f = jax.lax.psum(f, "dp")
+                out.append(f)
+            return out, new_persist
+
+        sm = jax.shard_map(mapped, mesh=mesh,
+                           in_specs=(persist_specs, feed_specs, P()),
+                           out_specs=(fetch_specs, out_persist_specs),
+                           check_vma=False)
+        return jax.jit(sm, donate_argnums=(0,))
+
     def run(self, fetch_list=None, feed=None, feed_dict=None,
             return_numpy=True, is_test=False):
         feed = dict(feed or feed_dict or {})
@@ -175,36 +328,54 @@ class ParallelExecutor:
             persist_sh[v.name] = sh
             persist[v.name] = self._param_to_global(val, sh)
 
+        policy = self.grad_sync
+        gs_plan = None
+        if policy is not None:
+            gs_plan = self._gradsync_prepare(program, persist, persist_sh)
+
         sig = tuple(sorted((k, v.shape, str(v.dtype))
                            for k, v in feed_arrays.items()))
         from ..core import trace as _trace
         ckey = (id(program), program._version, sig, tuple(fetch_names),
                 bool(is_test), _trace.FUSE_OPTIMIZER_TAIL,
                 _trace.FUSE_MAX_ELEMS)
+        if policy is not None:
+            # only the policy-on path may grow the compile key (the
+            # off path stays byte-for-byte the historical tuple)
+            ckey = ckey + (policy.key(),)
         fn = self._cache.get(ckey)
         if fn is None:
             if tm_on:
                 _tm.counter("pexe.compile_count").inc()
                 _tm.gauge("pexe.device_count").set(self.device_count)
-            step_fn = build_step_fn(program, fetch_names, is_test, None)
+            if policy is not None:
+                fn = self._build_gradsync_fn(
+                    program, fetch_names, is_test, feed_arrays, feed_sh,
+                    persist, persist_sh, gs_plan)
+                self._cache[ckey] = fn
+            else:
+                step_fn = build_step_fn(program, fetch_names, is_test,
+                                        None)
 
-            def wrapped(persist_in, feed_in, key_in, _step=step_fn,
-                        _sh=dict(persist_sh)):
-                fetches, new_persist = _step(persist_in, feed_in, key_in)
-                # pin state outputs to their input layout so the scope
-                # keeps genuinely sharded arrays between steps (tp/ZeRO)
-                new_persist = {
-                    n: jax.lax.with_sharding_constraint(v, _sh[n])
-                    if n in _sh else v
-                    for n, v in new_persist.items()}
-                return fetches, new_persist
+                def wrapped(persist_in, feed_in, key_in, _step=step_fn,
+                            _sh=dict(persist_sh)):
+                    fetches, new_persist = _step(persist_in, feed_in,
+                                                 key_in)
+                    # pin state outputs to their input layout so the
+                    # scope keeps genuinely sharded arrays between
+                    # steps (tp/ZeRO)
+                    new_persist = {
+                        n: jax.lax.with_sharding_constraint(v, _sh[n])
+                        if n in _sh else v
+                        for n, v in new_persist.items()}
+                    return fetches, new_persist
 
-            fn = jax.jit(
-                wrapped,
-                in_shardings=(persist_sh, dict(feed_sh),
-                              self._replicated),
-                donate_argnums=(0,))
-            self._cache[ckey] = fn
+                fn = jax.jit(
+                    wrapped,
+                    in_shardings=(persist_sh, dict(feed_sh),
+                                  self._replicated),
+                    donate_argnums=(0,))
+                self._cache[ckey] = fn
         elif tm_on:
             _tm.counter("pexe.cache_hit_count").inc()
 

@@ -574,6 +574,9 @@ class FleetCollector:
             coll_us = sum(float(s[3]) for s in env.get("spans", [])
                           if s[1] == "collective")
             bubble = m.get("pipeline.bubble_fraction")
+            gs_raw = m.get("gradsync.raw_bytes")
+            gs_wire = m.get("gradsync.wire_bytes")
+            gs_ratio = m.get("gradsync.compression_ratio")
             per_rank[str(r)] = {
                 "steps": h["count"] if h else 0,
                 "step_seconds_mean": (h["sum"] / h["count"])
@@ -583,6 +586,13 @@ class FleetCollector:
                 "collective_bytes": nbytes,
                 "collective_host_us": coll_us,
                 "bubble_fraction": bubble["value"] if bubble else None,
+                # gradient-sync policy layer (parallel/gradsync.py):
+                # raw grad bytes vs what the policy put on the wire
+                "gradsync_raw_bytes": gs_raw["value"] if gs_raw else 0,
+                "gradsync_wire_bytes": gs_wire["value"] if gs_wire
+                else 0,
+                "gradsync_ratio": gs_ratio["value"] if gs_ratio
+                else None,
                 "hostname": (env.get("host") or {}).get("hostname"),
                 "labels": env.get("labels", {}),
             }

@@ -1,0 +1,416 @@
+"""gradsync policy layer (ISSUE 6): bucketing/quantization/overlap
+levers, error feedback, executor integration on the 8-virtual-device
+CPU mesh, and the zero-overhead contract when the policy is off."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import telemetry as tm
+from paddle_tpu.parallel import collective as C
+from paddle_tpu.parallel import gradsync as gs
+from paddle_tpu.parallel.mesh import local_mesh
+from jax.sharding import PartitionSpec as P
+
+
+# ----------------------------------------------------------- policy spec
+
+def test_parse_policy_grammar():
+    assert gs.parse_policy(None) is None
+    assert gs.parse_policy("off") is None
+    assert gs.parse_policy("") is None
+    p = gs.parse_policy("int8")
+    assert p.mode == "int8" and p.error_feedback and p.overlap
+    assert p.bucket_bytes == 4 << 20 and p.block_size == 256
+    p = gs.parse_policy("bf16:bucket_mb=2,ef=1,overlap=0,reduce=sum")
+    assert (p.mode, p.bucket_bytes, p.error_feedback, p.overlap,
+            p.reduce) == ("bf16", 2 << 20, True, False, "sum")
+    p = gs.parse_policy("fp32:bucket_kb=64")
+    assert p.bucket_bytes == 64 * 1024 and not p.error_feedback
+    with pytest.raises(ValueError):
+        gs.parse_policy("fp8")
+    with pytest.raises(ValueError):
+        gs.parse_policy("int8:bogus=1")
+
+
+def test_resolve_policy_precedence(monkeypatch):
+    monkeypatch.setenv(gs.ENV_VAR, "bf16")
+    assert gs.resolve_policy(None).mode == "bf16"
+    assert gs.resolve_policy("int8").mode == "int8"      # arg beats env
+    assert gs.resolve_policy("off") is None              # explicit off
+    monkeypatch.delenv(gs.ENV_VAR)
+
+    class Prog:
+        _grad_sync = "int8:block=128"
+    assert gs.resolve_policy(None, program=Prog()).block_size == 128
+    assert gs.resolve_policy(None, program=object()) is None
+
+
+def test_minimize_records_program_hint():
+    img = layers.data("img", shape=[8])
+    loss = layers.mean(layers.fc(img, size=4))
+    pt.optimizer.SGD(0.1).minimize(loss, grad_sync="bf16")
+    prog = pt.default_main_program()
+    assert prog._grad_sync == "bf16"
+    bop = [op for op in prog.global_block().ops
+           if op.type == "backward_macro"][0]
+    assert bop.attrs["grad_sync"] == "bf16"
+    with pytest.raises(ValueError):        # typo surfaces at minimize
+        pt.optimizer.SGD(0.1).minimize(loss, grad_sync="int7")
+
+
+# ------------------------------------------------------------- buckets
+
+def test_plan_buckets_reverse_topological_and_capped():
+    named = [(f"p{i}", (256,), "float32") for i in range(8)]
+    plan = gs.plan_buckets(named, bucket_bytes=2 * 256 * 4,
+                           block_size=256)
+    assert len(plan) == 4
+    # reverse-topological: bucket 0 carries the LAST declared params
+    assert [n for n, _, _ in plan[0].entries] == ["p7", "p6"]
+    assert all(b.n_elems == 512 and b.padded == 512 for b in plan)
+
+
+def test_plan_buckets_dtype_homogeneous_and_padding():
+    named = [("a", (100,), "float32"), ("b", (100,), "bfloat16"),
+             ("c", (3, 5), "float32")]
+    plan = gs.plan_buckets(named, bucket_bytes=1 << 20, block_size=256)
+    assert [b.dtype.name for b in plan] == ["float32", "bfloat16",
+                                           "float32"]
+    assert plan[0].entries[0][0] == "c" and plan[0].padded == 256
+    # an oversized param still gets exactly one bucket of its own
+    plan = gs.plan_buckets([("big", (10000,), "float32")],
+                           bucket_bytes=1024, block_size=256)
+    assert len(plan) == 1 and plan[0].padded == 10240
+
+
+def test_int8_roundtrip_error_bound_per_block():
+    rng = np.random.RandomState(0)
+    block = 128
+    flat = jnp.asarray(rng.randn(8 * block).astype("float32") *
+                       np.repeat(10.0 ** rng.randint(-3, 3, 8), block))
+    q, scales = gs.quantize_int8_blockwise(flat, block)
+    back = gs.dequantize_int8_blockwise(q, scales)
+    err = np.abs(np.asarray(flat - back)).reshape(8, block)
+    absmax = np.abs(np.asarray(flat)).reshape(8, block).max(1)
+    # round-to-nearest with scale=absmax/127: error <= scale/2 per elem
+    bound = absmax / 127.0 / 2.0 + 1e-7
+    assert (err.max(1) <= bound).all()
+    # a zero block round-trips exactly with a unit scale
+    q0, s0 = gs.quantize_int8_blockwise(jnp.zeros(block), block)
+    assert np.asarray(s0).item() == 0.0
+    np.testing.assert_array_equal(np.asarray(q0), 0)
+
+
+# ------------------------------------------------- sync inside shard_map
+
+def _grads_fixture(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w1": jnp.asarray(rng.randn(8, 40, 7).astype("float32")),
+            "b1": jnp.asarray(rng.randn(8, 33).astype("float32")),
+            "w2": jnp.asarray(rng.randn(8, 5, 5, 3).astype("float32"))}
+
+
+def test_bucketed_fp32_exactly_matches_unbucketed():
+    grads = _grads_fixture()
+    mesh = local_mesh("dp")
+    for bucket_bytes in (1024, 1 << 20):   # many buckets vs one
+        policy = gs.GradSyncPolicy("fp32", bucket_bytes=bucket_bytes,
+                                   reduce="sum")
+
+        def f(w1, b1, w2):
+            out, _ = gs.sync_gradients(
+                {"w1": w1, "b1": b1, "w2": w2}, {}, policy, dp=8)
+            ref = {n: jax.lax.psum(v, "dp")
+                   for n, v in (("w1", w1), ("b1", b1), ("w2", w2))}
+            return [out[n] for n in ("w1", "b1", "w2")], \
+                   [ref[n] for n in ("w1", "b1", "w2")]
+
+        sm = jax.shard_map(f, mesh=mesh,
+                           in_specs=(P("dp"), P("dp"), P("dp")),
+                           out_specs=([P(None)] * 3, [P(None)] * 3),
+                           check_vma=False)
+        out, ref = sm(grads["w1"], grads["b1"], grads["w2"])
+        for a, b in zip(out, ref):
+            # bucketing is a layout change only: concat-then-psum adds
+            # in the same order as psum-per-tensor -> bitwise equal
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bf16_and_int8_sync_approximate_true_mean():
+    grads = _grads_fixture()
+    true_mean = {n: np.asarray(v).mean(0) for n, v in grads.items()}
+    for mode, tol in (("bf16", 2e-2), ("int8", 4e-2)):
+        policy = gs.GradSyncPolicy(mode, error_feedback=False)
+        mesh = local_mesh("dp")
+
+        def f(w1, b1, w2):
+            out, _ = gs.sync_gradients(
+                {"w1": w1[0], "b1": b1[0], "w2": w2[0]}, {}, policy,
+                dp=8)
+            return [out[n] for n in ("w1", "b1", "w2")]
+
+        sm = jax.shard_map(f, mesh=mesh,
+                           in_specs=(P("dp"), P("dp"), P("dp")),
+                           out_specs=[P(None)] * 3, check_vma=False)
+        out = sm(grads["w1"], grads["b1"], grads["w2"])
+        for n, a in zip(("w1", "b1", "w2"), out):
+            np.testing.assert_allclose(np.asarray(a), true_mean[n],
+                                       atol=tol)
+
+
+def test_int8_error_feedback_compensates_over_steps():
+    """With EF, the ACCUMULATED applied update stays within one
+    quantization step of the true accumulated gradient — without it,
+    the bias grows linearly."""
+    rng = np.random.RandomState(3)
+    g = jnp.asarray(rng.randn(8, 512).astype("float32") * 1e-2)
+    true_mean = np.asarray(g).mean(0)
+    mesh = local_mesh("dp")
+    steps = 20
+
+    def run(error_feedback):
+        policy = gs.GradSyncPolicy("int8",
+                                   error_feedback=error_feedback)
+        name = gs.EF_PREFIX + "0"
+
+        def f(v, st):
+            out, new_state = gs.sync_gradients(
+                {"g": v[0]}, {name: st}, policy, dp=8)
+            return out["g"], new_state.get(name, st)
+
+        sm = jax.shard_map(f, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                           out_specs=(P(None), P("dp")),
+                           check_vma=False)
+        st = jnp.zeros((8 * 512,), jnp.float32)
+        acc = np.zeros(512, np.float32)
+        for _ in range(steps):
+            synced, st = sm(g, st)
+            acc += np.asarray(synced)
+        return acc
+
+    err_ef = np.abs(run(True) - steps * true_mean).max()
+    err_no = np.abs(run(False) - steps * true_mean).max()
+    scale = np.abs(np.asarray(g)).max() / 127.0
+    assert err_ef <= 2 * scale + 1e-6, (err_ef, scale)
+    assert err_ef < err_no / 3, (err_ef, err_no)
+
+
+# ------------------------------------------------- executor integration
+
+def _build_mlp():
+    img = layers.data("img", shape=[32])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=64, act="relu")
+    pred = layers.fc(h, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _fresh_mlp(seed=7):
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        with pt.unique_name.guard():
+            loss = _build_mlp()
+    prog.random_seed = seed
+    startup.random_seed = seed
+    return prog, startup, loss
+
+
+def _feed(seed=0, B=16):
+    rng = np.random.RandomState(seed)
+    return {"img": rng.randn(B, 32).astype("float32"),
+            "label": rng.randint(0, 10, size=(B, 1)).astype("int64")}
+
+
+def _train(grad_sync, steps=4, seed=7):
+    prog, startup, loss = _fresh_mlp(seed)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                   main_program=prog, scope=scope,
+                                   grad_sync=grad_sync)
+        losses = [float(pexe.run(feed=_feed(), fetch_list=[loss])[0])
+                  for _ in range(steps)]
+    return losses, scope, pexe
+
+
+def test_pexe_fp32_policy_matches_implicit_path():
+    off, _, _ = _train(None)
+    fp32, scope, _ = _train("fp32")
+    np.testing.assert_allclose(off, fp32, rtol=1e-5)
+    assert not [k for k in scope.keys()
+                if k.startswith(gs.EF_PREFIX)]   # fp32 carries no state
+
+
+def test_pexe_int8_trains_with_persistable_ef_state():
+    losses, scope, pexe = _train("int8", steps=6)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    ef = [k for k in scope.keys() if k.startswith(gs.EF_PREFIX)]
+    assert ef, "int8+EF must persist residual state in the scope"
+    arr = scope.get(ef[0])
+    assert isinstance(arr, jax.Array)        # rode the donate path
+    assert arr.shape[0] % 8 == 0             # dp-sharded global shape
+    spec = arr.sharding.spec
+    assert tuple(spec)[:1] == ("dp",)
+    assert float(np.abs(np.asarray(arr)).max()) > 0  # residual is live
+
+
+def test_pexe_policy_telemetry_and_compression():
+    was = tm.enabled()
+    bytes_by = {}
+    try:
+        for mode in ("fp32", "int8"):
+            prog, startup, loss = _fresh_mlp()
+            scope = pt.Scope()
+            tm.enable()
+            tm.reset()
+            with pt.scope_guard(scope):
+                exe = pt.Executor(pt.CPUPlace())
+                exe.run(startup)
+                tm.reset()
+                pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                           main_program=prog,
+                                           scope=scope, grad_sync=mode)
+                pexe.run(feed=_feed(), fetch_list=[loss])
+            snap = tm.snapshot()
+            bytes_by[mode] = snap["collective.all_reduce.bytes"]
+            assert snap["gradsync.buckets"] >= 1
+            assert snap["gradsync.raw_bytes"] > 0
+            assert snap["gradsync.wire_bytes"] > 0
+            if mode == "int8":
+                assert snap["gradsync.compression_ratio"] >= 3.5
+    finally:
+        tm.reset()
+        if not was:
+            tm.disable()
+    # the acceptance bar: int8 cuts all-reduce wire bytes >= 3.5x
+    assert bytes_by["fp32"] / bytes_by["int8"] >= 3.5
+
+
+def test_pexe_rejects_transpiler_and_sparse_combos():
+    prog, startup, loss = _fresh_mlp()
+    t = pt.parallel.DistributeTranspiler(
+        pt.parallel.DistributeTranspilerConfig())
+    t.transpile(program=prog)
+    with pytest.raises(ValueError):
+        pt.ParallelExecutor(loss_name=loss.name, main_program=prog,
+                            transpiler=t, grad_sync="int8")
+
+    prog2, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(prog2, startup2):
+        with pt.unique_name.guard():
+            ids = layers.data("ids", shape=[4, 1], dtype="int64")
+            y = layers.data("y", shape=[16], dtype="float32")
+            emb = layers.embedding(ids, size=[64, 16], is_sparse=True)
+            loss2 = layers.mean(layers.square_error_cost(
+                layers.reduce_sum(emb, dim=1), y))
+            pt.optimizer.SGD(0.1).minimize(loss2)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor(pt.CPUPlace()).run(startup2)
+        pexe = pt.ParallelExecutor(loss_name=loss2.name,
+                                   main_program=prog2, scope=scope,
+                                   grad_sync="int8")
+        rng = np.random.RandomState(0)
+        with pytest.raises(ValueError):
+            pexe.run(feed={"ids": rng.randint(0, 64, (8, 4, 1))
+                           .astype("int64"),
+                           "y": rng.randn(8, 16).astype("float32")},
+                     fetch_list=[loss2])
+
+
+def test_pexe_env_var_resolution(monkeypatch):
+    monkeypatch.setenv(gs.ENV_VAR, "bf16:ef=1")
+    prog, startup, loss = _fresh_mlp()
+    pexe = pt.ParallelExecutor(loss_name=loss.name, main_program=prog)
+    assert pexe.grad_sync is not None and pexe.grad_sync.mode == "bf16"
+    # explicit "off" beats the env
+    pexe2 = pt.ParallelExecutor(loss_name=loss.name, main_program=prog,
+                                grad_sync="off")
+    assert pexe2.grad_sync is None
+
+
+# -------------------------------------------- zero-overhead contract
+
+def test_grad_sync_unset_adds_nothing(monkeypatch):
+    """Bench-contract pin (satellite): with PADDLE_TPU_GRAD_SYNC unset,
+    ParallelExecutor.run adds NO new collectives, persistable vars, or
+    compile-key entries — the same zero-overhead discipline as
+    telemetry-off."""
+    monkeypatch.delenv(gs.ENV_VAR, raising=False)
+    was = tm.enabled()
+    prog, startup, loss = _fresh_mlp()
+    scope = pt.Scope()
+    try:
+        with pt.scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup)
+            keys_before = set(scope.keys())
+            tm.enable()
+            tm.reset()
+            pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                       main_program=prog, scope=scope)
+            assert pexe.grad_sync is None
+            for _ in range(2):
+                pexe.run(feed=_feed(), fetch_list=[loss])
+            snap = tm.snapshot()
+        # no explicit collectives, no gradsync metrics
+        assert not [k for k in snap if k.startswith("collective.")], snap
+        assert not [k for k in snap if k.startswith("gradsync")], snap
+        # no new persistable state in the scope
+        assert set(scope.keys()) == keys_before
+        # the compile key stays the historical 7-tuple — no policy entry
+        (ckey,) = pexe._cache.keys()
+        assert len(ckey) == 7
+        assert not any(isinstance(el, tuple) and el
+                       and el[0] == "gradsync" for el in ckey)
+    finally:
+        tm.reset()
+        if not was:
+            tm.disable()
+
+
+# ------------------------------------------------------- convergence
+
+def test_mnist_convergence_int8_ef_matches_fp32():
+    """Small-MNIST-shaped convergence: after a fixed step count,
+    int8+error-feedback lands within tolerance of fp32 sync."""
+    steps = 30
+    rng = np.random.RandomState(1)
+    feeds = [{"img": rng.randn(16, 32).astype("float32"),
+              "label": rng.randint(0, 10, (16, 1)).astype("int64")}
+             for _ in range(8)]
+
+    def train(mode):
+        prog, startup, loss = _fresh_mlp(seed=11)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup)
+            pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                       main_program=prog, scope=scope,
+                                       grad_sync=mode)
+            first = last = None
+            for i in range(steps):
+                out = pexe.run(feed=feeds[i % len(feeds)],
+                               fetch_list=[loss])
+                last = float(out[0])
+                if first is None:
+                    first = last
+        return first, last
+
+    f32_first, f32_last = train("fp32")
+    i8_first, i8_last = train("int8")
+    assert f32_last < f32_first and i8_last < i8_first
+    assert np.isfinite(i8_last)
+    assert abs(i8_last - f32_last) <= max(0.15, 0.15 * f32_last), \
+        (f32_last, i8_last)

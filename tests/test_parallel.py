@@ -101,6 +101,56 @@ def test_collectives_shard_map():
     np.testing.assert_allclose(np.asarray(g(x))[:8], np.arange(8.0))
 
 
+def test_all_reduce_prod_handles_zero_and_negative():
+    """Regression (ISSUE 6 satellite): exp(psum(log x)) NaN'd on
+    negative members and poisoned the result with -inf-driven garbage
+    on zeros; the sign/zero-mask/log-magnitude decomposition must
+    return the true product."""
+    from paddle_tpu.parallel import collective as C
+    mesh = local_mesh("dp")
+    f = jax.shard_map(lambda v: C.all_reduce(v, "prod", "dp"),
+                      mesh=mesh, in_specs=jax.sharding.PartitionSpec("dp"),
+                      out_specs=jax.sharding.PartitionSpec("dp"),
+                      check_vma=False)
+    cases = [
+        [2.0, -3.0, 0.0, 1.5, -1.0, 4.0, -2.0, 0.5],   # zero + negatives
+        [2.0, -3.0, 5.0, 1.5, -1.0, 4.0, -2.0, 0.5],   # odd negatives
+        [2.0, 3.0, 5.0, 1.5, 1.0, 4.0, 2.0, 0.5],      # all positive
+        [-1.0] * 8,                                     # even negatives
+        [0.0] * 8,
+    ]
+    for vals in cases:
+        x = jnp.asarray(vals, jnp.float32)
+        out = np.asarray(f(x))
+        expect = float(np.prod(np.asarray(vals, np.float64)))
+        np.testing.assert_allclose(out, np.full(8, expect),
+                                   rtol=1e-5, atol=1e-6)
+        assert np.isfinite(out).all()
+    # elementwise vectors reduce per element too
+    xv = jnp.asarray(np.arange(16, dtype="float32").reshape(8, 2) - 7.0)
+    out = np.asarray(f(xv))
+    expect = np.prod(np.asarray(xv, np.float64), axis=0)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_pmin_raw_alias_exported():
+    """pmin was reachable only via all_reduce(op="min"); the raw alias
+    must exist alongside psum/pmean/pmax and be exported."""
+    from paddle_tpu.parallel import collective as C
+    assert "pmin" in C.__all__
+    mesh = local_mesh("dp")
+    x = jnp.asarray([3.0, -2.0, 7.0, 0.5, 9.0, -8.0, 1.0, 4.0])
+    f = jax.shard_map(lambda v: C.pmin(v, "dp"), mesh=mesh,
+                      in_specs=jax.sharding.PartitionSpec("dp"),
+                      out_specs=jax.sharding.PartitionSpec("dp"),
+                      check_vma=False)
+    np.testing.assert_allclose(np.asarray(f(x)), np.full(8, -8.0))
+    # gradsync rides the same module; its export is part of the wiring
+    import paddle_tpu.parallel as par
+    assert hasattr(par, "gradsync")
+    assert par.GradSyncPolicy is par.gradsync.GradSyncPolicy
+
+
 def test_transpiler_builds_plan():
     prog = pt.Program()
     startup = pt.Program()

@@ -163,6 +163,17 @@ def _fleet_worker(rank, spool):
         check_vma=False))
     np.asarray(f(jnp.ones((4, 8), jnp.float32)))
 
+    # one int8 gradient sync through the gradsync policy layer, so the
+    # fleet report's raw-vs-wire gauges have known per-rank values:
+    # 512 f32 grads -> raw 2048 B, wire 512 B codes + 2 block scales
+    # (8 B) = 520 B, ratio 2048/520
+    from paddle_tpu.parallel import gradsync
+    pol = gradsync.parse_policy("int8:ef=0")
+    g2 = jax.jit(jax.shard_map(
+        lambda v: gradsync.sync_gradients({"w": v}, {}, pol, dp=1)[0]["w"],
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+    np.asarray(g2(jnp.ones((64, 8), jnp.float32)))
+
     # pipeline bubble gauge via the same helper PipelineTrainer uses
     pipeline.record_bubble("gpipe", n_microbatch=4, n_stages=2)
 
@@ -228,18 +239,23 @@ def _print_fleet_table(rep):
           f"(declared process_count {rep['process_count']}), "
           f"verdict: {strag.get('verdict', '?')}")
     hdr = (f"  {'rank':<5} {'host':<12} {'steps':>5} {'step_ms':>9} "
-           f"{'coll#':>6} {'coll_KB':>8} {'bubble%':>8}  verdict")
+           f"{'coll#':>6} {'coll_KB':>8} {'bubble%':>8} "
+           f"{'gs_raw_KB':>10} {'gs_wire_KB':>11} {'gs_x':>6}  verdict")
     print(hdr)
     for r in rep["ranks"]:
         pr = rep["per_rank"][str(r)]
         mean = pr["step_seconds_mean"]
         bubble = pr["bubble_fraction"]
+        ratio = pr.get("gradsync_ratio")
         print(f"  {r:<5} {str(pr.get('hostname') or '-')[:12]:<12} "
               f"{pr['steps']:>5} "
               f"{(mean * 1e3 if mean else 0):>9.2f} "
               f"{pr['collective_calls']:>6} "
               f"{pr['collective_bytes'] / 1024:>8.1f} "
-              f"{(bubble * 100 if bubble is not None else 0):>8.1f}  "
+              f"{(bubble * 100 if bubble is not None else 0):>8.1f} "
+              f"{pr.get('gradsync_raw_bytes', 0) / 1024:>10.1f} "
+              f"{pr.get('gradsync_wire_bytes', 0) / 1024:>11.1f} "
+              f"{(f'{ratio:.2f}' if ratio else '-'):>6}  "
               f"{'STRAGGLER' if r in flagged else 'ok'}")
     if rep["collectives"]:
         parts = [f"{op} x{d.get('count', 0)} "
@@ -324,14 +340,44 @@ def _fleet_selftest(as_json, trace_path):
             if rep["ranks"] != [0, 1]:
                 problems.append(f"expected ranks [0, 1], got "
                                 f"{rep['ranks']}")
+            # per worker: one fp32 all_reduce (4x8 f32 = 128 B) plus
+            # one int8 gradsync all_reduce (512 codes + 2 fp32 block
+            # scales = 520 B)
             ar = rep["merged"].get("collective.all_reduce.count")
-            if not ar or ar["value"] != 2:
+            if not ar or ar["value"] != 4:
                 problems.append(
-                    f"merged collective.all_reduce.count != 2: {ar}")
+                    f"merged collective.all_reduce.count != 4: {ar}")
             ab = rep["merged"].get("collective.all_reduce.bytes")
-            if not ab or ab["value"] != 2 * 4 * 8 * 4:
+            if not ab or ab["value"] != 2 * (128 + 520):
                 problems.append(
-                    f"merged collective.all_reduce.bytes != 256: {ab}")
+                    f"merged collective.all_reduce.bytes != 1296: {ab}")
+            # gradsync gauges must merge correctly across ranks:
+            # counters sum, the per-rank compression ratio is retained
+            graw = rep["merged"].get("gradsync.raw_bytes")
+            if not graw or graw["value"] != 2 * 2048:
+                problems.append(
+                    f"merged gradsync.raw_bytes != 4096: {graw}")
+            gwire = rep["merged"].get("gradsync.wire_bytes")
+            if not gwire or gwire["value"] != 2 * 520:
+                problems.append(
+                    f"merged gradsync.wire_bytes != 1040: {gwire}")
+            gratio = rep["merged"].get("gradsync.compression_ratio")
+            expect_ratio = 2048 / 520
+            if (not gratio or gratio["kind"] != "gauge"
+                    or sorted(gratio.get("per_rank", {})) != ["0", "1"]
+                    or any(abs(v - expect_ratio) > 1e-6
+                           for v in gratio["per_rank"].values())):
+                problems.append(
+                    f"merged gradsync.compression_ratio malformed: "
+                    f"{gratio}")
+            for r in (0, 1):
+                pr = rep["per_rank"][str(r)]
+                if pr.get("gradsync_raw_bytes") != 2048 \
+                        or pr.get("gradsync_wire_bytes") != 520:
+                    problems.append(
+                        f"rank {r} gradsync raw/wire bytes wrong: "
+                        f"{pr.get('gradsync_raw_bytes')}/"
+                        f"{pr.get('gradsync_wire_bytes')}")
             for r in (0, 1):
                 bub = rep["per_rank"][str(r)]["bubble_fraction"]
                 if bub is None or abs(bub - 0.2) > 1e-9:
@@ -349,7 +395,7 @@ def _fleet_selftest(as_json, trace_path):
             # idempotent re-merge: same spool again, same totals
             coll.collect(spool)
             ar2 = coll.report()["merged"]["collective.all_reduce.count"]
-            if ar2["value"] != 2:
+            if ar2["value"] != 4:
                 problems.append(
                     f"re-merge not idempotent: count {ar2['value']}")
             if trace_path:
