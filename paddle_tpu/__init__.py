@@ -133,6 +133,7 @@ from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
                          release_memory, HashName, RoundRobin)
 from . import analysis
 from . import diagnostics
+from . import resilience
 from . import contrib
 from .async_executor import AsyncExecutor
 from .data_feed_desc import DataFeedDesc

@@ -72,6 +72,27 @@ class AsyncExecutor:
         finally:
             L.ptpu_ms_free(h)
 
+    @staticmethod
+    def _feasigns_i64(raw):
+        """Integer slot tokens -> int64, with uint64 feasigns in
+        [2^63, 2^64) BIT-CAST two's-complement (the reference's
+        uint64_t semantics) — matching native/multislot.cc exactly, so
+        the batch stream stays byte-identical whether or not the
+        native library built. Out-of-range tokens error on both
+        paths."""
+        try:
+            return np.asarray(raw, dtype=np.int64)
+        except (OverflowError, ValueError):
+            pass                      # a token >= 2^63: take the slow path
+        out = []
+        for tok in raw:
+            v = int(tok)              # re-raises ValueError on junk
+            if v >= (1 << 64) or v < -(1 << 63):
+                raise ValueError(
+                    f"feasign out of uint64/int64 range: {tok!r}")
+            out.append(v - (1 << 64) if v >= (1 << 63) else v)
+        return np.asarray(out, dtype=np.int64)
+
     def _parse_file(self, path, data_feed):
         """Yield per-sample tuples following the DataFeedDesc slots."""
         used = [s for s in data_feed.slots if s.is_used]
@@ -85,9 +106,10 @@ class AsyncExecutor:
                     raw = vals[pos:pos + n]; pos += n
                     if not s.is_used:
                         continue
-                    dt = "int64" if "int" in s.type or s.type == "uint64" \
-                        else "float32"
-                    sample.append(np.asarray(raw, dtype=dt))
+                    if "int" in s.type or s.type == "uint64":
+                        sample.append(self._feasigns_i64(raw))
+                    else:
+                        sample.append(np.asarray(raw, dtype="float32"))
                 yield tuple(sample)
 
     def run(self, program, data_feed, filelist, thread_num=1, fetch=None,

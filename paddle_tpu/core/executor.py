@@ -22,6 +22,7 @@ from .scope import global_scope
 from .trace import build_step_fn
 from .dtypes import as_jnp_dtype
 from .. import telemetry as _tm
+from ..resilience import chaos as _chaos
 
 from .scope import scope_guard  # noqa: F401  (ref executor.py re-exports it)
 
@@ -329,6 +330,13 @@ class Executor:
 
         seed = program.random_seed if program.random_seed else self._seed
         self._step += 1
+        # chaos: the executor.step injection point (step_fail:at=N
+        # raises ChaosFault / SIGKILLs mid-run — the Guardian/auto-
+        # resume acid test). One cached-bool check when disarmed.
+        if _chaos.armed():
+            _chaos.check("executor.step",
+                         detail=f"executor step {self._step - 1}",
+                         step=self._step - 1)
 
         # telemetry: one flag check on the disabled path (snapshot must
         # stay empty — pinned by tests/test_bench_contract.py); spans are

@@ -467,12 +467,43 @@ def program_from_fluid(blob):
             op.inputs = {k: list(v) for k, v in od["inputs"].items()}
             op.outputs = {k: list(v) for k, v in od["outputs"].items()}
             op.attrs = dict(od["attrs"])
+            # keep the proto-declared attr types: real Fluid stores
+            # attrs BY TYPE (Attr<int64_t> on an INT-typed attr is a
+            # bad variant get), so re-exporting must preserve the
+            # original LONG/INT distinction, not re-infer it from the
+            # Python value's magnitude
+            op.attr_types = dict(od["attr_types"])
             b.ops.append(op)
         p.blocks.append(b)
     p._bump_version()
     feed_names = [feeds[c] for c in sorted(feeds)]
     fetch_names = [fetches[c] for c in sorted(fetches)]
     return p, feed_names, fetch_names
+
+
+# ops whose reference OpMaker declares an int64 attr (AddAttr<int64_t>)
+# that Python-side building would mis-infer as INT because the value
+# fits in 32 bits (e.g. padding_idx=-1). Real Fluid's Attr<int64_t> on
+# an INT-typed attr is a bad variant get at kernel launch — the type
+# in the emitted desc must match the OpMaker declaration, not the
+# value's magnitude.
+_KNOWN_LONG_ATTRS = {
+    "lookup_table": ("padding_idx",),
+    "lookup_table_v2": ("padding_idx",),
+}
+
+
+def _attr_types_of(op):
+    """Emit-side attr types for one Operator: explicitly recorded
+    types (a program loaded from a real Fluid desc keeps them —
+    program_from_fluid) win, then the known int64 OpMaker table;
+    everything else stays None → value-based inference."""
+    types = dict(getattr(op, "attr_types", None) or {})
+    for name in _KNOWN_LONG_ATTRS.get(op.type, ()):
+        if isinstance(op.attrs.get(name), (int, np.integer)) \
+                and not isinstance(op.attrs.get(name), bool):
+            types.setdefault(name, A_LONG)
+    return types
 
 
 def program_to_fluid(program, feed_names=(), fetch_names=()):
@@ -497,7 +528,8 @@ def program_to_fluid(program, feed_names=(), fetch_names=()):
         ops = [{"type": op.type, "inputs": op.inputs,
                 "outputs": op.outputs,
                 "attrs": {k: v for k, v in op.attrs.items()
-                          if _serializable_attr(v)}}
+                          if _serializable_attr(v)},
+                "attr_types": _attr_types_of(op)}
                for op in blk.ops]
         if blk.idx == 0 and (feed_names or fetch_names):
             vars_.append({"name": "feed", "shape": [], "dtype": "float32",

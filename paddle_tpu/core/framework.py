@@ -269,6 +269,12 @@ class Program:
                 nop = Operator(nb, op.type, {}, {}, attrs)
                 nop.inputs = {k: list(v) for k, v in op.inputs.items()}
                 nop.outputs = {k: list(v) for k, v in op.outputs.items()}
+                # fluid interop: proto-declared attr types (INT vs
+                # LONG) ride clones, or a loaded-then-re-exported
+                # model would lose the distinction (fluid_proto)
+                at = getattr(op, "attr_types", None)
+                if at:
+                    nop.attr_types = dict(at)
                 nb.ops.append(nop)
             p.blocks.append(nb)
         if for_test:

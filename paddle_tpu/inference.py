@@ -173,10 +173,20 @@ class InferenceEngine:
 
     def _compile_fn(self, sig):
         """Build + cache the jitted step for `sig`; caller holds the
-        single-flight leadership for this signature."""
+        single-flight leadership for this signature. The trace/compile
+        is retried under the resilience policy: on relay-attached
+        backends a compile RPC can flake (UNAVAILABLE / deadline) —
+        transient failures (incl. the inference.compile chaos point)
+        are absorbed, real trace errors classify fatal and surface
+        unchanged."""
+        from .resilience import chaos as _chaos
+        from .resilience import retry as _retry
         if _tm.enabled():
             _tm.counter("inference.compile_count").inc()
-        with _tm.span("inference.compile", signatures=len(self._cache)):
+
+        def _build():
+            if _chaos.armed():
+                _chaos.check("inference.compile")
             step = build_step_fn(self.program, self.fetch_names,
                                  is_test=True, place=self.place)
 
@@ -185,7 +195,14 @@ class InferenceEngine:
                                   jax.random.PRNGKey(0))
                 return fetches
 
-            fn = jax.jit(infer)
+            return jax.jit(infer)
+
+        with _tm.span("inference.compile", signatures=len(self._cache)):
+            fn = _retry.call(
+                _build, name="inference.compile",
+                policy=_retry.RetryPolicy(max_attempts=3,
+                                          base_delay_s=0.1,
+                                          max_delay_s=2.0))
         self._cache[sig] = fn
         if _tm.enabled():
             _tm.gauge("inference.signature_count").set(len(self._cache))

@@ -5,14 +5,17 @@ save_persistables, save_inference_model/load_inference_model, plus
 incremental train checkpoints (program desc as JSON + params as .npz;
 layout is a directory with an npz payload + JSON manifest).
 """
+import glob
 import json
 import os
 import numpy as np
 
 from .core.framework import Program, Parameter
 from .core.scope import global_scope
+from .resilience import checkpoint as _rckpt
+from .resilience.checkpoint import CheckpointError
 
-__all__ = ["CheckpointSaver", "latest_checkpoint", 
+__all__ = ["CheckpointSaver", "latest_checkpoint", "CheckpointError",
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "save_checkpoint", "load_checkpoint",
@@ -210,19 +213,61 @@ def _load_fluid_inference_model(dirname, blob, params_filename):
 # ---------------------------------------------------------------------------
 def save_checkpoint(executor, dirname, main_program=None, step=0,
                     extra=None):
-    names = save_persistables(executor, dirname, main_program)
-    meta = {"step": int(step), "vars": names, "extra": extra or {}}
-    with open(os.path.join(dirname, META_FILE), "w") as f:
-        json.dump(meta, f)
+    """Crash-safe checkpoint: params + meta + checksum manifest are
+    written to a temp sibling, fsync'd per file, and published into
+    `dirname` by one atomic rename — a crash at any byte leaves either
+    the previous checkpoint or the new one, never a torn mix (the
+    pre-manifest writer saved in place: a crash mid-savez left a
+    checkpoint.json pointing at an unreadable npz that load_checkpoint
+    would happily open)."""
+    from .core.framework import default_main_program
+    program = main_program or default_main_program()
+    scope = global_scope()
+    arrays = _collect(program, lambda v: v.persistable, scope)
+    meta = {"step": int(step), "vars": sorted(arrays),
+            "extra": extra or {}}
+    parent = os.path.dirname(os.path.abspath(dirname)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = dirname + f".tmp.{os.getpid()}"
+    if os.path.isdir(tmp):
+        import shutil
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    _rckpt.write_payload(tmp, arrays, meta, PARAMS_FILE, META_FILE)
+    _rckpt.atomic_publish(tmp, dirname)
     return meta
+
+
+def _recovery_candidates(dirname):
+    """Crash leftovers that may hold a complete checkpoint when
+    `dirname` itself is torn/missing: the .old swap-out from
+    atomic_publish (crash between its two renames) and fully-written
+    .tmp.<pid> dirs (crash after payload, before publish)."""
+    return [dirname + ".old"] + sorted(
+        glob.glob(glob.escape(dirname) + ".tmp.*"), reverse=True)
 
 
 def load_checkpoint(executor, dirname, main_program=None):
     """Load a checkpoint dir — or, for a CheckpointSaver root holding
-    rotated checkpoint_N subdirs, the latest one."""
+    rotated checkpoint_N subdirs, the newest VALID one. Torn or
+    corrupt candidates (checksum-manifest verified) are skipped; a
+    flat dir that fails validation falls back to the writer's crash
+    leftovers before raising CheckpointError."""
     latest = latest_checkpoint(dirname)
     if latest is not None:
         dirname = latest
+    else:
+        ok, reason = _rckpt.validate(dirname)
+        if not ok:
+            for cand in _recovery_candidates(dirname):
+                if _rckpt.is_valid(cand):
+                    dirname = cand
+                    break
+            else:
+                raise CheckpointError(
+                    f"checkpoint {dirname!r} failed validation "
+                    f"({reason}) and no valid recovery candidate "
+                    "exists")
     load_persistables(executor, dirname, main_program)
     with open(os.path.join(dirname, META_FILE)) as f:
         return json.load(f)
@@ -242,14 +287,17 @@ def _list_checkpoints(root):
 
 
 def latest_checkpoint(root):
-    """Newest checkpoint_N subdir of a CheckpointSaver root, or None if
-    `root` is itself a flat checkpoint dir."""
+    """Newest VALID checkpoint_N subdir of a CheckpointSaver root
+    (torn/corrupt candidates are skipped, newest-first), or None if
+    `root` is itself a flat checkpoint dir or holds no valid
+    checkpoint."""
     if os.path.exists(os.path.join(root, META_FILE)):
         return None
-    steps = _list_checkpoints(root)
-    if not steps:
-        return None
-    return os.path.join(root, steps[-1][1])
+    for _, name in reversed(_list_checkpoints(root)):
+        path = os.path.join(root, name)
+        if _rckpt.is_valid(path):
+            return path
+    return None
 
 
 class CheckpointSaver:
@@ -278,12 +326,21 @@ class CheckpointSaver:
         self._clean_orphans()
 
     def _clean_orphans(self):
-        """Remove .tmp_checkpoint_* left by a crashed writer."""
+        """Recover from a crashed writer: drop torn .tmp_checkpoint_*
+        dirs, and resolve checkpoint_N.old swap leftovers — if the
+        crash landed between atomic_publish's two renames, the .old IS
+        the checkpoint and gets its real name back."""
         import shutil
         for name in os.listdir(self.root):
+            path = os.path.join(self.root, name)
             if name.startswith(".tmp_checkpoint_"):
-                shutil.rmtree(os.path.join(self.root, name),
-                              ignore_errors=True)
+                shutil.rmtree(path, ignore_errors=True)
+            elif name.startswith("checkpoint_") and name.endswith(".old"):
+                final = path[:-len(".old")]
+                if _rckpt.is_valid(final):
+                    shutil.rmtree(path, ignore_errors=True)
+                elif _rckpt.is_valid(path) and not os.path.exists(final):
+                    os.rename(path, final)
 
     def save(self, executor, main_program=None, step=0, extra=None):
         from .core.framework import default_main_program
@@ -319,33 +376,40 @@ class CheckpointSaver:
                 import shutil
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
-            params_path = os.path.join(tmp, PARAMS_FILE)
-            np.savez(params_path, **arrays)
-            with open(params_path, "rb+") as f:     # npz data durable
-                os.fsync(f.fileno())
-            with open(os.path.join(tmp, META_FILE), "w") as f:
-                json.dump(meta, f)
-                f.flush()
-                os.fsync(f.fileno())
-            if os.path.isdir(final):
-                import shutil
-                shutil.rmtree(final)
-            os.replace(tmp, final)
-            # make the rename itself durable before pruning older
-            # checkpoints — a crash here must leave SOME valid checkpoint
-            dfd = os.open(self.root, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+            # payload + checksum manifest, fsync'd per file; the
+            # checkpoint.write chaos point can tear the npz here —
+            # exactly like a writer killed mid-write, the torn state
+            # stays in tmp and never becomes visible
+            _rckpt.write_payload(tmp, arrays, meta, PARAMS_FILE,
+                                 META_FILE)
+            # publish atomically and make the rename durable before
+            # pruning — a crash here must leave SOME valid checkpoint
+            _rckpt.atomic_publish(tmp, final)
             self._prune()
         except Exception as e:            # surfaced on next wait()/save()
             self._error = e
 
     def _prune(self):
+        """Rotate down to max_to_keep — but NEVER delete the newest
+        valid checkpoint, even when everything newer than it is torn:
+        rotation GC must not be the thing that destroys the last
+        restore point."""
         import shutil
-        for _, name in _list_checkpoints(self.root)[:-self.max_to_keep]:
-            shutil.rmtree(os.path.join(self.root, name), ignore_errors=True)
+        entries = _list_checkpoints(self.root)
+        if len(entries) <= self.max_to_keep:
+            return
+        newest_valid = None
+        for _, name in reversed(entries):
+            if _rckpt.is_valid(os.path.join(self.root, name)):
+                newest_valid = name
+                break
+        keep = {name for _, name in entries[-self.max_to_keep:]}
+        if newest_valid is not None:
+            keep.add(newest_valid)
+        for _, name in entries:
+            if name not in keep:
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
 
     def wait(self):
         if self._thread is not None:

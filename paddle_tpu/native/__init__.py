@@ -57,7 +57,7 @@ def _configure(L):
     # (e.g. the v2 multi_reader_pop drained-sentinel change) that add no
     # new function for the per-symbol checks to trip on.
     L.ptpu_native_abi_version.restype = ctypes.c_uint64
-    if L.ptpu_native_abi_version() != 3:
+    if L.ptpu_native_abi_version() != 4:
         raise AttributeError("stale libptpu_native abi")
     L.ptpu_recordio_writer_open.restype = ctypes.c_void_p
     L.ptpu_recordio_writer_open.argtypes = [ctypes.c_char_p]

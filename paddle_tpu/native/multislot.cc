@@ -15,6 +15,7 @@
 //   ptpu_ms_error(h)                    // "" when clean
 //   ptpu_ms_free(h)
 #include <cctype>
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -77,6 +78,12 @@ void* ptpu_ms_parse(const char* path, int n_slots, const int* is_used,
   }
   std::fseek(fp, 0, SEEK_END);
   long sz = std::ftell(fp);
+  if (sz < 0) {
+    // unchecked, a -1 would wrap to a huge vector allocation below
+    f->error = std::string("cannot stat ") + path;
+    std::fclose(fp);
+    return f;
+  }
   std::fseek(fp, 0, SEEK_SET);
   // sz+1 with a NUL terminator: the strto* calls on the FINAL token
   // must not scan past the allocation when the file lacks a trailing
@@ -128,7 +135,27 @@ void* ptpu_ms_parse(const char* path, int n_slots, const int* is_used,
         if (sb.is_float) {
           sb.fvals.push_back(std::strtof(tok, &vend));
         } else {
-          sb.ivals.push_back((int64_t)std::strtoll(tok, &vend, 10));
+          // uint64 feasigns: values in [2^63, 2^64) must BIT-CAST to
+          // int64 (the reference's uint64_t feasign semantics) —
+          // strtoll would silently clamp them to INT64_MAX with
+          // endptr still at tok+len, so the malformed-token guard
+          // below never fires. Negative tokens keep signed parsing;
+          // true overflow (past uint64/int64 range) is an error, as
+          // in the python fallback path.
+          errno = 0;
+          int64_t v;
+          if (*tok == '-') {
+            v = (int64_t)std::strtoll(tok, &vend, 10);
+          } else {
+            v = (int64_t)std::strtoull(tok, &vend, 10);
+          }
+          if (errno == ERANGE && vend == tok + len) {
+            f->error = "line " + std::to_string(line_no) + ": slot " +
+                       std::to_string(s) + " value out of uint64 "
+                       "range '" + std::string(tok, len) + "'";
+            return f;
+          }
+          sb.ivals.push_back(v);
         }
         if (vend != tok + len) {
           f->error = "line " + std::to_string(line_no) + ": slot " +

@@ -144,7 +144,8 @@ extern "C" {
 // sentinel moved from -3 to INT64_MIN). The Python loader configures
 // this symbol; a stale .so missing it (or any symbol) raises
 // AttributeError and triggers a delete-and-rebuild.
-uint64_t ptpu_native_abi_version() { return 3; }
+// v4: multislot uint64 feasign bit-cast + ftell error check
+uint64_t ptpu_native_abi_version() { return 4; }
 
 void* ptpu_multi_reader_open(const char** paths, uint32_t n_paths,
                              uint32_t n_threads, uint32_t capacity) {

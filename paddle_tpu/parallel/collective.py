@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import telemetry as _tm
+from ..resilience import chaos as _chaos
 
 __all__ = ["all_reduce", "all_reduce_bf16", "all_reduce_int8_blockwise",
            "all_gather", "reduce_scatter", "broadcast",
@@ -43,7 +44,13 @@ def _nbytes(x):
 def _traced_bytes(op, nbytes, axis_name, **meta):
     """Trace-time accounting for one collective with a known wire
     payload; returns the span context (the shared no-op singleton when
-    telemetry is off)."""
+    telemetry is off). Also the `collective` chaos point: like the
+    telemetry, injection is host-side at issuance/trace time —
+    collective_fail raises a transient the surrounding retry/Guardian
+    layer must absorb, collective_delay sleeps (late-rank
+    simulation)."""
+    if _chaos.armed():
+        _chaos.check("collective", detail=f"collective {op}", op=op)
     if not _tm.enabled():
         return _tm.span(op)
     _tm.counter(f"collective.{op}.count").inc()

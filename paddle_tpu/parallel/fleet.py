@@ -7,6 +7,8 @@ jax.distributed world.
 import jax
 
 from .. import telemetry as _tm
+from ..resilience import chaos as _chaos
+from ..resilience import retry as _retry
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 
 __all__ = ["init", "distributed_optimizer", "worker_num", "worker_index",
@@ -14,14 +16,26 @@ __all__ = ["init", "distributed_optimizer", "worker_num", "worker_index",
 
 _state = {"initialized": False, "transpiler": None}
 
+# gang bring-up races the other hosts' process start; barriers race
+# transient coordinator/DCN flake — both are the canonical retryable
+# seams (the reference's grpc pserver channels retried the same way)
+_INIT_POLICY = _retry.RetryPolicy(max_attempts=3, base_delay_s=1.0,
+                                  max_delay_s=10.0, deadline_s=120.0)
+_BARRIER_POLICY = _retry.RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                                     max_delay_s=2.0)
+
 
 def init(role_maker=None, coordinator_address=None, num_processes=None,
          process_id=None):
     """Single-host: no-op. Multi-host: jax.distributed.initialize — after
-    which jax.devices() spans the pod and the SAME mesh code works."""
+    which jax.devices() spans the pod and the SAME mesh code works.
+    Bring-up is retried under _INIT_POLICY: hosts of a gang start at
+    different times, and the first connect losing the race is routine,
+    not fatal."""
     if coordinator_address is not None:
-        jax.distributed.initialize(coordinator_address, num_processes,
-                                   process_id)
+        _retry.call(jax.distributed.initialize, coordinator_address,
+                    num_processes, process_id,
+                    policy=_INIT_POLICY, name="fleet.init")
     _state["initialized"] = True
     # fleet observability: from here on every metric/span this process
     # exports carries its rank (registry default-labels hook; zero cost
@@ -56,7 +70,12 @@ def barrier_all():
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    with _tm.span("fleet.barrier_all", cat="fleet"):
+
+    def _barrier_once():
+        # fleet.barrier chaos point INSIDE the retried callable, so an
+        # injected transient (barrier_fail:at=N,times=K) exercises the
+        # same absorb-and-retry path a real coordinator flake takes
+        _chaos.check("fleet.barrier")
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("fleet_barrier_all")
@@ -69,6 +88,10 @@ def barrier_all():
                               out_specs=P()),
                 in_shardings=NamedSharding(mesh, P("all")))
             jax.block_until_ready(f(jnp.ones(len(devs))))
+
+    with _tm.span("fleet.barrier_all", cat="fleet"):
+        _retry.call(_barrier_once, policy=_BARRIER_POLICY,
+                    name="fleet.barrier")
     if _tm.enabled():
         _tm.counter("fleet.barriers").inc()
         _tm.fleet.mark_clock()

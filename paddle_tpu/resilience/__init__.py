@@ -1,0 +1,46 @@
+"""paddle_tpu.resilience — the fault-tolerance layer (tpuchaos).
+
+Every prior subsystem made the stack faster or more observable; this
+one makes it survive failures, in four pieces:
+
+  checkpoint   crash-safe write primitives: write-to-temp + fsync +
+               atomic rename + per-file SHA-256 manifest, and the
+               validator io.latest_checkpoint uses to skip torn or
+               corrupt candidates.
+  retry        deadline + exponential-backoff-with-jitter policy
+               engine with typed Retryable/Fatal classification,
+               wrapped around fleet init/barrier, spool I/O, and
+               inference compile (resilience.retry.* counters).
+  liveness     heartbeat-staleness dead-rank detection on the PR-5
+               fleet snapshot spool (fleet.liveness.* gauges, typed
+               FleetFault) — silence becomes an attributable fault
+               before the next collective hangs.
+  guardian     the auto-resume training-loop wrapper: on crash or
+               NanInfError, restore the newest valid checkpoint and
+               resume with a bounded restart budget.
+  chaos        the deterministic fault-injection harness that proves
+               all of the above: PADDLE_TPU_CHAOS="fault[:k=v,...]"
+               injects seeded faults at named points (torn checkpoint
+               write, dropped spool flush, failed collective,
+               exception/SIGKILL at step N). tools/tpuchaos.py is the
+               CLI; tests/test_resilience.py the suite.
+
+With PADDLE_TPU_CHAOS and every resilience knob unset, the hot path is
+bit-identical and zero-overhead (pinned by the bench-contract test,
+same discipline as telemetry/diagnostics/gradsync).
+"""
+from . import chaos
+from . import checkpoint
+from . import liveness
+from . import retry
+from .chaos import ChaosFault, TransientChaosFault
+from .checkpoint import CheckpointError
+from .guardian import Guardian, RestartBudgetExceeded, run_with_recovery
+from .liveness import FleetFault, check_liveness, assert_alive
+from .retry import Retryable, Fatal, RetryError, RetryPolicy
+
+__all__ = ["chaos", "checkpoint", "liveness", "retry",
+           "ChaosFault", "TransientChaosFault", "CheckpointError",
+           "Guardian", "RestartBudgetExceeded", "run_with_recovery",
+           "FleetFault", "check_liveness", "assert_alive",
+           "Retryable", "Fatal", "RetryError", "RetryPolicy"]

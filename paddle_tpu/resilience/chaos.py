@@ -1,0 +1,284 @@
+"""chaos — deterministic fault injection at named runtime points.
+
+Fault tolerance that has never seen a fault is a hypothesis, not a
+feature. This module lets a test (or an operator on a staging pod)
+inject *seeded, reproducible* failures at the exact seams the
+resilience layer is supposed to survive — the moral equivalent of the
+reference's distributed-training kill tests, but in-process and
+deterministic enough for CI.
+
+Spec grammar (mirrors gradsync's ``mode[:k=v,...]``), multiple faults
+joined by ``;`` in the ``PADDLE_TPU_CHAOS`` env var::
+
+    PADDLE_TPU_CHAOS="step_fail:at=5"
+    PADDLE_TPU_CHAOS="ckpt_torn:byte=128"
+    PADDLE_TPU_CHAOS="step_fail:at=7,mode=kill;spool_drop:every=2"
+
+Faults and their injection points:
+
+  ``step_fail:at=N[,times=K][,mode=raise|kill]``
+      point ``executor.step`` — raise ChaosFault (or SIGKILL the
+      process with mode=kill) on the N-th executor step hook hit.
+  ``ckpt_torn:byte=B[,at=N]``
+      point ``checkpoint.write`` — on the N-th (default 1st)
+      checkpoint payload write, truncate the file at byte B and raise,
+      simulating a writer killed mid-write.
+  ``spool_drop:at=N[,times=K] | every=K | prob=P[,seed=S]``
+      point ``fleet.spool`` — silently drop this rank's snapshot
+      flush (the spool goes stale; liveness must notice).
+  ``collective_fail:at=N[,times=K][,op=NAME]``
+      point ``collective`` — raise TransientChaosFault when the op is
+      issued/traced host-side (retry-classified as transient).
+  ``collective_delay:ms=M[,at=N][,every=K][,op=NAME]``
+      point ``collective`` — host-side sleep before issuing the op
+      (straggler/late-rank simulation).
+  ``compile_fail:at=N[,times=K]``
+      point ``inference.compile`` — transient compile failure (the
+      retry engine should absorb ``times`` consecutive ones).
+  ``barrier_fail:at=N[,times=K]``
+      point ``fleet.barrier`` — transient barrier failure.
+  ``worker_crash:at=N[,times=K]``
+      point ``serving.worker`` — kill a ModelServer worker thread
+      (the server must restart it; see serving.worker_restarts).
+
+Counting: every point keeps a process-wide hit counter (1-based).
+``at=N`` fires on hit N; ``times=K`` keeps firing through hit N+K-1;
+``every=K`` fires on every K-th hit; ``prob=P,seed=S`` draws from a
+dedicated ``random.Random(seed)`` stream per fault — same seed, same
+faults, every run. All counters live behind one lock.
+
+Cost contract: with ``PADDLE_TPU_CHAOS`` unset, the only thing a hot
+path pays is one ``armed()`` call returning a cached False — pinned by
+tests/test_bench_contract.py alongside telemetry/diagnostics.
+"""
+import os
+import random
+import threading
+import time
+
+from .retry import Retryable as _Retryable
+
+__all__ = ["ChaosFault", "TransientChaosFault", "ChaosSpecError",
+           "armed", "configure", "reset", "hit", "check", "enact",
+           "spec", "ENV_VAR", "POINTS"]
+
+ENV_VAR = "PADDLE_TPU_CHAOS"
+
+# fault name -> injection point it binds to
+POINTS = {
+    "step_fail": "executor.step",
+    "ckpt_torn": "checkpoint.write",
+    "spool_drop": "fleet.spool",
+    "collective_fail": "collective",
+    "collective_delay": "collective",
+    "compile_fail": "inference.compile",
+    "barrier_fail": "fleet.barrier",
+    "worker_crash": "serving.worker",
+}
+
+_INT_KNOBS = ("at", "times", "every", "byte", "seed", "step")
+_FLOAT_KNOBS = ("prob", "ms")
+
+
+class ChaosSpecError(ValueError):
+    """Malformed PADDLE_TPU_CHAOS spec."""
+
+
+class ChaosFault(RuntimeError):
+    """An injected fault. Carries the fault record that fired."""
+
+    def __init__(self, fault, detail=""):
+        self.fault = dict(fault)
+        name = fault.get("name", "?")
+        msg = f"injected chaos fault {name!r}"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class TransientChaosFault(ChaosFault, _Retryable):
+    """An injected fault the retry engine classifies as retryable
+    (transient infrastructure flake simulation) — Retryable by
+    inheritance, so the default policy classifier absorbs it."""
+
+
+_lock = threading.Lock()
+_armed = None          # None = env not read yet; False/True after
+_faults = []           # parsed fault dicts
+_hits = {}             # point -> hit counter
+_fired = 0             # total faults fired (introspection/selftest)
+
+
+def _parse_fault(text):
+    head, _, tail = text.partition(":")
+    name = head.strip()
+    if name not in POINTS:
+        raise ChaosSpecError(
+            f"unknown chaos fault {name!r} (known: {sorted(POINTS)})")
+    fault = {"name": name, "point": POINTS[name]}
+    if tail.strip():
+        for item in tail.split(","):
+            k, sep, v = item.partition("=")
+            k, v = k.strip(), v.strip()
+            if not sep or not k:
+                raise ChaosSpecError(
+                    f"chaos fault {name}: malformed knob {item!r} "
+                    "(want k=v)")
+            if k in _INT_KNOBS:
+                fault[k] = int(v)
+            elif k in _FLOAT_KNOBS:
+                fault[k] = float(v)
+            elif k == "mode":
+                if v not in ("raise", "kill"):
+                    raise ChaosSpecError(
+                        f"chaos fault {name}: mode={v!r} not in "
+                        "('raise', 'kill')")
+                fault[k] = v
+            elif k == "op":
+                fault[k] = v
+            else:
+                raise ChaosSpecError(
+                    f"chaos fault {name}: unknown knob {k!r}")
+    if "step" in fault and "at" not in fault:   # step= is an alias
+        fault["at"] = fault.pop("step")
+    if name == "ckpt_torn" and "byte" not in fault:
+        raise ChaosSpecError("ckpt_torn needs byte=B")
+    if name == "collective_delay" and "ms" not in fault:
+        raise ChaosSpecError("collective_delay needs ms=M")
+    if "prob" in fault:
+        p = fault["prob"]
+        if not 0.0 <= p <= 1.0:
+            raise ChaosSpecError(f"{name}: prob={p} outside [0, 1]")
+        fault["_rng"] = random.Random(fault.get("seed", 0))
+    elif not any(k in fault for k in ("at", "every")):
+        fault["at"] = 1          # bare fault: fire on the first hit
+    return fault
+
+
+def parse_spec(text):
+    """Parse a full spec string into fault dicts (no global state)."""
+    faults = []
+    for part in (text or "").split(";"):
+        part = part.strip()
+        if part:
+            faults.append(_parse_fault(part))
+    return faults
+
+
+def configure(spec_text):
+    """Install a chaos spec programmatically (tests / tools). Passing
+    None or "" disarms. Returns the parsed fault list."""
+    global _armed, _faults
+    with _lock:
+        _faults = parse_spec(spec_text or "")
+        _armed = bool(_faults)
+        _hits.clear()
+    return list(_faults)
+
+
+def reset():
+    """Disarm and forget everything, including the env cache — the
+    next armed() re-reads PADDLE_TPU_CHAOS."""
+    global _armed, _faults, _fired
+    with _lock:
+        _armed = None
+        _faults = []
+        _hits.clear()
+        _fired = 0
+
+
+def _load_env():
+    global _armed, _faults
+    with _lock:
+        if _armed is not None:
+            return
+        _faults = parse_spec(os.environ.get(ENV_VAR, ""))
+        _armed = bool(_faults)
+
+
+def armed():
+    """True when any fault is configured. The ONE check hot paths pay;
+    caches the env parse after the first call."""
+    if _armed is None:
+        _load_env()
+    return _armed
+
+
+def spec():
+    """The active fault list (parsed dicts; RNG state elided)."""
+    if _armed is None:
+        _load_env()
+    return [{k: v for k, v in f.items() if not k.startswith("_")}
+            for f in _faults]
+
+
+def fired_count():
+    return _fired
+
+
+def _matches(fault, n):
+    """Does the fault fire on ITS n-th matching hit? (Counters are
+    per-fault, advanced only on hits that pass the op filter — so
+    `at=2,op=all_gather` means the 2nd all_gather, not the 2nd
+    collective of any kind.)"""
+    if "prob" in fault:
+        return fault["_rng"].random() < fault["prob"]
+    if "every" in fault:
+        return n % fault["every"] == 0
+    at = fault.get("at", 1)
+    return at <= n < at + fault.get("times", 1)
+
+
+def hit(point, **ctx):
+    """Record a hit on `point`; return the fault dict that fires here
+    (None for the overwhelmingly common no-fault case). Callers enact
+    point-specific behavior themselves or via enact()."""
+    global _fired
+    if not armed():
+        return None
+    with _lock:
+        _hits[point] = _hits.get(point, 0) + 1
+        fired = None
+        for f in _faults:
+            if f["point"] != point:
+                continue
+            if f.get("op") is not None and ctx.get("op") != f["op"]:
+                continue
+            n = f["_n"] = f.get("_n", 0) + 1
+            if fired is None and _matches(f, n):
+                fired = f
+        if fired is None:
+            return None
+        _fired += 1
+    from .. import telemetry as _tm
+    if _tm.enabled():
+        _tm.counter("chaos.injected").inc()
+        _tm.counter(f"chaos.injected.{fired['name']}").inc()
+    return fired
+
+
+def check(point, detail="", **ctx):
+    """hit() + enact() in one call, for sites with no site-specific
+    handling. Costs one cached-bool test when disarmed."""
+    if not armed():
+        return
+    fault = hit(point, **ctx)
+    if fault is not None:
+        enact(fault, detail or point)
+
+
+def enact(fault, detail=""):
+    """Default enactment for a fired fault: SIGKILL for mode=kill
+    (the crash-safety acid test — no cleanup handlers run), transient
+    exception for the *_fail transients, ChaosFault otherwise.
+    collective_delay sleeps and returns."""
+    name = fault["name"]
+    if name == "collective_delay":
+        time.sleep(fault["ms"] / 1000.0)
+        return
+    if fault.get("mode") == "kill":
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    if name in ("collective_fail", "compile_fail", "barrier_fail"):
+        raise TransientChaosFault(fault, detail)
+    raise ChaosFault(fault, detail)

@@ -1,0 +1,194 @@
+"""Crash-safe checkpoint primitives: fsync'd writes, atomic publish,
+and a per-file checksum manifest.
+
+The failure model (TensorFlow paper §4.2, and two decades of pserver
+lore): the writer can die at ANY byte — mid-params, mid-meta, between
+files, before or after the rename. The invariants the io.py callers
+build on:
+
+1. A checkpoint becomes visible only via `os.replace` of a fully
+   written, fully fsync'd temp directory — readers never see a partial
+   write at the published path.
+2. Every published checkpoint carries `checkpoint.manifest.json`
+   listing each payload file's byte size and SHA-256. `validate()`
+   re-hashes; any torn/corrupt/missing file makes the candidate
+   invalid, and io.latest_checkpoint falls back to the next newest
+   valid one.
+3. The manifest is ADDITIVE — a pre-manifest reader (np.load +
+   json.load of the same files) still loads these checkpoints, and
+   manifest-less legacy dirs still validate via a structural check
+   (pinned by the bench-contract forward-compat test).
+
+Chaos: the payload writer consults the `checkpoint.write` injection
+point; `ckpt_torn:byte=B` truncates the params file at byte B and
+raises — exactly what a SIGKILL mid-write leaves behind.
+"""
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from . import chaos as _chaos
+
+__all__ = ["MANIFEST_FILE", "CheckpointError", "sha256_file",
+           "fsync_file", "fsync_dir", "write_payload", "write_manifest",
+           "validate", "is_valid", "atomic_publish"]
+
+MANIFEST_FILE = "checkpoint.manifest.json"
+MANIFEST_SCHEMA = "paddle_tpu.checkpoint.manifest.v1"
+
+_CHUNK = 1 << 20
+
+
+class CheckpointError(IOError):
+    """A checkpoint directory failed validation / could not be read."""
+
+
+def sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            blk = f.read(_CHUNK)
+            if not blk:
+                break
+            h.update(blk)
+    return h.hexdigest()
+
+
+def fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path):
+    """Make directory entries (renames, creates) durable."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_manifest(dirname, extra_meta=None):
+    """Hash every regular file in `dirname` (except the manifest
+    itself) into checkpoint.manifest.json, written atomically and
+    fsync'd LAST — its presence asserts the rest of the directory."""
+    files = {}
+    for name in sorted(os.listdir(dirname)):
+        path = os.path.join(dirname, name)
+        if name == MANIFEST_FILE or not os.path.isfile(path):
+            continue
+        files[name] = {"bytes": os.path.getsize(path),
+                       "sha256": sha256_file(path)}
+    manifest = {"schema": MANIFEST_SCHEMA, "files": files}
+    if extra_meta:
+        manifest.update(extra_meta)
+    tmp = os.path.join(dirname, MANIFEST_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dirname, MANIFEST_FILE))
+    fsync_dir(dirname)
+    return manifest
+
+
+def write_payload(dirname, arrays, meta, params_file, meta_file):
+    """Write a checkpoint payload (params npz + meta json + manifest)
+    into `dirname` with per-file fsync. The caller owns making
+    `dirname` visible atomically (atomic_publish). Honors the
+    `checkpoint.write` chaos point: a fired ckpt_torn fault truncates
+    the params file at the configured byte and raises ChaosFault,
+    simulating a writer killed mid-write."""
+    params_path = os.path.join(dirname, params_file)
+    np.savez(params_path, **arrays)
+    fault = _chaos.hit("checkpoint.write") if _chaos.armed() else None
+    if fault is not None and fault["name"] == "ckpt_torn":
+        size = os.path.getsize(params_path)
+        cut = max(0, min(int(fault["byte"]), size))
+        with open(params_path, "rb+") as f:
+            f.truncate(cut)
+        raise _chaos.ChaosFault(
+            fault, f"checkpoint params torn at byte {cut}/{size}")
+    fsync_file(params_path)
+    meta_path = os.path.join(dirname, meta_file)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    write_manifest(dirname, extra_meta={"step": meta.get("step")})
+
+
+def atomic_publish(tmp, final):
+    """`tmp` (complete, fsync'd) becomes `final` in one rename, durable
+    before return. An existing `final` is swapped out via a sibling
+    .old name so no crash window ever leaves BOTH destroyed: either the
+    old checkpoint still validates, or the new one does."""
+    root = os.path.dirname(os.path.abspath(final)) or "."
+    old = final + ".old"
+    if os.path.isdir(old):
+        import shutil
+        shutil.rmtree(old)
+    if os.path.isdir(final):
+        os.rename(final, old)
+    os.replace(tmp, final)
+    fsync_dir(root)
+    if os.path.isdir(old):
+        import shutil
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def validate(dirname, params_file="params.npz", meta_file="checkpoint.json"):
+    """(ok, reason) for a checkpoint directory. With a manifest: every
+    listed file must exist with matching size and SHA-256, and the
+    params/meta files must be listed. Without one (legacy dir): the
+    meta must parse and the npz must open and enumerate — catches
+    truncation (the zip central directory lives at EOF) though not
+    mid-file bit rot, which is exactly why new writes carry the
+    manifest."""
+    if not os.path.isdir(dirname):
+        return False, "not a directory"
+    mpath = os.path.join(dirname, MANIFEST_FILE)
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (ValueError, OSError) as e:
+            return False, f"unreadable manifest: {e}"
+        files = manifest.get("files", {})
+        for want in (params_file, meta_file):
+            if want not in files:
+                return False, f"manifest does not list {want}"
+        for name, rec in files.items():
+            path = os.path.join(dirname, name)
+            if not os.path.isfile(path):
+                return False, f"missing file {name}"
+            if os.path.getsize(path) != rec.get("bytes"):
+                return False, (f"{name}: size {os.path.getsize(path)} "
+                               f"!= manifest {rec.get('bytes')} (torn "
+                               "write)")
+            if sha256_file(path) != rec.get("sha256"):
+                return False, f"{name}: checksum mismatch (corrupt)"
+        return True, "ok"
+    # legacy (pre-manifest) checkpoint: structural check only
+    meta_path = os.path.join(dirname, meta_file)
+    params_path = os.path.join(dirname, params_file)
+    try:
+        with open(meta_path) as f:
+            json.load(f)
+    except (ValueError, OSError) as e:
+        return False, f"unreadable meta: {e}"
+    try:
+        with np.load(params_path, allow_pickle=False) as data:
+            _ = list(data.files)
+    except Exception as e:                 # zipfile raises several types
+        return False, f"unreadable params: {type(e).__name__}: {e}"
+    return True, "ok (legacy, no manifest)"
+
+
+def is_valid(dirname, **kw):
+    return validate(dirname, **kw)[0]

@@ -1,0 +1,195 @@
+"""Guardian — the auto-resume training-loop wrapper.
+
+The reference's production posture (TensorFlow paper §4.2, the
+pserver/trainer heritage) is that workers die mid-job and the JOB
+survives: periodic consistent checkpoints + automatic
+restart-and-restore. Guardian is that posture for the paddle_tpu step
+loop:
+
+    guardian = Guardian(exe, main_program, root="ckpts",
+                        startup_program=startup_p, save_every=10)
+    result = guardian.run_with_recovery(step_fn, steps=200)
+
+- `step_fn(step)` runs ONE training step (an Executor.run call plus
+  whatever bookkeeping the caller wants) and returns its fetches.
+- Guardian checkpoints every `save_every` completed steps through the
+  crash-safe io.CheckpointSaver (temp + fsync + rename + checksum
+  manifest), so there is ALWAYS a valid restore point.
+- On a recoverable failure — NanInfError from the PR-4 numerics
+  doctor, an injected ChaosFault, FloatingPointError — it restores the
+  newest VALID checkpoint and resumes from the step after it, burning
+  one unit of a bounded restart budget (`max_restarts`); exhausting
+  the budget raises RestartBudgetExceeded from the last failure.
+- Across PROCESS death (kill -9): a fresh process that builds the same
+  Guardian auto-restores at entry — `run_with_recovery` always starts
+  from the newest valid checkpoint when one exists, which is what
+  makes `tools/tpuchaos.py`'s killed run reach the same loss as the
+  uninterrupted one.
+
+Determinism note: resumption replays steps from restored state, so a
+run interrupted at step K and a straight-through run match exactly
+when `step_fn` is a pure function of (state, step) — feed your data by
+step index (rng seeded per step), not from an exhausted-once iterator.
+
+Telemetry: `resilience.guardian.restarts` / `.restores` counters and
+`resilience.guardian.resume_step` gauge, plus spans around restore.
+"""
+import logging
+
+from .. import telemetry as _tm
+from . import chaos as _chaos
+
+__all__ = ["Guardian", "RestartBudgetExceeded", "run_with_recovery"]
+
+_LOG = logging.getLogger("paddle_tpu.resilience")
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """The bounded restart budget ran out; __cause__ is the last
+    failure."""
+
+    def __init__(self, restarts, budget):
+        self.restarts = restarts
+        self.budget = budget
+        super().__init__(
+            f"guardian: {restarts} restart(s) exhausted the budget of "
+            f"{budget} — failing over to the operator")
+
+
+def _default_recoverable():
+    from ..diagnostics import NanInfError
+    return (NanInfError, _chaos.ChaosFault, FloatingPointError)
+
+
+class Guardian:
+    """Crash-safe training supervisor (see module docstring)."""
+
+    def __init__(self, executor, program, root, startup_program=None,
+                 scope=None, save_every=25, max_to_keep=3,
+                 max_restarts=3, recoverable=None, saver=None,
+                 extra_meta=None):
+        from ..io import CheckpointSaver
+        self.executor = executor
+        self.program = program
+        self.startup_program = startup_program
+        self.root = root
+        self.scope = scope
+        self.save_every = max(1, int(save_every))
+        self.max_restarts = int(max_restarts)
+        self.recoverable = tuple(recoverable) if recoverable is not None \
+            else _default_recoverable()
+        self.saver = saver or CheckpointSaver(root,
+                                              max_to_keep=max_to_keep)
+        self.extra_meta = extra_meta or {}
+        self.restarts = 0
+        self.restore_count = 0
+        self.last_failure = None
+
+    # ------------------------------------------------------ checkpoints
+    def save(self, step):
+        """Checkpoint completed step `step` (meta.step == step means
+        "resume at step + 1")."""
+        return self.saver.save(self.executor, self.program, step=step,
+                               extra=dict(self.extra_meta))
+
+    def _checkpoint_durable(self, step):
+        """save + drain: the restore point is DURABLE before training
+        proceeds past it — a SIGKILL one step later must still find
+        it (the async saver alone only promises eventual publish). A
+        failed write is logged and counted, not fatal: training
+        continues on the previous restore point."""
+        try:
+            self.save(step)
+            self.saver.wait()
+        except (RuntimeError, OSError) as e:
+            if _tm.enabled():
+                _tm.counter("resilience.guardian.save_failures").inc()
+            _LOG.warning(
+                "guardian: checkpoint at step %d failed (%s) — "
+                "training continues on the previous restore point",
+                step, e)
+
+    def restore(self):
+        """Restore the newest VALID checkpoint; returns the step to
+        resume AT (meta.step + 1), or None when no valid checkpoint
+        exists. A pending async save is drained first (its failure is
+        demoted to a log line — the older checkpoint is the restore
+        point either way)."""
+        from .. import io as _io
+        try:
+            self.saver.wait()
+        except RuntimeError as e:
+            _LOG.warning("guardian: in-flight checkpoint write failed "
+                         "(%s); restoring an older checkpoint", e)
+        latest = _io.latest_checkpoint(self.root)
+        if latest is None:
+            return None
+        with _tm.span("resilience.guardian.restore", path=latest):
+            meta = _io.load_checkpoint(self.executor, latest,
+                                       self.program)
+        self.restore_count += 1
+        resume_at = int(meta.get("step", -1)) + 1
+        if _tm.enabled():
+            _tm.counter("resilience.guardian.restores").inc()
+            _tm.gauge("resilience.guardian.resume_step").set(resume_at)
+        _LOG.warning("guardian: restored %s (resuming at step %d)",
+                     latest, resume_at)
+        return resume_at
+
+    def _cold_start(self):
+        """No checkpoint to restore: (re)initialize training state."""
+        if self.startup_program is not None:
+            self.executor.run(self.startup_program, feed={},
+                              fetch_list=[], scope=self.scope)
+        return 0
+
+    # ------------------------------------------------------------- loop
+    def run_with_recovery(self, step_fn, steps, start_step=0):
+        """Drive `step_fn(step)` for step in [start_step, steps),
+        checkpointing every save_every completed steps and
+        restoring+resuming on recoverable failures (bounded by
+        max_restarts). Returns the last step_fn result. A final
+        checkpoint is written at the end so a follow-up run is a no-op
+        resume."""
+        resumed = self.restore()
+        if resumed is None:
+            step = self._cold_start() or start_step
+        else:
+            step = max(resumed, start_step)
+        last = None
+        while step < steps:
+            try:
+                last = step_fn(step)
+            except self.recoverable as e:
+                self.last_failure = e
+                self.restarts += 1
+                if _tm.enabled():
+                    _tm.counter("resilience.guardian.restarts").inc()
+                if self.restarts > self.max_restarts:
+                    raise RestartBudgetExceeded(
+                        self.restarts - 1, self.max_restarts) from e
+                _LOG.warning(
+                    "guardian: step %d failed (%s: %s) — restart "
+                    "%d/%d", step, type(e).__name__, e, self.restarts,
+                    self.max_restarts)
+                resumed = self.restore()
+                if resumed is None:
+                    step = self._cold_start() or start_step
+                else:
+                    step = resumed
+                continue
+            step += 1
+            if step % self.save_every == 0:
+                self._checkpoint_durable(step - 1)
+        # terminal checkpoint: resume-after-completion is a no-op
+        if steps > 0 and steps % self.save_every != 0:
+            self._checkpoint_durable(steps - 1)
+        self.saver.wait()
+        return last
+
+
+def run_with_recovery(step_fn, steps, executor, program, root,
+                      **guardian_kw):
+    """Functional convenience over Guardian (one-shot jobs, tools)."""
+    g = Guardian(executor, program, root, **guardian_kw)
+    return g.run_with_recovery(step_fn, steps)

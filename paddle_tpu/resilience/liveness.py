@@ -1,0 +1,144 @@
+"""Rank liveness: heartbeat-staleness dead-rank detection on the
+fleet snapshot spool.
+
+PR 5 gave every rank a periodically-flushed `rank*.snap.json` envelope
+(telemetry.fleet.on_step). That spool doubles as a heartbeat stream:
+each envelope carries `flush_unix_us`, and the file's mtime moves on
+every flush. A rank that dies — OOM, preemption, kernel panic — just
+goes silent; nothing in the gang errors until the next collective
+hangs. This module turns silence into a typed, attributable fault
+*before* the hang:
+
+- `heartbeat_ages(spool)` — seconds since each rank's last flush
+  (max of envelope timestamp and file mtime, so a clock-skewed writer
+  doesn't look dead).
+- `check_liveness(spool, stale_after_s, expected_world)` — full
+  report: alive/stale ranks, missing ranks (never spooled), ages.
+  Publishes `fleet.liveness.alive` / `.dead` / `.missing` /
+  `.max_age_seconds` gauges.
+- `assert_alive(...)` — raises `FleetFault` naming the dead rank(s),
+  the analog of the reference pserver's barrier-timeout kick-out.
+
+The detector is a pure spool reader: it runs on the coordinator (or
+any rank) with no collective of its own, so it works precisely when
+collectives don't.
+"""
+import glob
+import json
+import os
+import re
+import time
+
+from .. import telemetry as _tm
+
+__all__ = ["FleetFault", "heartbeat_ages", "check_liveness",
+           "assert_alive", "DEFAULT_STALE_AFTER_S"]
+
+# 3x the default spool flush interval (PADDLE_TPU_FLEET_FLUSH_S=30):
+# one missed flush is scheduling noise, three is a dead rank
+DEFAULT_STALE_AFTER_S = 90.0
+
+_RANK_RE = re.compile(r"rank(\d+)\.snap\.json$")
+
+
+class FleetFault(RuntimeError):
+    """A rank-level fleet failure (dead/missing rank). Carries the
+    offending ranks and the liveness report."""
+
+    def __init__(self, msg, ranks=(), report=None):
+        self.ranks = list(ranks)
+        self.report = report
+        super().__init__(msg)
+
+
+def heartbeat_ages(spool, now_unix=None):
+    """{rank: age_seconds} from the spool. Age is measured against the
+    freshest evidence of life: the envelope's flush_unix_us stamp or
+    the file mtime, whichever is newer."""
+    now = time.time() if now_unix is None else now_unix
+    ages = {}
+    for path in sorted(glob.glob(os.path.join(spool, "rank*.snap.json"))):
+        m = _RANK_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        rank = int(m.group(1))
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue                     # racing a rewrite; skip
+        last = mtime
+        try:
+            with open(path) as f:
+                env = json.load(f)
+            stamp = env.get("flush_unix_us")
+            if stamp is not None:
+                last = max(last, float(stamp) / 1e6)
+        except (ValueError, OSError):
+            pass                         # torn snapshot: mtime still counts
+        ages[rank] = max(0.0, now - last)
+    return ages
+
+
+def check_liveness(spool, stale_after_s=DEFAULT_STALE_AFTER_S,
+                   expected_world=None, now_unix=None):
+    """Liveness report for a spool. `expected_world` (rank count) turns
+    never-seen ranks into `missing`; without it only spooled ranks are
+    judged. Publishes fleet.liveness.* gauges when telemetry is on."""
+    ages = heartbeat_ages(spool, now_unix=now_unix)
+    dead = sorted(r for r, a in ages.items() if a > stale_after_s)
+    alive = sorted(r for r in ages if r not in dead)
+    missing = []
+    if expected_world:
+        missing = sorted(set(range(int(expected_world))) - set(ages))
+    report = {
+        "spool": spool,
+        "stale_after_s": stale_after_s,
+        "ages_seconds": {str(r): round(a, 3)
+                         for r, a in sorted(ages.items())},
+        "alive": alive,
+        "dead": dead,
+        "missing": missing,
+        "ok": not dead and not missing,
+    }
+    if dead or missing:
+        whom = []
+        if dead:
+            whom.append("stale rank" + ("s " if len(dead) > 1 else " ")
+                        + ", ".join(str(r) for r in dead)
+                        + f" (no heartbeat for > {stale_after_s:.0f}s)")
+        if missing:
+            whom.append("missing rank"
+                        + ("s " if len(missing) > 1 else " ")
+                        + ", ".join(str(r) for r in missing)
+                        + " (never spooled)")
+        report["verdict"] = "; ".join(whom)
+        report["hint"] = (
+            "a silent rank usually means OOM-kill, preemption, or a "
+            "wedged input pipeline on that host — check the flight "
+            "recorder dump and host logs for the rank above, then "
+            "resume from the last valid checkpoint (Guardian does "
+            "this automatically)")
+    else:
+        report["verdict"] = "all ranks alive"
+    if _tm.enabled():
+        _tm.gauge("fleet.liveness.alive").set(len(alive))
+        _tm.gauge("fleet.liveness.dead").set(len(dead))
+        _tm.gauge("fleet.liveness.missing").set(len(missing))
+        if ages:
+            _tm.gauge("fleet.liveness.max_age_seconds").set(
+                max(ages.values()))
+    return report
+
+
+def assert_alive(spool, stale_after_s=DEFAULT_STALE_AFTER_S,
+                 expected_world=None, now_unix=None):
+    """check_liveness that raises FleetFault on any dead/missing rank.
+    Returns the (healthy) report otherwise."""
+    report = check_liveness(spool, stale_after_s=stale_after_s,
+                            expected_world=expected_world,
+                            now_unix=now_unix)
+    if not report["ok"]:
+        raise FleetFault(report["verdict"],
+                         ranks=report["dead"] + report["missing"],
+                         report=report)
+    return report

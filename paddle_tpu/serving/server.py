@@ -11,6 +11,7 @@ real request — traffic never eats a compile stall, and the selftest
 gate "compile_count <= bucket count" follows from serving only ever
 presenting bucket-shaped batches.
 """
+import logging
 import threading
 import time
 
@@ -18,7 +19,10 @@ import numpy as np
 
 from .. import telemetry as _tm
 from ..inference import InferenceEngine
+from ..resilience import chaos as _chaos
 from .batcher import (BatchConfig, DynamicBatcher, ServerClosed)
+
+_LOG = logging.getLogger("paddle_tpu.serving")
 
 __all__ = ["ModelRegistry", "ModelServer", "ServerConfig"]
 
@@ -75,7 +79,8 @@ class ModelRegistry:
 class _Served:
     """One (name, version)'s batcher + workers."""
 
-    __slots__ = ("name", "version", "engine", "batcher", "threads")
+    __slots__ = ("name", "version", "engine", "batcher", "threads",
+                 "restarts")
 
     def __init__(self, name, version, engine, batch_config):
         self.name = name
@@ -84,6 +89,7 @@ class _Served:
         self.batcher = DynamicBatcher(batch_config,
                                       name=f"{name}/{version}")
         self.threads = []
+        self.restarts = 0       # crashed-worker respawns (observability)
 
 
 class ModelServer:
@@ -117,11 +123,7 @@ class ModelServer:
         if self.config.warmup:
             self.warmup(name, version)
         for i in range(self.config.workers):
-            t = threading.Thread(
-                target=self._worker, args=(served,),
-                name=f"tpuserve-{name}/{version}-{i}", daemon=True)
-            t.start()
-            served.threads.append(t)
+            self._spawn_worker(served, i)
         return version
 
     def warmup(self, name, version=None):
@@ -160,6 +162,12 @@ class ModelServer:
     def healthy(self):
         return not self._stopping
 
+    @property
+    def worker_restarts(self):
+        """Total crashed-worker respawns across all served models."""
+        with self._lock:
+            return sum(s.restarts for s in self._served.values())
+
     # --------------------------------------------------------- serving
     def submit(self, name, feed, version=None, deadline_ms=None):
         """Async path: returns (Future, version)."""
@@ -186,6 +194,33 @@ class ModelServer:
         return outs
 
     # ---------------------------------------------------------- worker
+    def _spawn_worker(self, served, idx):
+        t = threading.Thread(
+            target=self._worker_guarded, args=(served, idx),
+            name=f"tpuserve-{served.name}/{served.version}-{idx}",
+            daemon=True)
+        t.start()
+        served.threads.append(t)
+
+    def _worker_guarded(self, served, idx):
+        """Supervisor shell: a worker that dies to anything but a
+        clean drain is respawned, so a single thread crash degrades
+        one batch instead of silently losing 1/N of the model's
+        serving capacity forever. Respawns are counted in
+        serving.worker_restarts (surfaced in /metrics)."""
+        try:
+            self._worker(served)
+        except BaseException as e:          # noqa: BLE001 — thread death
+            if self._stopping:
+                return
+            served.restarts += 1
+            if _tm.enabled():
+                _tm.counter("serving.worker_restarts").inc()
+            _LOG.warning(
+                "tpuserve worker %s/%s-%d died (%s: %s) — restarting",
+                served.name, served.version, idx, type(e).__name__, e)
+            self._spawn_worker(served, idx)
+
     def _worker(self, served):
         batcher = served.batcher
         while True:
@@ -194,7 +229,21 @@ class ModelServer:
                 if batcher.closed and batcher.pending() == 0:
                     return
                 continue
-            self._run_batch(served, batch)
+            try:
+                # chaos serving.worker point: counted per dequeued
+                # batch (deterministic), not per idle poll (timing)
+                if _chaos.armed():
+                    _chaos.check(
+                        "serving.worker",
+                        detail=f"worker {served.name}/{served.version}")
+                self._run_batch(served, batch)
+            except Exception as e:
+                # per-batch errors are handled inside _run_batch; an
+                # exception HERE is worker-fatal (e.g. injected crash):
+                # fail the in-flight batch so callers see an error
+                # instead of a deadline hang, then die -> respawned
+                batch.fail(e)
+                raise
 
     def _run_batch(self, served, batch):
         batch.drop_expired()

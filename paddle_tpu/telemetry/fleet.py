@@ -70,6 +70,23 @@ ENV_FLUSH_S = "PADDLE_TPU_FLEET_FLUSH_S"
 
 _MAX_SPANS_PER_SNAPSHOT = 20_000
 _DEFAULT_FLUSH_S = 30.0
+
+
+_spool_retry_policy = None
+
+
+def _spool_policy():
+    """Lazily built RetryPolicy for spool writes (resilience imports
+    telemetry, so the policy can't be built at this module's import
+    time)."""
+    global _spool_retry_policy
+    if _spool_retry_policy is None:
+        from ..resilience.retry import RetryPolicy
+        _spool_retry_policy = RetryPolicy(max_attempts=3,
+                                          base_delay_s=0.05,
+                                          max_delay_s=0.5,
+                                          deadline_s=5.0)
+    return _spool_retry_policy
 _DEFAULT_K_MAD = 3.0
 _RATIO_FALLBACK = 1.5
 
@@ -230,6 +247,12 @@ def on_step(dt=None):
         write_rank_snapshot()
     except OSError:
         pass
+    except RuntimeError as e:
+        # retry engine exhausted on a persistently failing spool: the
+        # heartbeat is lost but the step loop must not die for it
+        from ..resilience.retry import RetryError
+        if not isinstance(e, RetryError):
+            raise
 
 
 def _host_info():
@@ -279,10 +302,24 @@ def write_rank_snapshot(spool=None, rank_override=None):
     env = build_envelope(rank_override)
     os.makedirs(spool, exist_ok=True)
     path = os.path.join(spool, f"rank{env['rank']:05d}.snap.json")
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(env, f, default=str)
-    os.replace(tmp, path)
+    # chaos: the fleet.spool injection point — a fired spool_drop
+    # swallows this flush, the spool goes stale, and the liveness
+    # detector (resilience.liveness) must be what notices
+    from ..resilience import chaos as _chaos
+    if _chaos.armed() and _chaos.hit("fleet.spool",
+                                     rank=env["rank"]) is not None:
+        return None
+
+    def _put():
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(env, f, default=str)
+        os.replace(tmp, path)
+
+    # spool I/O rides shared filesystems that flake; retry briefly
+    # rather than losing the heartbeat to one EAGAIN
+    from ..resilience import retry as _retry
+    _retry.call(_put, policy=_spool_policy(), name="fleet.spool")
     with _lock:
         _state["last_flush"] = time.monotonic()
     return path

@@ -81,10 +81,12 @@ def test_telemetry_off_cached_fast_path():
     from paddle_tpu import layers
     from paddle_tpu import telemetry as tm
     from paddle_tpu.diagnostics import recorder as flight
+    from paddle_tpu.resilience import chaos
 
     tm.disable()
     tm.reset()
     flight.disable()
+    chaos.reset()                 # re-reads the (unset) PADDLE_TPU_CHAOS
     img = layers.data("img", shape=[8])
     out = layers.reduce_mean(layers.fc(img, size=4))
     exe = pt.Executor(pt.CPUPlace())
@@ -104,7 +106,42 @@ def test_telemetry_off_cached_fast_path():
         "diagnostics-off run snapshotted donated state"
     assert flight.active() is None
     assert exe.last_numerics_report is None
+    # resilience-off contract (PR-7 tpuchaos): with PADDLE_TPU_CHAOS
+    # unset the harness stays disarmed — no faults counted, no
+    # resilience.* metrics, nothing injected into the 100 cached runs
+    assert chaos.armed() is False, "chaos armed with env unset"
+    assert chaos.fired_count() == 0
     assert dt < 20.0, f"100 cached steps took {dt:.1f}s (bound 20s)"
+
+
+def test_resilience_off_checkpoint_forward_compatible(tmp_path):
+    """save_checkpoint's crash-safe rewrite must stay readable by the
+    PRE-PR reader (np.load of params.npz + json.load of
+    checkpoint.json — no manifest knowledge), and with all resilience
+    env unset a save adds exactly one extra file (the additive
+    checksum manifest) next to the two the old writer produced."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    img = layers.data("imgfc", shape=[4])
+    layers.fc(img, size=3, param_attr=pt.ParamAttr(name="fcw"))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    d = str(tmp_path / "ck")
+    meta = pt.io.save_checkpoint(exe, d, step=9)
+    assert sorted(os.listdir(d)) == ["checkpoint.json",
+                                     "checkpoint.manifest.json",
+                                     "params.npz"]
+    # the pre-PR reader: direct np.load + json.load, nothing else
+    with open(os.path.join(d, "checkpoint.json")) as f:
+        old_meta = json.load(f)
+    assert old_meta == meta
+    with np.load(os.path.join(d, "params.npz"),
+                 allow_pickle=False) as data:
+        assert "fcw" in data.files
+        np.testing.assert_array_equal(
+            data["fcw"], np.asarray(pt.global_scope().get("fcw")))
 
 
 def test_telemetry_artifact_helper(tmp_path):

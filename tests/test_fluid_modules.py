@@ -544,6 +544,58 @@ def test_async_executor_native_parser_matches_python(tmp_path):
         assert off == vals.shape[0]
 
 
+def test_async_executor_uint64_feasigns_bitcast_both_paths(tmp_path):
+    """ADVICE r5 regression: uint64 feasigns >= 2^63 must BIT-CAST to
+    int64 two's-complement on BOTH parse paths (the reference's
+    uint64_t semantics). The native parser used strtoll, silently
+    clamping to INT64_MAX with the endptr guard never firing, while
+    the python path raised OverflowError — breaking the documented
+    'batch stream is byte-identical whether or not the native library
+    built' guarantee for large sparse ids. Tokens past uint64 range
+    must error on both paths."""
+    import paddle_tpu.async_executor as ax
+    from paddle_tpu import native as pt_native
+
+    big = [2 ** 63, 2 ** 64 - 1, 2 ** 63 + 12345, 7, 0]
+    want = np.array([v - (1 << 64) if v >= (1 << 63) else v
+                     for v in big], dtype=np.int64)
+    data_path = os.path.join(tmp_path, "part-0")
+    with open(data_path, "w") as f:
+        f.write(f"{len(big)} " + " ".join(str(v) for v in big)
+                + " 1 1\n")
+    proto_path = os.path.join(tmp_path, "data.proto")
+    with open(proto_path, "w") as f:
+        f.write('name: "MultiSlotDataFeed"\nbatch_size: 2\n'
+                'multi_slot_desc {\n'
+                '  slots { name: "ids" type: "uint64" is_dense: false '
+                'is_used: true }\n'
+                '  slots { name: "lab" type: "int64" is_dense: true '
+                'is_used: true }\n}\n')
+    feed = pt.DataFeedDesc(proto_path)
+    ae = pt.AsyncExecutor()
+
+    (py_ids, py_lab), = list(ae._parse_file(data_path, feed))
+    assert py_ids.dtype == np.int64
+    np.testing.assert_array_equal(py_ids, want)
+
+    if pt_native.lib() is not None:
+        samples, slot_data = ae._parse_file_native(data_path, feed)
+        assert samples == 1
+        vals, lens = slot_data[0]
+        assert lens[0] == len(big)
+        np.testing.assert_array_equal(vals, want)
+
+    # out-of-uint64-range errors on both paths (no silent wrap)
+    bad_path = os.path.join(tmp_path, "part-bad")
+    with open(bad_path, "w") as f:
+        f.write(f"1 {2 ** 64} 1 0\n")
+    with pytest.raises(ValueError):
+        list(ae._parse_file(bad_path, feed))
+    if pt_native.lib() is not None:
+        with pytest.raises(ValueError):
+            ae._parse_file_native(bad_path, feed)
+
+
 def test_async_executor_batch_stream_native_vs_python(tmp_path):
     """The batch stream must be identical whether the native parser
     engaged or not — partial batches carry across files in both paths

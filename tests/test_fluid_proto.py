@@ -349,3 +349,67 @@ def test_fluid_combined_params_sorted_by_name(tmp_path):
     got = np.asarray(pt.Executor().run(prog, feed={"img": x},
                                        fetch_list=fetch_vars)[0])
     np.testing.assert_allclose(got, ref_out, atol=1e-6)
+
+
+def test_int64_attr_type_fidelity_roundtrip():
+    """Interop regression (ADVICE r5): the reference declares some op
+    attrs AddAttr<int64_t> (e.g. lookup_table's padding_idx=-1); real
+    Fluid stores attrs BY DECLARED TYPE, so an exported desc carrying
+    them as INT fails (bad variant get) under the reference executor.
+    Emit must type them LONG even though the value fits int32, a
+    parsed LONG must survive re-export byte-for-byte, and the
+    distinction must ride Program.clone (the inference-export
+    pruner)."""
+    # 1) hand-built desc with an explicit LONG attr, magnitude < 2^31
+    desc = {"blocks": [{
+        "idx": 0, "parent_idx": -1, "forward_block_idx": -1,
+        "vars": [{"name": "W", "shape": [10, 4], "dtype": "float32",
+                  "persistable": True, "lod_level": 0,
+                  "type": fpr.VT_LOD_TENSOR},
+                 {"name": "ids", "shape": [-1, 1], "dtype": "int64",
+                  "persistable": False, "lod_level": 0,
+                  "type": fpr.VT_LOD_TENSOR},
+                 {"name": "emb", "shape": [-1, 4], "dtype": "float32",
+                  "persistable": False, "lod_level": 0,
+                  "type": fpr.VT_LOD_TENSOR}],
+        "ops": [{"type": "lookup_table",
+                 "inputs": {"W": ["W"], "Ids": ["ids"]},
+                 "outputs": {"Out": ["emb"]},
+                 "attrs": {"padding_idx": -1, "is_sparse": False},
+                 "attr_types": {"padding_idx": fpr.A_LONG,
+                                "is_sparse": fpr.A_BOOLEAN}}],
+    }], "version": 0}
+    blob = fpr.emit_program_desc(desc)
+    parsed = fpr.parse_program_desc(blob)
+    op = parsed["blocks"][0]["ops"][0]
+    assert op["attrs"]["padding_idx"] == -1
+    assert op["attr_types"]["padding_idx"] == fpr.A_LONG
+
+    # 2) load -> Program keeps the declared types -> re-export keeps
+    # LONG (this round-tripped as INT before attr_types were threaded)
+    prog, _feeds, _fetches = fpr.program_from_fluid(blob)
+    lt = prog.global_block().ops[0]
+    assert lt.attr_types["padding_idx"] == fpr.A_LONG
+    re_blob = fpr.program_to_fluid(prog)
+    re_op = [o for b in fpr.parse_program_desc(re_blob)["blocks"]
+             for o in b["ops"] if o["type"] == "lookup_table"][0]
+    assert re_op["attr_types"]["padding_idx"] == fpr.A_LONG
+    assert re_op["attrs"]["padding_idx"] == -1
+    # sibling attrs keep their own declared types
+    assert re_op["attr_types"]["is_sparse"] == fpr.A_BOOLEAN
+
+    # 3) clone preserves the declared types
+    cl = prog.clone()
+    assert cl.global_block().ops[0].attr_types["padding_idx"] \
+        == fpr.A_LONG
+
+    # 4) natively-built programs: the known-OpMaker table types
+    # padding_idx LONG even with no explicit attr_types anywhere
+    _fresh()
+    ids = layers.data("ids2", shape=[1], dtype="int64")
+    emb = layers.embedding(ids, size=(10, 4))
+    native = fpr.program_to_fluid(
+        emb.block.program, feed_names=["ids2"], fetch_names=[emb.name])
+    nat_op = [o for b in fpr.parse_program_desc(native)["blocks"]
+              for o in b["ops"] if o["type"] == "lookup_table"][0]
+    assert nat_op["attr_types"]["padding_idx"] == fpr.A_LONG

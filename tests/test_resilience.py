@@ -1,0 +1,548 @@
+"""paddle_tpu.resilience: retry backoff/deadline/classification
+bounds, the chaos spec grammar and its determinism, torn-write
+checkpoint recovery (property-style over byte-boundary classes),
+rotation GC's last-valid guarantee, Guardian crash auto-resume
+(in-process fault AND a real kill -9 subprocess), dead-rank liveness
+on a stale spool, and the tools/tpuchaos.py --selftest subprocess CI
+gate."""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import telemetry as tm
+from paddle_tpu.io import CheckpointSaver, latest_checkpoint
+from paddle_tpu.resilience import (ChaosFault, CheckpointError,
+                                   FleetFault, Guardian,
+                                   RestartBudgetExceeded, chaos,
+                                   checkpoint, liveness, retry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TPUCHAOS = os.path.join(REPO, "tools", "tpuchaos.py")
+
+
+@pytest.fixture(autouse=True)
+def _disarmed_chaos():
+    """Every test starts and ends with chaos disarmed and telemetry
+    clean (the bench contract asserts an empty global registry)."""
+    chaos.reset()
+    tm.disable()
+    tm.reset()
+    yield
+    chaos.reset()
+    tm.disable()
+    tm.reset()
+
+
+# ------------------------------------------------------------- retry
+
+def test_retry_backoff_timing_bounds():
+    """Deterministic (jitter=0) backoff is exactly base * mult^k,
+    capped at max_delay; jittered delays stay inside the documented
+    [1-j, 1+j] envelope. No real sleeping — delays are recorded."""
+    delays = []
+    pol = retry.RetryPolicy(max_attempts=5, base_delay_s=0.05,
+                            multiplier=2.0, max_delay_s=0.15,
+                            jitter=0.0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 5:
+            raise retry.Retryable("flake")
+        return "ok"
+
+    assert retry.call(flaky, policy=pol, sleep=delays.append) == "ok"
+    assert delays == [0.05, 0.1, 0.15, 0.15]     # capped at max_delay
+
+    jittered = []
+    pol_j = retry.RetryPolicy(max_attempts=4, base_delay_s=0.1,
+                              multiplier=1.0, jitter=0.5)
+    calls["n"] = 0
+
+    def always():
+        raise retry.Retryable("flake")
+
+    with pytest.raises(retry.RetryError):
+        retry.call(always, policy=pol_j, sleep=jittered.append)
+    assert len(jittered) == 3
+    for d in jittered:
+        assert 0.05 - 1e-9 <= d <= 0.15 + 1e-9, jittered
+
+
+def test_retry_deadline_cuts_off():
+    """A retry never starts past the deadline: with a fake clock the
+    engine gives up as soon as elapsed + next_delay exceeds it."""
+    clock = {"t": 0.0}
+
+    def fake_sleep(d):
+        clock["t"] += d
+
+    pol = retry.RetryPolicy(max_attempts=100, base_delay_s=1.0,
+                            multiplier=1.0, jitter=0.0, deadline_s=3.5)
+
+    def always():
+        raise retry.Retryable("flake")
+
+    with pytest.raises(retry.RetryError) as ei:
+        retry.call(always, policy=pol, sleep=fake_sleep,
+                   clock=lambda: clock["t"])
+    assert "deadline" in str(ei.value)
+    assert clock["t"] <= 3.5                     # slept 3x, stopped
+
+
+def test_retry_classification():
+    """Fatal/real bugs surface unchanged on the first failure;
+    transient-smelling and typed-Retryable errors retry; counters
+    track attempts/retries/giveups."""
+    pol = retry.RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                            jitter=0.0)
+
+    def bug():
+        raise ValueError("off-by-one")           # not transient
+
+    with pytest.raises(ValueError):
+        retry.call(bug, policy=pol, sleep=lambda d: None)
+
+    def fatal():
+        raise retry.Fatal("stop now")
+
+    with pytest.raises(retry.Fatal):
+        retry.call(fatal, policy=pol, sleep=lambda d: None)
+
+    tm.enable()
+    tm.reset()
+    calls = {"n": 0}
+
+    def transport():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("connection reset by peer")
+        return 7
+
+    assert retry.call(transport, policy=pol, sleep=lambda d: None) == 7
+    snap = tm.snapshot()
+    assert snap["resilience.retry.attempts"] == 3
+    assert snap["resilience.retry.retries"] == 2
+
+
+# ------------------------------------------------------------- chaos
+
+def test_chaos_spec_grammar():
+    faults = chaos.parse_spec(
+        "step_fail:at=5,times=2,mode=kill;ckpt_torn:byte=128;"
+        "collective_delay:ms=10,every=3,op=all_reduce")
+    assert [f["name"] for f in faults] == ["step_fail", "ckpt_torn",
+                                          "collective_delay"]
+    assert faults[0] == {"name": "step_fail", "point": "executor.step",
+                         "at": 5, "times": 2, "mode": "kill"}
+    assert faults[2]["ms"] == 10.0 and faults[2]["op"] == "all_reduce"
+    for bad in ("nonsense:at=1", "step_fail:at", "step_fail:mode=boom",
+                "ckpt_torn", "collective_delay:at=1",
+                "spool_drop:prob=1.5"):
+        with pytest.raises(chaos.ChaosSpecError):
+            chaos.parse_spec(bad)
+    # unset env => disarmed, zero faults
+    assert chaos.parse_spec("") == []
+
+
+def test_chaos_counting_is_deterministic():
+    chaos.configure("spool_drop:prob=0.5,seed=7")
+    pattern1 = [chaos.hit("fleet.spool") is not None
+                for _ in range(32)]
+    chaos.configure("spool_drop:prob=0.5,seed=7")
+    pattern2 = [chaos.hit("fleet.spool") is not None
+                for _ in range(32)]
+    assert pattern1 == pattern2 and any(pattern1) \
+        and not all(pattern1)
+    # ops filter: a fault bound to one op ignores others
+    chaos.configure("collective_fail:at=1,op=all_gather")
+    assert chaos.hit("collective", op="all_reduce") is None
+    assert chaos.hit("collective", op="all_gather") is not None
+
+
+# ------------------------------------------- crash-safe checkpoints
+
+def _tiny_trained_scope():
+    """Fresh program + scope with initialized params; returns
+    (exe, main_p, scope, loss_name)."""
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        with pt.unique_name.guard():
+            x = layers.data("x", shape=[6])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(layers.fc(x, 8, act="tanh"), 1)
+            loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup_p)
+    return exe, main_p, scope, loss.name
+
+
+def test_torn_write_property_latest_valid_always_restores(tmp_path):
+    """Property over truncation classes: whatever byte the newest
+    checkpoint's params (or manifest, or meta) is torn at, the root
+    always yields a valid restore point — the older checkpoint — and
+    load_checkpoint succeeds. Pre-manifest, load_checkpoint opened
+    the torn npz and died."""
+    exe, main_p, scope, _loss = _tiny_trained_scope()
+    root = str(tmp_path)
+    with pt.scope_guard(scope):
+        saver = CheckpointSaver(root, max_to_keep=4, async_save=False)
+        saver.save(exe, main_p, step=1)
+        saver.save(exe, main_p, step=2)
+    good = os.path.join(root, "checkpoint_1")
+    victim = os.path.join(root, "checkpoint_2")
+    params = os.path.join(victim, "params.npz")
+    psize = os.path.getsize(params)
+    pristine = victim + ".pristine"
+    shutil.copytree(victim, pristine)
+
+    def restore_victim():
+        shutil.rmtree(victim, ignore_errors=True)
+        shutil.copytree(pristine, victim)
+
+    # byte-boundary classes: empty, first byte, interior, last byte
+    for cut in sorted({0, 1, psize // 2, psize - 1}):
+        restore_victim()
+        with open(params, "r+b") as f:
+            f.truncate(cut)
+        assert latest_checkpoint(root) == good, f"cut={cut}"
+        with pt.scope_guard(scope):
+            meta = pt.io.load_checkpoint(exe, root, main_p)
+        assert meta["step"] == 1, f"cut={cut}"
+
+    # corrupt (not truncated) params: same byte count, flipped bits —
+    # only the checksum manifest can catch this class
+    restore_victim()
+    with open(params, "r+b") as f:
+        f.seek(psize // 2)
+        f.write(b"\xff\x00\xff\x00")
+    assert latest_checkpoint(root) == good
+
+    # torn manifest / missing meta
+    restore_victim()
+    mpath = os.path.join(victim, checkpoint.MANIFEST_FILE)
+    with open(mpath, "r+b") as f:
+        f.truncate(os.path.getsize(mpath) // 2)
+    assert latest_checkpoint(root) == good
+    restore_victim()
+    os.remove(os.path.join(victim, "checkpoint.json"))
+    assert latest_checkpoint(root) == good
+
+    # intact again: the newest wins
+    restore_victim()
+    assert latest_checkpoint(root) == victim
+    shutil.rmtree(pristine)
+
+
+def test_chaos_torn_write_never_publishes(tmp_path):
+    """A ckpt_torn fault (writer killed mid-npz) surfaces as an error
+    and the torn state never becomes a visible checkpoint_N — the
+    root's newest valid checkpoint is unchanged."""
+    exe, main_p, scope, _loss = _tiny_trained_scope()
+    root = str(tmp_path)
+    with pt.scope_guard(scope):
+        saver = CheckpointSaver(root, max_to_keep=3, async_save=False)
+        saver.save(exe, main_p, step=5)
+        chaos.configure("ckpt_torn:byte=64")
+        try:
+            with pytest.raises(RuntimeError):
+                saver.save(exe, main_p, step=6)
+        finally:
+            chaos.reset()
+    assert latest_checkpoint(root).endswith("checkpoint_5")
+    assert not os.path.isdir(os.path.join(root, "checkpoint_6"))
+    # a fresh saver cleans the torn tmp orphan
+    CheckpointSaver(root, max_to_keep=3)
+    assert not any(n.startswith(".tmp_checkpoint_")
+                   for n in os.listdir(root))
+
+
+def test_rotation_gc_never_deletes_last_valid(tmp_path):
+    """max_to_keep=2 with the two NEWEST checkpoints torn: pruning
+    must keep the older valid one (the only restore point) instead of
+    rotating it away."""
+    exe, main_p, scope, _loss = _tiny_trained_scope()
+    root = str(tmp_path)
+    with pt.scope_guard(scope):
+        saver = CheckpointSaver(root, max_to_keep=2, async_save=False)
+        for step in (1, 2, 3):
+            saver.save(exe, main_p, step=step)
+        # tear 2 and 3 (now the only kept ones), then save 4 torn too
+        for n in (2, 3):
+            p = os.path.join(root, f"checkpoint_{n}", "params.npz")
+            with open(p, "r+b") as f:
+                f.truncate(10)
+        # un-tear nothing; write one more valid checkpoint and verify
+        # pruning keeps it, plus drops the torn ones safely
+        saver.save(exe, main_p, step=4)
+    kept = sorted(n for n in os.listdir(root)
+                  if n.startswith("checkpoint_"))
+    assert "checkpoint_4" in kept
+    assert latest_checkpoint(root).endswith("checkpoint_4")
+
+    # now the reverse: newest are torn, GC must preserve the valid one
+    with pt.scope_guard(scope):
+        saver2 = CheckpointSaver(root, max_to_keep=1, async_save=False)
+        chaos.configure("ckpt_torn:byte=32;ckpt_torn:byte=32,at=2")
+        try:
+            for step in (5, 6):
+                with pytest.raises(RuntimeError):
+                    saver2.save(exe, main_p, step=step)
+        finally:
+            chaos.reset()
+    assert latest_checkpoint(root).endswith("checkpoint_4")
+
+
+def test_flat_save_checkpoint_atomic_and_recoverable(tmp_path):
+    """Flat-dir save_checkpoint: the published dir always validates;
+    a crash window that left only the .old swap-out (or a complete
+    .tmp) is recovered by load_checkpoint; a hopeless root raises
+    CheckpointError instead of loading garbage."""
+    exe, main_p, scope, _loss = _tiny_trained_scope()
+    d = str(tmp_path / "flat")
+    with pt.scope_guard(scope):
+        pt.io.save_checkpoint(exe, d, main_p, step=3)
+        assert checkpoint.is_valid(d)
+        meta = pt.io.load_checkpoint(exe, d, main_p)
+        assert meta["step"] == 3
+
+        # crash-between-renames: dir gone, .old holds the payload
+        os.rename(d, d + ".old")
+        meta = pt.io.load_checkpoint(exe, d, main_p)
+        assert meta["step"] == 3
+        shutil.rmtree(d + ".old")
+
+        # hopeless: nothing valid anywhere
+        os.makedirs(d)
+        with open(os.path.join(d, "checkpoint.json"), "w") as f:
+            f.write("{ torn")
+        with pytest.raises(CheckpointError):
+            pt.io.load_checkpoint(exe, d, main_p)
+
+
+def test_checkpoint_forward_compat_pre_pr_reader(tmp_path):
+    """The manifest is additive: a checkpoint written by the new path
+    still loads with the PRE-PR reader semantics (np.load the npz +
+    json.load the meta, no manifest knowledge)."""
+    exe, main_p, scope, _loss = _tiny_trained_scope()
+    d = str(tmp_path / "fc")
+    with pt.scope_guard(scope):
+        pt.io.save_checkpoint(exe, d, main_p, step=11,
+                              extra={"tag": "fwd"})
+        want = {v.name: np.asarray(scope.get(v.name))
+                for v in main_p.persistable_vars()}
+    with open(os.path.join(d, "checkpoint.json")) as f:
+        meta = json.load(f)
+    assert meta["step"] == 11 and meta["extra"] == {"tag": "fwd"}
+    assert meta["vars"] == sorted(want)
+    with np.load(os.path.join(d, "params.npz"),
+                 allow_pickle=False) as data:
+        for name, arr in want.items():
+            np.testing.assert_array_equal(data[name], arr)
+    # and a legacy (manifest-less) dir still loads with the new reader
+    os.remove(os.path.join(d, checkpoint.MANIFEST_FILE))
+    with pt.scope_guard(scope):
+        assert pt.io.load_checkpoint(exe, d, main_p)["step"] == 11
+
+
+# ----------------------------------------------------------- guardian
+
+def _guardian_rig(root, save_every=3, max_restarts=3):
+    exe, main_p, scope, loss_name = _tiny_trained_scope()
+    losses = []
+
+    def step_fn(step):
+        rng = np.random.RandomState(100 + step)
+        feed = {"x": rng.rand(8, 6).astype("float32"),
+                "y": rng.rand(8, 1).astype("float32")}
+        out = exe.run(main_p, feed=feed, fetch_list=[loss_name])
+        losses.append(float(out[0]))
+        return float(out[0])
+
+    guardian = Guardian(exe, main_p, root, save_every=save_every,
+                        max_restarts=max_restarts)
+    return exe, main_p, scope, guardian, step_fn, losses
+
+
+def test_guardian_crash_resume_matches_uninterrupted(tmp_path):
+    """An injected mid-run crash + auto-resume lands on the SAME final
+    loss as a never-interrupted run (deterministic per-step feeds, no
+    PRNG-consuming ops): restore really is the step-K state."""
+    exe, main_p, scope, g_a, step_a, losses_a = _guardian_rig(
+        str(tmp_path / "a"))
+    with pt.scope_guard(scope):
+        g_a.run_with_recovery(step_a, steps=8)
+    assert g_a.restarts == 0
+
+    exe2, main_p2, scope2, g_b, step_b, losses_b = _guardian_rig(
+        str(tmp_path / "b"))
+    # hits: each exe2.run is one executor.step hit; _tiny_trained_scope
+    # already ran startup (hit outside configure window). at=6 →
+    # crash on run #6 after configure = training step 5 (0-based)
+    chaos.configure("step_fail:at=6")
+    try:
+        with pt.scope_guard(scope2):
+            g_b.run_with_recovery(step_b, steps=8)
+    finally:
+        chaos.reset()
+    assert g_b.restarts == 1
+    assert np.isclose(losses_a[-1], losses_b[-1], rtol=1e-5), \
+        (losses_a[-1], losses_b[-1])
+
+
+def test_guardian_restart_budget_exceeded(tmp_path):
+    """An unrecoverable repeat-offender exhausts the bounded budget
+    and surfaces RestartBudgetExceeded from the last failure."""
+    exe, main_p, scope, g, step_fn, _losses = _guardian_rig(
+        str(tmp_path), max_restarts=2)
+    chaos.configure("step_fail:at=2,times=99")   # every step after 1
+    try:
+        with pt.scope_guard(scope):
+            with pytest.raises(RestartBudgetExceeded) as ei:
+                g.run_with_recovery(step_fn, steps=8)
+    finally:
+        chaos.reset()
+    assert isinstance(ei.value.__cause__, ChaosFault)
+    assert g.restarts == 3                        # budget 2 + the fatal
+
+
+def test_guardian_kill9_subprocess_resume(tmp_path):
+    """The real thing: a worker subprocess SIGKILL'd mid-step (no
+    cleanup handlers run), then a fresh process with the same root
+    auto-resumes from the last valid checkpoint and completes."""
+    root = str(tmp_path / "kill")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_CHAOS="step_fail:at=9,mode=kill")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    cmd = [sys.executable, TPUCHAOS, "worker", "--root", root,
+           "--steps", "12"]
+    p1 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300)
+    assert p1.returncode == -signal.SIGKILL, \
+        (p1.returncode, p1.stderr[-400:])
+    assert latest_checkpoint(root) is not None, \
+        "SIGKILL'd run left no durable checkpoint"
+    assert not os.path.exists(os.path.join(root, "result.json"))
+
+    env.pop("PADDLE_TPU_CHAOS")
+    p2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300)
+    assert p2.returncode == 0, p2.stderr[-400:]
+    with open(os.path.join(root, "result.json")) as f:
+        result = json.load(f)
+    assert result["steps"] == 12
+    assert np.isfinite(result["final_loss"])
+
+
+# ----------------------------------------------------------- liveness
+
+def _write_snap(spool, rank, age_s, now=None):
+    now = now or time.time()
+    os.makedirs(spool, exist_ok=True)
+    path = os.path.join(spool, f"rank{rank:05d}.snap.json")
+    with open(path, "w") as f:
+        json.dump({"schema": "paddle_tpu.fleet.snapshot.v1",
+                   "rank": rank,
+                   "flush_unix_us": int((now - age_s) * 1e6),
+                   "metrics": {}}, f)
+    os.utime(path, (now - age_s, now - age_s))
+    return path
+
+
+def test_dead_rank_detection_on_stale_spool(tmp_path):
+    spool = str(tmp_path)
+    _write_snap(spool, 0, age_s=2.0)
+    _write_snap(spool, 1, age_s=500.0)
+    report = liveness.check_liveness(spool, stale_after_s=60.0)
+    assert report["dead"] == [1] and report["alive"] == [0]
+    assert not report["ok"] and "rank 1" in report["verdict"]
+    with pytest.raises(FleetFault) as ei:
+        liveness.assert_alive(spool, stale_after_s=60.0)
+    assert ei.value.ranks == [1]
+    # expected_world surfaces never-spooled ranks as missing
+    report = liveness.check_liveness(spool, stale_after_s=60.0,
+                                     expected_world=4)
+    assert report["missing"] == [2, 3]
+    # gauges land when telemetry is on
+    tm.enable()
+    tm.reset()
+    liveness.check_liveness(spool, stale_after_s=60.0)
+    snap = tm.snapshot()
+    assert snap["fleet.liveness.dead"] == 1
+    assert snap["fleet.liveness.alive"] == 1
+
+
+def test_spool_drop_goes_stale_then_detected(tmp_path):
+    """End-to-end: chaos drops every spool flush; the rank's snapshot
+    never lands, so liveness reports it missing."""
+    from paddle_tpu.telemetry import fleet as tfleet
+    spool = str(tmp_path / "spool")
+    tm.enable()
+    chaos.configure("spool_drop:every=1")
+    try:
+        tfleet.configure(0, 2, spool_dir=spool)
+        assert tfleet.write_rank_snapshot() is None   # dropped
+    finally:
+        chaos.reset()
+        tfleet._reset_for_tests()
+    report = liveness.check_liveness(spool if os.path.isdir(spool)
+                                     else str(tmp_path / "spool"),
+                                     stale_after_s=60.0,
+                                     expected_world=2)
+    assert report["missing"] == [0, 1]
+    # with chaos disarmed the same flush lands and the rank is alive
+    tm.enable()
+    try:
+        tfleet.configure(0, 2, spool_dir=spool)
+        assert tfleet.write_rank_snapshot() is not None
+    finally:
+        tfleet._reset_for_tests()
+    report = liveness.check_liveness(spool, stale_after_s=60.0,
+                                     expected_world=2)
+    assert report["alive"] == [0] and report["missing"] == [1]
+
+
+# ------------------------------------------------- zero-cost contract
+
+def test_disarmed_chaos_costs_one_cached_bool():
+    assert not chaos.armed()
+    assert chaos.spec() == []
+    assert chaos.hit("executor.step") is None     # no counters move
+    chaos.check("executor.step")                  # no-op, no raise
+    assert chaos.fired_count() == 0
+
+
+# ------------------------------------------------------ CI gate smoke
+
+def test_tpuchaos_selftest_subprocess():
+    """tools/tpuchaos.py --selftest as a CPU subprocess: the
+    acceptance gate — killed training auto-resumes to the baseline
+    loss, torn checkpoint writes never lose the restore point."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    env.pop("PADDLE_TPU_CHAOS", None)
+    p = subprocess.run(
+        [sys.executable, TPUCHAOS, "--selftest", "--json"],
+        capture_output=True, text=True, timeout=480, env=env)
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert lines, p.stderr[-500:]
+    verdict = json.loads(lines[-1])
+    assert p.returncode == 0, (verdict, p.stderr[-500:])
+    assert verdict["ok"] is True, verdict["problems"]
+    assert np.isclose(verdict["baseline_loss"],
+                      verdict["crash_resume_loss"], rtol=1e-4)
+    assert np.isclose(verdict["baseline_loss"],
+                      verdict["kill9_resume_loss"], rtol=1e-4)
+    assert verdict["compile_retries"] == 2

@@ -261,6 +261,39 @@ def test_registry_versions(tmp_path):
         server.shutdown(timeout=5.0)
 
 
+def test_worker_crash_restarts_and_service_continues(tmp_path):
+    """Kill the (only) worker thread mid-stream via the chaos
+    serving.worker point: the in-flight batch fails fast instead of
+    hanging to its deadline, a replacement worker is spawned so later
+    requests still serve, and the respawn is counted in
+    serving.worker_restarts — surfaced through /metrics. Before the
+    restart logic, this test deadlocked: the dead worker silently took
+    the model's whole capacity with it."""
+    from paddle_tpu.resilience import ChaosFault, chaos
+    tm.enable()
+    d = _save_small_model(tmp_path)
+    server = ModelServer(ServerConfig(
+        batch=BatchConfig(max_batch_size=4, buckets=(4,),
+                          max_wait_ms=1.0), workers=1))
+    try:
+        server.load("m", d)
+        x = {"img": np.zeros((1, 8), dtype="float32")}
+        assert len(server.predict("m", x, timeout=30)) == 1
+        chaos.configure("worker_crash:at=1")
+        try:
+            with pytest.raises(ChaosFault):   # fails fast, no hang
+                server.predict("m", x, timeout=10)
+        finally:
+            chaos.reset()
+        for _ in range(3):                    # respawned worker serves
+            assert len(server.predict("m", x, timeout=10)) == 1
+        assert server.worker_restarts == 1
+        assert "serving_worker_restarts 1" in tm.prometheus_text()
+    finally:
+        chaos.reset()
+        server.shutdown(timeout=5.0)
+
+
 # -------------------------------------------------------------- frontend
 
 def test_http_predict_healthz_metrics_roundtrip(tmp_path):

@@ -1,0 +1,368 @@
+#!/usr/bin/env python
+"""tpuchaos — the fault-tolerance layer's CLI and CI gate.
+
+Three jobs, in the tpustat/tpuserve/tpudoctor CLI tradition:
+
+  demo        (default) train a small deterministic model under the
+              Guardian, inject faults — a mid-step crash, a torn
+              checkpoint write, a transient compile failure, a dead
+              rank — and show the resilience layer surviving each:
+              auto-resume from the last valid checkpoint, torn-write
+              candidates skipped, retries absorbed, liveness flagged.
+  worker      (internal) one deterministic Guardian training run in a
+              subprocess — the kill -9 target. Faults come from
+              PADDLE_TPU_CHAOS in the environment; on completion a
+              result JSON (final loss, restarts) is written, so the
+              parent can verify an interrupted-then-resumed job
+              reaches the same loss as an uninterrupted one.
+  --selftest  CI gate: all demo legs with assertions —
+              (1) a run killed mid-step (in-process fault AND a real
+                  SIGKILL'd subprocess) auto-resumes from the last
+                  valid checkpoint and reaches a final loss within
+                  tolerance of the uninterrupted run;
+              (2) a checkpoint write torn at ANY injected byte offset
+                  never leaves the root without a loadable restore
+                  point (rotation GC keeps the last valid one);
+              (3) transient compile faults are absorbed by the retry
+                  engine (resilience.retry.* counters);
+              (4) a silent rank turns into a typed FleetFault via the
+                  spool-heartbeat liveness detector.
+              One JSON verdict line with --json; exit 2 on any
+              problem.
+
+Examples:
+  python tools/tpuchaos.py                         # demo
+  python tools/tpuchaos.py --selftest --json       # CI gate
+  PADDLE_TPU_CHAOS="step_fail:at=9,mode=kill" \\
+      python tools/tpuchaos.py worker --root /tmp/ckpt --steps 12
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+STEPS = 12
+SAVE_EVERY = 4
+# chaos executor.step hit N: startup run is hit 1, training step k is
+# hit k+2 -> at=9 crashes step 7, after the step-3 checkpoint landed
+CRASH_AT = 9
+LOSS_RTOL = 1e-4
+
+
+# ------------------------------------------------------- training rig
+
+def _build_model():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        with pt.unique_name.guard():
+            x = layers.data("x", shape=[8])
+            y = layers.data("y", shape=[1])
+            h = layers.fc(x, 16, act="tanh")
+            pred = layers.fc(h, 1)
+            loss = layers.reduce_mean(
+                layers.square_error_cost(pred, y))
+            opt = pt.optimizer.Adam(1e-2)
+            opt.minimize(loss)
+    return main_p, startup_p, loss
+
+
+def _feed_for_step(step):
+    """Pure function of the step index — resumption replays the exact
+    stream an uninterrupted run would have seen (the Guardian
+    determinism contract)."""
+    import numpy as np
+    rng = np.random.RandomState(1000 + step)
+    x = rng.rand(16, 8).astype("float32")
+    y = (0.5 * x.sum(axis=1, keepdims=True)).astype("float32")
+    return {"x": x, "y": y}
+
+
+def _train_with_guardian(root, steps=STEPS, max_restarts=3):
+    """One Guardian-supervised run in a fresh scope. Returns
+    (final_loss, guardian)."""
+    import paddle_tpu as pt
+    from paddle_tpu.resilience import Guardian
+
+    main_p, startup_p, loss = _build_model()
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        guardian = Guardian(exe, main_p, root,
+                            startup_program=startup_p,
+                            save_every=SAVE_EVERY,
+                            max_restarts=max_restarts)
+
+        def step_fn(step):
+            out = exe.run(main_p, feed=_feed_for_step(step),
+                          fetch_list=[loss])
+            return float(out[0])
+
+        final = guardian.run_with_recovery(step_fn, steps)
+    return final, guardian
+
+
+# ------------------------------------------------------------- worker
+
+def cmd_worker(args):
+    """Subprocess target: PADDLE_TPU_CHAOS in the env decides whether
+    this run dies; a completed run writes the result JSON."""
+    final, guardian = _train_with_guardian(args.root, steps=args.steps)
+    result = {"final_loss": final, "steps": args.steps,
+              "restarts": guardian.restarts,
+              "restores": guardian.restore_count}
+    path = args.result or os.path.join(args.root, "result.json")
+    with open(path, "w") as f:
+        json.dump(result, f)
+    print(json.dumps(result))
+    return 0
+
+
+# ---------------------------------------------------------- demo legs
+
+def run_demo(selftest=False):
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import telemetry as tm
+    from paddle_tpu.io import CheckpointSaver, latest_checkpoint
+    from paddle_tpu.resilience import (FleetFault, chaos, checkpoint,
+                                       liveness)
+
+    problems = []
+    info = {}
+
+    def check(ok, what):
+        if not ok:
+            problems.append(what)
+        return ok
+
+    def say(msg):
+        if not selftest:
+            print(msg)
+
+    chaos.reset()
+    check(not chaos.armed(), "chaos armed with no spec configured")
+
+    # 1) baseline: uninterrupted run ---------------------------------
+    base_root = tempfile.mkdtemp(prefix="tpuchaos_base_")
+    base_loss, g0 = _train_with_guardian(base_root)
+    info["baseline_loss"] = base_loss
+    say(f"[baseline] {STEPS} uninterrupted steps, final loss "
+        f"{base_loss:.6f}")
+    check(g0.restarts == 0, "baseline run restarted")
+    check(latest_checkpoint(base_root) is not None,
+          "baseline run left no checkpoint")
+
+    # 2) in-process crash at step 7 → Guardian auto-resume ------------
+    crash_root = tempfile.mkdtemp(prefix="tpuchaos_crash_")
+    chaos.configure(f"step_fail:at={CRASH_AT}")
+    try:
+        crash_loss, g1 = _train_with_guardian(crash_root)
+    finally:
+        chaos.reset()
+    info["crash_resume_loss"] = crash_loss
+    info["crash_restarts"] = g1.restarts
+    say(f"[crash]    injected ChaosFault at step {CRASH_AT - 2}; "
+        f"guardian restarted {g1.restarts}x, resumed from the last "
+        f"valid checkpoint, final loss {crash_loss:.6f}")
+    check(g1.restarts == 1, f"expected 1 restart, got {g1.restarts}")
+    check(np.isclose(crash_loss, base_loss, rtol=LOSS_RTOL),
+          f"crash-resumed loss {crash_loss} != baseline {base_loss}")
+
+    # 3) kill -9 mid-step in a real subprocess → fresh-process resume -
+    kill_root = tempfile.mkdtemp(prefix="tpuchaos_kill_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_CHAOS=f"step_fail:at={CRASH_AT},mode=kill")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "worker",
+           "--root", kill_root, "--steps", str(STEPS)]
+    p1 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300)
+    check(p1.returncode == -signal.SIGKILL,
+          f"worker exited {p1.returncode}, wanted -SIGKILL: "
+          f"{p1.stderr[-300:]}")
+    check(latest_checkpoint(kill_root) is not None,
+          "killed worker left no valid checkpoint")
+    env.pop("PADDLE_TPU_CHAOS")
+    p2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300)
+    check(p2.returncode == 0,
+          f"resume worker failed rc={p2.returncode}: "
+          f"{p2.stderr[-300:]}")
+    kill_loss = None
+    try:
+        with open(os.path.join(kill_root, "result.json")) as f:
+            kill_loss = json.load(f)["final_loss"]
+    except (OSError, ValueError, KeyError):
+        problems.append("resumed worker wrote no result.json")
+    info["kill9_resume_loss"] = kill_loss
+    if kill_loss is not None:
+        say(f"[kill -9]  subprocess SIGKILL'd mid-step, fresh process "
+            f"auto-resumed, final loss {kill_loss:.6f}")
+        check(np.isclose(kill_loss, base_loss, rtol=LOSS_RTOL),
+              f"kill-9 resumed loss {kill_loss} != baseline "
+              f"{base_loss}")
+
+    # 4) torn-write sweep: never without a loadable restore point ----
+    torn_root = tempfile.mkdtemp(prefix="tpuchaos_torn_")
+    main_p, startup_p, _loss = _build_model()
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup_p)
+        saver = CheckpointSaver(torn_root, max_to_keep=2,
+                                async_save=False)
+        saver.save(exe, main_p, step=0)
+        first = latest_checkpoint(torn_root)
+        check(first is not None, "seed checkpoint invalid")
+        psize = os.path.getsize(os.path.join(first, "params.npz"))
+        offsets = sorted({0, 1, 37, psize // 3, psize // 2,
+                          psize - 1, psize})
+        torn_ok = 0
+        for i, byte in enumerate(offsets):
+            chaos.configure(f"ckpt_torn:byte={byte}")
+            try:
+                saver.save(exe, main_p, step=i + 1)
+                problems.append(
+                    f"torn write at byte {byte} did not surface")
+            except RuntimeError:
+                pass
+            finally:
+                chaos.reset()
+            latest = latest_checkpoint(torn_root)
+            if latest is None or not checkpoint.is_valid(latest):
+                problems.append(
+                    f"torn write at byte {byte} left no valid "
+                    "restore point")
+                continue
+            torn_ok += 1
+        # and the root still LOADS after the whole sweep
+        try:
+            meta = pt.io.load_checkpoint(exe, torn_root, main_p)
+            check(meta["step"] == 0, "restored wrong checkpoint")
+        except Exception as e:
+            problems.append(f"post-sweep load failed: {e}")
+    info["torn_offsets_survived"] = f"{torn_ok}/{len(offsets)}"
+    say(f"[torn]     checkpoint writes torn at byte offsets "
+        f"{offsets}: root kept a loadable restore point every time")
+
+    # 5) transient compile faults absorbed by the retry engine -------
+    import numpy as np  # noqa: F811 (readability in this long fn)
+    retry_dir = tempfile.mkdtemp(prefix="tpuchaos_retry_")
+    from paddle_tpu import layers
+    from paddle_tpu.inference import InferenceEngine
+    inf_main, inf_start = pt.Program(), pt.Program()
+    with pt.program_guard(inf_main, inf_start):
+        with pt.unique_name.guard():
+            xv = layers.data("xv", shape=[4])
+            pv = layers.fc(xv, 2, act="softmax")
+    exe2 = pt.Executor(pt.CPUPlace())
+    scope2 = pt.Scope()
+    with pt.scope_guard(scope2):
+        exe2.run(inf_start)
+        pt.io.save_inference_model(retry_dir, ["xv"], [pv], exe2,
+                                   main_program=inf_main)
+    tm.enable()
+    tm.reset()
+    chaos.configure("compile_fail:at=1,times=2")
+    try:
+        eng = InferenceEngine.from_dir(retry_dir)
+        out = eng.run({"xv": np.zeros((2, 4), "float32")})
+        snap = tm.snapshot()
+    finally:
+        chaos.reset()
+        tm.disable()
+        tm.reset()
+    check(len(out) == 1 and out[0].shape == (2, 2),
+          "inference under injected compile faults returned garbage")
+    retries = snap.get("resilience.retry.retries", 0)
+    info["compile_retries"] = retries
+    say(f"[retry]    2 injected transient compile failures absorbed "
+        f"({retries} retries, then success)")
+    check(retries == 2, f"expected 2 retries, counters say {retries}")
+
+    # 6) dead-rank detection on a stale spool ------------------------
+    import time
+    spool = tempfile.mkdtemp(prefix="tpuchaos_spool_")
+    now = time.time()
+    for rank, age in ((0, 1.0), (1, 600.0)):
+        path = os.path.join(spool, f"rank{rank:05d}.snap.json")
+        with open(path, "w") as f:
+            json.dump({"schema": "paddle_tpu.fleet.snapshot.v1",
+                       "rank": rank,
+                       "flush_unix_us": int((now - age) * 1e6),
+                       "metrics": {}}, f)
+        os.utime(path, (now - age, now - age))
+    report = liveness.check_liveness(spool, stale_after_s=60.0,
+                                     expected_world=3)
+    info["liveness"] = report["verdict"]
+    say(f"[liveness] {report['verdict']}")
+    check(report["dead"] == [1], f"dead ranks {report['dead']} != [1]")
+    check(report["missing"] == [2],
+          f"missing ranks {report['missing']} != [2]")
+    try:
+        liveness.assert_alive(spool, stale_after_s=60.0,
+                              expected_world=3)
+        problems.append("assert_alive did not raise on a dead rank")
+    except FleetFault as e:
+        check(1 in e.ranks, "FleetFault does not name the dead rank")
+
+    return problems, info
+
+
+# ---------------------------------------------------------------- main
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("command", nargs="?", default="demo",
+                   choices=["demo", "worker"])
+    p.add_argument("--root", default=None,
+                   help="checkpoint root (worker)")
+    p.add_argument("--steps", type=int, default=STEPS)
+    p.add_argument("--result", default=None,
+                   help="result JSON path (worker; default "
+                        "<root>/result.json)")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the CI gate assertions")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="one machine-readable JSON verdict line")
+    p.add_argument("--platform", default="cpu",
+                   help="JAX_PLATFORMS to force ('env' keeps the "
+                        "environment's; default cpu so the CLI never "
+                        "hangs on a down relay)")
+    args = p.parse_args(argv)
+
+    if args.platform != "env":
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    if args.command == "worker":
+        if not args.root:
+            p.error("worker needs --root")
+        return cmd_worker(args)
+
+    problems, info = run_demo(selftest=args.selftest)
+    result = {"ok": not problems, "problems": problems}
+    result.update(info)
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    else:
+        if problems:
+            for prob in problems:
+                print(f"PROBLEM: {prob}", file=sys.stderr)
+        else:
+            print("tpuchaos: all checks passed "
+                  f"(baseline {info['baseline_loss']:.6f} == "
+                  f"crash-resume {info['crash_resume_loss']:.6f} == "
+                  f"kill-9-resume {info['kill9_resume_loss']:.6f})")
+    return 2 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
