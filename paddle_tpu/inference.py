@@ -156,6 +156,14 @@ class InferenceEngine:
         """Number of distinct compiled feed signatures (jit entries)."""
         return len(self._cache)
 
+    def params(self):
+        """{name: device array} of the loaded persistable parameters
+        (no copy). This is the official seam for building sibling
+        executables over the same checkpoint — e.g. the serving decode
+        tier (`serving.decode.DecodeEngine.from_inference_engine`)
+        shares these arrays with the full-program predict path."""
+        return dict(self._persist)
+
     def feed_specs(self):
         """{feed_name: (shape, dtype_str)} from the program's data vars
         (batch dim reported as -1). Serving uses this to build warmup

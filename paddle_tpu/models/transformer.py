@@ -12,6 +12,7 @@ from .. import layers
 
 __all__ = ["transformer", "build_program", "build_infer_program",
            "greedy_decode", "convert_qkv_checkpoint",
+           "decode_params", "IncrementalDecoder",
            "TransformerConfig"]
 
 
@@ -186,7 +187,7 @@ def build_infer_program(cfg=None, maxlen=None):
 
 
 def greedy_decode(exe, infer_program, logits_var, src, src_len, bos=0,
-                  eos=None):
+                  eos=None, fetch_argmax=False):
     """Autoregressive greedy decode through the compiled inference
     program: ONE executable (static [B, T] shapes) run T-1 times, the
     argmax at step t-1 fed back as token t. Returns ids [B, T]
@@ -196,10 +197,16 @@ def greedy_decode(exe, infer_program, logits_var, src, src_len, bos=0,
 
     T comes from src.shape[1] and must equal the maxlen the infer
     program was built with (the graph bakes it into the attention
-    bias shapes). Fetching the [B,T,V] logits per step costs O(T*V)
-    host transfer; for production decode fetch an in-graph argmax
-    instead — this helper keeps the raw logits to stay usable for
-    sampling/beam scoring experiments at tiny configs."""
+    bias shapes).
+
+    fetch_argmax=True appends an in-graph arg_max over the vocab axis
+    (once per program; cached on the program object) and fetches the
+    [B, T] token ids instead of the [B, T, V] logits — O(T) host
+    readback per step instead of O(T*V). The default keeps the raw
+    logits so the helper stays usable for sampling/beam scoring
+    experiments at tiny configs; production decode wants the argmax
+    fetch (or the KV-cached `IncrementalDecoder`, which never re-runs
+    the prefix at all)."""
     T = int(src.shape[1])
     B = src.shape[0]
     pvars = infer_program.global_block().vars
@@ -208,6 +215,14 @@ def greedy_decode(exe, infer_program, logits_var, src, src_len, bos=0,
         raise ValueError(
             f"src length {T} != infer program's built length "
             f"{built_T}; rebuild build_infer_program(maxlen={T})")
+    fetch_var = logits_var
+    if fetch_argmax:
+        fetch_var = getattr(infer_program, "_greedy_argmax_var", None)
+        if fetch_var is None:
+            from ..core import framework as _fw
+            with _fw.program_guard(infer_program):
+                fetch_var = layers.argmax(logits_var, axis=-1)
+            infer_program._greedy_argmax_var = fetch_var
     ids = np.zeros((B, T), dtype=np.int64)
     ids[:, 0] = bos
     done = np.zeros((B,), bool)
@@ -216,9 +231,12 @@ def greedy_decode(exe, infer_program, logits_var, src, src_len, bos=0,
                       feed={"src": src, "src_len": src_len,
                             "trg": ids,
                             "trg_len": np.full((B,), t, np.int64)},
-                      fetch_list=[logits_var], is_test=True)
-        step = np.asarray(out[0])[:, t - 1, :]        # [B, V]
-        nxt = step.argmax(-1)
+                      fetch_list=[fetch_var], is_test=True)
+        if fetch_argmax:
+            nxt = np.asarray(out[0])[:, t - 1]        # [B] ids
+        else:
+            step = np.asarray(out[0])[:, t - 1, :]    # [B, V]
+            nxt = step.argmax(-1)
         ids[:, t] = nxt
         if eos is not None:
             done |= nxt == eos
@@ -258,3 +276,339 @@ def convert_qkv_checkpoint(arrays, cfg, to_fused):
         op(f"dec{i}_self", ("q", "k", "v"), f"dec{i}_self_qkv.w_0")
         op(f"dec{i}_cross", ("k", "v"), f"dec{i}_cross_kv.w_0")
     return out
+
+
+# ---------------------------------------------------------------------------
+# incremental (KV-cached) decode — the tpudecode serving tier
+# ---------------------------------------------------------------------------
+def _ln_index(cfg, part, layer, sub):
+    """Deterministic layer_norm parameter index. transformer() builds
+    norms in a fixed order under a fresh unique_name.guard: encoder
+    layer i contributes layer_norm_{2i} (attn) and _{2i+1} (ffn);
+    decoder layer i contributes _{2L+3i} (self), +1 (cross), +2 (ffn).
+    Pinned by decode_params' existence check against the scope."""
+    L = cfg.n_layer
+    if part == "enc":
+        return 2 * layer + {"attn": 0, "ffn": 1}[sub]
+    return 2 * L + 3 * layer + {"self": 0, "cross": 1, "ffn": 2}[sub]
+
+
+def decode_params(arrays, cfg):
+    """Validate + normalize a transformer parameter dict for
+    incremental decode. Accepts BOTH checkpoint layouts: the unfused
+    per-projection default and the fused qkv/kv perf layout (detected
+    by its `*_qkv.w_0` names and split back via
+    `convert_qkv_checkpoint`). Returns a new {name: array} dict
+    restricted to the decode-relevant parameters; raises KeyError
+    naming every missing parameter on a mismatch."""
+    arrays = dict(arrays)
+    if any(k.endswith("_qkv.w_0") or k.endswith("_kv.w_0")
+           for k in arrays):
+        arrays = convert_qkv_checkpoint(arrays, cfg, to_fused=False)
+    need = ["src_emb.w_0", "trg_emb.w_0", "proj.w_0"]
+    for i in range(cfg.n_layer):
+        need += [f"enc{i}_{p}.w_0" for p in "qkvo"]
+        need += [f"dec{i}_self_{p}.w_0" for p in "qkvo"]
+        need += [f"dec{i}_cross_{p}.w_0" for p in "qkvo"]
+        for part in (f"enc{i}_ffn", f"dec{i}_ffn"):
+            need += [f"{part}_fc1.w_0", f"{part}_fc1.b_0",
+                     f"{part}_fc2.w_0", f"{part}_fc2.b_0"]
+    for j in range(5 * cfg.n_layer):        # 2L encoder + 3L decoder
+        need += [f"layer_norm_{j}.w_0", f"layer_norm_{j}.b_0"]
+    missing = sorted(n for n in need if n not in arrays)
+    if missing:
+        raise KeyError(
+            f"decode_params: {len(missing)} transformer parameters "
+            f"missing (config mismatch or foreign checkpoint?): "
+            f"{missing[:8]}{'...' if len(missing) > 8 else ''}")
+    return {n: arrays[n] for n in need}
+
+
+class IncrementalDecoder:
+    """KV-cached single-token transformer decode over a fixed slot
+    pool — the compute core of `paddle_tpu.serving.decode`.
+
+    Instead of re-running the whole [B, T] inference program once per
+    token (greedy_decode: O(T^2) compute, O(T*V) readback per step),
+    this holds a static-shape cache `[n_layer, num_slots, max_len,
+    n_head, d_head]` and compiles exactly TWO kinds of executables:
+
+    - ``prefill(src, src_len)`` (one per row bucket): encoder forward
+      plus the per-layer cross-attention K/V projections of enc_out —
+      everything decode steps need; enc_out itself never persists.
+    - ``step(ids, pos)`` (exactly one): embed the current token per
+      slot, scatter its self-attention K/V into the cache at `pos`,
+      attend over positions <= pos, and return the next token id per
+      slot via IN-GRAPH argmax (or top-k sampling) — only
+      ``[num_slots]`` int32 ids cross the host boundary per token.
+
+    Slots are independent rows: every op is row-wise in the slot dim,
+    so a slot's token stream is unaffected by who else occupies the
+    batch — continuous (iteration-level) batching is token-identical
+    to one-at-a-time greedy_decode. The math mirrors the traced
+    program's kernels exactly (same einsums, f32 `_attn_softmax`,
+    f32 layer-norm internals), keeping argmax parity.
+
+    Parameters come from `decode_params` (both `convert_qkv_checkpoint`
+    layouts accepted). Sampling: ``topk=0`` (default) is greedy argmax;
+    ``topk=k`` draws from the top-k logits at ``temperature`` using the
+    per-step ``seed`` fed to `step` (in-graph, still one executable).
+    """
+
+    def __init__(self, cfg, params, num_slots, max_len=None,
+                 src_max_len=None, topk=0, temperature=1.0):
+        import jax.numpy as jnp
+        self.cfg = cfg
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len or cfg.max_len)
+        self.src_max_len = int(src_max_len or self.max_len)
+        if self.num_slots < 1 or self.max_len < 2:
+            raise ValueError("need num_slots >= 1 and max_len >= 2")
+        self.topk = int(topk)
+        self.temperature = float(temperature)
+        self.params = {k: jnp.asarray(np.asarray(v))
+                       for k, v in decode_params(params, cfg).items()}
+        self._prefill_jit = {}          # rows -> jitted prefill
+        self._step_jit = None
+        self.compile_count = 0          # executables built (pinned)
+
+    # ---------------------------------------------------------- state
+    @property
+    def max_new_tokens(self):
+        """Generated-token capacity per slot (position 0 is bos)."""
+        return self.max_len - 1
+
+    def init_state(self):
+        """Fresh device-resident slot state (all slots free/garbage).
+        Keys: kc/vc [L,S,T,H,Dh] self-attn caches, ck/cv [L,S,Ts,H,Dh]
+        cross-attn caches, src_bias [S,1,1,Ts]."""
+        import jax.numpy as jnp
+        cfg = self.cfg
+        L, S = cfg.n_layer, self.num_slots
+        H, Dh = cfg.n_head, cfg.d_model // cfg.n_head
+        T, Ts = self.max_len, self.src_max_len
+        z = jnp.zeros
+        return {"kc": z((L, S, T, H, Dh), jnp.float32),
+                "vc": z((L, S, T, H, Dh), jnp.float32),
+                "ck": z((L, S, Ts, H, Dh), jnp.float32),
+                "cv": z((L, S, Ts, H, Dh), jnp.float32),
+                "src_bias": z((S, 1, 1, Ts), jnp.float32)}
+
+    # ------------------------------------------------------- math core
+    @staticmethod
+    def _pe(T, D):
+        """Sinusoidal table [T, D], bitwise the add_position_encoding
+        kernel's (jnp on device; constant-folded into the jit)."""
+        import jax.numpy as jnp
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
+        angle = pos / jnp.power(10000.0, 2 * i / D)
+        return jnp.concatenate([jnp.sin(angle), jnp.cos(angle)],
+                               axis=-1)
+
+    @staticmethod
+    def _ln(x, scale, bias, eps=1e-5):
+        """layer_norm kernel's jnp path (f32 internals, last axis)."""
+        import jax
+        import jax.numpy as jnp
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps)
+        return (y * scale.reshape(-1) + bias.reshape(-1)).astype(x.dtype)
+
+    @staticmethod
+    def _fc(x, w, b=None, relu=False):
+        """mul-kernel matmul (2-D flatten) + bias + activation."""
+        import jax
+        lead = x.shape[:-1]
+        out = x.reshape((-1, x.shape[-1])) @ w
+        out = out.reshape(lead + (w.shape[1],))
+        if b is not None:
+            out = out + b
+        if relu:
+            out = jax.nn.relu(out)
+        return out
+
+    def _build_prefill(self, rows):
+        """Encoder forward + cross K/V projections for `rows` padded
+        sequences; jitted per distinct row count (bucketed upstream)."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.kernels_nn import _attn_softmax
+        cfg = self.cfg
+        L, H = cfg.n_layer, cfg.n_head
+        D = cfg.d_model
+        Dh = D // H
+        Ts = self.src_max_len
+        scale = Dh ** -0.5
+        sqrt_d = float(np.sqrt(D))
+        fc, ln = self._fc, self._ln
+
+        def prefill(p, src, src_len):
+            mask = (jnp.arange(Ts)[None, :]
+                    < src_len[:, None]).astype(jnp.float32)
+            src_bias = (mask * jnp.asarray(1e9, jnp.float32)
+                        + jnp.asarray(-1e9, jnp.float32))[:, None, None, :]
+            ids = jnp.clip(src.astype(jnp.int32), 0,
+                           cfg.src_vocab - 1)
+            x = jnp.take(p["src_emb.w_0"], ids, axis=0)
+            x = x * jnp.asarray(sqrt_d, x.dtype)
+            x = x + self._pe(Ts, D)[None].astype(x.dtype)
+            for i in range(L):
+                res = x
+                q = fc(x, p[f"enc{i}_q.w_0"]).reshape(rows, Ts, H, Dh)
+                k = fc(x, p[f"enc{i}_k.w_0"]).reshape(rows, Ts, H, Dh)
+                v = fc(x, p[f"enc{i}_v.w_0"]).reshape(rows, Ts, H, Dh)
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(
+                    jnp.float32) * jnp.asarray(scale, jnp.float32)
+                logits = logits + src_bias
+                w = _attn_softmax(logits).astype(x.dtype)
+                o = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(
+                    rows, Ts, H * Dh)
+                x = ln(fc(o, p[f"enc{i}_o.w_0"]) + res,
+                       p[f"layer_norm_{_ln_index(cfg, 'enc', i, 'attn')}.w_0"],
+                       p[f"layer_norm_{_ln_index(cfg, 'enc', i, 'attn')}.b_0"])
+                res = x
+                h = fc(x, p[f"enc{i}_ffn_fc1.w_0"],
+                       p[f"enc{i}_ffn_fc1.b_0"], relu=True)
+                h = fc(h, p[f"enc{i}_ffn_fc2.w_0"],
+                       p[f"enc{i}_ffn_fc2.b_0"])
+                x = ln(h + res,
+                       p[f"layer_norm_{_ln_index(cfg, 'enc', i, 'ffn')}.w_0"],
+                       p[f"layer_norm_{_ln_index(cfg, 'enc', i, 'ffn')}.b_0"])
+            ck = jnp.stack([fc(x, p[f"dec{i}_cross_k.w_0"]).reshape(
+                rows, Ts, H, Dh) for i in range(L)])
+            cv = jnp.stack([fc(x, p[f"dec{i}_cross_v.w_0"]).reshape(
+                rows, Ts, H, Dh) for i in range(L)])
+            return ck, cv, src_bias
+
+        return jax.jit(prefill)
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.kernels_nn import _attn_softmax
+        cfg = self.cfg
+        L, H = cfg.n_layer, cfg.n_head
+        D = cfg.d_model
+        Dh = D // H
+        S, T = self.num_slots, self.max_len
+        V = cfg.trg_vocab
+        scale = Dh ** -0.5
+        sqrt_d = float(np.sqrt(D))
+        topk, temp = self.topk, self.temperature
+        fc, ln = self._fc, self._ln
+
+        def step(p, kc, vc, ck, cv, src_bias, ids, pos, seed):
+            rows = jnp.arange(S)
+            x = jnp.take(p["trg_emb.w_0"],
+                         jnp.clip(ids.astype(jnp.int32), 0, V - 1),
+                         axis=0)                              # [S, D]
+            x = x * jnp.asarray(sqrt_d, x.dtype)
+            x = x + jnp.take(self._pe(T, D).astype(x.dtype), pos, axis=0)
+            keep = (jnp.arange(T)[None, :]
+                    <= pos[:, None])[:, None, None, :]   # [S,1,1,T]
+            for i in range(L):
+                res = x
+                q = fc(x, p[f"dec{i}_self_q.w_0"]).reshape(S, 1, H, Dh)
+                kn = fc(x, p[f"dec{i}_self_k.w_0"]).reshape(S, H, Dh)
+                vn = fc(x, p[f"dec{i}_self_v.w_0"]).reshape(S, H, Dh)
+                kc = kc.at[i, rows, pos].set(kn)
+                vc = vc.at[i, rows, pos].set(vn)
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc[i]).astype(
+                    jnp.float32) * jnp.asarray(scale, jnp.float32)
+                logits = jnp.where(keep, logits, -jnp.inf)
+                w = _attn_softmax(logits).astype(x.dtype)
+                o = jnp.einsum("bhqk,bkhd->bqhd", w, vc[i]).reshape(
+                    S, H * Dh)
+                x = ln(fc(o, p[f"dec{i}_self_o.w_0"]) + res,
+                       p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'self')}.w_0"],
+                       p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'self')}.b_0"])
+                res = x
+                q = fc(x, p[f"dec{i}_cross_q.w_0"]).reshape(S, 1, H, Dh)
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck[i]).astype(
+                    jnp.float32) * jnp.asarray(scale, jnp.float32)
+                logits = logits + src_bias
+                w = _attn_softmax(logits).astype(x.dtype)
+                o = jnp.einsum("bhqk,bkhd->bqhd", w, cv[i]).reshape(
+                    S, H * Dh)
+                x = ln(fc(o, p[f"dec{i}_cross_o.w_0"]) + res,
+                       p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'cross')}.w_0"],
+                       p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'cross')}.b_0"])
+                res = x
+                h = fc(x, p[f"dec{i}_ffn_fc1.w_0"],
+                       p[f"dec{i}_ffn_fc1.b_0"], relu=True)
+                h = fc(h, p[f"dec{i}_ffn_fc2.w_0"],
+                       p[f"dec{i}_ffn_fc2.b_0"])
+                x = ln(h + res,
+                       p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'ffn')}.w_0"],
+                       p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'ffn')}.b_0"])
+            logits = fc(x, p["proj.w_0"])                  # [S, V]
+            if topk and topk > 1:
+                vals, cand = jax.lax.top_k(logits, topk)
+                key = jax.random.PRNGKey(seed)
+                choice = jax.random.categorical(
+                    key, vals.astype(jnp.float32)
+                    / jnp.asarray(temp, jnp.float32), axis=-1)
+                nxt = jnp.take_along_axis(
+                    cand, choice[:, None], axis=-1)[:, 0]
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return kc, vc, nxt.astype(jnp.int32)
+
+        import jax as _jax
+        donate = () if _jax.default_backend() == "cpu" else (1, 2)
+        return _jax.jit(step, donate_argnums=donate)
+
+    # --------------------------------------------------------- running
+    def prefill(self, src, src_len):
+        """Run the encoder for `rows = src.shape[0]` sequences (pad
+        rows upstream to a fixed bucket set to bound compiles). src
+        must be padded to src_max_len. Returns (ck, cv, src_bias)
+        shaped [L, rows, Ts, H, Dh] / [rows, 1, 1, Ts]."""
+        import jax.numpy as jnp
+        src = np.asarray(src)
+        rows, Ts = src.shape
+        if Ts != self.src_max_len:
+            raise ValueError(f"src padded to {Ts}, decoder built for "
+                             f"src_max_len={self.src_max_len}")
+        fn = self._prefill_jit.get(rows)
+        if fn is None:
+            fn = self._build_prefill(rows)
+            self._prefill_jit[rows] = fn
+            self.compile_count += 1
+        return fn(self.params, jnp.asarray(src.astype(np.int32)),
+                  jnp.asarray(np.asarray(src_len).astype(np.int32)))
+
+    def write_slots(self, state, prefill_out, slots):
+        """Scatter `len(slots)` prefilled rows into the slot state
+        (device-side; the extra bucket-pad rows are dropped)."""
+        import jax.numpy as jnp
+        ck, cv, src_bias = prefill_out
+        n = len(slots)
+        idx = jnp.asarray(np.asarray(slots, np.int32))
+        state["ck"] = state["ck"].at[:, idx].set(ck[:, :n])
+        state["cv"] = state["cv"].at[:, idx].set(cv[:, :n])
+        state["src_bias"] = state["src_bias"].at[idx].set(src_bias[:n])
+        return state
+
+    def step(self, state, ids, pos, seed=0):
+        """One decode iteration for ALL slots: feed the current token
+        id + position per slot, get the next token id per slot (numpy
+        int32 [num_slots]). Caches update in place in `state`. Free /
+        inactive slots compute garbage lanes that the scheduler
+        ignores — the price of a static shape, and exactly one
+        compiled executable."""
+        import jax.numpy as jnp
+        if self._step_jit is None:
+            self._step_jit = self._build_step()
+            self.compile_count += 1
+        kc, vc, nxt = self._step_jit(
+            self.params, state["kc"], state["vc"], state["ck"],
+            state["cv"], state["src_bias"],
+            jnp.asarray(np.asarray(ids, np.int32)),
+            jnp.asarray(np.asarray(pos, np.int32)),
+            jnp.asarray(np.uint32(seed)))
+        state["kc"], state["vc"] = kc, vc
+        return np.asarray(nxt)

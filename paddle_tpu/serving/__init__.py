@@ -15,14 +15,26 @@ requests are dropped with `DeadlineExceeded` instead of being
 computed). Every queue/batch/reject/warmup event lands in the
 `paddle_tpu.telemetry` registry when telemetry is enabled.
 
+Autoregressive traffic gets its own tier: `paddle_tpu.serving.decode`
+(tpudecode) does continuous (iteration-level) batching over a
+static-shape KV-cache slot pool with weighted-fair-queuing multi-tenant
+QoS — attach one to a served model with `ModelServer.attach_decoder`
+and drive it over HTTP via the predict route's `max_new_tokens` /
+`tenant` fields. The decode package is imported lazily: servers that
+never attach a decoder never pay for it (pinned by the bench
+contract).
+
 `tools/tpuserve.py` is the CLI: serve a `save_inference_model` dir,
-load-test it (`--bench`), or run the CI self-test (`--selftest`).
+load-test it (`--bench`, `--bench-decode`), or run the CI self-tests
+(`--selftest`, `--selftest-decode`).
 """
 from .batcher import (BatchConfig, DynamicBatcher, Future,
-                      RejectedError, DeadlineExceeded, ServerClosed)
+                      RejectedError, DeadlineExceeded, PreemptedError,
+                      ServerClosed)
 from .server import ModelRegistry, ModelServer, ServerConfig
 from .http import HttpFrontend
 
 __all__ = ["BatchConfig", "DynamicBatcher", "Future", "RejectedError",
-           "DeadlineExceeded", "ServerClosed", "ModelRegistry",
-           "ModelServer", "ServerConfig", "HttpFrontend"]
+           "DeadlineExceeded", "PreemptedError", "ServerClosed",
+           "ModelRegistry", "ModelServer", "ServerConfig",
+           "HttpFrontend"]

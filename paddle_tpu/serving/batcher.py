@@ -30,7 +30,8 @@ from .. import telemetry as _tm
 from ..inference import bucket_feed, default_buckets
 
 __all__ = ["BatchConfig", "DynamicBatcher", "Batch", "Future",
-           "RejectedError", "DeadlineExceeded", "ServerClosed"]
+           "RejectedError", "DeadlineExceeded", "ServerClosed",
+           "PreemptedError"]
 
 # fixed edges for the batch-size histogram: the registry freezes bucket
 # edges at first creation, so this must not vary with BatchConfig
@@ -47,6 +48,14 @@ class ServerClosed(RejectedError):
 
 class DeadlineExceeded(RejectedError):
     """Request deadline expired before a result was produced."""
+
+
+class PreemptedError(RejectedError):
+    """Request evicted from its decode slot by a QoS admission in
+    favor of a tenant below its fair share (HTTP 429: retry — the
+    service is up, this tenant is just over its share right now).
+    Lives here with the rest of the admission-control vocabulary so
+    the HTTP layer never has to import the decode package."""
 
 
 class BatchConfig:

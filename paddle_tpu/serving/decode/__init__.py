@@ -1,0 +1,46 @@
+"""paddle_tpu.serving.decode — tpudecode: continuous-batching
+autoregressive decode with a static-shape KV-cache slot pool and
+multi-tenant QoS.
+
+PR 3's DynamicBatcher coalesces fixed-shape one-shot requests; real
+traffic is autoregressive, and the legacy path (`greedy_decode`)
+re-runs the whole [B, T] inference program once per token — O(T^2)
+compute, O(T*V) logits readback per step, and a request that finishes
+early rides the batch to the end. This package is the iteration-level
+fix, kept inside the repo's static-shapes discipline:
+
+- `DecodeEngine` (engine.py): compiled executables around
+  `models.transformer.IncrementalDecoder` — one bucketed prefill per
+  row bucket + exactly ONE single-token step function over a
+  `[num_slots, T_max, heads, dim]` KV-cache with in-graph
+  argmax/top-k sampling. Only [num_slots] token ids cross the host
+  boundary per step.
+- `SlotPool` (slots.py): host bookkeeping for the static decode
+  batch; join/leave is scatter/gather over pre-allocated rows, with a
+  leak-check invariant the chaos tests drive across injected crashes.
+- `ContinuousScheduler` (scheduler.py): per-iteration
+  retire-on-eos-or-deadline / admit-into-free-slots / one compiled
+  step, with bounded-queue admission control and a supervised,
+  crash-respawning loop thread (`worker_crash` chaos point).
+- `QosPolicy` (qos.py): weighted-fair-queuing admission classes with
+  optional fair-share preemption (`PreemptedError` -> HTTP 429,
+  distinct from deadline's 504), per-tenant `serving.decode.*`
+  telemetry flowing into tpustat.
+
+The package is imported lazily by the rest of serving/ (bench-contract
+pins that decode-off paths never pull it in); `ModelServer.attach_
+decoder` and the HTTP frontend's `max_new_tokens` field opt a model
+into the tier. CLI: `tools/tpuserve.py --bench-decode /
+--selftest-decode`.
+"""
+from .engine import DecodeEngine, DecodeEngineConfig
+from .qos import QosPolicy, TenantClass
+from .scheduler import (ContinuousScheduler, DecodeConfig,
+                        DecodeRequest, DecodeResult)
+from .slots import Slot, SlotPool
+from ..batcher import PreemptedError
+
+__all__ = ["DecodeEngine", "DecodeEngineConfig", "QosPolicy",
+           "TenantClass", "ContinuousScheduler", "DecodeConfig",
+           "DecodeRequest", "DecodeResult", "Slot", "SlotPool",
+           "PreemptedError"]

@@ -1,0 +1,192 @@
+"""DecodeEngine: the serving face of the incremental decoder.
+
+Wraps `models.transformer.IncrementalDecoder` with everything the
+continuous scheduler needs and nothing it doesn't:
+
+- **bucketed prefill**: admitted requests are padded row-wise to a
+  fixed bucket set (powers of two up to `num_slots` by default, the
+  same discipline as `inference.default_buckets`), so the executable
+  count stays `len(prefill_buckets) + 1` — pinned by
+  `tpuserve --selftest-decode` and surfaced as the
+  `serving.decode.compile_count` gauge;
+- **warmup**: every prefill bucket and the step function compile on
+  zero feeds at attach time, so live traffic never eats a compile
+  stall (the PR 3 warmup story, extended to the decode tier);
+- **telemetry**: prefill/step/warmup spans and counters in the
+  `serving.decode.*` namespace, flowing into tpustat like every other
+  subsystem.
+
+The scheduler talks to this class through a deliberately narrow,
+duck-typeable surface (``num_slots / max_new_tokens / init_state /
+admit / step / compile_count``) so QoS and slot logic unit-test
+against a fake engine in microseconds.
+"""
+import numpy as np
+
+from ... import telemetry as _tm
+from ...inference import default_buckets, next_bucket
+
+__all__ = ["DecodeEngineConfig", "DecodeEngine"]
+
+
+class DecodeEngineConfig:
+    """Knobs for one model's decode tier.
+
+    num_slots: decode batch rows (the KV-cache's slot dimension).
+    max_len: decode cache length (generated capacity = max_len - 1);
+        defaults to the model config's max_len.
+    src_max_len: encoder pad length; defaults to max_len.
+    prefill_buckets: admitted-row buckets (default: powers of two up
+        to num_slots).
+    topk / temperature: in-graph sampling (0 = greedy argmax).
+    """
+
+    def __init__(self, num_slots=8, max_len=None, src_max_len=None,
+                 prefill_buckets=None, topk=0, temperature=1.0):
+        self.num_slots = int(num_slots)
+        self.max_len = max_len
+        self.src_max_len = src_max_len
+        self.prefill_buckets = tuple(sorted(
+            int(b) for b in (prefill_buckets
+                             or default_buckets(self.num_slots))))
+        if self.prefill_buckets[-1] < self.num_slots:
+            raise ValueError(
+                f"largest prefill bucket {self.prefill_buckets[-1]} "
+                f"< num_slots {self.num_slots}: a full admission wave "
+                f"must fit one prefill")
+        self.topk = int(topk)
+        self.temperature = float(temperature)
+
+
+class DecodeEngine:
+    """Compiled continuous-decode executables for one transformer."""
+
+    def __init__(self, model_cfg, params, config=None):
+        from ...models.transformer import IncrementalDecoder
+        self.config = config or DecodeEngineConfig()
+        self.model_cfg = model_cfg
+        self.decoder = IncrementalDecoder(
+            model_cfg, params,
+            num_slots=self.config.num_slots,
+            max_len=self.config.max_len,
+            src_max_len=self.config.src_max_len,
+            topk=self.config.topk,
+            temperature=self.config.temperature)
+
+    # ----------------------------------------------------- constructors
+    @classmethod
+    def from_inference_engine(cls, engine, model_cfg, config=None):
+        """Share a served `InferenceEngine`'s parameters (same arrays,
+        no copy): the prefill/step executables and the full-program
+        predict path serve one checkpoint."""
+        return cls(model_cfg, engine.params(), config=config)
+
+    @classmethod
+    def from_scope(cls, scope, model_cfg, config=None, names=None):
+        """Pull parameters out of a training/infer scope by name
+        (`names` defaults to every var the scope can produce for the
+        decode set — see `models.transformer.decode_params`)."""
+        from ...models.transformer import decode_params
+        if names is None:
+            probe = {}
+            for n in _decode_name_universe(model_cfg):
+                v = scope.get(n) if hasattr(scope, "get") else None
+                if v is not None:
+                    probe[n] = np.asarray(v)
+            arrays = probe
+        else:
+            arrays = {n: np.asarray(scope.get(n)) for n in names}
+        return cls(model_cfg, decode_params(arrays, model_cfg),
+                   config=config)
+
+    # ------------------------------------------------------- properties
+    @property
+    def num_slots(self):
+        return self.config.num_slots
+
+    @property
+    def max_new_tokens(self):
+        return self.decoder.max_new_tokens
+
+    @property
+    def src_max_len(self):
+        return self.decoder.src_max_len
+
+    @property
+    def compile_count(self):
+        return self.decoder.compile_count
+
+    # -------------------------------------------------------- lifecycle
+    def init_state(self):
+        return self.decoder.init_state()
+
+    def warmup(self):
+        """Compile every prefill bucket + the step on zero feeds.
+        Returns the executable count (== len(prefill_buckets) + 1)."""
+        Ts = self.decoder.src_max_len
+        for b in self.config.prefill_buckets:
+            with _tm.span("serving.decode.warmup", bucket=b):
+                self.decoder.prefill(np.zeros((b, Ts), np.int64),
+                                     np.ones((b,), np.int64))
+            if _tm.enabled():
+                _tm.counter("serving.decode.warmup_runs").inc()
+        state = self.init_state()
+        with _tm.span("serving.decode.warmup", bucket="step"):
+            self.decoder.step(state, np.zeros(self.num_slots, np.int64),
+                              np.zeros(self.num_slots, np.int64))
+        if _tm.enabled():
+            _tm.gauge("serving.decode.compile_count").set(
+                self.compile_count)
+        return self.compile_count
+
+    # ---------------------------------------------------------- serving
+    def admit(self, state, requests, slots):
+        """Prefill `requests` (same count as `slots`) and scatter the
+        encoder caches into their slot rows. Rows are padded to the
+        next prefill bucket so the jit cache sees only bucket shapes."""
+        n = len(requests)
+        Ts = self.decoder.src_max_len
+        bucket = next_bucket(n, self.config.prefill_buckets)
+        src = np.zeros((bucket, Ts), np.int64)
+        src_len = np.ones((bucket,), np.int64)   # pad rows attend pos 0
+        for j, r in enumerate(requests):
+            s = np.asarray(r.src, np.int64).reshape(-1)
+            src[j, :len(s)] = s
+            src_len[j] = min(Ts, max(1, int(r.src_len)))
+        with _tm.span("serving.decode.prefill", rows=n, bucket=bucket):
+            out = self.decoder.prefill(src, src_len)
+        if _tm.enabled():
+            _tm.counter("serving.decode.prefill_rows").inc(n)
+            _tm.counter("serving.decode.prefill_pad_rows").inc(
+                bucket - n)
+            _tm.gauge("serving.decode.compile_count").set(
+                self.compile_count)
+        return self.decoder.write_slots(state, out, slots)
+
+    def step(self, state, ids, pos, seed=0):
+        """One decode iteration over all slots -> next ids [S]."""
+        nxt = self.decoder.step(state, ids, pos, seed=seed)
+        if _tm.enabled():
+            _tm.counter("serving.decode.steps").inc()
+            _tm.gauge("serving.decode.compile_count").set(
+                self.compile_count)
+        return nxt
+
+
+def _decode_name_universe(cfg):
+    """Every parameter name decode could need, in either checkpoint
+    layout (union of unfused + fused names; absent ones just don't
+    resolve in the scope)."""
+    names = ["src_emb.w_0", "trg_emb.w_0", "proj.w_0"]
+    for i in range(cfg.n_layer):
+        names += [f"enc{i}_{p}.w_0" for p in "qkvo"]
+        names += [f"dec{i}_self_{p}.w_0" for p in "qkvo"]
+        names += [f"dec{i}_cross_{p}.w_0" for p in "qkvo"]
+        names += [f"enc{i}_qkv.w_0", f"dec{i}_self_qkv.w_0",
+                  f"dec{i}_cross_kv.w_0", f"dec{i}_cross_q.w_0"]
+        for part in (f"enc{i}_ffn", f"dec{i}_ffn"):
+            names += [f"{part}_fc1.w_0", f"{part}_fc1.b_0",
+                      f"{part}_fc2.w_0", f"{part}_fc2.b_0"]
+    for j in range(5 * cfg.n_layer):
+        names += [f"layer_norm_{j}.w_0", f"layer_norm_{j}.b_0"]
+    return names

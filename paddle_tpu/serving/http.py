@@ -3,20 +3,32 @@
 Endpoints (TF-Serving-shaped):
 
 - ``POST /v1/models/<name>:predict`` — body
-  ``{"inputs": {feed: nested list}, "deadline_ms": opt, "version": opt}``
+  ``{"inputs": {feed: nested list}, "deadline_ms": opt, "version": opt,
+  "tenant": opt, "max_new_tokens": opt}``
   (also ``/v1/models/<name>/versions/<v>:predict``); response
   ``{"outputs": [...], "model": name, "version": v}``.
+
+  With ``max_new_tokens`` set and a decode tier attached
+  (`ModelServer.attach_decoder`), the request routes to continuous
+  decode instead of the fixed-shape batcher: ``inputs`` carries one
+  sequence (``{"src": [ids...], "src_len": opt}``) and the response is
+  ``{"outputs": [[token ids...]], "finish_reason": "eos"|"length",
+  "tenant": t, ...}``. ``tenant`` names the QoS admission class.
 - ``GET /healthz`` — 200 ``{"status": "ok"}`` while serving, 503 while
   draining (load balancers stop routing before shutdown completes).
 - ``GET /metrics`` — the telemetry registry in Prometheus text format.
 - ``GET /v1/models`` — registered names and versions.
 
-Error mapping keeps overload semantics visible to clients: queue-full
-and oversized requests are 429 (back off / retry elsewhere), expired
-deadlines are 504, unknown models 404, malformed bodies 400. A
-`ThreadingHTTPServer` thread-per-connection model is plenty here: the
-handler only parses JSON and blocks on the batcher future; the real
-concurrency story is the batcher, not the socket layer.
+Error mapping keeps overload semantics visible to clients, with a
+machine-readable ``kind`` in every error body: queue-full and
+oversized requests are 429 ``rejected`` (back off / retry elsewhere),
+QoS slot evictions are 429 ``preempted`` (the tenant is over its fair
+share right now — distinct from 504 so clients can tell "retry" from
+"too slow"), expired deadlines are 504 ``deadline``, draining is 503,
+unknown models 404, malformed bodies 400. A `ThreadingHTTPServer`
+thread-per-connection model is plenty here: the handler only parses
+JSON and blocks on a future; the real concurrency story is the
+batcher/scheduler, not the socket layer.
 """
 import json
 import re
@@ -26,7 +38,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from .. import telemetry as _tm
-from .batcher import DeadlineExceeded, RejectedError, ServerClosed
+from .batcher import (DeadlineExceeded, PreemptedError, RejectedError,
+                      ServerClosed)
 
 __all__ = ["HttpFrontend"]
 
@@ -65,10 +78,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code, msg):
+    def _error(self, code, msg, kind=None):
         if _tm.enabled():
             _tm.counter("serving.http_errors").inc()
-        self._reply(code, {"error": msg})
+        body = {"error": msg}
+        if kind:
+            body["kind"] = kind
+        self._reply(code, body)
 
     def do_GET(self):
         if _tm.enabled():
@@ -95,31 +111,65 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path!r} (want "
                         f"/v1/models/<name>:predict)")
             return
+        name = m.group("name")
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
             version = body.get("version", m.group("version"))
-            engine, version = self.model_server.registry.get(
-                m.group("name"), version)
-            feed = _coerce_inputs(engine, body.get("inputs") or {})
-            outs = self.model_server.predict(
-                m.group("name"), feed, version=version,
-                deadline_ms=body.get("deadline_ms"))
+            if body.get("max_new_tokens") is not None:
+                payload = self._decode_request(name, body, version)
+            else:
+                engine, version = self.model_server.registry.get(
+                    name, version)
+                feed = _coerce_inputs(engine, body.get("inputs") or {})
+                outs = self.model_server.predict(
+                    name, feed, version=version,
+                    deadline_ms=body.get("deadline_ms"))
+                payload = {
+                    "outputs": [np.asarray(o).tolist() for o in outs],
+                    "model": name, "version": version}
         except KeyError as e:
             self._error(404, str(e))
         except DeadlineExceeded as e:
-            self._error(504, str(e))
-        except (ServerClosed, RejectedError) as e:
-            self._error(429 if not isinstance(e, ServerClosed) else 503,
-                        str(e))
+            self._error(504, str(e), kind="deadline")
+        except PreemptedError as e:
+            self._error(429, str(e), kind="preempted")
+        except ServerClosed as e:
+            self._error(503, str(e), kind="draining")
+        except RejectedError as e:
+            self._error(429, str(e), kind="rejected")
         except (ValueError, TypeError) as e:
             self._error(400, f"bad request: {e}")
         except Exception as e:              # noqa: BLE001 — last resort
             self._error(500, f"{type(e).__name__}: {e}")
         else:
-            self._reply(200, {
-                "outputs": [np.asarray(o).tolist() for o in outs],
-                "model": m.group("name"), "version": version})
+            self._reply(200, payload)
+
+    def _decode_request(self, name, body, version):
+        """Continuous-decode leg of the predict route: one sequence
+        in, generated token ids out."""
+        if self.model_server.decoder(name) is None:
+            raise KeyError(f"model {name!r} has no decode tier "
+                           f"(max_new_tokens set on a predict-only "
+                           f"model?)")
+        inputs = body.get("inputs") or {}
+        if "src" not in inputs:
+            raise ValueError('decode request needs "inputs": '
+                             '{"src": [token ids...]}')
+        src = np.asarray(inputs["src"], dtype=np.int64).reshape(-1)
+        src_len = inputs.get("src_len")
+        if src_len is not None:
+            src_len = int(np.asarray(src_len).reshape(-1)[0])
+        result = self.model_server.decode(
+            name, src, src_len=src_len,
+            tenant=str(body.get("tenant", "default")),
+            max_new_tokens=int(body["max_new_tokens"]),
+            deadline_ms=body.get("deadline_ms"))
+        return {"outputs": [np.asarray(result.tokens).tolist()],
+                "finish_reason": result.finish_reason,
+                "tenant": result.tenant,
+                "model": name,
+                "version": int(version) if version is not None else 1}
 
 
 class HttpFrontend:

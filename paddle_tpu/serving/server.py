@@ -99,6 +99,7 @@ class ModelServer:
         self.config = config or ServerConfig()
         self.registry = ModelRegistry()
         self._served = {}            # (name, version) -> _Served
+        self._decoders = {}          # name -> ContinuousScheduler
         self._lock = threading.Lock()
         self._stopping = False
         self._draining = False
@@ -142,6 +143,50 @@ class ModelServer:
                 _tm.counter("serving.warmup_runs").inc()
         return engine.signature_count()
 
+    def attach_decoder(self, name, decoder, start=True):
+        """Attach a continuous-batching decode tier
+        (`serving.decode.ContinuousScheduler`) under `name`. Predict
+        requests carrying `max_new_tokens` route to it; fixed-shape
+        requests keep using the registered InferenceEngine (if any) —
+        a model can serve both tiers at once."""
+        if self._stopping:
+            raise ServerClosed("server is shutting down")
+        with self._lock:
+            if name in self._decoders:
+                raise ValueError(f"model {name!r} already has a "
+                                 f"decoder attached")
+            self._decoders[name] = decoder
+        if start:
+            decoder.start()
+        return decoder
+
+    def decoder(self, name):
+        """The attached decode tier for `name`, or None."""
+        with self._lock:
+            return self._decoders.get(name)
+
+    def decode(self, name, src, src_len=None, tenant="default",
+               max_new_tokens=None, deadline_ms=None, timeout=None):
+        """Blocking continuous-decode: submit one sequence, wait for
+        its `DecodeResult`. KeyError when no decoder is attached (the
+        HTTP 404/400 discriminator)."""
+        if self._stopping:
+            raise ServerClosed("server is draining")
+        with self._lock:
+            decoder = self._decoders.get(name)
+        if decoder is None:
+            raise KeyError(f"model {name!r} has no decode tier; "
+                           f"decoders: {sorted(self._decoders)}")
+        t0 = time.perf_counter()
+        future = decoder.submit(src, src_len=src_len, tenant=tenant,
+                                max_new_tokens=max_new_tokens,
+                                deadline_ms=deadline_ms)
+        out = future.result(timeout=timeout)
+        if _tm.enabled():
+            _tm.histogram("serving.decode.request_latency_seconds") \
+               .observe(time.perf_counter() - t0)
+        return out
+
     def shutdown(self, drain=True, timeout=30.0):
         """Stop accepting; optionally drain queued work, then join
         workers. With drain=False pending requests fail fast."""
@@ -149,10 +194,13 @@ class ModelServer:
             self._stopping = True
             self._draining = drain
             served = list(self._served.values())
+            decoders = list(self._decoders.values())
         for s in served:
             s.batcher.close()
             if not drain:
                 s.batcher.fail_pending()
+        for d in decoders:
+            d.stop(drain=drain, timeout=timeout)
         deadline = time.monotonic() + timeout
         for s in served:
             for t in s.threads:

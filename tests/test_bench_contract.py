@@ -114,6 +114,28 @@ def test_telemetry_off_cached_fast_path():
     assert dt < 20.0, f"100 cached steps took {dt:.1f}s (bound 20s)"
 
 
+def test_decode_off_paths_untouched():
+    """tpudecode's off contract: a server that never attaches a
+    decoder never imports the decode package (serving/__init__ must
+    stay lazy), and the serving fast paths are byte-identical to the
+    pre-decode ones — no new flag checks on the predict route beyond
+    the existing decoder-is-None lookup."""
+    code = (
+        "import sys\n"
+        "import paddle_tpu.serving\n"
+        "import paddle_tpu.serving.server\n"
+        "import paddle_tpu.serving.http\n"
+        "assert 'paddle_tpu.serving.decode' not in sys.modules, "
+        "'serving/__init__ eagerly imports the decode package'\n"
+        "assert 'paddle_tpu.serving.decode.engine' not in sys.modules\n"
+        "print('LAZY_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-800:])
+    assert "LAZY_OK" in p.stdout
+
+
 def test_resilience_off_checkpoint_forward_compatible(tmp_path):
     """save_checkpoint's crash-safe rewrite must stay readable by the
     PRE-PR reader (np.load of params.npz + json.load of

@@ -19,12 +19,27 @@ Modes:
                    requests over HTTP, and exits non-zero unless
                    compile_count <= bucket count, every response matches
                    unbatched InferenceEngine.run, and overload requests
-                   are rejected within their deadline.
+                   are rejected within their deadline. Includes the
+                   decode leg (below).
+  --selftest-decode
+                   just the tpudecode CI gate: continuous-batching
+                   decode over a tiny transformer must be token-
+                   identical to one-at-a-time greedy_decode under
+                   staggered arrivals/mixed lengths, the executable
+                   count must stay == prefill buckets + 1, and
+                   overload must shed fast.
+  --bench-decode   continuous-decode closed loop at ~10x overload vs
+                   the PR 3 fixed-batch greedy_decode path on the SAME
+                   model: goodput (useful tokens/s), p50/p99
+                   time-to-first-token and per-token latency; writes
+                   the BENCH_decode.json artifact.
 
 Examples:
   python tools/tpuserve.py /models/mnist --name mnist --port 8500
   python tools/tpuserve.py /models/mnist --bench --duration 5 --json
   python tools/tpuserve.py --selftest --json
+  python tools/tpuserve.py --selftest-decode --json
+  python tools/tpuserve.py --bench-decode --duration 5 --json
 """
 import argparse
 import json
@@ -373,8 +388,13 @@ def run_selftest(args):
         frontend.stop()
         server.shutdown()
 
+    # decode leg: continuous batching must match one-at-a-time
+    # greedy_decode exactly, with a pinned executable count
+    decode_info = _decode_selftest_problems(problems)
+
     result = {
         "mode": "selftest",
+        "decode": decode_info,
         "buckets": list(buckets),
         "warmup_signatures": warm_sigs,
         "signatures_after_traffic": sigs,
@@ -401,6 +421,317 @@ def run_selftest(args):
         for prob in problems:
             print(f"FAIL: {prob}", file=sys.stderr)
     return 2 if problems else 0
+
+
+# -------------------------------------------------------------- tpudecode
+def _decode_stack(seed=7, maxlen=16, vocab=64, d_model=32, n_layer=2):
+    """Tiny transformer for the decode selftest/bench: infer program +
+    executor with SEEDED random parameters (drawn wide enough that
+    argmax tokens vary across rows/steps — a fresh default init is
+    degenerate) and the same params as a plain dict for the decode
+    engine. Returns (cfg, exe, infer_program, logits_var, params)."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.core import framework as fw
+    from paddle_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        src_vocab=vocab, trg_vocab=vocab, max_len=maxlen,
+        d_model=d_model, d_inner=2 * d_model, n_head=4,
+        n_layer=n_layer, dropout=0.0, label_smooth_eps=0.0)
+    infer, start = fw.Program(), fw.Program()
+    with pt.program_guard(infer, start):
+        with pt.unique_name.guard():
+            _feeds, logits = tfm.build_infer_program(cfg, maxlen=maxlen)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(start)
+    rng = np.random.RandomState(seed)
+    scope = pt.global_scope()
+    params = {}
+    for v in infer.persistable_vars():
+        a = np.asarray(scope.get(v.name))
+        if v.name.startswith("layer_norm") and v.name.endswith(".w_0"):
+            nv = 1.0 + 0.2 * rng.randn(*a.shape)
+        elif v.name.endswith(".b_0"):
+            nv = 0.1 * rng.randn(*a.shape)
+        else:
+            nv = 0.35 * rng.randn(*a.shape)
+        nv = nv.astype(a.dtype)
+        scope.set(v.name, nv)
+        params[v.name] = nv
+    return cfg, exe, infer, logits, params
+
+
+def _decode_requests(rng, count, maxlen, vocab, max_new_cap):
+    """Seeded mixed-length request set [(src, src_len, max_new)...]."""
+    reqs = []
+    for _ in range(count):
+        n = int(rng.randint(3, maxlen + 1))
+        src = rng.randint(2, vocab - 2, (n,)).astype("int64")
+        max_new = int(rng.randint(3, max_new_cap + 1))
+        reqs.append((src, n, max_new))
+    return reqs
+
+
+def _decode_selftest_problems(problems):
+    """The tpudecode CI leg; appends failures to `problems`, returns
+    an info dict for the report."""
+    import numpy as np
+    from paddle_tpu.models.transformer import greedy_decode
+    from paddle_tpu.serving import RejectedError, DeadlineExceeded
+    from paddle_tpu.serving.decode import (ContinuousScheduler,
+                                           DecodeConfig, DecodeEngine,
+                                           DecodeEngineConfig)
+
+    maxlen, slots, buckets = 16, 4, (1, 2, 4)
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+    engine = DecodeEngine(cfg, params, DecodeEngineConfig(
+        num_slots=slots, max_len=maxlen, prefill_buckets=buckets))
+    sched = ContinuousScheduler(engine, config=DecodeConfig(bos=0),
+                                warmup=True)
+    warm = engine.compile_count
+    if warm != len(buckets) + 1:
+        problems.append(
+            f"decode warmup compiled {warm} executables, expected "
+            f"{len(buckets)} prefill buckets + 1 step")
+
+    # one-at-a-time greedy_decode reference (the legacy full-program
+    # path, with the in-graph argmax fetch) for a mixed-length set
+    rng = np.random.RandomState(11)
+    reqs = _decode_requests(rng, 8, maxlen, cfg.trg_vocab,
+                            engine.max_new_tokens)
+    expected = []
+    for src, n, max_new in reqs:
+        row = np.zeros((1, maxlen), np.int64)
+        row[0, :n] = src
+        ids = greedy_decode(exe, infer, logits, row,
+                            np.array([n], "int64"), bos=0,
+                            fetch_argmax=True)
+        expected.append(ids[0, 1:1 + max_new])
+
+    # continuous, manually driven, STAGGERED arrivals: requests join
+    # the running batch mid-flight, finished ones leave early
+    futures = []
+    arrivals = {0: [0, 1], 2: [2, 3, 4], 5: [5], 6: [6, 7]}
+    it = 0
+    while len(futures) < len(reqs) or not all(
+            f.done() for f in futures):
+        for i in arrivals.get(it, ()):
+            src, n, max_new = reqs[i]
+            futures.append(sched.submit(src, src_len=n,
+                                        max_new_tokens=max_new))
+        sched.run_iteration()
+        it += 1
+        if it > 600:
+            problems.append("decode selftest did not converge in "
+                            "600 iterations")
+            break
+    mismatches = 0
+    for i, f in enumerate(futures):
+        if not f.done():
+            continue
+        got = f.result(timeout=0).tokens
+        if not np.array_equal(np.asarray(got, np.int64), expected[i]):
+            mismatches += 1
+    if mismatches:
+        problems.append(
+            f"{mismatches}/{len(reqs)} continuous-decode outputs "
+            f"differ from one-at-a-time greedy_decode — iteration-"
+            f"level batching changed the tokens")
+    steady = engine.compile_count
+    if steady != warm:
+        problems.append(
+            f"decode compiled {steady - warm} NEW executables under "
+            f"traffic (compile count must stay prefill buckets + 1)")
+    if sched.pool.free_count() != slots:
+        problems.append("decode slots leaked after drain")
+
+    # overload shed: no loop thread attached == permanently stalled
+    # worker; the bounded queue + deadline must both fire fast
+    shed = ContinuousScheduler(
+        engine, config=DecodeConfig(max_queue_requests=2),
+        warmup=False)
+    f1 = shed.submit(np.arange(2, 6), deadline_ms=150)
+    shed.submit(np.arange(2, 6))
+    t0 = time.perf_counter()
+    rejected_fast = deadline_fast = False
+    try:
+        shed.submit(np.arange(2, 6))
+    except RejectedError:
+        rejected_fast = time.perf_counter() - t0 < 0.1
+    if not rejected_fast:
+        problems.append("decode queue-full submit was not rejected "
+                        "fast")
+    t0 = time.perf_counter()
+    try:
+        f1.result()
+        problems.append("stalled decode request returned a result")
+    except DeadlineExceeded:
+        deadline_fast = time.perf_counter() - t0 < 1.0
+    if not deadline_fast:
+        problems.append("decode deadline enforcement took > 1s on a "
+                        "stalled scheduler")
+    return {"warmup_executables": warm,
+            "steady_executables": steady,
+            "prefill_buckets": list(buckets),
+            "requests": len(reqs),
+            "mismatches": mismatches,
+            "overload": {"rejected_fast": rejected_fast,
+                         "deadline_fast": deadline_fast}}
+
+
+def run_selftest_decode(args):
+    from paddle_tpu import telemetry
+    telemetry.enable()
+    problems = []
+    info = _decode_selftest_problems(problems)
+    result = {"mode": "selftest-decode", **info,
+              "problems": problems, "ok": not problems}
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    else:
+        print(f"tpuserve selftest-decode: {info['warmup_executables']} "
+              f"executables for {len(info['prefill_buckets'])} prefill "
+              f"buckets + 1 step; {info['requests']} staggered "
+              f"requests, {info['mismatches']} mismatches")
+        for prob in problems:
+            print(f"FAIL: {prob}", file=sys.stderr)
+    return 2 if problems else 0
+
+
+def run_bench_decode(args):
+    """Continuous decode vs the PR 3 fixed-batch path, same model,
+    ~10x overload. Writes BENCH_decode.json next to the repo root."""
+    import numpy as np
+    from paddle_tpu import telemetry
+    from paddle_tpu.models.transformer import greedy_decode
+    from paddle_tpu.serving import RejectedError
+    from paddle_tpu.serving.decode import (ContinuousScheduler,
+                                           DecodeConfig, DecodeEngine,
+                                           DecodeEngineConfig)
+    telemetry.enable()
+
+    maxlen, slots = args.decode_max_len, args.slots
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+    engine = DecodeEngine(cfg, params, DecodeEngineConfig(
+        num_slots=slots, max_len=maxlen))
+    sched = ContinuousScheduler(
+        engine,
+        config=DecodeConfig(max_queue_requests=4 * slots),
+        warmup=True).start()
+
+    rng = np.random.RandomState(23)
+    reqs = _decode_requests(rng, 256, maxlen, cfg.trg_vocab,
+                            engine.max_new_tokens)
+
+    # ---- continuous tier: closed loop at ~10x the slot count --------
+    stop_t = time.monotonic() + args.duration
+    lock = threading.Lock()
+    done_tokens, ttfts, per_tok, rejects = [0], [], [], [0]
+
+    def client(wid):
+        i = wid
+        while time.monotonic() < stop_t:
+            src, n, max_new = reqs[i % len(reqs)]
+            i += 10 * slots
+            try:
+                r = sched.submit(src, src_len=n,
+                                 max_new_tokens=max_new).result(
+                    timeout=max(5.0, args.duration))
+            except RejectedError:
+                with lock:
+                    rejects[0] += 1
+                time.sleep(0.002)
+                continue
+            except TimeoutError:
+                continue
+            with lock:
+                done_tokens[0] += len(r.tokens)
+                if r.ttft_s is not None:
+                    ttfts.append(r.ttft_s)
+                if len(r.tokens) > 1:
+                    per_tok.append(r.decode_s / len(r.tokens))
+
+    clients = [threading.Thread(target=client, args=(w,), daemon=True)
+               for w in range(10 * slots)]
+    t0 = time.monotonic()
+    for t in clients:
+        t.start()
+    for t in clients:
+        t.join()
+    cont_s = time.monotonic() - t0
+    sched.stop(drain=False, timeout=10.0)
+    ttfts.sort()
+    per_tok.sort()
+    continuous = {
+        "duration_s": round(cont_s, 3),
+        "goodput_tokens_per_s": round(done_tokens[0] / cont_s, 1),
+        "completed_tokens": done_tokens[0],
+        "rejected": rejects[0],
+        "ttft_p50_ms": round(1e3 * _percentile(ttfts, 0.5), 2)
+        if ttfts else None,
+        "ttft_p99_ms": round(1e3 * _percentile(ttfts, 0.99), 2)
+        if ttfts else None,
+        "per_token_p50_ms": round(1e3 * _percentile(per_tok, 0.5), 2)
+        if per_tok else None,
+        "per_token_p99_ms": round(1e3 * _percentile(per_tok, 0.99), 2)
+        if per_tok else None,
+        "executables": engine.compile_count,
+        "slots": slots,
+    }
+
+    # ---- PR 3 fixed-batch path: greedy_decode in rigid batches ------
+    # (one [slots, T] executable re-running the whole prefix per
+    # token; early finishers ride the batch to the end)
+    stop_t = time.monotonic() + args.duration
+    t0 = time.monotonic()
+    useful = batches = 0
+    i = 0
+    while time.monotonic() < stop_t:
+        group = [reqs[(i + j) % len(reqs)] for j in range(slots)]
+        i += slots
+        src = np.zeros((slots, maxlen), np.int64)
+        src_len = np.zeros((slots,), np.int64)
+        for j, (s, n, _mn) in enumerate(group):
+            src[j, :n] = s
+            src_len[j] = n
+        greedy_decode(exe, infer, logits, src, src_len, bos=0,
+                      fetch_argmax=True)
+        useful += sum(mn for _s, _n, mn in group)
+        batches += 1
+    fixed_s = time.monotonic() - t0
+    fixed = {
+        "duration_s": round(fixed_s, 3),
+        "goodput_tokens_per_s": round(useful / fixed_s, 1),
+        "completed_tokens": useful,
+        "batches": batches,
+        "batch_rows": slots,
+    }
+
+    ratio = None
+    if fixed["goodput_tokens_per_s"]:
+        ratio = round(continuous["goodput_tokens_per_s"]
+                      / fixed["goodput_tokens_per_s"], 2)
+    result = {"mode": "bench-decode", "model": "transformer-tiny",
+              "maxlen": maxlen, "overload_clients": 10 * slots,
+              "continuous": continuous, "fixed_batch": fixed,
+              "goodput_ratio": ratio}
+    out_path = os.path.join(_REPO, "BENCH_decode.json")
+    try:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+    except OSError:
+        pass
+    if args.as_json:
+        print(json.dumps(result))
+    else:
+        print(f"  continuous goodput  "
+              f"{continuous['goodput_tokens_per_s']} tok/s "
+              f"(ttft p50 {continuous['ttft_p50_ms']} ms)")
+        print(f"  fixed-batch goodput {fixed['goodput_tokens_per_s']} "
+              f"tok/s")
+        print(f"  ratio               {ratio}x")
+    return 0
 
 
 # ------------------------------------------------------------------ serve
@@ -456,7 +787,23 @@ def main(argv=None):
     p.add_argument("--selftest", action="store_true",
                    help="CI gate: serve mnist, mixed-shape concurrent "
                         "load, exit non-zero on compile explosion / "
-                        "result mismatch / unbounded overload")
+                        "result mismatch / unbounded overload "
+                        "(includes the decode leg)")
+    p.add_argument("--selftest-decode", action="store_true",
+                   dest="selftest_decode",
+                   help="just the tpudecode CI gate: greedy_decode "
+                        "parity under staggered arrivals, pinned "
+                        "executable count, fast overload shed")
+    p.add_argument("--bench-decode", action="store_true",
+                   dest="bench_decode",
+                   help="continuous decode vs the fixed-batch "
+                        "greedy_decode path at ~10x overload; writes "
+                        "BENCH_decode.json")
+    p.add_argument("--slots", type=int, default=8,
+                   help="--bench-decode slot-pool size")
+    p.add_argument("--decode-max-len", type=int, default=32,
+                   dest="decode_max_len",
+                   help="--bench-decode sequence/cache length")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="one machine-readable JSON line")
     args = p.parse_args(argv)
@@ -465,8 +812,13 @@ def main(argv=None):
         os.environ["JAX_PLATFORMS"] = args.platform
     if args.selftest:
         return run_selftest(args)
+    if args.selftest_decode:
+        return run_selftest_decode(args)
+    if args.bench_decode:
+        return run_bench_decode(args)
     if not args.model_dir:
-        p.error("model_dir is required unless --selftest")
+        p.error("model_dir is required unless --selftest / "
+                "--selftest-decode / --bench-decode")
     if args.bench:
         return run_bench(args)
     return run_serve(args)
