@@ -534,6 +534,12 @@ def bench_deepfm(platform):
     on_tpu = platform in ("tpu", "axon")
     B, F = (4096, 26) if on_tpu else (64, 6)
     vocab = 8_000_000 if on_tpu else 1000
+    # `bench.py --deepfm-vocab-rows=N` (env BENCH_DEEPFM_VOCAB_ROWS):
+    # scale the CTR vocabulary; vocabularies past single-device HBM
+    # belong to the sharded engine (`bench.py --sparse`, BENCH_sparse)
+    env_vocab = os.environ.get("BENCH_DEEPFM_VOCAB_ROWS")
+    if env_vocab:
+        vocab = int(float(env_vocab))
     main_p, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_p, startup):
         with pt.unique_name.guard():
@@ -569,9 +575,17 @@ def bench_deepfm(platform):
 
     dt = _median_window_time(window, 3 if on_tpu else 1)
     assert np.isfinite(state["loss"])
+    ids_np = np.asarray(feed["feat_ids"]).reshape(-1)
     out = {"deepfm_examples_per_sec": round(n * B / dt, 1),
            "deepfm_step_ms": round(dt / n * 1e3, 2),
-           "deepfm_vocab_rows": vocab}
+           "deepfm_vocab_rows": vocab,
+           # dedup opportunity of the batch (the sharded engine's wire
+           # win scales with 1 - unique_ratio); this dense-path stage
+           # exchanges nothing — the engine numbers live in
+           # BENCH_sparse.json (`bench.py --sparse`)
+           "deepfm_unique_ratio": round(
+               len(np.unique(ids_np)) / ids_np.size, 4),
+           "deepfm_exchange_bytes": 0}
     try:
         stats = jax.devices()[0].memory_stats()
         if stats and stats.get("peak_bytes_in_use"):
@@ -1124,13 +1138,143 @@ def _grad_sync_mode(steps=10, n_devices=8, mode="int8"):
         restore()
 
 
+def _sparse_mode(vocab_rows=100_000_000, steps=8, n_devices=8):
+    """`bench.py --sparse[=VOCAB_ROWS]`: DeepFM through the sharded
+    embedding engine (parallel/sparse.py, ROADMAP item 5) on an
+    8-virtual-device CPU mesh. The tables are never materialized on
+    one device: startup init is stripped and each mesh member seeds
+    only its vocab/N rows (engine.init_shards), so vocab_rows=1e8
+    (the default — the pserver-era scale) holds ~400 MB of table per
+    member instead of 3.2 GB anywhere. Ids follow a hot-set mixture
+    (30% of positions from 1k hot ids — CTR-style popularity skew) so
+    the unique-ids dedup has a measurable ratio. SGD keeps the 1e8
+    footprint at 1x table (lazy-Adam moments would 3x it; the engine
+    supports both). Prints ONE JSON line + BENCH_sparse.json with
+    examples/s, the dedup ratio, and the per-step exchange bytes."""
+    import __graft_entry__ as graft
+    restore = graft._force_cpu_mesh(n_devices)
+    try:
+        import jax
+        import paddle_tpu as pt
+        from paddle_tpu import telemetry
+        from paddle_tpu.models import deepfm
+        from paddle_tpu.parallel import sparse as tpusparse
+
+        B, F, D = 512, 26, 8
+        main_p, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup):
+            with pt.unique_name.guard():
+                feeds, loss, prob = deepfm.build_program(
+                    num_fields=F, vocab_size=vocab_rows, embed_dim=D,
+                    is_distributed=True)
+                pt.optimizer.SGD(0.1).minimize(loss)
+        main_p.random_seed = startup.random_seed = 1
+        tables = tpusparse.discover_tables(main_p)
+        tpusparse.strip_table_init(startup, tables)
+        rng = np.random.RandomState(0)
+        hot = rng.randint(0, vocab_rows, 1000)
+        flat = np.where(rng.rand(B * F) < 0.3,
+                        hot[rng.randint(0, 1000, B * F)],
+                        rng.randint(0, vocab_rows, B * F))
+        feed = {"feat_ids": flat.reshape(B, F, 1).astype("int64"),
+                "feat_vals": rng.rand(B, F).astype("float32"),
+                "label": rng.randint(0, 2, (B, 1)).astype("float32")}
+        was_on = telemetry.enabled()
+        telemetry.enable()
+        telemetry.reset()
+        scope = pt.Scope()
+        try:
+            with pt.scope_guard(scope):
+                exe = pt.Executor(pt.CPUPlace())
+                exe.run(startup)
+                pexe = pt.ParallelExecutor(
+                    loss_name=loss.name, main_program=main_p,
+                    scope=scope, sparse="shard")
+                t0 = time.perf_counter()
+                pexe.sparse_engine.init_shards(scope, seed=1)
+                init_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                l0 = float(np.asarray(pexe.run(
+                    feed=feed, fetch_list=[loss])[0]))  # compile
+                compile_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    last = float(np.asarray(pexe.run(
+                        feed=feed, fetch_list=[loss])[0]))
+                dt = time.perf_counter() - t0
+                eng = pexe.sparse_engine
+                shard_rows = {
+                    t: eng.tables[t].local_rows for t in tables}
+                stats = {t: np.asarray(
+                    scope.get(tpusparse.STATS_PREFIX + t))
+                    for t in tables}
+            snap = telemetry.snapshot()
+        finally:
+            telemetry.reset()
+            if not was_on:
+                telemetry.disable()
+        uniq = {t: round(float(s[1] / max(s[0], 1)), 4)
+                for t, s in stats.items()}
+        exchange = {t: int(snap.get(f"embed.{t}.exchange_bytes", 0))
+                    for t in tables}
+        ratio = sum(uniq.values()) / max(len(uniq), 1)
+        result = {
+            "metric": "sparse_deepfm_examples_per_sec",
+            "value": round(steps * B / dt, 1),
+            "unit": "examples/sec",
+            "vs_baseline": 0.0,
+            "platform": "cpu",
+            "vocab_rows": vocab_rows,
+            "n_devices": n_devices,
+            "embed_dim": D,
+            "batch": B,
+            "fields": F,
+            "step_ms": round(dt / steps * 1e3, 2),
+            "init_shards_s": round(init_s, 1),
+            "compile_s": round(compile_s, 1),
+            "unique_ratio": uniq,
+            "unique_ratio_mean": round(ratio, 4),
+            # trace-time wire accounting: one traced step's all-to-all
+            # payload per table (ids out + rows back, both directions)
+            "exchange_bytes_per_step": exchange,
+            "rows_per_shard": shard_rows,
+            "loss_first": round(l0, 5),
+            "loss_last": round(last, 5),
+            "trains": bool(np.isfinite(last) and last < l0),
+        }
+        try:
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_sparse.json")
+            with open(path, "w") as f:
+                json.dump({"schema": "paddle_tpu.bench.sparse.v1",
+                           **result}, f, indent=1)
+        except OSError:
+            pass
+        _emit(result)
+        return 0 if result["trains"] else 1
+    finally:
+        restore()
+
+
 def main():
+    for i, arg in enumerate(sys.argv[1:], start=1):
+        if arg.startswith("--deepfm-vocab-rows"):
+            _, eq, v = arg.partition("=")
+            val = v if eq else (sys.argv[i + 1]
+                                if len(sys.argv) > i + 1 else "")
+            if val:
+                os.environ["BENCH_DEEPFM_VOCAB_ROWS"] = val
     for i, arg in enumerate(sys.argv[1:], start=1):
         if arg.startswith("--grad-sync"):
             _, eq, v = arg.partition("=")
             mode = v if eq else (sys.argv[i + 1]
                                  if len(sys.argv) > i + 1 else "int8")
             sys.exit(_grad_sync_mode(mode=mode or "int8"))
+        if arg.startswith("--sparse"):
+            _, eq, v = arg.partition("=")
+            vocab = int(float(v)) if eq and v else 100_000_000
+            sys.exit(_sparse_mode(vocab_rows=vocab))
     if os.environ.get("BENCH_CHILD"):
         _child_main()
     else:

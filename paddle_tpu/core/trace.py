@@ -335,25 +335,43 @@ def _collect_sparse_deltas(program, ops):
 
 
 def build_step_fn(program, fetch_names, is_test, place,
-                  grad_transform=None):
+                  grad_transform=None, sparse_engine=None):
     """Returns step(persist, feed, key) -> (fetches, new_persist).
 
     Pure and jittable; the op list/attrs are closed over (static).
 
     grad_transform: optional hook applied at the point where data-
     parallel gradients are summed — called as
-    `grad_transform(dense_grads, env) -> (synced_grads, extra_persist)`
-    right after jax.value_and_grad, before the optimizer tail, with the
-    dense param grads (sparse row-grads excluded) and the full env.
-    `extra_persist` entries (e.g. gradsync error-feedback residuals)
-    join new_persist even though they are not program vars. The
-    parallel gradsync policy layer threads through here; None keeps the
-    step bit-identical to before the hook existed."""
+    `grad_transform(grads, env) -> (synced_grads, extra_persist)`
+    right after jax.value_and_grad, before the optimizer tail, with ALL
+    grads (dense param grads keyed by param name AND is_sparse row
+    grads keyed by their delta-tap name) and the full env; the returned
+    dict overrides matching entries. `extra_persist` entries (e.g.
+    gradsync error-feedback residuals) join new_persist even though
+    they are not program vars. The parallel gradsync policy layer
+    threads through here; None keeps the step bit-identical to before
+    the hook existed.
+
+    sparse_engine: optional parallel/sparse.py SparseEngine — THE
+    dispatch hook for mesh-sharded embedding tables. Ops the engine
+    owns (lookup_table on a distributed table, its sparse_sgd /
+    sparse_adam tail updates) execute through the engine instead of
+    their registered kernels, and the engine's non-program state
+    (stats accumulators, stale-update rings) joins new_persist. None
+    (every path but the explicit ParallelExecutor sparse one) leaves
+    dispatch byte-for-byte untouched."""
     block = program.global_block()
     ops = _prune_ops(program, list(block.ops), fetch_names)
     persist_names = [v.name for v in program.persistable_vars()]
     bi = _find_backward(ops)
     sparse_deltas = _collect_sparse_deltas(program, ops)
+    eng = sparse_engine
+
+    def run_op(e, op, i, key):
+        if eng is not None and eng.owns(op):
+            eng.exec(e, op)
+        else:
+            exec_op(e, op, i, key, is_test, place, block)
 
     def step(persist, feed, key):
         env = {}
@@ -368,7 +386,7 @@ def build_step_fn(program, fetch_names, is_test, place,
                 env[dname] = jnp.zeros((), env[wname].dtype)
         if bi is None:
             for i, op in enumerate(ops):
-                exec_op(env, op, i, key, is_test, place, block)
+                run_op(env, op, i, key)
         else:
             bop = ops[bi]
             pnames = bop.attrs["param_names"]
@@ -379,7 +397,7 @@ def build_step_fn(program, fetch_names, is_test, place,
                 e = dict(base_env)
                 e.update(pvals)
                 for i, op in enumerate(ops[:bi]):
-                    exec_op(e, op, i, key, is_test, place, block)
+                    run_op(e, op, i, key)
                 loss = e[loss_name]
                 return jnp.sum(loss.astype(jnp.float32)), e
 
@@ -406,7 +424,7 @@ def build_step_fn(program, fetch_names, is_test, place,
                 def _probe(_):
                     e = dict(base_env)
                     for i, op in enumerate(ops[:bi]):
-                        exec_op(e, op, i, key, is_test, place, block)
+                        run_op(e, op, i, key)
                     return {n: e[n] for n in missing}
 
                 ids_shapes = {n: v.shape for n, v in
@@ -424,9 +442,8 @@ def build_step_fn(program, fetch_names, is_test, place,
                     tap_grads[tap["delta"]] = tap["grad"]
             (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(pvals)
             if grad_transform is not None:
-                dense, extra_persist = grad_transform(
-                    {n: grads[n] for n in pnames}, env)
-                grads = dict(grads, **dense)
+                synced, extra_persist = grad_transform(dict(grads), env)
+                grads = dict(grads, **synced)
             for n in pnames:
                 env[grad_var_name(n)] = grads[n].astype(env[n].dtype) \
                     if hasattr(grads[n], "astype") else grads[n]
@@ -437,16 +454,17 @@ def build_step_fn(program, fetch_names, is_test, place,
             if FUSE_OPTIMIZER_TAIL:
                 for entry in _plan_update_tail(tail):
                     if entry[0] == "op":
-                        exec_op(env, entry[1], entry[2], key, is_test,
-                                place, block)
+                        run_op(env, entry[1], entry[2], key)
                     else:
                         _exec_adam_run(env, entry[1], key, is_test,
                                        place, block)
             else:
                 for op, i in tail:
-                    exec_op(env, op, i, key, is_test, place, block)
+                    run_op(env, op, i, key)
         new_persist = {n: env[n] for n in persist_names if n in env}
         new_persist.update(extra_persist)
+        if eng is not None:
+            new_persist.update(eng.collect(env))
         fetches = [env[n] for n in fetch_names]
         return fetches, new_persist
 

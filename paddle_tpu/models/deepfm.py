@@ -14,11 +14,18 @@ __all__ = ["deepfm", "build_program"]
 
 
 def deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim=10,
-           deep_layers=(400, 400, 400), is_sparse=True):
-    """feat_ids/feat_vals: [B, num_fields(,1)] sparse-feature ids+values."""
+           deep_layers=(400, 400, 400), is_sparse=True,
+           is_distributed=False):
+    """feat_ids/feat_vals: [B, num_fields(,1)] sparse-feature ids+values.
+
+    is_distributed=True marks BOTH tables for the mesh-sharded engine
+    (parallel/sparse.py): ParallelExecutor(sparse="shard") row-shards
+    them mod-N over the dp axis — vocabularies past single-device HBM
+    (the pserver workload, `bench.py --sparse`)."""
     # ---- first-order term: w_i * x_i
     first_w = layers.embedding(feat_ids, size=[vocab_size, 1],
-                               is_sparse=is_sparse)               # [B,F,1]
+                               is_sparse=is_sparse,
+                               is_distributed=is_distributed)     # [B,F,1]
     vals = layers.unsqueeze(feat_vals, [2]) \
         if len(feat_vals.shape) == 2 else feat_vals
     first = layers.reduce_sum(
@@ -27,7 +34,8 @@ def deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim=10,
         keep_dim=True)                                            # [B,1]
     # ---- second-order FM term: 0.5*((sum v x)^2 - sum (v x)^2)
     emb = layers.embedding(feat_ids, size=[vocab_size, embed_dim],
-                           is_sparse=is_sparse)                   # [B,F,D]
+                           is_sparse=is_sparse,
+                           is_distributed=is_distributed)         # [B,F,D]
     vx = layers.elementwise_mul(emb, vals)                        # broadcast
     sum_vx = layers.reduce_sum(vx, dim=1)                         # [B,D]
     sum_sq = layers.elementwise_mul(sum_vx, sum_vx)
@@ -47,13 +55,13 @@ def deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim=10,
 
 
 def build_program(num_fields=26, vocab_size=100000, embed_dim=10,
-                  is_sparse=True):
+                  is_sparse=True, is_distributed=False):
     feat_ids = layers.data("feat_ids", shape=[num_fields], dtype="int64")
     feat_vals = layers.data("feat_vals", shape=[num_fields],
                             dtype="float32")
     label = layers.data("label", shape=[1], dtype="float32")
     logit = deepfm(feat_ids, feat_vals, num_fields, vocab_size, embed_dim,
-                   is_sparse=is_sparse)
+                   is_sparse=is_sparse, is_distributed=is_distributed)
     loss = layers.mean(
         layers.sigmoid_cross_entropy_with_logits(logit, label))
     from ..layers import ops

@@ -384,6 +384,43 @@ _alias("hierarchical_sigmoid", "hsigmoid")
 _alias("lookup_sparse_table", "lookup_table")
 
 
+@kernel("fused_embedding_seq_pool")
+def _fused_embedding_seq_pool(ctx, ins, attrs):
+    """ref operators/fused/fused_embedding_seq_pool_op.h: lookup_table
+    + sequence_pool (sum/mean over the field/sequence axis) in one op,
+    here dispatched to the Pallas fused lookup+pool kernel when the
+    capability probe accepts (ops/pallas/embedding.py) and to the
+    lowered jnp gather+reduce composition otherwise — both paths share
+    one convention (negative/padding ids contribute zero and are
+    excluded from the mean denominator). Optional Weight input gives
+    the weighted pool (first-order CTR terms: sum_f w_i * x_i)."""
+    from .pallas import embedding as pemb
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ids = ids.astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    if ids.ndim == 1:
+        ids = ids[:, None]
+    weights = _opt(ins, "Weight")
+    if weights is not None and weights.ndim > 2:
+        weights = weights.reshape(ids.shape)
+    pool = attrs.get("pooltype", attrs.get("combiner", "sum")).lower()
+    if pool not in ("sum", "mean", "average"):
+        raise NotImplementedError(
+            f"fused_embedding_seq_pool pooltype {pool!r} (sum/mean only)")
+    pool = "mean" if pool in ("mean", "average") else "sum"
+    padding_idx = attrs.get("padding_idx", -1)
+    # out-of-range ids clip like _lookup_table; the padding id maps to
+    # the kernel's negative-invalid convention (zero contribution)
+    inv = jnp.clip(ids, 0, w.shape[0] - 1)
+    if padding_idx is not None and padding_idx >= 0:
+        inv = jnp.where(ids == padding_idx, -1, inv)
+    out = pemb.try_lookup_pool(w, inv, weights, pool)
+    if out is None:
+        out = pemb.lookup_pool_reference(w, inv, weights, pool)
+    return {"Out": [out]}
+
+
 @kernel("ctc_align")
 def _ctc_align(ctx, ins, attrs):
     """ref ctc_align_op.cc: collapse repeats then drop blanks over id

@@ -395,6 +395,32 @@ def _merge_taps(ins, dim):
     return ids, rows
 
 
+def sgd_row_update(p_rows, gsum, lr):
+    """THE row-sparse SGD formula, shared by the sparse_sgd kernel and
+    the sharded-embedding engine (parallel/sparse.py) so there is no
+    second copy of the update math to drift. All math in fp32."""
+    return p_rows.astype(jnp.float32) - lr * gsum
+
+
+def adam_row_update(p_rows, m_rows, v_rows, gsum, lr, b1, b2, eps,
+                    b1p_new, b2p_new):
+    """THE lazy row-sparse Adam formula (ref adam_op.h
+    SparseAdamFunctor), shared by the sparse_adam kernel and the
+    sharded-embedding engine. Returns (p_new, m_new, v_new) in fp32;
+    b1p_new/b2p_new are the ALREADY-advanced beta-pow accumulators."""
+    m_new = b1 * m_rows + (1 - b1) * gsum
+    v_new = b2 * v_rows + (1 - b2) * jnp.square(gsum)
+    lr_t = lr * jnp.sqrt(1 - b2p_new.reshape(())) / (1 - b1p_new.reshape(()))
+    p_new = p_rows.astype(jnp.float32) \
+        - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return p_new, m_new, v_new
+
+
+# public alias: the sharded-embedding engine (parallel/sparse.py) uses
+# the same static-shape duplicate-id merge on its exchanged row grads
+dedup_rows = _dedup_rows
+
+
 @kernel("sparse_sgd")
 def _sparse_sgd(ctx, ins, attrs):
     """Row-sparse SGD: only rows named by Ids change (ref
@@ -404,7 +430,7 @@ def _sparse_sgd(ctx, ins, attrs):
     ids, g = _merge_taps(ins, p.shape[-1])
     uids, gsum = _dedup_rows(ids, g, p.shape[0])
     rows = jnp.take(p, jnp.clip(uids, 0, p.shape[0] - 1), axis=0)
-    new_rows = rows.astype(jnp.float32) - _lr(ins) * gsum
+    new_rows = sgd_row_update(rows, gsum, _lr(ins))
     out = p.at[uids].set(new_rows.astype(p.dtype), mode="drop",
                          indices_are_sorted=True)
     return {"ParamOut": [out]}
@@ -431,13 +457,11 @@ def _sparse_adam(ctx, ins, attrs):
     safe = jnp.clip(uids, 0, vocab - 1)
     m_rows = jnp.take(m, safe, axis=0)
     v_rows = jnp.take(v, safe, axis=0)
-    p_rows = jnp.take(p, safe, axis=0).astype(jnp.float32)
-    m_new = b1 * m_rows + (1 - b1) * gsum
-    v_new = b2 * v_rows + (1 - b2) * jnp.square(gsum)
+    p_rows = jnp.take(p, safe, axis=0)
     b1p_new = b1p * b1
     b2p_new = b2p * b2
-    lr_t = lr * jnp.sqrt(1 - b2p_new.reshape(())) / (1 - b1p_new.reshape(()))
-    p_new_rows = p_rows - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    p_new_rows, m_new, v_new = adam_row_update(
+        p_rows, m_rows, v_rows, gsum, lr, b1, b2, eps, b1p_new, b2p_new)
     kw = dict(mode="drop", indices_are_sorted=True)
     return {"ParamOut": [p.at[uids].set(p_new_rows.astype(p.dtype), **kw)],
             "Moment1Out": [m.at[uids].set(m_new, **kw)],

@@ -19,3 +19,14 @@ from . import sharding
 from . import fleet
 from . import ulysses
 from . import moe
+
+
+def __getattr__(name):
+    # the sparse engine stays unimported until a distributed table
+    # actually asks for it (bench-contract: the engine-off path loads
+    # zero extra code) — PEP 562 lazy module attribute
+    if name == "sparse":
+        import importlib
+        return importlib.import_module(".sparse", __name__)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -61,8 +61,9 @@ from . import collective as C
 
 __all__ = ["GradSyncPolicy", "parse_policy", "resolve_policy",
            "plan_buckets", "state_entries", "sync_gradients",
-           "make_grad_transform", "quantize_int8_blockwise",
-           "dequantize_int8_blockwise", "EF_PREFIX"]
+           "make_grad_transform", "make_probe_transform",
+           "quantize_int8_blockwise", "dequantize_int8_blockwise",
+           "EF_PREFIX"]
 
 EF_PREFIX = "gradsync.ef."
 ENV_VAR = "PADDLE_TPU_GRAD_SYNC"
@@ -329,9 +330,51 @@ def sync_gradients(grads, env, policy, plan=None, dp=None):
     return out, new_state
 
 
-def make_grad_transform(policy, plan, dp):
-    """The build_step_fn grad_transform hook: (dense_grads, env) ->
-    (synced_grads, extra_persist)."""
+def make_grad_transform(policy, plan, dp, sparse_taps=()):
+    """The build_step_fn grad_transform hook: (grads, env) ->
+    (synced_grads, extra_persist).
+
+    Dense grads (the ones `plan` buckets) sync through the policy's
+    bucketed/quantized collectives. `sparse_taps` names the is_sparse
+    row-grad taps this policy must NOT bucket but still make globally
+    consistent: each tap's per-member row grads and its ids are
+    all-gathered over the dp axis (scaled 1/dp for `mean` losses), so
+    the replicated table's row-sparse tail update computes the SAME
+    merged update on every member — sparse grads skip the quantized
+    wire (they belong to the sparse engine; a ShardedTable handles its
+    own taps and is excluded from this list)."""
     def transform(grads, env):
-        return sync_gradients(grads, env, policy, plan=plan, dp=dp)
+        synced, state = sync_gradients(grads, env, policy, plan=plan,
+                                       dp=dp)
+        for tap in sparse_taps:
+            g = C.all_gather(grads[tap["delta"]],
+                             axis_name=policy.axis_name, axis=0,
+                             tiled=True)
+            if policy.reduce == "mean":
+                g = g / dp
+            synced[tap["delta"]] = g
+            env[tap["ids"]] = C.all_gather(env[tap["ids"]],
+                                           axis_name=policy.axis_name,
+                                           axis=0, tiled=True)
+        return synced, state
+    return transform
+
+
+def make_probe_transform(policy, plan, dp, sparse_taps=()):
+    """Axis-free shape twin of make_grad_transform for jax.eval_shape
+    (the executor's fetch-classification probe): dense grads pass
+    through, error-feedback state is zeros of the planned sizes, and
+    the sparse-tap all-gathers become dp-fold tiles."""
+    ef_entries = state_entries(plan, policy)
+
+    def tile(x):
+        return jnp.concatenate([x] * dp, axis=0) if dp > 1 else x
+
+    def transform(grads, env):
+        out = {}
+        for tap in sparse_taps:
+            out[tap["delta"]] = tile(grads[tap["delta"]])
+            env[tap["ids"]] = tile(env[tap["ids"]])
+        return out, {n: jnp.zeros((l,), jnp.float32)
+                     for n, l in ef_entries}
     return transform

@@ -46,7 +46,7 @@ class ParallelExecutor:
                  share_vars_from=None, exec_strategy=None,
                  build_strategy=None, num_trainers=1, trainer_id=0,
                  scope=None, mesh=None, use_tpu=None, transpiler=None,
-                 grad_sync=None):
+                 grad_sync=None, sparse=None):
         self.program = main_program or default_main_program()
         self.loss_name = loss_name
         self.scope = scope or global_scope()
@@ -67,6 +67,44 @@ class ParallelExecutor:
         from . import gradsync as _gradsync
         self.grad_sync = _gradsync.resolve_policy(grad_sync,
                                                   program=self.program)
+        # sparse-engine policy (parallel/sparse.py): only a program
+        # that actually carries a distributed lookup table AND an
+        # explicit opt-in (arg or PADDLE_TPU_SPARSE) ever imports the
+        # engine — pinned by tests/test_bench_contract.py. The engine
+        # runs the step under explicit shard_map, so it brings a
+        # default fp32 gradsync policy for the dense params when none
+        # is set.
+        self.sparse_engine = None
+        dist_tables = [
+            op.inputs["W"][0]
+            for op in self.program.global_block().ops
+            if op.type == "lookup_table"
+            and op.attrs.get("is_distributed")]
+        if dist_tables:
+            import os as _os
+            spec = sparse if sparse is not None \
+                else _os.environ.get("PADDLE_TPU_SPARSE")
+            if spec is not None and str(spec).strip().lower() not in \
+                    ("", "0", "off", "none", "false"):
+                from . import sparse as _sparse
+                pol = _sparse.parse_policy(spec)
+                if transpiler is not None:
+                    raise ValueError(
+                        "the sparse engine owns its tables' sharding; "
+                        "drop the DistributeTranspiler (its SPMD "
+                        "row-sharding is the engine-off path) or the "
+                        "sparse= policy")
+                if self.grad_sync is None:
+                    self.grad_sync = _gradsync.GradSyncPolicy("fp32")
+                self.sparse_engine = _sparse.SparseEngine(
+                    self.program, pol, self.mesh,
+                    reduce=self.grad_sync.reduce)
+        elif sparse is not None and str(sparse).strip().lower() not in \
+                ("", "0", "off", "none", "false"):
+            raise ValueError(
+                "sparse= engine requested but the program has no "
+                "distributed lookup table; build the embedding with "
+                "is_distributed=True (and is_sparse=True)")
         if self.grad_sync is not None:
             if transpiler is not None:
                 raise ValueError(
@@ -146,23 +184,31 @@ class ParallelExecutor:
 
     def _gradsync_prepare(self, program, persist, persist_sh):
         """Bucket plan + error-feedback state for the active grad_sync
-        policy. Seeds `gradsync.ef.<bucket>` residuals (zeros) in the
-        scope on first use and adds them to the persist set with dp
-        sharding, so they ride the executor's existing donate/sharding
-        path like any other state."""
+        policy, plus the is_sparse tap list. Seeds `gradsync.ef.<bucket>`
+        residuals (zeros) in the scope on first use and adds them to the
+        persist set with dp sharding, so they ride the executor's
+        existing donate/sharding path like any other state.
+
+        Sparse row grads are SKIPPED by the bucketed/quantized wire —
+        they belong to the sparse engine. Engine-owned tables handle
+        their own exchange; any remaining (replicated) is_sparse table
+        gets its taps returned so the grad transform can all-gather
+        ids+row-grads over dp, keeping the tail's row-sparse update
+        identical on every member."""
         from . import gradsync
         policy = self.grad_sync
         bops = [op for op in program.global_block().ops
                 if op.type == "backward_macro"]
         if not bops:
-            return []
+            return [], []
         bop = bops[0]
-        if bop.attrs.get("sparse_params"):
-            raise ValueError(
-                "grad_sync policies do not support is_sparse embedding "
-                "gradients (row grads are member-local under the "
-                "explicit sync path); use dense embeddings or disable "
-                "grad_sync")
+        engine_tables = set(self.sparse_engine.tables) \
+            if self.sparse_engine is not None else set()
+        sparse_taps = [
+            {"ids": tap["ids"], "delta": tap["delta"]}
+            for spec in bop.attrs.get("sparse_params", [])
+            if spec["param"] not in engine_tables
+            for tap in spec["taps"]]
         named = [(n, tuple(persist[n].shape), persist[n].dtype)
                  for n in bop.attrs["param_names"]]
         plan = gradsync.plan_buckets(named, policy.bucket_bytes,
@@ -176,15 +222,19 @@ class ParallelExecutor:
                 self.scope.set(name, val)
             persist_sh[name] = sh
             persist[name] = self._param_to_global(val, sh)
-        return plan
+        return plan, sparse_taps
 
     def _build_gradsync_fn(self, program, fetch_names, is_test,
                            feed_arrays, feed_sh, persist, persist_sh,
-                           plan):
+                           plan, sparse_taps=()):
         """The explicit-sync path: the SAME traced step runs under
         shard_map over the dp axis (per-member local compute) and
         gradsync.sync_gradients performs the dp reduction with
         explicit — bucketed / quantized / overlappable — collectives.
+        When the sparse engine is active it rides the same shard_map:
+        its lookup/update ops dispatch through the engine
+        (build_step_fn sparse_engine hook) and its sharded tables /
+        stale rings keep their dp layout through out_specs.
 
         Fetch semantics: fetches whose leading dim is the local batch
         stay dp-sharded (reassembling to the global batch axis, exactly
@@ -196,13 +246,15 @@ class ParallelExecutor:
         reference's per-trainer seeds)."""
         from . import gradsync
         policy = self.grad_sync
+        engine = self.sparse_engine
         mesh = self.mesh
         dp = mesh.shape.get("dp", 1)
 
         step = build_step_fn(
             program, fetch_names, is_test, None,
-            grad_transform=gradsync.make_grad_transform(policy, plan,
-                                                        dp))
+            grad_transform=gradsync.make_grad_transform(
+                policy, plan, dp, sparse_taps=sparse_taps),
+            sparse_engine=engine)
 
         persist_specs = {n: persist_sh[n].spec for n in persist}
         feed_specs = {k: feed_sh[k].spec for k in feed_arrays}
@@ -223,15 +275,13 @@ class ParallelExecutor:
 
         # classify fetches via an axis-free structural probe: the real
         # transform's collectives need the dp axis bound, so eval_shape
-        # runs with a shape-preserving stand-in instead
-        ef_entries = gradsync.state_entries(plan, policy)
-
-        def probe_transform(grads, env):
-            return grads, {n: jnp.zeros((l,), jnp.float32)
-                           for n, l in ef_entries}
-
-        probe = build_step_fn(program, fetch_names, is_test, None,
-                              grad_transform=probe_transform)
+        # runs with shape-preserving stand-ins instead (identity
+        # collectives in both the gradsync transform and the engine)
+        probe = build_step_fn(
+            program, fetch_names, is_test, None,
+            grad_transform=gradsync.make_probe_transform(
+                policy, plan, dp, sparse_taps=sparse_taps),
+            sparse_engine=engine.probe_clone() if engine else None)
         f_avals, p_avals = jax.eval_shape(probe, la_persist, la_feed,
                                           jax.random.PRNGKey(0))
 
@@ -254,9 +304,17 @@ class ParallelExecutor:
             else:
                 fetch_specs.append(P())
                 fetch_kind.append("sum")
-        out_persist_specs = {
-            n: (P("dp") if n.startswith(gradsync.EF_PREFIX) else P())
-            for n in p_avals}
+        def persist_out_spec(n):
+            if n.startswith(gradsync.EF_PREFIX):
+                return P("dp")
+            if engine is not None and (
+                    n in engine.row_var_names
+                    or n in engine.state_names):
+                return engine.out_spec(n) if n not in persist_specs \
+                    else persist_specs[n]
+            return P()
+
+        out_persist_specs = {n: persist_out_spec(n) for n in p_avals}
 
         def mapped(persist_in, feed_in, key_in):
             key_in = jax.random.fold_in(key_in,
@@ -316,9 +374,13 @@ class ParallelExecutor:
             feed_sh[k] = sh
             feed_arrays[k] = self._feed_to_global(arr, sh)
 
+        engine = self.sparse_engine
+        engine_rows = set(engine.row_var_names) if engine else ()
         persist = {}
         persist_sh = {}
         for v in program.persistable_vars():
+            if v.name in engine_rows:
+                continue           # mod-sharded by the engine below
             val = self.scope.get(v.name)
             if val is None:
                 raise RuntimeError(
@@ -327,11 +389,32 @@ class ParallelExecutor:
             sh = self._param_sharding(v.name)
             persist_sh[v.name] = sh
             persist[v.name] = self._param_to_global(val, sh)
+        if engine is not None:
+            dp = self.mesh.shape.get("dp", 1)
+
+            def local_shape(k):
+                shape = list(feed_arrays[k].shape)
+                spec = tuple(feed_sh[k].spec)
+                if shape and spec and spec[0] is not None:
+                    shape[0] //= dp
+                return tuple(shape)
+
+            engine.plan_run({k: local_shape(k) for k in feed_arrays})
+            engine.prepare_persist(persist, persist_sh, self.scope)
+            for name, gshape, dt, spec, fill in engine.state_entries():
+                sh = NamedSharding(self.mesh, spec)
+                val = self.scope.get(name)
+                if val is None or tuple(val.shape) != tuple(gshape):
+                    val = np.full(gshape, fill, dt)
+                    self.scope.set(name, val)
+                persist_sh[name] = sh
+                persist[name] = self._param_to_global(val, sh)
 
         policy = self.grad_sync
-        gs_plan = None
+        gs_plan = gs_taps = None
         if policy is not None:
-            gs_plan = self._gradsync_prepare(program, persist, persist_sh)
+            gs_plan, gs_taps = self._gradsync_prepare(program, persist,
+                                                      persist_sh)
 
         sig = tuple(sorted((k, v.shape, str(v.dtype))
                            for k, v in feed_arrays.items()))
@@ -343,6 +426,8 @@ class ParallelExecutor:
             # only the policy-on path may grow the compile key (the
             # off path stays byte-for-byte the historical tuple)
             ckey = ckey + (policy.key(),)
+        if engine is not None:
+            ckey = ckey + (engine.key(),)
         fn = self._cache.get(ckey)
         if fn is None:
             if tm_on:
@@ -351,7 +436,8 @@ class ParallelExecutor:
             if policy is not None:
                 fn = self._build_gradsync_fn(
                     program, fetch_names, is_test, feed_arrays, feed_sh,
-                    persist, persist_sh, gs_plan)
+                    persist, persist_sh, gs_plan,
+                    sparse_taps=gs_taps or ())
                 self._cache[ckey] = fn
             else:
                 step_fn = build_step_fn(program, fetch_names, is_test,
@@ -389,6 +475,8 @@ class ParallelExecutor:
             _tm.counter("pexe.steps").inc()
             _tm.histogram("pexe.step_seconds").observe(dt)
             _tm.fleet.on_step(dt)
+            if engine is not None:
+                engine.update_gauges(self.scope)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

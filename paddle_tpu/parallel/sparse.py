@@ -1,0 +1,728 @@
+"""tpusparse — mesh-sharded embedding tables (the pserver heritage).
+
+Parity: the reference's `operators/distributed/` pserver stack existed
+for ONE workload — recommender embedding tables too big for a single
+device. `distribute_lookup_table.py` found the distributed table,
+DistributeTranspiler row-partitioned it over pservers, trainers
+prefetch'd rows over gRPC and pushed sparse updates back. Here the
+same program markup (`embedding(is_sparse=True, is_distributed=True)`)
+lowers to a TPU-native engine (ROADMAP item 5):
+
+- **placement**: a `ShardedTable` holds `ceil(vocab/N)` rows per mesh
+  device, **mod-sharded** (row r lives on device `r % N` at local index
+  `r // N`) so power-law-popular low ids spread across the mesh instead
+  of hammering shard 0 — the reference's hash-bucketed pserver
+  partitioning, not the block split.
+- **dedup**: each step, the batch's ids collapse through a
+  `jnp.unique`-style static-shape dedup (`unique_static`: padded
+  unique-ids buffer + inverse indices + carried count — the
+  static-shapes discipline), so the wire and the update both move
+  O(unique ids), not O(batch): the EQuARX lesson from the gradsync PR
+  applied to gather/update traffic.
+- **exchange**: ONE all-to-all each way moves the deduped row requests
+  to their owners and the rows back (`operators/distributed/` prefetch
+  RPC ≙ `lax.all_to_all` over the dp axis). Request buckets are
+  per-owner static buffers (`cap` knob, default = worst case so no id
+  is ever dropped; smaller caps trade wire for a counted overflow —
+  see `tpusparse.stats.*`).
+- **local fused lookup+pool**: the gathered unique rows expand to the
+  program's [B, F, D] output through the Pallas fused lookup kernel
+  (ops/pallas/embedding.py) when the capability probe accepts, else
+  the identical jnp gather.
+- **update**: the backward's is_sparse row-grad taps give per-position
+  row gradients; they dedup locally (`dedup_rows`), exchange to their
+  owner shards (one all-to-all), merge across members, and apply the
+  SAME row-update formulas the sparse_sgd/sparse_adam kernels use
+  (ops/kernels_optim.py row helpers) on the owner's shard + moment
+  shards — update bandwidth O(unique ids), the SelectedRows push.
+- **async/stale** (`stale=k`): the grad exchange+apply for step N runs
+  inside step N+k's graph, where it depends only on persisted state —
+  XLA overlaps it with the forward pass (the gradsync overlap
+  machinery's dependency discipline). Lookups read the pre-apply
+  table, mirroring AsyncExecutor's stale-read semantics; `stale=0`
+  (default) applies synchronously in the tail, numerics matching the
+  dense path.
+
+Selection mirrors gradsync: `ParallelExecutor(sparse="shard")`, the
+`PADDLE_TPU_SPARSE` env var, grammar `shard[:stale=K,cap=N]`. Off (the
+default) leaves every existing path — plain Executor dense gather,
+transpiler SPMD row-sharding — byte-for-byte untouched, and this
+module is never even imported (pinned by tests/test_bench_contract).
+
+Telemetry: `embed.<table>.rows` (local rows/shard) and
+`embed.<table>.exchange_bytes` (trace-time wire accounting, like
+collective.*) plus the runtime `embed.<table>.unique_ratio` gauge read
+back from the in-graph `tpusparse.stats.<table>` accumulator —
+surfaced per rank in `tpustat --fleet`.
+"""
+import os
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import telemetry as _tm
+from . import collective as C
+from ..ops.kernels_optim import dedup_rows, adam_row_update, sgd_row_update
+
+__all__ = ["SparsePolicy", "parse_policy", "resolve_policy",
+           "discover_tables", "unique_static", "SparseEngine",
+           "ShardedTable", "strip_table_init", "STATS_PREFIX",
+           "PEND_PREFIX"]
+
+ENV_VAR = "PADDLE_TPU_SPARSE"
+STATS_PREFIX = "tpusparse.stats."
+PEND_PREFIX = "tpusparse.pend."
+
+# optimizer accumulator kinds row-shaped accumulators are named with
+# (optimizer.py _add_accumulator) — same vocabulary the transpiler's
+# table rule matches
+_ACCUM_KINDS = ("moment1", "moment2", "moment", "velocity", "inf_norm",
+                "mean_square", "mean_grad", "squared", "linear",
+                "avg_squared_grad", "avg_squared_update")
+
+
+class SparsePolicy:
+    """One resolved sparse-engine policy. `stale_steps=k` defers each
+    step's row updates by k steps (AsyncExecutor semantics — the
+    exchange overlaps the next step's forward); `capacity` caps the
+    per-owner exchange buckets (None = worst case, exact); `kernel`
+    gates the Pallas fused-lookup dispatch (on by default; the probe
+    still decides)."""
+
+    def __init__(self, mode="shard", stale_steps=0, capacity=None,
+                 kernel=True, axis_name="dp"):
+        if mode != "shard":
+            raise ValueError(f"sparse mode {mode!r} not in ('shard',)")
+        if stale_steps < 0:
+            raise ValueError("sparse stale_steps must be >= 0")
+        if capacity is not None and capacity < 1:
+            raise ValueError("sparse cap must be >= 1")
+        self.mode = mode
+        self.stale_steps = int(stale_steps)
+        self.capacity = None if capacity is None else int(capacity)
+        self.kernel = bool(kernel)
+        self.axis_name = axis_name
+
+    def key(self):
+        return ("tpusparse", self.mode, self.stale_steps, self.capacity,
+                self.kernel, self.axis_name)
+
+    def __repr__(self):
+        return (f"SparsePolicy(mode={self.mode!r}, "
+                f"stale_steps={self.stale_steps}, "
+                f"capacity={self.capacity}, kernel={self.kernel})")
+
+
+def parse_policy(spec):
+    """`spec` → SparsePolicy or None for off. Grammar (the gradsync
+    grammar): `shard[:stale=K,cap=N,kernel=0/1]`; "on"/"1" ≙ "shard"."""
+    if spec is None or isinstance(spec, SparsePolicy):
+        return spec
+    s = str(spec).strip().lower()
+    if s in ("", "0", "off", "none", "false"):
+        return None
+    if s in ("1", "on", "true"):
+        s = "shard"
+    mode, _, opts = s.partition(":")
+    kw = {}
+    for item in filter(None, (t.strip() for t in opts.split(","))):
+        k, eq, v = item.partition("=")
+        if not eq:
+            raise ValueError(f"sparse option {item!r} is not k=v")
+        if k in ("stale", "stale_steps"):
+            kw["stale_steps"] = int(v)
+        elif k in ("cap", "capacity"):
+            kw["capacity"] = int(v)
+        elif k == "kernel":
+            kw["kernel"] = v not in ("0", "false", "off")
+        else:
+            raise ValueError(f"unknown sparse option {k!r}")
+    return SparsePolicy(mode=mode, **kw)
+
+
+def resolve_policy(arg=None):
+    """Explicit arg (including "off") beats PADDLE_TPU_SPARSE."""
+    if arg is not None:
+        return parse_policy(arg)
+    env = os.environ.get(ENV_VAR)
+    if env is not None and env.strip():
+        return parse_policy(env)
+    return None
+
+
+def discover_tables(program):
+    """All distributed lookup tables in `program`, sorted.
+
+    Generalizes distribute_lookup_table.find_distributed_lookup_table
+    (which enforces the reference's at-most-ONE-table rule for the
+    transpiler) to several tables — DeepFM carries two ([V, 1] first
+    order + [V, D] factors). The per-table consistency check is the
+    same: every lookup on a distributed table must be distributed."""
+    ops = [op for op in program.global_block().ops
+           if op.type == "lookup_table"]
+    dist = {op.inputs["W"][0] for op in ops
+            if op.attrs.get("is_distributed")}
+    for op in ops:
+        if op.inputs["W"][0] in dist and \
+                not op.attrs.get("is_distributed"):
+            raise RuntimeError(
+                "lookup_table_ops on the same table must all be "
+                "distributed")
+    return sorted(dist)
+
+
+# ------------------------------------------------------- static dedup
+
+def unique_static(flat_ids):
+    """`jnp.unique`-style dedup with static shapes: flat_ids [M] int32
+    (all >= 0) → (uids [M], inv [M], count) where uids[:count] are the
+    distinct ids ascending, uids[count:] == -1 (the carried-count
+    padding), and flat_ids[i] == uids[inv[i]]."""
+    flat = flat_ids.reshape(-1).astype(jnp.int32)
+    m = flat.shape[0]
+    order = jnp.argsort(flat)
+    sid = jnp.take(flat, order)
+    first = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                             (sid[1:] != sid[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(first) - 1                 # unique slot per sorted pos
+    uids = jnp.full((m,), -1, jnp.int32).at[seg].set(sid)
+    inv = jnp.zeros((m,), jnp.int32).at[order].set(seg)
+    return uids, inv, seg[-1] + 1
+
+
+def _owner_buckets(uids, n, cap):
+    """Route the deduped ids to their mod-sharding owners: uids [M]
+    (-1 padded) → (req [n, cap] int32 (-1 padded), owner [M], pos [M],
+    overflow). Entry i goes to bucket (owner[i] = uids[i] % n) at slot
+    pos[i] (its rank within the bucket); entries past `cap` are counted
+    in `overflow` and dropped (cap = M never overflows)."""
+    valid = uids >= 0
+    owner = jnp.where(valid, uids % n, n)
+    onehot = (owner[:, None] == jnp.arange(n + 1)[None, :]).astype(
+        jnp.int32)
+    pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - 1,
+                              owner[:, None], axis=1)[:, 0]
+    overflow = jnp.sum(((pos >= cap) & valid).astype(jnp.int32))
+    req = jnp.full((n, cap), -1, jnp.int32)
+    # out-of-range (owner == n padding, pos >= cap overflow) drop
+    req = req.at[owner, pos].set(jnp.where(valid, uids, -1), mode="drop")
+    return req, owner, pos, overflow
+
+
+class _Axis:
+    """The engine's collective surface over one mesh axis. `fake=True`
+    is the shape-probe mode (ParallelExecutor's axis-free eval_shape):
+    every collective becomes a shape-preserving identity."""
+
+    def __init__(self, name, size, fake=False):
+        self.name = name
+        self.size = int(size)
+        self.fake = fake
+
+    def all_to_all(self, x):
+        if self.fake:
+            return x
+        return C.all_to_all(x, axis_name=self.name, split_axis=0,
+                            concat_axis=0)
+
+    def psum(self, x):
+        return x if self.fake else lax.psum(x, self.name)
+
+
+class ShardedTable:
+    """Static description + per-run plan of ONE mod-sharded table."""
+
+    def __init__(self, name, vocab, dim, dtype, n):
+        self.name = name
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.dtype = dtype
+        self.n = int(n)
+        self.local_rows = -(-self.vocab // self.n)
+        self.moments = {}          # accumulator kind suffix -> var name
+        self.m_ids = None          # flattened id count per member (plan)
+        self.cap = None            # per-owner exchange capacity (plan)
+
+    @property
+    def physical_shape(self):
+        return (self.n * self.local_rows, self.dim)
+
+    @property
+    def stats_name(self):
+        return STATS_PREFIX + self.name
+
+    def pend_names(self):
+        return (PEND_PREFIX + self.name + ".ids",
+                PEND_PREFIX + self.name + ".g")
+
+
+def strip_table_init(startup_program, names):
+    """Remove `names`' initializer ops from a startup program so huge
+    sharded tables are never materialized host-side — pair with
+    SparseEngine.init_shards, which seeds the scope shard-wise."""
+    names = set(names)
+    blk = startup_program.global_block()
+    blk.ops[:] = [op for op in blk.ops
+                  if not (set(op.output_names()) & names)]
+    for n in names:
+        blk.vars.pop(n, None)       # no init op -> not a startup output
+    return startup_program
+
+
+class SparseEngine:
+    """The trace-time sparse engine ParallelExecutor attaches when a
+    program carries distributed lookup tables and a SparsePolicy is
+    active. One instance per executor; `exec()` handles the owned
+    lookup_table / sparse_sgd / sparse_adam ops inside the traced step
+    (which MUST run under shard_map with the dp axis bound — the
+    probe_clone() variant runs axis-free for shape inference)."""
+
+    def __init__(self, program, policy, mesh, reduce="mean",
+                 table_names=None, _fake_axis=False):
+        self.program = program
+        self.policy = policy
+        self.mesh = mesh
+        self.reduce = reduce
+        axis = policy.axis_name
+        if axis not in mesh.shape:
+            raise ValueError(
+                f"sparse engine needs a {axis!r} axis on the mesh")
+        self.n = int(mesh.shape[axis])
+        self.axis = _Axis(axis, self.n, fake=_fake_axis)
+        names = table_names if table_names is not None \
+            else discover_tables(program)
+        if not names:
+            raise ValueError(
+                "sparse engine: program has no distributed lookup "
+                "table (embedding(..., is_distributed=True))")
+        block = program.global_block()
+        self.tables = {}
+        for name in names:
+            var = block.vars.get(name)
+            if var is None or len(var.shape or ()) < 2:
+                raise ValueError(
+                    f"sparse engine: table {name!r} not a [vocab, dim] "
+                    "var in the program")
+            self.tables[name] = ShardedTable(
+                name, var.shape[0], var.shape[-1],
+                str(var.dtype or "float32"), self.n)
+        # the engine's update path rides the is_sparse row-grad taps —
+        # a dense-gradient distributed table would densify [V, D] on
+        # every member, the exact thing sharding exists to avoid
+        for op in block.ops:
+            if op.type == "lookup_table" and \
+                    op.inputs["W"][0] in self.tables and \
+                    not op.attrs.get("is_sparse"):
+                raise ValueError(
+                    f"sparse engine: distributed table "
+                    f"{op.inputs['W'][0]!r} must use "
+                    "embedding(is_sparse=True) — the engine applies "
+                    "row-sparse updates through the SparseDelta taps")
+            if op.attrs.get("is_optimizer_op") and \
+                    op.inputs.get("Param") and \
+                    op.inputs["Param"][0] in self.tables and \
+                    op.type not in ("sparse_sgd", "sparse_adam"):
+                raise NotImplementedError(
+                    f"sparse engine: {op.type!r} update on sharded "
+                    f"table {op.inputs['Param'][0]!r}; use Adam or SGD")
+        # row-shaped accumulators (lazy-Adam moments) shard with their
+        # table — matched EXACTLY like the transpiler's table rule
+        accum_re = {
+            t: re.compile(re.escape(t) + "_(" + "|".join(_ACCUM_KINDS)
+                          + r")_\d+$")
+            for t in self.tables}
+        for v in program.persistable_vars():
+            for t, rx in accum_re.items():
+                spec = self.tables[t]
+                if rx.fullmatch(v.name) and \
+                        tuple(v.shape) == (spec.vocab, spec.dim):
+                    spec.moments[v.name] = v.name
+        self._row_sharding = None   # set in prepare_persist
+        self._physical = set()      # names known to hold mod-layout arrays
+
+    # ------------------------------------------------------ identity
+    def key(self):
+        """Compile-cache identity (joins the executor ckey only when
+        the engine is active)."""
+        plan = tuple(sorted((t.name, t.m_ids, t.cap)
+                            for t in self.tables.values()))
+        return self.policy.key() + (self.reduce, plan)
+
+    @property
+    def row_var_names(self):
+        """Every persistable the engine stores mod-sharded (tables +
+        their row-shaped accumulators)."""
+        out = []
+        for t in self.tables.values():
+            out.append(t.name)
+            out.extend(t.moments)
+        return out
+
+    def probe_clone(self):
+        """Axis-free twin for jax.eval_shape (collectives → identity)."""
+        eng = SparseEngine(self.program, self.policy, self.mesh,
+                          reduce=self.reduce,
+                          table_names=list(self.tables), _fake_axis=True)
+        for name, t in self.tables.items():
+            eng.tables[name].m_ids = t.m_ids
+            eng.tables[name].cap = t.cap
+        return eng
+
+    # ------------------------------------------------------ placement
+    def _phys_perm(self, t):
+        """Physical row p = d * L + l holds logical row l * n + d."""
+        d, l = np.divmod(np.arange(t.n * t.local_rows), t.local_rows)
+        return l * t.n + d                     # physical -> logical id
+
+    def to_physical(self, t, logical):
+        """Logical [V, D] host array → mod-permuted [n*L, D] np array
+        (pad rows zero)."""
+        logical = np.asarray(logical)
+        ids = self._phys_perm(t)
+        out = np.zeros(t.physical_shape, logical.dtype)
+        ok = ids < t.vocab
+        out[ok] = logical[ids[ok]]
+        return out
+
+    def to_logical(self, t, physical):
+        """Inverse of to_physical (tests / checkpoint export)."""
+        if isinstance(t, str):
+            t = self.tables[t]
+        physical = np.asarray(physical)
+        r = np.arange(t.vocab)
+        return physical[(r % t.n) * t.local_rows + r // t.n]
+
+    def prepare_persist(self, persist, persist_sh, scope):
+        """Place every engine-managed row var: host logical arrays are
+        permuted to the mod layout and sharded P(dp); arrays that are
+        ALREADY physical (a previous step's donated output, or
+        init_shards' shard-wise build) pass through untouched."""
+        sh = NamedSharding(self.mesh, P(self.policy.axis_name, None))
+        self._row_sharding = sh
+        for t in self.tables.values():
+            for name in [t.name] + list(t.moments):
+                val = scope.get(name)
+                if val is None:
+                    raise RuntimeError(
+                        f"sharded table var {name!r} not initialized; "
+                        "run the startup program, or for tables too "
+                        "big to materialize use "
+                        "sparse.strip_table_init + engine.init_shards")
+                # an array is physical iff THIS engine produced it (a
+                # prior step's sharded output, or init_shards) — the
+                # mod permutation is invisible in shape/dtype, so a
+                # sharding check alone could double-permute (jit
+                # outputs normalize P("dp", None) to P("dp",))
+                physical = (name in self._physical
+                            and isinstance(val, jax.Array)
+                            and tuple(val.shape) == t.physical_shape)
+                if not physical:
+                    phys = self.to_physical(t, val)
+                    val = jax.make_array_from_callback(
+                        t.physical_shape, sh,
+                        lambda idx, _p=phys: _p[idx])
+                    self._physical.add(name)
+                persist_sh[name] = sh
+                persist[name] = val
+            if _tm.enabled():
+                _tm.gauge(f"embed.{t.name}.rows").set(t.local_rows)
+
+    def init_shards(self, scope, seed=0, scale=0.02):
+        """Seed every engine table shard-WISE (no host copy of the full
+        [V, D] ever exists): normal(0, scale) rows per shard, zero
+        moments. The giant-vocab entry path — pair with
+        strip_table_init on the startup program."""
+        sh = NamedSharding(self.mesh, P(self.policy.axis_name, None))
+        for t in self.tables.values():
+            L = t.local_rows
+
+            def cb(idx, _t=t, _L=L):
+                d = idx[0].start // _L
+                rng = np.random.RandomState(
+                    (seed * 131071 + hash(_t.name) % 65521 + d)
+                    % (2 ** 31 - 1))
+                rows = rng.standard_normal((_L, _t.dim)).astype(
+                    np.dtype(_t.dtype)) * scale
+                # pad rows (logical id >= vocab) zero
+                lg = np.arange(_L) * _t.n + d
+                rows[lg >= _t.vocab] = 0
+                return rows
+
+            scope.set(t.name, jax.make_array_from_callback(
+                t.physical_shape, sh, cb))
+            self._physical.add(t.name)
+            for m in t.moments:
+                scope.set(m, jax.make_array_from_callback(
+                    t.physical_shape, sh,
+                    lambda idx, _t=t: np.zeros(
+                        (_t.local_rows, _t.dim), np.dtype(_t.dtype))))
+                self._physical.add(m)
+
+    # ------------------------------------------------------ run plan
+    def plan_run(self, feed_local_shapes):
+        """Compute per-table static sizes for THIS feed signature:
+        m_ids (flattened ids per member per step across the table's
+        lookups) and the per-owner exchange capacity. Needs every
+        lookup's Ids to be a feed (stale>0 additionally persists
+        m_ids-shaped ring buffers)."""
+        block = self.program.global_block()
+        for t in self.tables.values():
+            m = 0
+            for op in block.ops:
+                if op.type != "lookup_table" or \
+                        op.inputs["W"][0] != t.name:
+                    continue
+                ids_name = op.inputs["Ids"][0]
+                shape = feed_local_shapes.get(ids_name)
+                if shape is None:
+                    raise ValueError(
+                        f"sparse engine: lookup ids {ids_name!r} for "
+                        f"table {t.name!r} is not a feed; feed the ids "
+                        "directly (derived-id programs are not "
+                        "supported by the sharded engine)")
+                shape = tuple(shape)
+                if shape and shape[-1] == 1:
+                    shape = shape[:-1]
+                cnt = 1
+                for s in shape:
+                    cnt *= int(s)
+                m += cnt
+            t.m_ids = m
+            t.cap = min(self.policy.capacity or m, m)
+
+    def state_entries(self):
+        """[(name, global_shape, dtype, partition_spec, fill)] of the
+        engine's non-program persistables: the replicated stats
+        accumulator per table, plus the dp-sharded pending-update ring
+        (ids filled with -1 = empty) when stale_steps > 0."""
+        out = []
+        k = self.policy.stale_steps
+        ax = self.policy.axis_name
+        for t in self.tables.values():
+            out.append((t.stats_name, (4,), np.float32, P(), 0.0))
+            if k > 0:
+                pid, pg = t.pend_names()
+                out.append((pid, (self.n, k, t.m_ids), np.int32,
+                            P(ax, None, None), -1))
+                out.append((pg, (self.n, k, t.m_ids, t.dim), np.float32,
+                            P(ax, None, None, None), 0.0))
+        return out
+
+    def out_spec(self, name):
+        """Partition spec of one engine output in the explicit
+        shard_map path (ParallelExecutor out_specs)."""
+        if name.startswith(STATS_PREFIX):
+            return P()
+        ax = self.policy.axis_name
+        if name.startswith(PEND_PREFIX):
+            return P(ax, None, None) if name.endswith(".ids") \
+                else P(ax, None, None, None)
+        return P(ax, None)          # tables + row accumulators
+
+    @property
+    def state_names(self):
+        return [e[0] for e in self.state_entries()]
+
+    # ------------------------------------------------------ trace ops
+    def owns(self, op):
+        if op.type == "lookup_table":
+            return op.inputs["W"][0] in self.tables
+        if op.type in ("sparse_sgd", "sparse_adam"):
+            return op.inputs["Param"][0] in self.tables
+        return False
+
+    def exec(self, env, op):
+        if op.type == "lookup_table":
+            self._exec_lookup(env, op)
+        else:
+            self._exec_update(env, op)
+
+    def _bump_stats(self, env, t, n_ids, n_unique, overflow):
+        prev = env.get(t.stats_name)
+        if prev is None:
+            prev = jnp.zeros((4,), jnp.float32)
+        upd = jnp.stack([jnp.asarray(n_ids, jnp.float32),
+                         n_unique.astype(jnp.float32),
+                         overflow.astype(jnp.float32),
+                         jnp.asarray(1.0, jnp.float32)])
+        env[t.stats_name] = prev + lax.stop_gradient(
+            self.axis.psum(upd) / self.n)
+
+    def _exchange_rows(self, t, shard, uids):
+        """One all-to-all round trip: deduped uids [M] (-1 padded) →
+        their rows [M, D] fetched from the owner shards."""
+        n, cap, D = self.n, t.cap, t.dim
+        req, owner, pos, overflow = _owner_buckets(uids, n, cap)
+        recv = self.axis.all_to_all(req)              # ids wanted from me
+        valid_r = recv >= 0
+        lidx = jnp.clip(jnp.where(valid_r, recv // n, 0), 0,
+                        t.local_rows - 1)
+        rows = jnp.take(shard, lidx, axis=0) \
+            * valid_r[..., None].astype(shard.dtype)
+        got = self.axis.all_to_all(rows)              # [n, cap, D]
+        ok = (uids >= 0) & (pos < cap)
+        u_rows = got[jnp.clip(owner, 0, n - 1),
+                     jnp.clip(pos, 0, cap - 1)] \
+            * ok[:, None].astype(shard.dtype)
+        if _tm.enabled():
+            _tm.counter(f"embed.{t.name}.exchange_bytes").inc(
+                req.size * 4 + rows.size
+                * np.dtype(shard.dtype).itemsize)
+        return u_rows, overflow
+
+    def _exec_lookup(self, env, op):
+        """The lowered distributed lookup: dedup → one all-to-all row
+        exchange → fused local lookup (+ the is_sparse delta tap and
+        padding mask, in the dense kernel's exact order)."""
+        t = self.tables[op.inputs["W"][0]]
+        shard = env[t.name]                           # [L, D] local
+        ids = env[op.inputs["Ids"][0]].astype(jnp.int32)
+        if ids.ndim >= 1 and ids.shape[-1] == 1:
+            ids = jnp.squeeze(ids, -1)
+        clipped = jnp.clip(ids, 0, t.vocab - 1)
+        uids, inv, count = unique_static(clipped.reshape(-1))
+        u_rows, overflow = self._exchange_rows(t, shard, uids)
+        out = None
+        if self.policy.kernel:
+            from ..ops.pallas import embedding as pemb
+            out = pemb.try_lookup_pool(u_rows, inv[:, None], None, "sum")
+        if out is None:
+            out = jnp.take(u_rows, inv, axis=0)
+        out = out.reshape(ids.shape + (t.dim,))
+        if op.inputs.get("SparseDelta"):
+            out = out + env[op.inputs["SparseDelta"][0]]
+        padding_idx = op.attrs.get("padding_idx", -1)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        env[op.outputs["Out"][0]] = out
+        self._bump_stats(env, t, inv.shape[0], count, overflow)
+
+    def _exec_update(self, env, op):
+        """The sparse tail op on a sharded table. Sync (stale=0):
+        dedup → exchange → apply now. Stale (k>0): apply the k-steps-old
+        ring head (depends only on persisted state, so XLA overlaps the
+        exchange with this step's forward), push the current deduped
+        grads onto the ring."""
+        t = self.tables[op.inputs["Param"][0]]
+        ids = jnp.concatenate(
+            [jnp.clip(i.astype(jnp.int32), 0, t.vocab - 1).reshape(-1)
+             for i in env_list(env, op.inputs["Ids"])])
+        grads = jnp.concatenate(
+            [g.reshape(-1, t.dim).astype(jnp.float32)
+             for g in env_list(env, op.inputs["Grad"])])
+        if self.reduce == "mean":
+            # member grads differentiate the member-MEAN loss; the
+            # global mean's row grad is 1/n of each contribution
+            grads = grads / self.n
+        uids, gsum = dedup_rows(ids, grads, t.vocab)
+        uids = jnp.where(uids < t.vocab, uids, -1)    # carried-count pad
+        k = self.policy.stale_steps
+        if k == 0:
+            self._exchange_apply(env, op, t, uids, gsum)
+            return
+        pid, pg = t.pend_names()
+        pend_i, pend_g = env[pid], env[pg]            # [1, k, M(,D)] local
+        self._exchange_apply(env, op, t, pend_i[0, 0], pend_g[0, 0])
+        env[pid] = jnp.concatenate(
+            [pend_i[:, 1:], uids[None, None]], axis=1)
+        env[pg] = jnp.concatenate(
+            [pend_g[:, 1:], gsum[None, None].astype(jnp.float32)],
+            axis=1)
+
+    def _exchange_apply(self, env, op, t, uids, gsum):
+        """Scatter-back: route deduped row grads to their owners (one
+        all-to-all pair), merge duplicates ACROSS members, and apply
+        the shared sparse_sgd/sparse_adam row formulas on the local
+        shard. Writes the op's outputs into env."""
+        n, cap, D, L = self.n, t.cap, t.dim, t.local_rows
+        req, owner, pos, overflow = _owner_buckets(uids, n, cap)
+        gbuf = jnp.zeros((n, cap, D), jnp.float32).at[owner, pos].set(
+            gsum.astype(jnp.float32), mode="drop")
+        rid = self.axis.all_to_all(req).reshape(-1)
+        rg = self.axis.all_to_all(gbuf).reshape(-1, D)
+        if _tm.enabled():
+            _tm.counter(f"embed.{t.name}.exchange_bytes").inc(
+                req.size * 4 + gbuf.size * 4)
+        # merge the same row's grads from several members (SelectedRows
+        # MergeAdd across trainers); invalid entries sort to sentinel L
+        key_ids = jnp.where(rid >= 0, rid // n, L)
+        order = jnp.argsort(key_ids)
+        sid = jnp.take(key_ids, order)
+        sg = jnp.take(rg, order, axis=0)
+        first = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             (sid[1:] != sid[:-1]).astype(jnp.int32)])
+        seg = jnp.cumsum(first)
+        merged = jax.ops.segment_sum(sg, seg, num_segments=sid.shape[0])
+        ulidx = jnp.full((sid.shape[0],), L, jnp.int32).at[seg].set(sid)
+        valid = ulidx < L
+        safe = jnp.where(valid, ulidx, 0)
+        shard = env[t.name]
+        p_rows = jnp.take(shard, safe, axis=0)
+        kw = dict(mode="drop", indices_are_sorted=True)
+        scatter_idx = jnp.where(valid, ulidx, L)
+        a = op.attrs
+        if op.type == "sparse_sgd":
+            lr = env[op.inputs["LearningRate"][0]].astype(
+                jnp.float32).reshape(())
+            new_rows = sgd_row_update(p_rows, merged, lr)
+            env[op.outputs["ParamOut"][0]] = shard.at[scatter_idx].set(
+                new_rows.astype(shard.dtype), **kw)
+        else:                                         # sparse_adam
+            lr = env[op.inputs["LearningRate"][0]].astype(
+                jnp.float32).reshape(())
+            m = env[op.inputs["Moment1"][0]]
+            v = env[op.inputs["Moment2"][0]]
+            b1p = env[op.inputs["Beta1Pow"][0]]
+            b2p = env[op.inputs["Beta2Pow"][0]]
+            b1 = a.get("beta1", 0.9)
+            b2 = a.get("beta2", 0.999)
+            eps = a.get("epsilon", 1e-8)
+            b1p_new, b2p_new = b1p * b1, b2p * b2
+            p_new, m_new, v_new = adam_row_update(
+                p_rows, jnp.take(m, safe, axis=0),
+                jnp.take(v, safe, axis=0), merged, lr, b1, b2, eps,
+                b1p_new, b2p_new)
+            env[op.outputs["ParamOut"][0]] = shard.at[scatter_idx].set(
+                p_new.astype(shard.dtype), **kw)
+            env[op.outputs["Moment1Out"][0]] = m.at[scatter_idx].set(
+                m_new.astype(m.dtype), **kw)
+            env[op.outputs["Moment2Out"][0]] = v.at[scatter_idx].set(
+                v_new.astype(v.dtype), **kw)
+            env[op.outputs["Beta1PowOut"][0]] = b1p_new
+            env[op.outputs["Beta2PowOut"][0]] = b2p_new
+        t_stats = env.get(t.stats_name)
+        if t_stats is not None:
+            env[t.stats_name] = t_stats + lax.stop_gradient(
+                self.axis.psum(
+                    jnp.array([0.0, 0.0, 1.0, 0.0], jnp.float32)
+                    * overflow.astype(jnp.float32)) / self.n)
+
+    def collect(self, env):
+        """Engine persistables currently in env → extra_persist."""
+        return {n: env[n] for n in self.state_names if n in env}
+
+    # ------------------------------------------------------ telemetry
+    def update_gauges(self, scope):
+        """Post-step host-side gauges from the in-graph stats var
+        (only called when telemetry is on — costs one small readback)."""
+        for t in self.tables.values():
+            val = scope.get(t.stats_name)
+            if val is None:
+                continue
+            ids_total, uniq, overflow, _steps = (
+                float(x) for x in np.asarray(val))
+            if ids_total > 0:
+                _tm.gauge(f"embed.{t.name}.unique_ratio").set(
+                    uniq / ids_total)
+            if overflow:
+                _tm.gauge(f"embed.{t.name}.overflow").set(overflow)
+
+
+def env_list(env, names):
+    return [env[n] for n in names]

@@ -614,6 +614,20 @@ class FleetCollector:
             gs_raw = m.get("gradsync.raw_bytes")
             gs_wire = m.get("gradsync.wire_bytes")
             gs_ratio = m.get("gradsync.compression_ratio")
+            # sharded-embedding engine (parallel/sparse.py): per-table
+            # embed.<t>.{rows,unique_ratio,exchange_bytes} gauges →
+            # one row/ratio/bytes rollup per rank + per-table detail
+            embed_tables = {}
+            for name, ent in m.items():
+                if not name.startswith("embed."):
+                    continue
+                tname, _, what = name[len("embed."):].rpartition(".")
+                if tname and what in ("rows", "unique_ratio",
+                                      "exchange_bytes", "overflow"):
+                    embed_tables.setdefault(tname, {})[what] = \
+                        ent["value"]
+            ratios = [d["unique_ratio"] for d in embed_tables.values()
+                      if "unique_ratio" in d]
             per_rank[str(r)] = {
                 "steps": h["count"] if h else 0,
                 "step_seconds_mean": (h["sum"] / h["count"])
@@ -630,6 +644,14 @@ class FleetCollector:
                 else 0,
                 "gradsync_ratio": gs_ratio["value"] if gs_ratio
                 else None,
+                "embed_rows": sum(int(d.get("rows", 0))
+                                  for d in embed_tables.values()),
+                "embed_unique_ratio": (sum(ratios) / len(ratios))
+                if ratios else None,
+                "embed_exchange_bytes": sum(
+                    int(d.get("exchange_bytes", 0))
+                    for d in embed_tables.values()),
+                "embed_tables": embed_tables,
                 "hostname": (env.get("host") or {}).get("hostname"),
                 "labels": env.get("labels", {}),
             }

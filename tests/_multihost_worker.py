@@ -219,6 +219,30 @@ def _table_mode(pid, nproc, n_global):
 
     np.testing.assert_allclose(par, base, rtol=1e-4, atol=1e-6)
     assert par[-1] < par[0], par
+
+    # tpusparse ENGINE leg (parallel/sparse.py): the same table driven
+    # by the explicit mod-sharded engine — unique-ids dedup + the
+    # all-to-all row exchange CROSS the host boundary (the pserver
+    # prefetch/push RPC, now explicit collectives). Each host feeds its
+    # LOCAL batch; losses must equal the replicated global-batch run.
+    main_c, startup_c, loss_c = build()
+    scope_c = pt.Scope()
+    with pt.scope_guard(scope_c):
+        exe3 = pt.Executor(pt.CPUPlace())
+        exe3.run(startup_c)
+        pexe2 = pt.ParallelExecutor(loss_name=loss_c.name,
+                                    main_program=main_c, scope=scope_c,
+                                    sparse="shard")
+        eng = []
+        for s in range(steps):
+            out = pexe2.run(feed={"ids": ids[s, pid], "y": ys[s, pid]},
+                            fetch_list=[loss_c])
+            eng.append(float(np.asarray(out[0])))
+        table = scope_c.get("big_table")
+        for shard in table.addressable_shards:
+            assert shard.data.shape[0] == vocab // n_global, \
+                shard.data.shape
+    np.testing.assert_allclose(eng, base, rtol=1e-4, atol=1e-6)
     print(f"RESULT table-ok {nproc} {n_global} "
           f"{' '.join(f'{l:.6f}' for l in par)}", flush=True)
 

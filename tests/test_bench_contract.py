@@ -136,6 +136,62 @@ def test_decode_off_paths_untouched():
     assert "LAZY_OK" in p.stdout
 
 
+def test_sparse_engine_off_paths_untouched():
+    """tpusparse's off contract (the bench-contract pin): without a
+    distributed table — or with one but no sparse= opt-in — the engine
+    module is never imported, the ParallelExecutor compile key stays
+    the historical 7-tuple, and the lookup_table kernel's dense gather
+    is bit-identical to composing it by hand (no new attrs consumed,
+    no dispatch probe on the hot path)."""
+    code = (
+        "import numpy as np\n"
+        "import jax, jax.numpy as jnp\n"
+        "import sys\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n"
+        "from paddle_tpu.ops.registry import get_kernel, KernelCtx\n"
+        "# dense MLP through ParallelExecutor: engine never loads\n"
+        "main, startup = pt.Program(), pt.Program()\n"
+        "with pt.program_guard(main, startup):\n"
+        "    with pt.unique_name.guard():\n"
+        "        x = layers.data('x', shape=[8])\n"
+        "        y = layers.data('y', shape=[4])\n"
+        "        pred = layers.fc(x, size=4)\n"
+        "        loss = layers.mean(layers.square_error_cost(pred, y))\n"
+        "        pt.optimizer.SGD(0.1).minimize(loss)\n"
+        "scope = pt.Scope()\n"
+        "rng = np.random.RandomState(0)\n"
+        "with pt.scope_guard(scope):\n"
+        "    pt.Executor(pt.CPUPlace()).run(startup)\n"
+        "    pexe = pt.ParallelExecutor(loss_name=loss.name,\n"
+        "                               main_program=main, scope=scope)\n"
+        "    pexe.run(feed={'x': rng.randn(8, 8).astype('float32'),\n"
+        "                   'y': rng.randn(8, 4).astype('float32')},\n"
+        "             fetch_list=[loss])\n"
+        "(ckey,) = pexe._cache.keys()\n"
+        "assert len(ckey) == 7, ckey\n"
+        "assert 'paddle_tpu.parallel.sparse' not in sys.modules, \\\n"
+        "    'dense run imported the sparse engine'\n"
+        "assert 'paddle_tpu.ops.pallas.embedding' not in sys.modules\n"
+        "# the dense lookup_table kernel: bit-identical to the manual\n"
+        "# clip+gather composition\n"
+        "w = jnp.asarray(rng.randn(32, 8).astype('float32'))\n"
+        "ids = jnp.asarray(rng.randint(0, 32, (6, 3, 1)), jnp.int32)\n"
+        "out = get_kernel('lookup_table')(KernelCtx(), {'W': [w],\n"
+        "    'Ids': [ids]}, {'padding_idx': -1})['Out'][0]\n"
+        "ref = jnp.take(w, jnp.clip(jnp.squeeze(ids, -1), 0, 31),\n"
+        "               axis=0)\n"
+        "assert np.asarray(out).tobytes() == np.asarray(ref).tobytes()\n"
+        "assert 'paddle_tpu.parallel.sparse' not in sys.modules\n"
+        "print('SPARSE_OFF_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=REPO)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-1200:])
+    assert "SPARSE_OFF_OK" in p.stdout
+
+
 def test_resilience_off_checkpoint_forward_compatible(tmp_path):
     """save_checkpoint's crash-safe rewrite must stay readable by the
     PRE-PR reader (np.load of params.npz + json.load of

@@ -296,7 +296,7 @@ def test_pexe_policy_telemetry_and_compression():
     assert bytes_by["fp32"] / bytes_by["int8"] >= 3.5
 
 
-def test_pexe_rejects_transpiler_and_sparse_combos():
+def test_pexe_rejects_transpiler_combo():
     prog, startup, loss = _fresh_mlp()
     t = pt.parallel.DistributeTranspiler(
         pt.parallel.DistributeTranspilerConfig())
@@ -305,27 +305,46 @@ def test_pexe_rejects_transpiler_and_sparse_combos():
         pt.ParallelExecutor(loss_name=loss.name, main_program=prog,
                             transpiler=t, grad_sync="int8")
 
-    prog2, startup2 = pt.Program(), pt.Program()
-    with pt.program_guard(prog2, startup2):
-        with pt.unique_name.guard():
-            ids = layers.data("ids", shape=[4, 1], dtype="int64")
-            y = layers.data("y", shape=[16], dtype="float32")
-            emb = layers.embedding(ids, size=[64, 16], is_sparse=True)
-            loss2 = layers.mean(layers.square_error_cost(
-                layers.reduce_sum(emb, dim=1), y))
-            pt.optimizer.SGD(0.1).minimize(loss2)
-    scope = pt.Scope()
-    with pt.scope_guard(scope):
-        pt.Executor(pt.CPUPlace()).run(startup2)
-        pexe = pt.ParallelExecutor(loss_name=loss2.name,
-                                   main_program=prog2, scope=scope,
-                                   grad_sync="int8")
-        rng = np.random.RandomState(0)
-        with pytest.raises(ValueError):
-            pexe.run(feed={"ids": rng.randint(0, 64, (8, 4, 1))
-                           .astype("int64"),
-                           "y": rng.randn(8, 16).astype("float32")},
-                     fetch_list=[loss2])
+
+def test_pexe_skips_sparse_grads_and_syncs_dense():
+    """Regression (tpusparse satellite): a program with an is_sparse
+    lookup used to reject the WHOLE grad-sync policy. Now the sparse
+    row grads skip the bucketed wire — the transform all-gathers each
+    tap's ids+row-grads over dp so the replicated table's lazy update
+    stays member-identical — and only the dense grads quantize. fp32
+    must match the implicit (policy-off) path; int8 must train."""
+    def build_sp():
+        prog2, startup2 = pt.Program(), pt.Program()
+        with pt.program_guard(prog2, startup2):
+            with pt.unique_name.guard():
+                ids = layers.data("ids", shape=[4, 1], dtype="int64")
+                y = layers.data("y", shape=[16], dtype="float32")
+                emb = layers.embedding(ids, size=[64, 16],
+                                       is_sparse=True)
+                h = layers.fc(layers.reduce_sum(emb, dim=1), size=16)
+                loss2 = layers.mean(layers.square_error_cost(h, y))
+                pt.optimizer.SGD(0.1).minimize(loss2)
+        prog2.random_seed = startup2.random_seed = 7
+        return prog2, startup2, loss2
+
+    rng = np.random.RandomState(0)
+    feed = {"ids": rng.randint(0, 64, (16, 4, 1)).astype("int64"),
+            "y": rng.randn(16, 16).astype("float32")}
+    res = {}
+    for gs in (None, "fp32", "int8"):
+        prog2, startup2, loss2 = build_sp()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            pt.Executor(pt.CPUPlace()).run(startup2)
+            pexe = pt.ParallelExecutor(loss_name=loss2.name,
+                                       main_program=prog2, scope=scope,
+                                       grad_sync=gs)
+            res[gs] = [float(np.asarray(
+                pexe.run(feed=feed, fetch_list=[loss2])[0]))
+                for _ in range(4)]
+    np.testing.assert_allclose(res[None], res["fp32"], rtol=1e-5)
+    assert np.isfinite(res["int8"]).all()
+    assert res["int8"][-1] < res["int8"][0]
 
 
 def test_pexe_env_var_resolution(monkeypatch):

@@ -420,6 +420,9 @@ SPECS["lookup_table"] = S(
     {"W": (6, 4), "Ids": ("int", (3, 1), 6)})
 SPECS["lookup_table_v2"] = S({"W": (6, 4), "Ids": ("int", (3,), 6)})
 SPECS["embedding"] = S({"W": (6, 4), "Ids": ("int", (3, 1), 6)})
+SPECS["fused_embedding_seq_pool"] = S(
+    {"W": (6, 4), "Ids": ("int", (3, 2), 6), "Weight": (3, 2)},
+    {"pooltype": "sum", "padding_idx": -1}, f32=True)
 
 # attention
 SPECS["scaled_dot_product_attention"] = S(

@@ -1,0 +1,391 @@
+"""tpusparse — mesh-sharded embedding engine tests (parallel/sparse.py).
+
+All on the 8-virtual-device CPU mesh the suite already forces
+(tests/conftest.py): numerics parity vs the replicated dense path,
+mod-sharding placement, stale-update semantics, capacity/overflow
+accounting, gradsync composition, the giant-table shard-wise init
+path, and the engine's guards."""
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import telemetry as tm
+from paddle_tpu.parallel import sparse as sp
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+
+
+# ------------------------------------------------------------ helpers
+
+def _build_table_model(vocab, dim, opt="adam", dist=True, name="tbl",
+                       seed=17):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            i = layers.data("ids", shape=[4, 1], dtype="int64")
+            y = layers.data("y", shape=[dim], dtype="float32")
+            emb = layers.embedding(
+                i, size=[vocab, dim], is_sparse=True,
+                is_distributed=dist,
+                param_attr=pt.ParamAttr(name=name))
+            loss = layers.mean(layers.square_error_cost(
+                layers.reduce_sum(emb, dim=1), y))
+            opt_cls = {"adam": lambda: pt.optimizer.Adam(1e-2),
+                       "sgd": lambda: pt.optimizer.SGD(1e-1)}[opt]
+            opt_cls().minimize(loss)
+    main.random_seed = startup.random_seed = seed
+    return main, startup, loss
+
+
+def _feed(vocab, dim, B=16, seed=3):
+    rng = np.random.RandomState(seed)
+    return {"ids": rng.randint(0, vocab, (B, 4, 1)).astype("int64"),
+            "y": rng.randn(B, dim).astype("float32")}
+
+
+def _run_dense(vocab, dim, opt, feed, steps, seed=17):
+    main, startup, loss = _build_table_model(vocab, dim, opt,
+                                             dist=False, seed=seed)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(steps)]
+        table = np.asarray(scope.get("tbl"))
+    return losses, table
+
+
+def _run_engine(vocab, dim, opt, feed, steps, spec="shard", seed=17,
+                grad_sync=None):
+    main, startup, loss = _build_table_model(vocab, dim, opt,
+                                             seed=seed)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                   main_program=main, scope=scope,
+                                   sparse=spec, grad_sync=grad_sync)
+        losses = [float(np.asarray(pexe.run(feed=feed,
+                                            fetch_list=[loss])[0]))
+                  for _ in range(steps)]
+    return losses, scope, pexe
+
+
+# ------------------------------------------------------- pure helpers
+
+def test_unique_static_matches_np_unique():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 37, 64).astype("int32")
+    uids, inv, count = (np.asarray(x) for x in
+                        sp.unique_static(jax.numpy.asarray(ids)))
+    ref_u, ref_inv = np.unique(ids, return_inverse=True)
+    assert int(count) == len(ref_u)
+    np.testing.assert_array_equal(uids[:len(ref_u)], ref_u)
+    assert (uids[len(ref_u):] == -1).all()      # carried-count padding
+    np.testing.assert_array_equal(uids[inv], ids)
+
+
+def test_policy_grammar_and_resolution(monkeypatch):
+    p = sp.parse_policy("shard:stale=2,cap=128,kernel=0")
+    assert (p.stale_steps, p.capacity, p.kernel) == (2, 128, False)
+    assert sp.parse_policy("on").mode == "shard"
+    assert sp.parse_policy("off") is None
+    assert sp.parse_policy(None) is None
+    with pytest.raises(ValueError):
+        sp.parse_policy("shard:bogus=1")
+    with pytest.raises(ValueError):
+        sp.parse_policy("rows")
+    monkeypatch.setenv("PADDLE_TPU_SPARSE", "shard:stale=1")
+    assert sp.resolve_policy().stale_steps == 1
+    assert sp.resolve_policy("off") is None     # arg beats env
+
+
+def test_discover_tables_multi_and_consistency():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            i = layers.data("ids", shape=[2, 1], dtype="int64")
+            a = layers.embedding(i, size=[32, 4], is_sparse=True,
+                                 is_distributed=True,
+                                 param_attr=pt.ParamAttr(name="ta"))
+            b = layers.embedding(i, size=[48, 4], is_sparse=True,
+                                 is_distributed=True,
+                                 param_attr=pt.ParamAttr(name="tb"))
+            layers.mean(layers.elementwise_add(a, b))
+    assert sp.discover_tables(main) == ["ta", "tb"]
+
+
+# ------------------------------------------------------------ parity
+
+def test_engine_adam_matches_replicated_dense_path():
+    """Mod-sharded engine == single-device dense-path numerics (losses
+    AND the final table, through to_logical), with vocab/N rows per
+    shard — the pserver-partitioned-table semantics."""
+    vocab, dim, steps = 64, 8, 4
+    feed = _feed(vocab, dim)
+    base, table_a = _run_dense(vocab, dim, "adam", feed, steps)
+    par, scope, pexe = _run_engine(vocab, dim, "adam", feed, steps)
+    np.testing.assert_allclose(par, base, rtol=1e-4, atol=1e-6)
+    assert par[-1] < par[0]
+    table = scope.get("tbl")
+    for shard in table.addressable_shards:
+        assert shard.data.shape[0] == vocab // 8
+    eng = pexe.sparse_engine
+    np.testing.assert_allclose(
+        eng.to_logical("tbl", np.asarray(table)), table_a,
+        rtol=1e-4, atol=1e-6)
+
+
+def test_engine_sgd_uneven_vocab():
+    """vocab % N != 0: shards pad to ceil(vocab/N); numerics still
+    match the dense path exactly (pad rows are unreachable)."""
+    vocab, dim, steps = 61, 8, 4
+    feed = _feed(vocab, dim)
+    base, _ = _run_dense(vocab, dim, "sgd", feed, steps)
+    par, scope, _ = _run_engine(vocab, dim, "sgd", feed, steps)
+    np.testing.assert_allclose(par, base, rtol=1e-4, atol=1e-6)
+    assert scope.get("tbl").shape[0] == 8 * (-(-vocab // 8))
+
+
+def test_engine_first_step_loss_matches_before_any_update():
+    """Step-1 forward reads exact row copies — the dedup+exchange path
+    changes no bytes, only the loss reduction order differs (pmean of
+    member means vs one global mean)."""
+    vocab, dim = 64, 8
+    feed = _feed(vocab, dim)
+    base, _ = _run_dense(vocab, dim, "sgd", feed, 1)
+    par, _, _ = _run_engine(vocab, dim, "sgd", feed, 1)
+    np.testing.assert_allclose(par[0], base[0], rtol=1e-6)
+
+
+def test_engine_composes_with_int8_gradsync():
+    """DeepFM-shaped program: two sharded tables + dense tower under
+    int8 quantized grad sync — the engine owns the tables' exchange,
+    gradsync buckets only the dense params."""
+    from paddle_tpu.models import deepfm
+    vocab, F, B = 96, 6, 16
+    rng = np.random.RandomState(5)
+    feed = {"feat_ids": rng.randint(0, vocab, (B, F, 1)).astype("int64"),
+            "feat_vals": rng.rand(B, F).astype("float32"),
+            "label": rng.randint(0, 2, (B, 1)).astype("float32")}
+
+    def build(dist):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                feeds, loss, prob = deepfm.build_program(
+                    num_fields=F, vocab_size=vocab, embed_dim=8,
+                    is_distributed=dist)
+                pt.optimizer.Adam(1e-2).minimize(loss)
+        main.random_seed = startup.random_seed = 11
+        return main, startup, loss
+
+    steps = 6   # Adam at 1e-2 on the 400-wide tower oscillates early;
+    # by step 6 both the fp32 baseline and the int8 policy are down
+    main, startup, loss = build(False)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        base = [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0]))
+                for _ in range(steps)]
+
+    # fp32 (None -> engine default) must match the dense path
+    # step-for-step; int8 trains within quantization noise
+    for gs, check_parity in ((None, True), ("int8", False)):
+        main, startup, loss = build(True)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup)
+            pexe = pt.ParallelExecutor(
+                loss_name=loss.name, main_program=main, scope=scope,
+                sparse="shard", grad_sync=gs)
+            assert len(pexe.sparse_engine.tables) == 2
+            par = [float(np.asarray(pexe.run(feed=feed,
+                                             fetch_list=[loss])[0]))
+                   for _ in range(steps)]
+        assert np.isfinite(par).all() and par[-1] < par[0]
+        if check_parity:
+            np.testing.assert_allclose(par, base, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------- stale mode
+
+def test_stale_mode_defers_updates_by_k_steps():
+    """stale=1 ≙ AsyncExecutor: step N's loss reflects updates through
+    step N-2 (grads exchange+apply one step late) — so losses 1 AND 2
+    equal the sync path's step-1 loss, then training proceeds."""
+    vocab, dim, steps = 61, 8, 6
+    feed = _feed(vocab, dim)
+    base, _ = _run_dense(vocab, dim, "sgd", feed, steps)
+    st, scope, _ = _run_engine(vocab, dim, "sgd", feed, steps,
+                               spec="shard:stale=1")
+    np.testing.assert_allclose(st[0], base[0], rtol=1e-5)
+    np.testing.assert_allclose(st[1], base[0], rtol=1e-5)
+    assert st[-1] < st[0] and np.isfinite(st).all()
+    # the ring rides the scope as dp-sharded persistable state
+    pend = [k for k in scope.keys() if k.startswith(sp.PEND_PREFIX)]
+    assert sorted(pend) == [sp.PEND_PREFIX + "tbl.g",
+                            sp.PEND_PREFIX + "tbl.ids"]
+    ids_ring = scope.get(sp.PEND_PREFIX + "tbl.ids")
+    assert isinstance(ids_ring, jax.Array)
+    assert ids_ring.shape[0] == 8                 # dp-sharded leading dim
+
+
+def test_capacity_overflow_counted_not_crashed():
+    """cap=1 forces per-owner bucket overflow: the run stays finite
+    and the dropped count lands in the stats accumulator (the
+    count-carried static-shapes contract: never a wrong silent
+    resize)."""
+    vocab, dim = 64, 8
+    feed = _feed(vocab, dim)
+    losses, scope, _ = _run_engine(vocab, dim, "sgd", feed, 2,
+                                   spec="shard:cap=1")
+    assert np.isfinite(losses).all()
+    stats = np.asarray(scope.get(sp.STATS_PREFIX + "tbl"))
+    assert stats[2] > 0                           # overflow counted
+
+
+def test_eval_only_and_padding_idx():
+    """Inference programs (no backward) gather through the sharded
+    engine too, and padding_idx masks in the dense kernel's order."""
+    vocab, dim = 64, 8
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (16, 4, 1)).astype("int64")
+    ids[0, 0, 0] = 0
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            i = layers.data("ids", shape=[4, 1], dtype="int64")
+            emb = layers.embedding(
+                i, size=[vocab, dim], is_sparse=True, padding_idx=0,
+                is_distributed=True,
+                param_attr=pt.ParamAttr(name="tbl"))
+            out = layers.reduce_sum(emb, dim=1)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        w = np.asarray(scope.get("tbl"))
+        pexe = pt.ParallelExecutor(main_program=main, scope=scope,
+                                   sparse="shard")
+        res = pexe.run(feed={"ids": ids}, fetch_list=[out],
+                       is_test=True)[0]
+    mask = (ids.reshape(16, 4) != 0)[..., None]
+    ref = (np.take(w, ids.reshape(16, 4), axis=0) * mask).sum(1)
+    np.testing.assert_allclose(np.asarray(res), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+# -------------------------------------------------- giant-table path
+
+def test_strip_init_and_shard_wise_seeding():
+    """The vocab-beyond-HBM entry: startup never materializes the
+    table; init_shards seeds vocab/N rows per member directly."""
+    vocab, dim = 10_000, 8
+    main, startup, loss = _build_table_model(vocab, dim, "sgd")
+    sp.strip_table_init(startup, ["tbl"])
+    assert not any("tbl" in op.output_names()
+                   for op in startup.global_block().ops)
+    feed = _feed(vocab, dim)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        assert scope.get("tbl") is None           # never materialized
+        pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                   main_program=main, scope=scope,
+                                   sparse="shard")
+        pexe.sparse_engine.init_shards(scope, seed=1)
+        tbl = scope.get("tbl")
+        assert isinstance(tbl, jax.Array)
+        assert tbl.addressable_shards[0].data.shape[0] == vocab // 8
+        losses = [float(np.asarray(pexe.run(feed=feed,
+                                            fetch_list=[loss])[0]))
+                  for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------- telemetry
+
+def test_engine_telemetry_gauges():
+    vocab, dim = 64, 8
+    feed = _feed(vocab, dim)
+    was = tm.enabled()
+    tm.enable()
+    tm.reset()
+    try:
+        losses, scope, _ = _run_engine(vocab, dim, "sgd", feed, 2)
+        snap = tm.snapshot()
+    finally:
+        tm.reset()
+        if not was:
+            tm.disable()
+    assert snap.get("embed.tbl.rows") == vocab // 8
+    assert snap.get("embed.tbl.exchange_bytes", 0) > 0
+    ratio = snap.get("embed.tbl.unique_ratio")
+    assert ratio is not None and 0 < ratio <= 1
+    # the in-graph accumulator carries (ids, unique, overflow, steps)
+    stats = np.asarray(scope.get(sp.STATS_PREFIX + "tbl"))
+    assert stats[3] == 2 and stats[0] > 0 and 0 < stats[1] <= stats[0]
+
+
+# -------------------------------------------------------------- guards
+
+def test_guards():
+    vocab, dim = 64, 8
+    # sparse= without a distributed table
+    main, startup, loss = _build_table_model(vocab, dim, dist=False)
+    with pytest.raises(ValueError, match="no distributed"):
+        pt.ParallelExecutor(loss_name=loss.name, main_program=main,
+                            sparse="shard")
+    # transpiler + engine fight over the table
+    main, startup, loss = _build_table_model(vocab, dim)
+    t = pt.parallel.DistributeTranspiler(
+        pt.parallel.DistributeTranspilerConfig())
+    t.transpile(program=main)
+    with pytest.raises(ValueError, match="sparse"):
+        pt.ParallelExecutor(loss_name=loss.name, main_program=main,
+                            transpiler=t, sparse="shard")
+    # a distributed table must be is_sparse (row-grad taps)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            i = layers.data("ids", shape=[4, 1], dtype="int64")
+            y = layers.data("y", shape=[dim], dtype="float32")
+            emb = layers.embedding(i, size=[vocab, dim],
+                                   is_sparse=False,
+                                   is_distributed=True)
+            loss2 = layers.mean(layers.square_error_cost(
+                layers.reduce_sum(emb, dim=1), y))
+            pt.optimizer.SGD(0.1).minimize(loss2)
+    with pytest.raises(ValueError, match="is_sparse"):
+        pt.ParallelExecutor(loss_name=loss2.name, main_program=main,
+                            sparse="shard")
+
+
+def test_engine_off_is_default():
+    """No sparse= arg, no env: a distributed-table program through
+    ParallelExecutor keeps the historical replicated path — no engine,
+    no extra compile-key entry."""
+    vocab, dim = 64, 8
+    main, startup, loss = _build_table_model(vocab, dim)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                   main_program=main, scope=scope)
+        assert pexe.sparse_engine is None
+        pexe.run(feed=_feed(vocab, dim), fetch_list=[loss])
+        (ckey,) = pexe._cache.keys()
+        assert len(ckey) == 7                     # the historical tuple
+        assert not any("tpusparse" in str(part) for part in ckey)

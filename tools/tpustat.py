@@ -177,6 +177,13 @@ def _fleet_worker(rank, spool):
     # pipeline bubble gauge via the same helper PipelineTrainer uses
     pipeline.record_bubble("gpipe", n_microbatch=4, n_stages=2)
 
+    # sharded-embedding engine gauges with known values, so the fleet
+    # merge of the embed columns is pinned (parallel/sparse.py writes
+    # these per table; here the selftest plays the engine's role)
+    telemetry.gauge("embed.big_table.rows").set(64)
+    telemetry.gauge("embed.big_table.unique_ratio").set(0.5)
+    telemetry.counter("embed.big_table.exchange_bytes").inc(4096)
+
     if rank == 1:
         # synthetic straggler: this "host" reports pathologically slow
         # steps, so the detector path is exercised deterministically
@@ -240,13 +247,15 @@ def _print_fleet_table(rep):
           f"verdict: {strag.get('verdict', '?')}")
     hdr = (f"  {'rank':<5} {'host':<12} {'steps':>5} {'step_ms':>9} "
            f"{'coll#':>6} {'coll_KB':>8} {'bubble%':>8} "
-           f"{'gs_raw_KB':>10} {'gs_wire_KB':>11} {'gs_x':>6}  verdict")
+           f"{'gs_raw_KB':>10} {'gs_wire_KB':>11} {'gs_x':>6} "
+           f"{'emb_rows':>9} {'uniq%':>6} {'exch_KB':>8}  verdict")
     print(hdr)
     for r in rep["ranks"]:
         pr = rep["per_rank"][str(r)]
         mean = pr["step_seconds_mean"]
         bubble = pr["bubble_fraction"]
         ratio = pr.get("gradsync_ratio")
+        uniq = pr.get("embed_unique_ratio")
         print(f"  {r:<5} {str(pr.get('hostname') or '-')[:12]:<12} "
               f"{pr['steps']:>5} "
               f"{(mean * 1e3 if mean else 0):>9.2f} "
@@ -255,7 +264,10 @@ def _print_fleet_table(rep):
               f"{(bubble * 100 if bubble is not None else 0):>8.1f} "
               f"{pr.get('gradsync_raw_bytes', 0) / 1024:>10.1f} "
               f"{pr.get('gradsync_wire_bytes', 0) / 1024:>11.1f} "
-              f"{(f'{ratio:.2f}' if ratio else '-'):>6}  "
+              f"{(f'{ratio:.2f}' if ratio else '-'):>6} "
+              f"{pr.get('embed_rows', 0):>9} "
+              f"{(f'{uniq * 100:.1f}' if uniq is not None else '-'):>6} "
+              f"{pr.get('embed_exchange_bytes', 0) / 1024:>8.1f}  "
               f"{'STRAGGLER' if r in flagged else 'ok'}")
     if rep["collectives"]:
         parts = [f"{op} x{d.get('count', 0)} "
@@ -383,6 +395,27 @@ def _fleet_selftest(as_json, trace_path):
                 if bub is None or abs(bub - 0.2) > 1e-9:
                     problems.append(
                         f"rank {r} bubble_fraction != 0.2: {bub}")
+            # sharded-embedding columns: per-rank rollup (rows 64,
+            # unique 0.5, 4096 exchange bytes) + table detail + the
+            # counter summing across ranks in the merge
+            for r in (0, 1):
+                pr = rep["per_rank"][str(r)]
+                if pr.get("embed_rows") != 64 \
+                        or pr.get("embed_unique_ratio") != 0.5 \
+                        or pr.get("embed_exchange_bytes") != 4096:
+                    problems.append(
+                        f"rank {r} embed columns wrong: "
+                        f"{pr.get('embed_rows')}/"
+                        f"{pr.get('embed_unique_ratio')}/"
+                        f"{pr.get('embed_exchange_bytes')}")
+                det = pr.get("embed_tables", {}).get("big_table", {})
+                if det.get("rows") != 64:
+                    problems.append(
+                        f"rank {r} embed_tables detail wrong: {det}")
+            ex = rep["merged"].get("embed.big_table.exchange_bytes")
+            if not ex or ex["value"] != 2 * 4096:
+                problems.append(
+                    f"merged embed exchange_bytes != 8192: {ex}")
             if strag.get("flagged") != [1]:
                 problems.append(
                     f"straggler detector should flag rank 1, got "
