@@ -1257,6 +1257,210 @@ def _sparse_mode(vocab_rows=100_000_000, steps=8, n_devices=8):
         restore()
 
 
+def _async_mode(k=4, steps=40):
+    """`bench.py --async-steps=K`: A/B the asynchronous step pipeline
+    (tpupipe, core/pipeline_exec.py) against the synchronous executor
+    hot path — the round-4 `--flash-bf16-softmax` pattern. Two stages:
+
+    - mlp_feedbound: a feed-transfer-bound MLP (32 MB of feed per step
+      against a small matmul), the workload double_buffer existed for.
+      The SYNC leg is the PR-9 path exactly (per-step feed re-put,
+      donating, k=0); the PIPELINED leg is this PR's full feature set
+      (identity feed cache + async_steps=K + donate_state=False so
+      dispatch stays async on this jax's CPU backend). Acceptance:
+      >= 20% step-time reduction with bit-identical per-step losses.
+    - transformer: the flagship model under the same A/B (reported,
+      no bar — its step is compute-bound, the honest null case).
+
+    Caveat recorded in the artifact: this CI image has ONE host core,
+    so the window cannot overlap host work with device compute here —
+    the measured win is feed-put elimination + deferred readback; on
+    multi-core hosts / real TPUs the same knob adds compute overlap
+    (donation + async dispatch coexist on TPU backends).
+    Prints ONE JSON line + the BENCH_pipeline.json artifact."""
+    import __graft_entry__ as graft
+    restore = graft._force_cpu_mesh(1)
+    try:
+        import jax
+        # jax-0.4.37's CPU backend dispatches synchronously by
+        # default; the pipeline needs real async dispatch to measure
+        # anything (TPU backends are always async)
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        import paddle_tpu as pt
+        from paddle_tpu import layers, telemetry
+
+        def hist_sum(snap, name):
+            v = snap.get(name)
+            return float(v.get("sum", 0.0)) if isinstance(v, dict) \
+                else 0.0
+
+        def run_leg(build_fn, feed, n, *, async_k, cache,
+                    donate, seed=3):
+            main_p, startup_p = pt.Program(), pt.Program()
+            with pt.program_guard(main_p, startup_p):
+                with pt.unique_name.guard():
+                    fetch_var = build_fn()
+            main_p.random_seed = startup_p.random_seed = seed
+            scope = pt.Scope()
+            was_on = telemetry.enabled()
+            telemetry.enable()
+            telemetry.reset()
+            try:
+                with pt.scope_guard(scope):
+                    exe = pt.Executor(pt.CPUPlace())
+                    exe.feed_cache = cache
+                    exe.donate_state = donate
+                    exe.run(startup_p)
+                    exe.run(main_p, feed=feed,
+                            fetch_list=[fetch_var])      # compile
+                    telemetry.reset()
+                    t0 = time.perf_counter()
+                    outs = [exe.run(main_p, feed=feed,
+                                    fetch_list=[fetch_var],
+                                    async_steps=async_k or None)
+                            for _ in range(n)]
+                    if async_k:
+                        exe.drain()
+                    wall = time.perf_counter() - t0
+                    losses = [np.asarray(o[0]).tobytes() for o in outs]
+                    final = float(np.frombuffer(losses[-1],
+                                                np.float32)[0])
+                snap = telemetry.snapshot()
+            finally:
+                telemetry.reset()
+                if not was_on:
+                    telemetry.disable()
+            stall_s = hist_sum(snap, "executor.pending_wait_seconds") \
+                + hist_sum(snap, "executor.fetch_readback_seconds")
+            return {
+                "step_ms": round(wall / n * 1e3, 2),
+                "wall_s": round(wall, 3),
+                "final_loss": final,
+                "feed_put_reused": int(
+                    snap.get("executor.feed_put.reused", 0)),
+                # host time spent BLOCKED on device results; the
+                # overlap fraction below is 1 - stall/wall
+                "stall_s": round(stall_s, 4),
+                "_losses": losses,
+            }
+
+        rng = np.random.RandomState(0)
+        stages = {}
+
+        # ---- stage 1: feed-bound MLP (the acceptance stage) ----
+        B, D, H = 4096, 2048, 32
+        xs = rng.rand(B, D).astype("float32")
+        ys = rng.rand(B, 1).astype("float32")
+        # frozen batch: the identity cache only reuses buffers that
+        # CANNOT be mutated (or feed_cache="trust") — mark them
+        # read-only, the documented fixed-batch idiom
+        xs.flags.writeable = False
+        ys.flags.writeable = False
+
+        def build_mlp():
+            x = layers.data("x", shape=[D])
+            y = layers.data("y", shape=[1])
+            h = layers.fc(x, size=H, act="relu")
+            pred = layers.fc(h, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGD(0.1).minimize(loss)
+            return loss
+
+        feed = {"x": xs, "y": ys}
+        sync = run_leg(build_mlp, feed, steps,
+                       async_k=0, cache=False, donate=True)
+        pipe = run_leg(build_mlp, feed, steps,
+                       async_k=k, cache=True, donate=False)
+        ident = sync.pop("_losses") == pipe.pop("_losses")
+        red = 100.0 * (1.0 - pipe["step_ms"] / sync["step_ms"])
+        stages["mlp_feedbound"] = {
+            "batch": B, "dim": D, "hidden": H, "steps": steps,
+            "feed_mb": round((xs.nbytes + ys.nbytes) / 2**20, 1),
+            "sync": sync, "pipelined": pipe,
+            "step_time_reduction_pct": round(red, 1),
+            "overlap_fraction": round(
+                1.0 - pipe["stall_s"] / max(pipe["wall_s"], 1e-9), 4),
+            "bit_identical_losses": ident,
+        }
+
+        # ---- stage 2: transformer (reported; compute-bound) ----
+        from paddle_tpu.models import transformer as tfm
+
+        def build_tfm():
+            cfg = tfm.TransformerConfig(
+                src_vocab=512, trg_vocab=512, max_len=32,
+                d_model=128, d_inner=256, n_head=4, n_layer=2,
+                dropout=0.0)
+            feeds, avg_cost, tok = tfm.build_program(cfg, maxlen=32)
+            pt.optimizer.Adam(1e-3).minimize(avg_cost)
+            return avg_cost
+
+        tb, tt = 8, 32
+        src = rng.randint(3, 512, (tb, tt)).astype("int32")
+        trg = np.concatenate([np.zeros((tb, 1), "int32"),
+                              (src[:, :-1] + 1) % 512], axis=1)
+        tfm_feed = {"src": src,
+                    "src_len": np.full(tb, tt, "int32"),
+                    "trg": trg,
+                    "trg_len": np.full(tb, tt, "int32"),
+                    "label": ((src + 1) % 512).astype("int32")}
+        for arr in tfm_feed.values():
+            arr.flags.writeable = False
+        t_steps = 10
+        sync_t = run_leg(build_tfm, tfm_feed, t_steps,
+                         async_k=0, cache=False, donate=True)
+        pipe_t = run_leg(build_tfm, tfm_feed, t_steps,
+                         async_k=k, cache=True, donate=False)
+        ident_t = sync_t.pop("_losses") == pipe_t.pop("_losses")
+        stages["transformer"] = {
+            "batch": tb, "seq": tt, "steps": t_steps,
+            "sync": sync_t, "pipelined": pipe_t,
+            "step_time_reduction_pct": round(
+                100.0 * (1.0 - pipe_t["step_ms"] / sync_t["step_ms"]),
+                1),
+            "bit_identical_losses": ident_t,
+        }
+
+        ok = bool(red >= 20.0
+                  and stages["mlp_feedbound"]["bit_identical_losses"])
+        result = {
+            "metric": "pipeline_step_time_reduction_pct",
+            "value": round(red, 1),
+            "unit": "% (feed-bound stage, sync vs pipelined)",
+            "vs_baseline": round(red, 1),
+            "platform": "cpu",
+            "async_steps": k,
+            "host_cpus": os.cpu_count(),
+            "legs": {
+                "sync": "PR-9 path: per-step feed re-put, donating, "
+                        "k=0",
+                "pipelined": "feed identity cache + async window "
+                             f"k={k} + donate_state=False (CPU async "
+                             "dispatch)"},
+            "single_core_note": (
+                "1 host core on this image: the window cannot overlap "
+                "host work with device compute here, so the measured "
+                "win is feed-put elimination + deferred readback; "
+                "multi-core hosts / TPUs add compute overlap on top"
+            ) if (os.cpu_count() or 1) <= 1 else None,
+            "stages": stages,
+            "pass_20pct": ok,
+        }
+        try:
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_pipeline.json")
+            with open(path, "w") as f:
+                json.dump({"schema": "paddle_tpu.bench.pipeline.v1",
+                           **result}, f, indent=1)
+        except OSError:
+            pass
+        _emit(result)
+        return 0 if ok else 1
+    finally:
+        restore()
+
+
 def main():
     for i, arg in enumerate(sys.argv[1:], start=1):
         if arg.startswith("--deepfm-vocab-rows"):
@@ -1275,6 +1479,10 @@ def main():
             _, eq, v = arg.partition("=")
             vocab = int(float(v)) if eq and v else 100_000_000
             sys.exit(_sparse_mode(vocab_rows=vocab))
+        if arg.startswith("--async-steps"):
+            _, eq, v = arg.partition("=")
+            depth = int(v) if eq and v else 4
+            sys.exit(_async_mode(k=depth))
     if os.environ.get("BENCH_CHILD"):
         _child_main()
     else:

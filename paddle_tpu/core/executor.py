@@ -11,6 +11,7 @@ kernel launches (BASELINE.json north-star).
 import logging
 import os
 import time
+import weakref
 
 import numpy as np
 import jax
@@ -26,7 +27,30 @@ from ..resilience import chaos as _chaos
 
 from .scope import scope_guard  # noqa: F401  (ref executor.py re-exports it)
 
-__all__ = ["Executor", "scope_guard", "as_numpy"]
+__all__ = ["Executor", "scope_guard", "as_numpy",
+           "resolve_async_steps"]
+
+
+def resolve_async_steps(arg, attr=None):
+    """Async window depth: explicit run(async_steps=) arg > the
+    executor attribute > the PADDLE_TPU_ASYNC env var. 0 (the default
+    everywhere) is the synchronous path — pinned bit-identical to
+    pre-async behavior, without ever importing pipeline_exec."""
+    val = arg if arg is not None else attr
+    if val is None:
+        raw = (os.environ.get("PADDLE_TPU_ASYNC") or "").strip().lower()
+        if raw in ("", "0", "off", "false", "none", "no"):
+            return 0
+        try:
+            val = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"PADDLE_TPU_ASYNC={raw!r} is not an integer window "
+                "depth")
+    k = int(val)
+    if k < 0:
+        raise ValueError(f"async_steps must be >= 0, got {k}")
+    return k
 
 _LOG = logging.getLogger("paddle_tpu.executor")
 
@@ -86,8 +110,39 @@ class Executor:
         self.last_step_time = None   # wall seconds of the last run()
         self._seen_keys = set()
         # per-device on-device step counters (PRNG stream position);
-        # donated through every run() so advancing costs no dispatch
+        # donated through every run() so advancing costs no dispatch,
+        # with a host-side mirror of the value so diagnostics never
+        # need a blocking scalar readback (the counter advances by
+        # exactly 1 per run — the mirror is definitionally in sync)
         self._step_counters = {}
+        self._step_counter_vals = {}
+        # asynchronous step pipeline (tpupipe, core/pipeline_exec.py):
+        # run(async_steps=k) / PADDLE_TPU_ASYNC=k defers fetch
+        # readback + finite checks behind a k-deep in-flight window.
+        # None/0 (the default) is the synchronous path, bit-identical
+        # to pre-async behavior — pipeline_exec is only imported once
+        # a window is requested (pinned by the bench contract).
+        self.async_steps = None
+        self._async_pipe = None
+        self._prefetchers = {}
+        # identity-keyed feed reuse cache: a caller passing the SAME
+        # numpy buffer again skips the device re-put entirely (weakly
+        # referenced, so it never pins host memory and a recycled id
+        # can't alias a dead array). Mutating a previously-fed buffer
+        # in place is invisible to it — pass a fresh array, or set
+        # feed_cache = False.
+        self.feed_cache = True
+        self._feed_cache = {}
+        # persistable-state donation (default on: params update in
+        # place in HBM). MEASURED on this image's jax-0.4.37 CPU
+        # backend: executions with donated inputs run INLINE on the
+        # dispatching thread — donation and async dispatch are
+        # mutually exclusive there, so a pipelined (async_steps=k)
+        # throughput loop on such a backend can set donate_state=False
+        # to trade the in-place update for real compute/host overlap.
+        # TPU backends overlap fine with donation on; leave it alone.
+        # Toggling recompiles (the non-default value joins the ckey).
+        self.donate_state = True
         # run_scanned backend gate: "auto" probes the backend once per
         # device (relay backends re-dispatch scan bodies per iteration —
         # 30-85x slower than per-step execution); "on" forces the
@@ -97,38 +152,110 @@ class Executor:
         self._scan_gate_cache = {}
 
     def close(self):
+        # abandon any in-flight async steps (call drain() first if the
+        # final fetches/checks matter) and stop the prefetch threads
+        self.discard_pending()
+        for pf in self._prefetchers.values():
+            pf.stop()
+        self._prefetchers.clear()
         self._cache.clear()
         self._scan_gate_cache.clear()
         self._seen_keys.clear()
         self._step_counters.clear()
+        self._step_counter_vals.clear()
+        self._feed_cache.clear()
         # final flush so a closed executor's run leaves its metrics on
         # record (writes PADDLE_TPU_TELEMETRY_DIR artifacts when set)
         _tm.flush()
+
+    # ------------------------------------------------ async pipeline
+    def drain(self):
+        """Materialize every in-flight async step (deferred readbacks
+        and finite checks run now, in step order — the earliest
+        deferred failure raises first). No-op with no window; the
+        Guardian calls this before committing a checkpoint."""
+        if self._async_pipe is not None:
+            self._async_pipe.drain()
+        return self
+
+    def discard_pending(self):
+        """Abandon in-flight async steps WITHOUT their deferred checks
+        (restore/teardown paths — the state is being replaced anyway).
+        Returns how many steps were dropped."""
+        if self._async_pipe is not None:
+            return self._async_pipe.discard()
+        return 0
+
+    @property
+    def inflight(self):
+        """Current async window occupancy (0 when synchronous)."""
+        return len(self._async_pipe) if self._async_pipe is not None \
+            else 0
+
+    @staticmethod
+    def _feed_dtype(program, name):
+        """Target numpy dtype for feed `name`, or None when the program
+        doesn't declare it (x32 mode downcasts 64-bit like the TPU)."""
+        var = program.global_block().vars.get(name)
+        dt = as_jnp_dtype(var.dtype) if var is not None else None
+        if dt is not None and not jax.config.jax_enable_x64:
+            # avoid per-step truncation warnings: TPU runs x32
+            dt = {jnp.int64: jnp.int32, jnp.uint64: jnp.uint32,
+                  jnp.float64: jnp.float32}.get(dt, dt)
+        return np.dtype(dt) if dt is not None else None
+
+    @staticmethod
+    def _host_immutable(arr):
+        """True when `arr` cannot be mutated through ANY handle: the
+        array and its whole base chain are read-only (a read-only view
+        over a writeable base is still mutable through the base —
+        greedy_decode's in-place token feedback is exactly that kind
+        of aliasing hazard)."""
+        a = arr
+        while a is not None:
+            if getattr(getattr(a, "flags", None), "writeable", True):
+                return False
+            a = a.base if isinstance(a.base, np.ndarray) else None
+        return True
 
     def _put_feeds(self, program, feed, dev):
         """Feed values → device arrays with ONE transfer each: dtype
         casts happen host-side, and values that are already jax Arrays
         of the right dtype pass through untouched (a device_put per feed
         per step is a relay round-trip — measured ~3 ms each on the
-        remote-TPU tunnel)."""
+        remote-TPU tunnel). Numpy feeds are reuse-cached by buffer
+        identity: the same array object fed again skips the re-put
+        (executor.feed_put.reused counts the skips). SAFE by default —
+        reuse requires the buffer be genuinely immutable (read-only
+        down its base chain, so an in-place mutation is impossible
+        rather than merely unexpected); feed_cache="trust" reuses any
+        identical buffer for loops that promise not to mutate."""
         feed_arrays = {}
+        cache = self._feed_cache if self.feed_cache else None
+        trust = self.feed_cache == "trust"
+        tm_on = _tm.enabled()
         for k, v in feed.items():
-            var = program.global_block().vars.get(k)
-            dt = as_jnp_dtype(var.dtype) if var is not None else None
-            if dt is not None and not jax.config.jax_enable_x64:
-                # avoid per-step truncation warnings: TPU runs x32
-                dt = {jnp.int64: jnp.int32, jnp.uint64: jnp.uint32,
-                      jnp.float64: jnp.float32}.get(dt, dt)
-            npdt = np.dtype(dt) if dt is not None else None
+            npdt = self._feed_dtype(program, k)
             if isinstance(v, jax.Array) and (npdt is None
                                              or v.dtype == npdt) \
                     and v.sharding.device_set == {dev}:
                 feed_arrays[k] = v
                 continue
+            if cache is not None and isinstance(v, np.ndarray):
+                ent = cache.get(k)
+                if ent is not None and ent[0]() is v \
+                        and ent[1] is dev and ent[2] == npdt \
+                        and (trust or self._host_immutable(v)):
+                    feed_arrays[k] = ent[3]
+                    if tm_on:
+                        _tm.counter("executor.feed_put.reused").inc()
+                    continue
             arr = np.asarray(v)
             if npdt is not None and arr.dtype != npdt:
                 arr = arr.astype(npdt)
             feed_arrays[k] = jax.device_put(arr, dev)
+            if cache is not None and isinstance(v, np.ndarray):
+                cache[k] = (weakref.ref(v), dev, npdt, feed_arrays[k])
         return feed_arrays
 
     def _collect_persist(self, program, scope):
@@ -311,18 +438,45 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, is_test=None,
-            validate=None, check_nan_inf=None):
+            validate=None, check_nan_inf=None, async_steps=None):
+        k_async = resolve_async_steps(async_steps, self.async_steps)
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
+        dev = self.place.jax_device()
         # programs fed by py_reader/open_files queues: pop one batch per
         # step for any reader whose vars aren't explicitly fed (parity:
-        # the C++ reader queue; raises core.EOFException when exhausted)
+        # the C++ reader queue; raises core.EOFException when exhausted).
+        # In async mode an armed reader (use_double_buffer /
+        # layers.double_buffer) is promoted to a DevicePrefetcher: its
+        # batches arrive already device_put on a background thread.
         for rd in getattr(program, "_py_readers", []):
             names = [v.name for v in rd.vars]
-            if rd.is_started() and any(n not in feed for n in names):
-                for k, v in rd.next_feed().items():
-                    feed.setdefault(k, v)
+            if not any(n not in feed for n in names):
+                continue
+            pf = self._prefetchers.get(id(rd))
+            if pf is None and k_async > 0 and rd.is_started() \
+                    and getattr(rd, "_device_prefetch", False):
+                from .pipeline_exec import DevicePrefetcher
+                pf = DevicePrefetcher(
+                    rd, dev,
+                    lambda name, _p=program: self._feed_dtype(_p, name),
+                    capacity=max(2, k_async))
+                self._prefetchers[id(rd)] = pf
+            if pf is not None:
+                try:
+                    batch = pf.next_feed()
+                except Exception:
+                    # EOF or provider error: tear the stage down so a
+                    # reset()+start() reader gets a fresh one
+                    pf.stop()
+                    self._prefetchers.pop(id(rd), None)
+                    raise
+                for n, v in batch.items():
+                    feed.setdefault(n, v)
+            elif rd.is_started():
+                for n, v in rd.next_feed().items():
+                    feed.setdefault(n, v)
         fetch_list = list(fetch_list or [])
         fetch_names = [f.name if hasattr(f, "name") else f for f in fetch_list]
         if is_test is None:
@@ -348,7 +502,6 @@ class Executor:
         check = self._check_requested(check_nan_inf)
         from ..diagnostics import recorder as _fr
         flight = _fr.active()
-        dev = self.place.jax_device()
         with _tm.span("executor.feed_put", feeds=len(feed)):
             feed_arrays = self._put_feeds(program, feed, dev)
 
@@ -359,6 +512,10 @@ class Executor:
         ckey = (id(program), program._version, _feed_signature(feed_arrays),
                 tuple(fetch_names), bool(is_test), seed,
                 _trace.FUSE_OPTIMIZER_TAIL, _trace.FUSE_MAX_ELEMS)
+        if not self.donate_state:
+            # only the non-default mode grows the key — the donating
+            # path keeps the historical 8-tuple (bench-contract pin)
+            ckey = ckey + ("nodonate",)
         fn = self._cache.get(ckey) if use_program_cache else None
         # first-run (compile) detection must survive use_program_cache=False
         first_run = ckey not in self._seen_keys
@@ -392,7 +549,9 @@ class Executor:
                     fetches, new_persist = step_fn(persist, feed, key)
                     return fetches, new_persist, step + 1
 
-                fn = jax.jit(stepped, donate_argnums=(0, 2))
+                fn = jax.jit(stepped,
+                             donate_argnums=(0, 2) if self.donate_state
+                             else ())
             if use_program_cache:
                 self._cache[ckey] = fn
         elif tm_on:
@@ -405,16 +564,21 @@ class Executor:
             # device, poisoning later mesh-sharded use of the scope
             # (e.g. startup → PipelineTrainer over a pp mesh)
             step_dev = jnp.asarray(self._step - 1, jnp.int32)
+            self._step_counter_vals[dev] = self._step - 1
+        # the host mirror tracks the donated counter (+1 per run), so
+        # diagnostics step attribution never needs a blocking readback
+        # of a counter an in-flight step hasn't produced yet
+        step_val = self._step_counter_vals.get(dev, self._step - 1)
         pre_state = None
-        step_val = None
         if check:
-            # host snapshot of the donated state + the PRNG step counter
-            # so a trip can re-execute this exact step eagerly (np.array
-            # copy: np.asarray may alias a CPU buffer that donation is
-            # about to invalidate)
-            pre_state = {k: np.array(v, copy=True)
-                         for k, v in persist.items()}
-            step_val = int(np.asarray(step_dev))
+            # host snapshot of the donated state so a trip can
+            # re-execute this exact step eagerly (np.array copy:
+            # np.asarray may alias a CPU buffer that donation is about
+            # to invalidate). In async mode EVERY in-flight step holds
+            # its own snapshot — the deferred check of step N bisects
+            # against step N's state, not the newest.
+            pre_state = {name: np.array(v, copy=True)
+                         for name, v in persist.items()}
             self.diag_snapshot_count += 1
         t0 = time.perf_counter()
         try:
@@ -427,8 +591,10 @@ class Executor:
             # it so the next run() re-seeds instead of passing a deleted
             # buffer forever
             self._step_counters.pop(dev, None)
+            self._step_counter_vals.pop(dev, None)
             raise
         self._step_counters[dev] = step_dev
+        self._step_counter_vals[dev] = step_val + 1
         if self.step_timeout is not None:
             # completion barrier only when the watchdog is armed — don't
             # break async dispatch for return_numpy=False callers
@@ -449,8 +615,11 @@ class Executor:
             # fleet spool heartbeat: a no-op until a rank is configured
             # (fleet.init / PADDLE_TPU_FLEET_RANK); with a spool dir it
             # periodically flushes this rank's snapshot for the
-            # coordinator-side FleetCollector merge
-            _tm.fleet.on_step(dt)
+            # coordinator-side FleetCollector merge. Deferred to
+            # materialization in async mode (the heartbeat should
+            # attest a COMPLETED step, not a queued one).
+            if k_async == 0:
+                _tm.fleet.on_step(dt)
         if (self.step_timeout is not None and not first_run
                 and dt > self.step_timeout):
             if tm_on:
@@ -459,8 +628,61 @@ class Executor:
                 "executor stall: step %d took %.2fs (timeout %.2fs) — "
                 "program version %s, %d feeds", self._step - 1, dt,
                 self.step_timeout, program._version, len(feed_arrays))
+        if k_async > 0:
+            # XLA may alias a fetch that is ALSO a persistable output
+            # onto the persist buffer; the next queued step donates
+            # that buffer, which would invalidate the still-pending
+            # fetch — copy such fetches to their own buffer (async
+            # only: the sync path reads them back before any donation)
+            fetches = [jnp.array(f, copy=True) if n in new_persist
+                       else f
+                       for n, f in zip(fetch_names, fetches)]
         for name, val in new_persist.items():
             scope.set(name, val)
+
+        rec = {
+            "step": self._step - 1, "step_val": step_val,
+            "fetches": fetches, "fetch_names": fetch_names,
+            "new_persist": new_persist, "program": program,
+            "feed_arrays": feed_arrays, "pre_state": pre_state,
+            "check": check, "is_test": bool(is_test), "seed": seed,
+            "return_numpy": return_numpy, "flight": flight,
+            "tm_on": tm_on, "dt": dt, "deferred": k_async > 0,
+        }
+        if k_async > 0:
+            from .pipeline_exec import PendingStep, StepWindow
+            if tm_on:
+                _tm.counter("executor.async_steps").inc()
+            pipe = self._async_pipe
+            if pipe is None:
+                pipe = self._async_pipe = StepWindow(k_async)
+            pipe.depth = max(1, k_async)
+            # push applies backpressure: a full window materializes its
+            # oldest step first (deferred checks may raise HERE, for
+            # that older step)
+            return pipe.push(PendingStep(pipe, rec,
+                                         self._finalize_record))
+        return self._finalize_record(rec)
+
+    def _finalize_record(self, rec):
+        """Post-step work — finite checks, NaN diagnosis, numpy
+        readback, flight-recorder loss annotation. Runs inline on the
+        synchronous path; a deferred (async) step runs it at
+        materialization time against its OWN record, so errors and
+        telemetry attribute to the step that produced them."""
+        fetches = rec["fetches"]
+        fetch_names = rec["fetch_names"]
+        check = rec["check"]
+        tm_on = rec["tm_on"]
+        flight = rec["flight"]
+        if rec["deferred"]:
+            t_w = time.perf_counter()
+            with _tm.span("executor.pending_wait", step=rec["step"]):
+                jax.block_until_ready(fetches)
+            if tm_on:
+                _tm.histogram("executor.pending_wait_seconds").observe(
+                    time.perf_counter() - t_w)
+                _tm.fleet.on_step(rec["dt"])
 
         if check and (fetches or check == "all"):
             t_fc = time.perf_counter()
@@ -471,19 +693,25 @@ class Executor:
                     # the reference's FLAGS_check_nan_inf checks every
                     # op output; the whole-program analog is the full
                     # updated state (params + optimizer accumulators)
-                    bad = self._nonfinite_names(new_persist.items())
+                    bad = self._nonfinite_names(
+                        rec["new_persist"].items())
                     where = "updated persistable state"
             if tm_on:
                 _tm.histogram("executor.finite_check_seconds").observe(
                     time.perf_counter() - t_fc)
             if bad:
+                detail = (f"non-finite {where}: "
+                          f"{bad[:4]}{'...' if len(bad) > 4 else ''}")
+                if rec["deferred"]:
+                    detail += (f" (deferred check of step "
+                               f"{rec['step_val']}, materialized "
+                               f"behind the async window)")
                 self._diagnose_nan_inf(
-                    program, feed_arrays, pre_state, fetch_names,
-                    bool(is_test), seed, step_val,
-                    detail=f"non-finite {where}: "
-                           f"{bad[:4]}{'...' if len(bad) > 4 else ''}")
+                    rec["program"], rec["feed_arrays"],
+                    rec["pre_state"], fetch_names, rec["is_test"],
+                    rec["seed"], rec["step_val"], detail=detail)
 
-        if return_numpy:
+        if rec["return_numpy"]:
             t_rb = time.perf_counter()
             with _tm.span("executor.fetch_readback", n=len(fetches)):
                 out = [np.asarray(f) for f in fetches]
@@ -560,6 +788,7 @@ class Executor:
         # the counter up front (exception-safe) and let the next run()
         # re-seed from self._step
         self._step_counters.pop(dev, None)
+        self._step_counter_vals.pop(dev, None)
 
         # steps == 0 dispatches nothing either way; the scan path
         # returns the correct empty (0, ...)-shaped fetches

@@ -1,0 +1,299 @@
+"""pipeline_exec — the asynchronous step pipeline (tpupipe).
+
+The synchronous hot path pays host→device feed transfer, device
+compute, and device→host fetch readback IN SERIES every step, even
+though JAX dispatch is natively asynchronous (the reference shipped the
+same overlap as `fluid.layers.double_buffer` / `py_reader(
+use_double_buffer=True)`; the TensorFlow paper credits much of its
+step-time win to overlapping the input pipeline with device
+execution). This module provides the three pieces the Executor /
+ParallelExecutor use to overlap them, opt-in via
+``run(async_steps=k)`` or ``PADDLE_TPU_ASYNC=k``:
+
+PendingStep
+    The handle ``run(async_steps=k)`` returns: it holds the
+    UN-materialized device fetches plus everything the deferred
+    post-step work needs (the pre-step diagnostics snapshot, the step
+    number, the feed arrays). It is list-like — ``handle[0]``,
+    ``len(handle)``, iteration, and ``result()`` all materialize first
+    — so code written against the synchronous return value keeps
+    working when an operator sets ``PADDLE_TPU_ASYNC``; callers that
+    defer consumption get the overlap.
+
+StepWindow
+    The bounded in-flight window: pushing past ``depth`` steps
+    materializes (blocks on) the oldest first — backpressure, so the
+    host can never race more than ``k`` steps ahead of the device.
+    Materialization is always FIFO: asking for step N+2's result
+    finalizes N and N+1 first, so the EARLIEST deferred failure is the
+    one that raises, with its own step attribution.
+
+DevicePrefetcher
+    The device-side feed staging layer for PyReader-fed programs: a
+    daemon thread pulls host batches from the reader queue, casts them
+    to the program dtypes, and ``jax.device_put``s them while the
+    current step computes — step N+1's batch is already in HBM when
+    the executor dispatches it. Armed by
+    ``py_reader(use_double_buffer=True)`` / ``layers.double_buffer``;
+    a no-op until async mode is on.
+
+Deferral contract: ``np.asarray`` readback, ``check_nan_inf`` finite
+checks, flight-recorder loss annotation, and ``fleet.on_step``
+heartbeats all run at MATERIALIZATION time, against the record of the
+step that produced them — a NaN from step N's deferred check still
+names step N, and the tpudoctor bisect replays step N's snapshot.
+
+This module is imported lazily: with async mode off nothing here loads
+(pinned by tests/test_bench_contract.py).
+"""
+import collections
+import queue as _queue
+import threading
+
+from .. import telemetry as _tm
+
+__all__ = ["PendingStep", "StepWindow", "DevicePrefetcher", "ENV_VAR"]
+
+# the window-depth env knob; resolution lives in core.executor
+# (resolve_async_steps) so the off path never imports this module
+ENV_VAR = "PADDLE_TPU_ASYNC"
+
+
+class PendingStep:
+    """A dispatched-but-unmaterialized executor step (see module
+    docstring). List-like over the fetch values; materialization is
+    idempotent and error-sticky (a deferred NanInfError re-raises on
+    every later access rather than re-running the diagnosis)."""
+
+    def __init__(self, window, record, finalize):
+        self._window = window
+        self._rec = record
+        self._finalize = finalize
+        self._result = None
+        self._done = False
+        self._discarded = False
+        self._error = None
+
+    @property
+    def step(self):
+        """Global 0-based executor step index this handle belongs to."""
+        return self._rec["step"]
+
+    @property
+    def fetch_names(self):
+        return list(self._rec["fetch_names"])
+
+    @property
+    def done(self):
+        """True once materialized (or discarded)."""
+        return self._done or self._discarded
+
+    def ready(self):
+        """Non-blocking: have the device fetches landed? (True on
+        backends whose arrays don't expose readiness.)"""
+        if self._done or self._discarded:
+            return True
+        try:
+            return all(f.is_ready() for f in self._rec["fetches"]
+                       if hasattr(f, "is_ready"))
+        except Exception:
+            return True
+
+    def result(self, return_numpy=None):
+        """Materialize: run the deferred readback + checks of every
+        OLDER in-flight step, then this one, and return the fetch
+        values (numpy by default, matching the run() call)."""
+        if self._error is not None:
+            raise self._error
+        if self._discarded:
+            raise RuntimeError(
+                "this pending step was discarded (the window was "
+                "abandoned, e.g. by a Guardian restore) — its fetches "
+                "are gone")
+        if not self._done:
+            self._window.materialize_through(self)
+        if self._error is not None:
+            raise self._error
+        if return_numpy is not None \
+                and return_numpy != self._rec["return_numpy"]:
+            import numpy as np
+            vals = self._result
+            return [np.asarray(v) for v in vals] if return_numpy \
+                else list(vals)
+        return self._result
+
+    # internal: called by the window, in FIFO order only
+    def _materialize(self):
+        if self._done or self._discarded:
+            return
+        try:
+            self._result = self._finalize(self._rec)
+        except BaseException as e:
+            self._error = e
+            raise
+        finally:
+            self._done = True
+            self._rec = {k: self._rec[k]
+                         for k in ("step", "fetch_names",
+                                   "return_numpy")}
+
+    def _discard(self):
+        if not self._done:
+            self._discarded = True
+            self._rec = {k: self._rec[k]
+                         for k in ("step", "fetch_names",
+                                   "return_numpy")}
+
+    # -- list-like access (materializes)
+    def __len__(self):
+        return len(self.result())
+
+    def __getitem__(self, i):
+        return self.result()[i]
+
+    def __iter__(self):
+        return iter(self.result())
+
+    def __repr__(self):
+        state = ("discarded" if self._discarded else
+                 "error" if self._error is not None else
+                 "done" if self._done else "pending")
+        return (f"<PendingStep step={self.step} "
+                f"fetches={len(self._rec['fetch_names'])} {state}>")
+
+
+class StepWindow:
+    """Bounded FIFO of PendingSteps. `depth` is re-read on every push
+    (the latest run(async_steps=k) wins), and pushing past it
+    materializes the oldest entries first — that block is the
+    backpressure that keeps the host at most k steps ahead."""
+
+    def __init__(self, depth=1, gauge_name="executor.inflight"):
+        self.depth = max(1, int(depth))
+        self.gauge_name = gauge_name
+        self._q = collections.deque()
+
+    def __len__(self):
+        return len(self._q)
+
+    def _gauge(self):
+        if _tm.enabled():
+            _tm.gauge(self.gauge_name).set(len(self._q))
+
+    def push(self, pending):
+        while len(self._q) >= self.depth:
+            self._materialize_oldest()
+        self._q.append(pending)
+        self._gauge()
+        return pending
+
+    def _materialize_oldest(self):
+        p = self._q.popleft()
+        self._gauge()
+        p._materialize()
+
+    def materialize_through(self, pending):
+        """FIFO-finalize up to and including `pending` (earliest
+        deferred failure raises first)."""
+        while self._q and not pending.done:
+            self._materialize_oldest()
+
+    def drain(self):
+        """Materialize everything in flight (Guardian calls this
+        before committing a checkpoint so deferred checks validate the
+        state being saved). A deferred failure raises with the window
+        advanced past the failing step."""
+        while self._q:
+            self._materialize_oldest()
+
+    def discard(self):
+        """Abandon every in-flight step WITHOUT running its deferred
+        checks — for restore paths where the state is being thrown
+        away anyway."""
+        n = len(self._q)
+        while self._q:
+            self._q.popleft()._discard()
+        self._gauge()
+        return n
+
+
+class _PrefetchEOF(Exception):
+    pass
+
+
+class DevicePrefetcher:
+    """Background device-feed staging for one (reader, device) pair.
+
+    The thread pulls `reader.next_feed()` host batches, casts each
+    array to the program dtype, and `jax.device_put`s it, keeping up
+    to `capacity` batches staged in HBM ahead of the consumer. EOF /
+    provider errors ride the queue and re-raise in `next_feed()` on
+    the consumer side, exactly like the host-side PyReader contract.
+    """
+
+    def __init__(self, reader, dev, cast_fn, capacity=2):
+        self.reader = reader
+        self.dev = dev
+        self._cast = cast_fn      # {name: host_array} -> {name: dtype}
+        self.capacity = max(1, int(capacity))
+        self._q = _queue.Queue(maxsize=self.capacity)
+        self._stop = threading.Event()
+        self.at_eof = False
+        self._thread = threading.Thread(target=self._worker,
+                                        daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        import numpy as np
+        import jax
+        from . import EOFException
+        q, stop = self._q, self._stop
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        try:
+            while not stop.is_set():
+                try:
+                    host = self.reader.next_feed()
+                except EOFException as e:
+                    put(("eof", e))
+                    return
+                staged = {}
+                for name, arr in host.items():
+                    dt = self._cast(name)
+                    a = np.asarray(arr)
+                    if dt is not None and a.dtype != dt:
+                        a = a.astype(dt)
+                    staged[name] = jax.device_put(a, self.dev)
+                if _tm.enabled():
+                    _tm.counter("reader.device_prefetch.batches").inc()
+                if not put(("ok", staged)):
+                    return
+        except Exception as e:       # provider bug: surface, don't hang
+            put(("err", e))
+
+    def next_feed(self):
+        """One staged batch as {name: device_array}; EOFException when
+        the underlying reader is exhausted (after the staged tail is
+        consumed)."""
+        kind, payload = self._q.get()
+        if kind == "ok":
+            return payload
+        self.at_eof = True
+        raise payload
+
+    def stop(self):
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=2.0)

@@ -66,6 +66,14 @@ class PyReader:
                  provider=None):
         self.vars = list(vars)
         self.capacity = max(2 if use_double_buffer else 1, int(capacity))
+        # double-buffer arming (ref py_reader(use_double_buffer=True) /
+        # layers.double_buffer): marks this reader eligible for the
+        # DEVICE prefetch stage — under Executor.run(async_steps=k) its
+        # batches are device_put on a background thread while the
+        # current step computes (core/pipeline_exec.DevicePrefetcher).
+        # A no-op when async mode is off: the host queue alone already
+        # overlaps the provider with training.
+        self._device_prefetch = bool(use_double_buffer)
         self._provider = provider
         self._thread = None
         self._q = None
@@ -242,8 +250,14 @@ def read_file(reader):
 
 def double_buffer(reader, place=None, name=None):
     """ref layers.double_buffer — the PyReader queue already overlaps
-    host→device transfer with compute; this bumps its depth."""
+    the provider with compute; this bumps its depth AND arms the
+    device-prefetch stage, so under `Executor.run(async_steps=k)` /
+    `PADDLE_TPU_ASYNC=k` the next batch is staged in device memory by
+    a background thread while the current step computes (the
+    reference's double_buffer op semantics). With async mode off the
+    arming is a no-op."""
     reader.capacity = max(reader.capacity, 2)
+    reader._device_prefetch = True
     return reader
 
 

@@ -119,6 +119,12 @@ class ParallelExecutor:
         self._cache = {}
         self._step = 0
         self._replicated = NamedSharding(self.mesh, P())
+        # asynchronous step pipeline (tpupipe): same bounded in-flight
+        # window as Executor.run(async_steps=k), over the shard_map /
+        # SPMD path — run() returns PendingStep handles and defers the
+        # global-fetch readback. 0/None keeps today's synchronous path.
+        self.async_steps = None
+        self._async_pipe = None
 
     @property
     def device_count(self):
@@ -335,8 +341,47 @@ class ParallelExecutor:
                            check_vma=False)
         return jax.jit(sm, donate_argnums=(0,))
 
+    # ------------------------------------------------ async pipeline
+    def drain(self):
+        """Materialize every in-flight async step (see Executor.drain)."""
+        if self._async_pipe is not None:
+            self._async_pipe.drain()
+        return self
+
+    def discard_pending(self):
+        """Abandon in-flight async steps without materializing them."""
+        if self._async_pipe is not None:
+            return self._async_pipe.discard()
+        return 0
+
+    @property
+    def inflight(self):
+        return len(self._async_pipe) if self._async_pipe is not None \
+            else 0
+
+    def _finalize_record(self, rec):
+        """Deferred tail of an async pexe step: block, read the global
+        fetches back, and emit the completion-side telemetry (fleet
+        heartbeat, sparse-engine gauges) with that step's numbers."""
+        fetches = rec["fetches"]
+        if rec["deferred"]:
+            t_w = time.perf_counter()
+            with _tm.span("pexe.pending_wait", step=rec["step"]):
+                jax.block_until_ready(fetches)
+            if rec["tm_on"]:
+                _tm.histogram("pexe.pending_wait_seconds").observe(
+                    time.perf_counter() - t_w)
+                _tm.fleet.on_step(rec["dt"])
+                if rec["engine"] is not None:
+                    rec["engine"].update_gauges(self.scope)
+        if rec["return_numpy"]:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
     def run(self, fetch_list=None, feed=None, feed_dict=None,
-            return_numpy=True, is_test=False):
+            return_numpy=True, is_test=False, async_steps=None):
+        from ..core.executor import resolve_async_steps
+        k_async = resolve_async_steps(async_steps, self.async_steps)
         feed = dict(feed or feed_dict or {})
         fetch_names = [f.name if hasattr(f, "name") else f
                        for f in (fetch_list or [])]
@@ -468,15 +513,37 @@ class ParallelExecutor:
         with _tm.span("pexe.step", step=self._step - 1,
                       devices=self.device_count):
             fetches, new_persist = fn(persist, feed_arrays, key)
+        if k_async > 0:
+            # a fetch that is ALSO a persistable output may alias the
+            # state buffer the next queued step donates — give pending
+            # fetches their own buffer (async only; see Executor.run)
+            fetches = [jnp.copy(f) if n in new_persist else f
+                       for n, f in zip(fetch_names, fetches)]
         for name, val in new_persist.items():
             self.scope.set(name, val)
+        dt = time.perf_counter() - t_run0
         if tm_on:
-            dt = time.perf_counter() - t_run0
             _tm.counter("pexe.steps").inc()
             _tm.histogram("pexe.step_seconds").observe(dt)
-            _tm.fleet.on_step(dt)
-            if engine is not None:
-                engine.update_gauges(self.scope)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+            # completion-side accounting (heartbeat, engine gauges)
+            # defers to materialization in async mode
+            if k_async == 0:
+                _tm.fleet.on_step(dt)
+                if engine is not None:
+                    engine.update_gauges(self.scope)
+        rec = {"step": self._step - 1, "fetches": fetches,
+               "fetch_names": fetch_names, "return_numpy": return_numpy,
+               "tm_on": tm_on, "dt": dt, "engine": engine,
+               "deferred": k_async > 0}
+        if k_async > 0:
+            from ..core.pipeline_exec import PendingStep, StepWindow
+            if tm_on:
+                _tm.counter("pexe.async_steps").inc()
+            pipe = self._async_pipe
+            if pipe is None:
+                pipe = self._async_pipe = StepWindow(
+                    k_async, gauge_name="pexe.inflight")
+            pipe.depth = max(1, k_async)
+            return pipe.push(PendingStep(pipe, rec,
+                                         self._finalize_record))
+        return self._finalize_record(rec)

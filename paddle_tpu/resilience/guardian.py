@@ -85,6 +85,30 @@ class Guardian:
         self.restore_count = 0
         self.last_failure = None
 
+    # -------------------------------------------------- async window
+    def _drain_window(self):
+        """Materialize the executor's deferred async steps (tpupipe)
+        BEFORE state is committed to a checkpoint: their deferred
+        finite checks must validate the state being saved, so a
+        checkpoint can never capture a step a deferred check would
+        have rejected. Raises the deferred failure (recoverable) when
+        one surfaces; a synchronous executor is a no-op."""
+        drain = getattr(self.executor, "drain", None)
+        if drain is not None:
+            drain()
+
+    def _discard_window(self):
+        """Abandon in-flight async steps on the restore path — the
+        state they produced is being thrown away, so their deferred
+        checks must not fire (and must not block)."""
+        discard = getattr(self.executor, "discard_pending", None)
+        if discard is not None:
+            n = discard()
+            if n:
+                _LOG.warning(
+                    "guardian: discarded %d in-flight async step(s) "
+                    "before restore", n)
+
     # ------------------------------------------------------ checkpoints
     def save(self, step):
         """Checkpoint completed step `step` (meta.step == step means
@@ -116,6 +140,9 @@ class Guardian:
         demoted to a log line — the older checkpoint is the restore
         point either way)."""
         from .. import io as _io
+        # restoring over in-flight async steps is never valid: their
+        # deferred checks refer to state this restore replaces
+        self._discard_window()
         try:
             self.saver.wait()
         except RuntimeError as e:
@@ -160,6 +187,14 @@ class Guardian:
         while step < steps:
             try:
                 last = step_fn(step)
+                # drain the async window at every checkpoint boundary
+                # (and at the end of the run) INSIDE the recoverable
+                # scope: a deferred NaN surfacing here restores and
+                # resumes like any step failure, and the checkpoint
+                # below only ever commits validated state
+                done = step + 1
+                if done % self.save_every == 0 or done == steps:
+                    self._drain_window()
             except self.recoverable as e:
                 self.last_failure = e
                 self.restarts += 1
@@ -172,6 +207,7 @@ class Guardian:
                     "guardian: step %d failed (%s: %s) — restart "
                     "%d/%d", step, type(e).__name__, e, self.restarts,
                     self.max_restarts)
+                self._discard_window()
                 resumed = self.restore()
                 if resumed is None:
                     step = self._cold_start() or start_step

@@ -192,6 +192,74 @@ def test_sparse_engine_off_paths_untouched():
     assert "SPARSE_OFF_OK" in p.stdout
 
 
+def test_async_off_paths_untouched():
+    """tpupipe's off contract (the PR-10 bench-contract pin): with
+    PADDLE_TPU_ASYNC unset and no async_steps arg, a run never imports
+    core.pipeline_exec, the Executor compile key stays the historical
+    8-tuple (donating), telemetry stays empty, and the fetch values
+    are bit-identical to the raw jitted step-fn composition the
+    executor lowers to (same donated persist, same fold_in(seed, step)
+    PRNG derivation)."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import jax, jax.numpy as jnp\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n"
+        "from paddle_tpu import telemetry as tm\n"
+        "from paddle_tpu.core.trace import build_step_fn\n"
+        "main, startup = pt.Program(), pt.Program()\n"
+        "with pt.program_guard(main, startup):\n"
+        "    with pt.unique_name.guard():\n"
+        "        x = layers.data('x', shape=[8])\n"
+        "        y = layers.data('y', shape=[4])\n"
+        "        pred = layers.fc(x, size=4)\n"
+        "        loss = layers.mean(layers.square_error_cost(pred, y))\n"
+        "        pt.optimizer.SGD(0.1).minimize(loss)\n"
+        "main.random_seed = startup.random_seed = 6\n"
+        "rng = np.random.RandomState(0)\n"
+        "feed = {'x': rng.randn(8, 8).astype('float32'),\n"
+        "        'y': rng.randn(8, 4).astype('float32')}\n"
+        "scope = pt.Scope()\n"
+        "with pt.scope_guard(scope):\n"
+        "    exe = pt.Executor(pt.CPUPlace())\n"
+        "    exe.run(startup)\n"
+        "    ref_persist = {v.name: jnp.asarray(np.asarray(\n"
+        "        scope.get(v.name))) for v in main.persistable_vars()}\n"
+        "    outs = [exe.run(main, feed=feed, fetch_list=[loss])\n"
+        "            for _ in range(3)]\n"
+        "assert 'paddle_tpu.core.pipeline_exec' not in sys.modules, \\\n"
+        "    'sync run imported the async pipeline'\n"
+        "ckeys = list(exe._cache)\n"
+        "train_keys = [k for k in ckeys if isinstance(k, tuple)\n"
+        "              and len(k) == 8]\n"
+        "assert len(ckeys) == len(train_keys) == 2, ckeys\n"
+        "assert tm.snapshot() == {}\n"
+        "# value pin: replay the raw composition the executor lowers\n"
+        "# to (startup was executor step 0 -> training steps 1..3)\n"
+        "step_fn = build_step_fn(main, [loss.name], False,\n"
+        "                        pt.CPUPlace())\n"
+        "p = ref_persist\n"
+        "vals = []\n"
+        "for s in (1, 2, 3):\n"
+        "    key = jax.random.fold_in(jax.random.PRNGKey(6),\n"
+        "                             jnp.uint32(s))\n"
+        "    f, p = jax.jit(step_fn)(p, {k: jnp.asarray(v) for k, v\n"
+        "                                in feed.items()}, key)\n"
+        "    vals.append(np.asarray(f[0]))\n"
+        "for got, want in zip(outs, vals):\n"
+        "    assert np.asarray(got[0]).tobytes() == want.tobytes()\n"
+        "print('ASYNC_OFF_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_ASYNC", None)
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=REPO)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-1200:])
+    assert "ASYNC_OFF_OK" in p.stdout
+
+
 def test_resilience_off_checkpoint_forward_compatible(tmp_path):
     """save_checkpoint's crash-safe rewrite must stay readable by the
     PRE-PR reader (np.load of params.npz + json.load of

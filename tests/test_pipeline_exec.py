@@ -1,0 +1,639 @@
+"""tpupipe — the asynchronous step pipeline (core/pipeline_exec.py).
+
+Correctness under deferral is the whole game: async must be
+bit-identical to sync (fetches AND final params), deferred failures
+must attribute to the step that produced them (NanInfError step
+numbers, chaos faults, tpudoctor bisect snapshots), the Guardian must
+drain the window before committing a checkpoint and discard it before
+restoring, and the off path must stay byte-for-byte the old executor
+(pinned separately in tests/test_bench_contract.py).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.resilience import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ helpers
+
+def _build_mlp(dropout=False):
+    img = layers.data("img", shape=[16])
+    lbl = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=32, act="relu")
+    if dropout:
+        h = layers.dropout(h, dropout_prob=0.3)
+    pred = layers.fc(h, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, lbl))
+    pt.optimizer.Adam(1e-3).minimize(loss)
+    return loss
+
+
+def _build_deepfm():
+    from paddle_tpu.models import deepfm
+    feeds, loss, prob = deepfm.build_program(
+        num_fields=4, vocab_size=64, embed_dim=8)
+    pt.optimizer.Adam(1e-3).minimize(loss)
+    return loss
+
+
+def _mlp_feeds(n, B=8):
+    rng = np.random.RandomState(7)
+    return [{"img": rng.rand(B, 16).astype("float32"),
+             "label": rng.randint(0, 10, (B, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _deepfm_feeds(n, B=8):
+    rng = np.random.RandomState(7)
+    return [{"feat_ids": rng.randint(0, 64, (B, 4, 1)).astype("int64"),
+             "feat_vals": rng.rand(B, 4).astype("float32"),
+             "label": rng.randint(0, 2, (B, 1)).astype("float32")}
+            for _ in range(n)]
+
+
+def _run_steps(build_fn, feeds, fetch_extra=(), async_steps=None,
+               seed=11, drain=True):
+    """Fresh program+scope, run len(feeds) steps, return (per-step
+    fetch bytes, final param bytes) — byte-level so 'bit-identical'
+    means exactly that."""
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        with pt.unique_name.guard():
+            loss = build_fn()
+    main_p.random_seed = startup_p.random_seed = seed
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup_p)
+        outs = [exe.run(main_p, feed=f,
+                        fetch_list=[loss, *fetch_extra],
+                        async_steps=async_steps)
+                for f in feeds]
+        if drain:
+            exe.drain()
+        fetch_bytes = [tuple(np.asarray(v).tobytes() for v in o)
+                       for o in outs]
+        params = {v.name: np.asarray(scope.get(v.name)).tobytes()
+                  for v in main_p.persistable_vars()}
+    return fetch_bytes, params
+
+
+# --------------------------------------------------- sync == async
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_async_bit_identical_mnist_mlp(k):
+    feeds = _mlp_feeds(6)
+    sync_f, sync_p = _run_steps(_build_mlp, feeds)
+    async_f, async_p = _run_steps(_build_mlp, feeds, async_steps=k)
+    assert sync_f == async_f
+    assert sync_p == async_p
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_async_bit_identical_deepfm(k):
+    feeds = _deepfm_feeds(5)
+    sync_f, sync_p = _run_steps(_build_deepfm, feeds)
+    async_f, async_p = _run_steps(_build_deepfm, feeds, async_steps=k)
+    assert sync_f == async_f
+    assert sync_p == async_p
+
+
+def test_async_bit_identical_with_dropout_prng():
+    """The PRNG stream folds the donated step counter, so dropout
+    masks must match the sync sequence exactly even with steps queued
+    k deep."""
+    feeds = _mlp_feeds(6)
+    sync_f, _ = _run_steps(lambda: _build_mlp(dropout=True), feeds)
+    async_f, _ = _run_steps(lambda: _build_mlp(dropout=True), feeds,
+                            async_steps=4)
+    assert sync_f == async_f
+
+
+# ------------------------------------------------- handle semantics
+
+def test_pending_step_is_list_like_and_idempotent():
+    feeds = _mlp_feeds(3)
+    exe = pt.Executor(pt.CPUPlace())
+    main_p = pt.default_main_program()
+    with pt.unique_name.guard():
+        loss = _build_mlp()
+    exe.run(pt.default_startup_program())
+    hs = [exe.run(main_p, feed=f, fetch_list=[loss], async_steps=2)
+          for f in feeds]
+    from paddle_tpu.core.pipeline_exec import PendingStep
+    assert all(isinstance(h, PendingStep) for h in hs)
+    assert len(hs[-1]) == 1                 # materializes
+    v1 = float(hs[-1][0])
+    v2 = float(np.asarray(list(hs[-1])[0]))
+    assert v1 == v2                         # idempotent, cached
+    assert hs[-1].done and hs[0].done       # FIFO: older done first
+    assert [h.fetch_names for h in hs] == [[loss.name]] * 3
+    exe.drain()
+
+
+def test_backpressure_bounds_window_depth():
+    feeds = _mlp_feeds(7)
+    exe = pt.Executor(pt.CPUPlace())
+    main_p = pt.default_main_program()
+    with pt.unique_name.guard():
+        loss = _build_mlp()
+    exe.run(pt.default_startup_program())
+    depths = []
+    hs = []
+    for f in feeds:
+        hs.append(exe.run(main_p, feed=f, fetch_list=[loss],
+                          async_steps=2))
+        depths.append(exe.inflight)
+    assert max(depths) <= 2
+    # the overflowed (oldest) handles were materialized by backpressure
+    assert all(h.done for h in hs[:-2])
+    exe.drain()
+    assert exe.inflight == 0
+    assert all(h.done for h in hs)
+
+
+def test_async_env_opt_in(monkeypatch):
+    from paddle_tpu.core.pipeline_exec import PendingStep
+    feeds = _mlp_feeds(2)
+    exe = pt.Executor(pt.CPUPlace())
+    main_p = pt.default_main_program()
+    with pt.unique_name.guard():
+        loss = _build_mlp()
+    exe.run(pt.default_startup_program())
+    monkeypatch.setenv("PADDLE_TPU_ASYNC", "3")
+    h = exe.run(main_p, feed=feeds[0], fetch_list=[loss])
+    assert isinstance(h, PendingStep)
+    # float(out[0]) — the synchronous consumption idiom still works
+    assert np.isfinite(float(h[0]))
+    monkeypatch.delenv("PADDLE_TPU_ASYNC")
+    out = exe.run(main_p, feed=feeds[1], fetch_list=[loss])
+    assert isinstance(out, list)
+    monkeypatch.setenv("PADDLE_TPU_ASYNC", "banana")
+    with pytest.raises(ValueError):
+        exe.run(main_p, feed=feeds[1], fetch_list=[loss])
+
+
+def test_persistable_fetch_survives_donation_across_window():
+    """A fetch that is ALSO a persistable output may share a buffer
+    with the donated state; the async path must copy it so a handle
+    materialized AFTER later steps ran still reads step-N's value."""
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name="pw"))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(0.5).minimize(loss)
+    main_p = pt.default_main_program()
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(8, 4).astype("float32"),
+              "y": rng.rand(8, 1).astype("float32")} for _ in range(4)]
+
+    def run(k):
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(pt.default_startup_program())
+            outs = [exe.run(main_p, feed=f, fetch_list=[loss, "pw"],
+                            async_steps=k) for f in feeds]
+            exe.drain()
+            return [tuple(np.asarray(v).tobytes() for v in o)
+                    for o in outs]
+
+    assert run(None) == run(3)
+
+
+# ------------------------------------------- deferred attribution
+
+def test_deferred_nan_check_attributes_to_origin_step():
+    """check_nan_inf under a 4-deep window: the poison enters at step
+    2, the failure surfaces at materialization time — the NanInfError
+    must still carry step 2 and bisect against step 2's snapshot."""
+    from paddle_tpu.diagnostics import NanInfError
+    x = layers.data("x", shape=[4])
+    out = layers.reduce_mean(layers.fc(x, size=4))
+    main_p = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    good = np.ones((2, 4), np.float32)
+    bad = np.full((2, 4), np.inf, np.float32)
+    handles = []
+    err = None
+    try:
+        for i in range(5):
+            handles.append(exe.run(
+                main_p, feed={"x": bad if i == 2 else good},
+                fetch_list=[out], async_steps=4,
+                check_nan_inf="fetches"))
+        exe.drain()
+    except NanInfError as e:
+        err = e
+    assert err is not None, "deferred finite check never fired"
+    # attribution: the report carries the POISONED step's number (the
+    # executor's global counter — handles[2] is the bad dispatch),
+    # not the step during which the failure materialized
+    assert err.report.step == handles[2].step
+    assert err.report.step != handles[-1].step
+    assert err.report.phase == "input"      # the poisoned feed
+    assert "deferred" in (err.report.detail or "")
+    assert exe.last_numerics_report.step == handles[2].step
+    # earlier steps materialized clean before the failure surfaced
+    assert handles[0].done and handles[1].done
+    assert np.isfinite(float(handles[1][0]))
+
+
+def test_chaos_step_fail_under_deep_window_attributes_step():
+    feeds = _mlp_feeds(6)
+    exe = pt.Executor(pt.CPUPlace())
+    main_p = pt.default_main_program()
+    with pt.unique_name.guard():
+        loss = _build_mlp()
+    exe.run(pt.default_startup_program())      # chaos hit 1
+    chaos.configure("step_fail:at=4")          # 3rd training run below
+    try:
+        hs = []
+        with pytest.raises(chaos.ChaosFault) as ei:
+            for f in feeds:
+                hs.append(exe.run(main_p, feed=f, fetch_list=[loss],
+                                  async_steps=4))
+        # the fault fires at DISPATCH of the 4th post-configure run
+        # (executor step 4 — the startup run was step 0), with three
+        # steps still pending in the window
+        assert "executor step 4" in str(ei.value)
+        assert ei.value.fault["name"] == "step_fail"
+        assert len(hs) == 3 and exe.inflight == 3
+        # the queued pre-fault steps are intact and finite
+        exe.drain()
+        assert all(np.isfinite(float(h[0])) for h in hs)
+    finally:
+        chaos.reset()
+
+
+# ---------------------------------------------- reader prefetch
+
+def _feed_reader(data):
+    rd = layers.py_reader(
+        capacity=8, shapes=[(4, 16), (4, 1)],
+        dtypes=["float32", "int64"], use_double_buffer=True)
+    rd.decorate_tensor_provider(lambda: iter(data))
+    return rd
+
+
+def test_double_buffer_aliases_arm_device_prefetch():
+    rd = layers.py_reader(capacity=4, shapes=[(2, 4)],
+                          dtypes=["float32"], use_double_buffer=False)
+    assert rd._device_prefetch is False
+    layers.double_buffer(rd)
+    assert rd._device_prefetch is True
+    rd2 = layers.py_reader(capacity=4, shapes=[(2, 4)],
+                           dtypes=["float32"], use_double_buffer=True)
+    assert rd2._device_prefetch is True
+
+
+def test_reader_device_prefetch_matches_host_path():
+    """A py_reader-fed program under async: batches staged on-device
+    by the prefetch thread, same values as the synchronous host-queue
+    path, EOF still raised, and the prefetch stage torn down after."""
+    from paddle_tpu.core import EOFException
+    rng = np.random.RandomState(3)
+    data = [[rng.rand(4, 16).astype("float32"),
+             rng.randint(0, 10, (4, 1)).astype("int64")]
+            for _ in range(6)]
+
+    def run(k):
+        main_p, startup_p = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup_p):
+            with pt.unique_name.guard():
+                rd = _feed_reader(list(data))
+                img, lbl = layers.read_file(rd)
+                h = layers.fc(img, size=8, act="relu")
+                pred = layers.fc(h, size=10, act="softmax")
+                loss = layers.mean(layers.cross_entropy(pred, lbl))
+                pt.optimizer.SGD(0.1).minimize(loss)
+        main_p.random_seed = startup_p.random_seed = 2
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup_p)
+            rd.start()
+            outs = []
+            try:
+                while True:
+                    outs.append(exe.run(main_p, fetch_list=[loss],
+                                        async_steps=k))
+            except EOFException:
+                pass
+            exe.drain()
+            used_prefetch = bool(k) and not exe._prefetchers
+            vals = [np.asarray(o[0]).tobytes() for o in outs]
+        return vals, used_prefetch
+
+    sync_vals, _ = run(None)
+    async_vals, torn_down = run(2)
+    assert len(sync_vals) == 6
+    assert sync_vals == async_vals
+    assert torn_down, "prefetch stage not torn down after EOF"
+
+
+# ------------------------------------------------ feed reuse cache
+
+def test_feed_cache_reuses_readonly_buffers():
+    from paddle_tpu import telemetry as tm
+    x = layers.data("x", shape=[8])
+    out = layers.reduce_mean(layers.fc(x, size=4))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    a = np.random.RandomState(0).rand(2, 8).astype("float32")
+    a.flags.writeable = False          # frozen batch: safe to reuse
+    tm.enable()
+    tm.reset()
+    try:
+        r1 = exe.run(feed={"x": a}, fetch_list=[out])
+        r2 = exe.run(feed={"x": a}, fetch_list=[out])
+        r3 = exe.run(feed={"x": a}, fetch_list=[out])
+        assert tm.snapshot().get("executor.feed_put.reused") == 2
+        assert r1[0].tobytes() == r2[0].tobytes() == r3[0].tobytes()
+        # a DIFFERENT buffer (same values) is a miss, same result
+        b = a.copy()
+        r4 = exe.run(feed={"x": b}, fetch_list=[out])
+        assert tm.snapshot().get("executor.feed_put.reused") == 2
+        assert r4[0].tobytes() == r1[0].tobytes()
+        # fresh values through a fresh array are seen
+        r5 = exe.run(feed={"x": b * 2.0}, fetch_list=[out])
+        assert r5[0].tobytes() != r1[0].tobytes()
+        # "trust" mode reuses WRITEABLE identical buffers too
+        exe.feed_cache = "trust"
+        w = np.random.RandomState(1).rand(2, 8).astype("float32")
+        exe.run(feed={"x": w}, fetch_list=[out])
+        exe.run(feed={"x": w}, fetch_list=[out])
+        assert tm.snapshot().get("executor.feed_put.reused") == 3
+        # opt-out
+        exe.feed_cache = False
+        exe.run(feed={"x": a}, fetch_list=[out])
+        exe.run(feed={"x": a}, fetch_list=[out])
+        assert tm.snapshot().get("executor.feed_put.reused") == 3
+    finally:
+        tm.reset()
+        tm.disable()
+
+
+def test_feed_cache_default_sees_inplace_mutation():
+    """The greedy_decode regression pin: the default cache mode must
+    NOT reuse a writeable buffer, so a caller that mutates its feed
+    array in place between steps (autoregressive token feedback) gets
+    the fresh values. A read-only VIEW over a writeable base is still
+    mutable through the base — also not reused."""
+    x = layers.data("x", shape=[4])
+    out = layers.reduce_sum(x)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    a = np.ones((2, 4), np.float32)
+    r1 = float(exe.run(feed={"x": a}, fetch_list=[out])[0])
+    a[0, 0] = 100.0                     # in-place, same object
+    r2 = float(exe.run(feed={"x": a}, fetch_list=[out])[0])
+    assert r2 == r1 + 99.0, (r1, r2)
+    v = a[:]
+    v.flags.writeable = False           # read-only view, writeable base
+    r3 = float(exe.run(feed={"x": v}, fetch_list=[out])[0])
+    a[0, 0] = 1.0                       # mutate through the base
+    r4 = float(exe.run(feed={"x": v}, fetch_list=[out])[0])
+    assert r4 == r3 - 99.0, (r3, r4)
+
+
+def test_greedy_decode_unaffected_by_feed_cache():
+    """End-to-end guard on the same hazard: transformer greedy_decode
+    feeds the SAME ids buffer every token with in-place updates; the
+    decode must differ from a decode where tokens could never feed
+    back (i.e. the cache must not freeze step-1's trg)."""
+    import paddle_tpu.models.transformer as tfm
+    cfg = tfm.TransformerConfig(src_vocab=16, trg_vocab=16, max_len=8,
+                                d_model=16, d_inner=32, n_head=2,
+                                n_layer=1, dropout=0.0)
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        with pt.unique_name.guard():
+            feeds, logits = tfm.build_infer_program(cfg, maxlen=8)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, 16, (2, 8)).astype("int64")
+    with pt.scope_guard(scope):
+        exe.run(startup_p)
+        ids = tfm.greedy_decode(exe, main_p, logits, src,
+                                np.full(2, 8, np.int64))
+        # replay with the cache off: identical tokens
+        exe2 = pt.Executor(pt.CPUPlace())
+        exe2.feed_cache = False
+        ids2 = tfm.greedy_decode(exe2, main_p, logits, src,
+                                 np.full(2, 8, np.int64))
+    np.testing.assert_array_equal(ids, ids2)
+
+
+def test_feed_cache_holds_no_strong_host_ref():
+    """The cache keys on a WEAK reference: it never pins host memory
+    itself (backends whose device_put aliases the host buffer — this
+    jax's CPU backend — keep it alive through the device array
+    instead, which also makes id-recycling against a live entry
+    impossible). A fresh buffer after the old one dies re-puts."""
+    import weakref
+    x = layers.data("x", shape=[8])
+    out = layers.reduce_mean(layers.fc(x, size=4))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    a = np.random.RandomState(0).rand(2, 8).astype("float32")
+    exe.run(feed={"x": a}, fetch_list=[out])
+    assert isinstance(exe._feed_cache["x"][0], weakref.ref)
+    del a
+    # a new array object is a miss regardless of memory reuse
+    c = np.random.RandomState(1).rand(2, 8).astype("float32")
+    assert exe._feed_cache["x"][0]() is not c
+    r = exe.run(feed={"x": c}, fetch_list=[out])
+    assert np.isfinite(r[0]).all()
+    assert exe._feed_cache["x"][0]() is c
+    exe.close()
+    assert exe._feed_cache == {}
+
+
+# ----------------------------------------------------- guardian
+
+def test_guardian_drains_window_and_recovers_deferred_nan(tmp_path):
+    """Async training under the Guardian: deferred NaN from a poisoned
+    step surfaces at the checkpoint-boundary drain, the window is
+    discarded, the state restores, and the finished run matches the
+    clean synchronous one. Committed checkpoints only ever hold
+    validated state."""
+    from paddle_tpu.resilience import Guardian
+
+    def build():
+        main_p, startup_p = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup_p):
+            with pt.unique_name.guard():
+                x = layers.data("x", shape=[6])
+                y = layers.data("y", shape=[1])
+                pred = layers.fc(x, size=1)
+                loss = layers.mean(layers.square_error_cost(pred, y))
+                pt.optimizer.SGD(0.1).minimize(loss)
+        main_p.random_seed = startup_p.random_seed = 4
+        return main_p, startup_p, loss
+
+    def feed_for(step, poison=False):
+        rng = np.random.RandomState(100 + step)
+        x = rng.rand(8, 6).astype("float32")
+        if poison:
+            x[0, 0] = np.nan
+        return {"x": x, "y": rng.rand(8, 1).astype("float32")}
+
+    def run(poison_step, k):
+        main_p, startup_p, loss = build()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            g = Guardian(exe, main_p, str(tmp_path / f"g{k}{poison_step}"),
+                         startup_program=startup_p, save_every=3)
+            seen_poison = {"n": 0}
+
+            def step_fn(step):
+                # poison exactly once; the replay after restore is clean
+                p = step == poison_step and seen_poison["n"] == 0
+                if p:
+                    seen_poison["n"] += 1
+                return exe.run(main_p, feed=feed_for(step, poison=p),
+                               fetch_list=[loss], async_steps=k,
+                               check_nan_inf="fetches")
+
+            last = g.run_with_recovery(step_fn, steps=9)
+            final = float(np.asarray(last[0]))
+        return final, g
+
+    clean, g0 = run(poison_step=-1, k=None)
+    recovered, g1 = run(poison_step=4, k=4)
+    assert g0.restarts == 0
+    assert g1.restarts == 1, "deferred NaN did not trigger a restart"
+    assert np.isclose(clean, recovered, rtol=1e-5), (clean, recovered)
+
+
+def test_guardian_kill9_with_nonempty_window(tmp_path):
+    """kill -9 mid-run with steps in flight: every COMMITTED
+    checkpoint was drained-then-saved, so the fresh process resumes
+    from a valid restore point and lands on the uninterrupted async
+    run's loss (which itself equals the sync run's, per the parity
+    tests)."""
+    root = str(tmp_path / "kill")
+    worker = (
+        "import sys, json, numpy as np\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n"
+        "from paddle_tpu.resilience import Guardian\n"
+        "root, steps = sys.argv[1], int(sys.argv[2])\n"
+        "main_p, startup_p = pt.Program(), pt.Program()\n"
+        "with pt.program_guard(main_p, startup_p):\n"
+        "    with pt.unique_name.guard():\n"
+        "        x = layers.data('x', shape=[6])\n"
+        "        y = layers.data('y', shape=[1])\n"
+        "        pred = layers.fc(x, size=1)\n"
+        "        loss = layers.mean(layers.square_error_cost(pred, y))\n"
+        "        pt.optimizer.SGD(0.1).minimize(loss)\n"
+        "main_p.random_seed = startup_p.random_seed = 4\n"
+        "exe = pt.Executor(pt.CPUPlace())\n"
+        "g = Guardian(exe, main_p, root, startup_program=startup_p,\n"
+        "             save_every=4)\n"
+        "def step_fn(step):\n"
+        "    rng = np.random.RandomState(100 + step)\n"
+        "    return exe.run(main_p,\n"
+        "                   feed={'x': rng.rand(8, 6).astype('f4'),\n"
+        "                         'y': rng.rand(8, 1).astype('f4')},\n"
+        "                   fetch_list=[loss], async_steps=3)\n"
+        "last = g.run_with_recovery(step_fn, steps=steps)\n"
+        "print(json.dumps({'final': float(np.asarray(last[0]))}))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_CHAOS="step_fail:at=11,mode=kill")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, "-c", worker, root, "14"]
+    p1 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300, cwd=REPO)
+    assert p1.returncode == -signal.SIGKILL, \
+        (p1.returncode, p1.stderr[-500:])
+    from paddle_tpu.io import latest_checkpoint
+    assert latest_checkpoint(root) is not None, \
+        "killed run committed no durable checkpoint"
+
+    env.pop("PADDLE_TPU_CHAOS")
+    p2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300, cwd=REPO)
+    assert p2.returncode == 0, p2.stderr[-800:]
+    resumed = json.loads(p2.stdout.strip().splitlines()[-1])["final"]
+
+    # uninterrupted async run in a third process (fresh root)
+    env2 = dict(env)
+    cmd2 = [sys.executable, "-c", worker, str(tmp_path / "clean"), "14"]
+    p3 = subprocess.run(cmd2, env=env2, capture_output=True, text=True,
+                        timeout=300, cwd=REPO)
+    assert p3.returncode == 0, p3.stderr[-800:]
+    clean = json.loads(p3.stdout.strip().splitlines()[-1])["final"]
+    assert np.isclose(resumed, clean, rtol=1e-5), (resumed, clean)
+
+
+# ----------------------------------------- parallel executor window
+
+def test_parallel_executor_async_matches_sync():
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 8).astype("float32"),
+            "y": rng.randn(8, 4).astype("float32")}
+
+    def run(k):
+        main_p, startup_p = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup_p):
+            with pt.unique_name.guard():
+                x = layers.data("x", shape=[8])
+                y = layers.data("y", shape=[4])
+                pred = layers.fc(x, size=4)
+                loss = layers.mean(layers.square_error_cost(pred, y))
+                pt.optimizer.SGD(0.1).minimize(loss)
+        main_p.random_seed = startup_p.random_seed = 5
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            pt.Executor(pt.CPUPlace()).run(startup_p)
+            pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                       main_program=main_p,
+                                       scope=scope)
+            outs = [pexe.run(feed=feed, fetch_list=[loss],
+                             async_steps=k) for _ in range(4)]
+            if k:
+                assert pexe.inflight > 0
+                pexe.drain()
+                assert pexe.inflight == 0
+            return [np.asarray(o[0]).tobytes() for o in outs]
+
+    assert run(None) == run(2)
+
+
+# --------------------------------------------------- window plumbing
+
+def test_discard_pending_skips_checks_and_marks_handles():
+    from paddle_tpu.diagnostics import NanInfError  # noqa: F401
+    x = layers.data("x", shape=[4])
+    out = layers.reduce_mean(layers.fc(x, size=4))
+    main_p = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    bad = np.full((2, 4), np.inf, np.float32)
+    h = exe.run(main_p, feed={"x": bad}, fetch_list=[out],
+                async_steps=4, check_nan_inf="fetches")
+    assert exe.discard_pending() == 1
+    assert exe.inflight == 0
+    assert h.done
+    with pytest.raises(RuntimeError, match="discarded"):
+        h.result()
+    # the executor remains usable
+    ok = exe.run(main_p, feed={"x": np.ones((2, 4), np.float32)},
+                 fetch_list=[out])
+    assert np.isfinite(ok[0]).all()

@@ -460,6 +460,10 @@ def main(argv=None):
     p.add_argument("--model", default="mnist", choices=ALL_MODELS)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--async-steps", type=int, default=0,
+                   help="run the step loop through the tpupipe async "
+                        "window (Executor.run(async_steps=K)); 0 = "
+                        "synchronous")
     p.add_argument("--platform", default="cpu",
                    help="JAX_PLATFORMS to force before backend init "
                         "('env' keeps the environment's value; default "
@@ -521,10 +525,24 @@ def main(argv=None):
 
     rng = np.random.RandomState(0)
     losses = []
-    for _ in range(args.steps):
-        feed = feed_fn(args.batch_size, rng)
-        out = exe.run(main_p, feed=feed, fetch_list=[loss])
-        losses.append(float(np.asarray(out[0]).ravel()[0]))
+    inflight_peak = 0
+    if args.async_steps > 0:
+        # pipelined loop: dispatch every step, consume at the end so
+        # the window actually fills (consuming per-step would drain it)
+        handles = []
+        for _ in range(args.steps):
+            feed = feed_fn(args.batch_size, rng)
+            handles.append(exe.run(main_p, feed=feed,
+                                   fetch_list=[loss],
+                                   async_steps=args.async_steps))
+            inflight_peak = max(inflight_peak, exe.inflight)
+        exe.drain()
+        losses = [float(np.asarray(h[0]).ravel()[0]) for h in handles]
+    else:
+        for _ in range(args.steps):
+            feed = feed_fn(args.batch_size, rng)
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
 
     device_profile = None
     if args.profile_device:
@@ -578,6 +596,8 @@ def main(argv=None):
         "platform": jax.devices()[0].platform,
         "diagnostics": diag,
         "signatures": signatures,
+        "async_steps": args.async_steps,
+        "inflight_peak": inflight_peak,
         "final_loss": losses[-1] if losses else None,
         "metrics": snap,
         "trace": {"path": trace_path, "span_events": span_events},
@@ -590,10 +610,14 @@ def main(argv=None):
     if args.as_json:
         print(json.dumps(result, default=str))
     else:
+        async_hdr = (f"async={args.async_steps} "
+                     f"inflight_peak={inflight_peak} "
+                     if args.async_steps > 0 else "")
         print(f"tpustat: {args.model} x {args.steps} steps "
               f"(batch {args.batch_size}) on "
               f"{result['platform']}, {signatures} compiled "
               f"signature{'s' if signatures != 1 else ''}, "
+              f"{async_hdr}"
               f"nan_check={'on' if diag['nan_check'] else 'off'} "
               f"flight_recorder="
               f"{'on' if diag['flight_recorder'] else 'off'}")
