@@ -211,6 +211,38 @@ def _load_fluid_inference_model(dirname, blob, params_filename):
 # ---------------------------------------------------------------------------
 # train checkpoints (resume training: params + opt state + counters)
 # ---------------------------------------------------------------------------
+def _elastic_snapshot(executor, scope):
+    """(layout, shard_files) for a topology-independent save: with a
+    sparse engine attached (ParallelExecutor sparse=...), every
+    mod-sharded row var is snapshotted one shard file per mesh member
+    (each host copies only its addressable 1/N — never the gathered
+    [V, D]) and described by a logical `layout` record, so a
+    checkpoint written at world N restores at ANY world M through the
+    elastic streaming shuffle. Plain executors (no engine) return
+    empty — the checkpoint format is byte-identical to the pre-elastic
+    one then (bench-contract pin)."""
+    engine = getattr(executor, "sparse_engine", None)
+    if engine is None:
+        return {}, {}
+    layout, files = engine.export_shards(scope)
+    # npz/npy have no bfloat16: shards take the same uint16 disk view
+    # as sharded checkpoints; layout records the true dtype
+    return layout, {fn: _np_to_disk(a)[0] for fn, a in files.items()}
+
+
+def _checkpoint_meta(arrays, layout, engine_world, step, extra):
+    """The checkpoint meta/manifest record. `world_size` and `layout`
+    are ADDITIVE (pre-elastic readers ignore them; a manifest without
+    them still loads): world_size is the shard world of the layout
+    files — 1 when everything is logical."""
+    meta = {"step": int(step), "vars": sorted(arrays),
+            "extra": extra or {},
+            "world_size": int(engine_world) if layout else 1}
+    if layout:
+        meta["layout"] = layout
+    return meta
+
+
 def save_checkpoint(executor, dirname, main_program=None, step=0,
                     extra=None):
     """Crash-safe checkpoint: params + meta + checksum manifest are
@@ -219,13 +251,19 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
     the previous checkpoint or the new one, never a torn mix (the
     pre-manifest writer saved in place: a crash mid-savez left a
     checkpoint.json pointing at an unreadable npz that load_checkpoint
-    would happily open)."""
+    would happily open). Topology-independent: dense persistables are
+    saved in their logical layout, and an attached sparse engine's
+    mod-sharded tables go one shard file per member with a `layout`
+    manifest record, so the checkpoint restores at any world size."""
     from .core.framework import default_main_program
     program = main_program or default_main_program()
     scope = global_scope()
-    arrays = _collect(program, lambda v: v.persistable, scope)
-    meta = {"step": int(step), "vars": sorted(arrays),
-            "extra": extra or {}}
+    layout, shard_files = _elastic_snapshot(executor, scope)
+    arrays = _collect(program, lambda v: v.name not in layout, scope)
+    meta = _checkpoint_meta(
+        arrays, layout,
+        getattr(getattr(executor, "sparse_engine", None), "n", 1),
+        step, extra)
     parent = os.path.dirname(os.path.abspath(dirname)) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = dirname + f".tmp.{os.getpid()}"
@@ -233,7 +271,8 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
         import shutil
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    _rckpt.write_payload(tmp, arrays, meta, PARAMS_FILE, META_FILE)
+    _rckpt.write_payload(tmp, arrays, meta, PARAMS_FILE, META_FILE,
+                         extra_files=shard_files)
     _rckpt.atomic_publish(tmp, dirname)
     return meta
 
@@ -270,7 +309,17 @@ def load_checkpoint(executor, dirname, main_program=None):
                     "exists")
     load_persistables(executor, dirname, main_program)
     with open(os.path.join(dirname, META_FILE)) as f:
-        return json.load(f)
+        meta = json.load(f)
+    layout = meta.get("layout")
+    if layout:
+        # topology-independent tables: re-shard r%N -> r%M into the
+        # executor's engine placement (or assemble logically for a
+        # plain executor) — resilience/elastic.py, imported only when
+        # a checkpoint actually carries a layout (off-path pin)
+        from .resilience import elastic as _elastic
+        _elastic.restore_layout(executor, dirname, layout,
+                                global_scope())
+    return meta
 
 
 def _list_checkpoints(root):
@@ -346,29 +395,37 @@ class CheckpointSaver:
         from .core.framework import default_main_program
         program = main_program or default_main_program()
         scope = global_scope()
+        # topology-independent snapshot of any engine-sharded tables
+        # (one host copy per addressable shard) — taken NOW for the
+        # same donation reason as the dense arrays below
+        layout, shard_files = _elastic_snapshot(executor, scope)
         # device -> host snapshot NOW, with an explicit COPY: np.asarray
         # can alias a CPU jax.Array (or a numpy value already in scope),
         # and the executor donates the persist dict — an aliased buffer
         # would be rewritten by the next step while the writer runs
         arrays = {v.name: np.array(scope.get(v.name), copy=True)
                   for v in program.persistable_vars()
-                  if scope.get(v.name) is not None}
-        meta = {"step": int(step), "vars": sorted(arrays),
-                "extra": extra or {}}
+                  if scope.get(v.name) is not None
+                  and v.name not in layout}
+        meta = _checkpoint_meta(
+            arrays, layout,
+            getattr(getattr(executor, "sparse_engine", None), "n", 1),
+            step, extra)
         self.wait()                      # one in-flight save at a time
         if self.async_save:
             import threading
             self._thread = threading.Thread(
-                target=self._write, args=(arrays, meta, step), daemon=True)
+                target=self._write, args=(arrays, meta, step,
+                                          shard_files), daemon=True)
             self._thread.start()
         else:
-            self._write(arrays, meta, step)
+            self._write(arrays, meta, step, shard_files)
             if self._error is not None:   # sync mode: fail loudly NOW
                 err, self._error = self._error, None
                 raise RuntimeError(f"checkpoint write failed: {err}")
         return meta
 
-    def _write(self, arrays, meta, step):
+    def _write(self, arrays, meta, step, shard_files=None):
         try:
             tmp = os.path.join(self.root, f".tmp_checkpoint_{step}")
             final = os.path.join(self.root, f"checkpoint_{step}")
@@ -381,7 +438,7 @@ class CheckpointSaver:
             # exactly like a writer killed mid-write, the torn state
             # stays in tmp and never becomes visible
             _rckpt.write_payload(tmp, arrays, meta, PARAMS_FILE,
-                                 META_FILE)
+                                 META_FILE, extra_files=shard_files)
             # publish atomically and make the rename durable before
             # pruning — a crash here must leave SOME valid checkpoint
             _rckpt.atomic_publish(tmp, final)

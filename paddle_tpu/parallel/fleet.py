@@ -11,8 +11,8 @@ from ..resilience import chaos as _chaos
 from ..resilience import retry as _retry
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 
-__all__ = ["init", "distributed_optimizer", "worker_num", "worker_index",
-           "is_first_worker", "barrier_all"]
+__all__ = ["init", "reform", "distributed_optimizer", "worker_num",
+           "worker_index", "is_first_worker", "barrier_all"]
 
 _state = {"initialized": False, "transpiler": None}
 
@@ -44,6 +44,44 @@ def init(role_maker=None, coordinator_address=None, num_processes=None,
         _tm.fleet.configure_from_jax()
     except Exception:
         pass   # observability must never block gang bring-up
+
+
+# elastic re-form is noisier than first bring-up: every surviving rank
+# tears down and reconnects at once, racing the coordinator's own
+# restart — more attempts, longer deadline. Classification is the
+# point: coordinator-unavailable / failed-to-connect / address-in-use
+# are Retryable (retry.transient's transport markers), a TypeError or
+# config bug surfaces on attempt 1.
+_REFORM_POLICY = _retry.RetryPolicy(max_attempts=5, base_delay_s=0.5,
+                                    max_delay_s=8.0, deadline_s=180.0)
+
+
+def reform(coordinator_address=None, num_processes=None,
+           process_id=None):
+    """Tear down the collective world and bring it back up — the
+    elastic re-form step (resilience/elastic.py drives this when a
+    rank dies or a resize request arrives, then restores from the
+    topology-independent checkpoint). Single-process (no coordinator
+    address): there is no gang to tear down, only the fleet telemetry
+    identity is refreshed. Multi-process: jax.distributed.shutdown()
+    best-effort (a dead coordinator raising here is exactly WHY we are
+    re-forming), then initialize at the new world size under
+    _REFORM_POLICY."""
+    if coordinator_address is not None:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass   # already down — the dead coordinator is the cause
+        _retry.call(jax.distributed.initialize, coordinator_address,
+                    num_processes, process_id,
+                    policy=_REFORM_POLICY, name="fleet.reform")
+    _state["initialized"] = True
+    try:
+        _tm.fleet.configure_from_jax()
+    except Exception:
+        pass   # observability must never block re-form
+    if _tm.enabled():
+        _tm.counter("fleet.reforms").inc()
 
 
 def worker_num():

@@ -34,6 +34,7 @@ from ..core.scope import global_scope
 from ..core.trace import build_step_fn
 from ..core.dtypes import as_jnp_dtype
 from .. import telemetry as _tm
+from ..resilience import chaos as _chaos
 from .mesh import local_mesh
 
 from ..core.compiler import BuildStrategy, ExecutionStrategy  # noqa: F401
@@ -395,6 +396,14 @@ class ParallelExecutor:
         seed = program.random_seed
         key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
+        # chaos: the SAME executor.step injection point the plain
+        # Executor honors (step_fail / rank_lost / resize fire under
+        # SPMD training too — the elastic selftest's kill target).
+        # One cached-bool check when disarmed.
+        if _chaos.armed():
+            _chaos.check("executor.step",
+                         detail=f"pexe step {self._step - 1}",
+                         step=self._step - 1)
 
         feed_arrays = {}
         feed_sh = {}

@@ -362,6 +362,14 @@ class SparseEngine:
             out.extend(t.moments)
         return out
 
+    def owner_table(self, name):
+        """The ShardedTable a row var (table or row-shaped accumulator)
+        belongs to, or None for names the engine does not manage."""
+        for t in self.tables.values():
+            if name == t.name or name in t.moments:
+                return t
+        return None
+
     def probe_clone(self):
         """Axis-free twin for jax.eval_shape (collectives → identity)."""
         eng = SparseEngine(self.program, self.policy, self.mesh,
@@ -431,36 +439,94 @@ class SparseEngine:
             if _tm.enabled():
                 _tm.gauge(f"embed.{t.name}.rows").set(t.local_rows)
 
+    def install_shards(self, scope, name, make_rows):
+        """Install ONE engine row var shard-WISE: `make_rows(d)` returns
+        the [local_rows, dim] host rows for mesh member d in the mod
+        layout (local row l of member d holds logical id l*n + d — the
+        _phys_perm bijection). No host copy of the full [V, D] ever
+        exists — each device's callback materializes 1/N. The array is
+        marked physical so prepare_persist passes it through untouched.
+        Both init_shards (seeding) and the elastic r%N → r%M checkpoint
+        restore (resilience/elastic.py) enter here."""
+        t = self.owner_table(name)
+        if t is None:
+            raise KeyError(
+                f"install_shards: {name!r} is not an engine row var")
+        sh = NamedSharding(self.mesh, P(self.policy.axis_name, None))
+        L = t.local_rows
+
+        def cb(idx, _L=L, _t=t):
+            rows = np.asarray(make_rows(idx[0].start // _L))
+            if rows.shape != (_L, _t.dim):
+                raise ValueError(
+                    f"install_shards({name!r}): shard builder returned "
+                    f"{rows.shape}, want {(_L, _t.dim)}")
+            return rows
+
+        scope.set(name, jax.make_array_from_callback(
+            t.physical_shape, sh, cb))
+        self._physical.add(name)
+
     def init_shards(self, scope, seed=0, scale=0.02):
         """Seed every engine table shard-WISE (no host copy of the full
         [V, D] ever exists): normal(0, scale) rows per shard, zero
         moments. The giant-vocab entry path — pair with
         strip_table_init on the startup program."""
-        sh = NamedSharding(self.mesh, P(self.policy.axis_name, None))
         for t in self.tables.values():
-            L = t.local_rows
 
-            def cb(idx, _t=t, _L=L):
-                d = idx[0].start // _L
+            def mk(d, _t=t):
                 rng = np.random.RandomState(
                     (seed * 131071 + hash(_t.name) % 65521 + d)
                     % (2 ** 31 - 1))
-                rows = rng.standard_normal((_L, _t.dim)).astype(
+                rows = rng.standard_normal((_t.local_rows, _t.dim)).astype(
                     np.dtype(_t.dtype)) * scale
                 # pad rows (logical id >= vocab) zero
-                lg = np.arange(_L) * _t.n + d
+                lg = np.arange(_t.local_rows) * _t.n + d
                 rows[lg >= _t.vocab] = 0
                 return rows
 
-            scope.set(t.name, jax.make_array_from_callback(
-                t.physical_shape, sh, cb))
-            self._physical.add(t.name)
+            self.install_shards(scope, t.name, mk)
             for m in t.moments:
-                scope.set(m, jax.make_array_from_callback(
-                    t.physical_shape, sh,
-                    lambda idx, _t=t: np.zeros(
-                        (_t.local_rows, _t.dim), np.dtype(_t.dtype))))
-                self._physical.add(m)
+                self.install_shards(
+                    scope, m, lambda d, _t=t: np.zeros(
+                        (_t.local_rows, _t.dim), np.dtype(_t.dtype)))
+
+    def export_shards(self, scope):
+        """Host snapshots of every engine row var currently in the
+        PHYSICAL mod layout, one np array per mesh member — the
+        topology-independent checkpoint writer's entry (io.py). Returns
+        (layout, files): `layout[name]` is the manifest record (kind,
+        world, vocab, dim, local_rows, dtype, per-shard file names) and
+        `files[filename]` the shard rows (an explicit host COPY, 1/N of
+        the table each — on multi-host every process snapshots only its
+        addressable shards, never the gathered [V, D]). Row vars whose
+        scope value is not a physical engine array (e.g. a logical host
+        array before the first step) are omitted — the caller saves
+        those logically like any dense persistable."""
+        layout, files = {}, {}
+        for t in self.tables.values():
+            for name in [t.name] + list(t.moments):
+                val = scope.get(name)
+                if not (name in self._physical
+                        and isinstance(val, jax.Array)
+                        and tuple(val.shape) == t.physical_shape):
+                    continue
+                safe = name.replace("/", "__")
+                rec = {"kind": "mod_shard", "world": t.n,
+                       "vocab": t.vocab, "dim": t.dim,
+                       "local_rows": t.local_rows,
+                       "dtype": str(val.dtype), "files": {}}
+                seen = set()
+                for shard in val.addressable_shards:
+                    d = (shard.index[0].start or 0) // t.local_rows
+                    if d in seen:
+                        continue          # replicated copy of a shard
+                    seen.add(d)
+                    fn = f"{safe}.shard{d}of{t.n}.npy"
+                    rec["files"][str(d)] = fn
+                    files[fn] = np.array(shard.data, copy=True)
+                layout[name] = rec
+        return layout, files
 
     # ------------------------------------------------------ run plan
     def plan_run(self, feed_local_shapes):

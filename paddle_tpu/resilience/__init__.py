@@ -24,6 +24,14 @@ one makes it survive failures, in four pieces:
                write, dropped spool flush, failed collective,
                exception/SIGKILL at step N). tools/tpuchaos.py is the
                CLI; tests/test_resilience.py the suite.
+  elastic      topology-independent checkpoints + grow/shrink
+               re-sharding (tpuelastic): a checkpoint written at world
+               N restores at world M — dense state via its logical
+               layout, mod-sharded tables via a streaming r%N -> r%M
+               shard shuffle — and an ElasticCoordinator re-forms the
+               mesh when a rank dies or capacity changes. Imported
+               LAZILY: a run that never sees a layout-carrying
+               checkpoint never loads it (bench-contract pin).
 
 With PADDLE_TPU_CHAOS and every resilience knob unset, the hot path is
 bit-identical and zero-overhead (pinned by the bench-contract test,
@@ -39,8 +47,20 @@ from .guardian import Guardian, RestartBudgetExceeded, run_with_recovery
 from .liveness import FleetFault, check_liveness, assert_alive
 from .retry import Retryable, Fatal, RetryError, RetryPolicy
 
-__all__ = ["chaos", "checkpoint", "liveness", "retry",
+__all__ = ["chaos", "checkpoint", "liveness", "retry", "elastic",
            "ChaosFault", "TransientChaosFault", "CheckpointError",
            "Guardian", "RestartBudgetExceeded", "run_with_recovery",
            "FleetFault", "check_liveness", "assert_alive",
            "Retryable", "Fatal", "RetryError", "RetryPolicy"]
+
+
+def __getattr__(name):
+    # elastic stays unimported until someone actually uses it (or a
+    # checkpoint carries a layout) — the off-path import pin.
+    # importlib, not `from . import`: the fromlist machinery would
+    # re-enter this __getattr__ before the module attribute lands.
+    if name == "elastic":
+        import importlib
+        return importlib.import_module(".elastic", __name__)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

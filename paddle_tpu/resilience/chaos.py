@@ -40,6 +40,16 @@ Faults and their injection points:
   ``worker_crash:at=N[,times=K]``
       point ``serving.worker`` — kill a ModelServer worker thread
       (the server must restart it; see serving.worker_restarts).
+  ``rank_lost[:rank=R,at=N][,mode=raise|kill]``
+      point ``executor.step`` — rank R disappears at step hit N:
+      raise RankLostFault (an ElasticFault the Guardian escalates to
+      the elastic coordinator instead of restoring at the same world
+      size), or SIGKILL the process with mode=kill (the preemption
+      simulation the elastic selftest drives).
+  ``resize:to=M[,at=N]``
+      point ``executor.step`` — a planned grow/shrink request arrives
+      at step hit N: raise ResizeFault(to=M), which the elastic layer
+      (resilience/elastic.py) answers by re-forming the mesh at M.
 
 Counting: every point keeps a process-wide hit counter (1-based).
 ``at=N`` fires on hit N; ``times=K`` keeps firing through hit N+K-1;
@@ -59,6 +69,7 @@ import time
 from .retry import Retryable as _Retryable
 
 __all__ = ["ChaosFault", "TransientChaosFault", "ChaosSpecError",
+           "ElasticFault", "RankLostFault", "ResizeFault",
            "armed", "configure", "reset", "hit", "check", "enact",
            "spec", "ENV_VAR", "POINTS"]
 
@@ -74,9 +85,12 @@ POINTS = {
     "compile_fail": "inference.compile",
     "barrier_fail": "fleet.barrier",
     "worker_crash": "serving.worker",
+    "rank_lost": "executor.step",
+    "resize": "executor.step",
 }
 
-_INT_KNOBS = ("at", "times", "every", "byte", "seed", "step")
+_INT_KNOBS = ("at", "times", "every", "byte", "seed", "step", "rank",
+              "to")
 _FLOAT_KNOBS = ("prob", "ms")
 
 
@@ -100,6 +114,31 @@ class TransientChaosFault(ChaosFault, _Retryable):
     """An injected fault the retry engine classifies as retryable
     (transient infrastructure flake simulation) — Retryable by
     inheritance, so the default policy classifier absorbs it."""
+
+
+class ElasticFault:
+    """Marker mixin: a fault that changes the WORLD, not just a step.
+    The Guardian must NOT absorb these with a same-world restore
+    (restoring at the same N cannot bring a dead rank back) — it
+    re-raises them so the elastic coordinator (resilience/elastic.py)
+    can re-form the mesh at a new size first."""
+
+
+class RankLostFault(ChaosFault, ElasticFault):
+    """A rank disappeared (preemption/OOM simulation). `.rank` is the
+    lost rank, or None for "this one"."""
+
+    def __init__(self, fault, detail=""):
+        super().__init__(fault, detail)
+        self.rank = fault.get("rank")
+
+
+class ResizeFault(ChaosFault, ElasticFault):
+    """A planned grow/shrink request: re-form the fleet at `.to`."""
+
+    def __init__(self, fault, detail=""):
+        super().__init__(fault, detail)
+        self.to = int(fault["to"])
 
 
 _lock = threading.Lock()
@@ -145,6 +184,11 @@ def _parse_fault(text):
         raise ChaosSpecError("ckpt_torn needs byte=B")
     if name == "collective_delay" and "ms" not in fault:
         raise ChaosSpecError("collective_delay needs ms=M")
+    if name == "resize":
+        if "to" not in fault:
+            raise ChaosSpecError("resize needs to=M (the new world size)")
+        if fault["to"] < 1:
+            raise ChaosSpecError(f"resize: to={fault['to']} must be >= 1")
     if "prob" in fault:
         p = fault["prob"]
         if not 0.0 <= p <= 1.0:
@@ -281,4 +325,8 @@ def enact(fault, detail=""):
         os.kill(os.getpid(), signal.SIGKILL)
     if name in ("collective_fail", "compile_fail", "barrier_fail"):
         raise TransientChaosFault(fault, detail)
+    if name == "rank_lost":
+        raise RankLostFault(fault, detail)
+    if name == "resize":
+        raise ResizeFault(fault, detail)
     raise ChaosFault(fault, detail)

@@ -97,13 +97,17 @@ def write_manifest(dirname, extra_meta=None):
     return manifest
 
 
-def write_payload(dirname, arrays, meta, params_file, meta_file):
+def write_payload(dirname, arrays, meta, params_file, meta_file,
+                  extra_files=None):
     """Write a checkpoint payload (params npz + meta json + manifest)
-    into `dirname` with per-file fsync. The caller owns making
-    `dirname` visible atomically (atomic_publish). Honors the
-    `checkpoint.write` chaos point: a fired ckpt_torn fault truncates
-    the params file at the configured byte and raises ChaosFault,
-    simulating a writer killed mid-write."""
+    into `dirname` with per-file fsync. `extra_files` ({filename: np
+    array}) are side payloads — the topology-independent table shards
+    the elastic layer saves next to params.npz — written and fsync'd
+    BEFORE the manifest so its presence asserts them too. The caller
+    owns making `dirname` visible atomically (atomic_publish). Honors
+    the `checkpoint.write` chaos point: a fired ckpt_torn fault
+    truncates the params file at the configured byte and raises
+    ChaosFault, simulating a writer killed mid-write."""
     params_path = os.path.join(dirname, params_file)
     np.savez(params_path, **arrays)
     fault = _chaos.hit("checkpoint.write") if _chaos.armed() else None
@@ -115,12 +119,24 @@ def write_payload(dirname, arrays, meta, params_file, meta_file):
         raise _chaos.ChaosFault(
             fault, f"checkpoint params torn at byte {cut}/{size}")
     fsync_file(params_path)
+    for fn in sorted(extra_files or {}):
+        path = os.path.join(dirname, fn)
+        np.save(path, extra_files[fn])
+        fsync_file(path)
     meta_path = os.path.join(dirname, meta_file)
     with open(meta_path, "w") as f:
         json.dump(meta, f)
         f.flush()
         os.fsync(f.fileno())
-    write_manifest(dirname, extra_meta={"step": meta.get("step")})
+    # the manifest mirrors the elastic-relevant meta (world size +
+    # logical layout) so topology can be read without opening the npz —
+    # ADDITIVE keys, invisible to pre-elastic readers
+    extra = {"step": meta.get("step")}
+    if "world_size" in meta:
+        extra["world_size"] = meta["world_size"]
+    if meta.get("layout"):
+        extra["layout"] = meta["layout"]
+    write_manifest(dirname, extra_meta=extra)
 
 
 def atomic_publish(tmp, final):

@@ -1,0 +1,348 @@
+"""tpuelastic — topology-independent checkpoints and grow/shrink
+re-sharding (ROADMAP item 4).
+
+The reference's distributed story (`operators/distributed/` pserver +
+NCCL) assumes a FIXED world: the transpiler bakes a pserver list into
+the program and a lost trainer stalls the gang until an operator
+rebuilds the exact same topology. Production TPU fleets are
+preemptible — ranks disappear, capacity grows back — so state must
+outlive any particular device assignment (the TensorFlow paper's
+fault-tolerance-at-scale argument). Three pieces deliver that:
+
+1. **Topology-independent checkpoints.** io.save_checkpoint's manifest
+   records `world_size` and a per-var `layout`: dense persistables are
+   saved in the LOGICAL (unsharded) layout they already have, and the
+   sparse engine's mod-sharded tables are saved one shard file per
+   mesh member (`<var>.shard<d>of<N>.npy`) — each host snapshots only
+   its addressable 1/N, never the gathered [V, D].
+
+2. **Streaming re-shard.** A checkpoint written at world N restores at
+   world M by re-mapping `r % N → r % M` shard by shard: each
+   destination member's rows are assembled by scanning the N source
+   shard files one at a time (`reshard_rows`), so at most one source
+   shard + one destination shard are ever in memory. The endpoints are
+   the engine's own layout bijection (`SparseEngine.to_logical` /
+   `install_shards` — the same mod permutation, read and written
+   shard-wise).
+
+3. **The elastic coordinator.** On a dead rank (liveness
+   `check_liveness` with the new `expected_ranks`, or a RankLostFault
+   from tpuchaos) or a planned ResizeFault, `ElasticCoordinator` picks
+   the next world size, `reform()` tears down and re-forms the
+   collective world (parallel.fleet.reform, coordinator flake
+   classified Retryable), and `run_elastic` rebuilds the Guardian at
+   the new size and resumes from the newest valid checkpoint. The
+   Guardian itself escalates ElasticFaults instead of absorbing them —
+   restoring at the same N cannot bring a rank back.
+
+Off contract: nothing here is imported unless a checkpoint actually
+carries a `layout` (io.py imports this module lazily) or the caller
+builds a coordinator — pinned by tests/test_bench_contract.py.
+
+Proof: `python tools/tpuchaos.py --selftest-elastic` kills a rank at
+N=8 mid-training, resumes at N=6, grows back to N=8, and asserts the
+final loss is within tolerance of the uninterrupted run with ZERO lost
+embedding rows across both shard shuffles (per-row fingerprints).
+"""
+import os
+import zlib
+from collections import namedtuple
+
+import numpy as np
+
+from .. import telemetry as _tm
+from . import chaos as _chaos
+from .checkpoint import CheckpointError
+from .liveness import FleetFault, check_liveness, DEFAULT_STALE_AFTER_S
+
+__all__ = ["ElasticPlan", "ElasticCoordinator", "ReformBudgetExceeded",
+           "run_elastic", "restore_layout", "read_shard_fn",
+           "reshard_rows", "logical_rows", "fingerprint_rows",
+           "fingerprint_array"]
+
+
+# ------------------------------------------------- streaming re-shard
+#
+# Mod layout (parallel/sparse.py _phys_perm): logical row r lives on
+# member r % W at local index r // W; local row l of member d holds
+# logical id l * W + d; pad rows (id >= vocab) are zero.
+
+def read_shard_fn(dirname, rec):
+    """Shard reader for one layout record: returns `read(d)` -> the
+    [local_rows, dim] np rows of source member d, viewed back to the
+    recorded true dtype (bf16 round-trips through the uint16 disk
+    view, io._np_to_disk's convention)."""
+    from ..io import _np_from_disk
+    files = rec["files"]
+
+    def read(d):
+        fn = files.get(str(d))
+        if fn is None:
+            raise CheckpointError(
+                f"checkpoint layout lists no shard file for member {d} "
+                f"(have {sorted(files)})")
+        arr = np.load(os.path.join(dirname, fn), allow_pickle=False)
+        return _np_from_disk(arr, rec["dtype"])
+
+    return read
+
+
+def reshard_rows(read_shard, n_from, m_to, vocab, dim, d):
+    """Destination member d's [ceil(vocab/m_to), dim] rows of the
+    r%n_from → r%m_to shuffle, assembled by streaming over the source
+    shards (one in memory at a time — the full [vocab, dim] is never
+    materialized). Pad rows (logical id >= vocab) stay zero."""
+    n_from, m_to = int(n_from), int(m_to)
+    if n_from == m_to:
+        return np.asarray(read_shard(d))   # identity layout: one read
+    l_m = -(-vocab // m_to)
+    out = None
+    for s in range(n_from):
+        src = np.asarray(read_shard(s))
+        if out is None:
+            out = np.zeros((l_m, dim), src.dtype)
+        lg = s + n_from * np.arange(src.shape[0])
+        take = (lg % m_to == d) & (lg < vocab)
+        if take.any():
+            out[lg[take] // m_to] = src[take]
+    return out
+
+
+def logical_rows(read_shard, n_from, vocab, dim):
+    """The full LOGICAL [vocab, dim] table from its mod shards — the
+    plain-Executor restore path (a single-device run needs the dense
+    gather anyway) and the test/audit endpoint (== engine.to_logical
+    of the reassembled physical array)."""
+    out = None
+    for s in range(int(n_from)):
+        src = np.asarray(read_shard(s))
+        if out is None:
+            out = np.zeros((vocab, dim), src.dtype)
+        lg = s + int(n_from) * np.arange(src.shape[0])
+        ok = lg < vocab
+        out[lg[ok]] = src[ok]
+    return out
+
+
+def fingerprint_rows(read_shard, n_from, vocab):
+    """Per-logical-row crc32 fingerprints, streamed shard-by-shard —
+    the zero-lost-rows audit: a checkpoint's fingerprints must equal
+    the restored table's at ANY world size, byte for byte."""
+    fp = np.zeros(int(vocab), np.uint32)
+    for s in range(int(n_from)):
+        src = np.ascontiguousarray(read_shard(s))
+        lg = s + int(n_from) * np.arange(src.shape[0])
+        for i, r in enumerate(lg):
+            if r < vocab:
+                fp[r] = zlib.crc32(src[i].tobytes())
+    return fp
+
+
+def fingerprint_array(logical):
+    """fingerprint_rows for an in-memory logical [V, D] array."""
+    a = np.ascontiguousarray(logical)
+    return np.array([zlib.crc32(a[r].tobytes())
+                     for r in range(a.shape[0])], np.uint32)
+
+
+def restore_layout(executor, dirname, layout, scope):
+    """Restore every layout-recorded var from `dirname` into `scope`
+    at the CURRENT world size. With a sparse engine attached (the
+    executor's), each table re-shards r%N → r%M straight into the
+    engine's physical placement via install_shards — destination
+    members pull only the rows they own, streamed from the source
+    shard files. Without one (plain Executor), the logical [V, D]
+    is assembled dense. Returns the restored names."""
+    engine = getattr(executor, "sparse_engine", None)
+    restored = []
+    for name, rec in sorted(layout.items()):
+        if rec.get("kind") != "mod_shard":
+            raise CheckpointError(
+                f"checkpoint var {name!r} has unknown layout kind "
+                f"{rec.get('kind')!r} (newer writer?)")
+        read = read_shard_fn(dirname, rec)
+        n_from = int(rec["world"])
+        vocab, dim = int(rec["vocab"]), int(rec["dim"])
+        t = engine.owner_table(name) if engine is not None else None
+        if t is not None:
+            if (t.vocab, t.dim) != (vocab, dim):
+                raise CheckpointError(
+                    f"checkpoint table {name!r} is [{vocab}, {dim}] "
+                    f"but the program's is [{t.vocab}, {t.dim}]")
+            with _tm.span("elastic.reshard", var=name,
+                          world_from=n_from, world_to=t.n):
+                engine.install_shards(
+                    scope, name,
+                    lambda d, _r=read, _n=n_from, _t=t: reshard_rows(
+                        _r, _n, _t.n, _t.vocab, _t.dim, d))
+            if _tm.enabled() and n_from != t.n:
+                _tm.counter("elastic.resharded_rows").inc(vocab)
+        else:
+            scope.set(name, logical_rows(read, n_from, vocab, dim))
+        restored.append(name)
+    return restored
+
+
+# --------------------------------------------------- the coordinator
+
+ElasticPlan = namedtuple("ElasticPlan",
+                         ["old_world", "new_world", "reason"])
+
+
+class ReformBudgetExceeded(RuntimeError):
+    """The bounded re-form budget ran out; __cause__ is the last
+    world-changing fault."""
+
+    def __init__(self, reforms, budget):
+        self.reforms = reforms
+        self.budget = budget
+        super().__init__(
+            f"elastic: {reforms} re-form(s) exhausted the budget of "
+            f"{budget} — failing over to the operator")
+
+
+class ElasticCoordinator:
+    """Decides WHAT world to run at; parallel.fleet.reform does the
+    collective teardown/bring-up. `choices` restricts the sizes the
+    fleet may shrink/grow to (e.g. (8, 6, 4, 2) keeps the global batch
+    divisible); empty means any size down to `min_world`. The
+    coordinator is deliberately mesh-agnostic — it works identically
+    for the in-process run_elastic loop (mesh over a device subset)
+    and a multi-process driver relaunching workers (tools/tpuchaos.py
+    --selftest-elastic)."""
+
+    def __init__(self, root, world, choices=(), min_world=1,
+                 spool=None, stale_after_s=DEFAULT_STALE_AFTER_S):
+        self.root = root
+        self.world = int(world)
+        self.choices = tuple(sorted({int(c) for c in choices},
+                                    reverse=True))
+        self.min_world = int(min_world)
+        self.spool = spool
+        self.stale_after_s = stale_after_s
+        self.history = [int(world)]
+        self.reforms = 0
+
+    # ------------------------------------------------------ observe
+    def expected_ranks(self):
+        return list(range(self.world))
+
+    def observe(self, now_unix=None):
+        """Liveness over the CURRENT membership (a deliberately shrunk
+        fleet is not flagged for its retired ranks' stale snapshots).
+        None when no spool is configured."""
+        if self.spool is None:
+            return None
+        return check_liveness(self.spool,
+                              stale_after_s=self.stale_after_s,
+                              expected_ranks=self.expected_ranks(),
+                              now_unix=now_unix)
+
+    # --------------------------------------------------------- plan
+    def _pick(self, alive):
+        cand = alive
+        if self.choices:
+            cand = next((c for c in self.choices if c <= alive), 0)
+        if cand < self.min_world:
+            raise FleetFault(
+                f"elastic: {alive} rank(s) alive cannot form a world "
+                f">= min_world={self.min_world} "
+                f"(choices={self.choices or 'any'})")
+        return cand
+
+    def plan_after_loss(self, lost_ranks=(), report=None):
+        """Shrink plan after rank loss: `lost_ranks` from the fault
+        (None entries = unidentified ranks), plus anything a liveness
+        report marks dead/missing. Picks the largest allowed world
+        that the survivors can fill."""
+        lost = list(lost_ranks)
+        if report is not None:
+            lost += list(report.get("dead", []))
+            lost += list(report.get("missing", []))
+        known = {int(r) for r in lost if r is not None}
+        n_lost = len(known) + sum(1 for r in lost if r is None)
+        alive = max(0, self.world - max(n_lost, 1 if lost else 0))
+        new = self._pick(alive)
+        whom = sorted(known) if known else "?"
+        return ElasticPlan(self.world, new,
+                           f"lost rank(s) {whom}: {self.world} -> {new}")
+
+    def plan_resize(self, to, reason=None):
+        """Grow/shrink to an explicitly requested size (a ResizeFault,
+        a capacity event, a rolling update)."""
+        to = int(to)
+        if to < self.min_world:
+            raise ValueError(
+                f"resize to {to} below min_world={self.min_world}")
+        return ElasticPlan(self.world, to,
+                           reason or f"resize: {self.world} -> {to}")
+
+    # ------------------------------------------------------- reform
+    def reform(self, plan, coordinator_address=None, process_id=None):
+        """Execute a plan: tear down + re-form the collective world at
+        plan.new_world (parallel.fleet.reform — a no-op teardown on a
+        single process, the retried jax.distributed cycle on a real
+        gang), then adopt the new size. Returns the new world."""
+        new = plan.new_world if isinstance(plan, ElasticPlan) \
+            else int(plan)
+        from ..parallel import fleet as _fleet
+        _fleet.reform(
+            coordinator_address=coordinator_address,
+            num_processes=None if coordinator_address is None else new,
+            process_id=process_id)
+        self.world = new
+        self.history.append(new)
+        self.reforms += 1
+        if _tm.enabled():
+            _tm.counter("elastic.reforms").inc()
+            _tm.gauge("elastic.world_size").set(new)
+        return new
+
+    def resume_point(self):
+        """(path, meta) of the newest valid checkpoint under root, or
+        (None, None) — meta carries the world_size it was written at
+        (informational: restore re-shards to ANY world)."""
+        import json
+        from .. import io as _io
+        path = _io.latest_checkpoint(self.root)
+        if path is None and os.path.exists(
+                os.path.join(self.root, _io.META_FILE)):
+            path = self.root
+        if path is None:
+            return None, None
+        with open(os.path.join(path, _io.META_FILE)) as f:
+            return path, json.load(f)
+
+
+def run_elastic(build_fn, steps, coordinator, max_reforms=8,
+                coordinator_address=None, process_id=None):
+    """The in-process elastic training loop: `build_fn(world)` returns
+    a fresh `(guardian, step_fn)` for a mesh of `world` members,
+    rooted at the coordinator's checkpoint root. Runs to `steps`
+    completed steps across any number of world changes — a
+    RankLostFault/FleetFault shrinks (plan_after_loss), a ResizeFault
+    re-forms at the requested size, and every re-form resumes from the
+    newest valid topology-independent checkpoint (the Guardian's entry
+    restore re-shards r%N → r%M through the streaming shuffle).
+    Ordinary step failures keep the Guardian's same-world
+    restore/restart semantics untouched."""
+    while True:
+        guardian, step_fn = build_fn(coordinator.world)
+        try:
+            return guardian.run_with_recovery(step_fn, steps)
+        except _chaos.ResizeFault as e:
+            plan = coordinator.plan_resize(e.to)
+            cause = e
+        except (_chaos.RankLostFault, FleetFault) as e:
+            if isinstance(e, _chaos.RankLostFault):
+                lost = [e.rank]
+            else:
+                lost = list(getattr(e, "ranks", [])) or [None]
+            plan = coordinator.plan_after_loss(
+                lost, report=coordinator.observe())
+            cause = e
+        if coordinator.reforms >= max_reforms:
+            raise ReformBudgetExceeded(coordinator.reforms,
+                                       max_reforms) from cause
+        coordinator.reform(plan, coordinator_address=coordinator_address,
+                           process_id=process_id)

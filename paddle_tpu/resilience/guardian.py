@@ -196,6 +196,13 @@ class Guardian:
                 if done % self.save_every == 0 or done == steps:
                     self._drain_window()
             except self.recoverable as e:
+                if isinstance(e, _chaos.ElasticFault):
+                    # rank_lost / resize change the WORLD: restoring at
+                    # the same N cannot bring a rank back (or grow one).
+                    # Escalate to the elastic layer (resilience/
+                    # elastic.py run_elastic), which re-forms the mesh
+                    # and rebuilds this Guardian at the new size.
+                    raise
                 self.last_failure = e
                 self.restarts += 1
                 if _tm.enabled():

@@ -80,16 +80,27 @@ def heartbeat_ages(spool, now_unix=None):
 
 
 def check_liveness(spool, stale_after_s=DEFAULT_STALE_AFTER_S,
-                   expected_world=None, now_unix=None):
+                   expected_world=None, now_unix=None,
+                   expected_ranks=None):
     """Liveness report for a spool. `expected_world` (rank count) turns
     never-seen ranks into `missing`; without it only spooled ranks are
-    judged. Publishes fleet.liveness.* gauges when telemetry is on."""
+    judged. `expected_ranks` (an explicit rank set) narrows BOTH
+    judgements to the fleet's CURRENT membership — after an elastic
+    shrink the retired ranks' leftover rank*.snap.json files go stale
+    forever, and without the narrowing every post-shrink check would
+    read them as dead (and any gap as missing). Publishes
+    fleet.liveness.* gauges when telemetry is on."""
     ages = heartbeat_ages(spool, now_unix=now_unix)
+    if expected_ranks is not None:
+        expected = {int(r) for r in expected_ranks}
+        ages = {r: a for r, a in ages.items() if r in expected}
+        missing = sorted(expected - set(ages))
+    elif expected_world:
+        missing = sorted(set(range(int(expected_world))) - set(ages))
+    else:
+        missing = []
     dead = sorted(r for r, a in ages.items() if a > stale_after_s)
     alive = sorted(r for r in ages if r not in dead)
-    missing = []
-    if expected_world:
-        missing = sorted(set(range(int(expected_world))) - set(ages))
     report = {
         "spool": spool,
         "stale_after_s": stale_after_s,
@@ -131,12 +142,14 @@ def check_liveness(spool, stale_after_s=DEFAULT_STALE_AFTER_S,
 
 
 def assert_alive(spool, stale_after_s=DEFAULT_STALE_AFTER_S,
-                 expected_world=None, now_unix=None):
+                 expected_world=None, now_unix=None,
+                 expected_ranks=None):
     """check_liveness that raises FleetFault on any dead/missing rank.
     Returns the (healthy) report otherwise."""
     report = check_liveness(spool, stale_after_s=stale_after_s,
                             expected_world=expected_world,
-                            now_unix=now_unix)
+                            now_unix=now_unix,
+                            expected_ranks=expected_ranks)
     if not report["ok"]:
         raise FleetFault(report["verdict"],
                          ranks=report["dead"] + report["missing"],

@@ -61,7 +61,14 @@ class RetryError(RuntimeError):
 _TRANSIENT_MARKERS = ("unavailable", "deadline_exceeded", "deadline "
                       "exceeded", "connection reset", "connection "
                       "refused", "temporarily unavailable", "timed out",
-                      "timeout", "broken pipe", "try again")
+                      "timeout", "broken pipe", "try again",
+                      # elastic re-form: while every surviving rank
+                      # tears down and rebinds, jax.distributed
+                      # .initialize races the coordinator's restart —
+                      # failed-to-connect and the old socket lingering
+                      # in TIME_WAIT are transport flake, not bugs
+                      "address already in use", "failed to connect",
+                      "coordination service")
 
 
 def transient(exc):
@@ -72,6 +79,12 @@ def transient(exc):
         return False
     if isinstance(exc, Retryable):
         return True
+    # programming errors are never transport flake, whatever the
+    # message smells like — a TypeError from calling
+    # jax.distributed.initialize wrong must surface on attempt 1, not
+    # eat the retry budget during an elastic re-form
+    if isinstance(exc, (TypeError, AttributeError, NameError)):
+        return False
     if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError,
                         BrokenPipeError)):
         return True

@@ -265,10 +265,15 @@ def test_resilience_off_checkpoint_forward_compatible(tmp_path):
     PRE-PR reader (np.load of params.npz + json.load of
     checkpoint.json — no manifest knowledge), and with all resilience
     env unset a save adds exactly one extra file (the additive
-    checksum manifest) next to the two the old writer produced."""
+    checksum manifest) next to the two the old writer produced. The
+    elastic fields ride the same contract: world_size/layout are
+    ADDITIVE manifest keys (an engine-less save records world_size=1,
+    grows no shard files, and a pre-elastic checkpoint — no such keys
+    at all — still loads with the new reader)."""
     import numpy as np
     import paddle_tpu as pt
     from paddle_tpu import layers
+    from paddle_tpu.resilience import checkpoint as rckpt
 
     img = layers.data("imgfc", shape=[4])
     layers.fc(img, size=3, param_attr=pt.ParamAttr(name="fcw"))
@@ -288,6 +293,56 @@ def test_resilience_off_checkpoint_forward_compatible(tmp_path):
         assert "fcw" in data.files
         np.testing.assert_array_equal(
             data["fcw"], np.asarray(pt.global_scope().get("fcw")))
+    # elastic fields: additive, logical-world defaults, no layout
+    assert meta["world_size"] == 1 and "layout" not in meta
+    with open(os.path.join(d, rckpt.MANIFEST_FILE)) as f:
+        manifest = json.load(f)
+    assert manifest["world_size"] == 1 and "layout" not in manifest
+    # vice versa: a PRE-elastic checkpoint (manifest without the new
+    # keys, meta without world_size) still loads with the new reader
+    d2 = str(tmp_path / "ck_old")
+    os.makedirs(d2)
+    with np.load(os.path.join(d, "params.npz")) as data:
+        np.savez(os.path.join(d2, "params.npz"),
+                 **{n: data[n] for n in data.files})
+    with open(os.path.join(d2, "checkpoint.json"), "w") as f:
+        json.dump({"step": 9, "vars": meta["vars"], "extra": {}}, f)
+    rckpt.write_manifest(d2, extra_meta={"step": 9})
+    meta2 = pt.io.load_checkpoint(exe, d2)
+    assert meta2["step"] == 9 and "world_size" not in meta2
+
+
+def test_elastic_off_paths_untouched(tmp_path):
+    """tpuelastic's off contract (the PR-11 pin, same pattern as PRs
+    9/10): a run that never touches a layout-carrying checkpoint never
+    imports resilience.elastic — a plain save/load roundtrip stays the
+    historical 3-file format with no new imports, and the executor.step
+    chaos hook on the ParallelExecutor costs one cached-bool while
+    PADDLE_TPU_CHAOS is unset."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n"
+        "from paddle_tpu.resilience import chaos\n"
+        "img = layers.data('im', shape=[4])\n"
+        "layers.fc(img, size=3)\n"
+        "exe = pt.Executor(pt.CPUPlace())\n"
+        "exe.run(pt.default_startup_program())\n"
+        "meta = pt.io.save_checkpoint(exe, 'ck', step=1)\n"
+        "assert meta['world_size'] == 1 and 'layout' not in meta\n"
+        "assert pt.io.load_checkpoint(exe, 'ck')['step'] == 1\n"
+        "assert 'paddle_tpu.resilience.elastic' not in sys.modules, \\\n"
+        "    'an elastic-off checkpoint roundtrip imported elastic'\n"
+        "assert chaos.armed() is False and chaos.fired_count() == 0\n"
+        "print('ELASTIC_OFF_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PADDLE_TPU_CHAOS", None)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=str(tmp_path))
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-1200:])
+    assert "ELASTIC_OFF_OK" in p.stdout
 
 
 def test_telemetry_artifact_helper(tmp_path):

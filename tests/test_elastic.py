@@ -1,0 +1,429 @@
+"""tpuelastic — topology-independent checkpoints, rank-loss recovery,
+and grow/shrink re-sharding (resilience/elastic.py + the io.py/sparse
+plumbing).
+
+Covers: the rank_lost/resize chaos grammar and its determinism, the
+Guardian escalating ElasticFaults instead of absorbing them, liveness
+narrowed to a shrunk fleet's membership (expected_ranks), re-form
+retry classification, the streaming r%N -> r%M shard shuffle (pure,
+then through a real save/load across mesh sizes with Adam moments),
+the in-process run_elastic loop, and the tools/tpuchaos.py
+--selftest-elastic subprocess gate (N=8 -> 6 -> 8, loss within
+tolerance, zero lost rows)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import telemetry as tm
+from paddle_tpu.io import latest_checkpoint
+from paddle_tpu.parallel.mesh import local_mesh
+from paddle_tpu.resilience import (FleetFault, Guardian, chaos, elastic,
+                                   liveness, retry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TPUCHAOS = os.path.join(REPO, "tools", "tpuchaos.py")
+
+
+@pytest.fixture(autouse=True)
+def _disarmed_chaos():
+    chaos.reset()
+    tm.disable()
+    tm.reset()
+    yield
+    chaos.reset()
+    tm.disable()
+    tm.reset()
+
+
+# ------------------------------------------------------ chaos grammar
+
+def test_elastic_chaos_grammar():
+    faults = chaos.parse_spec("rank_lost:rank=3,at=5,mode=kill;"
+                              "resize:to=6,at=9")
+    assert faults[0] == {"name": "rank_lost", "point": "executor.step",
+                         "rank": 3, "at": 5, "mode": "kill"}
+    assert faults[1] == {"name": "resize", "point": "executor.step",
+                         "to": 6, "at": 9}
+    for bad in ("resize:at=1", "resize:to=0", "rank_lost:mode=boom",
+                "rank_lost:bogus=1"):
+        with pytest.raises(chaos.ChaosSpecError):
+            chaos.parse_spec(bad)
+
+
+def test_elastic_faults_fire_deterministically():
+    """Same seeded pattern as step_fail: the fault fires on exactly
+    its configured hit, carries its payload, and is typed Elastic (so
+    the Guardian escalates) but NOT retry-transient (so the retry
+    engine never eats a world change)."""
+    chaos.configure("rank_lost:rank=2,at=3")
+    fired = []
+    for n in range(1, 6):
+        f = chaos.hit("executor.step", step=n)
+        fired.append(f is not None)
+        if f is not None:
+            with pytest.raises(chaos.RankLostFault) as ei:
+                chaos.enact(f)
+            assert ei.value.rank == 2
+            assert isinstance(ei.value, chaos.ElasticFault)
+            assert not retry.transient(ei.value)
+    assert fired == [False, False, True, False, False]
+
+    chaos.configure("resize:to=6,at=2")
+    with pytest.raises(chaos.ResizeFault) as ei:
+        for n in range(1, 4):
+            chaos.check("executor.step")
+    assert ei.value.to == 6
+    assert not retry.transient(ei.value)
+
+
+# ----------------------------------------------- guardian escalation
+
+def _dense_rig(root, save_every=2):
+    """Guardian rig over the ambient global scope (fresh per test via
+    conftest) — the Guardian's saver/restore read global_scope(), so
+    the rig must train there too (a private Scope would checkpoint the
+    wrong state the moment the guard is released — exactly what the
+    real workers avoid by running fully inside scope_guard)."""
+    main_p, startup_p = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup_p):
+        with pt.unique_name.guard():
+            x = layers.data("x", shape=[6])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(layers.fc(x, 8, act="tanh"), 1)
+            loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup_p)
+    guardian = Guardian(exe, main_p, root, save_every=save_every)
+
+    def step_fn(step):
+        rng = np.random.RandomState(100 + step)
+        feed = {"x": rng.rand(8, 6).astype("float32"),
+                "y": rng.rand(8, 1).astype("float32")}
+        out = exe.run(main_p, feed=feed, fetch_list=[loss.name])
+        return float(out[0])
+
+    return guardian, step_fn
+
+
+def test_guardian_escalates_elastic_faults(tmp_path):
+    """A rank_lost is NOT a same-world recoverable: the Guardian must
+    re-raise it untouched (no restore, no restart burned) so the
+    elastic layer can re-form first — a plain step_fail at the same
+    point still restores as before."""
+    guardian, step_fn = _dense_rig(str(tmp_path))
+    chaos.configure("rank_lost:rank=1,at=4")
+    with pytest.raises(chaos.RankLostFault):
+        guardian.run_with_recovery(step_fn, steps=8)
+    assert guardian.restarts == 0
+    assert guardian.restore_count <= 1     # only the entry restore
+
+
+def test_run_elastic_loop_replans_and_resumes(tmp_path):
+    """The in-process elastic loop: a rank_lost escalates out of the
+    Guardian, the coordinator shrinks 8 -> 6 (largest allowed size the
+    survivors fill), build_fn is re-invoked at the new world, and the
+    run resumes from the checkpoint to the SAME final loss as an
+    uninterrupted run (deterministic per-step feeds)."""
+    root_a = str(tmp_path / "a")
+    g_a, step_a = _dense_rig(root_a)
+    want = g_a.run_with_recovery(step_a, steps=8)
+
+    root_b = str(tmp_path / "b")
+    coord = elastic.ElasticCoordinator(root_b, world=8,
+                                       choices=(8, 6, 4, 2))
+    worlds = []
+
+    def build_fn(world):
+        worlds.append(world)
+        guardian, step_fn = _dense_rig(root_b)
+        return guardian, step_fn
+
+    # hits: rig startup runs twice before training (the _dense_rig
+    # above consumed none — chaos was reset by the fixture); startup
+    # of build 1 is hit 1, step k is hit k+2 -> at=7 fires at step 5
+    chaos.configure("rank_lost:rank=3,at=7")
+    got = elastic.run_elastic(build_fn, 8, coord)
+    assert worlds == [8, 6]
+    assert coord.world == 6 and coord.history == [8, 6]
+    assert coord.reforms == 1
+    assert np.isclose(got, want, rtol=1e-6)
+
+
+def test_coordinator_planning():
+    c = elastic.ElasticCoordinator("/nonexistent", world=8,
+                                   choices=(8, 6, 4, 2), min_world=2)
+    plan = c.plan_after_loss([3])
+    assert (plan.old_world, plan.new_world) == (8, 6)
+    # two ranks lost -> 6 still fills; five lost -> only 2 fits
+    assert c.plan_after_loss([1, 5]).new_world == 6
+    assert c.plan_after_loss([1, 2, 3, 4, 5]).new_world == 2
+    # unidentified rank (RankLostFault.rank is None) counts as one
+    assert c.plan_after_loss([None]).new_world == 6
+    with pytest.raises(FleetFault):
+        c.plan_after_loss([0, 1, 2, 3, 4, 5, 6])   # 1 alive < min 2
+    assert c.plan_resize(8).new_world == 8
+    with pytest.raises(ValueError):
+        c.plan_resize(1)                           # below min_world
+    # no choices: any size the survivors fill
+    free = elastic.ElasticCoordinator("/nonexistent", world=8)
+    assert free.plan_after_loss([7]).new_world == 7
+
+
+# ------------------------------------------------- liveness narrowing
+
+def _write_snap(spool, rank, age_s, now=None):
+    now = now or time.time()
+    os.makedirs(spool, exist_ok=True)
+    path = os.path.join(spool, f"rank{rank:05d}.snap.json")
+    with open(path, "w") as f:
+        json.dump({"schema": "paddle_tpu.fleet.snapshot.v1",
+                   "rank": rank,
+                   "flush_unix_us": int((now - age_s) * 1e6),
+                   "metrics": {}}, f)
+    os.utime(path, (now - age_s, now - age_s))
+
+
+def test_liveness_expected_ranks_after_shrink(tmp_path):
+    """Shrink-then-check regression: the retired ranks' snap files go
+    stale forever, and without expected_ranks every later check would
+    flag them dead. Narrowed to the current membership the shrunk
+    fleet is healthy; a dead CURRENT rank is still caught."""
+    spool = str(tmp_path)
+    for r in range(8):
+        _write_snap(spool, r, age_s=1.0 if r < 6 else 900.0)
+    # unnarrowed: the leftovers read as dead (the pre-PR behavior)
+    assert liveness.check_liveness(spool, stale_after_s=60.0)["dead"] \
+        == [6, 7]
+    # narrowed to the post-shrink fleet: healthy, nothing missing
+    report = liveness.check_liveness(spool, stale_after_s=60.0,
+                                     expected_ranks=range(6))
+    assert report["ok"] and report["alive"] == [0, 1, 2, 3, 4, 5]
+    assert report["missing"] == [] and report["dead"] == []
+    # a genuinely dead current rank still surfaces
+    _write_snap(spool, 2, age_s=900.0)
+    report = liveness.check_liveness(spool, stale_after_s=60.0,
+                                     expected_ranks=range(6))
+    assert report["dead"] == [2] and not report["ok"]
+    with pytest.raises(FleetFault):
+        liveness.assert_alive(spool, stale_after_s=60.0,
+                              expected_ranks=range(6))
+    # a current rank that never spooled is missing (not silently ok)
+    os.remove(os.path.join(spool, "rank00003.snap.json"))
+    report = liveness.check_liveness(spool, stale_after_s=60.0,
+                                     expected_ranks=range(6))
+    assert report["missing"] == [3]
+
+
+# --------------------------------------------- re-form classification
+
+def test_reform_retry_classification():
+    """Coordinator-flake messages during re-form retry; a real
+    TypeError (bad initialize() call) surfaces on attempt 1 even
+    though the retry engine wraps the seam."""
+    for msg in ("jax.distributed: coordination service is unavailable",
+                "Failed to connect to coordinator at 10.0.0.1:8476",
+                "bind failed: address already in use"):
+        assert retry.transient(RuntimeError(msg)), msg
+    assert retry.transient(OSError(98, "Address already in use"))
+    assert not retry.transient(
+        TypeError("initialize() got an unexpected keyword 'x'"))
+    # ... even when a TypeError's message smells like transport
+    assert not retry.transient(TypeError("timed out unpacking"))
+
+    pol = retry.RetryPolicy(max_attempts=4, base_delay_s=0.0, jitter=0.0)
+    calls = {"n": 0}
+
+    def bad_call():
+        calls["n"] += 1
+        raise TypeError("initialize() takes 3 arguments")
+
+    with pytest.raises(TypeError):
+        retry.call(bad_call, policy=pol, sleep=lambda d: None,
+                   name="fleet.reform")
+    assert calls["n"] == 1                     # no retries burned
+
+
+# ---------------------------------------------- streaming shard shuffle
+
+def test_reshard_stream_roundtrip_preserves_every_row():
+    """r%8 -> r%6 -> r%8 over an uneven vocab: every logical row
+    byte-identical after both shuffles, pad rows stay zero, and the
+    reader never loads more than one source shard at a time."""
+    V, D, N, M = 53, 4, 8, 6
+    rng = np.random.RandomState(0)
+    logical = rng.randn(V, D).astype("float32")
+    LN = -(-V // N)
+
+    live = {"now": 0, "peak": 0}
+
+    def shard(s):
+        live["now"] += 1
+        live["peak"] = max(live["peak"], live["now"])
+        out = np.zeros((LN, D), "float32")
+        lg = s + N * np.arange(LN)
+        out[lg < V] = logical[lg[lg < V]]
+        live["now"] -= 1
+        return out
+
+    dest = {d: elastic.reshard_rows(shard, N, M, V, D, d)
+            for d in range(M)}
+    assert live["peak"] == 1                   # streamed, not gathered
+    np.testing.assert_array_equal(
+        elastic.logical_rows(lambda s: dest[s], M, V, D), logical)
+    # pad rows of the destination layout are zero
+    LM = -(-V // M)
+    for d in range(M):
+        lg = d + M * np.arange(LM)
+        assert (dest[d][lg >= V] == 0).all()
+    # ... and back to 8: byte-identical again, fingerprints invariant
+    back = {d: elastic.reshard_rows(lambda s: dest[s], M, N, V, D, d)
+            for d in range(N)}
+    np.testing.assert_array_equal(
+        elastic.logical_rows(lambda s: back[s], N, V, D), logical)
+    np.testing.assert_array_equal(
+        elastic.fingerprint_rows(shard, N, V),
+        elastic.fingerprint_rows(lambda s: dest[s], M, V))
+    np.testing.assert_array_equal(
+        elastic.fingerprint_rows(shard, N, V),
+        elastic.fingerprint_array(logical))
+
+
+# ------------------------------------- checkpoint roundtrip across N
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-device CPU mesh")
+def test_checkpoint_roundtrip_across_world_sizes(tmp_path):
+    """A checkpoint written by a world-8 sparse-engine run (Adam:
+    moments shard with the table) restores into a world-6 run with
+    byte-identical rows AND moments, records world_size/layout in meta
+    and manifest, and the training trajectory across the shrink
+    matches an uninterrupted world-8 run; a plain Executor restores
+    the same checkpoint as a dense logical table."""
+    V, D, B = 50, 8, 24
+
+    def build(seed=17):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                i = layers.data("ids", shape=[4, 1], dtype="int64")
+                y = layers.data("y", shape=[D], dtype="float32")
+                emb = layers.embedding(
+                    i, size=[V, D], is_sparse=True, is_distributed=True,
+                    param_attr=pt.ParamAttr(name="tbl"))
+                loss = layers.mean(layers.square_error_cost(
+                    layers.reduce_sum(emb, dim=1), y))
+                pt.optimizer.Adam(1e-2).minimize(loss)
+        main.random_seed = startup.random_seed = seed
+        return main, startup, loss
+
+    def feed(step):
+        rng = np.random.RandomState(1000 + step)
+        return {"ids": rng.randint(0, V, (B, 4, 1)).astype("int64"),
+                "y": rng.randn(B, D).astype("float32")}
+
+    d = str(tmp_path / "ck")
+
+    main, startup, loss = build()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        pt.Executor(pt.CPUPlace()).run(startup)
+        pexe = pt.ParallelExecutor(loss_name=loss.name,
+                                   main_program=main, scope=scope,
+                                   sparse="shard")
+        l8 = [float(np.asarray(pexe.run(feed=feed(s),
+                                        fetch_list=[loss])[0]))
+              for s in range(3)]
+        meta = pt.io.save_checkpoint(pexe, d, main, step=2)
+        eng = pexe.sparse_engine
+        tbl8 = eng.to_logical("tbl", np.asarray(scope.get("tbl")))
+        moment = sorted(eng.tables["tbl"].moments)[0]
+        m8 = eng.to_logical("tbl", np.asarray(scope.get(moment)))
+
+    assert meta["world_size"] == 8
+    assert set(meta["layout"]) == {"tbl", moment,
+                                   sorted(eng.tables["tbl"].moments)[1]}
+    assert "tbl" not in meta["vars"]           # not in params.npz
+    assert os.path.exists(os.path.join(d, "tbl.shard0of8.npy"))
+    with open(os.path.join(d, "checkpoint.manifest.json")) as f:
+        man = json.load(f)
+    assert man["world_size"] == 8 and "tbl" in man["layout"]
+    # every shard file is manifest-checksummed (torn shards detected)
+    assert "tbl.shard3of8.npy" in man["files"]
+
+    # reference: 6 uninterrupted world-8 steps
+    main_r, startup_r, loss_r = build()
+    scope_r = pt.Scope()
+    with pt.scope_guard(scope_r):
+        pt.Executor(pt.CPUPlace()).run(startup_r)
+        pexe_r = pt.ParallelExecutor(loss_name=loss_r.name,
+                                     main_program=main_r, scope=scope_r,
+                                     sparse="shard")
+        lref = [float(np.asarray(pexe_r.run(feed=feed(s),
+                                            fetch_list=[loss_r])[0]))
+                for s in range(6)]
+
+    # restore at world 6: rows and moments byte-identical, training
+    # continues on the reference trajectory
+    main2, startup2, loss2 = build()
+    scope2 = pt.Scope()
+    mesh6 = local_mesh("dp", devices=jax.devices()[:6])
+    with pt.scope_guard(scope2):
+        pt.Executor(pt.CPUPlace()).run(startup2)
+        pexe2 = pt.ParallelExecutor(loss_name=loss2.name,
+                                    main_program=main2, scope=scope2,
+                                    mesh=mesh6, sparse="shard")
+        meta2 = pt.io.load_checkpoint(pexe2, d, main2)
+        assert meta2["step"] == 2
+        eng2 = pexe2.sparse_engine
+        assert scope2.get("tbl").shape == eng2.tables["tbl"].physical_shape
+        np.testing.assert_array_equal(
+            eng2.to_logical("tbl", np.asarray(scope2.get("tbl"))), tbl8)
+        np.testing.assert_array_equal(
+            eng2.to_logical("tbl", np.asarray(scope2.get(moment))), m8)
+        l6 = [float(np.asarray(pexe2.run(feed=feed(s),
+                                         fetch_list=[loss2])[0]))
+              for s in range(3, 6)]
+    np.testing.assert_allclose(l8 + l6, lref, rtol=1e-3, atol=1e-6)
+
+    # plain Executor: dense logical restore of the same checkpoint
+    main3, startup3, _loss3 = build()
+    scope3 = pt.Scope()
+    with pt.scope_guard(scope3):
+        exe3 = pt.Executor(pt.CPUPlace())
+        exe3.run(startup3)
+        pt.io.load_checkpoint(exe3, d, main3)
+        np.testing.assert_array_equal(np.asarray(scope3.get("tbl")),
+                                      tbl8)
+
+
+# ------------------------------------------------ the subprocess gate
+
+def test_tpuchaos_selftest_elastic_subprocess():
+    """tools/tpuchaos.py --selftest-elastic: rank 3 SIGKILL'd at N=8,
+    liveness flags the silence, resume at N=6 through the streaming
+    r%8 -> r%6 shuffle, a resize request grows back to N=8 — final
+    loss within tolerance of the uninterrupted run, zero lost
+    embedding rows across both shuffles."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    env.pop("PADDLE_TPU_CHAOS", None)
+    p = subprocess.run(
+        [sys.executable, TPUCHAOS, "--selftest-elastic", "--json"],
+        capture_output=True, text=True, timeout=300, env=env)
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert lines, p.stderr[-500:]
+    verdict = json.loads(lines[-1])
+    assert p.returncode == 0, (verdict, p.stderr[-500:])
+    assert verdict["ok"] is True, verdict["problems"]
+    assert verdict["elastic_worlds"] == [8, 6, 8]
+    assert np.isclose(verdict["elastic_baseline_loss"],
+                      verdict["elastic_final_loss"], rtol=1e-3)
