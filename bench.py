@@ -112,6 +112,15 @@ _PEAK_BF16 = (
 
 
 def _peak_flops(device):
+    # the runtime attribution layer owns the peak table now (including
+    # the PADDLE_TPU_PEAK_FLOPS override), so bench's offline MFU and
+    # the live perf.mfu gauge read the same denominator; the local
+    # table stays as fallback for a stripped install
+    try:
+        from paddle_tpu.telemetry.attribution import peak_flops
+        return peak_flops(device)
+    except Exception:
+        pass
     kind = getattr(device, "device_kind", "").lower()
     for tag, peak in _PEAK_BF16:
         if tag in kind:
@@ -791,6 +800,81 @@ def _enable_compile_cache():
         pass
 
 
+_HISTORY_SCHEMA = "paddle_tpu.bench.history.v1"
+
+# result key -> (unit, stage) for the perf-history spine: one compact
+# record per completed bench stage lands in BENCH_history.jsonl, the
+# rolling trajectory `tpustat --slo` regression-gates against
+_HISTORY_METRICS = (
+    ("value", "tokens/sec", "transformer"),
+    ("mfu", "mfu", "transformer"),
+    ("resnet50_infer_images_per_sec", "images/sec", "inference"),
+    ("resnet50_infer_latency_ms", "ms", "inference"),
+    ("deepfm_examples_per_sec", "examples/sec", "deepfm"),
+    ("deepfm_step_ms", "ms", "deepfm"),
+    ("resnet50_images_per_sec", "images/sec", "resnet"),
+    ("mnist_mlp_steps_per_sec", "steps/sec", "mnist"),
+    ("transformer_b256_tokens_per_sec", "tokens/sec", "b256"),
+    ("transformer_b256_mfu", "mfu", "b256"),
+    ("flash_attn_32k_causal_ms", "ms", "flash"),
+)
+
+
+def _git_sha():
+    try:
+        import subprocess
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        sha = out.stdout.strip()
+        return sha or None
+    except Exception:
+        return None
+
+
+def _history_records(result, now=None):
+    """The schema'd per-stage records for one bench result. The
+    headline 'value' is renamed to its real metric name; zero values
+    from never-ran stages are skipped (a bootstrap artifact must not
+    drag the rolling median to 0)."""
+    now = now if now is not None else time.time()
+    sha = _git_sha()
+    common = {"schema": _HISTORY_SCHEMA,
+              "platform": result.get("platform"),
+              "device_kind": result.get("device_kind"),
+              "git_sha": sha, "unix_time": round(now, 1)}
+    records = []
+    for key, unit, stage in _HISTORY_METRICS:
+        v = result.get(key)
+        if not isinstance(v, (int, float)) or not v:
+            continue
+        metric = result.get("metric", key) if key == "value" else key
+        records.append(dict(common, metric=metric, value=v,
+                            unit=unit, stage=stage))
+    return records
+
+
+def _append_history(result, path=None):
+    """Append this run's per-stage records to the history spine
+    (BENCH_HISTORY_PATH overrides the default repo-root
+    BENCH_history.jsonl). Best-effort: any failure returns None and
+    never disturbs the bench artifacts or stdout contract."""
+    try:
+        records = _history_records(result)
+        if not records:
+            return None
+        path = path or os.environ.get("BENCH_HISTORY_PATH") \
+            or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_history.jsonl")
+        with open(path, "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return path
+    except Exception:
+        return None
+
+
 def _write_telemetry_artifact(path=None):
     """BENCH_telemetry.json alongside BENCH_probe.json: the full metric
     snapshot (+ span count) of the bench run when telemetry is on.
@@ -833,8 +917,9 @@ def _child_main():
         jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform  # may hang; parent supervises
     result = run_benchmarks(platform, emit_progress=_emit)
-    # artifact write happens BEFORE the final emit: the last stdout
-    # line must stay the result line no matter what the write does
+    # artifact writes happen BEFORE the final emit: the last stdout
+    # line must stay the result line no matter what the writes do
+    _append_history(result)
     _write_telemetry_artifact()
     _emit(result)
 

@@ -108,6 +108,10 @@ class Executor:
         # the race/stall analog of the reference's distributed watchdogs.
         self.step_timeout = None     # seconds; None disables
         self.last_step_time = None   # wall seconds of the last run()
+        # the most recent recompile explanation (telemetry on only):
+        # which ckey component busted the compile cache, per
+        # telemetry.attribution.explain_recompile
+        self.last_recompile = None
         self._seen_keys = set()
         # per-device on-device step counters (PRNG stream position);
         # donated through every run() so advancing costs no dispatch,
@@ -502,8 +506,13 @@ class Executor:
         check = self._check_requested(check_nan_inf)
         from ..diagnostics import recorder as _fr
         flight = _fr.active()
-        with _tm.span("executor.feed_put", feeds=len(feed)):
+        t_fp = time.perf_counter() if tm_on else 0.0
+        with _tm.span("executor.feed_put", feeds=len(feed),
+                      step=self._step - 1):
             feed_arrays = self._put_feeds(program, feed, dev)
+        if tm_on:
+            _tm.histogram("executor.feed_put_seconds").observe(
+                time.perf_counter() - t_fp)
 
         persist = self._collect_persist(program, scope)
         self._unalias_feeds(feed_arrays, persist)
@@ -519,7 +528,26 @@ class Executor:
         fn = self._cache.get(ckey) if use_program_cache else None
         # first-run (compile) detection must survive use_program_cache=False
         first_run = ckey not in self._seen_keys
+        if first_run and tm_on and self._seen_keys:
+            # a NEW compile key while others are cached: diff it
+            # against the nearest seen neighbor and say which
+            # component busted the cache (tpuscope recompile explainer)
+            from ..telemetry import attribution as _attr
+            self.last_recompile = _attr.explain_recompile(
+                "executor", _attr.executor_ckey_fields(ckey),
+                [_attr.executor_ckey_fields(k)
+                 for k in self._seen_keys],
+                step=self._step - 1)
         self._seen_keys.add(ckey)
+
+        step_dev = self._step_counters.get(dev)
+        if step_dev is None:
+            # uncommitted on purpose: a device_put-committed counter
+            # would commit every jit OUTPUT (params included) to one
+            # device, poisoning later mesh-sharded use of the scope
+            # (e.g. startup → PipelineTrainer over a pp mesh)
+            step_dev = jnp.asarray(self._step - 1, jnp.int32)
+            self._step_counter_vals[dev] = self._step - 1
         if fn is None:
             if flight is not None:
                 flight.event("compile", program=program._version,
@@ -552,19 +580,20 @@ class Executor:
                 fn = jax.jit(stepped,
                              donate_argnums=(0, 2) if self.donate_state
                              else ())
+                if tm_on:
+                    # AOT-compile here (still inside the compile span)
+                    # to capture this ckey's FLOPs from cost_analysis
+                    # for perf.mfu — the executable replaces the jit
+                    # wrapper, so the capture costs no second compile
+                    from ..telemetry import attribution as _attr
+                    fn = _attr.instrument_compile(
+                        fn, (persist, feed_arrays, step_dev), ckey,
+                        feed_arrays, kind="executor")
             if use_program_cache:
                 self._cache[ckey] = fn
         elif tm_on:
             _tm.counter("executor.cache_hit_count").inc()
 
-        step_dev = self._step_counters.get(dev)
-        if step_dev is None:
-            # uncommitted on purpose: a device_put-committed counter
-            # would commit every jit OUTPUT (params included) to one
-            # device, poisoning later mesh-sharded use of the scope
-            # (e.g. startup → PipelineTrainer over a pp mesh)
-            step_dev = jnp.asarray(self._step - 1, jnp.int32)
-            self._step_counter_vals[dev] = self._step - 1
         # the host mirror tracks the donated counter (+1 per run), so
         # diagnostics step attribution never needs a blocking readback
         # of a counter an in-flight step hasn't produced yet
@@ -609,6 +638,12 @@ class Executor:
         if tm_on:
             _tm.counter("executor.steps").inc()
             _tm.histogram("executor.step_seconds").observe(dt)
+            # attribution window: fold this step's FLOPs/examples into
+            # the perf.mfu / perf.goodput.* gauges (compile runs only
+            # re-anchor the window — compile time is not throughput)
+            from ..telemetry import attribution as _attr
+            _attr.on_step(ckey, dt, compile_run=first_run,
+                          feed_arrays=feed_arrays)
             # watermark gauges; a no-op on backends without allocator
             # stats (capability probed once — see telemetry.memory)
             _tm.sample_device_memory()
@@ -686,7 +721,7 @@ class Executor:
 
         if check and (fetches or check == "all"):
             t_fc = time.perf_counter()
-            with _tm.span("executor.finite_check"):
+            with _tm.span("executor.finite_check", step=rec["step"]):
                 bad = self._nonfinite_names(zip(fetch_names, fetches))
                 where = "fetched vars"
                 if not bad and check == "all":
@@ -713,7 +748,8 @@ class Executor:
 
         if rec["return_numpy"]:
             t_rb = time.perf_counter()
-            with _tm.span("executor.fetch_readback", n=len(fetches)):
+            with _tm.span("executor.fetch_readback", n=len(fetches),
+                          step=rec["step"]):
                 out = [np.asarray(f) for f in fetches]
             if tm_on:
                 _tm.histogram("executor.fetch_readback_seconds").observe(

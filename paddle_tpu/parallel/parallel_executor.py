@@ -119,6 +119,10 @@ class ParallelExecutor:
                     "grad_sync policies need a 'dp' axis on the mesh")
         self._cache = {}
         self._step = 0
+        # recompile-explainer state (telemetry on only): named fields
+        # of every compile key seen, plus the latest explanation
+        self._seen_fields = []
+        self.last_recompile = None
         self._replicated = NamedSharding(self.mesh, P())
         # asynchronous step pipeline (tpupipe): same bounded in-flight
         # window as Executor.run(async_steps=k), over the shard_map /
@@ -487,6 +491,19 @@ class ParallelExecutor:
             if tm_on:
                 _tm.counter("pexe.compile_count").inc()
                 _tm.gauge("pexe.device_count").set(self.device_count)
+                # tpuscope recompile explainer: name the ckey
+                # component (shape bucket, grad_sync policy, engine
+                # key, ...) that busted the cache
+                from ..telemetry import attribution as _attr
+                fields = _attr.pexe_ckey_fields(
+                    ckey,
+                    policy_key=policy.key() if policy else None,
+                    engine_key=engine.key() if engine else None)
+                if self._seen_fields:
+                    self.last_recompile = _attr.explain_recompile(
+                        "pexe", fields, self._seen_fields,
+                        step=self._step - 1)
+                self._seen_fields.append(fields)
             if policy is not None:
                 fn = self._build_gradsync_fn(
                     program, fetch_names, is_test, feed_arrays, feed_sh,

@@ -132,15 +132,16 @@ class Future:
 
 class _Request:
     __slots__ = ("feed", "rows", "group", "deadline", "enqueue_t",
-                 "future")
+                 "future", "request_id")
 
-    def __init__(self, feed, rows, group, deadline):
+    def __init__(self, feed, rows, group, deadline, request_id=None):
         self.feed = feed
         self.rows = rows
         self.group = group
         self.deadline = deadline
         self.enqueue_t = time.monotonic()
         self.future = Future(deadline)
+        self.request_id = request_id
 
     def expired(self, now=None):
         return self.deadline is not None \
@@ -229,10 +230,11 @@ class DynamicBatcher:
         self._closed = False
 
     # ---------------------------------------------------- caller side
-    def submit(self, feed, deadline_ms=None):
+    def submit(self, feed, deadline_ms=None, request_id=None):
         """Enqueue one request; returns a Future. Raises RejectedError
         (queue full / oversized / closed) instead of blocking — the
-        caller learns about overload immediately."""
+        caller learns about overload immediately. `request_id` rides
+        along for span/trace attribution."""
         if not feed:
             raise ValueError("empty feed")
         rows_set = {int(np.shape(v)[0]) if np.ndim(v) >= 1 else None
@@ -249,7 +251,8 @@ class DynamicBatcher:
                 f"{self.config.max_batch_size}")
         deadline = None if deadline_ms is None \
             else time.monotonic() + float(deadline_ms) / 1e3
-        req = _Request(feed, rows, _group_key(feed), deadline)
+        req = _Request(feed, rows, _group_key(feed), deadline,
+                       request_id=request_id)
         with self._cond:
             if self._closed:
                 raise ServerClosed("server is draining; not accepting "

@@ -60,9 +60,10 @@ class DecodeConfig:
 
 class DecodeRequest:
     __slots__ = ("src", "src_len", "tenant", "max_new_tokens",
-                 "deadline", "enqueue_t", "future")
+                 "deadline", "enqueue_t", "future", "request_id")
 
-    def __init__(self, src, src_len, tenant, max_new_tokens, deadline):
+    def __init__(self, src, src_len, tenant, max_new_tokens, deadline,
+                 request_id=None):
         self.src = src
         self.src_len = src_len
         self.tenant = tenant
@@ -70,6 +71,7 @@ class DecodeRequest:
         self.deadline = deadline           # monotonic seconds or None
         self.enqueue_t = time.monotonic()
         self.future = Future(deadline)
+        self.request_id = request_id
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
@@ -120,7 +122,8 @@ class ContinuousScheduler:
 
     # ------------------------------------------------------ caller side
     def submit(self, src, src_len=None, tenant="default",
-               max_new_tokens=None, deadline_ms=None):
+               max_new_tokens=None, deadline_ms=None,
+               request_id=None):
         """Enqueue one sequence; returns a Future resolving to a
         `DecodeResult`. Sheds immediately on a full queue or an
         oversized source (RejectedError) — overload never builds an
@@ -144,7 +147,7 @@ class ContinuousScheduler:
         tenant = str(tenant)
         self.qos.tenant(tenant)        # strict mode rejects here
         req = DecodeRequest(src, src_len, tenant, max_new_tokens,
-                            deadline)
+                            deadline, request_id=request_id)
         with self._cond:
             if self._closed:
                 raise ServerClosed("decoder is draining; not "
@@ -260,6 +263,11 @@ class ContinuousScheduler:
                 _tm.histogram(
                     "serving.decode.queue_wait_seconds").observe(
                     time.monotonic() - req.enqueue_t)
+                # admit marker on the timeline, carrying the caller's
+                # request id so a trace can be searched by it
+                _tm.instant_event("serving.decode.admit",
+                                  tenant=req.tenant, slot=slot.index,
+                                  request_id=req.request_id)
         if batch:
             self.state = self.engine.admit(self.state, batch, slots)
             if _tm.enabled():
@@ -349,6 +357,10 @@ class ContinuousScheduler:
         if _tm.enabled():
             _tm.counter("serving.decode.retired").inc()
             _tm.counter(f"serving.decode.retired_{reason}").inc()
+            _tm.instant_event("serving.decode.retire",
+                              tenant=req.tenant, slot=slot.index,
+                              reason=reason, delivered=delivered,
+                              request_id=req.request_id)
 
     # ------------------------------------------------------- lifecycle
     def start(self):

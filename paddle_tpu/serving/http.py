@@ -19,6 +19,12 @@ Endpoints (TF-Serving-shaped):
 - ``GET /metrics`` — the telemetry registry in Prometheus text format.
 - ``GET /v1/models`` — registered names and versions.
 
+Every POST carries a correlation id: ``X-Request-Id`` header or
+``request_id`` body field if the caller sent one, generated otherwise.
+It is echoed in the response header and in success AND error bodies,
+and threaded through the batcher / decode-scheduler spans so a request
+can be found on the Chrome timeline by id.
+
 Error mapping keeps overload semantics visible to clients, with a
 machine-readable ``kind`` in every error body: queue-full and
 oversized requests are 429 ``rejected`` (back off / retry elsewhere),
@@ -33,6 +39,7 @@ batcher/scheduler, not the socket layer.
 import json
 import re
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -69,11 +76,19 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):      # quiet by default
         pass
 
+    # per-request correlation id (satellite of tpuscope): accepted via
+    # X-Request-Id header or a "request_id" body field, generated
+    # otherwise, threaded through batcher/decode spans, and echoed in
+    # every success and error body + response header
+    _request_id = None
+
     def _reply(self, code, payload, content_type="application/json"):
         body = payload if isinstance(payload, bytes) \
             else json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", content_type)
+        if self._request_id:
+            self.send_header("X-Request-Id", self._request_id)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -84,9 +99,12 @@ class _Handler(BaseHTTPRequestHandler):
         body = {"error": msg}
         if kind:
             body["kind"] = kind
+        if self._request_id:
+            body["request_id"] = self._request_id
         self._reply(code, body)
 
     def do_GET(self):
+        self._request_id = None      # keep-alive reuse: never stale
         if _tm.enabled():
             _tm.counter("serving.http_requests").inc()
         if self.path == "/healthz":
@@ -104,6 +122,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path!r}")
 
     def do_POST(self):
+        # header id is captured before body parse so even a 400 for
+        # malformed JSON echoes the caller's correlation id
+        self._request_id = \
+            (self.headers.get("X-Request-Id") or "").strip() or None
         if _tm.enabled():
             _tm.counter("serving.http_requests").inc()
         m = _PREDICT_RE.match(self.path)
@@ -115,19 +137,28 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
+            rid = body.get("request_id") or self._request_id \
+                or uuid.uuid4().hex[:16]
+            self._request_id = rid = str(rid)
             version = body.get("version", m.group("version"))
             if body.get("max_new_tokens") is not None:
-                payload = self._decode_request(name, body, version)
+                with _tm.span("serving.http.predict", model=name,
+                              request_id=rid, route="decode"):
+                    payload = self._decode_request(name, body, version)
             else:
                 engine, version = self.model_server.registry.get(
                     name, version)
                 feed = _coerce_inputs(engine, body.get("inputs") or {})
-                outs = self.model_server.predict(
-                    name, feed, version=version,
-                    deadline_ms=body.get("deadline_ms"))
+                with _tm.span("serving.http.predict", model=name,
+                              request_id=rid, route="batch"):
+                    outs = self.model_server.predict(
+                        name, feed, version=version,
+                        deadline_ms=body.get("deadline_ms"),
+                        request_id=rid)
                 payload = {
                     "outputs": [np.asarray(o).tolist() for o in outs],
-                    "model": name, "version": version}
+                    "model": name, "version": version,
+                    "request_id": rid}
         except KeyError as e:
             self._error(404, str(e))
         except DeadlineExceeded as e:
@@ -164,12 +195,14 @@ class _Handler(BaseHTTPRequestHandler):
             name, src, src_len=src_len,
             tenant=str(body.get("tenant", "default")),
             max_new_tokens=int(body["max_new_tokens"]),
-            deadline_ms=body.get("deadline_ms"))
+            deadline_ms=body.get("deadline_ms"),
+            request_id=self._request_id)
         return {"outputs": [np.asarray(result.tokens).tolist()],
                 "finish_reason": result.finish_reason,
                 "tenant": result.tenant,
                 "model": name,
-                "version": int(version) if version is not None else 1}
+                "version": int(version) if version is not None else 1,
+                "request_id": self._request_id}
 
 
 class HttpFrontend:

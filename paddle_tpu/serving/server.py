@@ -166,7 +166,8 @@ class ModelServer:
             return self._decoders.get(name)
 
     def decode(self, name, src, src_len=None, tenant="default",
-               max_new_tokens=None, deadline_ms=None, timeout=None):
+               max_new_tokens=None, deadline_ms=None, timeout=None,
+               request_id=None):
         """Blocking continuous-decode: submit one sequence, wait for
         its `DecodeResult`. KeyError when no decoder is attached (the
         HTTP 404/400 discriminator)."""
@@ -180,7 +181,8 @@ class ModelServer:
         t0 = time.perf_counter()
         future = decoder.submit(src, src_len=src_len, tenant=tenant,
                                 max_new_tokens=max_new_tokens,
-                                deadline_ms=deadline_ms)
+                                deadline_ms=deadline_ms,
+                                request_id=request_id)
         out = future.result(timeout=timeout)
         if _tm.enabled():
             _tm.histogram("serving.decode.request_latency_seconds") \
@@ -217,7 +219,8 @@ class ModelServer:
             return sum(s.restarts for s in self._served.values())
 
     # --------------------------------------------------------- serving
-    def submit(self, name, feed, version=None, deadline_ms=None):
+    def submit(self, name, feed, version=None, deadline_ms=None,
+               request_id=None):
         """Async path: returns (Future, version)."""
         if self._stopping:
             raise ServerClosed("server is draining")
@@ -225,16 +228,18 @@ class ModelServer:
         served = self._served[(name, version)]
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
-        return served.batcher.submit(feed, deadline_ms=deadline_ms), \
+        return served.batcher.submit(feed, deadline_ms=deadline_ms,
+                                     request_id=request_id), \
             version
 
     def predict(self, name, feed, version=None, deadline_ms=None,
-                timeout=None):
+                timeout=None, request_id=None):
         """Blocking convenience: submit + wait. Returns the fetch list
         (numpy arrays, rows matching the request's batch dim)."""
         t0 = time.perf_counter()
         future, _version = self.submit(name, feed, version=version,
-                                       deadline_ms=deadline_ms)
+                                       deadline_ms=deadline_ms,
+                                       request_id=request_id)
         outs = future.result(timeout=timeout)
         if _tm.enabled():
             _tm.histogram("serving.request_latency_seconds").observe(
@@ -302,7 +307,10 @@ class ModelServer:
                 served.batcher.config.buckets)
             with _tm.span("serving.batch", model=served.name,
                           rows=true_rows, bucket=bucket,
-                          requests=len(batch.requests)):
+                          requests=len(batch.requests),
+                          request_ids=[r.request_id
+                                       for r in batch.requests
+                                       if r.request_id] or None):
                 outs = served.engine.run(padded)
             if _tm.enabled():
                 _tm.counter("serving.batch_rows_total").inc(true_rows)

@@ -44,7 +44,8 @@ from .registry import (Counter, Gauge, Histogram, counter, gauge,
                        histogram, prometheus_text,
                        DEFAULT_TIME_BUCKETS)
 from .spans import (span, iter_spans, chrome_trace, write_chrome_trace,
-                    merge_device_ops, SpanRecord, append_span, now_us)
+                    merge_device_ops, SpanRecord, append_span, now_us,
+                    instant_event)
 from .memory import device_memory_supported, sample_device_memory
 
 __all__ = ["enabled", "enable", "disable", "counter", "gauge",
@@ -52,8 +53,19 @@ __all__ = ["enabled", "enable", "disable", "counter", "gauge",
            "chrome_trace", "write_chrome_trace", "merge_device_ops",
            "iter_spans", "sample_device_memory",
            "device_memory_supported", "reset", "flush", "fleet",
-           "append_span", "now_us", "Counter",
-           "Gauge", "Histogram", "SpanRecord", "DEFAULT_TIME_BUCKETS"]
+           "append_span", "now_us", "instant_event", "Counter",
+           "Gauge", "Histogram", "SpanRecord", "DEFAULT_TIME_BUCKETS",
+           "attribution", "slo"]
+
+
+def __getattr__(name):
+    # attribution/slo load lazily: the off-path contract (bench pin)
+    # is that a telemetry-disabled run never even imports them
+    if name in ("attribution", "slo"):
+        import importlib
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")
 
 _LOG = logging.getLogger("paddle_tpu.telemetry")
 
@@ -104,6 +116,13 @@ def reset():
     loop, and by tests."""
     _registry.reset_metrics()
     _spans.clear_spans()
+    # restart the MFU/goodput accumulation window too — but only if
+    # attribution was ever loaded (importing it here would defeat the
+    # lazy off-path contract)
+    import sys
+    attr = sys.modules.get(__name__ + ".attribution")
+    if attr is not None:
+        attr.reset_window()
 
 
 def flush(log=True):

@@ -376,6 +376,12 @@ def merge_histograms(a, b, name=""):
     return out
 
 
+def _rank_gauge(metrics, name):
+    """Scalar value of one {"kind","value"} envelope entry, or None."""
+    ent = metrics.get(name)
+    return ent["value"] if ent else None
+
+
 def detect_stragglers(per_rank_seconds, k=_DEFAULT_K_MAD):
     """Flag ranks whose step wall-time sits > k·MAD above the fleet
     median. MAD is robust to the outliers we're hunting, but degenerates
@@ -594,8 +600,8 @@ class FleetCollector:
 
     def report(self):
         """The one-command fleet view tpustat --fleet renders: per-rank
-        step time / collective volume / bubble fraction, merged
-        metrics, collective totals, and the straggler verdict."""
+        step time / collective volume / bubble fraction / MFU+goodput,
+        merged metrics, collective totals, and the straggler verdict."""
         merged = self.merged_metrics()
         per_rank = {}
         for r in self.ranks:
@@ -652,6 +658,13 @@ class FleetCollector:
                     int(d.get("exchange_bytes", 0))
                     for d in embed_tables.values()),
                 "embed_tables": embed_tables,
+                # tpuscope attribution gauges, when the rank ran with
+                # the attribution layer live
+                "mfu": _rank_gauge(m, "perf.mfu"),
+                "goodput_examples_per_s": _rank_gauge(
+                    m, "perf.goodput.examples_per_s"),
+                "goodput_tokens_per_s": _rank_gauge(
+                    m, "perf.goodput.tokens_per_s"),
                 "hostname": (env.get("host") or {}).get("hostname"),
                 "labels": env.get("labels", {}),
             }

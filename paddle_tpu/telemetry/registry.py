@@ -16,7 +16,8 @@ import threading
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "snapshot", "snapshot_with_kinds",
            "reset_metrics", "prometheus_text", "set_default_labels",
-           "default_labels", "DEFAULT_TIME_BUCKETS"]
+           "default_labels", "quantile_from_buckets",
+           "DEFAULT_TIME_BUCKETS"]
 
 # exponential wall-time buckets, 100µs .. 2min (seconds); the spread
 # covers a cached CPU step (~1ms) through a cold TPU-relay compile
@@ -45,6 +46,53 @@ def set_default_labels(labels):
 def default_labels():
     with _registry_lock:
         return dict(_default_labels)
+
+
+def _bucket_quantile(edges, counts, q, lo=None, hi=None):
+    """Interpolated quantile over fixed buckets: find the bucket the
+    q-rank falls in, interpolate linearly inside it. `counts` has one
+    extra trailing slot (+Inf); the observed min/max tighten the open
+    ends (first bucket's lower bound, +Inf's upper bound) and clamp
+    the result so an estimate never leaves the observed range."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if rank <= cum + c or i == len(counts) - 1:
+            lower = edges[i - 1] if i > 0 else \
+                (lo if lo is not None else 0.0)
+            upper = edges[i] if i < len(edges) else \
+                (hi if hi is not None else lower)
+            frac = (rank - cum) / c
+            frac = 0.0 if frac < 0.0 else (1.0 if frac > 1.0 else frac)
+            v = lower + (upper - lower) * frac
+            if lo is not None and v < lo:
+                v = lo
+            if hi is not None and v > hi:
+                v = hi
+            return v
+        cum += c
+    return None
+
+
+def quantile_from_buckets(value, q):
+    """Quantile estimate from a histogram's snapshot form (the
+    `to_value()` dict, as found in registry snapshots and the fleet
+    merge). Returns None for an empty histogram."""
+    if not isinstance(value, dict) or not value.get("count"):
+        return None
+    buckets = value.get("buckets") or {}
+    # bucket keys are floats in-process but strings after a JSON round
+    # trip (fleet spool files, /metrics consumers) — coerce either way
+    edges = sorted(float(k) for k in buckets if k != "+Inf")
+    by_edge = {float(k): v for k, v in buckets.items() if k != "+Inf"}
+    counts = [by_edge[e] for e in edges] + [buckets.get("+Inf", 0)]
+    return _bucket_quantile(edges, counts, q,
+                            value.get("min"), value.get("max"))
 
 
 class Counter:
@@ -167,6 +215,16 @@ class Histogram:
     def sum(self):
         return self._sum
 
+    def quantile(self, q):
+        """Interpolated quantile estimate from the bucket counts
+        (None while empty). Exact only up to bucket resolution —
+        good enough for SLO gating, not for billing."""
+        with self._lock:
+            return _bucket_quantile(
+                self.buckets, self._counts, q,
+                self._min if self._count else None,
+                self._max if self._count else None)
+
     def to_value(self):
         with self._lock:
             d = {"count": self._count, "sum": self._sum,
@@ -177,6 +235,12 @@ class Histogram:
                 d["min"] = self._min
                 d["max"] = self._max
                 d["mean"] = self._sum / self._count
+                d["p50"] = _bucket_quantile(
+                    self.buckets, self._counts, 0.5, self._min,
+                    self._max)
+                d["p99"] = _bucket_quantile(
+                    self.buckets, self._counts, 0.99, self._min,
+                    self._max)
         return d
 
 
@@ -260,6 +324,12 @@ def prometheus_text():
             lines.append(f'{pname}_bucket{{le="+Inf"}} {v["count"]}')
             lines.append(f"{pname}_sum {v['sum']:g}")
             lines.append(f"{pname}_count {v['count']}")
+            if v["count"]:
+                # quantile summaries alongside the raw buckets, so
+                # scrape-side dashboards (and SLO rules) don't need
+                # to re-derive them from _bucket counts
+                lines.append(f"{pname}_p50 {v['p50']:g}")
+                lines.append(f"{pname}_p99 {v['p99']:g}")
         else:
             lines.append(f"{pname} {m.to_value():g}")
     return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,338 @@
+"""tpuscope SLO engine: declarative perf rules + history regression gate.
+
+Rules are one-line strings — ``"step_ms.p99 < 250"``,
+``"perf.mfu > 0.3"``, ``"serving.queue_depth < 64"`` — evaluated
+against a registry snapshot (or the fleet merge). A trailing
+``.p50/.p99/.mean/.min/.max/.count`` segment selects a histogram
+statistic (quantiles interpolate from the fixed buckets via
+``registry.quantile_from_buckets``); everything else reads the metric's
+scalar value. Missing metrics are *skipped*, not violated — a serving
+rule shouldn't fail a training run — unless ``strict=True``.
+
+The regression gate reuses the fleet straggler detector's robust
+statistics (median ± k·MAD with a small-sample ratio fallback,
+fleet.py `detect_stragglers`) against the rolling ``BENCH_history.jsonl``
+spine bench.py appends to: the latest record for each metric is
+compared to the median of its predecessors, direction-aware (throughput
+regresses down, latency regresses up).
+
+Dependency-free beyond sibling telemetry modules — no jax — so
+``tpustat --slo`` can gate in CI without touching a backend.
+"""
+import json
+import os
+import re
+import statistics
+
+from . import registry as _registry
+# the straggler detector's knobs ARE the regression gate's knobs: one
+# definition of "anomalously far from the median" across the repo
+from .fleet import _DEFAULT_K_MAD, _RATIO_FALLBACK
+
+__all__ = ["Rule", "RuleResult", "SloReport", "parse_rule",
+           "evaluate", "evaluate_fleet", "check_regression",
+           "history_gate", "load_history", "append_history",
+           "DEFAULT_RULES"]
+
+_OPS = {
+    "<=": lambda a, b: a <= b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    ">": lambda a, b: a > b,
+}
+_RULE_RE = re.compile(
+    r"^\s*(?P<metric>[A-Za-z0-9_.:\-]+)\s*"
+    r"(?P<op><=|>=|==|!=|<|>)\s*"
+    r"(?P<value>[-+0-9.eE]+)\s*$")
+
+_STATS = ("p50", "p99", "mean", "max", "min", "count", "value")
+
+# shorthand -> (real metric, scale applied to the observed value).
+# step_ms reads the step-seconds histogram in milliseconds, matching
+# how every BENCH artifact and ROADMAP target quotes step time.
+ALIASES = {
+    "step_ms": ("executor.step_seconds", 1e3),
+}
+
+# the ruleset `tpustat --slo` applies when none is given: generous
+# sanity ceilings that hold on any healthy run rather than aggressive
+# targets (those belong in a per-deployment rules file)
+DEFAULT_RULES = (
+    "step_ms.p99 < 3600000",        # a step completes within an hour
+    "serving.queue_depth < 100000",
+)
+
+
+class Rule:
+    __slots__ = ("text", "metric", "stat", "op", "threshold", "scale")
+
+    def __init__(self, text, metric, stat, op, threshold, scale=1.0):
+        self.text = text
+        self.metric = metric
+        self.stat = stat
+        self.op = op
+        self.threshold = threshold
+        self.scale = scale
+
+    def __repr__(self):
+        return f"Rule({self.text!r})"
+
+
+class RuleResult:
+    __slots__ = ("rule", "ok", "observed", "skipped", "reason")
+
+    def __init__(self, rule, ok, observed=None, skipped=False,
+                 reason=None):
+        self.rule = rule
+        self.ok = ok
+        self.observed = observed
+        self.skipped = skipped
+        self.reason = reason
+
+    def to_dict(self):
+        return {"rule": self.rule.text, "ok": self.ok,
+                "observed": self.observed, "skipped": self.skipped,
+                "reason": self.reason}
+
+    def __str__(self):
+        if self.skipped:
+            return f"SKIP {self.rule.text} ({self.reason})"
+        tag = "PASS" if self.ok else "FAIL"
+        return f"{tag} {self.rule.text} (observed {self.observed:g})"
+
+
+class SloReport:
+    """Typed outcome of one evaluation pass: per-rule results plus the
+    rolled-up verdict. `ok` is True when no rule FAILED (skips don't
+    fail — unless the evaluation ran strict, in which case skips were
+    already converted to failures)."""
+    __slots__ = ("results",)
+
+    def __init__(self, results):
+        self.results = list(results)
+
+    @property
+    def violations(self):
+        return [r for r in self.results
+                if not r.ok and not r.skipped]
+
+    @property
+    def skipped(self):
+        return [r for r in self.results if r.skipped]
+
+    @property
+    def ok(self):
+        return not self.violations
+
+    def to_dict(self):
+        return {"ok": self.ok,
+                "violations": len(self.violations),
+                "results": [r.to_dict() for r in self.results]}
+
+    def __str__(self):
+        lines = [str(r) for r in self.results]
+        lines.append(f"SLO: {'OK' if self.ok else 'VIOLATED'} "
+                     f"({len(self.violations)} violation(s), "
+                     f"{len(self.skipped)} skipped, "
+                     f"{len(self.results)} rule(s))")
+        return "\n".join(lines)
+
+
+def parse_rule(text):
+    """'name[.stat] OP value' -> Rule. The stat suffix only splits off
+    when it names a known statistic, so dotted metric names
+    ('perf.mfu', 'serving.queue_depth') parse whole."""
+    m = _RULE_RE.match(text)
+    if not m:
+        raise ValueError(
+            f"bad SLO rule {text!r} (want 'metric[.stat] "
+            f"{'|'.join(_OPS)} number')")
+    metric = m.group("metric")
+    stat = "value"
+    head, dot, tail = metric.rpartition(".")
+    if dot and tail in _STATS:
+        metric, stat = head, tail
+    scale = 1.0
+    if metric in ALIASES:
+        metric, scale = ALIASES[metric]
+    return Rule(text.strip(), metric, stat, m.group("op"),
+                float(m.group("value")), scale)
+
+
+def _observe(value, stat):
+    """Pull `stat` out of one snapshot entry (scalar or histogram
+    dict). Returns (observed, reason): observed None means the stat
+    can't be read, with the reason saying why."""
+    if isinstance(value, dict) and "kind" in value and "value" in value:
+        value = value["value"]            # snapshot_with_kinds entry
+    if isinstance(value, dict):
+        if stat == "value":
+            stat = "mean"                 # bare histogram name
+        if stat in ("p50", "p99"):
+            q = _registry.quantile_from_buckets(value,
+                                               float(stat[1:]) / 100)
+            if q is None:
+                return None, "empty histogram"
+            return q, None
+        if stat in value:
+            return float(value[stat]), None
+        return None, f"histogram has no {stat!r}"
+    if stat not in ("value",):
+        return None, f"scalar metric has no {stat!r}"
+    try:
+        return float(value), None
+    except (TypeError, ValueError):
+        return None, f"non-numeric value {value!r}"
+
+
+def evaluate(rules, snap=None, strict=False):
+    """Evaluate rules against a registry snapshot (default: the live
+    registry). Counts violations on the `slo.violations` counter when
+    telemetry is recording."""
+    parsed = [r if isinstance(r, Rule) else parse_rule(r)
+              for r in rules]
+    if snap is None:
+        snap = _registry.snapshot()
+    results = []
+    for rule in parsed:
+        if rule.metric not in snap:
+            results.append(RuleResult(
+                rule, ok=not strict, skipped=not strict,
+                reason=f"metric {rule.metric!r} absent"))
+            continue
+        observed, reason = _observe(snap[rule.metric], rule.stat)
+        if observed is None:
+            results.append(RuleResult(rule, ok=not strict,
+                                      skipped=not strict,
+                                      reason=reason))
+            continue
+        observed *= rule.scale
+        ok = _OPS[rule.op](observed, rule.threshold)
+        results.append(RuleResult(rule, ok=ok, observed=observed))
+    report = SloReport(results)
+    n = len(report.violations)
+    if n and _registry.snapshot():
+        _registry.counter("slo.violations").inc(n)
+    return report
+
+
+def evaluate_fleet(rules, report, strict=False):
+    """Evaluate rules against a fleet merge (FleetCollector.report()):
+    merged entries are {"kind", "value"} dicts, which _observe already
+    unwraps."""
+    merged = report.get("merged", report) or {}
+    return evaluate(rules, snap=merged, strict=strict)
+
+
+# ------------------------------------------------------- history gate
+
+HISTORY_SCHEMA = "paddle_tpu.bench.history.v1"
+
+# substrings that decide which direction is "worse" for a metric when
+# the record doesn't say; throughput-ish names regress DOWN,
+# latency-ish names regress UP
+_HIGHER_BETTER = ("per_sec", "per_s", "_sec", "mfu", "goodput",
+                  "steps_per", "tokens_per", "images_per",
+                  "examples_per")
+_LOWER_BETTER = ("_ms", "latency", "seconds", "step_ms", "_time")
+
+
+def metric_direction(metric, unit=None):
+    """'higher' | 'lower' — which way is better for this metric."""
+    probe = f"{metric} {unit or ''}".lower()
+    for tag in _HIGHER_BETTER:
+        if tag in probe:
+            return "higher"
+    for tag in _LOWER_BETTER:
+        if tag in probe:
+            return "lower"
+    return "higher"
+
+
+def check_regression(history_values, current, direction="higher",
+                     k=_DEFAULT_K_MAD, window=20):
+    """Is `current` an outlier on the bad side of the rolling history?
+
+    Same robust statistics as the fleet straggler detector: with >= 4
+    samples and nonzero MAD the threshold is median ± k·MAD, else the
+    ratio fallback (median × or ÷ 1.5). Returns a dict with
+    `regressed`, `median`, `threshold`, `n`."""
+    vals = [float(v) for v in history_values][-window:]
+    out = {"regressed": False, "median": None, "threshold": None,
+           "n": len(vals), "current": float(current),
+           "direction": direction}
+    if not vals:
+        return out
+    med = statistics.median(vals)
+    mad = statistics.median([abs(v - med) for v in vals])
+    if len(vals) >= 4 and mad > 0:
+        delta = k * mad
+    else:
+        delta = (_RATIO_FALLBACK - 1.0) * abs(med)
+    if direction == "higher":
+        threshold = med - delta
+        regressed = current < threshold
+    else:
+        threshold = med + delta
+        regressed = current > threshold
+    out.update(median=med, threshold=threshold, regressed=regressed)
+    return out
+
+
+def load_history(path):
+    """BENCH_history.jsonl -> list of record dicts. Unparseable lines
+    are skipped (the file is append-only across interrupted runs)."""
+    records = []
+    if not os.path.exists(path):
+        return records
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec \
+                    and "value" in rec:
+                records.append(rec)
+    return records
+
+
+def append_history(path, records):
+    with open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return len(records)
+
+
+def history_gate(records, k=_DEFAULT_K_MAD, window=20,
+                 platform=None):
+    """Regression-gate the newest record of each metric against the
+    rolling median of its predecessors. Records for other platforms
+    are excluded (a CPU smoke run must not drag a TPU baseline).
+    Returns {"ok", "checked", "regressions": [per-metric dicts]}."""
+    by_metric = {}
+    for rec in records:
+        if platform and rec.get("platform") not in (None, platform):
+            continue
+        by_metric.setdefault(rec["metric"], []).append(rec)
+    regressions = []
+    checked = 0
+    for metric, recs in sorted(by_metric.items()):
+        if len(recs) < 2:
+            continue                     # nothing to compare against
+        *prior, latest = recs
+        checked += 1
+        direction = metric_direction(metric, latest.get("unit"))
+        res = check_regression(
+            [r["value"] for r in prior], latest["value"],
+            direction=direction, k=k, window=window)
+        res["metric"] = metric
+        if res["regressed"]:
+            regressions.append(res)
+    return {"ok": not regressions, "checked": checked,
+            "regressions": regressions}

@@ -20,7 +20,7 @@ import time
 
 __all__ = ["span", "iter_spans", "clear_spans", "chrome_trace",
            "write_chrome_trace", "merge_device_ops", "SpanRecord",
-           "now_us", "append_span"]
+           "now_us", "append_span", "instant_event"]
 
 _EPOCH_NS = time.perf_counter_ns()
 _MAX_SPANS = 200_000
@@ -61,6 +61,13 @@ def append_span(name, cat="host", ts_us=None, dur_us=0.0, tid=None,
     with _lock:
         _spans.append(rec)
     return rec
+
+
+def instant_event(name, cat="instant", **args):
+    """Zero-duration marker (recompile explained, decode admit/retire)
+    rendered as a Chrome instant ("i") event — a vertical tick on the
+    timeline rather than a bar. No-op when telemetry is disabled."""
+    return append_span(name, cat=cat, dur_us=0.0, args=args or None)
 
 
 class _Span:
@@ -163,8 +170,13 @@ def chrome_trace():
     tids = set()
     for s in spans:
         tids.add(s.tid)
-        ev = {"name": s.name, "cat": s.cat, "ph": "X", "ts": s.ts_us,
-              "dur": s.dur_us, "pid": pid, "tid": s.tid}
+        if s.cat == "instant":
+            ev = {"name": s.name, "cat": s.cat, "ph": "i",
+                  "ts": s.ts_us, "s": "t", "pid": pid, "tid": s.tid}
+        else:
+            ev = {"name": s.name, "cat": s.cat, "ph": "X",
+                  "ts": s.ts_us, "dur": s.dur_us, "pid": pid,
+                  "tid": s.tid}
         args = dict(s.args) if s.args else {}
         args["depth"] = s.depth
         ev["args"] = args

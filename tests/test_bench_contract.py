@@ -23,7 +23,9 @@ BENCH = os.path.join(REPO, "bench.py")
 def _env():
     env = dict(os.environ)
     env.update(JAX_PLATFORMS="cpu", BENCH_ONLY="mnist",
-               BENCH_TOTAL_BUDGET_S="120")
+               BENCH_TOTAL_BUDGET_S="120",
+               # keep test runs out of the committed perf spine
+               BENCH_HISTORY_PATH=os.devnull)
     env.pop("XLA_FLAGS", None)
     env.pop("PADDLE_TPU_TELEMETRY", None)
     return env
@@ -258,6 +260,46 @@ def test_async_off_paths_untouched():
                        cwd=REPO)
     assert p.returncode == 0, (p.stdout[-400:], p.stderr[-1200:])
     assert "ASYNC_OFF_OK" in p.stdout
+
+
+def test_attribution_off_paths_untouched():
+    """tpuscope's off contract (the PR-12 pin, same pattern as PRs
+    9/10/11): with PADDLE_TPU_TELEMETRY unset a training run never
+    imports telemetry.attribution or telemetry.slo (no cost_analysis,
+    no AOT lowering, no per-ckey registry growth), the Executor compile
+    key stays the historical 8-tuple, and the registry snapshot stays
+    empty. `import paddle_tpu.telemetry` itself must not pull either
+    module in (the lazy __getattr__ contract)."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n"
+        "from paddle_tpu import telemetry as tm\n"
+        "img = layers.data('img', shape=[8])\n"
+        "out = layers.reduce_mean(layers.fc(img, size=4))\n"
+        "exe = pt.Executor(pt.CPUPlace())\n"
+        "exe.run(pt.default_startup_program())\n"
+        "x = np.random.rand(2, 8).astype('float32')\n"
+        "for _ in range(3):\n"
+        "    exe.run(feed={'img': x}, fetch_list=[out])\n"
+        "assert 'paddle_tpu.telemetry.attribution' not in sys.modules,\\\n"
+        "    'telemetry-off run imported the attribution layer'\n"
+        "assert 'paddle_tpu.telemetry.slo' not in sys.modules\n"
+        "train_keys = [k for k in exe._cache\n"
+        "              if isinstance(k, tuple) and len(k) == 8]\n"
+        "assert len(train_keys) == len(exe._cache) == 2, \\\n"
+        "    list(exe._cache)\n"
+        "assert tm.snapshot() == {}\n"
+        "assert exe.last_recompile is None\n"
+        "print('ATTRIBUTION_OFF_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=REPO)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-1200:])
+    assert "ATTRIBUTION_OFF_OK" in p.stdout
 
 
 def test_resilience_off_checkpoint_forward_compatible(tmp_path):

@@ -23,12 +23,30 @@ pid per rank, clocks aligned on the shared barrier marker).
 their spool, and validates the whole path — the CI gate
 tests/test_fleet.py runs.
 
+SLO mode (--slo, "tpuscope"): evaluate declarative perf rules
+(telemetry.slo) against the run's snapshot — step_ms.p99 < X,
+perf.mfu > Y, serving.queue_depth < Z — plus a MAD-based regression
+gate of the newest BENCH_history.jsonl record per metric against its
+rolling median (same robust statistics as the fleet straggler
+detector). --rules takes a file (one rule per line, # comments) or an
+inline ';'-separated list; --history points at an alternate spine.
+--slo --selftest validates the whole layer in-process (rule parsing,
+live MFU/goodput gauges on a tiny model, an injected step-time
+regression that MUST be flagged) — the tier-1 CI gate.
+
+Watch mode (--watch N, with --fleet SPOOL_DIR): re-render the fleet
+table every N seconds with an MFU / goodput / step-budget header —
+a live top(1) over the telemetry spool.
+
 Examples:
   python tools/tpustat.py --model mnist --steps 20 --json
   python tools/tpustat.py --model resnet --steps 10 --prom
   python tools/tpustat.py --model mnist --platform env   # real backend
   python tools/tpustat.py --fleet /run/spool --trace fleet.json
   python tools/tpustat.py --fleet --selftest --json      # CI gate
+  python tools/tpustat.py --model mnist --slo --rules ci.rules
+  python tools/tpustat.py --slo --selftest --json        # CI gate
+  python tools/tpustat.py --fleet /run/spool --watch 5
 """
 import argparse
 import json
@@ -246,6 +264,7 @@ def _print_fleet_table(rep):
           f"(declared process_count {rep['process_count']}), "
           f"verdict: {strag.get('verdict', '?')}")
     hdr = (f"  {'rank':<5} {'host':<12} {'steps':>5} {'step_ms':>9} "
+           f"{'mfu%':>6} "
            f"{'coll#':>6} {'coll_KB':>8} {'bubble%':>8} "
            f"{'gs_raw_KB':>10} {'gs_wire_KB':>11} {'gs_x':>6} "
            f"{'emb_rows':>9} {'uniq%':>6} {'exch_KB':>8}  verdict")
@@ -256,9 +275,11 @@ def _print_fleet_table(rep):
         bubble = pr["bubble_fraction"]
         ratio = pr.get("gradsync_ratio")
         uniq = pr.get("embed_unique_ratio")
+        mfu = pr.get("mfu")
         print(f"  {r:<5} {str(pr.get('hostname') or '-')[:12]:<12} "
               f"{pr['steps']:>5} "
               f"{(mean * 1e3 if mean else 0):>9.2f} "
+              f"{(f'{mfu * 100:.1f}' if mfu else '-'):>6} "
               f"{pr['collective_calls']:>6} "
               f"{pr['collective_bytes'] / 1024:>8.1f} "
               f"{(bubble * 100 if bubble is not None else 0):>8.1f} "
@@ -454,6 +475,262 @@ def _fleet_selftest(as_json, trace_path):
     return 2 if problems else 0
 
 
+# ------------------------------------------------------------ slo / watch
+
+def _default_history_path():
+    return os.path.join(_REPO, "BENCH_history.jsonl")
+
+
+def _load_rules(rules_arg):
+    """--rules: a file of one rule per line (# comments) or an inline
+    ';'-separated list; default ruleset otherwise."""
+    from paddle_tpu.telemetry import slo
+    if not rules_arg:
+        return list(slo.DEFAULT_RULES)
+    if os.path.exists(rules_arg):
+        with open(rules_arg) as f:
+            lines = f.read().splitlines()
+    else:
+        lines = rules_arg.split(";")
+    return [ln.strip() for ln in lines
+            if ln.strip() and not ln.strip().startswith("#")]
+
+
+def _slo_gate(snap, rules_arg, history_path, platform=None):
+    """Evaluate rules against `snap` + regression-gate the history
+    spine. Returns (problems, detail_dict)."""
+    from paddle_tpu.telemetry import slo
+    problems = []
+    rules = _load_rules(rules_arg)
+    try:
+        report = slo.evaluate(rules, snap=snap)
+    except ValueError as e:
+        return [f"bad SLO rule: {e}"], {}
+    for r in report.violations:
+        problems.append(f"SLO violated: {r.rule.text} "
+                        f"(observed {r.observed:g})")
+    history_path = history_path or _default_history_path()
+    records = slo.load_history(history_path)
+    gate = slo.history_gate(records, platform=platform)
+    for reg in gate["regressions"]:
+        problems.append(
+            f"perf regression: {reg['metric']} = {reg['current']:g} "
+            f"vs rolling median {reg['median']:g} "
+            f"(threshold {reg['threshold']:g}, n={reg['n']})")
+    detail = {"slo": report.to_dict(),
+              "history": {"path": history_path,
+                          "records": len(records),
+                          "checked": gate["checked"],
+                          "regressions": gate["regressions"]}}
+    return problems, detail
+
+
+def _slo_selftest(as_json, history_path):
+    """tpustat --slo --selftest: validate the tpuscope layer end to end
+    in-process — live MFU/goodput gauges on a tiny model, rule parsing,
+    and the regression gate flagging an injected step-time regression.
+    Exit 0 iff everything holds — the tier-1 CI gate."""
+    import tempfile
+    problems = []
+
+    # 1) rule parsing round-trips (aliases, stats, operators)
+    from paddle_tpu.telemetry import slo
+    r = slo.parse_rule("step_ms.p99 < 250")
+    if (r.metric, r.stat, r.scale, r.threshold) != \
+            ("executor.step_seconds", "p99", 1e3, 250.0):
+        problems.append(f"rule parse wrong: {r.metric}/{r.stat}/"
+                        f"{r.scale}/{r.threshold}")
+    r = slo.parse_rule("perf.mfu > 0.3")
+    if (r.metric, r.stat) != ("perf.mfu", "value"):
+        problems.append(f"dotted metric parse wrong: "
+                        f"{r.metric}/{r.stat}")
+    try:
+        slo.parse_rule("nonsense ~ 3")
+        problems.append("bad rule did not raise")
+    except ValueError:
+        pass
+
+    # 2) live gauges: a tiny training loop must produce perf.mfu > 0
+    # (synthetic peak: CPU has no table entry) and pass generous rules
+    os.environ["PADDLE_TPU_PEAK_FLOPS"] = "1e12"
+    try:
+        import numpy as np
+        import paddle_tpu as fluid
+        from paddle_tpu import layers, telemetry
+        telemetry.enable()
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup_p):
+            with fluid.unique_name.guard():
+                x = layers.data("x", shape=[8])
+                y = layers.data("y", shape=[4])
+                pred = layers.fc(x, size=4)
+                loss = layers.mean(
+                    layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup_p, feed={}, fetch_list=[])
+        telemetry.reset()
+        rng = np.random.RandomState(0)
+        for _ in range(6):
+            feed = {"x": rng.randn(8, 8).astype("float32"),
+                    "y": rng.randn(8, 4).astype("float32")}
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+        snap = telemetry.snapshot()
+        live = slo.evaluate(list(slo.DEFAULT_RULES)
+                            + ["perf.mfu > 0",
+                               "perf.goodput.examples_per_s > 0",
+                               "executor.steps >= 5"], snap=snap)
+        if not live.ok:
+            problems.append("live rules failed:\n" + str(live))
+        mfu = snap.get("perf.mfu")
+        if not mfu or mfu <= 0:
+            problems.append(f"perf.mfu gauge not live: {mfu}")
+    finally:
+        os.environ.pop("PADDLE_TPU_PEAK_FLOPS", None)
+
+    # 3) the regression gate MUST flag injected regressions and MUST
+    # pass a clean series (both directions)
+    if not slo.check_regression([10.0] * 8, 100.0,
+                                direction="lower")["regressed"]:
+        problems.append("injected step-time regression not flagged")
+    if not slo.check_regression([1000.0] * 8, 100.0,
+                                direction="higher")["regressed"]:
+        problems.append("injected throughput regression not flagged")
+    if slo.check_regression([10.0, 10.1, 9.9, 10.0, 10.2], 10.1,
+                            direction="lower")["regressed"]:
+        problems.append("clean step-time series falsely flagged")
+
+    # 4) history spine: append/load round-trip + end-to-end gate over
+    # a file with one injected step-time regression
+    with tempfile.TemporaryDirectory(prefix="tpuslo_") as td:
+        hist = os.path.join(td, "hist.jsonl")
+        base = {"schema": slo.HISTORY_SCHEMA, "platform": "cpu",
+                "unit": "ms", "stage": "deepfm"}
+        recs = [dict(base, metric="deepfm_step_ms", value=10.0 + 0.01 * i)
+                for i in range(8)]
+        recs.append(dict(base, metric="deepfm_step_ms", value=100.0))
+        slo.append_history(hist, recs)
+        loaded = slo.load_history(hist)
+        if len(loaded) != len(recs):
+            problems.append(f"history round-trip lost records: "
+                            f"{len(loaded)} != {len(recs)}")
+        gate = slo.history_gate(loaded)
+        if gate["ok"] or not any(
+                g["metric"] == "deepfm_step_ms"
+                for g in gate["regressions"]):
+            problems.append(
+                f"history gate missed the injected step-time "
+                f"regression: {gate}")
+        # clean spine passes
+        clean = [dict(base, metric="deepfm_step_ms",
+                      value=10.0 + 0.01 * i) for i in range(9)]
+        if not slo.history_gate(clean)["ok"]:
+            problems.append("history gate flagged a clean series")
+
+    result = {"selftest": "slo", "problems": problems,
+              "ok": not problems}
+    if as_json:
+        print(json.dumps(result, default=str))
+    else:
+        for prob in problems:
+            print(f"SELFTEST FAIL: {prob}", file=sys.stderr)
+        if not problems:
+            print("slo selftest OK")
+    return 2 if problems else 0
+
+
+_BUDGET_HISTS = (
+    ("feed_put", "executor.feed_put_seconds"),
+    ("dispatch", "executor.step_seconds"),
+    ("stall", "executor.pending_wait_seconds"),
+    ("readback", "executor.fetch_readback_seconds"),
+    ("check", "executor.finite_check_seconds"),
+)
+
+
+def _merged_value(merged, name):
+    ent = merged.get(name)
+    return ent.get("value") if isinstance(ent, dict) else None
+
+
+def _watch_header(rep):
+    """The mfu / goodput / step-budget summary lines above the fleet
+    table in --watch mode."""
+    from paddle_tpu.telemetry import registry
+    merged = rep.get("merged", {})
+    mfus = [pr["mfu"] for pr in rep.get("per_rank", {}).values()
+            if pr.get("mfu")]
+    goodput = [pr["goodput_examples_per_s"]
+               for pr in rep.get("per_rank", {}).values()
+               if pr.get("goodput_examples_per_s")]
+    step_h = _merged_value(merged, "executor.step_seconds")
+    p99 = registry.quantile_from_buckets(step_h, 0.99) \
+        if isinstance(step_h, dict) else None
+    lines = [
+        "  mfu: " + (f"{sum(mfus) / len(mfus) * 100:.1f}% (mean of "
+                     f"{len(mfus)} ranks)" if mfus else "n/a")
+        + "   goodput: "
+        + (f"{sum(goodput):.1f} examples/s" if goodput else "n/a")
+        + "   step p99: "
+        + (f"{p99 * 1e3:.2f} ms" if p99 else "n/a")]
+    sums = []
+    for label, name in _BUDGET_HISTS:
+        v = _merged_value(merged, name)
+        sums.append((label, float(v.get("sum", 0.0))
+                     if isinstance(v, dict) else 0.0))
+    total = sum(s for _, s in sums)
+    if total > 0:
+        width = 24
+        parts = []
+        for label, s in sums:
+            if s <= 0:
+                continue
+            bar = "#" * max(1, round(s / total * width))
+            parts.append(f"{label} {s / total * 100:4.1f}% {bar}")
+        lines.append("  step budget: " + "  ".join(parts))
+    return "\n".join(lines)
+
+
+def _watch(spool, interval, iterations, as_json):
+    """tpustat --fleet SPOOL --watch N: re-render the fleet view every
+    N seconds. `iterations` bounds the loop (None = forever)."""
+    import time as _time
+    from paddle_tpu.telemetry import fleet as tfleet
+    i = 0
+    while True:
+        coll = tfleet.FleetCollector()
+        err = None
+        rep = None
+        try:
+            coll.collect(spool)
+            rep = coll.report()
+        except (OSError, ValueError) as e:
+            err = f"{type(e).__name__}: {e}"
+        if as_json:
+            out = {"iteration": i, "ok": err is None}
+            if rep:
+                out["ranks"] = rep["ranks"]
+                out["per_rank"] = rep["per_rank"]
+            if err:
+                out["error"] = err
+            print(json.dumps(out, default=str), flush=True)
+        else:
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+            print(f"tpustat --watch (every {interval:g}s, "
+                  f"iteration {i})")
+            if err:
+                print(f"  spool not readable yet: {err}")
+            else:
+                print(_watch_header(rep))
+                _print_fleet_table(rep)
+            sys.stdout.flush()
+        i += 1
+        if iterations is not None and i >= iterations:
+            return 0
+        _time.sleep(interval)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="runtime telemetry over a benchmark model")
@@ -488,8 +765,25 @@ def main(argv=None):
                         "straggler verdict (--trace writes the "
                         "stitched multi-rank timeline)")
     p.add_argument("--selftest", action="store_true",
-                   help="with --fleet: spawn 2 local workers, merge "
-                        "their spool, validate everything (CI gate)")
+                   help="with --fleet or --slo: validate the layer "
+                        "end to end (CI gate)")
+    p.add_argument("--slo", action="store_true",
+                   help="evaluate SLO rules against the run's metrics "
+                        "and regression-gate BENCH_history.jsonl; "
+                        "exit 2 on violation (tpuscope)")
+    p.add_argument("--rules", default=None,
+                   help="SLO rules: a file (one per line, # comments) "
+                        "or an inline ';'-separated list; default: "
+                        "telemetry.slo.DEFAULT_RULES")
+    p.add_argument("--history", default=None, metavar="PATH",
+                   help="perf-history spine for the --slo regression "
+                        "gate (default <repo>/BENCH_history.jsonl)")
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="with --fleet SPOOL_DIR: re-render the fleet "
+                        "view every N seconds (mfu / goodput / step "
+                        "budget header)")
+    p.add_argument("--watch-iterations", type=int, default=None,
+                   help=argparse.SUPPRESS)
     p.add_argument("--fleet-worker", type=int, default=None,
                    help=argparse.SUPPRESS)
     p.add_argument("--spool", default=None, help=argparse.SUPPRESS)
@@ -500,14 +794,20 @@ def main(argv=None):
 
     if args.fleet_worker is not None:
         return _fleet_worker(args.fleet_worker, args.spool)
-    if args.selftest and args.fleet is None:
-        p.error("--selftest is a fleet-mode flag; use --fleet "
-                "--selftest")
+    if args.selftest and args.fleet is None and not args.slo:
+        p.error("--selftest needs --fleet or --slo")
+    if args.slo and args.selftest:
+        return _slo_selftest(args.as_json, args.history)
+    if args.watch is not None and args.fleet in (None, ""):
+        p.error("--watch needs --fleet SPOOL_DIR")
     if args.fleet is not None:
         if args.selftest:
             return _fleet_selftest(args.as_json, args.trace)
         if not args.fleet:
             p.error("--fleet needs a SPOOL_DIR (or --selftest)")
+        if args.watch is not None:
+            return _watch(args.fleet, args.watch,
+                          args.watch_iterations, args.as_json)
         return _fleet_report(args.fleet, args.as_json, args.trace)
 
     import numpy as np
@@ -587,6 +887,13 @@ def main(argv=None):
     # compile time — see executor.run / InferenceEngine._get_fn)
     signatures = int(max(snap.get("executor.signature_count", 0),
                          snap.get("inference.signature_count", 0)))
+    slo_detail = None
+    if args.slo:
+        slo_problems, slo_detail = _slo_gate(
+            snap, args.rules, args.history,
+            platform=jax.devices()[0].platform)
+        problems += slo_problems
+
     from paddle_tpu import diagnostics
     diag = diagnostics.status()
     result = {
@@ -606,6 +913,8 @@ def main(argv=None):
     }
     if device_profile is not None:
         result["device_profile"] = device_profile
+    if slo_detail is not None:
+        result["slo"] = slo_detail
 
     if args.as_json:
         print(json.dumps(result, default=str))
