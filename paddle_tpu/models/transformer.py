@@ -353,11 +353,35 @@ class IncrementalDecoder:
     layouts accepted). Sampling: ``topk=0`` (default) is greedy argmax;
     ``topk=k`` draws from the top-k logits at ``temperature`` using the
     per-step ``seed`` fed to `step` (in-graph, still one executable).
+
+    Replica-serving extensions (all default-off; the single-engine
+    path is byte-identical without them — pinned by the bench
+    contract):
+
+    - ``device``: pin params + slot state to one jax device. The
+      jitted functions follow their committed inputs, so N decoders
+      on N devices share *traces* but get per-device executables —
+      how `serving.farm` places replicas on disjoint mesh slices.
+    - ``kv_quant="int8"``: store the self-attn caches as int8 codes +
+      fp32 absmax scales over ``kv_block``-wide blocks of the head
+      dim (gradsync's wire format, imported lazily so the fp32 path
+      never loads it), dequantized in-graph at attention time.
+      Cross-attn caches stay fp32 (written once per request, read
+      every step — quantizing them buys little and costs parity).
+    - ``build_cache``: an object with ``get_or_build(key, build) ->
+      (fn, built)`` (e.g. `serving.farm.SharedBuildCache`) shared by
+      same-config replicas so each (bucket, step) traces once per
+      group; `compile_count` then counts only the builds THIS decoder
+      performed.
+    - ``return_logits``: the step also returns the pre-sampling
+      [S, V] logits, stashed on ``last_logits`` — parity tests report
+      max logit deltas without a second executable shape.
     """
 
     def __init__(self, cfg, params, num_slots, max_len=None,
-                 src_max_len=None, topk=0, temperature=1.0):
-        import jax.numpy as jnp
+                 src_max_len=None, topk=0, temperature=1.0,
+                 device=None, kv_quant=None, kv_block=None,
+                 build_cache=None, return_logits=False):
         self.cfg = cfg
         self.num_slots = int(num_slots)
         self.max_len = int(max_len or cfg.max_len)
@@ -366,11 +390,53 @@ class IncrementalDecoder:
             raise ValueError("need num_slots >= 1 and max_len >= 2")
         self.topk = int(topk)
         self.temperature = float(temperature)
-        self.params = {k: jnp.asarray(np.asarray(v))
+        self.device = device
+        if kv_quant in ("", "fp32", "none"):
+            kv_quant = None
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant={kv_quant!r} not in "
+                             f"(None, 'int8')")
+        self.kv_quant = kv_quant
+        Dh = cfg.d_model // cfg.n_head
+        self.kv_block = int(kv_block or Dh)
+        if self.kv_quant and (self.kv_block < 1
+                              or Dh % self.kv_block != 0):
+            raise ValueError(
+                f"kv_block={self.kv_block} must divide the head dim "
+                f"{Dh} so scales broadcast over whole blocks")
+        self.return_logits = bool(return_logits)
+        self.last_logits = None         # [S, V] after step() when opted in
+        self._build_cache = build_cache
+        self.params = {k: self._put(v)
                        for k, v in decode_params(params, cfg).items()}
         self._prefill_jit = {}          # rows -> jitted prefill
         self._step_jit = None
         self.compile_count = 0          # executables built (pinned)
+
+    def _put(self, x):
+        """Array onto this decoder's device (committed) or the default
+        (uncommitted — jax places it; the pre-farm behavior)."""
+        import jax
+        import jax.numpy as jnp
+        if self.device is None:
+            return jnp.asarray(np.asarray(x))
+        return jax.device_put(np.asarray(x), self.device)
+
+    def load_params(self, arrays):
+        """Swap in a new parameter set UNDER the compiled executables
+        (rolling weight update). Shapes must match the serving set —
+        same shapes mean the existing prefill/step executables keep
+        running with zero recompiles, which is what lets a replica
+        flip versions inside one drain window."""
+        new = decode_params(arrays, self.cfg)
+        for k, old in self.params.items():
+            shp = tuple(np.asarray(new[k]).shape)
+            if shp != tuple(old.shape):
+                raise ValueError(
+                    f"rolling update changed the shape of {k}: "
+                    f"{tuple(old.shape)} -> {shp}; weight updates "
+                    f"must keep the serving architecture")
+        self.params = {k: self._put(v) for k, v in new.items()}
 
     # ---------------------------------------------------------- state
     @property
@@ -380,19 +446,54 @@ class IncrementalDecoder:
 
     def init_state(self):
         """Fresh device-resident slot state (all slots free/garbage).
-        Keys: kc/vc [L,S,T,H,Dh] self-attn caches, ck/cv [L,S,Ts,H,Dh]
-        cross-attn caches, src_bias [S,1,1,Ts]."""
+        Keys: kc/vc [L,S,T,H,Dh] self-attn caches (or, with
+        kv_quant="int8", kc_q/vc_q int8 codes + kc_s/vc_s fp32 absmax
+        scales [L,S,T,H,Dh/kv_block]), ck/cv [L,S,Ts,H,Dh] cross-attn
+        caches, src_bias [S,1,1,Ts]."""
+        import jax
         import jax.numpy as jnp
         cfg = self.cfg
         L, S = cfg.n_layer, self.num_slots
         H, Dh = cfg.n_head, cfg.d_model // cfg.n_head
         T, Ts = self.max_len, self.src_max_len
         z = jnp.zeros
-        return {"kc": z((L, S, T, H, Dh), jnp.float32),
-                "vc": z((L, S, T, H, Dh), jnp.float32),
-                "ck": z((L, S, Ts, H, Dh), jnp.float32),
-                "cv": z((L, S, Ts, H, Dh), jnp.float32),
-                "src_bias": z((S, 1, 1, Ts), jnp.float32)}
+        if self.kv_quant == "int8":
+            nb = Dh // self.kv_block
+            state = {"kc_q": z((L, S, T, H, Dh), jnp.int8),
+                     "kc_s": z((L, S, T, H, nb), jnp.float32),
+                     "vc_q": z((L, S, T, H, Dh), jnp.int8),
+                     "vc_s": z((L, S, T, H, nb), jnp.float32),
+                     "ck": z((L, S, Ts, H, Dh), jnp.float32),
+                     "cv": z((L, S, Ts, H, Dh), jnp.float32),
+                     "src_bias": z((S, 1, 1, Ts), jnp.float32)}
+        else:
+            state = {"kc": z((L, S, T, H, Dh), jnp.float32),
+                     "vc": z((L, S, T, H, Dh), jnp.float32),
+                     "ck": z((L, S, Ts, H, Dh), jnp.float32),
+                     "cv": z((L, S, Ts, H, Dh), jnp.float32),
+                     "src_bias": z((S, 1, 1, Ts), jnp.float32)}
+        if self.device is not None:
+            state = {k: jax.device_put(v, self.device)
+                     for k, v in state.items()}
+        return state
+
+    def kv_cache_bytes(self):
+        """Analytic slot-state footprint in bytes (self-attn codes +
+        scales, cross-attn caches, src bias) — the per-replica
+        capacity number behind tpustat's KV column and the
+        slots-per-device bench curve; int8 shrinks the self-attn term
+        ~4x (codes) minus the scale overhead."""
+        cfg = self.cfg
+        L, S = cfg.n_layer, self.num_slots
+        H, Dh = cfg.n_head, cfg.d_model // cfg.n_head
+        T, Ts = self.max_len, self.src_max_len
+        n_self = L * S * T * H * Dh
+        if self.kv_quant == "int8":
+            self_b = 2 * (n_self + (n_self // self.kv_block) * 4)
+        else:
+            self_b = 2 * n_self * 4
+        cross_b = 2 * L * S * Ts * H * Dh * 4
+        return self_b + cross_b + S * Ts * 4
 
     # ------------------------------------------------------- math core
     @staticmethod
@@ -499,8 +600,41 @@ class IncrementalDecoder:
         sqrt_d = float(np.sqrt(D))
         topk, temp = self.topk, self.temperature
         fc, ln = self._fc, self._ln
+        quant = self.kv_quant == "int8"
+        ret_logits = self.return_logits
+        B = self.kv_block
 
-        def step(p, kc, vc, ck, cv, src_bias, ids, pos, seed):
+        if quant:
+            # the int8 KV path is the ONLY importer of gradsync here:
+            # fp32 decode must not load the collective machinery
+            # (lazily-imported pin in tests/test_bench_contract.py)
+            from ..parallel.gradsync import quantize_int8_blockwise
+
+            def cache_write(c, i, rows, pos, new):
+                # new [S,H,Dh] -> int8 codes + per-block absmax scales
+                # (gradsync's wire format, block = kv_block head lanes)
+                cq, cs = c
+                q8, sc = quantize_int8_blockwise(new.reshape(-1),
+                                                 block_size=B)
+                return (cq.at[i, rows, pos].set(q8.reshape(S, H, Dh)),
+                        cs.at[i, rows, pos].set(
+                            sc.reshape(S, H, Dh // B)))
+
+            def cache_read(c, i):
+                # dequantize in-graph at attention time: codes * scale
+                # broadcast over each block -> fp32 [S,T,H,Dh]
+                cq, cs = c
+                f = cq[i].astype(jnp.float32).reshape(
+                    S, T, H, Dh // B, B) * cs[i][..., None]
+                return f.reshape(S, T, H, Dh)
+        else:
+            def cache_write(c, i, rows, pos, new):
+                return (c[0].at[i, rows, pos].set(new),)
+
+            def cache_read(c, i):
+                return c[0][i]
+
+        def body(p, kcache, vcache, ck, cv, src_bias, ids, pos, seed):
             rows = jnp.arange(S)
             x = jnp.take(p["trg_emb.w_0"],
                          jnp.clip(ids.astype(jnp.int32), 0, V - 1),
@@ -514,13 +648,15 @@ class IncrementalDecoder:
                 q = fc(x, p[f"dec{i}_self_q.w_0"]).reshape(S, 1, H, Dh)
                 kn = fc(x, p[f"dec{i}_self_k.w_0"]).reshape(S, H, Dh)
                 vn = fc(x, p[f"dec{i}_self_v.w_0"]).reshape(S, H, Dh)
-                kc = kc.at[i, rows, pos].set(kn)
-                vc = vc.at[i, rows, pos].set(vn)
-                logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc[i]).astype(
+                kcache = cache_write(kcache, i, rows, pos, kn)
+                vcache = cache_write(vcache, i, rows, pos, vn)
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q,
+                                    cache_read(kcache, i)).astype(
                     jnp.float32) * jnp.asarray(scale, jnp.float32)
                 logits = jnp.where(keep, logits, -jnp.inf)
                 w = _attn_softmax(logits).astype(x.dtype)
-                o = jnp.einsum("bhqk,bkhd->bqhd", w, vc[i]).reshape(
+                o = jnp.einsum("bhqk,bkhd->bqhd", w,
+                               cache_read(vcache, i)).reshape(
                     S, H * Dh)
                 x = ln(fc(o, p[f"dec{i}_self_o.w_0"]) + res,
                        p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'self')}.w_0"],
@@ -555,11 +691,58 @@ class IncrementalDecoder:
                     cand, choice[:, None], axis=-1)[:, 0]
             else:
                 nxt = jnp.argmax(logits, axis=-1)
-            return kc, vc, nxt.astype(jnp.int32)
+            return (kcache, vcache, nxt.astype(jnp.int32),
+                    logits.astype(jnp.float32))
 
-        import jax as _jax
-        donate = () if _jax.default_backend() == "cpu" else (1, 2)
-        return _jax.jit(step, donate_argnums=donate)
+        # flat signatures so donation sees individual cache buffers;
+        # donating the caches on accelerators keeps the update in
+        # place (CPU can't donate — jax warns and copies)
+        cpu = jax.default_backend() == "cpu"
+        if quant:
+            def step(p, kc_q, kc_s, vc_q, vc_s, ck, cv, src_bias,
+                     ids, pos, seed):
+                kcache, vcache, nxt, lg = body(
+                    p, (kc_q, kc_s), (vc_q, vc_s), ck, cv, src_bias,
+                    ids, pos, seed)
+                out = kcache + vcache + (nxt,)
+                return out + (lg,) if ret_logits else out
+            donate = () if cpu else (1, 2, 3, 4)
+        else:
+            def step(p, kc, vc, ck, cv, src_bias, ids, pos, seed):
+                kcache, vcache, nxt, lg = body(
+                    p, (kc,), (vc,), ck, cv, src_bias, ids, pos, seed)
+                out = kcache + vcache + (nxt,)
+                return out + (lg,) if ret_logits else out
+            donate = () if cpu else (1, 2)
+        return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------- compile sharing
+    def _build_key(self, kind, rows=None):
+        """Structural identity of a jitted function — everything its
+        closure bakes in. Two decoders with equal keys can share the
+        trace (jax still specializes executables per device placement
+        under the hood); params are runtime args, so the key excludes
+        them and rolling updates never re-key."""
+        cfg = self.cfg
+        if kind == "prefill":
+            return ("prefill", cfg.src_vocab, cfg.d_model, cfg.n_head,
+                    cfg.n_layer, self.src_max_len, int(rows))
+        return ("step", cfg.trg_vocab, cfg.d_model, cfg.n_head,
+                cfg.n_layer, self.num_slots, self.max_len,
+                self.src_max_len, self.topk, self.temperature,
+                self.kv_quant, self.kv_block, self.return_logits)
+
+    def _get_or_build(self, kind, rows=None):
+        build = (lambda: self._build_prefill(rows)) \
+            if kind == "prefill" else self._build_step
+        if self._build_cache is None:
+            self.compile_count += 1
+            return build()
+        fn, built = self._build_cache.get_or_build(
+            self._build_key(kind, rows), build)
+        if built:
+            self.compile_count += 1
+        return fn
 
     # --------------------------------------------------------- running
     def prefill(self, src, src_len):
@@ -575,9 +758,8 @@ class IncrementalDecoder:
                              f"src_max_len={self.src_max_len}")
         fn = self._prefill_jit.get(rows)
         if fn is None:
-            fn = self._build_prefill(rows)
+            fn = self._get_or_build("prefill", rows)
             self._prefill_jit[rows] = fn
-            self.compile_count += 1
         return fn(self.params, jnp.asarray(src.astype(np.int32)),
                   jnp.asarray(np.asarray(src_len).astype(np.int32)))
 
@@ -602,13 +784,22 @@ class IncrementalDecoder:
         compiled executable."""
         import jax.numpy as jnp
         if self._step_jit is None:
-            self._step_jit = self._build_step()
-            self.compile_count += 1
-        kc, vc, nxt = self._step_jit(
-            self.params, state["kc"], state["vc"], state["ck"],
-            state["cv"], state["src_bias"],
-            jnp.asarray(np.asarray(ids, np.int32)),
-            jnp.asarray(np.asarray(pos, np.int32)),
-            jnp.asarray(np.uint32(seed)))
-        state["kc"], state["vc"] = kc, vc
+            self._step_jit = self._get_or_build("step")
+        feed = (jnp.asarray(np.asarray(ids, np.int32)),
+                jnp.asarray(np.asarray(pos, np.int32)),
+                jnp.asarray(np.uint32(seed)))
+        if self.kv_quant == "int8":
+            out = self._step_jit(
+                self.params, state["kc_q"], state["kc_s"],
+                state["vc_q"], state["vc_s"], state["ck"],
+                state["cv"], state["src_bias"], *feed)
+            (state["kc_q"], state["kc_s"], state["vc_q"],
+             state["vc_s"], nxt) = out[:5]
+        else:
+            out = self._step_jit(
+                self.params, state["kc"], state["vc"], state["ck"],
+                state["cv"], state["src_bias"], *feed)
+            state["kc"], state["vc"], nxt = out[:3]
+        if self.return_logits:
+            self.last_logits = np.asarray(out[-1])
         return np.asarray(nxt)

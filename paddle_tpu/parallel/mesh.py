@@ -17,8 +17,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
-__all__ = ["make_mesh", "local_mesh", "axis_size", "P", "NamedSharding",
-           "Mesh"]
+__all__ = ["make_mesh", "local_mesh", "axis_size", "device_slices",
+           "P", "NamedSharding", "Mesh"]
 
 P = PartitionSpec
 
@@ -48,3 +48,40 @@ def local_mesh(axis="dp", devices=None):
 
 def axis_size(mesh, name):
     return mesh.shape[name] if name in mesh.shape else 1
+
+
+def device_slices(n, devices=None, reserve=0):
+    """Partition the local devices into `reserve` dedicated head
+    devices plus `n` DISJOINT contiguous slices — the placement
+    primitive of serving replica groups (`serving.farm`): each decode
+    replica owns one slice, the reserved heads carry disaggregated
+    prefill executables.
+
+    Contiguity matters for the same reason make_mesh puts tp/sp
+    innermost: a replica's devices stay ICI neighbors, so any future
+    intra-replica sharding rides the fastest links. Returns
+    ``(reserved, slices)`` with ``len(slices) == n``.
+
+    When there are fewer devices than ``reserve + n`` the slices wrap
+    around and SHARE devices (single-device CPU fallback — every
+    "slice" aliases the same physical device; placement becomes a
+    no-op but the replica topology still exercises end-to-end).
+    Leftover devices after an even split are appended to the last
+    slice rather than idling."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n < 1 or reserve < 0:
+        raise ValueError(f"need n >= 1 slices (got {n}) and "
+                         f"reserve >= 0 (got {reserve})")
+    if not devices:
+        raise ValueError("no devices to slice")
+    if len(devices) < reserve + n:        # wrap-around sharing
+        reserved = [devices[i % len(devices)] for i in range(reserve)]
+        slices = [[devices[(reserve + i) % len(devices)]]
+                  for i in range(n)]
+        return reserved, slices
+    reserved = devices[:reserve]
+    rest = devices[reserve:]
+    per = len(rest) // n
+    slices = [rest[i * per:(i + 1) * per] for i in range(n)]
+    slices[-1].extend(rest[n * per:])
+    return reserved, slices

@@ -37,9 +37,13 @@ Faults and their injection points:
       retry engine should absorb ``times`` consecutive ones).
   ``barrier_fail:at=N[,times=K]``
       point ``fleet.barrier`` — transient barrier failure.
-  ``worker_crash:at=N[,times=K]``
-      point ``serving.worker`` — kill a ModelServer worker thread
-      (the server must restart it; see serving.worker_restarts).
+  ``worker_crash:at=N[,times=K][,replica=R]``
+      point ``serving.worker`` — kill a ModelServer worker thread or
+      a decode-scheduler loop (the supervisor must respawn it; see
+      serving.worker_restarts). ``replica=R`` restricts the fault to
+      the serving-farm replica whose scheduler carries that index
+      (hits from other loops don't advance this fault's counter), so
+      a group test can deterministically down ONE replica of N.
   ``rank_lost[:rank=R,at=N][,mode=raise|kill]``
       point ``executor.step`` — rank R disappears at step hit N:
       raise RankLostFault (an ElasticFault the Guardian escalates to
@@ -90,7 +94,7 @@ POINTS = {
 }
 
 _INT_KNOBS = ("at", "times", "every", "byte", "seed", "step", "rank",
-              "to")
+              "to", "replica")
 _FLOAT_KNOBS = ("prob", "ms")
 
 
@@ -287,6 +291,9 @@ def hit(point, **ctx):
             if f["point"] != point:
                 continue
             if f.get("op") is not None and ctx.get("op") != f["op"]:
+                continue
+            if f.get("replica") is not None \
+                    and ctx.get("replica") != f["replica"]:
                 continue
             n = f["_n"] = f.get("_n", 0) + 1
             if fired is None and _matches(f, n):

@@ -39,10 +39,14 @@ class DecodeEngineConfig:
     prefill_buckets: admitted-row buckets (default: powers of two up
         to num_slots).
     topk / temperature: in-graph sampling (0 = greedy argmax).
+    kv_quant / kv_block: opt-in int8 block-quantized self-attn KV
+        cache (None keeps fp32 — the byte-identical default); block
+        defaults to the head dim.
     """
 
     def __init__(self, num_slots=8, max_len=None, src_max_len=None,
-                 prefill_buckets=None, topk=0, temperature=1.0):
+                 prefill_buckets=None, topk=0, temperature=1.0,
+                 kv_quant=None, kv_block=None):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.src_max_len = src_max_len
@@ -56,33 +60,67 @@ class DecodeEngineConfig:
                 f"must fit one prefill")
         self.topk = int(topk)
         self.temperature = float(temperature)
+        self.kv_quant = kv_quant
+        self.kv_block = kv_block
 
 
 class DecodeEngine:
-    """Compiled continuous-decode executables for one transformer."""
+    """Compiled continuous-decode executables for one transformer.
 
-    def __init__(self, model_cfg, params, config=None):
+    Replica-serving knobs (all default-off, single-engine path
+    unchanged): ``device`` pins the decode executables + slot state to
+    one device (a farm replica's slice primary); ``prefill_device``
+    DISAGGREGATES prefill — a second decoder on a dedicated device
+    runs the encoder executables and its KV output is handed
+    device-to-device into this engine's slot pool (`jax.device_put`:
+    ICI/DMA on TPU, a host copy fallback on CPU), so long-prompt
+    prefills stop stalling the token loop's device; ``build_cache``
+    shares jit traces across same-config replicas."""
+
+    def __init__(self, model_cfg, params, config=None, device=None,
+                 prefill_device=None, build_cache=None):
         from ...models.transformer import IncrementalDecoder
         self.config = config or DecodeEngineConfig()
         self.model_cfg = model_cfg
+        self.device = device
         self.decoder = IncrementalDecoder(
             model_cfg, params,
             num_slots=self.config.num_slots,
             max_len=self.config.max_len,
             src_max_len=self.config.src_max_len,
             topk=self.config.topk,
-            temperature=self.config.temperature)
+            temperature=self.config.temperature,
+            device=device,
+            kv_quant=self.config.kv_quant,
+            kv_block=self.config.kv_block,
+            build_cache=build_cache)
+        self.prefill_decoder = None
+        if prefill_device is not None:
+            # prefill never touches the decode-side KV cache, so the
+            # prefill worker stays fp32 regardless of kv_quant; it
+            # shares the build cache (prefill keys exclude step-only
+            # knobs, so pooled and disaggregated replicas share the
+            # same encoder traces)
+            self.prefill_decoder = IncrementalDecoder(
+                model_cfg, params,
+                num_slots=self.config.num_slots,
+                max_len=self.config.max_len,
+                src_max_len=self.config.src_max_len,
+                device=prefill_device,
+                build_cache=build_cache)
 
     # ----------------------------------------------------- constructors
     @classmethod
-    def from_inference_engine(cls, engine, model_cfg, config=None):
+    def from_inference_engine(cls, engine, model_cfg, config=None,
+                              **kw):
         """Share a served `InferenceEngine`'s parameters (same arrays,
         no copy): the prefill/step executables and the full-program
         predict path serve one checkpoint."""
-        return cls(model_cfg, engine.params(), config=config)
+        return cls(model_cfg, engine.params(), config=config, **kw)
 
     @classmethod
-    def from_scope(cls, scope, model_cfg, config=None, names=None):
+    def from_scope(cls, scope, model_cfg, config=None, names=None,
+                   **kw):
         """Pull parameters out of a training/infer scope by name
         (`names` defaults to every var the scope can produce for the
         decode set — see `models.transformer.decode_params`)."""
@@ -97,7 +135,7 @@ class DecodeEngine:
         else:
             arrays = {n: np.asarray(scope.get(n)) for n in names}
         return cls(model_cfg, decode_params(arrays, model_cfg),
-                   config=config)
+                   config=config, **kw)
 
     # ------------------------------------------------------- properties
     @property
@@ -114,20 +152,42 @@ class DecodeEngine:
 
     @property
     def compile_count(self):
-        return self.decoder.compile_count
+        n = self.decoder.compile_count
+        if self.prefill_decoder is not None:
+            n += self.prefill_decoder.compile_count
+        return n
+
+    @property
+    def kv_cache_bytes(self):
+        """Slot-state footprint (see IncrementalDecoder.kv_cache_bytes)."""
+        return self.decoder.kv_cache_bytes()
 
     # -------------------------------------------------------- lifecycle
     def init_state(self):
         return self.decoder.init_state()
 
+    def set_params(self, arrays):
+        """Rolling weight update: swap the parameter set under the
+        compiled executables (shapes must match -> zero recompile).
+        Covers the disaggregated prefill decoder too, atomically from
+        the caller's point of view — the replica is drained while this
+        runs, so no request sees mixed versions."""
+        self.decoder.load_params(arrays)
+        if self.prefill_decoder is not None:
+            self.prefill_decoder.load_params(arrays)
+
     def warmup(self):
         """Compile every prefill bucket + the step on zero feeds.
-        Returns the executable count (== len(prefill_buckets) + 1)."""
+        Returns the executable count (== len(prefill_buckets) + 1 when
+        this engine built everything itself; shared build caches and
+        disaggregation split the count across decoders but the sum is
+        pinned at the group level)."""
+        pf = self.prefill_decoder or self.decoder
         Ts = self.decoder.src_max_len
         for b in self.config.prefill_buckets:
             with _tm.span("serving.decode.warmup", bucket=b):
-                self.decoder.prefill(np.zeros((b, Ts), np.int64),
-                                     np.ones((b,), np.int64))
+                pf.prefill(np.zeros((b, Ts), np.int64),
+                           np.ones((b,), np.int64))
             if _tm.enabled():
                 _tm.counter("serving.decode.warmup_runs").inc()
         state = self.init_state()
@@ -137,13 +197,18 @@ class DecodeEngine:
         if _tm.enabled():
             _tm.gauge("serving.decode.compile_count").set(
                 self.compile_count)
+            _tm.gauge("serving.decode.kv_cache_bytes").set(
+                self.kv_cache_bytes)
         return self.compile_count
 
     # ---------------------------------------------------------- serving
     def admit(self, state, requests, slots):
         """Prefill `requests` (same count as `slots`) and scatter the
         encoder caches into their slot rows. Rows are padded to the
-        next prefill bucket so the jit cache sees only bucket shapes."""
+        next prefill bucket so the jit cache sees only bucket shapes.
+        With a disaggregated prefill decoder, the encoder runs on its
+        dedicated device and the KV output is handed off to the decode
+        device before the scatter."""
         n = len(requests)
         Ts = self.decoder.src_max_len
         bucket = next_bucket(n, self.config.prefill_buckets)
@@ -153,8 +218,11 @@ class DecodeEngine:
             s = np.asarray(r.src, np.int64).reshape(-1)
             src[j, :len(s)] = s
             src_len[j] = min(Ts, max(1, int(r.src_len)))
+        pf = self.prefill_decoder or self.decoder
         with _tm.span("serving.decode.prefill", rows=n, bucket=bucket):
-            out = self.decoder.prefill(src, src_len)
+            out = pf.prefill(src, src_len)
+        if self.prefill_decoder is not None:
+            out = self._handoff(out)
         if _tm.enabled():
             _tm.counter("serving.decode.prefill_rows").inc(n)
             _tm.counter("serving.decode.prefill_pad_rows").inc(
@@ -162,6 +230,24 @@ class DecodeEngine:
             _tm.gauge("serving.decode.compile_count").set(
                 self.compile_count)
         return self.decoder.write_slots(state, out, slots)
+
+    def _handoff(self, out):
+        """Move prefilled KV state (ck, cv, src_bias) from the prefill
+        device onto the decode device. `jax.device_put` is the one
+        transfer op that lowers to whatever the platform has —
+        device-to-device DMA over ICI on TPU, a host round-trip
+        fallback on CPU — so the slot scatter always sees colocated
+        operands."""
+        import jax
+        ck, cv, src_bias = out
+        if _tm.enabled():
+            _tm.counter("serving.decode.handoff_bytes").inc(
+                int(ck.nbytes + cv.nbytes + src_bias.nbytes))
+            _tm.counter("serving.decode.handoffs").inc()
+        dev = self.device if self.device is not None \
+            else jax.devices()[0]
+        with _tm.span("serving.decode.handoff"):
+            return jax.device_put((ck, cv, src_bias), dev)
 
     def step(self, state, ids, pos, seed=0):
         """One decode iteration over all slots -> next ids [S]."""

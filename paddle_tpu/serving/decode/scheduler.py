@@ -115,8 +115,14 @@ class ContinuousScheduler:
         self._closed = False
         self._thread = None
         self._iteration = 0
+        self._started = False
         self.restarts = 0
         self.preemptions = 0
+        self.tokens_generated = 0    # lifetime tokens (goodput gauges)
+        # set by serving.farm: this scheduler's replica index, carried
+        # into the chaos ctx (worker_crash replica=R targeting) and
+        # the serving.replica.<i>.* telemetry
+        self.replica_index = None
         if warmup:
             engine.warmup()
 
@@ -193,7 +199,8 @@ class ContinuousScheduler:
             # counted per working iteration, like ModelServer counts
             # per dequeued batch — deterministic under load
             _chaos.check("serving.worker",
-                         detail=f"decode loop {self.name}")
+                         detail=f"decode loop {self.name}",
+                         replica=self.replica_index)
         self._admit()
         return self._step_active()
 
@@ -320,6 +327,7 @@ class ContinuousScheduler:
                     _tm.histogram("serving.decode.ttft_seconds").observe(
                         now - req.enqueue_t)
             slot.tokens.append(tok)
+            self.tokens_generated += 1
             if _tm.enabled():
                 _tm.counter("serving.decode.tokens_total").inc()
                 _tm.counter(
@@ -367,6 +375,7 @@ class ContinuousScheduler:
         """Spawn the supervised decode loop thread."""
         if self._thread is not None and self._thread.is_alive():
             return self
+        self._started = True
         self._thread = threading.Thread(
             target=self._loop_guarded,
             name=f"tpudecode-{self.name}", daemon=True)
@@ -443,3 +452,13 @@ class ContinuousScheduler:
     def queued(self):
         with self._cond:
             return self._queued
+
+    @property
+    def alive(self):
+        """False exactly in the crashed-and-not-yet-respawned window
+        of a started loop (the farm router's skip signal). A scheduler
+        that was never start()ed is driven by hand — always alive."""
+        if not self._started:
+            return True
+        t = self._thread
+        return t is not None and t.is_alive()

@@ -1,0 +1,20 @@
+"""tpufarm: replicated & disaggregated serving above the decode tier.
+
+NEVER imported by `paddle_tpu.serving` itself — a server with no
+replica group configured must not load this package (lazy-import pin
+in tests/test_bench_contract.py). Import it explicitly:
+
+    from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup
+
+    group = ReplicaGroup(model_cfg, params, FarmConfig(
+        replicas=2, prefill_devices=1,
+        engine=DecodeEngineConfig(num_slots=8, kv_quant="int8")))
+    server.attach_decoder("nmt", group)      # one registry name
+"""
+from .group import (FarmConfig, GroupFuture, Replica, ReplicaGroup,
+                    SharedBuildCache, load_checkpoint_params)
+from .router import LeastLoadedRouter
+
+__all__ = ["FarmConfig", "GroupFuture", "Replica", "ReplicaGroup",
+           "SharedBuildCache", "LeastLoadedRouter",
+           "load_checkpoint_params"]

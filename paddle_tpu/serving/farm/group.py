@@ -1,0 +1,473 @@
+"""tpufarm replica groups: N decode engines over disjoint device
+slices behind one least-loaded router.
+
+The decode tier (serving/decode) is one engine: one slot pool, one
+device set, one scheduler loop. This module is the scale-out layer
+above it — the piece of the reference's Paddle Serving / pserver fleet
+story rebuilt TPU-native:
+
+- **Replica groups.** `ReplicaGroup` instantiates N `DecodeEngine`s
+  over disjoint device slices (`parallel.mesh.device_slices`), each
+  with its own `ContinuousScheduler`, and routes submissions through a
+  `LeastLoadedRouter` scoring free slots against queue depth. The
+  group duck-types the scheduler surface (`submit` / `decode` /
+  `start` / `stop` / `queued`), so `ModelServer.attach_decoder(name,
+  group)` serves a whole fleet under one registry name and the HTTP
+  `max_new_tokens` route works unchanged.
+
+- **Disaggregated prefill.** With `prefill_devices=k`, the first k
+  devices are reserved as a prefill pool: each replica's encoder
+  executables are pinned there and the prefilled KV state is handed
+  device-to-device into the replica's slot pool
+  (`DecodeEngine._handoff`), so a long prompt's prefill never stalls
+  another replica's token loop. Every replica keeps its OWN prefill
+  decoder instance (possibly sharing a physical device) so rolling
+  updates swap prefill+decode weights atomically per replica.
+
+- **Crash containment.** A replica whose loop dies (e.g. the
+  `worker_crash` chaos fault) fails its in-flight futures and is
+  skipped by the router until its supervisor respawns it; the
+  `GroupFuture` wrapper resubmits crash-failed requests to another
+  replica, so the GROUP drops zero requests through a
+  one-replica-down window.
+
+- **Rolling weight updates.** `rolling_update` drains one replica at
+  a time (router skips it, in-flight work finishes), swaps its
+  parameter set under the compiled executables (same shapes -> zero
+  recompile), bumps its version, and moves on — the group serves both
+  versions mid-update and never stops serving.
+
+- **Shared compiles.** Same-config replicas share jit traces through
+  `SharedBuildCache` (single-flight: concurrent warmups build once,
+  waiters block), so group warmup cost is per GROUP, not per replica
+  — `ReplicaGroup.compile_count` pins the cache's build count.
+
+Telemetry lands under ``serving.replica.<i>.*`` gauges plus
+``serving.farm.*`` rollups, consumed by tpustat --watch/--fleet and
+the fleet report.
+"""
+import logging
+import threading
+import time
+
+import numpy as np
+
+from ... import telemetry as _tm
+from ...parallel.mesh import device_slices
+from ..batcher import (DeadlineExceeded, PreemptedError, RejectedError,
+                       ServerClosed)
+from ..decode import (ContinuousScheduler, DecodeConfig, DecodeEngine,
+                      DecodeEngineConfig)
+from .router import LeastLoadedRouter
+
+_LOG = logging.getLogger("paddle_tpu.serving.farm")
+
+__all__ = ["FarmConfig", "Replica", "ReplicaGroup", "SharedBuildCache",
+           "GroupFuture", "load_checkpoint_params"]
+
+
+class SharedBuildCache:
+    """Single-flight jit-build sharing across same-config replicas.
+
+    `get_or_build(key, build)` returns ``(fn, built)``: the first
+    caller for a key runs `build` while concurrent callers for the
+    same key wait on its completion instead of duplicating the trace
+    (the inference-engine compile-lock discipline, applied across
+    decoder instances). `builds` is the group-level compile count the
+    selftest pins."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fns = {}
+        self._inflight = {}     # key -> Event other callers wait on
+        self.builds = 0
+
+    def get_or_build(self, key, build):
+        while True:
+            with self._lock:
+                if key in self._fns:
+                    return self._fns[key], False
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = self._inflight[key] = threading.Event()
+                    building = True
+                else:
+                    building = False
+            if not building:
+                ev.wait()
+                continue        # re-check: hit, or builder failed
+            try:
+                fn = build()
+                with self._lock:
+                    self._fns[key] = fn
+                    self.builds += 1
+                return fn, True
+            finally:
+                with self._lock:
+                    del self._inflight[key]
+                ev.set()
+
+
+class FarmConfig:
+    """Shape of one replica group.
+
+    replicas: decode replica count (each gets a disjoint device slice).
+    prefill_devices: devices reserved up front for disaggregated
+        prefill (0 = pooled: each replica prefills on its own slice).
+    engine: per-replica `DecodeEngineConfig` (slots, buckets, kv_quant
+        — int8 KV opts in HERE, per model).
+    decode: per-replica scheduler `DecodeConfig` (queue bound,
+        deadlines, bos/eos).
+    devices: explicit device list to slice (default: all local).
+    share_compiles: share jit traces across replicas (single-flight).
+    retries: how many times a GroupFuture resubmits a crash-failed
+        request to another replica before giving up.
+    qos_factory: () -> QosPolicy per replica (None = default WFQ).
+    """
+
+    def __init__(self, replicas=2, prefill_devices=0, engine=None,
+                 decode=None, devices=None, share_compiles=True,
+                 retries=1, qos_factory=None):
+        self.replicas = int(replicas)
+        if self.replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        self.prefill_devices = int(prefill_devices)
+        self.engine = engine or DecodeEngineConfig()
+        self.decode = decode or DecodeConfig()
+        self.devices = devices
+        self.share_compiles = bool(share_compiles)
+        self.retries = int(retries)
+        self.qos_factory = qos_factory
+
+
+class Replica:
+    """One decode engine + scheduler bound to a device slice."""
+
+    __slots__ = ("index", "engine", "scheduler", "devices", "draining",
+                 "version")
+
+    def __init__(self, index, engine, scheduler, devices):
+        self.index = index
+        self.engine = engine
+        self.scheduler = scheduler
+        self.devices = list(devices)
+        self.draining = False    # rolling update in progress
+        self.version = 1
+
+    @property
+    def routable(self):
+        return not self.draining and self.scheduler.alive
+
+
+class GroupFuture:
+    """A decode future that survives replica crashes.
+
+    Wraps the routed replica's future; `result()` resubmits to another
+    routable replica when the underlying request died WITH its replica
+    (loop crash — e.g. an injected worker_crash) rather than by a
+    structured shed (deadline / preemption / rejection / shutdown
+    propagate unchanged). Bounded by the group's `retries` budget and
+    the caller's timeout."""
+
+    def __init__(self, group, kwargs, replica, future, retries):
+        self._group = group
+        self._kwargs = kwargs
+        self._replica = replica
+        self._future = future
+        self._retries = retries
+        self._failed = set()
+
+    def done(self):
+        return self._future.done()
+
+    @property
+    def replica_index(self):
+        """Which replica currently carries the request."""
+        return self._replica.index
+
+    def result(self, timeout=None):
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            try:
+                return self._future.result(timeout=left)
+            except (DeadlineExceeded, PreemptedError, RejectedError,
+                    ServerClosed, TimeoutError):
+                raise
+            except Exception as e:     # noqa: BLE001 — replica death
+                if self._retries <= 0:
+                    raise
+                self._retries -= 1
+                self._failed.add(self._replica)
+                rep, fut = self._group._route(
+                    self._kwargs, exclude=self._failed)
+                _LOG.warning(
+                    "farm %s: request resubmitted from crashed "
+                    "replica %d to %d (%s)", self._group.name,
+                    self._replica.index, rep.index, type(e).__name__)
+                if _tm.enabled():
+                    _tm.counter("serving.farm.retries").inc()
+                self._replica, self._future = rep, fut
+
+
+class ReplicaGroup:
+    """N continuous-decode replicas behind one least-loaded router —
+    the serving unit `ModelServer.attach_decoder` registers under a
+    single model name."""
+
+    def __init__(self, model_cfg, params, config=None, router=None,
+                 name="farm", warmup=True):
+        self.config = config or FarmConfig()
+        self.model_cfg = model_cfg
+        self.name = name
+        self.router = router or LeastLoadedRouter()
+        self.build_cache = SharedBuildCache() \
+            if self.config.share_compiles else None
+        reserved, slices = device_slices(
+            self.config.replicas, devices=self.config.devices,
+            reserve=self.config.prefill_devices)
+        self.prefill_devices = reserved
+        self.version = 1
+        self._lock = threading.Lock()
+        self._rate = {}          # index -> (t, tokens) goodput sample
+        self.replicas = []
+        for i in range(self.config.replicas):
+            engine = DecodeEngine(
+                model_cfg, params, config=self.config.engine,
+                device=slices[i][0],
+                prefill_device=(reserved[i % len(reserved)]
+                                if reserved else None),
+                build_cache=self.build_cache)
+            qos = self.config.qos_factory() \
+                if self.config.qos_factory else None
+            sched = ContinuousScheduler(
+                engine, qos=qos, config=self.config.decode,
+                name=f"{name}.r{i}", warmup=warmup)
+            sched.replica_index = i
+            self.replicas.append(Replica(i, engine, sched, slices[i]))
+        if _tm.enabled():
+            _tm.gauge("serving.farm.replicas").set(len(self.replicas))
+            _tm.gauge("serving.farm.compile_count").set(
+                self.compile_count)
+        self._publish()
+
+    # ------------------------------------------------------- properties
+    @property
+    def compile_count(self):
+        """Executables built for the whole group — with compile
+        sharing this is the CACHE's build count (per group, not per
+        replica), the satellite pin."""
+        if self.build_cache is not None:
+            return self.build_cache.builds
+        return sum(r.engine.compile_count for r in self.replicas)
+
+    @property
+    def queued(self):
+        return sum(r.scheduler.queued for r in self.replicas)
+
+    @property
+    def num_slots(self):
+        return sum(r.scheduler.pool.num_slots for r in self.replicas)
+
+    # ---------------------------------------------------------- serving
+    def submit(self, src, src_len=None, tenant="default",
+               max_new_tokens=None, deadline_ms=None, request_id=None):
+        """Route one sequence to the least-loaded replica; returns a
+        `GroupFuture` (resolves to a DecodeResult, resubmitting across
+        replicas on a crash)."""
+        kwargs = dict(src=src, src_len=src_len, tenant=tenant,
+                      max_new_tokens=max_new_tokens,
+                      deadline_ms=deadline_ms, request_id=request_id)
+        rep, fut = self._route(kwargs, exclude=())
+        return GroupFuture(self, kwargs, rep, fut,
+                           retries=self.config.retries)
+
+    def decode(self, src, timeout=None, **kw):
+        """Blocking convenience: submit + wait -> DecodeResult."""
+        return self.submit(src, **kw).result(timeout=timeout)
+
+    def _route(self, kwargs, exclude):
+        with self._lock:
+            rep = self.router.pick(self.replicas, exclude=exclude)
+            if rep is None:
+                # nothing routable (all draining/dead/excluded): keep
+                # accepting on the least-queued live replica rather
+                # than going dark — its queue serves when it recovers
+                live = [r for r in self.replicas if r not in exclude]
+                if not live:
+                    raise RejectedError(
+                        f"farm {self.name!r}: no replica available")
+                rep = min(live, key=lambda r: r.scheduler.queued)
+        fut = rep.scheduler.submit(**kwargs)
+        if _tm.enabled():
+            _tm.counter("serving.farm.routed").inc()
+            _tm.counter(
+                f"serving.replica.{rep.index}.routed").inc()
+        self._publish()
+        return rep, fut
+
+    # -------------------------------------------------------- iteration
+    def run_iteration(self):
+        """Manual deterministic drive: one retire/admit/step cycle on
+        EVERY replica (tests and the selftest use this instead of the
+        loop threads). Returns total active slots stepped."""
+        stepped = 0
+        for r in self.replicas:
+            stepped += r.scheduler.run_iteration()
+        self._publish()
+        return stepped
+
+    # ------------------------------------------------------- lifecycle
+    def start(self):
+        for r in self.replicas:
+            r.scheduler.start()
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        for r in self.replicas:
+            r.scheduler.stop(drain=drain, timeout=timeout)
+
+    # -------------------------------------------------- rolling updates
+    def rolling_update(self, params=None, checkpoint_dir=None,
+                       version=None, drain_timeout=30.0, poll_s=0.002,
+                       drive=False):
+        """Load a new weight version into each replica IN TURN while
+        the others keep serving, then flip `version`.
+
+        Per replica: mark draining (router skips it), wait for its
+        slots + queue to empty, swap the parameter set under the
+        compiled executables (`DecodeEngine.set_params` — zero
+        recompile, prefill + decode atomically), bump its version,
+        undrain. `params` is a checkpoint array dict; alternatively
+        `checkpoint_dir` names a PR-11 topology-independent checkpoint
+        (a CheckpointSaver root resolves to its newest valid
+        checkpoint_N). `drive=True` is for manual mode: the update
+        itself pumps `run_iteration()` to drain (no loop threads)."""
+        if params is None:
+            if checkpoint_dir is None:
+                raise ValueError("rolling_update needs params or "
+                                 "checkpoint_dir")
+            params = load_checkpoint_params(checkpoint_dir)
+        version = int(version if version is not None
+                      else self.version + 1)
+        with _tm.span("serving.farm.rolling_update", farm=self.name,
+                      version=version):
+            for r in self.replicas:
+                r.draining = True
+                self._publish()
+                try:
+                    deadline = time.monotonic() + drain_timeout
+                    while (r.scheduler.pool.active_count() > 0
+                           or r.scheduler.queued > 0):
+                        if drive:
+                            self.run_iteration()
+                        else:
+                            time.sleep(poll_s)
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"replica {r.index} did not drain "
+                                f"within {drain_timeout}s for the "
+                                f"rolling update")
+                    r.engine.set_params(params)
+                    r.version = version
+                finally:
+                    r.draining = False
+                if _tm.enabled():
+                    _tm.counter("serving.farm.replicas_updated").inc()
+                _LOG.info("farm %s: replica %d now serving version %d",
+                          self.name, r.index, version)
+        self.version = version
+        self._publish()
+        return version
+
+    # -------------------------------------------------------- telemetry
+    def stats(self):
+        """Per-replica serving stats (also pushed as
+        serving.replica.<i>.* gauges): slots in use, queue depth, KV
+        bytes, lifetime tokens, goodput tokens/s, restarts, version,
+        liveness, device slice."""
+        out = {"name": self.name, "version": self.version,
+               "replicas": [],
+               "compile_count": self.compile_count,
+               "prefill_devices": [str(d)
+                                   for d in self.prefill_devices]}
+        for r in self.replicas:
+            s = r.scheduler
+            out["replicas"].append({
+                "index": r.index,
+                "slots_in_use": s.pool.active_count(),
+                "num_slots": s.pool.num_slots,
+                "queue_depth": s.queued,
+                "kv_cache_bytes": r.engine.kv_cache_bytes,
+                "tokens_total": s.tokens_generated,
+                "goodput_tps": self._goodput(r),
+                "restarts": s.restarts,
+                "alive": s.alive,
+                "draining": r.draining,
+                "version": r.version,
+                "devices": [str(d) for d in r.devices]})
+        self._publish()
+        return out
+
+    def _goodput(self, r, update=False):
+        """Tokens/s since the previous goodput sample of replica r."""
+        now = time.monotonic()
+        tokens = r.scheduler.tokens_generated
+        last = self._rate.get(r.index)
+        if update or last is None:
+            self._rate[r.index] = (now, tokens)
+        if last is None:
+            return 0.0
+        dt = now - last[0]
+        return (tokens - last[1]) / dt if dt > 1e-6 else 0.0
+
+    def _publish(self):
+        if not _tm.enabled():
+            return
+        for r in self.replicas:
+            s = r.scheduler
+            pre = f"serving.replica.{r.index}"
+            _tm.gauge(f"{pre}.slots_in_use").set(
+                float(s.pool.active_count()))
+            _tm.gauge(f"{pre}.num_slots").set(float(s.pool.num_slots))
+            _tm.gauge(f"{pre}.queue_depth").set(float(s.queued))
+            _tm.gauge(f"{pre}.kv_cache_bytes").set(
+                float(r.engine.kv_cache_bytes))
+            _tm.gauge(f"{pre}.tokens_total").set(
+                float(s.tokens_generated))
+            _tm.gauge(f"{pre}.goodput_tps").set(
+                self._goodput(r, update=True))
+            _tm.gauge(f"{pre}.restarts").set(float(s.restarts))
+            _tm.gauge(f"{pre}.alive").set(1.0 if s.alive else 0.0)
+            _tm.gauge(f"{pre}.draining").set(
+                1.0 if r.draining else 0.0)
+            _tm.gauge(f"{pre}.version").set(float(r.version))
+
+
+def load_checkpoint_params(dirname):
+    """Dense params out of a PR-11 topology-independent checkpoint:
+    resolve a CheckpointSaver root to its newest VALID checkpoint_N
+    (torn/corrupt candidates skipped), verify the checksum manifest,
+    and load params.npz — the array dict `rolling_update` feeds to
+    every replica."""
+    import os
+
+    from ... import io as _io
+    from ...resilience import checkpoint as _rckpt
+
+    d = dirname
+    if not os.path.exists(os.path.join(d, _io.META_FILE)):
+        latest = _io.latest_checkpoint(d)
+        if latest is None:
+            raise FileNotFoundError(
+                f"{dirname!r} is neither a checkpoint dir nor a "
+                f"root holding a valid checkpoint_N")
+        d = latest
+    ok, reason = _rckpt.validate(d)
+    if not ok:
+        raise ValueError(f"checkpoint {d!r} failed validation: "
+                         f"{reason}")
+    with np.load(os.path.join(d, _io.PARAMS_FILE)) as z:
+        return {k: z[k] for k in z.files}

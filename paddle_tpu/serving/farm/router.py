@@ -1,0 +1,47 @@
+"""Least-loaded routing across decode replicas.
+
+The reference's Paddle Serving scaled out by running N independent
+server instances behind an external load balancer that knew nothing
+about slot pools or queues — round-robin at best. Here the router sits
+IN-PROCESS with full visibility into every replica's scheduler, so it
+can score actual capacity: free decode slots (work starts this
+iteration) discounted by queue depth (work waits behind others).
+
+Routability is a hard filter before scoring: a replica that is
+draining for a rolling weight update, or whose loop thread has died
+and not yet been respawned by its supervisor, takes no new work. The
+group falls back to least-queued among whatever is left only when
+NOTHING is routable (one-replica groups mid-update keep accepting
+rather than going dark — availability over update latency).
+"""
+
+__all__ = ["LeastLoadedRouter"]
+
+
+class LeastLoadedRouter:
+    """score = (free_slots + 1) / (1 + queue_weight * queue_depth).
+
+    Free slots dominate (a request admitted now beats any queue), the
+    +1 keeps fully-busy replicas comparable by backlog, and
+    `queue_weight` tunes how hard queueing repels new work. Ties break
+    toward the lowest replica index for determinism."""
+
+    def __init__(self, queue_weight=1.0):
+        self.queue_weight = float(queue_weight)
+
+    def score(self, replica):
+        s = replica.scheduler
+        return (s.pool.free_count() + 1.0) / \
+            (1.0 + self.queue_weight * s.queued)
+
+    def pick(self, replicas, exclude=()):
+        """The routable replica with the best score, or None when no
+        replica is routable (all draining/dead/excluded)."""
+        best, best_score = None, 0.0
+        for r in replicas:
+            if r in exclude or not r.routable:
+                continue
+            sc = self.score(r)
+            if best is None or sc > best_score:
+                best, best_score = r, sc
+        return best

@@ -18,6 +18,9 @@ Endpoints (TF-Serving-shaped):
   draining (load balancers stop routing before shutdown completes).
 - ``GET /metrics`` — the telemetry registry in Prometheus text format.
 - ``GET /v1/models`` — registered names and versions.
+- ``GET /v1/farm`` — per-replica stats for every attached decode tier
+  that is a replica group (slots in use, queue depth, KV bytes,
+  goodput, versions); ``{}`` when serving single engines only.
 
 Every POST carries a correlation id: ``X-Request-Id`` header or
 ``request_id`` body field if the caller sent one, generated otherwise.
@@ -118,6 +121,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/v1/models":
             self._reply(200, {"models":
                               self.model_server.registry.models()})
+        elif self.path == "/v1/farm":
+            farms = {name: dec.stats()
+                     for name, dec in
+                     self.model_server.decoders().items()
+                     if hasattr(dec, "stats")}
+            self._reply(200, {"farms": farms})
         else:
             self._error(404, f"no route {self.path!r}")
 

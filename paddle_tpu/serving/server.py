@@ -144,11 +144,13 @@ class ModelServer:
         return engine.signature_count()
 
     def attach_decoder(self, name, decoder, start=True):
-        """Attach a continuous-batching decode tier
-        (`serving.decode.ContinuousScheduler`) under `name`. Predict
-        requests carrying `max_new_tokens` route to it; fixed-shape
-        requests keep using the registered InferenceEngine (if any) —
-        a model can serve both tiers at once."""
+        """Attach a continuous-batching decode tier under `name` — a
+        `serving.decode.ContinuousScheduler`, or a whole
+        `serving.farm.ReplicaGroup` (same duck-typed surface), so one
+        registry name fans out across N replicas. Predict requests
+        carrying `max_new_tokens` route to it; fixed-shape requests
+        keep using the registered InferenceEngine (if any) — a model
+        can serve both tiers at once."""
         if self._stopping:
             raise ServerClosed("server is shutting down")
         with self._lock:
@@ -164,6 +166,33 @@ class ModelServer:
         """The attached decode tier for `name`, or None."""
         with self._lock:
             return self._decoders.get(name)
+
+    def decoders(self):
+        """Snapshot of all attached decode tiers (name -> scheduler
+        or replica group) — the /v1/farm introspection surface."""
+        with self._lock:
+            return dict(self._decoders)
+
+    def rolling_update(self, name, params=None, checkpoint_dir=None,
+                       version=None, **kw):
+        """Rolling weight update on `name`'s replica group: each
+        replica drains and flips to the new version in turn while the
+        rest keep serving (see `serving.farm.ReplicaGroup
+        .rolling_update`). Raises KeyError when `name` has no decode
+        tier and TypeError when its decoder is a single scheduler
+        (nothing to roll — restart it instead)."""
+        decoder = self.decoder(name)
+        if decoder is None:
+            raise KeyError(f"model {name!r} has no decode tier; "
+                           f"decoders: {sorted(self._decoders)}")
+        if not hasattr(decoder, "rolling_update"):
+            raise TypeError(
+                f"decoder for {name!r} is a single engine, not a "
+                f"replica group; rolling updates need "
+                f"serving.farm.ReplicaGroup")
+        return decoder.rolling_update(params=params,
+                                      checkpoint_dir=checkpoint_dir,
+                                      version=version, **kw)
 
     def decode(self, name, src, src_len=None, tenant="default",
                max_new_tokens=None, deadline_ms=None, timeout=None,

@@ -634,6 +634,19 @@ class FleetCollector:
                         ent["value"]
             ratios = [d["unique_ratio"] for d in embed_tables.values()
                       if "unique_ratio" in d]
+            # serving farm (serving/farm): per-replica
+            # serving.replica.<i>.{slots_in_use,queue_depth,...}
+            # gauges → a replicas table per rank + a served-tokens
+            # rollup (the tpustat --fleet replica columns)
+            serving_replicas = {}
+            for name, ent in m.items():
+                if not name.startswith("serving.replica."):
+                    continue
+                idx, _, what = \
+                    name[len("serving.replica."):].partition(".")
+                if idx and what:
+                    serving_replicas.setdefault(idx, {})[what] = \
+                        ent["value"]
             per_rank[str(r)] = {
                 "steps": h["count"] if h else 0,
                 "step_seconds_mean": (h["sum"] / h["count"])
@@ -658,6 +671,10 @@ class FleetCollector:
                     int(d.get("exchange_bytes", 0))
                     for d in embed_tables.values()),
                 "embed_tables": embed_tables,
+                "serving_replicas": serving_replicas,
+                "serving_tokens_total": sum(
+                    int(d.get("tokens_total", 0))
+                    for d in serving_replicas.values()),
                 # tpuscope attribution gauges, when the rank ran with
                 # the attribution layer live
                 "mfu": _rank_gauge(m, "perf.mfu"),

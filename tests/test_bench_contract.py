@@ -138,6 +138,50 @@ def test_decode_off_paths_untouched():
     assert "LAZY_OK" in p.stdout
 
 
+def test_farm_off_paths_untouched():
+    """tpufarm's off contract: serving without a replica group never
+    imports the farm package (single-engine deployments pay nothing),
+    and the fp32 decode state schema stays byte-identical to the
+    pre-farm layout — the int8 KV path is opt-in per model, never a
+    default."""
+    code = (
+        "import sys\n"
+        "import paddle_tpu.serving\n"
+        "import paddle_tpu.serving.server\n"
+        "import paddle_tpu.serving.http\n"
+        "import paddle_tpu.serving.decode\n"
+        "assert 'paddle_tpu.serving.farm' not in sys.modules, "
+        "'serving eagerly imports the farm package'\n"
+        "assert 'paddle_tpu.serving.farm.group' not in sys.modules\n"
+        "from paddle_tpu.models import transformer as tfm\n"
+        "import numpy as np\n"
+        "cfg = tfm.TransformerConfig(src_vocab=16, trg_vocab=16,"
+        " max_len=8, d_model=8, d_inner=16, n_head=2, n_layer=1,"
+        " dropout=0.0, label_smooth_eps=0.0)\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu.core import framework as fw\n"
+        "infer, start = fw.Program(), fw.Program()\n"
+        "with pt.program_guard(infer, start):\n"
+        "    with pt.unique_name.guard():\n"
+        "        tfm.build_infer_program(cfg, maxlen=8)\n"
+        "pt.Executor(pt.CPUPlace()).run(start)\n"
+        "scope = pt.global_scope()\n"
+        "params = {v.name: np.asarray(scope.get(v.name))"
+        " for v in infer.persistable_vars()}\n"
+        "dec = tfm.IncrementalDecoder(cfg, params, num_slots=2,"
+        " max_len=8)\n"
+        "assert set(dec.init_state()) == "
+        "{'kc', 'vc', 'ck', 'cv', 'src_bias'}, "
+        "'default decode state schema changed'\n"
+        "assert 'paddle_tpu.serving.farm' not in sys.modules\n"
+        "print('FARM_OFF_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-800:])
+    assert "FARM_OFF_OK" in p.stdout
+
+
 def test_sparse_engine_off_paths_untouched():
     """tpusparse's off contract (the bench-contract pin): without a
     distributed table — or with one but no sparse= opt-in — the engine

@@ -1,0 +1,656 @@
+"""tpufarm: replica groups over device slices (least-loaded routing,
+greedy parity through the router + disaggregated prefill handoff),
+int8 block-quantized KV cache parity across prompt lengths and
+temperatures, shared single-flight build cache, rolling weight
+updates (in-memory and from a PR-11 checkpoint), group-level
+worker_crash chaos with zero dropped requests, ModelServer / HTTP
+integration, per-replica telemetry -> fleet rollup -> tpustat
+rendering, and the tpuserve --selftest-farm gate."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import telemetry as tm
+from paddle_tpu.core import framework as fw
+from paddle_tpu.models import transformer as tfm
+from paddle_tpu.parallel.mesh import device_slices
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.chaos import ChaosFault
+from paddle_tpu.serving import ModelServer, HttpFrontend
+from paddle_tpu.serving.decode import (ContinuousScheduler, DecodeConfig,
+                                       DecodeEngine, DecodeEngineConfig)
+from paddle_tpu.serving.farm import (FarmConfig, LeastLoadedRouter,
+                                     ReplicaGroup, SharedBuildCache,
+                                     load_checkpoint_params)
+from paddle_tpu.telemetry import fleet as tf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    tm.disable()
+    tm.reset()
+    tf._reset_for_tests()
+    yield
+    tm.disable()
+    tm.reset()
+    tf._reset_for_tests()
+
+
+# ---------------------------------------------------------------- helpers
+def _seeded_stack(maxlen=12, seed=7, n_layer=2):
+    """Tiny transformer with seeded wide random params; returns
+    (cfg, exe, infer_program, logits_var, params)."""
+    cfg = tfm.TransformerConfig(src_vocab=64, trg_vocab=64,
+                                max_len=maxlen, d_model=32, d_inner=64,
+                                n_head=4, n_layer=n_layer, dropout=0.0,
+                                label_smooth_eps=0.0)
+    infer, start = fw.Program(), fw.Program()
+    with pt.program_guard(infer, start):
+        with pt.unique_name.guard():
+            _feeds, logits = tfm.build_infer_program(cfg, maxlen=maxlen)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(start)
+    rng = np.random.RandomState(seed)
+    scope = pt.global_scope()
+    params = {}
+    for v in infer.persistable_vars():
+        a = np.asarray(scope.get(v.name))
+        if v.name.startswith("layer_norm") and v.name.endswith(".w_0"):
+            nv = 1.0 + 0.2 * rng.randn(*a.shape)
+        elif v.name.endswith(".b_0"):
+            nv = 0.1 * rng.randn(*a.shape)
+        else:
+            nv = 0.35 * rng.randn(*a.shape)
+        nv = nv.astype(a.dtype)
+        scope.set(v.name, nv)
+        params[v.name] = nv
+    return cfg, exe, infer, logits, params
+
+
+def _group(cfg, params, replicas=2, slots=2, maxlen=12,
+           buckets=(1, 2), prefill_devices=0, kv_quant=None,
+           name="farm", retries=1, warmup=True):
+    return ReplicaGroup(cfg, params, FarmConfig(
+        replicas=replicas, prefill_devices=prefill_devices,
+        engine=DecodeEngineConfig(num_slots=slots, max_len=maxlen,
+                                  prefill_buckets=buckets,
+                                  kv_quant=kv_quant),
+        decode=DecodeConfig(bos=0, max_queue_requests=64),
+        retries=retries), name=name, warmup=warmup)
+
+
+def _pump(group, futures, budget=600):
+    """Manual drive until every GroupFuture resolves; a crashed
+    replica is recovered by hand (no supervisor thread in manual
+    mode) and its requests resubmit through the GroupFuture retry."""
+    results = {}
+    pending = dict(enumerate(futures))
+    for _ in range(budget):
+        if not pending:
+            break
+        for i, f in list(pending.items()):
+            if not f.done():
+                continue
+            try:
+                results[i] = f.result(timeout=0)
+                del pending[i]
+            except TimeoutError:
+                pass            # resubmitted to another replica
+        if pending:
+            try:
+                group.run_iteration()
+            except ChaosFault as e:
+                rep = group.replicas[0]
+                rep.scheduler._crash_recover(e)
+                rep.scheduler.restarts += 1
+    assert not pending, f"{len(pending)} requests never completed"
+    return [results[i] for i in range(len(futures))]
+
+
+def _greedy_ref(exe, infer, logits, src, src_len, maxlen, max_new):
+    row = np.zeros((1, maxlen), np.int64)
+    row[0, :len(src)] = src
+    ids = tfm.greedy_decode(exe, infer, logits, row,
+                            np.array([src_len], "int64"), bos=0,
+                            fetch_argmax=True)
+    return ids[0, 1:1 + max_new].astype(np.int64)
+
+
+# ------------------------------------------------------- device slicing
+def test_device_slices_disjoint_with_reserve():
+    reserved, slices = device_slices(3, devices=list(range(8)),
+                                     reserve=2)
+    assert reserved == [0, 1]
+    assert len(slices) == 3
+    flat = [d for s in slices for d in s]
+    assert sorted(flat) == list(range(2, 8))    # disjoint, no idlers
+    assert len(set(flat)) == len(flat)
+    # contiguous, leftovers appended to the last slice
+    assert slices == [[2, 3], [4, 5], [6, 7]]
+
+
+def test_device_slices_leftovers_and_wraparound():
+    _, slices = device_slices(3, devices=list(range(7)))
+    assert slices == [[0, 1], [2, 3], [4, 5, 6]]
+    # fewer devices than reserve + n: slices share (CPU fallback)
+    reserved, slices = device_slices(2, devices=[0], reserve=1)
+    assert reserved == [0]
+    assert slices == [[0], [0]]
+    with pytest.raises(ValueError):
+        device_slices(0, devices=[0])
+    with pytest.raises(ValueError):
+        device_slices(1, devices=[])
+
+
+# --------------------------------------------------- shared build cache
+def test_shared_build_cache_single_flight():
+    cache = SharedBuildCache()
+    built = []
+    start = threading.Barrier(4)
+
+    def build():
+        built.append(threading.get_ident())
+        time.sleep(0.05)        # widen the race window
+        return "fn"
+
+    got = []
+
+    def racer():
+        start.wait()
+        got.append(cache.get_or_build("k", build))
+
+    threads = [threading.Thread(target=racer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(built) == 1 and cache.builds == 1
+    assert all(fn == "fn" for fn, _ in got)
+    assert sum(1 for _, was_built in got if was_built) == 1
+    # distinct key builds again; same key hits
+    assert cache.get_or_build("k2", lambda: "fn2") == ("fn2", True)
+    assert cache.get_or_build("k", lambda: "never") == ("fn", False)
+    assert cache.builds == 2
+
+
+def test_shared_build_cache_builder_failure_releases_waiters():
+    cache = SharedBuildCache()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("trace failed")
+        return "fn"
+
+    with pytest.raises(RuntimeError):
+        cache.get_or_build("k", flaky)
+    # the in-flight marker was released: the next caller rebuilds
+    assert cache.get_or_build("k", flaky) == ("fn", True)
+    assert cache.builds == 1
+
+
+# ----------------------------------------------------------- the router
+class _FakePool:
+    def __init__(self, free):
+        self._free = free
+        self.num_slots = 4
+
+    def free_count(self):
+        return self._free
+
+
+class _FakeSched:
+    def __init__(self, free, queued):
+        self.pool = _FakePool(free)
+        self.queued = queued
+
+
+class _FakeReplica:
+    def __init__(self, index, free=4, queued=0, routable=True):
+        self.index = index
+        self.scheduler = _FakeSched(free, queued)
+        self.routable = routable
+
+
+def test_router_prefers_free_slots_and_penalizes_queue():
+    r = LeastLoadedRouter()
+    a = _FakeReplica(0, free=0, queued=0)
+    b = _FakeReplica(1, free=3, queued=0)
+    assert r.pick([a, b]) is b
+    # deep queue beats raw free slots
+    c = _FakeReplica(0, free=4, queued=20)
+    d = _FakeReplica(1, free=1, queued=0)
+    assert r.pick([c, d]) is d
+    # ties break to the lowest index (deterministic tests)
+    e, f = _FakeReplica(0), _FakeReplica(1)
+    assert r.pick([e, f]) is e
+
+
+def test_router_skips_unroutable_and_excluded():
+    r = LeastLoadedRouter()
+    dead = _FakeReplica(0, routable=False)
+    live = _FakeReplica(1, free=1, queued=5)
+    assert r.pick([dead, live]) is live
+    assert r.pick([dead, live], exclude={live}) is None
+    assert r.pick([], exclude=()) is None
+
+
+# -------------------------------------------- group parity (the tentpole)
+def test_group_parity_with_disaggregated_prefill():
+    """Requests routed across 2 replicas with prefill pinned to a
+    reserved device decode token-identically to one-at-a-time
+    greedy_decode, at the group compile pin (shared traces), with no
+    slot leaks and real load spread."""
+    tm.enable()
+    maxlen, buckets = 12, (1, 2)
+    cfg, exe, infer, logits, params = _seeded_stack(maxlen=maxlen)
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   buckets=buckets, prefill_devices=1)
+    warm = group.compile_count
+    assert warm == len(buckets) + 1, \
+        "compile sharing must make warmup per GROUP, not per replica"
+
+    rng = np.random.RandomState(5)
+    reqs = []
+    for _ in range(5):
+        n = int(rng.randint(3, maxlen))
+        reqs.append((rng.randint(2, 60, (n,)).astype("int64"), n,
+                     int(rng.randint(3, 9))))
+    expected = [_greedy_ref(exe, infer, logits, src, n, maxlen, mn)
+                for src, n, mn in reqs]
+    futures = [group.submit(src, src_len=n, max_new_tokens=mn)
+               for src, n, mn in reqs]
+    results = _pump(group, futures)
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int64), expected[i])
+
+    spread = [r.scheduler.tokens_generated for r in group.replicas]
+    assert min(spread) > 0, f"router starved a replica: {spread}"
+    assert group.compile_count == warm, "traffic must not recompile"
+    for r in group.replicas:
+        r.scheduler.pool.check()
+        assert r.scheduler.pool.free_count() == 2
+    # the prefill handoff actually crossed devices
+    assert tm.counter("serving.decode.handoffs").value > 0
+
+
+def test_slotpool_invariants_on_cross_device_handoff():
+    """Single engine, prefill on device 0, decode slots on device 1:
+    the handed-off KV lands committed on the decode device, tokens
+    stay byte-identical to the pooled engine, and the slot pool is
+    leak-free through admit/retire cycles."""
+    import jax
+    tm.enable()
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    maxlen = 12
+    cfg, exe, infer, logits, params = _seeded_stack(maxlen=maxlen)
+    ecfg = DecodeEngineConfig(num_slots=2, max_len=maxlen,
+                              prefill_buckets=(1, 2))
+    pooled = DecodeEngine(cfg, params, config=ecfg, device=devs[1])
+    disagg = DecodeEngine(cfg, params, config=ecfg, device=devs[1],
+                          prefill_device=devs[0])
+    assert disagg.prefill_decoder is not None
+    assert pooled.prefill_decoder is None
+
+    def run(engine):
+        sched = ContinuousScheduler(engine,
+                                    config=DecodeConfig(bos=0),
+                                    warmup=False)
+        rng = np.random.RandomState(9)
+        futs = []
+        for _ in range(4):
+            n = int(rng.randint(3, maxlen))
+            futs.append(sched.submit(
+                rng.randint(2, 60, (n,)).astype("int64"), src_len=n,
+                max_new_tokens=5))
+        for _ in range(200):
+            if all(f.done() for f in futs):
+                break
+            sched.run_iteration()
+        sched.pool.check()
+        assert sched.pool.free_count() == 2
+        return sched, [f.result(timeout=0).tokens for f in futs]
+
+    _, want = run(pooled)
+    sched, got = run(disagg)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    # cross caches were handed over and are committed decode-side
+    assert tm.counter("serving.decode.handoffs").value > 0
+    assert tm.counter("serving.decode.handoff_bytes").value > 0
+    assert set(sched.state["ck"].devices()) == {devs[1]}
+    assert set(sched.state["src_bias"].devices()) == {devs[1]}
+
+
+# ----------------------------------------------------- int8 KV parity
+def test_int8_kv_state_layout_and_bytes():
+    maxlen = 12
+    cfg, _exe, _infer, _logits, params = _seeded_stack(maxlen=maxlen)
+    dec_f = tfm.IncrementalDecoder(cfg, params, num_slots=2,
+                                   max_len=maxlen)
+    dec_q = tfm.IncrementalDecoder(cfg, params, num_slots=2,
+                                   max_len=maxlen, kv_quant="int8")
+    # the fp32 path keeps the legacy state schema byte-for-byte
+    assert set(dec_f.init_state()) == {"kc", "vc", "ck", "cv",
+                                       "src_bias"}
+    st = dec_q.init_state()
+    assert set(st) == {"kc_q", "kc_s", "vc_q", "vc_s", "ck", "cv",
+                       "src_bias"}
+    assert st["kc_q"].dtype == np.int8
+    assert st["kc_s"].dtype == np.float32
+    assert dec_q.kv_cache_bytes() < dec_f.kv_cache_bytes()
+    # knob validation
+    with pytest.raises(ValueError):
+        tfm.IncrementalDecoder(cfg, params, num_slots=2,
+                               max_len=maxlen, kv_quant="int4")
+    with pytest.raises(ValueError):
+        tfm.IncrementalDecoder(cfg, params, num_slots=2,
+                               max_len=maxlen, kv_quant="int8",
+                               kv_block=3)     # must divide head dim
+
+
+@pytest.mark.parametrize("topk,temperature,kv_block", [
+    (0, 1.0, None),          # greedy, full-head blocks
+    (0, 1.0, 4),             # greedy, sub-head blocks
+    (4, 1.3, None),          # sampled, hot temperature
+])
+def test_int8_kv_token_parity_property(topk, temperature, kv_block):
+    """The int8 block-quantized cache must reproduce the fp32 tokens
+    across prompt lengths and temperatures (teacher-forced so the
+    comparison never diverges), with a small bounded logit delta."""
+    maxlen = 12
+    cfg, _exe, _infer, _logits, params = _seeded_stack(maxlen=maxlen)
+    kw = dict(num_slots=2, max_len=maxlen, topk=topk,
+              temperature=temperature, return_logits=True)
+    dec_f = tfm.IncrementalDecoder(cfg, params, **kw)
+    dec_q = tfm.IncrementalDecoder(cfg, params, kv_quant="int8",
+                                   kv_block=kv_block, **kw)
+    rng = np.random.RandomState(3)
+    mismatch = total = 0
+    max_delta = 0.0
+    for n0, n1 in ((3, 5), (7, maxlen - 1)):
+        src = np.zeros((2, dec_f.src_max_len), np.int64)
+        src[0, :n0] = rng.randint(2, 60, n0)
+        src[1, :n1] = rng.randint(2, 60, n1)
+        sl = np.array([n0, n1], "int64")
+        st_f = dec_f.write_slots(dec_f.init_state(),
+                                 dec_f.prefill(src, sl), [0, 1])
+        st_q = dec_q.write_slots(dec_q.init_state(),
+                                 dec_q.prefill(src, sl), [0, 1])
+        ids = np.zeros(2, np.int64)
+        pos = np.zeros(2, np.int64)
+        for step in range(6):
+            nf = dec_f.step(st_f, ids, pos, seed=step)
+            lf = dec_f.last_logits[:2].copy()
+            nq = dec_q.step(st_q, ids, pos, seed=step)
+            lq = dec_q.last_logits[:2].copy()
+            max_delta = max(max_delta,
+                            float(np.max(np.abs(lf - lq))))
+            mismatch += int((nf[:2] != nq[:2]).sum())
+            total += 2
+            ids[:2] = nf[:2]            # teacher-force fp32's choice
+            pos += 1
+    bound = 0.02 if topk == 0 else 0.10   # sampling may split a tie
+    assert mismatch / total <= bound, \
+        (f"int8 KV diverged: {mismatch}/{total} tokens "
+         f"(max logit delta {max_delta:.5f})")
+    assert max_delta < 0.5, \
+        f"int8 dequantized logits drifted: max delta {max_delta:.5f}"
+
+
+def test_int8_kv_through_replica_group():
+    """kv_quant opts in per model via the engine config: an int8 group
+    still matches greedy_decode end-to-end through the router."""
+    maxlen = 12
+    cfg, exe, infer, logits, params = _seeded_stack(maxlen=maxlen)
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   kv_quant="int8", name="int8farm")
+    rng = np.random.RandomState(17)
+    reqs = []
+    for _ in range(4):
+        n = int(rng.randint(3, maxlen))
+        reqs.append((rng.randint(2, 60, (n,)).astype("int64"), n, 6))
+    expected = [_greedy_ref(exe, infer, logits, src, n, maxlen, mn)
+                for src, n, mn in reqs]
+    results = _pump(group, [group.submit(s, src_len=n,
+                                         max_new_tokens=mn)
+                            for s, n, mn in reqs])
+    for r, want in zip(results, expected):
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int64), want)
+    for rep in group.replicas:
+        assert rep.engine.kv_cache_bytes < \
+            DecodeEngine(cfg, params, config=DecodeEngineConfig(
+                num_slots=2, max_len=maxlen,
+                prefill_buckets=(1, 2))).kv_cache_bytes
+
+
+# ------------------------------------------------------ rolling updates
+def test_rolling_update_zero_recompile_and_checkpoint_roundtrip(
+        tmp_path):
+    """Weight flips ride the compiled executables (zero recompile),
+    change the tokens, and a PR-11 checkpoint dir is a valid source;
+    rolling back to the checkpointed v1 weights restores the original
+    tokens exactly."""
+    maxlen = 12
+    cfg, exe, infer, logits, params = _seeded_stack(maxlen=maxlen)
+    # global scope still holds v1 params: checkpoint them
+    ckpt = str(tmp_path / "ck")
+    pt.io.save_checkpoint(exe, ckpt, main_program=infer, step=1)
+
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   name="roll")
+    warm = group.compile_count
+    src = np.arange(2, 8).astype("int64")
+
+    def decode_once():
+        [r] = _pump(group, [group.submit(src, src_len=6,
+                                         max_new_tokens=6)])
+        return np.asarray(r.tokens, np.int64)
+
+    v1_tokens = decode_once()
+    rng = np.random.RandomState(99)
+    params2 = {k: (v + 0.5 * rng.randn(*v.shape)).astype(v.dtype)
+               for k, v in params.items()}
+    assert group.rolling_update(params=params2, drive=True) == 2
+    assert [r.version for r in group.replicas] == [2, 2]
+    v2_tokens = decode_once()
+    assert not np.array_equal(v1_tokens, v2_tokens), \
+        "new weights must change the decode"
+    # rolling back from the checkpoint restores v1 exactly
+    assert group.rolling_update(checkpoint_dir=ckpt, drive=True,
+                                version=3) == 3
+    np.testing.assert_array_equal(decode_once(), v1_tokens)
+    assert group.compile_count == warm, \
+        "rolling updates must not recompile"
+    # shape mismatches are rejected before touching the replica
+    bad = dict(params2)
+    bad["proj.w_0"] = bad["proj.w_0"][:, :-1]
+    with pytest.raises(ValueError):
+        group.rolling_update(params=bad, drive=True)
+
+
+def test_load_checkpoint_params_validates(tmp_path):
+    cfg, exe, infer, _logits, _params = _seeded_stack()
+    ckpt = str(tmp_path / "ck")
+    pt.io.save_checkpoint(exe, ckpt, main_program=infer, step=3)
+    arrays = load_checkpoint_params(ckpt)
+    assert "proj.w_0" in arrays
+    # corrupt the payload: validation must refuse it
+    with open(os.path.join(ckpt, "params.npz"), "r+b") as f:
+        f.seek(30)
+        f.write(b"\xff\xff\xff\xff")
+    with pytest.raises(ValueError):
+        load_checkpoint_params(ckpt)
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint_params(str(tmp_path / "nope"))
+
+
+# --------------------------------------------------------------- chaos
+def test_group_worker_crash_zero_dropped_requests():
+    """worker_crash pinned to replica 0 of 2: its in-flight requests
+    resubmit to replica 1 through the GroupFuture, the router skips
+    the corpse, nothing leaks, and ALL requests complete."""
+    maxlen = 12
+    cfg, _exe, _infer, _logits, params = _seeded_stack(maxlen=maxlen)
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   name="chaos", retries=2)
+    rng = np.random.RandomState(29)
+    reqs = []
+    for _ in range(5):
+        n = int(rng.randint(3, maxlen))
+        reqs.append((rng.randint(2, 60, (n,)).astype("int64"), n, 5))
+    chaos.configure("worker_crash:at=2,replica=0")
+    try:
+        futures = [group.submit(s, src_len=n, max_new_tokens=mn)
+                   for s, n, mn in reqs]
+        results = _pump(group, futures)
+    finally:
+        chaos.reset()
+    assert len(results) == len(reqs)
+    assert all(len(r.tokens) > 0 for r in results)
+    restarts = [r.scheduler.restarts for r in group.replicas]
+    assert restarts[0] == 1, restarts
+    assert restarts[1] == 0, \
+        "the replica= filter must confine the fault to replica 0"
+    for r in group.replicas:
+        r.scheduler.pool.check()
+        assert r.scheduler.pool.free_count() == 2
+
+
+# ------------------------------------------- server / HTTP integration
+class _FakeGroup:
+    """Duck-typed replica group for transport-level tests."""
+
+    def __init__(self):
+        self.started = False
+        self.updates = []
+
+    def start(self):
+        self.started = True
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        pass
+
+    def stats(self):
+        return {"name": "fg", "version": 1,
+                "replicas": [{"index": 0, "slots_in_use": 1}]}
+
+    def rolling_update(self, params=None, checkpoint_dir=None,
+                       version=None, **kw):
+        self.updates.append((version, checkpoint_dir))
+        return version or 2
+
+
+def test_model_server_farm_surface():
+    server = ModelServer()
+    fake = _FakeGroup()
+    server.attach_decoder("nmt", fake)
+    assert fake.started
+    assert server.decoders() == {"nmt": fake}
+    assert server.rolling_update("nmt", params={"w": 1},
+                                 version=7) == 7
+    assert fake.updates == [(7, None)]
+    with pytest.raises(KeyError):
+        server.rolling_update("ghost", params={})
+
+    class _PlainSched:
+        def start(self):
+            return self
+
+        def stop(self, **kw):
+            pass
+
+    server2 = ModelServer()
+    server2.attach_decoder("solo", _PlainSched())
+    with pytest.raises(TypeError):
+        server2.rolling_update("solo", params={})
+    server.shutdown(drain=False)
+    server2.shutdown(drain=False)
+
+
+def test_http_farm_route():
+    server = ModelServer()
+    server.attach_decoder("nmt", _FakeGroup())
+    with HttpFrontend(server, port=0) as fe:
+        import urllib.request
+        with urllib.request.urlopen(f"{fe.url}/v1/farm",
+                                    timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+    assert body["farms"]["nmt"]["replicas"][0]["slots_in_use"] == 1
+    server.shutdown(drain=False)
+
+
+# --------------------------------------- telemetry / fleet / tpustat
+def test_replica_gauges_fleet_rollup_and_tpustat(tmp_path, capsys):
+    """serving.replica.<i>.* gauges land in the fleet per-rank report
+    (serving_replicas + token rollup) and render as the tpustat
+    replica table."""
+    tm.enable()
+    maxlen = 12
+    cfg, _exe, _infer, _logits, params = _seeded_stack(maxlen=maxlen)
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   name="telefarm")
+    _pump(group, [group.submit(np.arange(2, 7), src_len=5,
+                               max_new_tokens=4)])
+    stats = group.stats()
+    assert {r["index"] for r in stats["replicas"]} == {0, 1}
+    assert sum(r["tokens_total"] for r in stats["replicas"]) == 4
+    assert all(r["alive"] for r in stats["replicas"])
+
+    tf.configure(rank=0, world=1, spool_dir=str(tmp_path))
+    tf.write_rank_snapshot()
+    rep = tf.FleetCollector().collect(str(tmp_path)).report()
+    pr = rep["per_rank"]["0"]
+    assert set(pr["serving_replicas"]) == {"0", "1"}
+    assert pr["serving_tokens_total"] == 4
+    assert pr["serving_replicas"]["0"]["num_slots"] == 2
+
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tpustat_farm_test", os.path.join(REPO, "tools",
+                                          "tpustat.py"))
+    tpustat = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tpustat)
+    tpustat._print_replica_table(rep)
+    out = capsys.readouterr().out
+    assert "serving replicas: 2" in out
+    assert "tokens" in out and "ok" in out
+
+
+# ------------------------------------------------------ subprocess gate
+def test_tpuserve_selftest_farm_subprocess():
+    """The tpufarm CI gate: group parity at the compile pin, int8
+    parity bound with its logit-delta report, one-replica-down chaos
+    with zero drops, rolling update serving both versions."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    env.pop("PADDLE_TPU_CHAOS", None)
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpuserve.py"),
+         "--selftest-farm", "--json"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["ok"] is True and obj["problems"] == []
+    assert obj["parity"]["mismatches"] == 0
+    assert obj["int8_kv"]["token_mismatch_rate"] <= 0.02
+    assert obj["int8_kv"]["max_logit_delta"] < 0.5
+    assert obj["chaos"]["served"] == obj["chaos"]["requests"]
+    assert obj["rolling"]["dropped"] == 0
+    assert obj["rolling"]["mixed_versions_observed"] is True

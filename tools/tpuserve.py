@@ -33,6 +33,18 @@ Modes:
                    model: goodput (useful tokens/s), p50/p99
                    time-to-first-token and per-token latency; writes
                    the BENCH_decode.json artifact.
+  --selftest-farm  the tpufarm CI gate: a 2-replica group with
+                   disaggregated prefill must be token-identical to
+                   greedy_decode at the group compile pin, int8
+                   block-quantized KV must match fp32 tokens within
+                   the parity bound (max logit delta reported), one
+                   replica crashed by chaos must not drop a single
+                   request, and a rolling weight update must serve
+                   both versions mid-update with zero drops.
+  --bench-farm     replica-group serving across the farm axes (1 vs 2
+                   replicas, fp32 vs int8 KV, pooled vs disaggregated
+                   prefill): slots/device and goodput/device per
+                   case; writes the BENCH_decode2.json artifact.
 
 Examples:
   python tools/tpuserve.py /models/mnist --name mnist --port 8500
@@ -40,6 +52,8 @@ Examples:
   python tools/tpuserve.py --selftest --json
   python tools/tpuserve.py --selftest-decode --json
   python tools/tpuserve.py --bench-decode --duration 5 --json
+  python tools/tpuserve.py --selftest-farm --json
+  python tools/tpuserve.py --bench-farm --duration 5 --json
 """
 import argparse
 import json
@@ -734,6 +748,462 @@ def run_bench_decode(args):
     return 0
 
 
+# ------------------------------------------------------------------- farm
+def _farm_group(cfg, params, replicas, slots, maxlen, buckets,
+                prefill_devices=0, kv_quant=None, name="farm",
+                max_queue=64, retries=1):
+    from paddle_tpu.serving.decode import (DecodeConfig,
+                                           DecodeEngineConfig)
+    from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup
+    return ReplicaGroup(cfg, params, FarmConfig(
+        replicas=replicas, prefill_devices=prefill_devices,
+        engine=DecodeEngineConfig(num_slots=slots, max_len=maxlen,
+                                  prefill_buckets=buckets,
+                                  kv_quant=kv_quant),
+        decode=DecodeConfig(bos=0, max_queue_requests=max_queue),
+        retries=retries), name=name)
+
+
+def _pump_group(group, futures, problems, label, budget=800):
+    """Drive a non-started group until every future resolves; crashed
+    requests are resubmitted by GroupFuture on the result() poll."""
+    from paddle_tpu.resilience.chaos import ChaosFault
+    results = {}
+    pending = dict(enumerate(futures))
+    left = budget
+    while pending and left:
+        left -= 1
+        for i, f in list(pending.items()):
+            if not f.done():
+                continue
+            try:
+                results[i] = f.result(timeout=0)
+                del pending[i]
+            except TimeoutError:
+                pass            # resubmitted to another replica
+        if pending:
+            try:
+                group.run_iteration()
+            except ChaosFault as e:
+                # manual drive has no supervisor thread: reclaim the
+                # crashed replica's slots by hand, like _loop_guarded
+                rep = group.replicas[0]
+                rep.scheduler._crash_recover(e)
+                rep.scheduler.restarts += 1
+    if pending:
+        problems.append(f"farm {label}: {len(pending)} requests never "
+                        f"completed in {budget} iterations")
+    return results
+
+
+def _farm_parity_leg(problems, cfg, exe, infer, logits, params,
+                     maxlen, buckets):
+    """Leg 1: a 2-replica group with disaggregated prefill must be
+    token-identical to one-at-a-time greedy_decode, spread load across
+    both replicas, and stay at the group-level compile pin."""
+    import numpy as np
+    from paddle_tpu import telemetry
+    from paddle_tpu.models.transformer import greedy_decode
+
+    slots = 4
+    group = _farm_group(cfg, params, replicas=2, slots=slots,
+                        maxlen=maxlen, buckets=buckets,
+                        prefill_devices=1, name="selftest")
+    warm = group.compile_count
+    if warm != len(buckets) + 1:
+        problems.append(
+            f"farm warmup built {warm} executables for 2 replicas, "
+            f"expected {len(buckets)} shared prefill buckets + 1 "
+            f"shared step")
+
+    rng = np.random.RandomState(11)
+    reqs = _decode_requests(rng, 8, maxlen, cfg.trg_vocab,
+                            group.replicas[0].engine.max_new_tokens)
+    expected = []
+    for src, n, max_new in reqs:
+        row = np.zeros((1, maxlen), np.int64)
+        row[0, :n] = src
+        ids = greedy_decode(exe, infer, logits, row,
+                            np.array([n], "int64"), bos=0,
+                            fetch_argmax=True)
+        expected.append(ids[0, 1:1 + max_new])
+    futures = [group.submit(src, src_len=n, max_new_tokens=mn)
+               for src, n, mn in reqs]
+    results = _pump_group(group, futures, problems, "parity")
+    mismatches = sum(
+        1 for i, r in results.items()
+        if not np.array_equal(np.asarray(r.tokens, np.int64),
+                              expected[i]))
+    if mismatches:
+        problems.append(
+            f"{mismatches}/{len(reqs)} farm-decoded outputs differ "
+            f"from greedy_decode — routing or the prefill handoff "
+            f"changed the tokens")
+    spread = [r.scheduler.tokens_generated for r in group.replicas]
+    if min(spread) == 0:
+        problems.append(f"router sent every request to one replica "
+                        f"(tokens per replica: {spread})")
+    if group.compile_count != warm:
+        problems.append(
+            f"farm compiled {group.compile_count - warm} NEW "
+            f"executables under traffic")
+    for r in group.replicas:
+        r.scheduler.pool.check()
+        if r.scheduler.pool.free_count() != slots:
+            problems.append(f"replica {r.index} leaked slots")
+    handoffs = telemetry.counter("serving.decode.handoffs").value
+    if not handoffs:
+        problems.append("disaggregated prefill never handed KV "
+                        "device-to-device")
+    return {"compile_count": warm, "requests": len(reqs),
+            "mismatches": mismatches, "tokens_per_replica": spread,
+            "prefill_devices": [str(d)
+                                for d in group.prefill_devices],
+            "handoffs": int(handoffs)}
+
+
+def _farm_int8_leg(problems, cfg, params, maxlen):
+    """Leg 2: int8 block-quantized KV vs the fp32 cache on the SAME
+    weights, teacher-forced so per-step logits stay comparable."""
+    import jax
+    import numpy as np
+    from paddle_tpu.models.transformer import IncrementalDecoder
+
+    devs = jax.devices()
+    dec_f = IncrementalDecoder(cfg, params, num_slots=2,
+                               max_len=maxlen, return_logits=True,
+                               device=devs[0])
+    dec_q = IncrementalDecoder(cfg, params, num_slots=2,
+                               max_len=maxlen, return_logits=True,
+                               kv_quant="int8",
+                               device=devs[1 % len(devs)])
+    rng = np.random.RandomState(3)
+    mismatch = total = 0
+    max_delta = 0.0
+    for n0, n1 in ((3, 5), (7, 10), (12, maxlen - 1)):
+        src = np.zeros((2, dec_f.src_max_len), np.int64)
+        src[0, :n0] = rng.randint(2, cfg.src_vocab - 2, n0)
+        src[1, :n1] = rng.randint(2, cfg.src_vocab - 2, n1)
+        sl = np.array([n0, n1], "int64")
+        st_f = dec_f.write_slots(dec_f.init_state(),
+                                 dec_f.prefill(src, sl), [0, 1])
+        st_q = dec_q.write_slots(dec_q.init_state(),
+                                 dec_q.prefill(src, sl), [0, 1])
+        ids = np.zeros(2, np.int64)
+        pos = np.zeros(2, np.int64)
+        for _ in range(8):
+            nf = dec_f.step(st_f, ids, pos)
+            lf = dec_f.last_logits[:2].copy()
+            nq = dec_q.step(st_q, ids, pos)
+            lq = dec_q.last_logits[:2].copy()
+            max_delta = max(max_delta,
+                            float(np.max(np.abs(lf - lq))))
+            mismatch += int((nf[:2] != nq[:2]).sum())
+            total += 2
+            ids[:2] = nf[:2]        # teacher-force the fp32 choice
+            pos += 1
+    rate = mismatch / total
+    if rate > 0.02:
+        problems.append(
+            f"int8 KV cache diverged: {mismatch}/{total} tokens "
+            f"differ from fp32 (bound 2%); max logit delta "
+            f"{max_delta:.4f}")
+    fb, qb = dec_f.kv_cache_bytes(), dec_q.kv_cache_bytes()
+    if qb >= fb:
+        problems.append(f"int8 KV cache is not smaller: {qb} vs "
+                        f"{fb} bytes")
+    return {"token_mismatch_rate": round(rate, 4),
+            "max_logit_delta": round(max_delta, 6),
+            "kv_bytes_fp32": fb, "kv_bytes_int8": qb,
+            "kv_ratio": round(qb / fb, 3)}
+
+
+def _farm_chaos_leg(problems, cfg, params, maxlen, buckets):
+    """Leg 3: worker_crash on replica 0 of 2 (threaded) — the group
+    must serve every request anyway: router skips the dead replica,
+    GroupFuture resubmits the crashed ones, no slot leaks."""
+    import numpy as np
+    from paddle_tpu.resilience import chaos as _chaos
+
+    slots = 4
+    group = _farm_group(cfg, params, replicas=2, slots=slots,
+                        maxlen=maxlen, buckets=buckets,
+                        name="chaosfarm", retries=2)
+    rng = np.random.RandomState(29)
+    reqs = _decode_requests(rng, 6, maxlen, cfg.trg_vocab,
+                            group.replicas[0].engine.max_new_tokens)
+    _chaos.configure("worker_crash:at=2,replica=0")
+    try:
+        futures = [group.submit(src, src_len=n, max_new_tokens=mn)
+                   for src, n, mn in reqs]
+        group.start()
+        served = 0
+        for f in futures:
+            try:
+                r = f.result(timeout=60.0)
+                if len(r.tokens) > 0:
+                    served += 1
+            except Exception as e:      # noqa: BLE001 — a drop
+                problems.append(f"farm chaos leg dropped a request: "
+                                f"{type(e).__name__}: {e}")
+    finally:
+        _chaos.reset()
+        group.stop(drain=True, timeout=10.0)
+    restarts = [r.scheduler.restarts for r in group.replicas]
+    if restarts[0] < 1:
+        problems.append("chaos worker_crash replica=0 never fired "
+                        f"(restarts {restarts})")
+    if served != len(reqs):
+        problems.append(f"one-replica-down served {served}/"
+                        f"{len(reqs)} — the group dropped requests")
+    for r in group.replicas:
+        r.scheduler.pool.check()
+    return {"requests": len(reqs), "served": served,
+            "restarts": restarts}
+
+
+def _farm_rolling_leg(problems, cfg, params, maxlen):
+    """Leg 4: rolling weight update under live traffic — zero dropped
+    requests, both versions observed serving mid-update, zero new
+    compiles from the weight swap."""
+    import numpy as np
+
+    slots = 2
+    group = _farm_group(cfg, params, replicas=2, slots=slots,
+                        maxlen=maxlen, buckets=(1, 2),
+                        name="rollfarm", max_queue=64).start()
+    params2 = {k: (v + 0.05 * np.random.RandomState(99)
+                   .randn(*v.shape)).astype(v.dtype)
+               for k, v in params.items()}
+    rng = np.random.RandomState(41)
+    reqs = _decode_requests(rng, 32, maxlen, cfg.trg_vocab, 8)
+    stop = threading.Event()
+    lock = threading.Lock()
+    completed, errors = [0], []
+
+    def client(wid):
+        i = wid
+        while not stop.is_set():
+            src, n, mn = reqs[i % len(reqs)]
+            i += 4
+            try:
+                group.submit(src, src_len=n,
+                             max_new_tokens=mn).result(timeout=30.0)
+                with lock:
+                    completed[0] += 1
+            except Exception as e:      # noqa: BLE001 — a drop
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+
+    versions_seen = set()
+
+    def watcher():
+        while not stop.is_set():
+            versions_seen.add(
+                tuple(r.version for r in group.replicas))
+            time.sleep(0.0002)
+
+    threads = [threading.Thread(target=client, args=(w,), daemon=True)
+               for w in range(4)]
+    threads.append(threading.Thread(target=watcher, daemon=True))
+    pre_compiles = group.compile_count
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.2)
+        group.rolling_update(params=params2, drain_timeout=30.0)
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+        group.stop(drain=True, timeout=10.0)
+    if errors:
+        problems.append(f"rolling update dropped {len(errors)} "
+                        f"requests (first: {errors[0]})")
+    mixed = any(len(set(v)) == 2 for v in versions_seen)
+    if not mixed:
+        problems.append(
+            f"rolling update never served both versions at once "
+            f"(version snapshots: {sorted(versions_seen)})")
+    if group.version != 2 or any(r.version != 2
+                                 for r in group.replicas):
+        problems.append("rolling update did not land version 2 on "
+                        "every replica")
+    if group.compile_count != pre_compiles:
+        problems.append(
+            f"rolling update recompiled "
+            f"({group.compile_count - pre_compiles} new executables "
+            f"— the weight swap must reuse the traces)")
+    return {"completed": completed[0], "dropped": len(errors),
+            "mixed_versions_observed": mixed,
+            "version_snapshots": sorted(versions_seen)}
+
+
+def _farm_selftest_problems(problems):
+    """The tpufarm CI gate: replica-group parity + compile pin, int8
+    KV parity bound, one-replica-down chaos, rolling update."""
+    maxlen, buckets = 16, (1, 2, 4)
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+    info = {"parity": _farm_parity_leg(problems, cfg, exe, infer,
+                                       logits, params, maxlen,
+                                       buckets),
+            "int8_kv": _farm_int8_leg(problems, cfg, params, maxlen),
+            "chaos": _farm_chaos_leg(problems, cfg, params, maxlen,
+                                     buckets),
+            "rolling": _farm_rolling_leg(problems, cfg, params,
+                                         maxlen)}
+    return info
+
+
+def run_selftest_farm(args):
+    from paddle_tpu import telemetry
+    telemetry.enable()
+    problems = []
+    info = _farm_selftest_problems(problems)
+    result = {"mode": "selftest-farm", **info,
+              "problems": problems, "ok": not problems}
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    else:
+        p = info["parity"]
+        q = info["int8_kv"]
+        print(f"tpuserve selftest-farm: {p['compile_count']} "
+              f"executables for 2 replicas, "
+              f"{p['mismatches']}/{p['requests']} greedy mismatches, "
+              f"int8 KV {q['kv_ratio']}x bytes "
+              f"(max logit delta {q['max_logit_delta']}), chaos "
+              f"served {info['chaos']['served']}/"
+              f"{info['chaos']['requests']}, rolling dropped "
+              f"{info['rolling']['dropped']}")
+        for prob in problems:
+            print(f"FAIL: {prob}", file=sys.stderr)
+    return 2 if problems else 0
+
+
+def run_bench_farm(args):
+    """Replica-group serving across the farm axes — 1 vs 2 replicas,
+    fp32 vs int8 KV, pooled vs disaggregated prefill — each as a
+    closed loop at ~5x total slots. Writes BENCH_decode2.json."""
+    import numpy as np
+    from paddle_tpu import telemetry
+    from paddle_tpu.serving import RejectedError
+    telemetry.enable()
+
+    maxlen = args.decode_max_len
+    slots = args.slots
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+    rng = np.random.RandomState(23)
+    # short prompts: the self-attn cache (the part int8 shrinks)
+    # dominates the cross caches
+    src_cap = max(4, maxlen // 2)
+    reqs = _decode_requests(rng, 256, src_cap, cfg.trg_vocab,
+                            maxlen - 1)
+
+    cases = [
+        ("r1_fp32_pooled", 1, None, 0),
+        ("r1_int8_pooled", 1, "int8", 0),
+        ("r2_fp32_pooled", 2, None, 0),
+        ("r2_int8_pooled", 2, "int8", 0),
+        ("r2_fp32_disagg", 2, None, 1),
+        ("r2_int8_disagg", 2, "int8", 1),
+    ]
+    out_cases = {}
+    for cname, replicas, kv, pdev in cases:
+        group = _farm_group(
+            cfg, params, replicas=replicas, slots=slots,
+            maxlen=maxlen, buckets=None, kv_quant=kv,
+            prefill_devices=pdev, name=cname,
+            max_queue=8 * slots * replicas).start()
+        total_slots = group.num_slots
+        stop_t = time.monotonic() + args.duration
+        lock = threading.Lock()
+        done_tokens, rejects = [0], [0]
+
+        def client(wid, _stop=stop_t, _g=group):
+            i = wid
+            while time.monotonic() < _stop:
+                src, n, mn = reqs[i % len(reqs)]
+                i += 5 * total_slots
+                try:
+                    r = _g.submit(src, src_len=n,
+                                  max_new_tokens=mn).result(
+                        timeout=max(5.0, args.duration))
+                except RejectedError:
+                    with lock:
+                        rejects[0] += 1
+                    time.sleep(0.002)
+                    continue
+                except TimeoutError:
+                    continue
+                with lock:
+                    done_tokens[0] += len(r.tokens)
+
+        clients = [threading.Thread(target=client, args=(w,),
+                                    daemon=True)
+                   for w in range(5 * total_slots)]
+        t0 = time.monotonic()
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join()
+        dt = time.monotonic() - t0
+        group.stop(drain=False, timeout=10.0)
+        # devices actually computing (each engine is pinned to one
+        # decode device), not the whole owned slice
+        devices = {str(r.engine.device) for r in group.replicas}
+        devices |= {str(d) for d in group.prefill_devices}
+        goodput = done_tokens[0] / dt
+        out_cases[cname] = {
+            "replicas": replicas,
+            "kv_quant": kv or "fp32",
+            "prefill": "disaggregated" if pdev else "pooled",
+            "devices": len(devices),
+            "total_slots": total_slots,
+            "slots_per_device": round(total_slots / len(devices), 3),
+            "goodput_tokens_per_s": round(goodput, 1),
+            "goodput_per_device": round(goodput / len(devices), 1),
+            "kv_cache_bytes_per_replica":
+                group.replicas[0].engine.kv_cache_bytes,
+            "completed_tokens": done_tokens[0],
+            "rejected": rejects[0],
+            "compile_count": group.compile_count,
+        }
+        if not args.as_json:
+            c = out_cases[cname]
+            print(f"  {cname:<16} {c['goodput_tokens_per_s']:>8} "
+                  f"tok/s  {c['goodput_per_device']:>8} tok/s/dev  "
+                  f"{c['slots_per_device']:>5} slots/dev  KV "
+                  f"{c['kv_cache_bytes_per_replica']} B")
+
+    curves = {}
+    for kv in ("fp32", "int8"):
+        for pf in ("pooled", "disaggregated"):
+            pts = sorted(
+                ({"replicas": c["replicas"],
+                  "slots_per_device": c["slots_per_device"],
+                  "goodput_per_device": c["goodput_per_device"]}
+                 for c in out_cases.values()
+                 if c["kv_quant"] == kv and c["prefill"] == pf),
+                key=lambda p: p["replicas"])
+            if pts:
+                curves[f"{kv}_{pf}"] = pts
+    result = {"mode": "bench-farm", "model": "transformer-tiny",
+              "maxlen": maxlen, "slots_per_replica": slots,
+              "duration_s": args.duration, "cases": out_cases,
+              "curves": curves}
+    out_path = os.path.join(_REPO, "BENCH_decode2.json")
+    try:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+    except OSError:
+        pass
+    if args.as_json:
+        print(json.dumps(result))
+    return 0
+
+
 # ------------------------------------------------------------------ serve
 def run_serve(args):
     from paddle_tpu import telemetry
@@ -799,6 +1269,17 @@ def main(argv=None):
                    help="continuous decode vs the fixed-batch "
                         "greedy_decode path at ~10x overload; writes "
                         "BENCH_decode.json")
+    p.add_argument("--selftest-farm", action="store_true",
+                   dest="selftest_farm",
+                   help="the tpufarm CI gate: replica-group parity + "
+                        "compile pin, int8 KV parity bound, one-"
+                        "replica-down chaos with zero drops, rolling "
+                        "update serving both versions")
+    p.add_argument("--bench-farm", action="store_true",
+                   dest="bench_farm",
+                   help="replica-group bench across 1 vs 2 replicas, "
+                        "fp32 vs int8 KV, pooled vs disaggregated "
+                        "prefill; writes BENCH_decode2.json")
     p.add_argument("--slots", type=int, default=8,
                    help="--bench-decode slot-pool size")
     p.add_argument("--decode-max-len", type=int, default=32,
@@ -810,15 +1291,28 @@ def main(argv=None):
 
     if args.platform != "env":
         os.environ["JAX_PLATFORMS"] = args.platform
+    if args.selftest_farm or args.bench_farm:
+        # the farm slices real devices: give the CPU backend 8
+        # virtual ones (must land before jax is first imported)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8") \
+                .strip()
     if args.selftest:
         return run_selftest(args)
     if args.selftest_decode:
         return run_selftest_decode(args)
     if args.bench_decode:
         return run_bench_decode(args)
+    if args.selftest_farm:
+        return run_selftest_farm(args)
+    if args.bench_farm:
+        return run_bench_farm(args)
     if not args.model_dir:
         p.error("model_dir is required unless --selftest / "
-                "--selftest-decode / --bench-decode")
+                "--selftest-decode / --bench-decode / "
+                "--selftest-farm / --bench-farm")
     if args.bench:
         return run_bench(args)
     return run_serve(args)

@@ -295,8 +295,39 @@ def _print_fleet_table(rep):
                  f"({d.get('bytes', 0) / 1024:.1f} KB)"
                  for op, d in sorted(rep["collectives"].items())]
         print("  collectives (trace-time): " + ", ".join(parts))
+    _print_replica_table(rep)
     if strag.get("hint"):
         print(f"  hint: {strag['hint']}")
+
+
+def _print_replica_table(rep):
+    """Serving-farm sub-table: one row per decode replica, from the
+    serving.replica.<i>.* gauges (ranks serving no farm print
+    nothing)."""
+    rows = []
+    for r in rep["ranks"]:
+        pr = rep["per_rank"][str(r)]
+        for idx, d in sorted(
+                (pr.get("serving_replicas") or {}).items(),
+                key=lambda kv: int(kv[0]) if kv[0].isdigit() else 0):
+            rows.append((r, idx, d))
+    if not rows:
+        return
+    print(f"  serving replicas: {len(rows)}")
+    print(f"    {'rank':<5} {'rep':>3} {'ver':>4} {'slots':>7} "
+          f"{'queue':>6} {'kv_MB':>7} {'tokens':>8} {'tok/s':>8} "
+          f"{'restarts':>8}  state")
+    for r, idx, d in rows:
+        state = "down" if not d.get("alive", 1.0) else (
+            "draining" if d.get("draining") else "ok")
+        print(f"    {r:<5} {idx:>3} {int(d.get('version', 1)):>4} "
+              f"{int(d.get('slots_in_use', 0)):>3}/"
+              f"{int(d.get('num_slots', 0)):<3} "
+              f"{int(d.get('queue_depth', 0)):>6} "
+              f"{d.get('kv_cache_bytes', 0) / 1e6:>7.2f} "
+              f"{int(d.get('tokens_total', 0)):>8} "
+              f"{d.get('goodput_tps', 0.0):>8.1f} "
+              f"{int(d.get('restarts', 0)):>8}  {state}")
 
 
 def _fleet_report(spool, as_json, trace_path):
