@@ -54,6 +54,24 @@ Faults and their injection points:
       point ``executor.step`` — a planned grow/shrink request arrives
       at step hit N: raise ResizeFault(to=M), which the elastic layer
       (resilience/elastic.py) answers by re-forming the mesh at M.
+  ``replica_slow:ms=M[,replica=R][,at=N|every=K|prob=P]``
+      point ``serving.worker`` — sleep M milliseconds inside the
+      decode iteration (straggler replica simulation: the loop stays
+      alive but every request routed there inherits the stall). A
+      bare ``replica_slow:ms=M`` defaults to ``every=1`` — persistent
+      slowness — unlike other faults, whose bare form fires once.
+  ``replica_flap:at=N[,times=K][,replica=R]``
+      point ``serving.worker`` — kill the decode loop like
+      ``worker_crash``, but typically with ``times=K`` so the replica
+      crashes in a burst, respawns, and crashes again (the flapping
+      pattern the guard tier's health probation must eject and, once
+      the burst is exhausted, re-admit via probe traffic).
+  ``request_poison:at=N[,times=K]``
+      point ``serving.request`` — the N-th request submitted through a
+      ReplicaGroup is tagged poisoned; the replica that admits it
+      crashes when it steps (and crashes AGAIN on every resubmission,
+      because the tag rides the request). Proves the guard isolates a
+      bad REQUEST without condemning the replicas it burns through.
 
 Counting: every point keeps a process-wide hit counter (1-based).
 ``at=N`` fires on hit N; ``times=K`` keeps firing through hit N+K-1;
@@ -89,6 +107,9 @@ POINTS = {
     "compile_fail": "inference.compile",
     "barrier_fail": "fleet.barrier",
     "worker_crash": "serving.worker",
+    "replica_slow": "serving.worker",
+    "replica_flap": "serving.worker",
+    "request_poison": "serving.request",
     "rank_lost": "executor.step",
     "resize": "executor.step",
 }
@@ -188,6 +209,12 @@ def _parse_fault(text):
         raise ChaosSpecError("ckpt_torn needs byte=B")
     if name == "collective_delay" and "ms" not in fault:
         raise ChaosSpecError("collective_delay needs ms=M")
+    if name == "replica_slow":
+        if "ms" not in fault:
+            raise ChaosSpecError("replica_slow needs ms=M")
+        # a straggler is slow on EVERY iteration unless told otherwise
+        if not any(k in fault for k in ("at", "every", "prob")):
+            fault["every"] = 1
     if name == "resize":
         if "to" not in fault:
             raise ChaosSpecError("resize needs to=M (the new world size)")
@@ -324,7 +351,7 @@ def enact(fault, detail=""):
     exception for the *_fail transients, ChaosFault otherwise.
     collective_delay sleeps and returns."""
     name = fault["name"]
-    if name == "collective_delay":
+    if name in ("collective_delay", "replica_slow"):
         time.sleep(fault["ms"] / 1000.0)
         return
     if fault.get("mode") == "kill":

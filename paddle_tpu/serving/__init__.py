@@ -30,11 +30,13 @@ load-test it (`--bench`, `--bench-decode`), or run the CI self-tests
 """
 from .batcher import (BatchConfig, DynamicBatcher, Future,
                       RejectedError, DeadlineExceeded, PreemptedError,
-                      ServerClosed)
+                      ServerClosed, CancelledError,
+                      RetryBudgetExhausted, BrownoutShed)
 from .server import ModelRegistry, ModelServer, ServerConfig
 from .http import HttpFrontend
 
 __all__ = ["BatchConfig", "DynamicBatcher", "Future", "RejectedError",
            "DeadlineExceeded", "PreemptedError", "ServerClosed",
+           "CancelledError", "RetryBudgetExhausted", "BrownoutShed",
            "ModelRegistry", "ModelServer", "ServerConfig",
            "HttpFrontend"]

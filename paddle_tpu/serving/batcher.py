@@ -31,7 +31,8 @@ from ..inference import bucket_feed, default_buckets
 
 __all__ = ["BatchConfig", "DynamicBatcher", "Batch", "Future",
            "RejectedError", "DeadlineExceeded", "ServerClosed",
-           "PreemptedError"]
+           "PreemptedError", "CancelledError", "RetryBudgetExhausted",
+           "BrownoutShed"]
 
 # fixed edges for the batch-size histogram: the registry freezes bucket
 # edges at first creation, so this must not vary with BatchConfig
@@ -56,6 +57,31 @@ class PreemptedError(RejectedError):
     service is up, this tenant is just over its share right now).
     Lives here with the rest of the admission-control vocabulary so
     the HTTP layer never has to import the decode package."""
+
+
+class CancelledError(RejectedError):
+    """Request cancelled by the caller side — normally the losing leg
+    of a hedged request after the other replica already delivered.
+    Clients never see this; it resolves the abandoned future so
+    nothing blocks on it forever."""
+
+
+class RetryBudgetExhausted(RejectedError):
+    """Resubmission/hedge refused: the group-wide retry token bucket
+    is empty. A retry storm (mass replica death, poisoned request
+    resubmitting forever) degrades into fast typed rejections instead
+    of amplifying the overload (HTTP 429, kind "retry_budget")."""
+
+
+class BrownoutShed(RejectedError):
+    """Request shed by the brownout controller: the group is over its
+    queue-depth / deadline-miss thresholds and this tenant is in the
+    lowest QoS class (HTTP 429, kind "brownout"). `retry_after_s` is
+    the hint surfaced as the Retry-After header."""
+
+    def __init__(self, msg, retry_after_s=1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 class BatchConfig:

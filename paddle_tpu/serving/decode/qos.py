@@ -86,6 +86,24 @@ class QosPolicy:
         with self._lock:
             return dict(self._tenants)
 
+    def weights(self):
+        """name -> weight snapshot of the registered classes."""
+        with self._lock:
+            return {n: t.weight for n, t in self._tenants.items()}
+
+    def lowest_classes(self):
+        """Registered class names sharing the minimum weight — the
+        brownout controller's shed set. Empty when every class weighs
+        the same: "shed the lowest class" must never mean "shed
+        everyone"."""
+        with self._lock:
+            ws = {t.weight for t in self._tenants.values()}
+            if len(ws) < 2:
+                return set()
+            lo = min(ws)
+            return {n for n, t in self._tenants.items()
+                    if t.weight == lo}
+
     def charge(self, name, slot_iterations):
         """Accrue service: `slot_iterations` of machine time
         reserved/used."""

@@ -35,8 +35,8 @@ import numpy as np
 
 from ... import telemetry as _tm
 from ...resilience import chaos as _chaos
-from ..batcher import (DeadlineExceeded, Future, PreemptedError,
-                       RejectedError, ServerClosed)
+from ..batcher import (CancelledError, DeadlineExceeded, Future,
+                       PreemptedError, RejectedError, ServerClosed)
 from .qos import QosPolicy
 from .slots import SlotPool
 
@@ -60,10 +60,11 @@ class DecodeConfig:
 
 class DecodeRequest:
     __slots__ = ("src", "src_len", "tenant", "max_new_tokens",
-                 "deadline", "enqueue_t", "future", "request_id")
+                 "deadline", "enqueue_t", "future", "request_id",
+                 "cancelled", "poisoned")
 
     def __init__(self, src, src_len, tenant, max_new_tokens, deadline,
-                 request_id=None):
+                 request_id=None, poisoned=False):
         self.src = src
         self.src_len = src_len
         self.tenant = tenant
@@ -72,6 +73,12 @@ class DecodeRequest:
         self.enqueue_t = time.monotonic()
         self.future = Future(deadline)
         self.request_id = request_id
+        # set by cancel(): the iteration loop retires the slot (it is
+        # the slot pool's single writer; cancel never frees directly)
+        self.cancelled = False
+        # set by the request_poison chaos fault: stepping this request
+        # crashes its replica (rides resubmissions by design)
+        self.poisoned = poisoned
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
@@ -129,7 +136,7 @@ class ContinuousScheduler:
     # ------------------------------------------------------ caller side
     def submit(self, src, src_len=None, tenant="default",
                max_new_tokens=None, deadline_ms=None,
-               request_id=None):
+               request_id=None, poison=False):
         """Enqueue one sequence; returns a Future resolving to a
         `DecodeResult`. Sheds immediately on a full queue or an
         oversized source (RejectedError) — overload never builds an
@@ -153,7 +160,8 @@ class ContinuousScheduler:
         tenant = str(tenant)
         self.qos.tenant(tenant)        # strict mode rejects here
         req = DecodeRequest(src, src_len, tenant, max_new_tokens,
-                            deadline, request_id=request_id)
+                            deadline, request_id=request_id,
+                            poisoned=poison)
         with self._cond:
             if self._closed:
                 raise ServerClosed("decoder is draining; not "
@@ -184,6 +192,38 @@ class ContinuousScheduler:
         """Blocking convenience: submit + wait -> DecodeResult."""
         return self.submit(src, **kw).result(timeout=timeout)
 
+    def cancel(self, future):
+        """Best-effort cancellation of the request behind `future`
+        (the losing leg of a hedged request). A still-queued request
+        is removed and failed with CancelledError right here; an
+        admitted one is only FLAGGED — the iteration loop retires it
+        and reclaims the slot at the next retire pass, because the
+        slot pool has exactly one writer. Either way the future
+        resolves exactly once: the queue removal happens under the
+        same lock `_admit` pops under, and a flagged slot is touched
+        only by the loop thread. Returns True when the request was
+        found (still pending somewhere), False when it already
+        finished or was never ours."""
+        with self._cond:
+            for tenant, q in self._queues.items():
+                for req in q:
+                    if req.future is future:
+                        q.remove(req)
+                        self._queued -= 1
+                        req.future.set_error(CancelledError(
+                            "cancelled while queued"))
+                        if _tm.enabled():
+                            _tm.counter(
+                                "serving.decode.cancelled_queued").inc()
+                        return True
+        slot = self.pool.find(future)
+        if slot is not None:
+            req = slot.request      # snapshot: loop may retire it
+            if req is not None:
+                req.cancelled = True
+                return True
+        return False
+
     # ------------------------------------------------------- iteration
     def run_iteration(self):
         """One retire/admit/step cycle. Returns the number of active
@@ -195,18 +235,38 @@ class ContinuousScheduler:
         self._drop_expired_queued(now)
         had_work = self.pool.active_count() > 0 or self._queued > 0
         if had_work and _chaos.armed():
-            # the serving.worker chaos point (worker_crash faults):
-            # counted per working iteration, like ModelServer counts
-            # per dequeued batch — deterministic under load
+            # the serving.worker chaos point (worker_crash /
+            # replica_slow / replica_flap faults): counted per working
+            # iteration, like ModelServer counts per dequeued batch —
+            # deterministic under load
             _chaos.check("serving.worker",
                          detail=f"decode loop {self.name}",
                          replica=self.replica_index)
+            # a poisoned request (request_poison fault, tagged at farm
+            # submit so the tag rides resubmissions) kills the replica
+            # that stepped it — the blast the guard must contain
+            for slot in self.pool.active():
+                r = slot.request
+                if r is not None and r.poisoned:
+                    raise _chaos.ChaosFault(
+                        {"name": "request_poison",
+                         "point": "serving.request"},
+                        f"poisoned request in slot {slot.index} of "
+                        f"{self.name}")
         self._admit()
         return self._step_active()
 
     def _retire_deadlines(self, now):
         for slot in self.pool.active():
             req = slot.request
+            if req.cancelled:
+                if not req.future.done():
+                    req.future.set_error(CancelledError(
+                        f"cancelled after {len(slot.tokens)} "
+                        f"generated tokens; slot reclaimed"))
+                self._finish_slot(slot, delivered=False,
+                                  reason="cancelled")
+                continue
             if req.expired(now):
                 req.future.set_error(DeadlineExceeded(
                     f"deadline expired after {len(slot.tokens)} "

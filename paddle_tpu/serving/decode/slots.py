@@ -95,6 +95,15 @@ class SlotPool:
     def slot(self, index):
         return self._slots[index]
 
+    def find(self, future):
+        """The busy slot whose bound request resolves `future`, or
+        None (the cancel path's lookup; also the hedge-leak tests')."""
+        for s in self._slots:
+            r = s.request        # snapshot: callers read cross-thread
+            if r is not None and r.future is future:
+                return s
+        return None
+
     def held_by_tenant(self):
         held = {}
         for s in self._slots:

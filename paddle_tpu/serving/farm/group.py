@@ -42,6 +42,15 @@ story rebuilt TPU-native:
   waiters block), so group warmup cost is per GROUP, not per replica
   — `ReplicaGroup.compile_count` pins the cache's build count.
 
+- **Overload defense (opt-in).** `FarmConfig(guard=GuardConfig(...))`
+  attaches a `serving.guard.GroupGuard`: per-replica health probation
+  / ejection / half-open probing consulted by the router, hedged
+  requests (re-issue at the live p99, loser cancelled and its slot
+  reclaimed), a retry budget shared by hedges and crash
+  resubmissions, and brownout shedding of the lowest QoS class. A
+  group WITHOUT a guard never imports the package and routes exactly
+  as before — pinned by the bench contract.
+
 Telemetry lands under ``serving.replica.<i>.*`` gauges plus
 ``serving.farm.*`` rollups, consumed by tpustat --watch/--fleet and
 the fleet report.
@@ -54,8 +63,9 @@ import numpy as np
 
 from ... import telemetry as _tm
 from ...parallel.mesh import device_slices
+from ...resilience import chaos as _chaos
 from ..batcher import (DeadlineExceeded, PreemptedError, RejectedError,
-                       ServerClosed)
+                       RetryBudgetExhausted, ServerClosed)
 from ..decode import (ContinuousScheduler, DecodeConfig, DecodeEngine,
                       DecodeEngineConfig)
 from .router import LeastLoadedRouter
@@ -121,13 +131,18 @@ class FarmConfig:
     devices: explicit device list to slice (default: all local).
     share_compiles: share jit traces across replicas (single-flight).
     retries: how many times a GroupFuture resubmits a crash-failed
-        request to another replica before giving up.
+        request to another replica before giving up (with a guard,
+        additionally capped by the group retry budget).
     qos_factory: () -> QosPolicy per replica (None = default WFQ).
+    guard: a `serving.guard.GuardConfig` (or True for defaults) to
+        attach overload defense — health probation, hedging, retry
+        budget, brownout. None (default) adds nothing: the guard
+        package is not even imported.
     """
 
     def __init__(self, replicas=2, prefill_devices=0, engine=None,
                  decode=None, devices=None, share_compiles=True,
-                 retries=1, qos_factory=None):
+                 retries=1, qos_factory=None, guard=None):
         self.replicas = int(replicas)
         if self.replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
@@ -138,6 +153,7 @@ class FarmConfig:
         self.share_compiles = bool(share_compiles)
         self.retries = int(retries)
         self.qos_factory = qos_factory
+        self.guard = guard
 
 
 class Replica:
@@ -167,7 +183,14 @@ class GroupFuture:
     (loop crash — e.g. an injected worker_crash) rather than by a
     structured shed (deadline / preemption / rejection / shutdown
     propagate unchanged). Bounded by the group's `retries` budget and
-    the caller's timeout."""
+    the caller's timeout.
+
+    With a guard configured, `result()` runs the guarded path instead:
+    it races a candidate set (primary + at most one hedge launched at
+    the live-p99 delay), cancels the losing leg so its slot is
+    reclaimed, feeds every leg's outcome to the health tracker, and
+    draws resubmissions from the group retry budget — exhaustion is a
+    fast typed `RetryBudgetExhausted`, not a storm."""
 
     def __init__(self, group, kwargs, replica, future, retries):
         self._group = group
@@ -176,8 +199,17 @@ class GroupFuture:
         self._future = future
         self._retries = retries
         self._failed = set()
+        self._guard = group.guard
+        if self._guard is not None:
+            # candidate legs racing for this request: primary now,
+            # plus at most one hedge later
+            self._cands = [{"rep": replica, "fut": future,
+                            "t0": time.monotonic(), "hedge": False}]
+            self._hedged = False
 
     def done(self):
+        if self._guard is not None:
+            return any(c["fut"].done() for c in self._cands)
         return self._future.done()
 
     @property
@@ -186,6 +218,8 @@ class GroupFuture:
         return self._replica.index
 
     def result(self, timeout=None):
+        if self._guard is not None:
+            return self._result_guarded(timeout)
         deadline = None if timeout is None \
             else time.monotonic() + timeout
         while True:
@@ -211,6 +245,113 @@ class GroupFuture:
                     _tm.counter("serving.farm.retries").inc()
                 self._replica, self._future = rep, fut
 
+    # ------------------------------------------------- guarded path
+    def _result_guarded(self, timeout):
+        g = self._guard
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            for c in list(self._cands):
+                if not c["fut"].done():
+                    continue
+                try:
+                    res = c["fut"].result(timeout=0)
+                except (DeadlineExceeded, PreemptedError,
+                        RejectedError, ServerClosed) as e:
+                    # a structured shed, not a replica death: drop the
+                    # leg; only when it was the LAST leg does the shed
+                    # become the caller's answer
+                    self._cands.remove(c)
+                    if isinstance(e, DeadlineExceeded):
+                        g.on_deadline_miss()
+                    if not self._cands:
+                        raise
+                except TimeoutError:
+                    continue          # raced done(); not resolved yet
+                except Exception as e:  # noqa: BLE001 — replica death
+                    self._cands.remove(c)
+                    self._failed.add(c["rep"])
+                    g.on_error(c["rep"].index)
+                    if not self._cands:
+                        self._resubmit(e)   # refills or raises typed
+                else:
+                    self._settle(c, time.monotonic() - c["t0"])
+                    return res
+            self._maybe_hedge()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError("timed out waiting for result")
+            time.sleep(g.poll_s)
+
+    def _settle(self, winner, latency_s):
+        """First completion wins: record health, cancel the losers
+        (their decode slots are reclaimed by the loser's iteration
+        loop — the pool's single writer)."""
+        g = self._guard
+        g.on_result(winner["rep"].index, latency_s,
+                    hedge=winner["hedge"])
+        for c in self._cands:
+            if c is not winner and c["rep"].scheduler.cancel(c["fut"]):
+                g.on_cancelled()
+        self._cands = [winner]
+        self._replica, self._future = winner["rep"], winner["fut"]
+
+    def _maybe_hedge(self):
+        """Launch the backup leg once the primary has been pending
+        longer than the hedge delay (live p99 derived). At most one
+        hedge per request; denied budgets mean no hedge, never an
+        error."""
+        g = self._guard
+        if self._hedged or len(self._cands) != 1:
+            return
+        c0 = self._cands[0]
+        delay = g.hedge_delay()
+        if delay is None or time.monotonic() - c0["t0"] < delay:
+            return
+        self._hedged = True
+        if not g.allow_hedge():
+            return
+        exclude = set(self._failed)
+        exclude.add(c0["rep"])
+        try:
+            rep, fut = self._group._route(self._kwargs,
+                                          exclude=exclude)
+        except RejectedError:
+            g.refund_hedge()        # nowhere to hedge to
+            return
+        g.on_hedge()
+        if _tm.enabled():
+            _tm.instant_event(
+                "serving.guard.hedge", farm=self._group.name,
+                primary=c0["rep"].index, hedge=rep.index,
+                request_id=self._kwargs.get("request_id"))
+        self._cands.append({"rep": rep, "fut": fut,
+                            "t0": time.monotonic(), "hedge": True})
+
+    def _resubmit(self, exc):
+        """All legs died with their replicas: resubmit if both the
+        per-request retry count and the group retry budget allow,
+        else fail fast and typed."""
+        g = self._guard
+        if self._retries <= 0:
+            raise exc
+        if not g.allow_resubmit():
+            raise RetryBudgetExhausted(
+                f"farm {self._group.name!r}: retry budget exhausted "
+                f"resubmitting after {type(exc).__name__}") from exc
+        self._retries -= 1
+        rep, fut = self._group._route(self._kwargs,
+                                      exclude=self._failed)
+        g.on_resubmit()
+        _LOG.warning(
+            "farm %s: request resubmitted from crashed replica %d "
+            "to %d (%s)", self._group.name, self._replica.index,
+            rep.index, type(exc).__name__)
+        if _tm.enabled():
+            _tm.counter("serving.farm.retries").inc()
+        self._cands.append({"rep": rep, "fut": fut,
+                            "t0": time.monotonic(), "hedge": False})
+        self._replica, self._future = rep, fut
+
 
 class ReplicaGroup:
     """N continuous-decode replicas behind one least-loaded router —
@@ -223,6 +364,17 @@ class ReplicaGroup:
         self.model_cfg = model_cfg
         self.name = name
         self.router = router or LeastLoadedRouter()
+        # overload defense is strictly opt-in: an unconfigured farm
+        # never imports serving.guard (bench-contract pin)
+        self.guard = None
+        if self.config.guard is not None:
+            from ..guard import GroupGuard
+            gc = self.config.guard
+            self.guard = GroupGuard(
+                None if gc is True else gc,
+                num_replicas=self.config.replicas)
+            if getattr(self.router, "health", None) is None:
+                self.router.health = self.guard.health
         self.build_cache = SharedBuildCache() \
             if self.config.share_compiles else None
         reserved, slices = device_slices(
@@ -280,6 +432,18 @@ class ReplicaGroup:
         kwargs = dict(src=src, src_len=src_len, tenant=tenant,
                       max_new_tokens=max_new_tokens,
                       deadline_ms=deadline_ms, request_id=request_id)
+        if self.guard is not None:
+            # brownout shed/clamp + hedge-allowance deposit
+            kwargs["max_new_tokens"] = self.guard.admit(
+                str(tenant), self.replicas[0].scheduler.qos,
+                self.queued, max_new_tokens)
+        if _chaos.armed():
+            # the serving.request chaos point: request_poison tags the
+            # N-th farm submission; the tag rides resubmissions, so
+            # the request stays lethal wherever it lands
+            f = _chaos.hit("serving.request")
+            if f is not None and f["name"] == "request_poison":
+                kwargs["poison"] = True
         rep, fut = self._route(kwargs, exclude=())
         return GroupFuture(self, kwargs, rep, fut,
                            retries=self.config.retries)
@@ -393,10 +557,15 @@ class ReplicaGroup:
                "compile_count": self.compile_count,
                "prefill_devices": [str(d)
                                    for d in self.prefill_devices]}
+        if self.guard is not None:
+            out["guard"] = self.guard.stats()
         for r in self.replicas:
             s = r.scheduler
             out["replicas"].append({
                 "index": r.index,
+                **({"guard_state":
+                    self.guard.health.state(r.index)}
+                   if self.guard is not None else {}),
                 "slots_in_use": s.pool.active_count(),
                 "num_slots": s.pool.num_slots,
                 "queue_depth": s.queued,
@@ -444,6 +613,8 @@ class ReplicaGroup:
             _tm.gauge(f"{pre}.draining").set(
                 1.0 if r.draining else 0.0)
             _tm.gauge(f"{pre}.version").set(float(r.version))
+        if self.guard is not None:
+            self.guard.publish()
 
 
 def load_checkpoint_params(dirname):

@@ -13,6 +13,13 @@ and not yet been respawned by its supervisor, takes no new work. The
 group falls back to least-queued among whatever is left only when
 NOTHING is routable (one-replica groups mid-update keep accepting
 rather than going dark — availability over update latency).
+
+With a guard configured (`FarmConfig(guard=...)`), the router also
+consults the group's `HealthTracker`: ejected replicas are filtered
+like the dead, probation discounts the score, and a half-open replica
+with probe capacity is picked FIRST — live traffic is the probe that
+re-admits it. `health=None` (the default) is byte-for-byte the PR-13
+decision function, pinned by the bench contract.
 """
 
 __all__ = ["LeastLoadedRouter"]
@@ -26,8 +33,9 @@ class LeastLoadedRouter:
     `queue_weight` tunes how hard queueing repels new work. Ties break
     toward the lowest replica index for determinism."""
 
-    def __init__(self, queue_weight=1.0):
+    def __init__(self, queue_weight=1.0, health=None):
         self.queue_weight = float(queue_weight)
+        self.health = health        # guard HealthTracker, or None
 
     def score(self, replica):
         s = replica.scheduler
@@ -36,12 +44,22 @@ class LeastLoadedRouter:
 
     def pick(self, replicas, exclude=()):
         """The routable replica with the best score, or None when no
-        replica is routable (all draining/dead/excluded)."""
+        replica is routable (all draining/dead/excluded — and, with a
+        guard, all ejected)."""
+        h = self.health
         best, best_score = None, 0.0
         for r in replicas:
             if r in exclude or not r.routable:
                 continue
-            sc = self.score(r)
+            if h is None:
+                sc = self.score(r)
+            else:
+                if not h.routable(r.index):
+                    continue
+                if h.wants_probe(r.index):
+                    h.on_probe_routed(r.index)
+                    return r
+                sc = self.score(r) * h.penalty(r.index)
             if best is None or sc > best_score:
                 best, best_score = r, sc
         return best

@@ -1,0 +1,39 @@
+"""tpuguard — serving-tier overload defense for replica groups.
+
+The farm tier (serving/farm) scales decode out; this package keeps it
+honest under the traffic that scale invites. Four mechanisms, one
+`GroupGuard` per `ReplicaGroup`, opted in via `FarmConfig(guard=...)`:
+
+- **Health probation & circuit breaking** (`health.HealthTracker`):
+  per-replica latency/error EWMAs drive healthy → probation → ejected
+  → half-open; slow or flapping replicas stop taking traffic and are
+  re-admitted by live probe requests, not operator action.
+- **Hedged requests** (`hedge.HedgePolicy`): after a delay derived
+  from the group's live p99, a pending request is re-issued on the
+  next-best replica; first completion wins, the loser is cancelled
+  and its decode slot reclaimed. Bounded to a fraction of traffic.
+- **Retry budget** (`budget.RetryBudget`): one token bucket shared by
+  hedges and crash resubmissions — retry storms become fast
+  `RetryBudgetExhausted` rejections instead of amplification.
+- **Brownout** (`brownout.BrownoutController`): past queue-depth /
+  deadline-miss thresholds, shed the lowest QoS tenant class with
+  `Retry-After` hints, clamp `max_new_tokens`, recover with
+  hysteresis.
+
+A farm constructed without `guard=` never imports this package, adds
+no per-request work, and routes exactly as PR 13 did — pinned by
+tests/test_bench_contract.py. Proven end-to-end by `tpuserve
+--selftest-guard` against the `replica_slow` / `replica_flap` /
+`request_poison` chaos faults.
+"""
+from .brownout import BrownoutController
+from .budget import FractionBucket, RetryBudget
+from .core import GroupGuard, GuardConfig
+from .health import (EJECTED, HALF_OPEN, HEALTHY, PROBATION,
+                     STATE_CODES, HealthTracker)
+from .hedge import HedgePolicy, LatencyWindow
+
+__all__ = ["GuardConfig", "GroupGuard", "HealthTracker",
+           "HedgePolicy", "LatencyWindow", "RetryBudget",
+           "FractionBucket", "BrownoutController", "HEALTHY",
+           "PROBATION", "EJECTED", "HALF_OPEN", "STATE_CODES"]

@@ -1,0 +1,122 @@
+"""Brownout: planned partial degradation instead of unplanned collapse.
+
+When a group is overloaded — queue depth past `queue_high`, or the
+deadline-miss EWMA past `miss_high` — rejecting everything is as wrong
+as accepting everything. The brownout controller degrades in order of
+pain:
+
+1. **Shed the lowest QoS class.** Tenants in the cheapest weight class
+   get `BrownoutShed` (HTTP 429, kind "brownout") with a `Retry-After`
+   hint; paying classes keep flowing. Shedding never touches work
+   already admitted — only new arrivals.
+2. **Clamp `max_new_tokens`.** Surviving requests are capped at
+   `clamp_new_tokens`, trading answer length for admission rate — each
+   slot turns over faster, so more callers get *something*.
+
+Recovery is hysteretic: brownout exits only when the queue has fallen
+below `queue_low` AND the miss EWMA below `miss_low` AND `dwell_s` has
+elapsed since entry — a controller that flaps at the threshold would
+hand clients a 429/200 strobe light.
+"""
+import threading
+import time
+
+from ... import telemetry as _tm
+
+__all__ = ["BrownoutController"]
+
+
+class BrownoutController:
+    """Hysteretic overload state machine for one replica group."""
+
+    def __init__(self, queue_high=32, queue_low=8, miss_high=0.2,
+                 miss_low=0.05, miss_alpha=0.2, clamp_new_tokens=None,
+                 retry_after_s=1.0, dwell_s=0.25,
+                 clock=time.monotonic):
+        self.queue_high = int(queue_high)
+        self.queue_low = int(queue_low)
+        self.miss_high = float(miss_high)
+        self.miss_low = float(miss_low)
+        self.miss_alpha = float(miss_alpha)
+        self.clamp_new_tokens = clamp_new_tokens if \
+            clamp_new_tokens is None else int(clamp_new_tokens)
+        self.retry_after_s = float(retry_after_s)
+        self.dwell_s = float(dwell_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._active = False
+        self._entered_at = 0.0
+        self._miss_ewma = 0.0
+        self.entries = 0
+        self.sheds = 0
+        self.clamped = 0
+
+    # -------------------------------------------------------- signals
+    def on_deadline_miss(self):
+        with self._lock:
+            self._miss_ewma = ((1.0 - self.miss_alpha) * self._miss_ewma
+                               + self.miss_alpha)
+
+    def on_ok(self):
+        with self._lock:
+            self._miss_ewma *= (1.0 - self.miss_alpha)
+
+    @property
+    def miss_ewma(self):
+        with self._lock:
+            return self._miss_ewma
+
+    @property
+    def active(self):
+        with self._lock:
+            return self._active
+
+    # ------------------------------------------------------ admission
+    def observe(self, queue_depth):
+        """Update the state machine against the current queue depth;
+        called on every group submit. Returns the active flag."""
+        with self._lock:
+            if not self._active:
+                if queue_depth >= self.queue_high \
+                        or self._miss_ewma >= self.miss_high:
+                    self._active = True
+                    self._entered_at = self._clock()
+                    self.entries += 1
+                    if _tm.enabled():
+                        _tm.counter("serving.guard.brownouts").inc()
+            else:
+                calm = (queue_depth <= self.queue_low
+                        and self._miss_ewma <= self.miss_low)
+                dwelt = (self._clock() - self._entered_at
+                         >= self.dwell_s)
+                if calm and dwelt:
+                    self._active = False
+            return self._active
+
+    def admit(self, tenant, shed_classes, max_new_tokens):
+        """Admission verdict while the controller may be active.
+        Returns the (possibly clamped) max_new_tokens, or raises
+        BrownoutShed for the shed classes. No-op when inactive."""
+        with self._lock:
+            if not self._active:
+                return max_new_tokens
+        if tenant in shed_classes:
+            with self._lock:
+                self.sheds += 1
+            if _tm.enabled():
+                _tm.counter("serving.guard.brownout_sheds").inc()
+            from ..batcher import BrownoutShed
+            raise BrownoutShed(
+                f"brownout: tenant {tenant!r} is in the lowest QoS "
+                f"class and the group is overloaded; retry after "
+                f"{self.retry_after_s:g}s",
+                retry_after_s=self.retry_after_s)
+        if self.clamp_new_tokens is not None and (
+                max_new_tokens is None
+                or max_new_tokens > self.clamp_new_tokens):
+            with self._lock:
+                self.clamped += 1
+            if _tm.enabled():
+                _tm.counter("serving.guard.clamped").inc()
+            return self.clamp_new_tokens
+        return max_new_tokens

@@ -1,0 +1,102 @@
+"""Token buckets: the arithmetic that stops retries from amplifying.
+
+Two shapes of the same primitive:
+
+- ``RetryBudget`` refills at a fixed rate (`rate` tokens/s up to
+  `burst`): the group-wide cap shared by hedges and crash
+  resubmissions. When a mass failure tries to turn every in-flight
+  request into N retries, the bucket empties in milliseconds and the
+  excess becomes fast `RetryBudgetExhausted` rejections instead of a
+  self-sustaining storm.
+
+- ``FractionBucket`` refills per *event*: every submitted request
+  deposits `fraction` tokens, a hedge withdraws one — so hedge volume
+  is bounded to a fraction of real traffic by construction, whatever
+  the arrival rate. An idle group banks at most `burst`.
+
+Both are lock-per-op and allocation-free on the acquire path; neither
+is imported unless a guard is configured (guard-off pays nothing).
+"""
+import threading
+import time
+
+__all__ = ["RetryBudget", "FractionBucket"]
+
+
+class RetryBudget:
+    """Time-refilled token bucket: `rate` tokens/s, capacity `burst`.
+
+    `rate=0` makes the bucket non-refilling — exactly `burst` retries
+    ever, the deterministic shape the selftests pin."""
+
+    def __init__(self, rate=8.0, burst=16, clock=time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+        self.denied = 0
+
+    def _refill(self, now):
+        if self.rate > 0.0:
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def acquire(self, n=1.0):
+        """Take `n` tokens; False (and `denied` grows) when short."""
+        with self._lock:
+            self._refill(self._clock())
+            if self._tokens + 1e-9 < n:
+                self.denied += 1
+                return False
+            self._tokens -= n
+            return True
+
+    def refund(self, n=1.0):
+        """Give tokens back (an acquire whose action never launched)."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + n)
+
+    @property
+    def tokens(self):
+        with self._lock:
+            self._refill(self._clock())
+            return self._tokens
+
+
+class FractionBucket:
+    """Event-refilled bucket: deposits ride traffic, not the clock.
+
+    With `fraction=f`, over any interval the withdrawals (hedges)
+    cannot exceed f x deposits (submissions) + `burst` — the "bounded
+    fraction of traffic" contract."""
+
+    def __init__(self, fraction=0.25, burst=8.0):
+        self.fraction = float(fraction)
+        self.burst = float(burst)
+        self._tokens = min(1.0, self.burst)   # allow one early hedge
+        self._lock = threading.Lock()
+        self.denied = 0
+
+    def deposit(self):
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + self.fraction)
+
+    def acquire(self, n=1.0):
+        with self._lock:
+            if self._tokens + 1e-9 < n:
+                self.denied += 1
+                return False
+            self._tokens -= n
+            return True
+
+    def refund(self, n=1.0):
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + n)
+
+    @property
+    def tokens(self):
+        with self._lock:
+            return self._tokens

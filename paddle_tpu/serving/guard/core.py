@@ -1,0 +1,246 @@
+"""GroupGuard: one object bundling the four defense mechanisms for a
+replica group — health tracking, hedging, retry budget, brownout —
+behind the narrow surface `serving.farm.group` calls into.
+
+Configured via `GuardConfig` on `FarmConfig(guard=...)`; a farm
+without one never imports this package (the bench contract pins it).
+Every event lands in `serving.guard.*` counters when telemetry is on.
+"""
+import time
+
+from ... import telemetry as _tm
+from .brownout import BrownoutController
+from .budget import FractionBucket, RetryBudget
+from .health import STATE_CODES, HealthTracker
+from .hedge import HedgePolicy, LatencyWindow
+
+__all__ = ["GuardConfig", "GroupGuard"]
+
+
+class GuardConfig:
+    """Knobs for one group's guard. Defaults are production-shaped
+    (seconds-scale cooldowns, p99-derived hedge delay); the selftests
+    tighten them for CI clocks.
+
+    health: EWMA smoothing, relative-slowness bar (`slow_factor` x
+        peer median), probation/ejection streaks, half-open cooldown.
+    hedge: `hedge=False` disables re-issue; delay = `hedge_factor` x
+        live p`hedge_quantile` (floored), or `hedge_fixed_delay_s`
+        when pinned. `hedge_fraction` bounds hedges to that fraction
+        of submitted traffic.
+    retry: token bucket shared by hedges and crash resubmissions
+        (`retry_rate` tokens/s, burst `retry_burst`; rate 0 = a fixed
+        allowance, the deterministic test shape).
+    brownout: queue-depth / deadline-miss thresholds with hysteresis;
+        `clamp_new_tokens` caps generation length while active.
+    """
+
+    def __init__(self,
+                 # health
+                 latency_alpha=0.3, error_alpha=0.3, min_samples=4,
+                 slow_factor=3.0, slow_floor_s=0.005,
+                 err_probation=0.3, err_exit=0.1, enter_streak=3,
+                 probation_grace=4, probation_good=3,
+                 probation_penalty=0.1, cooldown_s=5.0,
+                 cooldown_max_s=60.0, probe_max=1,
+                 # hedging
+                 hedge=True, hedge_quantile=0.99, hedge_factor=1.5,
+                 hedge_floor_s=0.02, hedge_min_samples=8,
+                 hedge_fixed_delay_s=None, hedge_fraction=0.25,
+                 hedge_burst=8.0, window_size=512,
+                 # retry budget
+                 retry_rate=8.0, retry_burst=16,
+                 # brownout
+                 queue_high=32, queue_low=8, miss_high=0.2,
+                 miss_low=0.05, miss_alpha=0.2, clamp_new_tokens=None,
+                 retry_after_s=1.0, dwell_s=0.25,
+                 # guarded result() poll tick
+                 poll_s=0.001):
+        self.latency_alpha = latency_alpha
+        self.error_alpha = error_alpha
+        self.min_samples = min_samples
+        self.slow_factor = slow_factor
+        self.slow_floor_s = slow_floor_s
+        self.err_probation = err_probation
+        self.err_exit = err_exit
+        self.enter_streak = enter_streak
+        self.probation_grace = probation_grace
+        self.probation_good = probation_good
+        self.probation_penalty = probation_penalty
+        self.cooldown_s = cooldown_s
+        self.cooldown_max_s = cooldown_max_s
+        self.probe_max = probe_max
+        self.hedge = hedge
+        self.hedge_quantile = hedge_quantile
+        self.hedge_factor = hedge_factor
+        self.hedge_floor_s = hedge_floor_s
+        self.hedge_min_samples = hedge_min_samples
+        self.hedge_fixed_delay_s = hedge_fixed_delay_s
+        self.hedge_fraction = hedge_fraction
+        self.hedge_burst = hedge_burst
+        self.window_size = window_size
+        self.retry_rate = retry_rate
+        self.retry_burst = retry_burst
+        self.queue_high = queue_high
+        self.queue_low = queue_low
+        self.miss_high = miss_high
+        self.miss_low = miss_low
+        self.miss_alpha = miss_alpha
+        self.clamp_new_tokens = clamp_new_tokens
+        self.retry_after_s = retry_after_s
+        self.dwell_s = dwell_s
+        self.poll_s = float(poll_s)
+
+
+class GroupGuard:
+    """The guard instance one ReplicaGroup owns."""
+
+    def __init__(self, config=None, num_replicas=1,
+                 clock=time.monotonic):
+        self.config = cfg = config or GuardConfig()
+        self.poll_s = cfg.poll_s
+        self.health = HealthTracker(
+            num_replicas, latency_alpha=cfg.latency_alpha,
+            error_alpha=cfg.error_alpha, min_samples=cfg.min_samples,
+            slow_factor=cfg.slow_factor,
+            slow_floor_s=cfg.slow_floor_s,
+            err_probation=cfg.err_probation, err_exit=cfg.err_exit,
+            enter_streak=cfg.enter_streak,
+            probation_grace=cfg.probation_grace,
+            probation_good=cfg.probation_good,
+            probation_penalty=cfg.probation_penalty,
+            cooldown_s=cfg.cooldown_s,
+            cooldown_max_s=cfg.cooldown_max_s,
+            probe_max=cfg.probe_max, clock=clock)
+        self.hedge = HedgePolicy(
+            enabled=cfg.hedge, quantile=cfg.hedge_quantile,
+            factor=cfg.hedge_factor, floor_s=cfg.hedge_floor_s,
+            min_samples=cfg.hedge_min_samples,
+            fixed_delay_s=cfg.hedge_fixed_delay_s,
+            window=LatencyWindow(cfg.window_size))
+        self.hedge_budget = FractionBucket(
+            fraction=cfg.hedge_fraction, burst=cfg.hedge_burst)
+        self.retry_budget = RetryBudget(
+            rate=cfg.retry_rate, burst=cfg.retry_burst, clock=clock)
+        self.brownout = BrownoutController(
+            queue_high=cfg.queue_high, queue_low=cfg.queue_low,
+            miss_high=cfg.miss_high, miss_low=cfg.miss_low,
+            miss_alpha=cfg.miss_alpha,
+            clamp_new_tokens=cfg.clamp_new_tokens,
+            retry_after_s=cfg.retry_after_s, dwell_s=cfg.dwell_s,
+            clock=clock)
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.hedge_cancelled = 0
+        self.resubmits = 0
+
+    # ------------------------------------------------------ admission
+    def admit(self, tenant, qos, queue_depth, max_new_tokens):
+        """Group-submit admission: update brownout against the queue,
+        shed/clamp, and bank this request's hedge allowance. Returns
+        the max_new_tokens to submit with (possibly clamped)."""
+        self.brownout.observe(queue_depth)
+        out = self.brownout.admit(
+            tenant, qos.lowest_classes() if qos is not None else (),
+            max_new_tokens)
+        self.hedge_budget.deposit()
+        return out
+
+    # -------------------------------------------------- result events
+    def on_result(self, index, latency_s, hedge=False):
+        self.health.record(index, latency_s=latency_s, ok=True)
+        self.hedge.observe(latency_s)
+        self.brownout.on_ok()
+        if hedge:
+            self.hedge_wins += 1
+            if _tm.enabled():
+                _tm.counter("serving.guard.hedge_wins").inc()
+
+    def on_error(self, index):
+        self.health.record(index, ok=False)
+
+    def on_deadline_miss(self):
+        self.brownout.on_deadline_miss()
+
+    def on_cancelled(self):
+        self.hedge_cancelled += 1
+        if _tm.enabled():
+            _tm.counter("serving.guard.hedge_cancelled").inc()
+
+    # ------------------------------------------------------- budgets
+    def hedge_delay(self):
+        return self.hedge.delay()
+
+    def allow_hedge(self):
+        """One hedge = one hedge-fraction token AND one retry token
+        (hedges and resubmissions drain the same storm budget)."""
+        if not self.hedge.enabled:
+            return False
+        if not self.hedge_budget.acquire():
+            if _tm.enabled():
+                _tm.counter("serving.guard.hedge_denied").inc()
+            return False
+        if not self.retry_budget.acquire():
+            self.hedge_budget.refund()
+            if _tm.enabled():
+                _tm.counter("serving.guard.hedge_denied").inc()
+            return False
+        return True
+
+    def refund_hedge(self):
+        """The routed hedge never launched (no second replica)."""
+        self.hedge_budget.refund()
+        self.retry_budget.refund()
+
+    def on_hedge(self):
+        self.hedges += 1
+        if _tm.enabled():
+            _tm.counter("serving.guard.hedges").inc()
+
+    def allow_resubmit(self):
+        if not self.retry_budget.acquire():
+            if _tm.enabled():
+                _tm.counter("serving.guard.retry_denied").inc()
+            return False
+        return True
+
+    def on_resubmit(self):
+        self.resubmits += 1
+        if _tm.enabled():
+            _tm.counter("serving.guard.resubmits").inc()
+
+    # ----------------------------------------------------- telemetry
+    def stats(self):
+        p99 = self.hedge.p99_ms()
+        return {
+            "replicas": self.health.snapshot(),
+            "ejections": self.health.ejections,
+            "readmissions": self.health.readmissions,
+            "probes": self.health.probes,
+            "hedges": self.hedges,
+            "hedge_wins": self.hedge_wins,
+            "hedge_cancelled": self.hedge_cancelled,
+            "resubmits": self.resubmits,
+            "retry_tokens": round(self.retry_budget.tokens, 2),
+            "retry_denied": self.retry_budget.denied,
+            "brownout": self.brownout.active,
+            "brownout_entries": self.brownout.entries,
+            "brownout_sheds": self.brownout.sheds,
+            "clamped": self.brownout.clamped,
+            "p99_ms": None if p99 is None else round(p99, 3)}
+
+    def publish(self):
+        """Push the guard gauges (piggybacks on group._publish)."""
+        if not _tm.enabled():
+            return
+        snap = self.health.snapshot()
+        for i, h in enumerate(snap):
+            _tm.gauge(f"serving.replica.{i}.guard_state").set(
+                STATE_CODES[h["state"]])
+        _tm.gauge("serving.guard.brownout").set(
+            1.0 if self.brownout.active else 0.0)
+        _tm.gauge("serving.guard.retry_tokens").set(
+            self.retry_budget.tokens)
+        p99 = self.hedge.p99_ms()
+        if p99 is not None:
+            _tm.gauge("serving.guard.p99_ms").set(p99)

@@ -1,0 +1,255 @@
+"""Per-replica health: rolling EWMAs feeding a circuit breaker.
+
+The farm router (PR 13) is death-aware only: a replica is skipped when
+its loop thread is gone, period. This tracker adds the judgment call —
+a replica that is *alive but wrong* (slow straggler, crash-flapping,
+burning through respawns) is walked through a state machine:
+
+    HEALTHY ──bad streak / error EWMA──▶ PROBATION
+    PROBATION ──persists──▶ EJECTED          (score 0, no traffic)
+    PROBATION ──recovers──▶ HEALTHY
+    EJECTED ──cooldown──▶ HALF_OPEN          (probe_max live probes)
+    HALF_OPEN ──probe ok──▶ HEALTHY          (re-admitted, no operator)
+    HALF_OPEN ──probe bad──▶ EJECTED         (cooldown doubles, capped)
+
+Samples arrive from the guarded `GroupFuture.result` path: one
+``record(index, latency_s, ok)`` per completed request leg. "Slow" is
+judged *relatively* — a sample is bad when its latency exceeds
+``slow_factor`` x the median of the OTHER replicas' latency EWMAs — so
+a uniformly loaded group never ejects anybody, while one straggler
+among peers stands out immediately.
+
+Safety rail: a replica is never ejected when no OTHER replica is
+healthy or on probation — degraded capacity beats zero capacity.
+"""
+import statistics
+import threading
+import time
+
+from ... import telemetry as _tm
+
+__all__ = ["HealthTracker", "HEALTHY", "PROBATION", "EJECTED",
+           "HALF_OPEN", "STATE_CODES"]
+
+HEALTHY = "healthy"
+PROBATION = "probation"
+EJECTED = "ejected"
+HALF_OPEN = "half_open"
+
+# gauge encoding for serving.replica.<i>.guard_state
+STATE_CODES = {HEALTHY: 0.0, PROBATION: 1.0, EJECTED: 2.0,
+               HALF_OPEN: 3.0}
+
+
+class _ReplicaHealth:
+    __slots__ = ("state", "lat_ewma", "err_ewma", "samples",
+                 "bad_streak", "good_streak", "ejected_at",
+                 "cooldown_s", "probes_inflight")
+
+    def __init__(self, cooldown_s):
+        self.state = HEALTHY
+        self.lat_ewma = None
+        self.err_ewma = 0.0
+        self.samples = 0
+        self.bad_streak = 0
+        self.good_streak = 0
+        self.ejected_at = 0.0
+        self.cooldown_s = cooldown_s
+        self.probes_inflight = 0
+
+
+class HealthTracker:
+    """EWMA health accounting + state machine for one replica group."""
+
+    def __init__(self, num_replicas, latency_alpha=0.3,
+                 error_alpha=0.3, min_samples=4, slow_factor=3.0,
+                 slow_floor_s=0.005, err_probation=0.3, err_exit=0.1,
+                 enter_streak=3, probation_grace=4, probation_good=3,
+                 probation_penalty=0.1, cooldown_s=5.0,
+                 cooldown_max_s=60.0, probe_max=1,
+                 clock=time.monotonic):
+        self.latency_alpha = float(latency_alpha)
+        self.error_alpha = float(error_alpha)
+        self.min_samples = int(min_samples)
+        self.slow_factor = float(slow_factor)
+        self.slow_floor_s = float(slow_floor_s)
+        self.err_probation = float(err_probation)
+        self.err_exit = float(err_exit)
+        self.enter_streak = int(enter_streak)
+        self.probation_grace = int(probation_grace)
+        self.probation_good = int(probation_good)
+        self.probation_penalty = float(probation_penalty)
+        self.cooldown_base_s = float(cooldown_s)
+        self.cooldown_max_s = float(cooldown_max_s)
+        self.probe_max = int(probe_max)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._reps = [_ReplicaHealth(self.cooldown_base_s)
+                      for _ in range(int(num_replicas))]
+        self.ejections = 0
+        self.readmissions = 0
+        self.probes = 0
+
+    # -------------------------------------------------------- sampling
+    def record(self, index, latency_s=None, ok=True):
+        """One completed request leg on replica `index`. Updates the
+        EWMAs and runs the state machine."""
+        with self._lock:
+            h = self._reps[index]
+            self._maybe_half_open(h)
+            h.samples += 1
+            if h.probes_inflight > 0:
+                h.probes_inflight -= 1
+            if latency_s is not None:
+                h.lat_ewma = latency_s if h.lat_ewma is None else (
+                    (1.0 - self.latency_alpha) * h.lat_ewma
+                    + self.latency_alpha * latency_s)
+            h.err_ewma = ((1.0 - self.error_alpha) * h.err_ewma
+                          + self.error_alpha * (0.0 if ok else 1.0))
+            bad = (not ok) or self._slow(index, latency_s)
+            if bad:
+                h.bad_streak += 1
+                h.good_streak = 0
+            else:
+                h.good_streak += 1
+                h.bad_streak = 0
+            self._transition(index, h, bad)
+
+    def _slow(self, index, latency_s):
+        """Is this sample a straggler relative to the peer group?"""
+        if latency_s is None:
+            return False
+        peers = [r.lat_ewma for i, r in enumerate(self._reps)
+                 if i != index and r.lat_ewma is not None
+                 and r.samples >= self.min_samples]
+        if not peers:
+            return False
+        bar = self.slow_factor * max(statistics.median(peers),
+                                     self.slow_floor_s)
+        return latency_s > bar
+
+    def _transition(self, index, h, bad):
+        if h.state == HEALTHY:
+            if h.err_ewma > self.err_probation \
+                    or h.bad_streak >= self.enter_streak:
+                h.state = PROBATION
+                self._count("probations")
+        elif h.state == PROBATION:
+            if bad and h.bad_streak >= self.probation_grace:
+                self._eject(index, h, escalate=False)
+            elif not bad and h.good_streak >= self.probation_good \
+                    and h.err_ewma < self.err_exit:
+                h.state = HEALTHY
+        elif h.state == HALF_OPEN:
+            if bad:
+                self._eject(index, h, escalate=True)
+            else:
+                h.state = HEALTHY
+                h.cooldown_s = self.cooldown_base_s
+                h.err_ewma = 0.0
+                self.readmissions += 1
+                self._count("readmissions")
+        # EJECTED: stragglers may still report; EWMAs updated above
+
+    def _eject(self, index, h, escalate):
+        # never go dark: keep the last routable replica taking traffic
+        others = [r for i, r in enumerate(self._reps)
+                  if i != index and r.state in (HEALTHY, PROBATION)]
+        if not others:
+            h.bad_streak = 0        # stay in probation, retry later
+            return
+        h.state = EJECTED
+        h.ejected_at = self._clock()
+        h.probes_inflight = 0
+        if escalate:
+            h.cooldown_s = min(self.cooldown_max_s, h.cooldown_s * 2.0)
+        self.ejections += 1
+        self._count("ejections")
+
+    def _maybe_half_open(self, h):
+        if h.state == EJECTED \
+                and self._clock() - h.ejected_at >= h.cooldown_s:
+            h.state = HALF_OPEN
+            h.good_streak = 0
+            h.bad_streak = 0
+            h.probes_inflight = 0
+
+    @staticmethod
+    def _count(what):
+        if _tm.enabled():
+            _tm.counter(f"serving.guard.{what}").inc()
+
+    # -------------------------------------------------------- routing
+    def state(self, index):
+        with self._lock:
+            h = self._reps[index]
+            self._maybe_half_open(h)
+            return h.state
+
+    def routable(self, index):
+        """May the router send this replica regular traffic?"""
+        with self._lock:
+            h = self._reps[index]
+            self._maybe_half_open(h)
+            if h.state == EJECTED:
+                return False
+            if h.state == HALF_OPEN:
+                return h.probes_inflight < self.probe_max
+            return True
+
+    def penalty(self, index):
+        """Score multiplier for the router (1.0 = full confidence)."""
+        with self._lock:
+            h = self._reps[index]
+            self._maybe_half_open(h)
+            if h.state == EJECTED:
+                return 0.0
+            if h.state == PROBATION:
+                return self.probation_penalty
+            if h.state == HALF_OPEN:
+                return self.probation_penalty * 0.5
+            return 1.0
+
+    def wants_probe(self, index):
+        """HALF_OPEN with probe capacity: the router sends the next
+        request here deliberately — live traffic IS the probe."""
+        with self._lock:
+            h = self._reps[index]
+            self._maybe_half_open(h)
+            return (h.state == HALF_OPEN
+                    and h.probes_inflight < self.probe_max)
+
+    def on_probe_routed(self, index):
+        with self._lock:
+            h = self._reps[index]
+            if h.state == HALF_OPEN:
+                h.probes_inflight += 1
+                self.probes += 1
+                self._count("probes")
+
+    # ------------------------------------------------------ inspection
+    def set_state(self, index, state):
+        """Operator/test override (tpustat drain-style intervention)."""
+        if state not in STATE_CODES:
+            raise ValueError(f"unknown guard state {state!r}")
+        with self._lock:
+            h = self._reps[index]
+            h.state = state
+            if state == EJECTED:
+                h.ejected_at = self._clock()
+            h.bad_streak = 0
+            h.good_streak = 0
+            h.probes_inflight = 0
+
+    def snapshot(self):
+        with self._lock:
+            out = []
+            for h in self._reps:
+                self._maybe_half_open(h)
+                out.append({
+                    "state": h.state,
+                    "latency_ewma_s": h.lat_ewma,
+                    "error_ewma": round(h.err_ewma, 4),
+                    "samples": h.samples,
+                    "cooldown_s": h.cooldown_s})
+            return out

@@ -1,0 +1,87 @@
+"""Hedged-request timing: a rolling latency window feeding the delay.
+
+The hedge delay is *derived from the group's live p99*, not a fixed
+timeout: re-issuing at ``factor x p99`` means ~99% of requests never
+hedge (they finish first), while the tail — exactly the requests stuck
+behind a straggler — gets a second replica racing on their behalf.
+First completion wins; the loser is cancelled and its decode slot
+reclaimed. This is the backup-task trick of the TensorFlow paper's
+straggler mitigation, applied at the serving tier.
+
+`LatencyWindow` keeps the last `size` completed-request latencies in a
+ring; quantiles are computed on demand over a copy (the window is
+small — a few hundred floats — so sorting on the hedge decision path
+is cheaper than maintaining a sketch, and exact).
+"""
+import threading
+
+__all__ = ["LatencyWindow", "HedgePolicy"]
+
+
+class LatencyWindow:
+    """Fixed-size ring of recent request latencies (seconds)."""
+
+    def __init__(self, size=512):
+        self.size = int(size)
+        self._buf = [0.0] * self.size
+        self._n = 0                  # lifetime count
+        self._lock = threading.Lock()
+
+    def observe(self, latency_s):
+        with self._lock:
+            self._buf[self._n % self.size] = float(latency_s)
+            self._n += 1
+
+    def __len__(self):
+        with self._lock:
+            return min(self._n, self.size)
+
+    def quantile(self, q):
+        """Exact q-quantile over the window, or None when empty."""
+        with self._lock:
+            n = min(self._n, self.size)
+            if n == 0:
+                return None
+            vals = sorted(self._buf[:n])
+        idx = min(n - 1, max(0, int(q * (n - 1) + 0.5)))
+        return vals[idx]
+
+
+class HedgePolicy:
+    """When (and whether) to re-issue a pending request.
+
+    delay() returns the seconds a request should wait on its primary
+    replica before hedging, or None while hedging is disabled or the
+    window is too thin to know what "slow" means (`min_samples`).
+    `fixed_delay_s` pins the delay for deterministic tests; production
+    leaves it None and rides the live quantile."""
+
+    def __init__(self, enabled=True, quantile=0.99, factor=1.5,
+                 floor_s=0.02, min_samples=8, fixed_delay_s=None,
+                 window=None):
+        self.enabled = bool(enabled)
+        self.quantile = float(quantile)
+        self.factor = float(factor)
+        self.floor_s = float(floor_s)
+        self.min_samples = int(min_samples)
+        self.fixed_delay_s = fixed_delay_s
+        self.window = window or LatencyWindow()
+
+    def observe(self, latency_s):
+        self.window.observe(latency_s)
+
+    def delay(self):
+        if not self.enabled:
+            return None
+        if self.fixed_delay_s is not None:
+            return float(self.fixed_delay_s)
+        if len(self.window) < self.min_samples:
+            return None
+        q = self.window.quantile(self.quantile)
+        if q is None:
+            return None
+        return max(self.floor_s, self.factor * q)
+
+    def p99_ms(self):
+        q = self.window.quantile(0.99)
+        return None if q is None else q * 1000.0

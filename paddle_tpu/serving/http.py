@@ -33,13 +33,22 @@ machine-readable ``kind`` in every error body: queue-full and
 oversized requests are 429 ``rejected`` (back off / retry elsewhere),
 QoS slot evictions are 429 ``preempted`` (the tenant is over its fair
 share right now — distinct from 504 so clients can tell "retry" from
-"too slow"), expired deadlines are 504 ``deadline``, draining is 503,
-unknown models 404, malformed bodies 400. A `ThreadingHTTPServer`
+"too slow"), brownout sheds are 429 ``brownout`` and an exhausted
+retry budget 429 ``retry_budget`` (the guard tier's typed verdicts),
+expired deadlines are 504 ``deadline``, draining is 503, unknown
+models 404, malformed bodies 400. Every 429/503 carries a
+``Retry-After`` header (seconds, integer-rounded up) so well-behaved
+clients and proxies back off instead of hammering — the brownout
+controller's ``retry_after_s`` hint when it shed, 1s otherwise.
+``GET /healthz`` reports ``"browned_out"`` (still 200 — the balancer
+keeps routing, paying tenants still flow) while any attached guard is
+shedding. A `ThreadingHTTPServer`
 thread-per-connection model is plenty here: the handler only parses
 JSON and blocks on a future; the real concurrency story is the
 batcher/scheduler, not the socket layer.
 """
 import json
+import math
 import re
 import threading
 import uuid
@@ -48,7 +57,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from .. import telemetry as _tm
-from .batcher import (DeadlineExceeded, PreemptedError, RejectedError,
+from .batcher import (BrownoutShed, DeadlineExceeded, PreemptedError,
+                      RejectedError, RetryBudgetExhausted,
                       ServerClosed)
 
 __all__ = ["HttpFrontend"]
@@ -85,18 +95,24 @@ class _Handler(BaseHTTPRequestHandler):
     # every success and error body + response header
     _request_id = None
 
-    def _reply(self, code, payload, content_type="application/json"):
+    def _reply(self, code, payload, content_type="application/json",
+               retry_after=None):
         body = payload if isinstance(payload, bytes) \
             else json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", content_type)
+        if retry_after is not None:
+            # RFC 9110 delay-seconds: integer, rounded up so a 0.5s
+            # hint never becomes "retry immediately"
+            self.send_header("Retry-After",
+                             str(max(1, math.ceil(retry_after))))
         if self._request_id:
             self.send_header("X-Request-Id", self._request_id)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code, msg, kind=None):
+    def _error(self, code, msg, kind=None, retry_after=None):
         if _tm.enabled():
             _tm.counter("serving.http_errors").inc()
         body = {"error": msg}
@@ -104,7 +120,9 @@ class _Handler(BaseHTTPRequestHandler):
             body["kind"] = kind
         if self._request_id:
             body["request_id"] = self._request_id
-        self._reply(code, body)
+        if retry_after is None and code in (429, 503):
+            retry_after = 1.0      # overload always hints a back-off
+        self._reply(code, body, retry_after=retry_after)
 
     def do_GET(self):
         self._request_id = None      # keep-alive reuse: never stale
@@ -112,9 +130,12 @@ class _Handler(BaseHTTPRequestHandler):
             _tm.counter("serving.http_requests").inc()
         if self.path == "/healthz":
             if self.model_server.healthy:
-                self._reply(200, {"status": "ok"})
+                status = "browned_out" \
+                    if self.model_server.overloaded else "ok"
+                self._reply(200, {"status": status})
             else:
-                self._reply(503, {"status": "draining"})
+                self._reply(503, {"status": "draining"},
+                            retry_after=1.0)
         elif self.path == "/metrics":
             self._reply(200, _tm.prometheus_text().encode("utf-8"),
                         content_type="text/plain; version=0.0.4")
@@ -176,6 +197,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(429, str(e), kind="preempted")
         except ServerClosed as e:
             self._error(503, str(e), kind="draining")
+        except BrownoutShed as e:
+            self._error(429, str(e), kind="brownout",
+                        retry_after=e.retry_after_s)
+        except RetryBudgetExhausted as e:
+            self._error(429, str(e), kind="retry_budget")
         except RejectedError as e:
             self._error(429, str(e), kind="rejected")
         except (ValueError, TypeError) as e:

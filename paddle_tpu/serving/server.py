@@ -242,6 +242,19 @@ class ModelServer:
         return not self._stopping
 
     @property
+    def overloaded(self):
+        """True while any attached decode tier's guard is in brownout
+        — the /healthz "browned_out" discriminator. Duck-typed: single
+        engines and guard-less groups simply have no `guard`."""
+        with self._lock:
+            decoders = list(self._decoders.values())
+        for d in decoders:
+            g = getattr(d, "guard", None)
+            if g is not None and g.brownout.active:
+                return True
+        return False
+
+    @property
     def worker_restarts(self):
         """Total crashed-worker respawns across all served models."""
         with self._lock:

@@ -647,6 +647,16 @@ class FleetCollector:
                 if idx and what:
                     serving_replicas.setdefault(idx, {})[what] = \
                         ent["value"]
+            # guard tier (serving/guard): group-level serving.guard.*
+            # counters/gauges → one flat dict per rank (ejections,
+            # hedges, brownout, p99_ms, ... — the tpustat guard line);
+            # per-replica guard_state rides serving_replicas above
+            serving_guard = {}
+            for name, ent in m.items():
+                if name.startswith("serving.guard.") \
+                        and ent.get("kind") != "histogram":
+                    serving_guard[name[len("serving.guard."):]] = \
+                        ent["value"]
             per_rank[str(r)] = {
                 "steps": h["count"] if h else 0,
                 "step_seconds_mean": (h["sum"] / h["count"])
@@ -672,6 +682,7 @@ class FleetCollector:
                     for d in embed_tables.values()),
                 "embed_tables": embed_tables,
                 "serving_replicas": serving_replicas,
+                "serving_guard": serving_guard,
                 "serving_tokens_total": sum(
                     int(d.get("tokens_total", 0))
                     for d in serving_replicas.values()),

@@ -1,0 +1,620 @@
+"""tpuguard: serving-tier overload defense — the health state machine
+(probation / ejection / half-open probes / escalating cooldown, with
+the never-eject-last rail), relative-slowness judgment, retry and
+hedge token buckets, hedge-delay policy, brownout hysteresis, the
+health-aware router property (never an ejected replica, always routes
+while one is healthy), hedge cancellation with zero slot leaks and
+zero double-completed futures, retry-budget-bounded resubmission with
+its counter, HTTP Retry-After / typed-kind regressions, and the
+tpuserve --selftest-guard gate."""
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import telemetry as tm
+from paddle_tpu.core import framework as fw
+from paddle_tpu.models import transformer as tfm
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.chaos import ChaosFault
+from paddle_tpu.serving import HttpFrontend, ModelServer
+from paddle_tpu.serving.batcher import (BrownoutShed, Future,
+                                        RejectedError,
+                                        RetryBudgetExhausted)
+from paddle_tpu.serving.decode import DecodeConfig, DecodeEngineConfig
+from paddle_tpu.serving.farm import (FarmConfig, LeastLoadedRouter,
+                                     ReplicaGroup)
+from paddle_tpu.serving.guard import (EJECTED, HALF_OPEN, HEALTHY,
+                                      PROBATION, BrownoutController,
+                                      FractionBucket, GuardConfig,
+                                      HealthTracker, HedgePolicy,
+                                      LatencyWindow, RetryBudget)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    tm.disable()
+    tm.reset()
+    chaos.reset()
+    yield
+    tm.disable()
+    tm.reset()
+    chaos.reset()
+
+
+class _Clock:
+    """Deterministic monotonic clock for the state-machine walks."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------- helpers
+def _seeded_stack(maxlen=12, seed=7, n_layer=2):
+    cfg = tfm.TransformerConfig(src_vocab=64, trg_vocab=64,
+                                max_len=maxlen, d_model=32, d_inner=64,
+                                n_head=4, n_layer=n_layer, dropout=0.0,
+                                label_smooth_eps=0.0)
+    infer, start = fw.Program(), fw.Program()
+    with pt.program_guard(infer, start):
+        with pt.unique_name.guard():
+            _feeds, logits = tfm.build_infer_program(cfg, maxlen=maxlen)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(start)
+    rng = np.random.RandomState(seed)
+    scope = pt.global_scope()
+    params = {}
+    for v in infer.persistable_vars():
+        a = np.asarray(scope.get(v.name))
+        if v.name.startswith("layer_norm") and v.name.endswith(".w_0"):
+            nv = 1.0 + 0.2 * rng.randn(*a.shape)
+        elif v.name.endswith(".b_0"):
+            nv = 0.1 * rng.randn(*a.shape)
+        else:
+            nv = 0.35 * rng.randn(*a.shape)
+        nv = nv.astype(a.dtype)
+        scope.set(v.name, nv)
+        params[v.name] = nv
+    return cfg, exe, infer, logits, params
+
+
+def _group(cfg, params, replicas=2, slots=2, maxlen=12,
+           buckets=(1, 2), name="guard", retries=1, guard=None,
+           qos_factory=None):
+    return ReplicaGroup(cfg, params, FarmConfig(
+        replicas=replicas,
+        engine=DecodeEngineConfig(num_slots=slots, max_len=maxlen,
+                                  prefill_buckets=buckets),
+        decode=DecodeConfig(bos=0, max_queue_requests=64),
+        retries=retries, guard=guard, qos_factory=qos_factory),
+        name=name)
+
+
+def _greedy_ref(exe, infer, logits, src, src_len, maxlen, max_new):
+    row = np.zeros((1, maxlen), np.int64)
+    row[0, :len(src)] = src
+    ids = tfm.greedy_decode(exe, infer, logits, row,
+                            np.array([src_len], "int64"), bos=0,
+                            fetch_argmax=True)
+    return ids[0, 1:1 + max_new].astype(np.int64)
+
+
+def _drain(group, futs, budget=3000):
+    """Manual guarded drive: poll every future (the guarded result()
+    path hedges/resubmits inside the poll), step all replicas."""
+    out, pending = {}, dict(enumerate(futs))
+    for _ in range(budget):
+        if not pending:
+            break
+        for i, f in list(pending.items()):
+            try:
+                out[i] = f.result(timeout=0)
+                del pending[i]
+            except TimeoutError:
+                pass
+        try:
+            group.run_iteration()
+        except ChaosFault as e:
+            rep = group.replicas[0]
+            rep.scheduler._crash_recover(e)
+            rep.scheduler.restarts += 1
+    assert not pending, f"{len(pending)} requests never completed"
+    return [out[i] for i in range(len(futs))]
+
+
+# ------------------------------------------------- health state machine
+def test_health_state_machine_full_walk():
+    clk = _Clock()
+    h = HealthTracker(2, min_samples=1, enter_streak=2,
+                      probation_grace=2, probation_good=2,
+                      err_probation=2.0, err_exit=1.0, cooldown_s=10.0,
+                      cooldown_max_s=15.0, clock=clk)
+    for _ in range(3):
+        h.record(1, latency_s=0.01, ok=True)      # healthy peer
+    h.record(0, ok=False)
+    assert h.state(0) == HEALTHY                  # streak 1 < 2
+    h.record(0, ok=False)
+    assert h.state(0) == PROBATION
+    assert h.penalty(0) == pytest.approx(0.1)     # score discount
+    assert h.routable(0)                          # probation still serves
+    h.record(0, ok=False)                         # grace exceeded
+    assert h.state(0) == EJECTED and h.ejections == 1
+    assert not h.routable(0) and h.penalty(0) == 0.0
+
+    clk.t += 10.0                                 # cooldown elapses
+    assert h.state(0) == HALF_OPEN and h.wants_probe(0)
+    h.on_probe_routed(0)
+    assert h.probes == 1
+    assert not h.routable(0), "probe_max=1: one probe in flight"
+    h.record(0, latency_s=0.01, ok=True)          # the probe succeeds
+    assert h.state(0) == HEALTHY and h.readmissions == 1
+    assert h.snapshot()[0]["cooldown_s"] == pytest.approx(10.0)
+
+    # relapse: a failed half-open probe escalates the cooldown (capped)
+    for _ in range(3):
+        h.record(0, ok=False)
+    assert h.state(0) == EJECTED and h.ejections == 2
+    clk.t += 10.0
+    assert h.state(0) == HALF_OPEN
+    h.record(0, ok=False)
+    assert h.state(0) == EJECTED and h.ejections == 3
+    assert h.snapshot()[0]["cooldown_s"] == pytest.approx(15.0), \
+        "escalated cooldown must double, capped at cooldown_max_s"
+
+
+def test_health_never_ejects_the_last_replica():
+    clk = _Clock()
+    h = HealthTracker(2, min_samples=1, enter_streak=1,
+                      probation_grace=1, err_probation=2.0, clock=clk)
+    h.set_state(1, EJECTED)
+    for _ in range(5):
+        h.record(0, ok=False)
+    assert h.state(0) == PROBATION, \
+        "degraded capacity beats zero capacity"
+    assert h.ejections == 0 and h.routable(0)
+
+
+def test_health_slowness_is_relative_to_peers():
+    clk = _Clock()
+    h = HealthTracker(2, min_samples=2, slow_factor=2.0,
+                      slow_floor_s=0.005, enter_streak=2,
+                      err_probation=2.0, clock=clk)
+    for _ in range(4):
+        h.record(1, latency_s=0.01, ok=True)
+    h.record(0, latency_s=0.012, ok=True)   # near the peer median: fine
+    assert h.state(0) == HEALTHY
+    h.record(0, latency_s=0.05, ok=True)    # > 2 x median(0.01)
+    h.record(0, latency_s=0.06, ok=True)
+    assert h.state(0) == PROBATION, \
+        "a straggler must stand out against its peer group"
+    # a uniformly-slow group never ejects anybody (no relative bar)
+    h2 = HealthTracker(2, min_samples=1, slow_factor=2.0,
+                       enter_streak=1, err_probation=2.0, clock=clk)
+    for _ in range(6):
+        h2.record(0, latency_s=0.5, ok=True)
+        h2.record(1, latency_s=0.5, ok=True)
+    assert h2.state(0) == HEALTHY and h2.state(1) == HEALTHY
+
+
+# ------------------------------------------------------- token buckets
+def test_retry_budget_fixed_allowance_and_refill():
+    clk = _Clock()
+    b = RetryBudget(rate=0.0, burst=2, clock=clk)
+    assert b.acquire() and b.acquire()
+    assert not b.acquire() and b.denied == 1      # rate 0: never refills
+    clk.t += 100.0
+    assert not b.acquire() and b.denied == 2
+    b.refund()
+    assert b.acquire()
+
+    r = RetryBudget(rate=10.0, burst=5, clock=clk)
+    for _ in range(5):
+        assert r.acquire()
+    assert not r.acquire()
+    clk.t += 0.2                                  # 10/s x 0.2s = 2 tokens
+    assert r.acquire() and r.acquire()
+    assert not r.acquire()
+    assert r.tokens == pytest.approx(0.0, abs=1e-6)
+
+
+def test_fraction_bucket_rides_traffic_not_the_clock():
+    b = FractionBucket(fraction=0.5, burst=4.0)
+    assert b.acquire()                    # the banked early hedge
+    assert not b.acquire() and b.denied == 1
+    b.deposit()
+    b.deposit()                           # 2 submissions -> 1 token
+    assert b.acquire()
+    for _ in range(100):
+        b.deposit()
+    assert b.tokens == pytest.approx(4.0), "deposits cap at burst"
+
+
+# -------------------------------------------------------- hedge policy
+def test_latency_window_ring_and_quantiles():
+    w = LatencyWindow(size=4)
+    assert len(w) == 0 and w.quantile(0.99) is None
+    for v in (0.01, 0.02, 0.03, 0.04, 0.05):
+        w.observe(v)
+    assert len(w) == 4                    # ring: oldest evicted
+    assert w.quantile(1.0) == pytest.approx(0.05)
+    assert w.quantile(0.0) == pytest.approx(0.02)
+
+
+def test_hedge_policy_delay_gating():
+    assert HedgePolicy(enabled=False).delay() is None
+    # a pinned delay bypasses the window entirely
+    assert HedgePolicy(fixed_delay_s=0.07).delay() == \
+        pytest.approx(0.07)
+    p = HedgePolicy(min_samples=3, factor=2.0, floor_s=0.001,
+                    quantile=1.0, window=LatencyWindow(8))
+    p.observe(0.01)
+    p.observe(0.01)
+    assert p.delay() is None, "thin window: don't guess what slow is"
+    p.observe(0.05)
+    assert p.delay() == pytest.approx(0.1)        # 2.0 x p100
+    assert p.p99_ms() == pytest.approx(50.0)
+    # the floor keeps a fast group from hedging at microsecond delays
+    f = HedgePolicy(min_samples=1, factor=1.0, floor_s=0.5,
+                    window=LatencyWindow(8))
+    f.observe(0.001)
+    assert f.delay() == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------ brownout
+def test_brownout_hysteresis_shed_and_clamp():
+    clk = _Clock()
+    bo = BrownoutController(queue_high=4, queue_low=1, clamp_new_tokens=3,
+                            retry_after_s=2.5, dwell_s=5.0, clock=clk)
+    assert not bo.observe(3)
+    assert bo.admit("batch", {"batch"}, 10) == 10, \
+        "inactive brownout must not touch admissions"
+    assert bo.observe(5) and bo.entries == 1
+    with pytest.raises(BrownoutShed) as ei:
+        bo.admit("batch", {"batch"}, 10)
+    assert ei.value.retry_after_s == pytest.approx(2.5)
+    assert bo.sheds == 1
+    assert bo.admit("interactive", {"batch"}, 10) == 3
+    assert bo.clamped == 1
+    assert bo.admit("interactive", {"batch"}, 2) == 2, \
+        "already-short requests are not lengthened"
+    # calm queue but dwell not served: still active (no 429/200 strobe)
+    assert bo.observe(0) is True
+    clk.t += 5.0
+    assert bo.observe(0) is False and not bo.active
+    assert bo.admit("batch", {"batch"}, 10) == 10
+
+
+def test_brownout_enters_on_deadline_miss_ewma():
+    clk = _Clock()
+    bo = BrownoutController(queue_high=10**9, miss_high=0.4,
+                            miss_low=0.05, miss_alpha=0.5, clock=clk)
+    bo.on_deadline_miss()
+    bo.on_deadline_miss()                 # ewma 0.5 -> 0.75
+    assert bo.miss_ewma > 0.4
+    assert bo.observe(0) is True, "miss pressure alone must brown out"
+    for _ in range(8):
+        bo.on_ok()                        # decay below miss_low
+    clk.t += 1.0                          # default dwell 0.25s
+    assert bo.observe(0) is False
+
+
+# ----------------------------------------------- health-aware routing
+class _FakePool:
+    def __init__(self, free):
+        self._free = free
+        self.num_slots = 4
+
+    def free_count(self):
+        return self._free
+
+
+class _FakeSched:
+    def __init__(self, free, queued):
+        self.pool = _FakePool(free)
+        self.queued = queued
+
+
+class _FakeReplica:
+    def __init__(self, index, free=4, queued=0, routable=True):
+        self.index = index
+        self.scheduler = _FakeSched(free, queued)
+        self.routable = routable
+
+
+def test_router_property_never_ejected_always_routes():
+    """300 random (load, liveness, guard-state) configurations: the
+    router NEVER picks an ejected replica, and always picks SOMETHING
+    while at least one healthy/probation replica is routable."""
+    rng = np.random.RandomState(23)
+    states = [HEALTHY, PROBATION, EJECTED, HALF_OPEN]
+    for _ in range(300):
+        n = int(rng.randint(2, 5))
+        h = HealthTracker(n)
+        reps = []
+        for i in range(n):
+            reps.append(_FakeReplica(
+                i, free=int(rng.randint(0, 5)),
+                queued=int(rng.randint(0, 6)),
+                routable=bool(rng.rand() < 0.85)))
+            h.set_state(i, states[int(rng.randint(0, 4))])
+        router = LeastLoadedRouter(health=h)
+        pick = router.pick(reps)
+        if pick is not None:
+            assert pick.routable
+            assert h.state(pick.index) != EJECTED, \
+                "router selected an EJECTED replica"
+        if any(r.routable and h.state(r.index) in (HEALTHY, PROBATION)
+               for r in reps):
+            assert pick is not None, \
+                "router went dark with a healthy replica available"
+
+
+def test_router_probes_half_open_first():
+    h = HealthTracker(2)
+    h.set_state(0, HALF_OPEN)
+    router = LeastLoadedRouter(health=h)
+    # replica 1 scores far better — the probe is still routed first
+    reps = [_FakeReplica(0, free=0, queued=9),
+            _FakeReplica(1, free=4, queued=0)]
+    assert router.pick(reps) is reps[0] and h.probes == 1
+    # probe capacity consumed: regular traffic goes to the healthy one
+    assert router.pick(reps) is reps[1]
+
+
+# ----------------------------------- hedge cancellation (no leaks)
+def test_hedge_cancellation_no_leaks_no_double_completion(monkeypatch):
+    """200 randomized hedged requests (hedge delay pinned to 0 so every
+    request races two replicas): greedy-parity on every completion, no
+    future is ever completed twice, and both slot pools come out
+    leak-free."""
+    doubles = [0]
+    orig_res, orig_err = Future.set_result, Future.set_error
+
+    def sr(self, result):
+        if self.done():
+            doubles[0] += 1
+        orig_res(self, result)
+
+    def se(self, exc):
+        if self.done():
+            doubles[0] += 1
+        orig_err(self, exc)
+
+    monkeypatch.setattr(Future, "set_result", sr)
+    monkeypatch.setattr(Future, "set_error", se)
+
+    maxlen = 12
+    cfg, exe, infer, logits, params = _seeded_stack(maxlen=maxlen)
+    gcfg = GuardConfig(hedge_fixed_delay_s=0.0, hedge_fraction=1.0,
+                       hedge_burst=1e9, retry_rate=1000.0,
+                       retry_burst=1000, slow_factor=1e9,
+                       enter_streak=10**6, err_probation=2.0,
+                       queue_high=10**9)
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   guard=gcfg, name="hedgeleak", retries=2)
+    rng = np.random.RandomState(41)
+    base = []
+    for _ in range(12):
+        n = int(rng.randint(3, maxlen))
+        base.append((rng.randint(2, 60, (n,)).astype("int64"), n,
+                     int(rng.randint(2, 5))))
+    expected = [_greedy_ref(exe, infer, logits, s, n, maxlen, mn)
+                for s, n, mn in base]
+    order = rng.randint(0, len(base), 200)
+    served = 0
+    for wave_at in range(0, 200, 4):
+        wave = order[wave_at:wave_at + 4]
+        futs = [group.submit(base[j][0], src_len=base[j][1],
+                             max_new_tokens=base[j][2]) for j in wave]
+        for j, res in zip(wave, _drain(group, futs)):
+            np.testing.assert_array_equal(
+                np.asarray(res.tokens, np.int64), expected[j])
+            served += 1
+    assert served == 200 and doubles[0] == 0, \
+        f"{doubles[0]} futures were completed twice"
+    # cancelled legs are only FLAGGED by _settle; the retire pass
+    # reclaims their slots — give it a few iterations before the
+    # leak audit
+    for _ in range(10):
+        group.run_iteration()
+    g = group.guard
+    assert g.hedges >= 100, f"only {g.hedges} hedges fired"
+    assert g.hedge_cancelled >= 1
+    for r in group.replicas:
+        r.scheduler.pool.check()
+        assert r.scheduler.pool.free_count() == 2, \
+            f"replica {r.index} leaked decode slots"
+
+
+# ------------------------------------- retry budget bounds resubmission
+def test_resubmits_bounded_by_retry_budget_and_counted():
+    """A replica that dies on every-other iteration would resubmit
+    forever under retries=10; the group retry budget (rate 0, burst 2)
+    caps it at exactly 2, the failure is the typed
+    RetryBudgetExhausted, and the counter records both."""
+    tm.enable()
+    maxlen = 12
+    cfg, _exe, _infer, _logits, params = _seeded_stack(maxlen=maxlen)
+    gcfg = GuardConfig(hedge=False, slow_factor=1e9, retry_rate=0.0,
+                       retry_burst=2, enter_streak=10**6,
+                       err_probation=2.0, queue_high=10**9)
+    group = _group(cfg, params, replicas=3, slots=2, maxlen=maxlen,
+                   guard=gcfg, name="retrycap", retries=10)
+    chaos.configure("worker_crash:every=2")
+    fut = group.submit(np.arange(2, 8).astype("int64"), src_len=6,
+                       max_new_tokens=5)
+    err = None
+    try:
+        for _ in range(400):
+            try:
+                fut.result(timeout=0)
+                break
+            except TimeoutError:
+                pass
+            for r in group.replicas:
+                try:
+                    r.scheduler.run_iteration()
+                except ChaosFault as e:
+                    r.scheduler._crash_recover(e)
+                    r.scheduler.restarts += 1
+    except RetryBudgetExhausted as e:
+        err = e
+    finally:
+        chaos.reset()
+    assert err is not None, "retry budget never tripped"
+    g = group.guard
+    assert g.resubmits == 2, f"budget burst=2 allowed {g.resubmits}"
+    assert g.retry_budget.denied >= 1
+    assert tm.counter("serving.guard.resubmits").value == 2
+    for r in group.replicas:
+        r.scheduler.pool.check()
+        assert r.scheduler.pool.free_count() == 2
+
+
+# --------------------------------------- HTTP overload-surface pins
+class _RaisingDecoder:
+    """Duck-typed decode tier whose submissions fail with a canned
+    typed error — exercises the transport mapping in isolation."""
+
+    def __init__(self, exc):
+        self._exc = exc
+
+    def start(self):
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        pass
+
+    def submit(self, src, **kw):
+        exc = self._exc
+
+        class _F:
+            def result(self, timeout=None):
+                raise exc
+
+        return _F()
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read().decode())
+
+
+def test_http_retry_after_on_overload_verdicts():
+    """Every 429/503 must carry Retry-After: the brownout hint rounded
+    up, 1s otherwise; bodies carry the machine-readable kind."""
+    cases = [
+        (BrownoutShed("shed", retry_after_s=2.5), 429, "brownout", "3"),
+        (RetryBudgetExhausted("storm"), 429, "retry_budget", "1"),
+        (RejectedError("queue full"), 429, "rejected", "1"),
+    ]
+    for exc, want_code, want_kind, want_ra in cases:
+        server = ModelServer()
+        server.attach_decoder("nmt", _RaisingDecoder(exc))
+        with HttpFrontend(server, port=0) as fe:
+            code, headers, body = _post(
+                f"{fe.url}/v1/models/nmt:predict",
+                {"inputs": {"src": [2, 3, 4]}, "max_new_tokens": 4})
+        server.shutdown(drain=False)
+        assert code == want_code, (exc, code, body)
+        assert body["kind"] == want_kind
+        assert headers.get("Retry-After") == want_ra, \
+            f"{want_kind}: Retry-After {headers.get('Retry-After')!r}"
+
+
+def test_http_retry_after_on_draining_paths():
+    server = ModelServer()
+    server.attach_decoder("nmt", _RaisingDecoder(RuntimeError("x")))
+    with HttpFrontend(server, port=0) as fe:
+        server.shutdown(drain=False)
+        # healthz flips to 503 draining with a back-off hint
+        try:
+            with urllib.request.urlopen(f"{fe.url}/healthz",
+                                        timeout=10) as resp:
+                code, headers = resp.status, dict(resp.headers)
+                body = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            code, headers = e.code, dict(e.headers)
+            body = json.loads(e.read().decode())
+        assert code == 503 and body["status"] == "draining"
+        assert headers.get("Retry-After") == "1"
+        # and a predict against the draining server: 503 + Retry-After
+        code, headers, body = _post(
+            f"{fe.url}/v1/models/nmt:predict",
+            {"inputs": {"src": [2, 3]}, "max_new_tokens": 2})
+        assert code == 503 and body["kind"] == "draining"
+        assert headers.get("Retry-After") == "1"
+
+
+def test_healthz_reports_brownout_but_stays_200():
+    import types
+    server = ModelServer()
+    guard = types.SimpleNamespace(
+        brownout=types.SimpleNamespace(active=True))
+    dec = _RaisingDecoder(RuntimeError("x"))
+    dec.guard = guard
+    server.attach_decoder("nmt", dec)
+    with HttpFrontend(server, port=0) as fe:
+        with urllib.request.urlopen(f"{fe.url}/healthz",
+                                    timeout=10) as resp:
+            assert resp.status == 200, \
+                "brownout must NOT unhealth the balancer target"
+            assert json.loads(resp.read().decode())["status"] == \
+                "browned_out"
+        guard.brownout.active = False
+        with urllib.request.urlopen(f"{fe.url}/healthz",
+                                    timeout=10) as resp:
+            assert json.loads(resp.read().decode())["status"] == "ok"
+    server.shutdown(drain=False)
+
+
+# ------------------------------------------------------ subprocess gate
+def test_tpuserve_selftest_guard_subprocess():
+    """The tpuguard CI gate: hedging cuts p99 at token parity, a
+    flapping replica is ejected/probed/re-admitted with zero drops, a
+    poisoned request fails alone without ejecting its replicas, and
+    brownout sheds only the lowest class then recovers; the retry
+    budget caps resubmissions at its burst."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    env.pop("PADDLE_TPU_CHAOS", None)
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpuserve.py"),
+         "--selftest-guard", "--json"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["ok"] is True and obj["problems"] == []
+    hedge = obj["hedge"]
+    assert hedge["hedged"]["p99_ms"] < 0.7 * hedge["off"]["p99_ms"]
+    assert hedge["hedged"]["hedges"] >= 1
+    assert hedge["hedged"]["hedge_wins"] >= 1
+    flap = obj["flap"]
+    assert flap["ejections"] >= 1 and flap["probes"] >= 1
+    assert flap["readmissions"] >= 1
+    assert flap["final_states"] == ["healthy", "healthy"]
+    assert obj["poison"]["failed"] == [2]
+    over = obj["overload"]
+    assert over["brownout"]["sheds"] == 2
+    assert over["brownout"]["recovered"] is True
+    assert over["retry_budget"]["typed"] is True
+    assert over["retry_budget"]["resubmits"] == 2

@@ -45,6 +45,19 @@ Modes:
                    replicas, fp32 vs int8 KV, pooled vs disaggregated
                    prefill): slots/device and goodput/device per
                    case; writes the BENCH_decode2.json artifact.
+  --selftest-guard the tpuguard CI gate: hedged requests must cut p99
+                   vs guard-off under replica_slow on 1 of 2 replicas
+                   at greedy_decode token parity; a replica_flap'd
+                   replica must be ejected, probed and re-admitted
+                   with zero drops; request_poison must fail exactly
+                   one request with the replica surviving probation;
+                   brownout must shed only the lowest QoS class with
+                   a Retry-After hint and recover, and the retry
+                   budget must cap resubmissions with a typed error.
+  --bench-guard    closed-loop p50/p99 with vs without hedging while
+                   replica_slow throttles 1 of 2 replicas; writes
+                   BENCH_guard.json and appends guard_* records to
+                   the bench history spine (tpustat --slo).
 
 Examples:
   python tools/tpuserve.py /models/mnist --name mnist --port 8500
@@ -54,6 +67,8 @@ Examples:
   python tools/tpuserve.py --bench-decode --duration 5 --json
   python tools/tpuserve.py --selftest-farm --json
   python tools/tpuserve.py --bench-farm --duration 5 --json
+  python tools/tpuserve.py --selftest-guard --json
+  python tools/tpuserve.py --bench-guard --duration 5 --json
 """
 import argparse
 import json
@@ -751,7 +766,7 @@ def run_bench_decode(args):
 # ------------------------------------------------------------------- farm
 def _farm_group(cfg, params, replicas, slots, maxlen, buckets,
                 prefill_devices=0, kv_quant=None, name="farm",
-                max_queue=64, retries=1):
+                max_queue=64, retries=1, guard=None, qos_factory=None):
     from paddle_tpu.serving.decode import (DecodeConfig,
                                            DecodeEngineConfig)
     from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup
@@ -761,7 +776,8 @@ def _farm_group(cfg, params, replicas, slots, maxlen, buckets,
                                   prefill_buckets=buckets,
                                   kv_quant=kv_quant),
         decode=DecodeConfig(bos=0, max_queue_requests=max_queue),
-        retries=retries), name=name)
+        retries=retries, guard=guard, qos_factory=qos_factory),
+        name=name)
 
 
 def _pump_group(group, futures, problems, label, budget=800):
@@ -1204,6 +1220,672 @@ def run_bench_farm(args):
     return 0
 
 
+# ------------------------------------------------------------------ guard
+def _guard_latency_phase(group, reqs, expected, problems, label,
+                         threads=4, timeout=30.0):
+    """Closed-loop clients over a STARTED group: every request's
+    latency recorded, every token sequence checked against the
+    precomputed greedy_decode reference. Returns sorted latencies."""
+    import numpy as np
+    lock = threading.Lock()
+    lats, errs, mism = [], [], [0]
+
+    def client(wid):
+        for i in range(wid, len(reqs), threads):
+            src, n, mn = reqs[i]
+            t0 = time.monotonic()
+            try:
+                r = group.submit(src, src_len=n,
+                                 max_new_tokens=mn).result(
+                    timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — a drop
+                with lock:
+                    errs.append(f"{type(e).__name__}: {e}")
+                continue
+            dt = time.monotonic() - t0
+            with lock:
+                lats.append(dt)
+                if not np.array_equal(
+                        np.asarray(r.tokens, np.int64), expected[i]):
+                    mism[0] += 1
+
+    ts = [threading.Thread(target=client, args=(w,), daemon=True)
+          for w in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout + 30.0)
+    if errs:
+        problems.append(f"guard {label}: dropped {len(errs)}/"
+                        f"{len(reqs)} requests (first: {errs[0]})")
+    if mism[0]:
+        problems.append(
+            f"guard {label}: {mism[0]}/{len(reqs)} outputs differ "
+            f"from greedy_decode — hedging/cancellation changed "
+            f"the tokens")
+    return sorted(lats)
+
+
+def _hedge_guard_config(**over):
+    """Hedging isolated: health transitions and brownout are pushed
+    out of reach so any p99 win is attributable to the hedge alone."""
+    from paddle_tpu.serving.guard import GuardConfig
+    kw = dict(hedge_fixed_delay_s=0.05, hedge_fraction=1.0,
+              hedge_burst=64.0, retry_rate=1000.0, retry_burst=1000,
+              slow_factor=1e9, err_probation=2.0, enter_streak=10**6,
+              queue_high=10**9)
+    kw.update(over)
+    return GuardConfig(**kw)
+
+
+def _guard_hedge_leg(problems, cfg, exe, infer, logits, params,
+                     maxlen, buckets):
+    """Leg (a): replica_slow on 1 of 2 replicas — hedged requests must
+    cut p99 vs the guard-off group under the SAME fault, at token
+    parity with greedy_decode, with every losing leg's slot
+    reclaimed."""
+    import numpy as np
+    from paddle_tpu.models.transformer import greedy_decode
+    from paddle_tpu.resilience import chaos as _chaos
+
+    slots = 4
+    rng = np.random.RandomState(17)
+    reqs = _decode_requests(rng, 24, maxlen, cfg.trg_vocab, 6)
+    expected = []
+    for src, n, max_new in reqs:
+        row = np.zeros((1, maxlen), np.int64)
+        row[0, :n] = src
+        ids = greedy_decode(exe, infer, logits, row,
+                            np.array([n], "int64"), bos=0,
+                            fetch_argmax=True)
+        expected.append(ids[0, 1:1 + max_new])
+
+    out = {}
+    for label, guard in (("off", None), ("hedged",
+                                         _hedge_guard_config())):
+        group = _farm_group(cfg, params, replicas=2, slots=slots,
+                            maxlen=maxlen, buckets=buckets,
+                            name=f"guard-{label}", retries=2,
+                            guard=guard).start()
+        _chaos.configure("replica_slow:ms=120,replica=0")
+        try:
+            lats = _guard_latency_phase(group, reqs, expected,
+                                        problems, label)
+        finally:
+            _chaos.reset()
+            group.stop(drain=True, timeout=15.0)
+        for r in group.replicas:
+            r.scheduler.pool.check()
+            if r.scheduler.pool.free_count() != slots:
+                problems.append(f"guard {label}: replica {r.index} "
+                                f"leaked slots")
+        case = {"requests": len(lats),
+                "p50_ms": round(1000 * _percentile(lats, 0.50), 2)
+                if lats else None,
+                "p99_ms": round(1000 * _percentile(lats, 0.99), 2)
+                if lats else None}
+        if guard is not None:
+            g = group.guard
+            case.update(hedges=g.hedges, hedge_wins=g.hedge_wins,
+                        hedge_cancelled=g.hedge_cancelled)
+            if g.hedges < 1:
+                problems.append("hedging never fired under "
+                                "replica_slow")
+            if g.hedge_wins < 1:
+                problems.append("no hedge ever won the race against "
+                                "the throttled primary")
+        out[label] = case
+    off, on = out["off"]["p99_ms"], out["hedged"]["p99_ms"]
+    if off is not None and off < 200.0:
+        problems.append(f"replica_slow did not bite: guard-off p99 "
+                        f"{off}ms (expected a throttled tail)")
+    if off is not None and on is not None and on >= 0.7 * off:
+        problems.append(
+            f"hedging did not cut p99: {on}ms hedged vs {off}ms "
+            f"guard-off under the same replica_slow fault")
+    return out
+
+
+def _pump_guard(group, futs, problems, label, budget=600):
+    """Drive a non-started guarded group until every future resolves,
+    catching injected crashes the way the supervisor thread would.
+    Returns {index: DecodeResult}; drops land in `problems`."""
+    from paddle_tpu.resilience.chaos import ChaosFault
+    results, pending, left = {}, dict(enumerate(futs)), budget
+    while pending and left:
+        left -= 1
+        for i, f in list(pending.items()):
+            try:
+                results[i] = f.result(timeout=0)
+                del pending[i]
+            except TimeoutError:
+                pass            # resubmitted / still decoding
+            except Exception as e:  # noqa: BLE001 — a drop
+                problems.append(f"guard {label} dropped a request: "
+                                f"{type(e).__name__}: {e}")
+                del pending[i]
+        if not pending:
+            break
+        for r in group.replicas:
+            try:
+                r.scheduler.run_iteration()
+            except ChaosFault as e:
+                r.scheduler._crash_recover(e)
+                r.scheduler.restarts += 1
+    if pending:
+        problems.append(f"guard {label}: {len(pending)} requests "
+                        f"never completed in {budget} iterations")
+    return results
+
+
+def _guard_flap_leg(problems, cfg, params, maxlen, buckets):
+    """Leg (b): a crash-flapping replica must be walked to EJECTED,
+    probed after cooldown, and re-admitted — with zero dropped
+    requests along the way. Manually driven: the flap is armed only
+    once slots are bound, so the walk is deterministic."""
+    import numpy as np
+    from paddle_tpu.resilience import chaos as _chaos
+    from paddle_tpu.resilience.chaos import ChaosFault
+    from paddle_tpu.serving.guard import GuardConfig
+
+    # trip-sensitive health for CI clocks: the first crash-failed leg
+    # puts replica 0 on probation, the second consecutive one ejects
+    # it (a real deployment would ride the defaults' longer streaks)
+    gcfg = GuardConfig(hedge=False, slow_factor=1e9, min_samples=1,
+                       enter_streak=1, probation_grace=1,
+                       err_probation=0.25, err_exit=0.6,
+                       probation_good=1, cooldown_s=0.25,
+                       cooldown_max_s=2.0, retry_rate=200.0,
+                       retry_burst=200, queue_high=10**9)
+    group = _farm_group(cfg, params, replicas=2, slots=4,
+                        maxlen=maxlen, buckets=buckets,
+                        name="guard-flap", retries=4, guard=gcfg)
+    health = group.guard.health
+    rng = np.random.RandomState(19)
+    reqs = _decode_requests(rng, 12, maxlen, cfg.trg_vocab, 5)
+
+    # 4 submissions alternate r0/r1 under least-loaded scoring; admit
+    # them into slots BEFORE arming the flap so the burst has legs to
+    # kill (the chaos check runs before admission, so queued-only work
+    # never dies with a replica)
+    futs = [group.submit(src, src_len=n, max_new_tokens=mn)
+            for src, n, mn in reqs[:4]]
+    legs0 = sum(1 for f in futs if f.replica_index == 0)
+    if legs0 < 2:
+        problems.append(f"flap precondition: expected 2 legs routed "
+                        f"to replica 0, got {legs0}")
+    group.run_iteration()
+    _chaos.configure("replica_flap:at=1,times=2,replica=0")
+    try:
+        r0 = group.replicas[0]
+        try:
+            r0.scheduler.run_iteration()
+            problems.append("replica_flap never fired on the bound "
+                            "slots")
+        except ChaosFault as e:
+            r0.scheduler._crash_recover(e)
+            r0.scheduler.restarts += 1
+        # polling the dead legs immediately (pure Python, well inside
+        # the cooldown window) feeds the health tracker: first error
+        # -> probation, second consecutive -> EJECTED; both requests
+        # resubmit to replica 1 — zero drops
+        for f in futs:
+            try:
+                f.result(timeout=0)
+            except TimeoutError:
+                pass
+        if health.ejections < 1 or health.state(0) != "ejected":
+            problems.append(
+                f"flapping replica was not ejected (state "
+                f"{health.state(0)!r}, ejections "
+                f"{health.ejections})")
+        # while ejected the router must never select replica 0
+        mid = [group.submit(src, src_len=n, max_new_tokens=mn)
+               for src, n, mn in reqs[4:8]]
+        if any(f.replica_index == 0 for f in mid):
+            problems.append("router sent traffic to an EJECTED "
+                            "replica")
+        _pump_guard(group, futs + mid, problems, "flap-mid",
+                    budget=400)
+        # cooldown passes -> HALF_OPEN; the next request IS the probe.
+        # The flap still has one charge: the probe rides through a
+        # respawn (the crash fires before admission, so the probe
+        # survives queued), then completes as the OK sample that
+        # re-admits the replica
+        time.sleep(0.3)
+        src, n, mn = reqs[8]
+        probe = group.submit(src, src_len=n, max_new_tokens=mn)
+        if probe.replica_index != 0:
+            problems.append(
+                f"half-open probe was not routed to the cooled-down "
+                f"replica (went to {probe.replica_index})")
+        if health.probes < 1:
+            problems.append("probe routing did not consume probe "
+                            "capacity")
+        _pump_guard(group, [probe], problems, "flap-probe",
+                    budget=400)
+    finally:
+        _chaos.reset()
+    if health.readmissions < 1 or health.state(0) != "healthy":
+        problems.append(
+            f"probed replica was not re-admitted (state "
+            f"{health.state(0)!r}, readmissions "
+            f"{health.readmissions})")
+    for r in group.replicas:
+        r.scheduler.pool.check()
+        if r.scheduler.pool.free_count() != 4:
+            problems.append(f"flap leg: replica {r.index} leaked "
+                            f"slots")
+    return {"served": 9, "ejections": health.ejections,
+            "probes": health.probes,
+            "readmissions": health.readmissions,
+            "replica0_restarts": group.replicas[0].scheduler.restarts,
+            "final_states": [health.state(r.index)
+                             for r in group.replicas]}
+
+
+def _guard_poison_leg(problems, cfg, params, maxlen, buckets):
+    """Leg (c): request_poison kills whichever replica steps it — the
+    poisoned request must fail ALONE (typed, after its retries burn
+    out), innocents ride resubmission, the blasted replicas survive
+    probation without ejection, and no slot leaks."""
+    import numpy as np
+    from paddle_tpu.resilience import chaos as _chaos
+    from paddle_tpu.serving.guard import GuardConfig
+
+    slots = 4
+    gcfg = GuardConfig(hedge=False, slow_factor=1e9, enter_streak=3,
+                       probation_grace=10, err_probation=0.35,
+                       retry_rate=200.0, retry_burst=200,
+                       queue_high=10**9)
+    group = _farm_group(cfg, params, replicas=2, slots=slots,
+                        maxlen=maxlen, buckets=buckets,
+                        name="guard-poison", retries=3,
+                        guard=gcfg).start()
+    rng = np.random.RandomState(31)
+    reqs = _decode_requests(rng, 8, maxlen, cfg.trg_vocab, 5)
+    poison_i = 2
+    _chaos.configure(f"request_poison:at={poison_i + 1}")
+    outcomes = []
+    try:
+        futures = [group.submit(src, src_len=n, max_new_tokens=mn)
+                   for src, n, mn in reqs]
+        for f in futures:
+            try:
+                r = f.result(timeout=30.0)
+                outcomes.append(("ok", len(r.tokens)))
+            except Exception as e:  # noqa: BLE001 — expected once
+                outcomes.append(("err", type(e).__name__))
+    finally:
+        _chaos.reset()
+    failed = [i for i, o in enumerate(outcomes) if o[0] == "err"]
+    if failed != [poison_i]:
+        problems.append(
+            f"request_poison blast was not contained: requests "
+            f"{failed} failed, expected exactly [{poison_i}] "
+            f"(outcomes: {outcomes})")
+    health = group.guard.health
+    if health.ejections:
+        problems.append("a single poisoned request got a replica "
+                        "ejected (poison != sick replica)")
+    # recovery wave: both replicas must still serve after the blast
+    recovered = 0
+    for src, n, mn in reqs[:4]:
+        try:
+            group.submit(src, src_len=n,
+                         max_new_tokens=mn).result(timeout=30.0)
+            recovered += 1
+        except Exception as e:  # noqa: BLE001 — a drop
+            problems.append(f"post-poison request dropped: "
+                            f"{type(e).__name__}: {e}")
+    group.stop(drain=True, timeout=15.0)
+    for r in group.replicas:
+        r.scheduler.pool.check()
+        if r.scheduler.pool.free_count() != slots:
+            problems.append(f"poison leg: replica {r.index} leaked "
+                            f"slots")
+    return {"outcomes": outcomes,
+            "failed": failed,
+            "recovered_wave": recovered,
+            "restarts": [r.scheduler.restarts
+                         for r in group.replicas],
+            "resubmits": group.guard.resubmits,
+            "final_states": [health.state(r.index)
+                             for r in group.replicas]}
+
+
+def _guard_brownout_leg(problems, cfg, params, maxlen, buckets):
+    """Leg (d): synthetic overload — brownout sheds ONLY the lowest
+    QoS class (with a Retry-After hint), clamps the survivors'
+    generation length, recovers hysteretically; then a crash storm
+    shows the retry budget capping resubmissions with a typed error."""
+    import numpy as np
+    from paddle_tpu.resilience import chaos as _chaos
+    from paddle_tpu.resilience.chaos import ChaosFault
+    from paddle_tpu.serving import RetryBudgetExhausted
+    from paddle_tpu.serving.batcher import BrownoutShed
+    from paddle_tpu.serving.decode import QosPolicy
+    from paddle_tpu.serving.guard import GuardConfig
+
+    # --- brownout: shed the batch class, clamp interactive, recover
+    gcfg = GuardConfig(hedge=False, slow_factor=1e9, queue_high=6,
+                       queue_low=1, dwell_s=0.05, clamp_new_tokens=3,
+                       retry_after_s=2.5, retry_rate=200.0,
+                       retry_burst=200, enter_streak=10**6)
+    group = _farm_group(
+        cfg, params, replicas=1, slots=4, maxlen=maxlen,
+        buckets=buckets, name="guard-brownout", guard=gcfg,
+        qos_factory=lambda: QosPolicy(
+            tenants=[("interactive", 4.0), ("batch", 1.0)]))
+    rng = np.random.RandomState(37)
+    reqs = _decode_requests(rng, 12, maxlen, cfg.trg_vocab, 4)
+    futs, shed = [], None
+    for k in range(8):
+        src, n, mn = reqs[k]
+        try:
+            futs.append(group.submit(src, src_len=n, tenant="batch",
+                                     max_new_tokens=mn))
+        except BrownoutShed as e:
+            shed = e
+    bo = group.guard.brownout
+    if shed is None:
+        problems.append("brownout never shed the batch class under "
+                        "a flooded queue")
+    elif shed.retry_after_s != 2.5:
+        problems.append(f"BrownoutShed lost the Retry-After hint: "
+                        f"{shed.retry_after_s}")
+    if not bo.active:
+        problems.append("brownout controller not active at "
+                        "queue_high")
+    # the paying class rides through, generation length clamped
+    src, n, _ = reqs[8]
+    fi = group.submit(src, src_len=n, tenant="interactive",
+                      max_new_tokens=8)
+    if bo.clamped < 1:
+        problems.append("brownout did not clamp the interactive "
+                        "class's max_new_tokens")
+    futs.append(fi)
+    pending = dict(enumerate(futs))
+    interactive_tokens = None
+    for _ in range(600):
+        if not pending:
+            break
+        group.run_iteration()
+        for i, f in list(pending.items()):
+            try:
+                r = f.result(timeout=0)
+            except TimeoutError:
+                continue
+            if f is fi:
+                interactive_tokens = len(r.tokens)
+            del pending[i]
+    if pending:
+        problems.append(f"brownout leg: {len(pending)} requests "
+                        f"never completed")
+    if interactive_tokens is not None and interactive_tokens > 3:
+        problems.append(f"clamped interactive request generated "
+                        f"{interactive_tokens} tokens (clamp 3)")
+    time.sleep(0.06)        # past the hysteresis dwell, queue empty
+    src, n, mn = reqs[9]
+    try:
+        f2 = group.submit(src, src_len=n, tenant="batch",
+                          max_new_tokens=mn)
+    except BrownoutShed:
+        f2 = None
+        problems.append("brownout failed to recover: batch class "
+                        "still shed on an empty queue")
+    if bo.active:
+        problems.append("brownout still active after recovery "
+                        "conditions were met")
+    if f2 is not None:
+        for _ in range(200):
+            group.run_iteration()
+            try:
+                f2.result(timeout=0)
+                break
+            except TimeoutError:
+                continue
+        else:
+            problems.append("post-recovery batch request never "
+                            "completed")
+    brown = {"entries": bo.entries, "sheds": bo.sheds,
+             "clamped": bo.clamped, "recovered": not bo.active,
+             "retry_after_s": None if shed is None
+             else shed.retry_after_s}
+
+    # --- retry budget: a crash storm is capped by the token bucket,
+    # not by the per-request retry count (10 here)
+    group2 = _farm_group(cfg, params, replicas=3, slots=2,
+                         maxlen=maxlen, buckets=buckets,
+                         name="guard-storm", retries=10,
+                         guard=GuardConfig(hedge=False,
+                                           slow_factor=1e9,
+                                           retry_rate=0.0,
+                                           retry_burst=2,
+                                           queue_high=10**9))
+    src, n, _ = reqs[10]
+    _chaos.configure("worker_crash:every=2")
+    typed = None
+    try:
+        f = group2.submit(src, src_len=n, max_new_tokens=3)
+        for _ in range(200):
+            for r in group2.replicas:
+                try:
+                    r.scheduler.run_iteration()
+                except ChaosFault as e:
+                    r.scheduler._crash_recover(e)
+                    r.scheduler.restarts += 1
+            try:
+                f.result(timeout=0)
+                problems.append("crash-storm request completed — "
+                                "worker_crash:every=2 never fired")
+                break
+            except TimeoutError:
+                continue
+            except RetryBudgetExhausted as e:
+                typed = e
+                break
+            except Exception as e:  # noqa: BLE001 — wrong type
+                problems.append(
+                    f"retry-budget exhaustion raised "
+                    f"{type(e).__name__}, expected "
+                    f"RetryBudgetExhausted: {e}")
+                break
+    finally:
+        _chaos.reset()
+    if typed is None and not problems:
+        problems.append("retry budget never produced a typed "
+                        "RetryBudgetExhausted under the crash storm")
+    g2 = group2.guard
+    if g2.resubmits != 2:
+        problems.append(f"retry budget (burst 2) allowed "
+                        f"{g2.resubmits} resubmissions, expected "
+                        f"exactly 2")
+    for r in group2.replicas:
+        r.scheduler.pool.check()
+    return {"brownout": brown,
+            "retry_budget": {"typed": typed is not None,
+                             "resubmits": g2.resubmits,
+                             "denied": g2.retry_budget.denied}}
+
+
+def _guard_selftest_problems(problems):
+    """The tpuguard CI gate: hedging under replica_slow, flap
+    ejection/re-admission, poison containment, brownout + retry
+    budget."""
+    maxlen, buckets = 16, (1, 2, 4)
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+    info = {"hedge": _guard_hedge_leg(problems, cfg, exe, infer,
+                                      logits, params, maxlen,
+                                      buckets),
+            "flap": _guard_flap_leg(problems, cfg, params, maxlen,
+                                    buckets),
+            "poison": _guard_poison_leg(problems, cfg, params, maxlen,
+                                        buckets),
+            "overload": _guard_brownout_leg(problems, cfg, params,
+                                            maxlen, buckets)}
+    return info
+
+
+def run_selftest_guard(args):
+    from paddle_tpu import telemetry
+    telemetry.enable()
+    problems = []
+    info = _guard_selftest_problems(problems)
+    result = {"mode": "selftest-guard", **info,
+              "problems": problems, "ok": not problems}
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    else:
+        h = info["hedge"]
+        fl = info["flap"]
+        ov = info["overload"]
+        print(f"tpuserve selftest-guard: hedged p99 "
+              f"{h['hedged']['p99_ms']}ms vs {h['off']['p99_ms']}ms "
+              f"guard-off ({h['hedged'].get('hedges', 0)} hedges, "
+              f"{h['hedged'].get('hedge_wins', 0)} wins); flap "
+              f"ejections={fl['ejections']} probes={fl['probes']} "
+              f"readmissions={fl['readmissions']} "
+              f"dropped={fl['dropped']}; poison failed "
+              f"{info['poison']['failed']}; brownout sheds="
+              f"{ov['brownout']['sheds']} clamped="
+              f"{ov['brownout']['clamped']} recovered="
+              f"{ov['brownout']['recovered']}; retry resubmits="
+              f"{ov['retry_budget']['resubmits']}")
+        for prob in problems:
+            print(f"FAIL: {prob}", file=sys.stderr)
+    return 2 if problems else 0
+
+
+def run_bench_guard(args):
+    """Tail-latency defense bench: closed-loop p50/p99 with and
+    without hedging while replica_slow throttles 1 of 2 replicas.
+    Writes BENCH_guard.json and appends guard_* records to the
+    paddle_tpu.bench.history.v1 spine for the tpustat --slo gate."""
+    import numpy as np
+    from paddle_tpu import telemetry
+    from paddle_tpu.resilience import chaos as _chaos
+    telemetry.enable()
+
+    maxlen = args.decode_max_len
+    slots = args.slots
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+    rng = np.random.RandomState(43)
+    reqs = _decode_requests(rng, 128, max(4, maxlen // 2),
+                            cfg.trg_vocab, 8)
+    out_cases = {}
+    for label, guard in (("guard_off", None),
+                         ("guard_hedged", _hedge_guard_config())):
+        group = _farm_group(cfg, params, replicas=2, slots=slots,
+                            maxlen=maxlen, buckets=None, name=label,
+                            retries=2, guard=guard,
+                            max_queue=16 * slots).start()
+        _chaos.configure("replica_slow:ms=60,replica=0")
+        stop_t = time.monotonic() + args.duration
+        lock = threading.Lock()
+        lats, drops = [], [0]
+
+        def client(wid, _stop=stop_t, _g=group):
+            i = wid
+            while time.monotonic() < _stop:
+                src, n, mn = reqs[i % len(reqs)]
+                i += 4 * slots
+                t0 = time.monotonic()
+                try:
+                    _g.submit(src, src_len=n,
+                              max_new_tokens=mn).result(
+                        timeout=max(5.0, args.duration))
+                except Exception:  # noqa: BLE001 — count, move on
+                    with lock:
+                        drops[0] += 1
+                    continue
+                with lock:
+                    lats.append(time.monotonic() - t0)
+
+        clients = [threading.Thread(target=client, args=(w,),
+                                    daemon=True)
+                   for w in range(4 * slots)]
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join()
+        _chaos.reset()
+        group.stop(drain=True, timeout=15.0)
+        lats.sort()
+        case = {"requests": len(lats), "dropped": drops[0],
+                "p50_ms": round(1000 * _percentile(lats, 0.50), 2)
+                if lats else None,
+                "p99_ms": round(1000 * _percentile(lats, 0.99), 2)
+                if lats else None}
+        if guard is not None:
+            g = group.guard
+            case.update(hedges=g.hedges, hedge_wins=g.hedge_wins,
+                        hedge_cancelled=g.hedge_cancelled)
+        out_cases[label] = case
+        if not args.as_json:
+            print(f"  {label:<14} p50 {case['p50_ms']}ms  p99 "
+                  f"{case['p99_ms']}ms  ({case['requests']} requests"
+                  + (f", {case['hedges']} hedges"
+                     if "hedges" in case else "") + ")")
+
+    result = {"mode": "bench-guard", "model": "transformer-tiny",
+              "maxlen": maxlen, "slots_per_replica": slots,
+              "duration_s": args.duration,
+              "fault": "replica_slow:ms=60,replica=0",
+              "cases": out_cases}
+    out_path = os.path.join(_REPO, "BENCH_guard.json")
+    try:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+    except OSError:
+        pass
+    result["history_appended"] = _guard_append_history(out_cases)
+    if args.as_json:
+        print(json.dumps(result))
+    return 0
+
+
+def _guard_append_history(cases):
+    """One paddle_tpu.bench.history.v1 record per headline guard
+    metric, onto the same spine bench.py feeds (BENCH_HISTORY_PATH
+    overrides the repo-root default) so `tpustat --slo` regression-
+    gates the hedged tail like any other perf number. Best-effort:
+    returns the path or None, never raises."""
+    try:
+        import subprocess
+
+        from paddle_tpu.telemetry import slo
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO,
+                capture_output=True, text=True,
+                timeout=10).stdout.strip() or None
+        except Exception:  # noqa: BLE001 — sha is optional
+            sha = None
+        common = {"schema": slo.HISTORY_SCHEMA,
+                  "platform": os.environ.get("JAX_PLATFORMS", "cpu"),
+                  "device_kind": "cpu", "git_sha": sha,
+                  "unix_time": round(time.time(), 1),
+                  "stage": "guard"}
+        recs = []
+        for case, key, metric in (
+                ("guard_off", "p99_ms", "guard_off_p99_ms"),
+                ("guard_hedged", "p99_ms", "guard_hedged_p99_ms"),
+                ("guard_hedged", "p50_ms", "guard_hedged_p50_ms")):
+            v = cases.get(case, {}).get(key)
+            if isinstance(v, (int, float)) and v:
+                recs.append(dict(common, metric=metric, value=v,
+                                 unit="ms"))
+        if not recs:
+            return None
+        path = os.environ.get("BENCH_HISTORY_PATH") \
+            or os.path.join(_REPO, "BENCH_history.jsonl")
+        slo.append_history(path, recs)
+        return path
+    except Exception:  # noqa: BLE001 — history is best-effort
+        return None
+
+
 # ------------------------------------------------------------------ serve
 def run_serve(args):
     from paddle_tpu import telemetry
@@ -1280,6 +1962,21 @@ def main(argv=None):
                    help="replica-group bench across 1 vs 2 replicas, "
                         "fp32 vs int8 KV, pooled vs disaggregated "
                         "prefill; writes BENCH_decode2.json")
+    p.add_argument("--selftest-guard", action="store_true",
+                   dest="selftest_guard",
+                   help="the tpuguard CI gate: hedging cuts p99 "
+                        "under replica_slow at token parity, a "
+                        "flapping replica is ejected/probed/"
+                        "re-admitted with zero drops, request_poison "
+                        "fails alone, brownout sheds only the lowest "
+                        "QoS class and recovers, the retry budget "
+                        "caps resubmissions with a typed error")
+    p.add_argument("--bench-guard", action="store_true",
+                   dest="bench_guard",
+                   help="p50/p99 with vs without hedging while "
+                        "replica_slow throttles 1 of 2 replicas; "
+                        "writes BENCH_guard.json and appends to the "
+                        "bench history spine")
     p.add_argument("--slots", type=int, default=8,
                    help="--bench-decode slot-pool size")
     p.add_argument("--decode-max-len", type=int, default=32,
@@ -1291,7 +1988,8 @@ def main(argv=None):
 
     if args.platform != "env":
         os.environ["JAX_PLATFORMS"] = args.platform
-    if args.selftest_farm or args.bench_farm:
+    if args.selftest_farm or args.bench_farm or args.selftest_guard \
+            or args.bench_guard:
         # the farm slices real devices: give the CPU backend 8
         # virtual ones (must land before jax is first imported)
         flags = os.environ.get("XLA_FLAGS", "")
@@ -1309,10 +2007,15 @@ def main(argv=None):
         return run_selftest_farm(args)
     if args.bench_farm:
         return run_bench_farm(args)
+    if args.selftest_guard:
+        return run_selftest_guard(args)
+    if args.bench_guard:
+        return run_bench_guard(args)
     if not args.model_dir:
         p.error("model_dir is required unless --selftest / "
                 "--selftest-decode / --bench-decode / "
-                "--selftest-farm / --bench-farm")
+                "--selftest-farm / --bench-farm / "
+                "--selftest-guard / --bench-guard")
     if args.bench:
         return run_bench(args)
     return run_serve(args)

@@ -300,10 +300,17 @@ def _print_fleet_table(rep):
         print(f"  hint: {strag['hint']}")
 
 
+# serving.replica.<i>.guard_state gauge codes (guard/health.py
+# STATE_CODES) — rendered in the replica table's state column
+_GUARD_STATES = {0.0: "ok", 1.0: "probation", 2.0: "EJECTED",
+                 3.0: "half-open"}
+
+
 def _print_replica_table(rep):
     """Serving-farm sub-table: one row per decode replica, from the
     serving.replica.<i>.* gauges (ranks serving no farm print
-    nothing)."""
+    nothing), plus one guard line per rank running overload defense
+    (serving.guard.* rollups)."""
     rows = []
     for r in rep["ranks"]:
         pr = rep["per_rank"][str(r)]
@@ -320,6 +327,8 @@ def _print_replica_table(rep):
     for r, idx, d in rows:
         state = "down" if not d.get("alive", 1.0) else (
             "draining" if d.get("draining") else "ok")
+        if state == "ok" and "guard_state" in d:
+            state = _GUARD_STATES.get(d["guard_state"], "ok")
         print(f"    {r:<5} {idx:>3} {int(d.get('version', 1)):>4} "
               f"{int(d.get('slots_in_use', 0)):>3}/"
               f"{int(d.get('num_slots', 0)):<3} "
@@ -328,6 +337,20 @@ def _print_replica_table(rep):
               f"{int(d.get('tokens_total', 0)):>8} "
               f"{d.get('goodput_tps', 0.0):>8.1f} "
               f"{int(d.get('restarts', 0)):>8}  {state}")
+    for r in rep["ranks"]:
+        g = rep["per_rank"][str(r)].get("serving_guard") or {}
+        if not g:
+            continue
+        p99 = g.get("p99_ms")
+        print(f"    guard[rank {r}]: "
+              f"{'BROWNOUT' if g.get('brownout') else 'normal'} "
+              f"ejections={int(g.get('ejections', 0))} "
+              f"readmissions={int(g.get('readmissions', 0))} "
+              f"hedges={int(g.get('hedges', 0))} "
+              f"(wins={int(g.get('hedge_wins', 0))}) "
+              f"resubmits={int(g.get('resubmits', 0))} "
+              f"sheds={int(g.get('brownout_sheds', 0))} "
+              f"p99={f'{p99:.1f}ms' if p99 is not None else '-'}")
 
 
 def _fleet_report(spool, as_json, trace_path):
