@@ -14,8 +14,16 @@ IR *before* tracing:
     waw-hazard         write-after-write / aliasing (parallel/ safety)
     recompile-hazard   attrs/feed signatures that bust the compile cache
 
+The `meshlint` subpackage extends the same pipeline to SHARDED
+executions (PartitionSpecs vs the mesh + API-capability verdicts,
+collective consistency, donation aliasing, per-device footprint,
+static recompile hazards) — see analysis/meshlint/__init__.py. It is
+imported lazily (ParallelExecutor.verify(), FarmConfig.verify(),
+tools/tpulint.py), never from here: the validate-off path must not pay
+for it.
+
 Entry points: Program.verify(), Executor.run(..., validate=True) /
-PADDLE_TPU_VALIDATE=1, and tools/proglint.py.
+PADDLE_TPU_VALIDATE=1, tools/proglint.py, and tools/tpulint.py.
 """
 from .diagnostics import (Diagnostic, ProgramVerificationError,
                           SEVERITIES, ERROR, WARNING, INFO,

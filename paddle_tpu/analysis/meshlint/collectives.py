@@ -1,0 +1,211 @@
+"""collective-consistency pass: per-member collective sequences under a
+policy, and conditional collectives that can deadlock.
+
+SPMD correctness hangs on every member issuing the SAME collectives in
+the SAME order. Three ways this repo can break that statically:
+
+1. members configured with DIFFERENT policies — a gradsync bucket
+   order or sparse exchange that differs per member interleaves
+   all_reduces against all_gathers and deadlocks;
+2. a nondeterministic bucket plan — the plan must be a pure function
+   of the program, or ranks that built it independently disagree;
+3. a collective-bearing op inside a conditionally-executed sub-block
+   (cond/while body) — members whose predicate differs skip the
+   collective others are blocked in.
+
+The per-member collective sequence for a gradsync policy is derived
+from the same `plan_buckets` the executor uses, so the lint and the
+runtime cannot drift.
+"""
+from ..defuse import CONTROL_FLOW_TYPES, sub_block_indices
+from ..diagnostics import Diagnostic, ERROR
+from .context import mesh_pass
+
+__all__ = ["check_collective_consistency", "gradsync_collective_plan",
+           "policy_grammar_diags"]
+
+
+def _policy_str(policy):
+    if policy is None:
+        return None
+    if isinstance(policy, str):
+        return policy
+    key = getattr(policy, "key", None)
+    if callable(key):
+        return str(key())
+    return str(policy)
+
+
+def gradsync_collective_plan(program, policy):
+    """Ordered per-member collective sequence for a gradsync policy
+    over `program`'s trainable params: [(op, axis, bucket_index,
+    dtype)] — all_reduce per bucket in reverse-topological order, then
+    the sparse-tap all_gathers (parallel/gradsync.py
+    sync_gradients)."""
+    from ...parallel import gradsync as _gs
+    pol = _gs.resolve_policy(policy) if isinstance(policy, str) \
+        else policy
+    if pol is None:
+        return []
+    named = []
+    sparse_taps = []
+    block = program.global_block()
+    grad_params = set()
+    for op in block.ops:
+        if op.type == "backward_macro":
+            grad_params |= set(op.attrs.get("param_names", ()))
+    for v in program.list_vars():
+        if v.persistable and v.name in grad_params:
+            named.append((v.name, tuple(v.shape), v.dtype))
+    for op in block.ops:
+        if op.attrs.get("is_sparse") and op.inputs.get("SparseDelta"):
+            w = op.inputs.get("W", [None])[0]
+            if w:
+                sparse_taps.append(w)
+    plan = _gs.plan_buckets(named, bucket_bytes=pol.bucket_bytes,
+                            block_size=pol.block_size)
+    seq = [("all_reduce", "dp", b.index, pol.mode) for b in plan]
+    seq += [("all_gather", "dp", None, w) for w in sorted(sparse_taps)]
+    return seq
+
+
+def policy_grammar_diags(mctx):
+    """Parse-check the policy grammar strings (gradsync + sparse) so a
+    typo'd `PADDLE_TPU_GRAD_SYNC` fails the lint, not step 0."""
+    diags = []
+    if isinstance(mctx.grad_sync, str):
+        from ...parallel import gradsync as _gs
+        try:
+            _gs.resolve_policy(mctx.grad_sync)
+        except Exception as e:
+            diags.append(Diagnostic(
+                ERROR, "collective-consistency",
+                f"gradsync policy grammar {mctx.grad_sync!r} does not "
+                f"parse: {e}",
+                hint="grammar: mode[:k=v,...] with mode in "
+                     "fp32|bf16|int8 (parallel/gradsync.py)"))
+    if isinstance(mctx.sparse, str):
+        from ...parallel import sparse as _sp
+        try:
+            _sp.parse_policy(mctx.sparse)
+        except Exception as e:
+            diags.append(Diagnostic(
+                ERROR, "collective-consistency",
+                f"sparse policy grammar {mctx.sparse!r} does not "
+                f"parse: {e}",
+                hint="grammar: shard[:stale=K,cap=N,kernel=0/1] "
+                     "(parallel/sparse.py)"))
+    return diags
+
+
+def _collective_bearing_ops(program):
+    """(block_idx, op_idx, op_type, why) for IR ops that lower to
+    collectives under a parallel policy: distributed lookup_tables
+    (engine all-to-all row exchange) and is_sparse grad taps (gradsync
+    all_gather)."""
+    out = []
+    for b in program.blocks:
+        for i, op in enumerate(b.ops):
+            if op.type == "lookup_table" \
+                    and op.attrs.get("is_distributed"):
+                out.append((b.idx, i, op.type,
+                            "distributed lookup_table: the engine's "
+                            "all-to-all row exchange"))
+            elif op.attrs.get("is_sparse") \
+                    and op.inputs.get("SparseDelta"):
+                out.append((b.idx, i, op.type,
+                            "is_sparse grad tap: gradsync's "
+                            "all_gather"))
+    return out
+
+
+@mesh_pass("collective-consistency")
+def check_collective_consistency(mctx):
+    diags = []
+    diags += policy_grammar_diags(mctx)
+
+    # 1. member policy divergence ---------------------------------
+    if mctx.member_policies is not None:
+        distinct = sorted({str(_policy_str(p))
+                           for p in mctx.member_policies})
+        if len(distinct) > 1:
+            diags.append(Diagnostic(
+                ERROR, "collective-consistency",
+                f"members are configured with {len(distinct)} "
+                f"different sync policies {distinct}: their "
+                f"collective sequences (bucket order, quantization "
+                f"mode) diverge — an interleaving deadlock, not a "
+                f"numeric bug",
+                hint="give every member the identical policy string"))
+
+    # 2. bucket-plan determinism + 3. conditional collectives ------
+    if mctx.program is not None:
+        if mctx.grad_sync is not None:
+            try:
+                a = gradsync_collective_plan(mctx.program,
+                                             mctx.grad_sync)
+                b = gradsync_collective_plan(mctx.program,
+                                             mctx.grad_sync)
+            except Exception:
+                a = b = None  # grammar diags already cover parse fails
+            if a != b:
+                diags.append(Diagnostic(
+                    ERROR, "collective-consistency",
+                    "the gradsync bucket plan is not a deterministic "
+                    "function of the program: two derivations "
+                    "disagree, so independently-planning ranks would "
+                    "issue mismatched all_reduce orders",
+                    hint="plan_buckets must be pure in the program"))
+        parallel_policy = (mctx.grad_sync is not None
+                           or mctx.sparse is not None)
+        if parallel_policy:
+            sub_blocks = set()
+            for blk in mctx.program.blocks:
+                for op in blk.ops:
+                    if op.type in CONTROL_FLOW_TYPES:
+                        sub_blocks |= set(sub_block_indices(op))
+            for bidx, oidx, otype, why in \
+                    _collective_bearing_ops(mctx.program):
+                if bidx in sub_blocks:
+                    diags.append(Diagnostic(
+                        ERROR, "collective-consistency",
+                        f"op {otype!r} in conditionally-executed "
+                        f"block {bidx} lowers to a collective under "
+                        f"the active policy ({why}); members whose "
+                        f"predicate differs skip a collective others "
+                        f"block in — deadlock",
+                        block_idx=bidx, op_idx=oidx, op_type=otype,
+                        hint="hoist the op out of the control-flow "
+                             "body, or make the predicate "
+                             "mesh-uniform"))
+
+    # 4. pipeline schedule sanity ---------------------------------
+    if mctx.pipeline_schedule is not None:
+        if mctx.pipeline_schedule not in ("gpipe", "1f1b"):
+            diags.append(Diagnostic(
+                ERROR, "collective-consistency",
+                f"unknown pipeline schedule "
+                f"{mctx.pipeline_schedule!r}",
+                hint="choose gpipe or 1f1b"))
+        if "pp" not in mctx.mesh.axes:
+            diags.append(Diagnostic(
+                ERROR, "collective-consistency",
+                f"pipeline schedule {mctx.pipeline_schedule!r} needs "
+                f"a 'pp' axis on the {mctx.mesh}",
+                hint="make_mesh(pp=n_stages, ...)"))
+        if mctx.data_axis is not None \
+                and mctx.data_axis not in mctx.mesh.axes:
+            diags.append(Diagnostic(
+                ERROR, "collective-consistency",
+                f"pipeline data_axis {mctx.data_axis!r} is not on "
+                f"the {mctx.mesh}",
+                hint="add the axis to the mesh or drop data_axis"))
+
+    # 5. gradsync needs dp ----------------------------------------
+    if mctx.grad_sync is not None and "dp" not in mctx.mesh.axes:
+        diags.append(Diagnostic(
+            ERROR, "collective-consistency",
+            f"gradsync policy {_policy_str(mctx.grad_sync)!r} "
+            f"all_reduces over 'dp', which is not on the {mctx.mesh}",
+            hint="grad_sync policies need a 'dp' mesh axis"))
+    return diags

@@ -1,0 +1,91 @@
+"""donation-aliasing pass: fetched values that alias donated buffers,
+and identity-cached feeds that a later op mutates.
+
+ParallelExecutor donates the persistable-state pytree to the jitted
+step (`donate_argnums=(0,)`): after the call the OLD buffers are dead.
+Two hazards follow that proglint cannot see (it has no donation
+concept):
+
+1. a fetch that is ITSELF a piece of persistable state returns an
+   array aliasing a donated buffer — fine synchronously (the executor
+   copies fetches out of the async window), but a fetch list naming
+   raw param names under async execution reads deleted storage;
+2. the feed-signature identity cache keys on `id(array)` — a feed
+   array the program also WRITES (an op output name colliding with a
+   feed name) invalidates the cached value without changing its id.
+"""
+from ..diagnostics import Diagnostic, ERROR, WARNING
+from .context import mesh_pass
+
+__all__ = ["check_donation_aliasing"]
+
+
+def _persistable_names(program):
+    return {v.name for v in program.list_vars() if v.persistable}
+
+
+def _written_names(program):
+    out = set()
+    for b in program.blocks:
+        for op in b.ops:
+            for names in op.outputs.values():
+                out.update(names)
+    return out
+
+
+@mesh_pass("donation-aliasing")
+def check_donation_aliasing(mctx):
+    if mctx.program is None:
+        return []
+    diags = []
+    persist = _persistable_names(mctx.program)
+    written = _written_names(mctx.program)
+    async_on = bool(mctx.async_steps)
+
+    if mctx.donate_state:
+        aliased = [n for n in mctx.fetch_names if n in persist]
+        for name in aliased:
+            if async_on:
+                diags.append(Diagnostic(
+                    ERROR, "donation-aliasing",
+                    f"fetch {name!r} is donated persistable state and "
+                    f"async_steps={mctx.async_steps}: by the time the "
+                    f"fetch is read, its buffer has been donated to a "
+                    f"later in-flight step — the value aliases dead "
+                    f"storage",
+                    var_names=[name],
+                    hint="fetch a non-persistable copy (assign the "
+                         "param to a fresh var), or run with "
+                         "async_steps=0"))
+            else:
+                diags.append(Diagnostic(
+                    WARNING, "donation-aliasing",
+                    f"fetch {name!r} aliases donated persistable "
+                    f"state; the synchronous path copies it out, but "
+                    f"the same fetch list breaks under async "
+                    f"execution",
+                    var_names=[name],
+                    hint="prefer fetching a non-persistable alias of "
+                         "the param"))
+
+    for name in mctx.feed_names:
+        if name in written:
+            diags.append(Diagnostic(
+                ERROR, "donation-aliasing",
+                f"feed {name!r} is also written by an op in the "
+                f"program: the executor's identity cache keys feeds "
+                f"by id(array), so an in-place update changes the "
+                f"value without changing the cache key — later steps "
+                f"silently reuse the stale device copy",
+                var_names=[name],
+                hint="rename the op output, or feed a fresh array "
+                     "each step"))
+        if name in persist:
+            diags.append(Diagnostic(
+                WARNING, "donation-aliasing",
+                f"feed {name!r} is persistable state: feeding over a "
+                f"donated param both fights the donation and defeats "
+                f"the sharded persist path",
+                var_names=[name],
+                hint="initialise params via scope, not feeds"))
+    return diags

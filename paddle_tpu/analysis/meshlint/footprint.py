@@ -1,0 +1,140 @@
+"""device-footprint pass: per-member byte estimate vs the device cap,
+before anything compiles.
+
+The OOM a sharded job hits at step 0 was decided at config time:
+params split by their PartitionSpecs, optimizer slots stacked on top,
+the gradsync error-feedback residual (one fp32 copy of each bucket's
+padded extent), and any serving-side state (KV cache) the caller
+declares. All of that is computable from shapes alone — `eval_shape`
+arithmetic, no compile — so the pass prices the config per member and
+compares it to the cap (PADDLE_TPU_DEVICE_MEM_CAP or
+mctx.memory_cap_bytes).
+
+The estimate is deliberately a floor (activations and XLA temp space
+are workload-shaped and excluded); exceeding the cap with the FLOOR is
+therefore a guaranteed OOM, which is what makes it an ERROR.
+"""
+import os
+
+import numpy as np
+
+from ..diagnostics import Diagnostic, ERROR, INFO
+from .context import entry_axes, mesh_pass, normalize_spec
+
+__all__ = ["check_device_footprint", "member_footprint",
+           "OPTIMIZER_SLOTS"]
+
+# fp32 slot copies per param element, by optimizer op type
+OPTIMIZER_SLOTS = {"sgd": 0, "momentum": 1, "adam": 2, "adamw": 2,
+                   "adagrad": 1, "rmsprop": 1, "lamb": 2}
+
+_CAP_ENV = "PADDLE_TPU_DEVICE_MEM_CAP"
+
+
+def _shard_factor(mesh, spec):
+    """How many ways a value with `spec` splits across one member's
+    view: product of the named axis sizes."""
+    f = 1
+    for entry in normalize_spec(spec or ()):
+        for ax in entry_axes(entry):
+            if ax in mesh.axes:
+                f *= mesh.axis_size(ax)
+    return f
+
+
+def _dtype_bytes(dtype):
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except Exception:
+        return 4
+
+
+def member_footprint(mctx):
+    """{"params": bytes, "optimizer": bytes, "gradsync_ef": bytes,
+    "extra": bytes, "total": bytes, "detail": [(name, bytes)]} — the
+    per-member floor for this config."""
+    out = {"params": 0, "optimizer": 0, "gradsync_ef": 0,
+           "extra": int(mctx.extra_state_bytes), "detail": []}
+    if mctx.program is not None:
+        mesh = mctx.mesh
+        slots = 0
+        grad_params = set()
+        for op in mctx.program.global_block().ops:
+            if op.type in OPTIMIZER_SLOTS:
+                slots = max(slots, OPTIMIZER_SLOTS[op.type])
+            if op.type == "backward_macro":
+                grad_params |= set(op.attrs.get("param_names", ()))
+        named = []
+        for v in mctx.program.list_vars():
+            if not v.persistable:
+                continue
+            n = 1
+            for d in v.shape:
+                n *= max(int(d), 1)
+            nbytes = n * _dtype_bytes(v.dtype)
+            per_member = nbytes // _shard_factor(
+                mesh, mctx.param_specs.get(v.name))
+            out["params"] += per_member
+            out["detail"].append((v.name, per_member))
+            if v.name in grad_params:
+                # optimizer slots are fp32 regardless of param dtype
+                out["optimizer"] += slots * n * 4 // _shard_factor(
+                    mesh, mctx.param_specs.get(v.name))
+                named.append((v.name, tuple(v.shape), v.dtype))
+        if mctx.grad_sync is not None and named:
+            from ...parallel import gradsync as _gs
+            try:
+                pol = _gs.resolve_policy(mctx.grad_sync) \
+                    if isinstance(mctx.grad_sync, str) else mctx.grad_sync
+                if pol is not None and pol.error_feedback:
+                    plan = _gs.plan_buckets(
+                        named, bucket_bytes=pol.bucket_bytes,
+                        block_size=pol.block_size)
+                    out["gradsync_ef"] = sum(
+                        b.padded * 4 for b in plan)
+            except Exception:
+                pass  # grammar errors are collective-consistency's job
+    out["total"] = (out["params"] + out["optimizer"]
+                    + out["gradsync_ef"] + out["extra"])
+    return out
+
+
+def _fmt_mib(n):
+    return f"{n / (1 << 20):.1f} MiB"
+
+
+@mesh_pass("device-footprint")
+def check_device_footprint(mctx):
+    if mctx.program is None and not mctx.extra_state_bytes:
+        return []
+    fp = member_footprint(mctx)
+    cap = mctx.memory_cap_bytes
+    if cap is None:
+        env = os.environ.get(_CAP_ENV, "").strip()
+        if env:
+            try:
+                cap = int(float(env) * (1 << 20))  # env is in MiB
+            except ValueError:
+                return [Diagnostic(
+                    ERROR, "device-footprint",
+                    f"{_CAP_ENV}={env!r} is not a number (MiB)")]
+    breakdown = (f"params {_fmt_mib(fp['params'])} + optimizer "
+                 f"{_fmt_mib(fp['optimizer'])} + gradsync EF "
+                 f"{_fmt_mib(fp['gradsync_ef'])} + extra "
+                 f"{_fmt_mib(fp['extra'])}")
+    diags = [Diagnostic(
+        INFO, "device-footprint",
+        f"per-member state floor on {mctx.mesh}: "
+        f"{_fmt_mib(fp['total'])} ({breakdown}; activations and XLA "
+        f"temps excluded)")]
+    if cap is not None and fp["total"] > cap:
+        worst = sorted(fp["detail"], key=lambda kv: -kv[1])[:3]
+        worst_s = ", ".join(f"{n}={_fmt_mib(b)}" for n, b in worst)
+        diags.append(Diagnostic(
+            ERROR, "device-footprint",
+            f"per-member state floor {_fmt_mib(fp['total'])} exceeds "
+            f"the device cap {_fmt_mib(cap)} — this config OOMs "
+            f"before the first step (largest: {worst_s})",
+            hint="shard the largest params (param_specs), drop "
+                 "optimizer slots, or raise the cap"))
+    return diags

@@ -1,0 +1,75 @@
+"""mesh-recompile-hazard pass: static twin of the tpuscope recompile
+explainer.
+
+The runtime explainer (telemetry/attribution.py, ``explain_recompile``)
+fires AFTER a cache bust and diffs the new compile key against its
+nearest seen neighbor. This pass predicts the same busts from the
+Program alone, and — deliberately — phrases each hazard with the SAME
+ckey component vocabulary (telemetry/ckey_vocab.py), so the static
+warning a user reads at lint time and the runtime explanation they read
+at step N use the same words for the same cause. tests/test_meshlint.py
+pins that phrasing against ``explain_recompile`` output.
+"""
+from ...telemetry.ckey_vocab import component_name
+from ..diagnostics import Diagnostic, WARNING, INFO
+from .context import mesh_pass
+
+__all__ = ["check_recompile_hazards"]
+
+
+def _wildcard_dims(shape):
+    return [d for d, s in enumerate(shape) if int(s) < 0]
+
+
+@mesh_pass("mesh-recompile-hazard")
+def check_recompile_hazards(mctx):
+    if mctx.program is None:
+        return []
+    diags = []
+    feed_comp = component_name("feed_signature")  # "shape bucket"
+    fetch_comp = component_name("fetch_names")    # "fetch set"
+    feeds = set(mctx.feed_names)
+    if not feeds:
+        # infer: non-persistable vars the global block reads but no op
+        # writes — the executor fills those from the feed dict
+        written, read = set(), set()
+        for op in mctx.program.global_block().ops:
+            for names in op.outputs.values():
+                written.update(names)
+            for names in op.inputs.values():
+                read.update(names)
+        feeds = read - written
+    for v in mctx.program.list_vars():
+        if v.persistable or v.name not in feeds:
+            continue
+        wild = _wildcard_dims(v.shape)
+        trailing = [d for d in wild if d != 0]
+        if trailing:
+            diags.append(Diagnostic(
+                WARNING, "mesh-recompile-hazard",
+                f"feed {v.name!r} declares wildcard dim(s) "
+                f"{trailing} beyond the leading batch dim (shape "
+                f"{tuple(v.shape)}): every distinct extent is a new "
+                f"{feed_comp}, and each new {feed_comp} is a full "
+                f"recompile of the sharded step",
+                var_names=[v.name],
+                hint="pad variable-length feeds to a fixed ladder of "
+                     "extents (the serving path's bucket approach) so "
+                     f"the {feed_comp} count stays bounded"))
+        elif wild:
+            diags.append(Diagnostic(
+                INFO, "mesh-recompile-hazard",
+                f"feed {v.name!r} has a wildcard leading batch dim: "
+                f"each distinct batch size is its own {feed_comp} "
+                f"(one recompile per size; usually fine for a fixed "
+                f"batch)",
+                var_names=[v.name]))
+    if mctx.fetch_names and len(set(mctx.fetch_names)) != \
+            len(mctx.fetch_names):
+        diags.append(Diagnostic(
+            WARNING, "mesh-recompile-hazard",
+            f"duplicate names in the fetch list "
+            f"{list(mctx.fetch_names)}: a reordered or deduplicated "
+            f"variant is a different {fetch_comp}, which recompiles",
+            hint="keep one canonical fetch tuple per step fn"))
+    return diags

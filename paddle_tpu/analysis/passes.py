@@ -195,12 +195,19 @@ def _shapes_compatible(declared, traced):
 
 @analysis_pass("shape-dtype")
 def check_shape_dtype(ctx):
-    """Abstract interpretation of the global block: each kernel runs
+    """Abstract interpretation of the whole Program: each kernel runs
     under jax.eval_shape on ShapeDtypeStructs seeded from feeds and
     persistables, and traced output shapes/dtypes are checked against
-    the declared Variable.shape/dtype. Ops whose kernels need concrete
-    values (or whose inputs are already unknown) degrade to the declared
-    signature instead of poisoning downstream checks."""
+    the declared Variable.shape/dtype. Control-flow ops recurse into
+    their sub-blocks with the env threaded exactly the way
+    core/trace.py:_exec_control_flow binds names (sub_env = copy of the
+    parent env, carries/slices bound by the op), so a shape bug inside
+    a cond/while/scan/static_rnn body is caught here instead of dying
+    mid-trace — plus loop-specific checks trace time cannot phrase:
+    branch outputs that disagree and loop carries whose struct changes
+    across iterations. Ops whose kernels need concrete values (or
+    whose inputs are already unknown) degrade to the declared signature
+    instead of poisoning downstream checks."""
     import jax
     import jax.numpy as jnp
     from ..core.dtypes import as_jnp_dtype
@@ -230,71 +237,243 @@ def check_shape_dtype(ctx):
     ctx_k = KernelCtx(key=jax.random.PRNGKey(0),
                       is_test=getattr(program, "_is_test", False))
 
-    def fallback_outputs(op):
+    def struct_eq(a, b):
+        return (tuple(a.shape) == tuple(b.shape)
+                and np.dtype(a.dtype) == np.dtype(b.dtype))
+
+    def struct_str(s):
+        return f"{np.dtype(s.dtype).name}{tuple(s.shape)}"
+
+    def known(e, unk, names):
+        return all(n in e and n not in unk for n in names)
+
+    def walk_sub(bidx, e, unk, binds, check_declared=True):
+        """Walk sub-block `bidx` against a COPY of (env, unknown) with
+        `binds` name->struct overlaid — trace.py's sub_env=dict(env)
+        semantics. Returns the sub scope for reading results out.
+        check_declared=False for scan bodies: scan_layer builds the
+        body against the FULL xs, so declared shapes there carry a
+        spurious leading T the trace-time slice binding removes."""
+        sub_e, sub_unk = dict(e), set(unk)
+        sub_e.update(binds)
+        for n in binds:
+            sub_unk.discard(n)
+        walk_block(program.blocks[bidx], sub_e, sub_unk,
+                   check_declared=check_declared)
+        return sub_e, sub_unk
+
+    def carry_stability(blk, i, op, what, name, init, new):
+        if not struct_eq(init, new):
+            diags.append(Diagnostic(
+                ERROR, "shape-dtype",
+                f"op {op.type!r}: {what} {name!r} enters as "
+                f"{struct_str(init)} but the body produces "
+                f"{struct_str(new)} — the carry must keep one "
+                f"shape/dtype across iterations",
+                block_idx=blk.idx, op_idx=i, op_type=op.type,
+                var_names=[name],
+                hint="make the body's carry output match the init "
+                     "struct (reshape/cast inside the body)"))
+
+    def walk_control_flow(blk, i, op, env, unknown):
+        a = op.attrs
+
+        def bind_out(name, struct):
+            if struct is None:
+                unknown.add(name)
+            else:
+                env[name] = struct
+                unknown.discard(name)
+
+        if op.type == "cond":
+            t_e, t_unk = walk_sub(a["true_block"], env, unknown, {})
+            f_e, f_unk = walk_sub(a["false_block"], env, unknown, {})
+            for name, tn, fn in zip(op.outputs.get("Out", ()),
+                                    a["true_outs"], a["false_outs"]):
+                ts = t_e.get(tn) if tn not in t_unk else None
+                fs = f_e.get(fn) if fn not in f_unk else None
+                if ts is not None and fs is not None \
+                        and not struct_eq(ts, fs):
+                    diags.append(Diagnostic(
+                        ERROR, "shape-dtype",
+                        f"op 'cond': branches disagree on output "
+                        f"{name!r}: true branch {tn!r} is "
+                        f"{struct_str(ts)}, false branch {fn!r} is "
+                        f"{struct_str(fs)} — lax.cond requires "
+                        f"identical output structs",
+                        block_idx=blk.idx, op_idx=i, op_type=op.type,
+                        var_names=[name],
+                        hint="make both branches produce the same "
+                             "shape and dtype"))
+                bind_out(name, ts if ts is not None else fs)
+            return
+        if op.type == "while_loop":
+            carries = a["carry_names"]
+            if not known(env, unknown, carries):
+                fallback_outputs(blk, env, unknown, op)
+                return
+            binds = {n: env[n] for n in carries}
+            walk_sub(a["cond_block"], env, unknown, binds)
+            b_e, b_unk = walk_sub(a["body_block"], env, unknown, binds)
+            for cname, bout in zip(carries, a["body_outs"]):
+                if bout in b_e and bout not in b_unk:
+                    carry_stability(blk, i, op, "loop carry", cname,
+                                    env[cname], b_e[bout])
+            for name, cname in zip(op.outputs.get("Out", ()), carries):
+                bind_out(name, env[cname])
+            return
+        if op.type == "scan":
+            init_n = op.inputs["Init"][0]
+            xs_n = op.inputs["Xs"][0]
+            if not known(env, unknown, (init_n, xs_n)) \
+                    or not env[xs_n].shape:
+                fallback_outputs(blk, env, unknown, op)
+                return
+            xs = env[xs_n]
+            x = jax.ShapeDtypeStruct(tuple(xs.shape[1:]), xs.dtype)
+            b_e, b_unk = walk_sub(a["body_block"], env, unknown,
+                                  {a["init_name"]: env[init_n],
+                                   a["x_name"]: x},
+                                  check_declared=False)
+            co = a["carry_out"]
+            if co in b_e and co not in b_unk:
+                carry_stability(blk, i, op, "scan carry", co,
+                                env[init_n], b_e[co])
+            bind_out(op.outputs["CarryOut"][0], env[init_n])
+            y = b_e.get(a["y_out"]) if a["y_out"] not in b_unk else None
+            bind_out(op.outputs["Ys"][0],
+                     None if y is None else jax.ShapeDtypeStruct(
+                         (xs.shape[0],) + tuple(y.shape), y.dtype))
+            return
+        if op.type == "static_rnn":
+            outers = [o for o, _ in a["x_map"]]
+            inits = [init for init, _, _ in a["mem_map"]]
+            if not known(env, unknown, outers + inits) \
+                    or any(not env[o].shape for o in outers):
+                fallback_outputs(blk, env, unknown, op)
+                return
+            T = env[outers[0]].shape[0]
+            binds = {}
+            for outer, step in a["x_map"]:
+                xs = env[outer]
+                binds[step] = jax.ShapeDtypeStruct(tuple(xs.shape[1:]),
+                                                   xs.dtype)
+            for init, prev, _ in a["mem_map"]:
+                binds[prev] = env[init]
+            b_e, b_unk = walk_sub(a["step_block"], env, unknown, binds)
+            for init, _, new in a["mem_map"]:
+                if new in b_e and new not in b_unk:
+                    carry_stability(blk, i, op, "rnn memory", new,
+                                    env[init], b_e[new])
+            for step_y, out in a["y_map"]:
+                y = b_e.get(step_y) if step_y not in b_unk else None
+                bind_out(out,
+                         None if y is None else jax.ShapeDtypeStruct(
+                             (T,) + tuple(y.shape), y.dtype))
+            for name, (init, _, _) in zip(a.get("final_mem_outs", []),
+                                          a["mem_map"]):
+                bind_out(name, env[init])
+            return
+        fallback_outputs(blk, env, unknown, op)
+
+    def fallback_outputs(blk, env, unknown, op):
         for name in op.output_names():
-            var = block.vars.get(name)
+            var = blk.vars.get(name)
             if var is not None and var.shape != ():
                 env[name] = _declared_struct(var)
             else:
                 unknown.add(name)
 
-    for i, op in enumerate(block.ops):
-        if op.type in MACRO_TYPES or not has_kernel(op.type):
-            fallback_outputs(op)
-            continue
-        in_names = op.input_names()
-        if any(n in unknown or n not in env for n in in_names):
-            fallback_outputs(op)
-            continue
-        ins = {slot: [env[n] for n in names]
-               for slot, names in op.inputs.items() if names}
-        attrs = dict(op.attrs)
-        attrs.setdefault("_op_type", op.type)
-        kern = get_kernel(op.type)
-        try:
-            out = jax.eval_shape(lambda xs: kern(ctx_k, xs, attrs), ins)
-        except Exception as e:
-            diags.append(Diagnostic(
-                INFO, "shape-dtype",
-                f"op {op.type!r} not abstractly traceable "
-                f"({type(e).__name__}); downstream shapes unchecked",
-                block_idx=block.idx, op_idx=i, op_type=op.type))
-            fallback_outputs(op)
-            continue
-        for slot, names in op.outputs.items():
-            vals = out.get(slot)
-            if vals is None:
-                for n in names:
-                    unknown.add(n)
+    def walk_block(blk, env, unknown, check_declared=True):
+        for i, op in enumerate(blk.ops):
+            if op.type in CONTROL_FLOW_TYPES:
+                try:
+                    walk_control_flow(blk, i, op, env, unknown)
+                except (KeyError, IndexError, TypeError):
+                    # malformed control-flow attrs: other passes report
+                    fallback_outputs(blk, env, unknown, op)
                 continue
-            for name, val in zip(names, vals):
-                env[name] = jax.ShapeDtypeStruct(tuple(val.shape),
-                                                 val.dtype)
-                var = block.vars.get(name)
-                if var is None:
+            if op.type in MACRO_TYPES or not has_kernel(op.type):
+                fallback_outputs(blk, env, unknown, op)
+                continue
+            in_names = op.input_names()
+            if any(n in unknown or n not in env for n in in_names):
+                fallback_outputs(blk, env, unknown, op)
+                continue
+            ins = {slot: [env[n] for n in names]
+                   for slot, names in op.inputs.items() if names}
+            attrs = dict(op.attrs)
+            attrs.setdefault("_op_type", op.type)
+            kern = get_kernel(op.type)
+            try:
+                out = jax.eval_shape(lambda xs: kern(ctx_k, xs, attrs),
+                                     ins)
+            except Exception as e:
+                # Concretization/tracer errors mean the kernel needs
+                # concrete VALUES — not checkable abstractly, degrade.
+                # A plain TypeError/ValueError with fully-known input
+                # structs means the op cannot execute at trace time
+                # either (incompatible shapes/dtypes): a real bug.
+                if isinstance(e, (TypeError, ValueError)) \
+                        and not isinstance(e, jax.errors.JAXTypeError):
+                    diags.append(Diagnostic(
+                        ERROR, "shape-dtype",
+                        f"op {op.type!r} rejects its input "
+                        f"shapes/dtypes "
+                        f"({', '.join(f'{n}={struct_str(env[n])}' for n in in_names)}): "
+                        f"{e}",
+                        block_idx=blk.idx, op_idx=i, op_type=op.type,
+                        var_names=in_names,
+                        hint="the same error would abort the trace; "
+                             "fix the operand shapes"))
+                else:
+                    diags.append(Diagnostic(
+                        INFO, "shape-dtype",
+                        f"op {op.type!r} not abstractly traceable "
+                        f"({type(e).__name__}); downstream shapes "
+                        f"unchecked",
+                        block_idx=blk.idx, op_idx=i, op_type=op.type))
+                fallback_outputs(blk, env, unknown, op)
+                continue
+            for slot, names in op.outputs.items():
+                vals = out.get(slot)
+                if vals is None:
+                    for n in names:
+                        unknown.add(n)
                     continue
-                decl_dt = np.dtype(as_jnp_dtype(var.dtype))
-                if np.dtype(val.dtype) != decl_dt:
-                    diags.append(Diagnostic(
-                        ERROR, "shape-dtype",
-                        f"op {op.type!r} produces {name!r} as "
-                        f"{np.dtype(val.dtype).name} but the var is "
-                        f"declared {var.dtype}",
-                        block_idx=block.idx, op_idx=i, op_type=op.type,
-                        var_names=[name],
-                        hint="fix the var's declared dtype or insert a "
-                             "cast op"))
-                if var.shape != () and not _shapes_compatible(
-                        var.shape, val.shape):
-                    diags.append(Diagnostic(
-                        ERROR, "shape-dtype",
-                        f"op {op.type!r} produces {name!r} with shape "
-                        f"{tuple(val.shape)} but the var is declared "
-                        f"{tuple(var.shape)} (with -1 as the batch "
-                        f"placeholder {_BATCH_PLACEHOLDER})",
-                        block_idx=block.idx, op_idx=i, op_type=op.type,
-                        var_names=[name],
-                        hint="fix the declared shape or the op wiring"))
+                for name, val in zip(names, vals):
+                    env[name] = jax.ShapeDtypeStruct(tuple(val.shape),
+                                                     val.dtype)
+                    unknown.discard(name)
+                    var = blk.vars.get(name)
+                    if var is None or not check_declared:
+                        continue
+                    decl_dt = np.dtype(as_jnp_dtype(var.dtype))
+                    if np.dtype(val.dtype) != decl_dt:
+                        diags.append(Diagnostic(
+                            ERROR, "shape-dtype",
+                            f"op {op.type!r} produces {name!r} as "
+                            f"{np.dtype(val.dtype).name} but the var is "
+                            f"declared {var.dtype}",
+                            block_idx=blk.idx, op_idx=i,
+                            op_type=op.type, var_names=[name],
+                            hint="fix the var's declared dtype or "
+                                 "insert a cast op"))
+                    if var.shape != () and not _shapes_compatible(
+                            var.shape, val.shape):
+                        diags.append(Diagnostic(
+                            ERROR, "shape-dtype",
+                            f"op {op.type!r} produces {name!r} with "
+                            f"shape {tuple(val.shape)} but the var is "
+                            f"declared {tuple(var.shape)} (with -1 as "
+                            f"the batch placeholder "
+                            f"{_BATCH_PLACEHOLDER})",
+                            block_idx=blk.idx, op_idx=i,
+                            op_type=op.type, var_names=[name],
+                            hint="fix the declared shape or the op "
+                                 "wiring"))
+
+    walk_block(block, env, unknown)
     return diags
 
 

@@ -383,8 +383,60 @@ class ParallelExecutor:
             return [np.asarray(f) for f in fetches]
         return fetches
 
+    # ------------------------------------------------------------------
+    def _mesh_context(self, fetch_names=(), feed_names=(),
+                      memory_cap_bytes=None):
+        """This executor's config as a meshlint MeshLintContext — the
+        object verify() lints and tools/tpulint.py serializes. Imports
+        meshlint, so only validate-on paths may call it (bench pin)."""
+        from ..analysis.meshlint import MeshLintContext
+        import jax as _jax
+        param_specs = {n: tuple(sh.spec)
+                       for n, sh in self._shardings.items()}
+        return MeshLintContext(
+            self.mesh,
+            program=self.program,
+            fetch_names=fetch_names,
+            feed_names=feed_names,
+            donate_state=True,        # donate_argnums=(0,) below
+            async_steps=self.async_steps,
+            grad_sync=self.grad_sync,
+            sparse=(self.sparse_engine.policy
+                    if self.sparse_engine is not None else None),
+            processes=_jax.process_count(),
+            backend=_jax.default_backend(),
+            param_specs=param_specs,
+            memory_cap_bytes=memory_cap_bytes,
+            label="ParallelExecutor")
+
+    def verify(self, fetch_list=None, feed_names=(), passes=None,
+               raise_on_error=True, memory_cap_bytes=None):
+        """Static pre-trace verification of this executor's sharded
+        config: proglint over the Program (use-before-def, shapes,
+        hazards) plus the meshlint passes (mesh-spec API-capability
+        verdicts, collective consistency, donation aliasing, device
+        footprint, recompile hazards). Runs automatically on each
+        compile when PADDLE_TPU_VALIDATE=1 (or run(validate=True));
+        callable directly for lint-only flows (tools/tpulint.py).
+        Returns the combined diagnostics list."""
+        from ..analysis import run_passes as _run_prog
+        from ..analysis.diagnostics import ProgramVerificationError
+        from ..analysis.meshlint import run_mesh_passes
+        fetch_names = tuple(f.name if hasattr(f, "name") else f
+                            for f in (fetch_list or ()))
+        diags = list(_run_prog(self.program, fetch_list=fetch_names,
+                               feed_names=feed_names))
+        diags += run_mesh_passes(self._mesh_context(
+            fetch_names=fetch_names, feed_names=feed_names,
+            memory_cap_bytes=memory_cap_bytes), passes=passes)
+        if raise_on_error and any(d.severity == "error" for d in diags):
+            raise ProgramVerificationError(
+                [d for d in diags if d.severity == "error"])
+        return diags
+
     def run(self, fetch_list=None, feed=None, feed_dict=None,
-            return_numpy=True, is_test=False, async_steps=None):
+            return_numpy=True, is_test=False, async_steps=None,
+            validate=None):
         from ..core.executor import resolve_async_steps
         k_async = resolve_async_steps(async_steps, self.async_steps)
         feed = dict(feed or feed_dict or {})
@@ -488,6 +540,17 @@ class ParallelExecutor:
             ckey = ckey + (engine.key(),)
         fn = self._cache.get(ckey)
         if fn is None:
+            # opt-in pre-trace verification gate (same tri-state as
+            # Executor.run: validate= arg > PADDLE_TPU_VALIDATE env):
+            # proglint + meshlint once per compile, so a bad spec or a
+            # capability the active jax rejects surfaces as a
+            # ProgramVerificationError with a named pass instead of a
+            # _SpecError stack from inside the trace. Cache hits (and
+            # the default validate-off path) never import meshlint.
+            from ..core.executor import Executor as _Exec
+            if _Exec._validate_requested(validate):
+                self.verify(fetch_list=fetch_names,
+                            feed_names=list(feed_arrays))
             if tm_on:
                 _tm.counter("pexe.compile_count").inc()
                 _tm.gauge("pexe.device_count").set(self.device_count)

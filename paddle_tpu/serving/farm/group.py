@@ -155,6 +155,76 @@ class FarmConfig:
         self.qos_factory = qos_factory
         self.guard = guard
 
+    def verify(self, devices=None, model_config=None,
+               raise_on_error=False):
+        """Static pre-spawn verification of the farm shape via the
+        meshlint pipeline: device-slice arithmetic (replicas sharing a
+        physical device, reserved prefill heads eating the decode
+        pool), engine knob consistency, and the per-replica KV-cache
+        byte footprint vs the device cap — the serving-tier twin of
+        ParallelExecutor.verify(). Returns the diagnostics; imports
+        meshlint only when called (the serve path never pays for it)."""
+        from ...analysis.diagnostics import (Diagnostic, ERROR, WARNING,
+                                             ProgramVerificationError)
+        from ...analysis.meshlint import (MeshLintContext, MeshSpec,
+                                          run_mesh_passes)
+        import jax
+
+        diags = []
+        devs = list(devices if devices is not None
+                    else self.devices if self.devices is not None
+                    else jax.devices())
+        need = self.prefill_devices + self.replicas
+        if len(devs) < need:
+            diags.append(Diagnostic(
+                WARNING, "collective-consistency",
+                f"farm wants {self.replicas} replica slice(s) + "
+                f"{self.prefill_devices} prefill head(s) but only "
+                f"{len(devs)} device(s) exist: device_slices wraps "
+                f"and replicas SHARE devices — correct but serialized",
+                hint="drop replicas/prefill_devices or add devices"))
+        eng = self.engine
+        if eng.kv_quant is not None and eng.kv_quant != "int8":
+            diags.append(Diagnostic(
+                ERROR, "collective-consistency",
+                f"engine kv_quant={eng.kv_quant!r} is not a known KV "
+                f"cache quantization (int8 or None)",
+                hint="DecodeEngineConfig(kv_quant='int8')"))
+        if self.retries < 0:
+            diags.append(Diagnostic(
+                ERROR, "collective-consistency",
+                f"retries={self.retries} is negative"))
+        # per-replica KV footprint: slots x len x layers x 2 (k+v) x
+        # heads*head_dim, int8 = 1 byte + per-block scales, fp32 = 4
+        extra = 0
+        if model_config is not None:
+            mc = model_config
+            hid = getattr(mc, "hidden", None) or getattr(
+                mc, "d_model", 0)
+            layers = getattr(mc, "layers", None) or getattr(
+                mc, "n_layers", 0)
+            max_len = eng.max_len or getattr(mc, "max_len", 0)
+            if hid and layers and max_len:
+                per_elem = 1 if eng.kv_quant == "int8" else 4
+                extra = (2 * layers * eng.num_slots * max_len * hid
+                         * per_elem)
+                if eng.kv_quant == "int8":
+                    block = eng.kv_block or hid
+                    extra += (2 * layers * eng.num_slots * max_len
+                              * -(-hid // block) * 4)  # scales
+        per_slice = max(1, (len(devs) - self.prefill_devices)
+                        // self.replicas)
+        mctx = MeshLintContext(
+            MeshSpec({"replica": per_slice}),
+            extra_state_bytes=extra,
+            label=f"FarmConfig[replicas={self.replicas}]")
+        diags += run_mesh_passes(mctx, passes=["device-footprint"])
+        diags.sort(key=Diagnostic.sort_key)
+        if raise_on_error and any(d.severity == "error" for d in diags):
+            raise ProgramVerificationError(
+                [d for d in diags if d.severity == "error"])
+        return diags
+
 
 class Replica:
     """One decode engine + scheduler bound to a device slice."""
@@ -375,6 +445,15 @@ class ReplicaGroup:
                 num_replicas=self.config.replicas)
             if getattr(self.router, "health", None) is None:
                 self.router.health = self.guard.health
+        # pre-spawn verification gate: same PADDLE_TPU_VALIDATE
+        # tri-state as the executors — lint the farm shape (slice
+        # arithmetic, engine knobs, KV footprint) before any engine
+        # compiles; off (the default) never imports meshlint
+        import os as _os
+        if _os.environ.get("PADDLE_TPU_VALIDATE", "").lower() \
+                not in ("", "0", "false", "off"):
+            self.config.verify(model_config=model_cfg,
+                               raise_on_error=True)
         self.build_cache = SharedBuildCache() \
             if self.config.share_compiles else None
         reserved, slices = device_slices(

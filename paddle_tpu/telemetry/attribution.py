@@ -238,21 +238,13 @@ _PEXE_CKEY_NAMES = (
     "program_id", "program_version", "feed_signature", "fetch_names",
     "is_test", "fuse_optimizer_tail", "fuse_max_elems")
 
-# ckey field -> the component name the event/report leads with
-_COMPONENT = {
-    "feed_signature": "shape bucket",
-    "donate": "donate flag",
-    "grad_sync": "grad_sync policy",
-    "engine": "engine key",
-    "is_test": "train/eval mode",
-    "seed": "seed",
-    "program_id": "program identity",
-    "program_version": "program version",
-    "fetch_names": "fetch set",
-    "fuse_optimizer_tail": "fusion config",
-    "fuse_max_elems": "fusion config",
-    "async": "async window",
-}
+# ckey field -> component name: ONE vocabulary shared with meshlint's
+# static recompile-hazard pass (telemetry/ckey_vocab.py), so the static
+# warning and the runtime explanation lead with the same words —
+# regression-tested by tests/test_meshlint.py
+from .ckey_vocab import (COMPONENT as _COMPONENT,
+                         diff_feed_signature as _diff_feed_signature,
+                         fmt_field as _fmt_field)
 
 
 def executor_ckey_fields(ckey):
@@ -273,34 +265,6 @@ def pexe_ckey_fields(ckey, policy_key=None, engine_key=None):
     d["grad_sync"] = policy_key
     d["engine"] = engine_key
     return d
-
-
-def _diff_feed_signature(old, new):
-    """Human-readable diff of two _feed_signature tuples — names the
-    exact feed whose shape bucket (or dtype) changed."""
-    try:
-        o = {name: (shape, dt) for name, shape, dt in old}
-        n = {name: (shape, dt) for name, shape, dt in new}
-    except (TypeError, ValueError):
-        return f"{old!r} -> {new!r}"
-    parts = []
-    for name in sorted(set(o) | set(n)):
-        if name not in o:
-            parts.append(f"feed {name!r} added")
-        elif name not in n:
-            parts.append(f"feed {name!r} removed")
-        elif o[name] != n[name]:
-            what = "shape" if o[name][0] != n[name][0] else "dtype"
-            ov = o[name][0] if what == "shape" else o[name][1]
-            nv = n[name][0] if what == "shape" else n[name][1]
-            parts.append(f"feed {name!r} {what} {ov} -> {nv}")
-    return "; ".join(parts) or "identical signatures"
-
-
-def _fmt_field(name, old, new):
-    if name == "feed_signature":
-        return f"shape bucket: {_diff_feed_signature(old, new)}"
-    return f"{_COMPONENT.get(name, name)} ({name}): {old!r} -> {new!r}"
 
 
 def explain_recompile(kind, fields, seen_fields, step=None):

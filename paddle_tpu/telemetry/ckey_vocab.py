@@ -1,0 +1,70 @@
+"""Shared compile-key vocabulary: one formatter for the component names
+that explain a recompile.
+
+Two consumers must name the same ckey component with the same phrasing
+(regression-tested by tests/test_meshlint.py):
+
+- the RUNTIME recompile explainer (telemetry/attribution.py,
+  ``explain_recompile``), which diffs a new compile key against its
+  nearest previously-seen neighbor after the cache was already busted;
+- the STATIC recompile-hazard findings of meshlint
+  (analysis/meshlint/recompile.py), which predict the bust before the
+  first trace.
+
+This module is dependency-free on purpose: ``telemetry.attribution`` is
+pinned off the import path for telemetry-off runs (bench contract), and
+``analysis.meshlint`` is pinned off the validate-off path — neither may
+drag the other in, so the shared words live below both.
+"""
+
+__all__ = ["COMPONENT", "component_name", "fmt_field",
+           "diff_feed_signature"]
+
+# ckey field -> the component name the event/report/diagnostic leads with
+COMPONENT = {
+    "feed_signature": "shape bucket",
+    "donate": "donate flag",
+    "grad_sync": "grad_sync policy",
+    "engine": "engine key",
+    "is_test": "train/eval mode",
+    "seed": "seed",
+    "program_id": "program identity",
+    "program_version": "program version",
+    "fetch_names": "fetch set",
+    "fuse_optimizer_tail": "fusion config",
+    "fuse_max_elems": "fusion config",
+    "async": "async window",
+}
+
+
+def component_name(field):
+    """The human name a ckey field is reported under."""
+    return COMPONENT.get(field, field)
+
+
+def diff_feed_signature(old, new):
+    """Human-readable diff of two _feed_signature tuples — names the
+    exact feed whose shape bucket (or dtype) changed."""
+    try:
+        o = {name: (shape, dt) for name, shape, dt in old}
+        n = {name: (shape, dt) for name, shape, dt in new}
+    except (TypeError, ValueError):
+        return f"{old!r} -> {new!r}"
+    parts = []
+    for name in sorted(set(o) | set(n)):
+        if name not in o:
+            parts.append(f"feed {name!r} added")
+        elif name not in n:
+            parts.append(f"feed {name!r} removed")
+        elif o[name] != n[name]:
+            what = "shape" if o[name][0] != n[name][0] else "dtype"
+            ov = o[name][0] if what == "shape" else o[name][1]
+            nv = n[name][0] if what == "shape" else n[name][1]
+            parts.append(f"feed {name!r} {what} {ov} -> {nv}")
+    return "; ".join(parts) or "identical signatures"
+
+
+def fmt_field(name, old, new):
+    if name == "feed_signature":
+        return f"shape bucket: {diff_feed_signature(old, new)}"
+    return f"{component_name(name)} ({name}): {old!r} -> {new!r}"

@@ -312,3 +312,98 @@ def test_draw_block_graphviz_diagnostics(tmp_path):
     dot = open(path).read()
     assert SEVERITY_COLORS["error"] in dot
     assert "unknown-op" in dot
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype abstract interpretation inside control-flow sub-blocks
+# ---------------------------------------------------------------------------
+def test_shape_check_descends_into_cond_branch():
+    """A shape bug buried inside a cond branch is found statically,
+    with the diagnostic pointing at the SUB-block, not the cond op."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        with fluid.unique_name.guard():
+            x = layers.data("x", shape=[8])
+            flag = layers.data("flag", shape=[1], dtype="bool")
+
+            def true_fn():
+                bad = layers.fill_constant([3], "float32", 1.0)
+                return layers.elementwise_add(x, bad)  # (-1,8)+(3,)
+
+            def false_fn():
+                return x
+
+            layers.cond(flag, true_fn, false_fn)
+    errs = _errors(_of_pass(main.verify(feed_names=["x", "flag"]),
+                            "shape-dtype"))
+    assert errs, "branch-internal shape bug not caught"
+    assert any(d.block_idx != 0 for d in errs)
+
+
+def test_cond_branch_struct_disagreement():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        with fluid.unique_name.guard():
+            flag = layers.data("flag", shape=[1], dtype="bool")
+            layers.cond(flag,
+                        lambda: layers.fill_constant([4], "float32", 0.0),
+                        lambda: layers.fill_constant([8], "float32", 1.0))
+    errs = _errors(_of_pass(main.verify(feed_names=["flag"]),
+                            "shape-dtype"))
+    assert len(errs) == 1
+    assert "branches disagree" in errs[0].message
+    assert errs[0].op_type == "cond"
+
+
+def test_while_carry_shape_drift():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        with fluid.unique_name.guard():
+            i = layers.fill_constant([1], "int64", 0)
+
+            def cond_fn(c):
+                return layers.less_than(c, layers.fill_constant(
+                    [1], "int64", 4))
+
+            def body_fn(c):
+                # carry grows: (1,) int64 -> (2,) int64
+                return layers.concat([c, c], axis=0)
+
+            layers.while_loop(cond_fn, body_fn, [i])
+    errs = _errors(_of_pass(main.verify(), "shape-dtype"))
+    assert len(errs) == 1
+    assert "carry" in errs[0].message
+    assert errs[0].op_type == "while_loop"
+
+
+def test_scan_carry_drift_and_clean_threading():
+    # drift: carry (4,) -> body yields (8,)
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        with fluid.unique_name.guard():
+            init = layers.fill_constant([4], "float32", 0.0)
+            xs = layers.data("xs", shape=[6, 4], append_batch_size=False)
+
+            def body(c, xt):
+                return layers.concat([c, c], axis=0), xt
+
+            layers.scan_layer(body, init, xs)
+    errs = _errors(_of_pass(main.verify(feed_names=["xs"]),
+                            "shape-dtype"))
+    assert len(errs) == 1 and "scan carry" in errs[0].message
+
+    # clean scan: Ys is threaded as (T,)+y and usable downstream
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        with fluid.unique_name.guard():
+            init = layers.fill_constant([4], "float32", 0.0)
+            xs = layers.data("xs", shape=[6, 4], append_batch_size=False)
+
+            def body(c, xt):
+                c2 = layers.elementwise_add(c, xt)
+                return c2, c2
+
+            _, ys = layers.scan_layer(body, init, xs)
+            layers.reduce_sum(ys)  # consumes the (6, 4) stack
+    diags = main.verify(feed_names=["xs"])
+    assert not _errors(_of_pass(diags, "shape-dtype"))

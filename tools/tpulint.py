@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""tpulint — the unified static-analysis gate: proglint + meshlint.
+
+One command, one exit code, for everything the static verifiers can
+prove about this repo before anything traces or compiles:
+
+  1. proglint over every benchmark model Program (tools/proglint.py —
+     use-before-def, unknown ops, dead code, shape/dtype abstract
+     interpretation incl. control-flow sub-blocks, WAW hazards,
+     recompile hazards);
+  2. meshlint over the sharded-execution configs: the classified red
+     multichip test configs (each must classify to a named pass with a
+     both-API capability verdict), the green parallel control set
+     (must produce ZERO errors — the false-positive pin), the
+     gradsync / sparse policy grammars, and the serving FarmConfig
+     shapes;
+  3. the LINT_multichip.json baseline: the committed classification of
+     the 18 red multichip tests must match what the passes derive
+     today (drift = the capability table and reality disagree = fail).
+
+Exit status is non-zero when any error-severity diagnostic fires (or
+any warning with --strict) — a CI gate, like proglint.
+
+Examples:
+  python tools/tpulint.py                      # the whole gate
+  python tools/tpulint.py --json               # machine-readable
+  python tools/tpulint.py --write-baseline     # refresh LINT_multichip.json
+  python tools/tpulint.py --selftest           # fast smoke (tier-1)
+"""
+import argparse
+import json
+import os
+import sys
+
+# static analysis never needs an accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+BASELINE = os.path.join(_REPO, "LINT_multichip.json")
+
+# policy grammar strings the repo's docs/benchmarks advertise — each
+# must parse (a grammar regression breaks users' env vars silently)
+GRAMMAR_FIXTURES = {
+    "grad_sync": ["fp32", "bf16", "int8", "int8:bucket_mb=1",
+                  "bf16:bucket_kb=256,block=128",
+                  "int8:overlap=0,ef=1", "fp32:reduce=sum"],
+    "sparse": ["shard", "shard:stale=2", "shard:stale=4,cap=1024",
+               "shard:kernel=0", "1", "on"],
+}
+
+
+def _meshlint():
+    from paddle_tpu.analysis import meshlint
+    return meshlint
+
+
+def lint_models(names=None, quiet=False):
+    """Section 1: proglint over the benchmark models."""
+    import proglint
+    out = {}
+    for name in names or proglint.ALL_MODELS:
+        diags, n_ops = proglint.lint_model(name)
+        if quiet:
+            diags = [d for d in diags if d.severity != "info"]
+        out[name] = {"ops": n_ops,
+                     "diagnostics": [d.to_dict() for d in diags]}
+    return out
+
+
+def lint_mesh_configs(quiet=False):
+    """Section 2: meshlint — red classification, green control set,
+    policy grammars, farm shapes."""
+    from paddle_tpu.analysis.diagnostics import Diagnostic, ERROR
+    ml = _meshlint()
+    out = {"red": [], "green": {}, "grammars": {}, "farm": {},
+           "errors": []}
+
+    for rec in ml.classify_red_tests():
+        out["red"].append(rec)
+        if not rec["classified"]:
+            out["errors"].append(
+                f"red config {rec['test']} did not classify: no "
+                f"meshlint pass names a capability for it")
+
+    for label, mctx in ml.green_configs():
+        diags = ml.run_mesh_passes(mctx)
+        if quiet:
+            diags = [d for d in diags if d.severity != "info"]
+        out["green"][label] = [d.to_dict() for d in diags]
+        for d in diags:
+            if d.severity == "error":
+                out["errors"].append(
+                    f"FALSE POSITIVE: green config {label!r} got "
+                    f"[{d.pass_name}] {d.message}")
+
+    from paddle_tpu.parallel import gradsync, sparse
+    for kind, parse in (("grad_sync", gradsync.parse_policy),
+                        ("sparse", sparse.parse_policy)):
+        for g in GRAMMAR_FIXTURES[kind]:
+            try:
+                parse(g)
+                out["grammars"][f"{kind}:{g}"] = "ok"
+            except Exception as e:
+                out["grammars"][f"{kind}:{g}"] = f"FAIL: {e}"
+                out["errors"].append(
+                    f"{kind} grammar {g!r} no longer parses: {e}")
+
+    from paddle_tpu.serving.farm import FarmConfig
+    from paddle_tpu.serving.decode import DecodeEngineConfig
+    farm_shapes = {
+        "default": FarmConfig(),
+        "prefill-disagg": FarmConfig(replicas=2, prefill_devices=1),
+        "kv-int8": FarmConfig(engine=DecodeEngineConfig(
+            num_slots=8, kv_quant="int8")),
+    }
+    for label, cfg in farm_shapes.items():
+        diags = cfg.verify()
+        if quiet:
+            diags = [d for d in diags if d.severity != "info"]
+        out["farm"][label] = [d.to_dict() for d in diags]
+        for d in diags:
+            if d.severity == "error":
+                out["errors"].append(
+                    f"farm shape {label!r}: [{d.pass_name}] "
+                    f"{d.message}")
+    return out
+
+
+def check_baseline(red_records):
+    """Section 3: the committed LINT_multichip.json must match today's
+    derivation (test -> pass/capability). Returns error strings."""
+    if not os.path.exists(BASELINE):
+        return [f"baseline {BASELINE} missing; run "
+                f"tools/tpulint.py --write-baseline and commit it"]
+    with open(BASELINE) as f:
+        base = json.load(f)
+    errs = []
+    base_by_test = {r["test"]: r for r in base.get("red_tests", [])}
+    now_by_test = {r["test"]: r for r in red_records}
+    for test in sorted(set(base_by_test) | set(now_by_test)):
+        b, n = base_by_test.get(test), now_by_test.get(test)
+        if b is None:
+            errs.append(f"red config {test} is new (not in baseline)")
+        elif n is None:
+            errs.append(f"baseline red config {test} no longer "
+                        f"derived")
+        elif (b["pass"], b["capability"]) != (n["pass"],
+                                              n["capability"]):
+            errs.append(
+                f"classification drift for {test}: baseline "
+                f"{b['pass']}/{b['capability']} vs derived "
+                f"{n['pass']}/{n['capability']}")
+    return errs
+
+
+def write_baseline(red_records):
+    ml = _meshlint()
+    payload = {
+        "comment": "Machine-readable classification of the red "
+                   "multichip tests: which meshlint pass flags each "
+                   "config and the per-API capability verdict. "
+                   "Regenerate with tools/tpulint.py --write-baseline "
+                   "after changing the capability table or the tests.",
+        "api_profiles": list(ml.api_profiles()),
+        "mesh_passes": ml.mesh_pass_names(),
+        "red_tests": red_records,
+    }
+    with open(BASELINE, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return payload
+
+
+def selftest():
+    """Fast smoke for tier-1 (tpudoctor pattern: last stdout line is a
+    JSON object with an "ok" field). Exercises every pass once with a
+    seeded defect and once clean — no model builds, subsecond."""
+    ml = _meshlint()
+    checks = {}
+    # seeded defect: unknown axis + non-divisible dim must both fire
+    mesh = ml.MeshSpec({"dp": 4, "tp": 2})
+    use = ml.ShardMapUse("selftest", in_specs=[("xx",), ("dp", "tp")],
+                         arg_shapes=[(8,), (6, 4)])  # 6 % dp=4 != 0
+    diags = ml.run_mesh_passes(ml.MeshLintContext(mesh, uses=[use]),
+                               passes=["mesh-spec"])
+    errs = [d for d in diags if d.severity == "error"]
+    checks["seeded_spec_defect_fires"] = len(errs) >= 2
+    # clean config: no errors
+    ok_use = ml.ShardMapUse("selftest-ok", in_specs=[("dp",)],
+                            arg_shapes=[(8,)])
+    diags = ml.run_mesh_passes(ml.MeshLintContext(mesh, uses=[ok_use]))
+    checks["clean_config_quiet"] = not any(
+        d.severity == "error" for d in diags)
+    # every advertised pass is registered
+    checks["passes_registered"] = set(ml.mesh_pass_names()) == {
+        "mesh-spec", "collective-consistency", "donation-aliasing",
+        "device-footprint", "mesh-recompile-hazard"}
+    # all red configs classify and the baseline (when present) agrees
+    recs = ml.classify_red_tests()
+    checks["red_configs_classified"] = (
+        len(recs) == 18 and all(r["classified"] for r in recs))
+    if os.path.exists(BASELINE):
+        checks["baseline_consistent"] = not check_baseline(recs)
+    # green control set stays quiet
+    checks["green_zero_errors"] = all(
+        not any(d.severity == "error" for d in ml.run_mesh_passes(m))
+        for _, m in ml.green_configs())
+    ok = all(checks.values())
+    print(json.dumps({"ok": ok, "checks": checks,
+                      "passes": ml.mesh_pass_names()}))
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="unified static-analysis gate (proglint + meshlint)")
+    p.add_argument("models", nargs="*", default=None,
+                   help="benchmark models to proglint (default: all)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also fail the exit status")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress info-severity diagnostics")
+    p.add_argument("--skip-models", action="store_true",
+                   help="meshlint sections only (no model builds)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help=f"write {os.path.basename(BASELINE)} and exit")
+    p.add_argument("--list-passes", action="store_true",
+                   help="print proglint + meshlint pass names and exit")
+    p.add_argument("--selftest", action="store_true",
+                   help="fast smoke; last stdout line is JSON verdict")
+    args = p.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if args.list_passes:
+        from paddle_tpu.analysis import pass_names
+        ml = _meshlint()
+        print("\n".join(pass_names()))
+        print("\n".join(ml.mesh_pass_names()))
+        return 0
+
+    mesh_report = lint_mesh_configs(quiet=args.quiet)
+    if args.write_baseline:
+        write_baseline(mesh_report["red"])
+        print(f"wrote {BASELINE} ({len(mesh_report['red'])} red "
+              f"configs)")
+        return 0
+    mesh_report["baseline"] = check_baseline(mesh_report["red"])
+
+    model_report = {}
+    if not args.skip_models:
+        model_report = lint_models(args.models, quiet=args.quiet)
+
+    failed = bool(mesh_report["errors"] or mesh_report["baseline"])
+    n_warn_total = 0
+    for name, rec in model_report.items():
+        sevs = [d["severity"] for d in rec["diagnostics"]]
+        n_err, n_warn = sevs.count("error"), sevs.count("warning")
+        n_warn_total += n_warn
+        if n_err:
+            failed = True
+        if not args.as_json:
+            status = "FAIL" if n_err else ("warn" if n_warn else "ok")
+            print(f"proglint {name:<24} {rec['ops']:>4} ops  "
+                  f"{n_err} error(s), {n_warn} warning(s)  [{status}]")
+    for label, dl in list(mesh_report["green"].items()) \
+            + list(mesh_report["farm"].items()):
+        n_warn_total += sum(d["severity"] == "warning" for d in dl)
+    if args.strict and n_warn_total:
+        failed = True
+
+    if not args.as_json:
+        n_red = sum(r["classified"] for r in mesh_report["red"])
+        print(f"meshlint {n_red}/{len(mesh_report['red'])} red "
+              f"multichip configs classified, "
+              f"{len(mesh_report['green'])} green configs clean, "
+              f"{len(mesh_report['grammars'])} grammars, "
+              f"{len(mesh_report['farm'])} farm shapes")
+        for e in mesh_report["errors"] + mesh_report["baseline"]:
+            print(f"  error: {e}")
+    else:
+        print(json.dumps({"models": model_report,
+                          "meshlint": mesh_report}, indent=1))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
