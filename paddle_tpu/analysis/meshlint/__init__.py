@@ -27,6 +27,11 @@ fix hints, crash-isolation) to sharded executions:
     mesh-recompile-hazard   static twin of the tpuscope recompile
                             explainer, phrased with the SAME ckey
                             component vocabulary (telemetry/ckey_vocab)
+    kern-capability         program ops served by a registered Pallas
+                            kernel (ops/kern) whose static probe
+                            rejects the per-shard declared shapes —
+                            the op lowers its jnp fallback, correct
+                            but unaccelerated
 
 Entry points: ParallelExecutor.verify() / FarmConfig.verify() (and
 their PADDLE_TPU_VALIDATE pre-trace gates), tools/tpulint.py, and
@@ -44,7 +49,7 @@ from .context import (MESH_PASSES, MeshLintContext, MeshSpec,
                       normalize_spec, run_mesh_passes, spec_str,
                       verify_mesh)
 from .spec_check import static_spec_verdict
-from . import spec_check, collectives, donation, footprint, recompile  # noqa: F401 (pass registration)
+from . import spec_check, collectives, donation, footprint, recompile, kerncap  # noqa: F401 (pass registration)
 from .classify import classify_red_tests, green_configs, red_configs
 
 __all__ = [

@@ -18,7 +18,7 @@ per-op grad ops (python/paddle/fluid/backward.py).
 import jax
 import jax.numpy as jnp
 
-from ..ops.registry import get_kernel, KernelCtx
+from ..ops.registry import get_kernel, KernelCtx, accel
 from .framework import grad_var_name
 from .dtypes import is_float
 
@@ -244,7 +244,9 @@ def exec_op(env, op, op_idx, base_key, is_test, place, block, program=None):
             vals.append(env[n])
         ins[slot] = vals
     key = jax.random.fold_in(base_key, op_idx) if base_key is not None else None
-    ctx = KernelCtx(key=key, is_test=is_test, place=place)
+    # trace-time lowering consults the kern registry through the one
+    # accel seam (ops.registry.accel) — op kernels never import pallas
+    ctx = KernelCtx(key=key, is_test=is_test, place=place, accel=accel)
     attrs = dict(op.attrs)
     attrs.setdefault("_op_type", op.type)
     outs = kern(ctx, ins, attrs)

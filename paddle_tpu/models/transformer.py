@@ -603,6 +603,14 @@ class IncrementalDecoder:
         quant = self.kv_quant == "int8"
         ret_logits = self.return_logits
         B = self.kv_block
+        # trace-time kern-registry consult (ops.registry.accel): the
+        # single-token ragged decode kernel for the fp32 cache, the
+        # fused dequantize-attend for the int8 cache. Each call below
+        # still self-gates (try_* convention) — None keeps the exact
+        # jnp composition, and PADDLE_TPU_KERN=off never loads kern.
+        from ..ops.registry import accel as _accel
+        fused_dequant = _accel("dequant_attend_int8") if quant else None
+        fused_decode = None if quant else _accel("decode_attend")
 
         if quant:
             # the int8 KV path is the ONLY importer of gradsync here:
@@ -650,14 +658,31 @@ class IncrementalDecoder:
                 vn = fc(x, p[f"dec{i}_self_v.w_0"]).reshape(S, H, Dh)
                 kcache = cache_write(kcache, i, rows, pos, kn)
                 vcache = cache_write(vcache, i, rows, pos, vn)
-                logits = jnp.einsum("bqhd,bkhd->bhqk", q,
-                                    cache_read(kcache, i)).astype(
-                    jnp.float32) * jnp.asarray(scale, jnp.float32)
-                logits = jnp.where(keep, logits, -jnp.inf)
-                w = _attn_softmax(logits).astype(x.dtype)
-                o = jnp.einsum("bhqk,bkhd->bqhd", w,
-                               cache_read(vcache, i)).reshape(
-                    S, H * Dh)
+                o = None
+                if fused_dequant is not None:
+                    # int8 codes + scales stream straight into the
+                    # kernel — no fp32 cache copy materializes
+                    got = fused_dequant(q.reshape(S, H, Dh),
+                                        kcache[0][i], kcache[1][i],
+                                        vcache[0][i], vcache[1][i],
+                                        pos, scale)
+                    if got is not None:
+                        o = got.astype(x.dtype).reshape(S, H * Dh)
+                elif fused_decode is not None:
+                    got = fused_decode(q.reshape(S, H, Dh),
+                                       kcache[0][i], vcache[0][i],
+                                       pos, scale)
+                    if got is not None:
+                        o = got.astype(x.dtype).reshape(S, H * Dh)
+                if o is None:
+                    logits = jnp.einsum("bqhd,bkhd->bhqk", q,
+                                        cache_read(kcache, i)).astype(
+                        jnp.float32) * jnp.asarray(scale, jnp.float32)
+                    logits = jnp.where(keep, logits, -jnp.inf)
+                    w = _attn_softmax(logits).astype(x.dtype)
+                    o = jnp.einsum("bhqk,bkhd->bqhd", w,
+                                   cache_read(vcache, i)).reshape(
+                        S, H * Dh)
                 x = ln(fc(o, p[f"dec{i}_self_o.w_0"]) + res,
                        p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'self')}.w_0"],
                        p[f"layer_norm_{_ln_index(cfg, 'dec', i, 'self')}.b_0"])

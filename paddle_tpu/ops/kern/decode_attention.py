@@ -1,0 +1,320 @@
+"""Single-token decode attention over the slot-pool cache layout.
+
+The serving decoder (models/transformer.IncrementalDecoder) holds its
+KV cache as [slots, T_max, heads, Dh] — every slot is a live request
+at its own position, so the effective attention is RAGGED: slot s
+attends to t <= pos[s] of a fixed T_max buffer. The jnp composition
+materializes [S, H, T] scores and, on the int8 cache, a fully
+dequantized fp32 [S, T, H, Dh] copy of BOTH caches every step. These
+kernels stream the cache through VMEM in (block_t, Dh) tiles with
+flash-style online softmax instead:
+
+- decode_attend       fp32/bf16 cache: one pass over K and V, no
+                      [S,H,T] score tensor in HBM, whole k-blocks
+                      above pos[s] skipped (the ragged win: a slot at
+                      position 37 of a 2048-deep pool reads one block,
+                      not 2048 rows).
+- dequant_attend      the PR-13 block-quantized cache: int8 codes +
+                      per-block scales are dequantized IN the kernel's
+                      VMEM tile right before the dot — the fp32 cache
+                      copy never exists, so HBM read bytes drop ~4x on
+                      the decode hot path (the EQuARX fusion argument).
+
+Grid is (slots, heads, n_t) with t innermost and "arbitrary" (online
+softmax carries m/l/acc scratch across t-steps, exactly the flash
+kernel's structure); q rows are [1, Dh] tiles — legal Mosaic blocks by
+the block==dim rule the flash bias rows already rely on. pos arrives
+lane-replicated [S, 128] (1-lane vectors are not a legal VMEM tile).
+
+Numerics convention matches the decoder composition exactly: f32
+logits, mask to -1e30 (vs the composition's -inf — both vanish in
+softmax; parity gate tolerance covers it), f32 softmax, weighted sum
+in f32. pos[s] < 0 (never produced by the decoder) yields an all-zero
+row, not NaN.
+
+Perf gates (auto mode only; interpret bypasses): MIN_T_DECODE /
+MIN_T_DEQUANT. Defaults are conservative and UNMEASURED on real chips
+— the expected crossover by the flash MIN_SEQ_LEN analogy, pending an
+on-chip sweep via `tools/tpukern.py tune`.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..pallas import flash_attention as fa
+
+if fa._HAS_PALLAS:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["decode_attend", "decode_attend_reference", "try_decode_attend",
+           "dequant_attend", "dequant_attend_reference",
+           "try_dequant_attend", "probe_decode", "probe_dequant",
+           "STATS", "DEFAULT_BLOCK_T", "MIN_T_DECODE", "MIN_T_DEQUANT"]
+
+STATS = {"pallas_calls": 0}
+
+DEFAULT_BLOCK_T = 512
+
+# Hardware perf gates on the pool depth T_max (interpret bypasses):
+# fp32 decode attend is a bandwidth tie with XLA's fused einsum until
+# the score tensor + cache reread stop fitting; the dequant variant
+# wins as soon as skipping the fp32 cache materialization pays for the
+# grid overhead. Unmeasured defaults — see module docstring.
+MIN_T_DECODE = 1024
+MIN_T_DEQUANT = 256
+
+
+def _pick_bt(T, pref=None):
+    return fa._pick_block(T, pref or DEFAULT_BLOCK_T)
+
+
+# ------------------------------------------------------------ kernels
+def _attend_body(s, pos, j, bt, v_f, m_ref, l_ref, acc_ref):
+    """Shared online-softmax update for one [1, bt] score row against a
+    [bt, Dh] value tile."""
+    k_pos = j * bt + jax.lax.broadcasted_iota(jnp.int32, (1, bt), 1)
+    s = jnp.where(k_pos <= pos, s, fa._NEG_INF)
+    m_prev = m_ref[...][:, :1]
+    l_prev = l_ref[...][:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+    acc_ref[...] = acc_ref[...] * alpha + fa._dot(
+        p.astype(v_f.dtype), v_f)
+
+
+def _init(j, m_ref, l_ref, acc_ref):
+    @pl.when(j == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, fa._NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+
+def _flush(j, n_t, l_ref, acc_ref, o_ref):
+    # MUST be emitted after the compute block: on the last t step both
+    # predicates are true and pl.when bodies run in emission order
+    @pl.when(j == n_t - 1)
+    def _():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-20)
+        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, pos_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, scale, n_t, bt):
+    """q_ref [1, Dh]; k/v_ref [bt, Dh]; pos_ref [1, LANES] int32."""
+    j = pl.program_id(2)
+    _init(j, m_ref, l_ref, acc_ref)
+    pos = pos_ref[0, 0]
+
+    @pl.when(j * bt <= pos)   # whole blocks above pos never load compute
+    def _compute():
+        s = fa._dot_t(q_ref[...], k_ref[...]) * scale        # [1, bt]
+        _attend_body(s, pos, j, bt, v_ref[...], m_ref, l_ref, acc_ref)
+
+    _flush(j, n_t, l_ref, acc_ref, o_ref)
+
+
+def _dequant_kernel(q_ref, kq_ref, ks_ref, vq_ref, vs_ref, pos_ref,
+                    o_ref, m_ref, l_ref, acc_ref, *, scale, n_t, bt,
+                    qblock):
+    """int8 codes [bt, Dh] + scales [bt, Dh/qblock] per tile; dequantize
+    in VMEM right before each dot — no fp32 cache copy in HBM."""
+    j = pl.program_id(2)
+    _init(j, m_ref, l_ref, acc_ref)
+    pos = pos_ref[0, 0]
+
+    @pl.when(j * bt <= pos)
+    def _compute():
+        nb = ks_ref.shape[1]
+        dh = kq_ref.shape[1]
+        k_f = (kq_ref[...].astype(jnp.float32).reshape(bt, nb, qblock)
+               * ks_ref[...][..., None]).reshape(bt, dh)
+        s = fa._dot_t(q_ref[...].astype(jnp.float32), k_f) * scale
+        v_f = (vq_ref[...].astype(jnp.float32).reshape(bt, nb, qblock)
+               * vs_ref[...][..., None]).reshape(bt, dh)
+        _attend_body(s, pos, j, bt, v_f, m_ref, l_ref, acc_ref)
+
+    _flush(j, n_t, l_ref, acc_ref, o_ref)
+
+
+# -------------------------------------------------------------- calls
+def _common_wiring(S, H, Dh, T, bt, q, inputs, in_specs, kernel, interpret):
+    n_t = T // bt
+    pos_rep = inputs[-1]
+    out = pl.pallas_call(
+        kernel,
+        grid=(S, H, n_t),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, 1, Dh), lambda s, h, j: (s, h, 0)),
+        out_shape=jax.ShapeDtypeStruct((S, H, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, fa._LANES), jnp.float32),
+            pltpu.VMEM((1, fa._LANES), jnp.float32),
+            pltpu.VMEM((1, Dh), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*inputs)
+    del pos_rep
+    return out
+
+
+def decode_attend(q, k, v, pos, scale=None, block_t=None,
+                  interpret=False):
+    """q [S,H,Dh], k/v [S,T,H,Dh], pos [S] int32 (attend to t <=
+    pos[s]) -> [S,H,Dh]."""
+    S, H, Dh = q.shape
+    T = k.shape[1]
+    scale = float(scale) if scale is not None else Dh ** -0.5
+    bt = _pick_bt(T, block_t)
+    if not bt:
+        raise NotImplementedError("pool depth must tile")
+    STATS["pallas_calls"] += 1
+    pos_rep = jnp.broadcast_to(pos.astype(jnp.int32)[:, None],
+                               (S, fa._LANES))
+    in_specs = [
+        pl.BlockSpec((None, 1, Dh), lambda s, h, j: (s, h, 0)),
+        pl.BlockSpec((None, bt, None, Dh), lambda s, h, j: (s, j, h, 0)),
+        pl.BlockSpec((None, bt, None, Dh), lambda s, h, j: (s, j, h, 0)),
+        pl.BlockSpec((1, fa._LANES), lambda s, h, j: (s, 0)),
+    ]
+    kern = functools.partial(_decode_kernel, scale=scale, n_t=T // bt,
+                             bt=bt)
+    return _common_wiring(S, H, Dh, T, bt, q, (q, k, v, pos_rep),
+                          in_specs, kern, interpret)
+
+
+def dequant_attend(q, kq, ks, vq, vs, pos, scale=None, block_t=None,
+                   interpret=False):
+    """q [S,H,Dh] f32; kq/vq [S,T,H,Dh] int8; ks/vs [S,T,H,Dh/qblock]
+    f32 per-block scales; pos [S] int32 -> [S,H,Dh] f32. qblock is
+    implied by the scale layout (Dh // ks.shape[-1])."""
+    S, H, Dh = q.shape
+    T = kq.shape[1]
+    nb = ks.shape[-1]
+    qblock = Dh // nb
+    scale = float(scale) if scale is not None else Dh ** -0.5
+    bt = _pick_bt(T, block_t)
+    if not bt:
+        raise NotImplementedError("pool depth must tile")
+    STATS["pallas_calls"] += 1
+    pos_rep = jnp.broadcast_to(pos.astype(jnp.int32)[:, None],
+                               (S, fa._LANES))
+    in_specs = [
+        pl.BlockSpec((None, 1, Dh), lambda s, h, j: (s, h, 0)),
+        pl.BlockSpec((None, bt, None, Dh), lambda s, h, j: (s, j, h, 0)),
+        pl.BlockSpec((None, bt, None, nb), lambda s, h, j: (s, j, h, 0)),
+        pl.BlockSpec((None, bt, None, Dh), lambda s, h, j: (s, j, h, 0)),
+        pl.BlockSpec((None, bt, None, nb), lambda s, h, j: (s, j, h, 0)),
+        pl.BlockSpec((1, fa._LANES), lambda s, h, j: (s, 0)),
+    ]
+    kern = functools.partial(_dequant_kernel, scale=scale, n_t=T // bt,
+                             bt=bt, qblock=qblock)
+    return _common_wiring(S, H, Dh, T, bt, q,
+                          (q, kq, ks, vq, vs, pos_rep), in_specs, kern,
+                          interpret)
+
+
+# ---------------------------------------------------------- reference
+def decode_attend_reference(q, k, v, pos, scale=None):
+    """EXACTLY the IncrementalDecoder composition on [S,T,H,Dh]: f32
+    logits, -inf mask on t > pos, the custom-vjp _attn_softmax, cast,
+    weighted sum — so kernel-vs-reference parity IS kernel-vs-decoder
+    parity."""
+    from ..kernels_nn import _attn_softmax
+    Dh = q.shape[-1]
+    T = k.shape[1]
+    scale = float(scale) if scale is not None else Dh ** -0.5
+    logits = jnp.einsum("shd,sthd->sht", q, k).astype(jnp.float32) \
+        * jnp.asarray(scale, jnp.float32)
+    keep = (jnp.arange(T)[None, None, :] <= pos[:, None, None])
+    logits = jnp.where(keep, logits, -jnp.inf)
+    w = _attn_softmax(logits).astype(q.dtype)
+    return jnp.einsum("sht,sthd->shd", w, v).astype(q.dtype)
+
+
+def dequant_attend_reference(q, kq, ks, vq, vs, pos, scale=None):
+    """The decoder's int8 composition: dequantize BOTH caches to fp32
+    in-graph (codes * broadcast scales), then the fp32 reference."""
+    S, T, H, Dh = kq.shape
+    nb = ks.shape[-1]
+    qblock = Dh // nb
+    k = (kq.astype(jnp.float32).reshape(S, T, H, nb, qblock)
+         * ks[..., None]).reshape(S, T, H, Dh)
+    v = (vq.astype(jnp.float32).reshape(S, T, H, nb, qblock)
+         * vs[..., None]).reshape(S, T, H, Dh)
+    return decode_attend_reference(q, k, v, pos, scale)
+
+
+# ------------------------------------------------------------- probes
+def probe_decode(q, k, v, pos, scale=None, *, interpret=False):
+    """STATIC acceptance (shape-only; works on ShapeDtypeStruct)."""
+    if getattr(q, "ndim", None) != 3 or getattr(k, "ndim", None) != 4:
+        return False
+    if getattr(v, "ndim", None) != 4 or tuple(k.shape) != tuple(v.shape):
+        return False
+    S, H, Dh = q.shape
+    if k.shape[0] != S or k.shape[2] != H or k.shape[3] != Dh:
+        return False
+    if tuple(pos.shape) != (S,):
+        return False
+    T = k.shape[1]
+    if not interpret and T < MIN_T_DECODE:
+        return False
+    return bool(_pick_bt(T))
+
+
+def probe_dequant(q, kq, ks, vq, vs, pos, scale=None, *,
+                  interpret=False):
+    if getattr(q, "ndim", None) != 3 or getattr(kq, "ndim", None) != 4:
+        return False
+    if getattr(ks, "ndim", None) != 4 or getattr(vq, "ndim", None) != 4 \
+            or getattr(vs, "ndim", None) != 4:
+        return False
+    if tuple(kq.shape) != tuple(vq.shape) \
+            or tuple(ks.shape) != tuple(vs.shape):
+        return False
+    S, H, Dh = q.shape
+    if kq.shape[0] != S or kq.shape[2] != H or kq.shape[3] != Dh:
+        return False
+    if jnp.dtype(kq.dtype) != jnp.dtype(jnp.int8):
+        return False
+    nb = ks.shape[-1]
+    if nb < 1 or Dh % nb or ks.shape[:3] != kq.shape[:3]:
+        return False
+    if tuple(pos.shape) != (S,):
+        return False
+    T = kq.shape[1]
+    if not interpret and T < MIN_T_DEQUANT:
+        return False
+    return bool(_pick_bt(T))
+
+
+# ----------------------------------------------------------- dispatch
+def try_decode_attend(q, k, v, pos, scale=None, block_t=None):
+    """try_* dispatch entry (the house policy shape): result or None."""
+    use, interpret = fa.active()
+    if not use:
+        return None
+    if not probe_decode(q, k, v, pos, scale, interpret=interpret):
+        return None
+    return decode_attend(q, k, v, pos, scale, block_t, interpret)
+
+
+def try_dequant_attend(q, kq, ks, vq, vs, pos, scale=None,
+                       block_t=None):
+    use, interpret = fa.active()
+    if not use:
+        return None
+    if not probe_dequant(q, kq, ks, vq, vs, pos, scale,
+                         interpret=interpret):
+        return None
+    return dequant_attend(q, kq, ks, vq, vs, pos, scale, block_t,
+                          interpret)

@@ -1,0 +1,349 @@
+"""Every Pallas kernel, declared to the registry.
+
+One KernelSpec per kernel: the try_* dispatch entry, the jnp reference
+composition it must match, a STATIC capability probe (runs on
+jax.ShapeDtypeStruct — meshlint and the CLI probe without data), the
+parity tolerance, the autotuner's shape signature + candidate space +
+staleness re-probe, and a small interpret-runnable example for the
+selftest gate.
+
+The probes mirror each try_* function's own acceptance conditions
+minus the active() backend gate — fn stays self-gating (dispatch
+correctness never depends on a probe), the probe exists so OTHER
+subsystems can ask "would this kernel take these shapes?" statically.
+"""
+import jax.numpy as jnp
+
+from ..pallas import flash_attention as fa
+from ..pallas import layer_norm as ln
+from ..pallas import embedding as emb
+from . import decode_attention as da
+from . import quant
+from .registry import KernelSpec, register
+
+
+def _shape(x):
+    return tuple(int(d) for d in x.shape)
+
+
+# ------------------------------------------------------------ layer_norm
+def _ln_reference(x, scale, bias, eps, begin_norm_axis):
+    """The (y, mean, var) triple the op kernel's jnp fallback produces
+    for minor-axis norm — what try_layer_norm returns."""
+    C = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1)
+    var = jnp.var(xf, axis=-1)
+    y = ((xf - mean[..., None]) / jnp.sqrt(var[..., None] + eps)
+         * scale.reshape(C).astype(jnp.float32)
+         + bias.reshape(C).astype(jnp.float32)).astype(x.dtype)
+    return y, mean.squeeze(), var.squeeze()
+
+
+def _ln_probe(x, scale, bias, eps, begin_norm_axis, *, interpret=False,
+              **kw):
+    if scale is None or bias is None:
+        return False
+    ndim = getattr(x, "ndim", 0)
+    if begin_norm_axis != ndim - 1 or ndim < 2:
+        return False
+    C = x.shape[-1]
+    if C % 128 != 0 and C > 256:
+        return False
+    rows = x.shape[-2]
+    if rows < 8:
+        return False
+    br = ln._pick_rows(rows, C)
+    if not br or (rows // br) * br != rows:
+        return False
+    if ndim >= 3 and rows * C > ln._BLOCK_BUDGET:
+        return False
+    return True
+
+
+def _ln_space(x, *a, **kw):
+    rows, C = x.shape[-2], x.shape[-1]
+    out = []
+    for br in (8, 16, 32, 64, 128, 256, 512):
+        if br <= rows and rows % br == 0 and br * C <= ln._BLOCK_BUDGET:
+            out.append({"block_rows": br})
+    return out
+
+
+def _ln_config_ok(cfg, x, *a, **kw):
+    br = cfg.get("block_rows")
+    if br is None:
+        return not cfg
+    rows, C = x.shape[-2], x.shape[-1]
+    return (br % 8 == 0 or br == rows) and rows % br == 0 \
+        and br * C <= ln._BLOCK_BUDGET
+
+
+def _ln_example(rng):
+    x = jnp.asarray(rng.standard_normal((16, 128)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(128), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(128), jnp.float32)
+    return (x, g, b, 1e-5, 1), {}
+
+
+register(KernelSpec(
+    name="layer_norm",
+    fn=ln.try_layer_norm,
+    reference=_ln_reference,
+    probe=_ln_probe,
+    tol=(2e-5, 2e-5),
+    op_types=("layer_norm",),
+    signature=lambda x, *a, **kw: _shape(x),
+    tune_space=_ln_space,
+    config_ok=_ln_config_ok,
+    example=_ln_example,
+    note="fused minor-axis LayerNorm, fwd+bwd (custom_vjp)",
+))
+
+
+# -------------------------------------------------------- flash_attention
+def _flash_probe(q, k, v, bias=None, causal=False, scale=None,
+                 with_lse=False, causal_offset=0, *, interpret=False,
+                 **kw):
+    if getattr(q, "ndim", 0) != 4:
+        return False
+    if not interpret and k.shape[2] < fa.MIN_SEQ_LEN:
+        return False
+    return fa.supports(q, k, v, bias=bias)
+
+
+def _flash_space(q, k, v, *a, **kw):
+    T, S = q.shape[2], k.shape[2]
+    D, DV = q.shape[-1], v.shape[-1]
+    out = []
+    for bq in (256, 512, 1024, 2048):
+        for bk in (512, 1024, 2048):
+            got = fa._choose_blocks(T, S, D, DV, bq, bk)
+            if got == (bq, bk) and {"block_q": bq, "block_k": bk} \
+                    not in out:
+                out.append({"block_q": bq, "block_k": bk})
+    return out
+
+
+def _flash_config_ok(cfg, q, k, v, *a, **kw):
+    bq, bk = cfg.get("block_q"), cfg.get("block_k")
+    if bq is None and bk is None:
+        return not cfg
+    T, S = q.shape[2], k.shape[2]
+    return fa._choose_blocks(T, S, q.shape[-1], v.shape[-1],
+                             bq, bk) == (bq, bk)
+
+
+def _flash_example(rng):
+    q = jnp.asarray(rng.standard_normal((1, 2, 128, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 128, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 128, 64)), jnp.float32)
+    return (q, k, v), {"causal": True}
+
+
+register(KernelSpec(
+    name="flash_attention",
+    fn=fa.try_flash,
+    reference=fa.flash_attention_reference,
+    probe=_flash_probe,
+    tol=(2e-5, 2e-5),
+    op_types=("flash_attention",),
+    signature=lambda q, k, v, *a, **kw: (_shape(q) + (k.shape[2],)
+                                         + (v.shape[-1],)),
+    tune_space=_flash_space,
+    config_ok=_flash_config_ok,
+    example=_flash_example,
+    note="tiled online-softmax attention, fwd+bwd (custom_vjp)",
+))
+
+
+# ------------------------------------------------------------ lookup_pool
+def _emb_probe(table, inv, weights=None, pool="sum", *,
+               interpret=False, **kw):
+    if pool not in ("sum", "mean"):
+        return False
+    if getattr(table, "ndim", 0) != 2 or getattr(inv, "ndim", 0) != 2:
+        return False
+    C, D = table.shape
+    R, F = inv.shape
+    if R < 8:
+        return False
+    br = emb._pick_rows(R, C, D, F)
+    return bool(br) and (R // br) * br == R
+
+
+def _emb_space(table, inv, *a, **kw):
+    R = inv.shape[0]
+    out = []
+    for br in (8, 16, 32, 64, 128, 256, 512):
+        if br <= R and R % br == 0:
+            out.append({"block_rows": br})
+    return out
+
+
+def _emb_config_ok(cfg, table, inv, *a, **kw):
+    br = cfg.get("block_rows")
+    if br is None:
+        return not cfg
+    C, D = table.shape
+    R, F = inv.shape
+    if R % br or (br % 8 and br != R):
+        return False
+    return C * D + br * (C + D + F) <= emb._VMEM_BUDGET
+
+
+def _emb_example(rng):
+    table = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+    inv = jnp.asarray(rng.randint(-1, 64, size=(16, 4)), jnp.int32)
+    return (table, inv), {"pool": "mean"}
+
+
+register(KernelSpec(
+    name="lookup_pool",
+    fn=emb.try_lookup_pool,
+    reference=emb.lookup_pool_reference,
+    probe=_emb_probe,
+    tol=(2e-5, 2e-5),
+    op_types=("lookup_pool", "fused_embedding_seq_pool"),
+    signature=lambda table, inv, *a, **kw: (_shape(table) + _shape(inv)),
+    tune_space=_emb_space,
+    config_ok=_emb_config_ok,
+    example=_emb_example,
+    note="fused embedding lookup+pool (one-hot MXU gather)",
+))
+
+
+# ---------------------------------------------------------- decode_attend
+def _da_space(q, k, v, pos, *a, **kw):
+    T = k.shape[1]
+    out = []
+    for bt in (128, 256, 512, 1024):
+        if fa._pick_block(T, bt) == bt:
+            out.append({"block_t": bt})
+    return out
+
+
+def _da_config_ok(cfg, q, k, v, pos, *a, **kw):
+    bt = cfg.get("block_t")
+    if bt is None:
+        return not cfg
+    return fa._pick_block(k.shape[1], bt) == bt
+
+
+def _da_example(rng):
+    S, T, H, Dh = 4, 128, 2, 128
+    q = jnp.asarray(rng.standard_normal((S, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, T, H, Dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, T, H, Dh)), jnp.float32)
+    pos = jnp.asarray(rng.randint(0, T, size=(S,)), jnp.int32)
+    return (q, k, v, pos), {}
+
+
+register(KernelSpec(
+    name="decode_attend",
+    fn=da.try_decode_attend,
+    reference=da.decode_attend_reference,
+    probe=da.probe_decode,
+    tol=(2e-5, 2e-5),
+    op_types=("decode_attend",),
+    signature=lambda q, k, v, pos, *a, **kw: (_shape(q) + (k.shape[1],)),
+    tune_space=_da_space,
+    config_ok=_da_config_ok,
+    example=_da_example,
+    note="single-token ragged decode attention over the slot pool",
+))
+
+
+# ----------------------------------------------------- dequant_attend_int8
+def _dq_space(q, kq, ks, vq, vs, pos, *a, **kw):
+    T = kq.shape[1]
+    out = []
+    for bt in (128, 256, 512, 1024):
+        if fa._pick_block(T, bt) == bt:
+            out.append({"block_t": bt})
+    return out
+
+
+def _dq_config_ok(cfg, q, kq, *a, **kw):
+    bt = cfg.get("block_t")
+    if bt is None:
+        return not cfg
+    return fa._pick_block(kq.shape[1], bt) == bt
+
+
+def _dq_example(rng):
+    S, T, H, Dh, qb = 4, 128, 2, 128, 64
+    nb = Dh // qb
+    q = jnp.asarray(rng.standard_normal((S, H, Dh)), jnp.float32)
+    kq = jnp.asarray(rng.randint(-127, 128, size=(S, T, H, Dh)),
+                     jnp.int8)
+    vq = jnp.asarray(rng.randint(-127, 128, size=(S, T, H, Dh)),
+                     jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.005, 0.02, size=(S, T, H, nb)),
+                     jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.005, 0.02, size=(S, T, H, nb)),
+                     jnp.float32)
+    pos = jnp.asarray(rng.randint(0, T, size=(S,)), jnp.int32)
+    return (q, kq, ks, vq, vs, pos), {}
+
+
+register(KernelSpec(
+    name="dequant_attend_int8",
+    fn=da.try_dequant_attend,
+    reference=da.dequant_attend_reference,
+    probe=da.probe_dequant,
+    tol=(2e-5, 2e-5),
+    op_types=("dequant_attend_int8",),
+    signature=lambda q, kq, ks, *a, **kw: (_shape(q) + (kq.shape[1],)
+                                           + (ks.shape[-1],)),
+    tune_space=_dq_space,
+    config_ok=_dq_config_ok,
+    example=_dq_example,
+    note="fused int8 dequantize-attend over the block-quantized KV cache",
+))
+
+
+# -------------------------------------------------------------- int8_quant
+def _q_space(flat, block_size=256, **kw):
+    nb = flat.shape[0] // max(block_size, 1)
+    out = []
+    for br in (64, 128, 256, 512, 1024):
+        if quant._pick_rows(nb, block_size, br) == br:
+            out.append({"block_rows": br})
+    return out
+
+
+def _q_config_ok(cfg, flat, block_size=256, **kw):
+    br = cfg.get("block_rows")
+    if br is None:
+        return not cfg
+    nb = flat.shape[0] // max(block_size, 1)
+    return quant._pick_rows(nb, block_size, br) == br
+
+
+def _q_example(rng):
+    # 1024 blocks: enough rows that the tune ladder (128-multiple row
+    # tiles) has real candidates
+    flat = jnp.asarray(rng.standard_normal(1024 * 256), jnp.float32)
+    # a zero block exercises the safe-scale path
+    flat = flat.at[:256].set(0.0)
+    return (flat,), {"block_size": 256}
+
+
+register(KernelSpec(
+    name="int8_quant",
+    fn=quant.try_quantize,
+    reference=quant.quantize_int8_blockwise_reference,
+    probe=quant.probe_quant,
+    # codes are int8 (compared exactly); scales are the same jnp
+    # expression per block — bit-equal, the tol is slack for the fp32
+    # reduction order
+    tol=(0.0, 1e-7),
+    op_types=("int8_quant",),
+    signature=lambda flat, block_size=256, **kw: (flat.shape[0],
+                                                  block_size),
+    tune_space=_q_space,
+    config_ok=_q_config_ok,
+    example=_q_example,
+    note="shared int8 blockwise quantize (EQuARX wire format)",
+))

@@ -384,17 +384,39 @@ _alias("hierarchical_sigmoid", "hsigmoid")
 _alias("lookup_sparse_table", "lookup_table")
 
 
+def lookup_pool_reference(table, inv, weights=None, pool="sum"):
+    """The lowered jnp gather+reduce composition for the fused
+    embedding lookup+pool: `out[r] = pool_f weights[r, f] *
+    table[inv[r, f]]`, negative inv = padding (contributes zero, is
+    excluded from the mean denominator). Lives HERE (not in
+    ops/pallas/embedding, which re-exports it) so the fallback path
+    never imports the pallas package — it is the numerics reference the
+    kern registry holds for the lookup_pool kernel."""
+    C, D = table.shape
+    inv = inv.astype(jnp.int32)
+    valid = (inv >= 0)
+    rows = jnp.take(table, jnp.clip(inv, 0, C - 1), axis=0
+                    ).astype(jnp.float32)            # [R, F, D]
+    w = weights.astype(jnp.float32) if weights is not None \
+        else jnp.ones(inv.shape, jnp.float32)
+    w = jnp.where(valid, w, 0.0)
+    out = jnp.sum(rows * w[:, :, None], axis=1)
+    if pool == "mean":
+        out = out / jnp.maximum(valid.sum(axis=1, keepdims=True), 1
+                                ).astype(jnp.float32)
+    return out.astype(table.dtype)
+
+
 @kernel("fused_embedding_seq_pool")
 def _fused_embedding_seq_pool(ctx, ins, attrs):
     """ref operators/fused/fused_embedding_seq_pool_op.h: lookup_table
     + sequence_pool (sum/mean over the field/sequence axis) in one op,
-    here dispatched to the Pallas fused lookup+pool kernel when the
-    capability probe accepts (ops/pallas/embedding.py) and to the
+    here dispatched through the kern registry to the Pallas fused
+    lookup+pool kernel when the capability probe accepts, and to the
     lowered jnp gather+reduce composition otherwise — both paths share
     one convention (negative/padding ids contribute zero and are
     excluded from the mean denominator). Optional Weight input gives
     the weighted pool (first-order CTR terms: sum_f w_i * x_i)."""
-    from .pallas import embedding as pemb
     w, ids = ins["W"][0], ins["Ids"][0]
     ids = ids.astype(jnp.int32)
     if ids.ndim >= 2 and ids.shape[-1] == 1:
@@ -415,9 +437,12 @@ def _fused_embedding_seq_pool(ctx, ins, attrs):
     inv = jnp.clip(ids, 0, w.shape[0] - 1)
     if padding_idx is not None and padding_idx >= 0:
         inv = jnp.where(ids == padding_idx, -1, inv)
-    out = pemb.try_lookup_pool(w, inv, weights, pool)
+    out = None
+    fused = ctx.accel("fused_embedding_seq_pool")
+    if fused is not None:
+        out = fused(w, inv, weights, pool)
     if out is None:
-        out = pemb.lookup_pool_reference(w, inv, weights, pool)
+        out = lookup_pool_reference(w, inv, weights, pool)
     return {"Out": [out]}
 
 

@@ -56,14 +56,17 @@ STATS = {"pallas_calls": 0}
 _VMEM_BUDGET = 1536 * 1024
 
 
-def _pick_rows(R, C, D, F):
+def _pick_rows(R, C, D, F, pref=None):
     """Largest row block (multiple of 8, or R itself) that divides R
-    and fits the budget next to the resident [C, D] table. 0 if none."""
+    and fits the budget next to the resident [C, D] table. 0 if none.
+    `pref` caps the preference below the VMEM-derived one (the kern
+    autotuner's knob)."""
     table = C * D
     if table >= _VMEM_BUDGET:
         return 0
     per_row = C + D + F          # one-hot row + out row + inv row
-    pref = max(8, min(R, (_VMEM_BUDGET - table) // max(per_row, 1)))
+    cap = (_VMEM_BUDGET - table) // max(per_row, 1)
+    pref = max(8, min(R, cap, pref or cap))
     if pref >= R:
         return R
     for b in range(pref // 8 * 8, 0, -8):
@@ -174,29 +177,20 @@ def _bwd_vjp(pool, block_rows, interpret, res, dy):
 lookup_pool.defvjp(_fwd_vjp, _bwd_vjp)
 
 
-def lookup_pool_reference(table, inv, weights=None, pool="sum"):
-    """The lowered jnp gather+reduce composition (numerics reference
-    and the fallback path). Same signature/convention as lookup_pool."""
-    C, D = table.shape
-    inv = inv.astype(jnp.int32)
-    valid = (inv >= 0)
-    rows = jnp.take(table, jnp.clip(inv, 0, C - 1), axis=0
-                    ).astype(jnp.float32)            # [R, F, D]
-    w = weights.astype(jnp.float32) if weights is not None \
-        else jnp.ones(inv.shape, jnp.float32)
-    w = jnp.where(valid, w, 0.0)
-    out = jnp.sum(rows * w[:, :, None], axis=1)
-    if pool == "mean":
-        out = out / jnp.maximum(valid.sum(axis=1, keepdims=True), 1
-                                ).astype(jnp.float32)
-    return out.astype(table.dtype)
+# The jnp reference/fallback composition lives with the op kernel
+# (ops/kernels_extra.py) so fallback paths never import this package;
+# re-exported here for back-compat (tests and the sparse engine used to
+# find it in this module).
+from ..kernels_extra import lookup_pool_reference  # noqa: E402
 
 
-def try_lookup_pool(table, inv, weights=None, pool="sum"):
+def try_lookup_pool(table, inv, weights=None, pool="sum",
+                    block_rows=None):
     """THE dispatch policy: the fused kernel's result, or None → caller
     falls back to lookup_pool_reference. Requirements: Pallas active,
     2D table/inv, a known pool mode, and table + row block within the
-    VMEM budget."""
+    VMEM budget. block_rows caps the row-block preference (the kern
+    autotuner's knob); _pick_rows still legalizes it."""
     use_pallas, interpret = active()
     if not use_pallas or pool not in ("sum", "mean"):
         return None
@@ -206,7 +200,7 @@ def try_lookup_pool(table, inv, weights=None, pool="sum"):
     R, F = inv.shape
     if R < 8:
         return None
-    br = _pick_rows(R, C, D, F)
+    br = _pick_rows(R, C, D, F, block_rows)
     if not br or (R // br) * br != R:
         return None
     return lookup_pool(table, inv.astype(jnp.int32), weights, pool,

@@ -673,13 +673,15 @@ def flash_attention_reference(q, k, v, bias=None, causal=False, scale=None,
 
 
 def try_flash(q, k, v, bias=None, causal=False, scale=None, with_lse=False,
-              causal_offset=0):
+              causal_offset=0, block_q=None, block_k=None):
     """THE dispatch policy, in one place (used by ops/kernels_nn.py,
     parallel/ring_attention.py, parallel/ulysses.py): returns the Pallas
     result — `out` or `(out, lse)` with `with_lse` — when the kernel is
     active, profitable (S >= MIN_SEQ_LEN; interpret mode bypasses the
     perf gate), and the shapes/bias layout are supported; else None and
-    the caller runs its own fused-XLA fallback."""
+    the caller runs its own fused-XLA fallback. block_q/block_k override
+    the default tile preference (the kern autotuner's knob); _prep still
+    re-legalizes them through _choose_blocks."""
     use_pallas, interpret = active()
     if not use_pallas:
         return None
@@ -690,7 +692,10 @@ def try_flash(q, k, v, bias=None, causal=False, scale=None, with_lse=False,
     if with_lse:
         return flash_attention_with_lse(q, k, v, bias=bias, causal=causal,
                                         scale=scale, interpret=interpret,
+                                        block_q=block_q, block_k=block_k,
                                         causal_offset=causal_offset)
     return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale,
+                           block_q=block_q or DEFAULT_BLOCK_Q,
+                           block_k=block_k or DEFAULT_BLOCK_K,
                            interpret=interpret,
                            causal_offset=causal_offset)

@@ -13,12 +13,41 @@ Kernel signature:
     .key      per-op PRNG key (deterministic: fold_in(program seed, op index))
     .is_test  executor mode (inference disables dropout etc.)
     .place    the target Place
+    .accel    the Pallas dispatch seam (see accel() below)
 """
+import os
 
 __all__ = ["kernel", "get_kernel", "has_kernel", "closest_kernels",
-           "KernelCtx", "KERNELS", "autocast"]
+           "KernelCtx", "KERNELS", "autocast", "accel", "kern_enabled",
+           "ENV_KERN"]
 
 KERNELS = {}
+
+# THE registry switch: PADDLE_TPU_KERN=off|0|false disables the kern
+# subsystem entirely — accel() returns None before ops/kern (and thus
+# ops/pallas) is ever imported, so every op kernel lowers its jnp
+# fallback, byte-identical to a build without the subsystem (pinned in
+# tests/test_bench_contract.py). Default is on: dispatch still
+# self-gates per kernel on backend/mode/shape.
+ENV_KERN = "PADDLE_TPU_KERN"
+
+
+def kern_enabled():
+    return os.environ.get(ENV_KERN, "").lower() not in ("off", "0",
+                                                        "false")
+
+
+def accel(op_type):
+    """The ONE Pallas dispatch seam: a callable running the registered
+    kernel for `op_type` (returns the kernel result, or None when its
+    own gate rejects — the try_* convention), or None when the kern
+    registry is off or holds nothing for this op. Op kernels reach this
+    through ctx.accel; trace-time lowering consults the registry here
+    instead of per-call-site pallas imports."""
+    if not kern_enabled():
+        return None
+    from . import kern
+    return kern.adapter(op_type)
 
 
 def autocast(*arrays):
@@ -39,10 +68,11 @@ def autocast(*arrays):
 
 
 class KernelCtx:
-    def __init__(self, key=None, is_test=False, place=None):
+    def __init__(self, key=None, is_test=False, place=None, accel=accel):
         self.key = key
         self.is_test = is_test
         self.place = place
+        self.accel = accel
 
 
 def kernel(*types):

@@ -115,7 +115,12 @@ def all_reduce_int8_blockwise(q, scales, axis_name="dp"):
                        wire="int8-blockwise"):
         qg = lax.all_gather(q, axis_name, axis=0, tiled=False)
         sg = lax.all_gather(scales, axis_name, axis=0, tiled=False)
-        return jnp.sum(qg.astype(jnp.float32) * sg, axis=0)
+        # per-member dequantize is the shared wire primitive
+        # (ops/kern/quant.py) vmapped over the member axis — the sum
+        # stays the same fp32 accumulation over members
+        from ..ops.kern.quant import dequantize_int8_blockwise
+        deq = jax.vmap(dequantize_int8_blockwise)(qg, sg)  # [M, nb*bs]
+        return jnp.sum(deq, axis=0).reshape(q.shape)
 
 
 psum = lambda x, axis_name="dp": lax.psum(x, axis_name)

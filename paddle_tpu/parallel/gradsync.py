@@ -220,21 +220,24 @@ def state_entries(plan, policy):
 
 
 # ---------------------------------------------------------- quantization
+#
+# The scheme (per-block absmax/127 fp32 scales, zero blocks keep unit
+# scale, codes clipped to ±127 int8) moved to ops/kern/quant.py — ONE
+# implementation shared by these buckets, the decode KV cache, and the
+# collective wire, with a fused Pallas kernel behind the registry.
+# These names stay importable (public API + the KV cache imports them).
 
 def quantize_int8_blockwise(flat, block_size=256):
     """flat fp32 [padded] -> (q int8 [n_blocks, block], scales fp32
     [n_blocks, 1]) with per-block absmax/127 scales (zero blocks get a
     unit scale so the codes stay 0)."""
-    blocks = flat.reshape(-1, block_size)
-    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
-    scales = absmax / 127.0
-    safe = jnp.where(scales == 0, 1.0, scales)
-    q = jnp.clip(jnp.round(blocks / safe), -127, 127).astype(jnp.int8)
-    return q, scales
+    from ..ops.kern.quant import quantize_int8_blockwise as impl
+    return impl(flat, block_size)
 
 
 def dequantize_int8_blockwise(q, scales):
-    return (q.astype(jnp.float32) * scales).reshape(-1)
+    from ..ops.kern.quant import dequantize_int8_blockwise as impl
+    return impl(q, scales)
 
 
 # ----------------------------------------------------------------- sync

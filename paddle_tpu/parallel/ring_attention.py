@@ -39,8 +39,6 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from ..ops.pallas import flash_attention as _fa
-
 __all__ = ["ring_attention", "ring_attention_local"]
 
 _NEG_INF = -1e30
@@ -65,10 +63,16 @@ def _block_jnp(q, k, v, causal, scale, causal_offset=0):
 
 
 def _block_engine(q, k, v, scale):
-    """Pick the per-block attention fn (causal: bool) → (out_f32, lse)."""
+    """Pick the per-block attention fn (causal: bool) → (out_f32, lse).
+    Flash is reached through the kern-registry seam (ops.registry.accel)
+    so this module loads no Pallas code until a block actually runs."""
+    from ..ops.registry import accel
+    fused = accel("flash_attention")
+
     def run(causal, causal_offset=0):
-        res = _fa.try_flash(q, k, v, causal=causal, scale=scale,
-                            with_lse=True, causal_offset=causal_offset)
+        res = fused(q, k, v, causal=causal, scale=scale, with_lse=True,
+                    causal_offset=causal_offset) \
+            if fused is not None else None
         if res is None:
             return _block_jnp(q, k, v, causal, scale, causal_offset)
         out, lse = res

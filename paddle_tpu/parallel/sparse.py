@@ -26,9 +26,9 @@ lowers to a TPU-native engine (ROADMAP item 5):
   is ever dropped; smaller caps trade wire for a counted overflow —
   see `tpusparse.stats.*`).
 - **local fused lookup+pool**: the gathered unique rows expand to the
-  program's [B, F, D] output through the Pallas fused lookup kernel
-  (ops/pallas/embedding.py) when the capability probe accepts, else
-  the identical jnp gather.
+  program's [B, F, D] output through the kern registry's fused lookup
+  kernel (`ops.registry.accel("lookup_pool")`) when its capability
+  probe accepts, else the identical jnp gather.
 - **update**: the backward's is_sparse row-grad taps give per-position
   row gradients; they dedup locally (`dedup_rows`), exchange to their
   owner shards (one all-to-all), merge across members, and apply the
@@ -654,8 +654,10 @@ class SparseEngine:
         u_rows, overflow = self._exchange_rows(t, shard, uids)
         out = None
         if self.policy.kernel:
-            from ..ops.pallas import embedding as pemb
-            out = pemb.try_lookup_pool(u_rows, inv[:, None], None, "sum")
+            from ..ops.registry import accel
+            fused = accel("lookup_pool")
+            if fused is not None:
+                out = fused(u_rows, inv[:, None], None, "sum")
         if out is None:
             out = jnp.take(u_rows, inv, axis=0)
         out = out.reshape(ids.shape + (t.dim,))

@@ -14,8 +14,6 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..ops.pallas import flash_attention as _fa
-
 __all__ = ["ulysses_attention"]
 
 
@@ -31,6 +29,10 @@ def ulysses_attention(mesh, q, k, v, causal=False, scale=None,
     if T % sp:
         raise ValueError(f"sequence {T} must divide sp={sp}")
     scale = scale if scale is not None else D ** -0.5
+    # kern-registry seam (ops.registry.accel): no module-level Pallas
+    # import; the shared try_flash policy still decides per call
+    from ..ops.registry import accel
+    fused = accel("flash_attention")
 
     def local(ql, kl, vl):
         # local [B, H, T/sp, D] → all_to_all → [B, H/sp, T, D]
@@ -43,7 +45,8 @@ def ulysses_attention(mesh, q, k, v, causal=False, scale=None,
         # full sequence is local after the all-to-all — the shared
         # try_flash policy decides kernel vs fused-XLA exactly as for
         # single-device attention
-        out = _fa.try_flash(ql, kl, vl, causal=causal, scale=scale)
+        out = fused(ql, kl, vl, causal=causal, scale=scale) \
+            if fused is not None else None
         if out is None:
             s = jnp.einsum("bhqd,bhkd->bhqk", ql, kl).astype(jnp.float32)
             s = s * scale

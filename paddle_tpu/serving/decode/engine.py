@@ -199,6 +199,15 @@ class DecodeEngine:
                 self.compile_count)
             _tm.gauge("serving.decode.kv_cache_bytes").set(
                 self.kv_cache_bytes)
+            # kern-registry evidence from the step trace (read via
+            # sys.modules — registry-off runs must not import kern)
+            import sys
+            kr = sys.modules.get("paddle_tpu.ops.kern.registry")
+            if kr is not None:
+                _tm.gauge("serving.decode.kern_dispatches").set(
+                    kr.STATS["dispatches"])
+                _tm.gauge("serving.decode.kern_accepted").set(
+                    kr.STATS["accepted"])
         return self.compile_count
 
     # ---------------------------------------------------------- serving

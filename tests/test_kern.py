@@ -1,0 +1,262 @@
+"""ops/kern: registry dispatch, parity, autotune cache, meshlint pass.
+
+The registry's invariants, each pinned here:
+  - every registered kernel passes its parity gate on its own example
+    (interpret mode — the numerics are backend-independent)
+  - the autotune cache key covers (kernel, sig, dtype, platform) AND
+    every persisted entry stores its key, verified on load — a
+    hand-moved or digest-colliding entry can never cross shape/dtype/
+    platform boundaries
+  - torn state is skipped, never fatal: a corrupt baseline file, a
+    torn disk entry, a stale config failing config_ok all fall back to
+    the default block sizes
+  - the meshlint kern-capability pass warns exactly when a program op
+    with a registered kernel probes False on the per-shard shapes
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.ops import kern
+from paddle_tpu.ops.kern import autotune, registry as kreg
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+@pytest.fixture
+def interpret_mode():
+    fa.set_mode("interpret")
+    try:
+        yield
+    finally:
+        fa.set_mode("auto")
+
+
+@pytest.fixture
+def clean_cache(tmp_path, monkeypatch):
+    """Isolated autotune state: tmp disk cache, NO committed baseline
+    (points at a nonexistent file), reset memory before and after."""
+    monkeypatch.setenv(autotune.ENV_CACHE, str(tmp_path / "cache"))
+    monkeypatch.setenv(autotune.ENV_BASELINE,
+                       str(tmp_path / "no_baseline.json"))
+    monkeypatch.delenv(autotune.ENV_AUTOTUNE, raising=False)
+    autotune.reset()
+    yield tmp_path
+    autotune.reset()
+
+
+# ------------------------------------------------------------- parity
+def test_at_least_five_kernels_registered():
+    assert len(kreg.names()) >= 5
+    for name in kreg.names():
+        spec = kreg.get(name)
+        assert spec.example is not None, name
+        assert spec.reference is not None, name
+
+
+def test_every_kernel_parity_on_its_example(interpret_mode):
+    ran = 0
+    for name in kreg.names():
+        spec = kreg.get(name)
+        args, kwargs = spec.example(np.random.RandomState(0))
+        ok, detail = kreg.parity_check(name, args, kwargs)
+        assert ok is True, (name, detail)
+        ran += 1
+    assert ran >= 5
+
+
+def test_static_probe_accepts_every_example():
+    import jax
+    for name in kreg.names():
+        spec = kreg.get(name)
+        args, kwargs = spec.example(np.random.RandomState(1))
+        structs = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") and hasattr(a, "dtype") else a
+            for a in args)
+        assert spec.probe(*structs, interpret=True, **kwargs), name
+
+
+def test_dispatch_counts_stats(interpret_mode, clean_cache):
+    spec = kreg.get("int8_quant")
+    args, kwargs = spec.example(np.random.RandomState(2))
+    before = dict(kreg.STATS)
+    out = kreg.dispatch("int8_quant", *args, **kwargs)
+    assert out is not None
+    assert kreg.STATS["dispatches"] == before["dispatches"] + 1
+    assert kreg.STATS["accepted"] == before["accepted"] + 1
+    assert kreg.adapter("int8_quant") is not None
+    assert kreg.adapter("no_such_op") is None
+
+
+# ----------------------------------------------------- autotune cache
+def _quant_case():
+    spec = kreg.get("int8_quant")
+    args, kwargs = spec.example(np.random.RandomState(3))
+    return spec, args, kwargs
+
+
+def test_cache_key_covers_dtype_and_platform(interpret_mode):
+    import jax.numpy as jnp
+    spec, args, kwargs = _quant_case()
+    k32 = autotune.cache_key(spec, args, kwargs)
+    k16 = autotune.cache_key(spec, (args[0].astype(jnp.bfloat16),),
+                             kwargs)
+    assert k32 != k16 and k32[:2] == k16[:2]
+    fa.set_mode("auto")
+    try:
+        k_auto = autotune.cache_key(spec, args, kwargs)
+    finally:
+        fa.set_mode("interpret")
+    assert k_auto[3] != k32[3] == "interpret"
+
+
+def test_moved_entry_rejected_on_stored_key(interpret_mode, clean_cache):
+    """A disk entry hand-moved (or digest-colliding) into another
+    key's directory is rejected by the stored-key check."""
+    import shutil
+    spec, args, kwargs = _quant_case()
+    key = autotune.cache_key(spec, args, kwargs)
+    autotune.publish(key, {"block_rows": 128}, source="test")
+    assert autotune._load_disk(key) == {"block_rows": 128}
+    other = (key[0], (key[1][0] * 2, key[1][1]), key[2], key[3])
+    src, dst = autotune._entry_dir(key), autotune._entry_dir(other)
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    shutil.move(src, dst)
+    rejected = autotune.STATS["entries_rejected"]
+    assert autotune._load_disk(other) is None
+    assert autotune.STATS["entries_rejected"] == rejected + 1
+
+
+def test_torn_baseline_skipped_not_fatal(interpret_mode, clean_cache,
+                                         monkeypatch):
+    spec, args, kwargs = _quant_case()
+    torn = clean_cache / "torn_baseline.json"
+    torn.write_text('{"schema": "paddle_tpu.kern.tuned.v1", "entr')
+    monkeypatch.setenv(autotune.ENV_BASELINE, str(torn))
+    autotune.reset()
+    skipped = autotune.STATS["baseline_skipped"]
+    assert autotune.load_baseline() == {}
+    assert autotune.STATS["baseline_skipped"] == skipped + 1
+    # the read path still answers (defaults), it does not crash
+    assert autotune.tuned_config(spec, args, kwargs) == {}
+
+
+def test_wrong_schema_baseline_skipped(clean_cache, monkeypatch):
+    bad = clean_cache / "bad_schema.json"
+    bad.write_text(json.dumps({"schema": "something.else.v9",
+                               "entries": []}))
+    monkeypatch.setenv(autotune.ENV_BASELINE, str(bad))
+    autotune.reset()
+    assert autotune.load_baseline() == {}
+
+
+def test_torn_disk_entry_skipped(interpret_mode, clean_cache):
+    spec, args, kwargs = _quant_case()
+    key = autotune.cache_key(spec, args, kwargs)
+    autotune.publish(key, {"block_rows": 128}, source="test")
+    with open(os.path.join(autotune._entry_dir(key), "tuned.json"),
+              "w") as f:
+        f.write('{"torn": ')
+    autotune.reset()
+    rejected = autotune.STATS["entries_rejected"]
+    assert autotune.tuned_config(spec, args, kwargs) == {}
+    assert autotune.STATS["entries_rejected"] > rejected
+
+
+def test_stale_config_falls_back_to_defaults(interpret_mode,
+                                             clean_cache):
+    """A persisted config that config_ok rejects for the CURRENT args
+    (tuned when the shape divided differently) yields defaults, not a
+    crash inside the kernel."""
+    spec, args, kwargs = _quant_case()
+    key = autotune.cache_key(spec, args, kwargs)
+    # 999 is not a legal row tile for any shape (not a 128-multiple)
+    autotune.publish(key, {"block_rows": 999}, source="test")
+    autotune.reset()
+    rejected = autotune.STATS["entries_rejected"]
+    assert autotune.tuned_config(spec, args, kwargs) == {}
+    assert autotune.STATS["entries_rejected"] == rejected + 1
+    # and dispatch still runs on the default blocks
+    out = kreg.dispatch("int8_quant", *args, **kwargs)
+    assert out is not None
+
+
+def test_publish_load_roundtrip(interpret_mode, clean_cache):
+    spec, args, kwargs = _quant_case()
+    key = autotune.cache_key(spec, args, kwargs)
+    autotune.publish(key, {"block_rows": 256}, source="test", ms=1.0)
+    autotune.reset()
+    hits = autotune.STATS["tuned_hits"]
+    assert autotune.tuned_config(spec, args, kwargs) == \
+        {"block_rows": 256}
+    assert autotune.STATS["tuned_hits"] == hits + 1
+
+
+def test_committed_baseline_is_wellformed():
+    """The repo-root KERN_TUNED.json loads, has the right schema, and
+    every entry names a registered kernel with a config its
+    tune-space vocabulary recognizes."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "KERN_TUNED.json")
+    assert os.path.exists(path)
+    index = autotune.load_baseline(path)
+    assert index, "committed baseline is empty or malformed"
+    for kj, entry in index.items():
+        kernel = json.loads(kj)[0]
+        assert kernel in kreg.KERN_SPECS, kernel
+        assert isinstance(entry["config"], dict) and entry["config"]
+
+
+# ------------------------------------------------- meshlint pass
+def _ln_program(rows, C):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[rows, C],
+                              append_batch_size=False, dtype="float32")
+        fluid.layers.layer_norm(x, begin_norm_axis=1)
+    return main
+
+
+def _kern_diags(mctx):
+    from paddle_tpu.analysis import meshlint as ml
+    return [d for d in ml.run_mesh_passes(mctx, passes=["kern-capability"])
+            if d.pass_name == "kern-capability"]
+
+
+def test_meshlint_warns_on_probe_reject():
+    from paddle_tpu.analysis import meshlint as ml
+    diags = _kern_diags(ml.MeshLintContext(
+        ml.MeshSpec({"dp": 2}), program=_ln_program(4, 128)))
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.severity == "warning" and d.op_type == "layer_norm"
+    assert "jnp fallback" in d.message
+    assert ml.active_profile() in d.message
+
+
+def test_meshlint_quiet_on_probe_accept():
+    from paddle_tpu.analysis import meshlint as ml
+    assert _kern_diags(ml.MeshLintContext(
+        ml.MeshSpec({"dp": 2}), program=_ln_program(16, 128))) == []
+
+
+def test_meshlint_probes_per_shard_shapes():
+    """16 rows probe fine globally, but dp=4 leaves 4 rows per device
+    — the pass judges what each device actually traces."""
+    from paddle_tpu.analysis import meshlint as ml
+    diags = _kern_diags(ml.MeshLintContext(
+        ml.MeshSpec({"dp": 4}), program=_ln_program(16, 128),
+        data_axis="dp"))
+    assert len(diags) == 1
+    assert "per-device view" in diags[0].message
+
+
+def test_meshlint_quiet_without_program_or_registry(monkeypatch):
+    from paddle_tpu.analysis import meshlint as ml
+    assert _kern_diags(ml.MeshLintContext(ml.MeshSpec({"dp": 2}))) == []
+    monkeypatch.setenv("PADDLE_TPU_KERN", "off")
+    assert _kern_diags(ml.MeshLintContext(
+        ml.MeshSpec({"dp": 2}), program=_ln_program(4, 128))) == []

@@ -197,7 +197,8 @@ def selftest():
     # every advertised pass is registered
     checks["passes_registered"] = set(ml.mesh_pass_names()) == {
         "mesh-spec", "collective-consistency", "donation-aliasing",
-        "device-footprint", "mesh-recompile-hazard"}
+        "device-footprint", "mesh-recompile-hazard",
+        "kern-capability"}
     # all red configs classify and the baseline (when present) agrees
     recs = ml.classify_red_tests()
     checks["red_configs_classified"] = (
