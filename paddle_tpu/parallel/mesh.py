@@ -18,7 +18,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
 __all__ = ["make_mesh", "local_mesh", "axis_size", "device_slices",
-           "P", "NamedSharding", "Mesh"]
+           "SliceAllocator", "P", "NamedSharding", "Mesh"]
 
 P = PartitionSpec
 
@@ -85,3 +85,112 @@ def device_slices(n, devices=None, reserve=0):
     slices = [rest[i * per:(i + 1) * per] for i in range(n)]
     slices[-1].extend(rest[n * per:])
     return reserved, slices
+
+
+class SliceAllocator:
+    """Ownership ledger over a device pool: `device_slices` with a
+    free/re-allocation API — the placement bookkeeping autoscaling
+    (`serving.scale`) needs that a one-shot partition cannot provide.
+
+    `alloc(width)` hands out a slice of `width` devices: EXCLUSIVE
+    while the free pool covers it, falling back to the same
+    wrap-around SHARING as `device_slices` when the pool is exhausted
+    (every device already owned — the single-device CPU shape).
+    `free(slc)` returns a slice's devices for reuse.
+
+    The subtlety free() must get right (the bug this class exists to
+    fix, regression-pinned in tests): a *shared* slice's devices are
+    aliases of devices some exclusive owner still holds. A naive
+    ledger that pushed them back into the free pool would let the next
+    `alloc` — especially one at a DIFFERENT width than the freed slice
+    — hand out a device twice, once "exclusively". Here sharing is
+    tracked per allocation: freeing a shared slice never feeds the
+    free pool, while freeing an exclusive slice returns exactly its
+    devices (original pool order preserved, so re-allocation keeps ICI
+    neighbors contiguous even at a different width)."""
+
+    def __init__(self, devices=None, reserve=0):
+        devices = list(devices if devices is not None
+                       else jax.devices())
+        if reserve < 0 or reserve > len(devices):
+            raise ValueError(
+                f"reserve={reserve} outside [0, {len(devices)}]")
+        self.reserved = devices[:reserve]
+        self.pool = devices[reserve:]
+        if not self.pool:
+            raise ValueError("no devices left to allocate after "
+                             f"reserve={reserve}")
+        self._order = {id(d): i for i, d in enumerate(self.pool)}
+        self._free = list(self.pool)
+        self._exclusive = []     # [set(id(dev))] live exclusive slices
+        self._shared = []        # [frozenset ids] live shared slices
+        self._wrap = 0           # rotation cursor for shared slices
+
+    # ------------------------------------------------------ accounting
+    def free_count(self):
+        """Devices available for an exclusive allocation."""
+        return len(self._free)
+
+    def can_alloc(self, width=1, shared_ok=False):
+        """Would `alloc(width)` succeed without sharing? (With
+        `shared_ok`, alloc never fails — this is the planner's device
+        ceiling probe.)"""
+        return shared_ok or len(self._free) >= max(1, int(width))
+
+    # ------------------------------------------------------ allocation
+    def alloc(self, width=1, shared_ok=False):
+        """Take `width` devices. Exclusive when the free pool covers
+        the request; wrap-around shared when it doesn't and
+        `shared_ok` — otherwise RuntimeError (the device ceiling)."""
+        width = max(1, int(width))
+        if len(self._free) >= width:
+            slc = self._free[:width]
+            del self._free[:width]
+            self._exclusive.append({id(d) for d in slc})
+            return slc
+        if not shared_ok:
+            raise RuntimeError(
+                f"device ceiling: want {width} device(s), "
+                f"{len(self._free)} free of {len(self.pool)}")
+        slc = [self.pool[(self._wrap + i) % len(self.pool)]
+               for i in range(width)]
+        self._wrap = (self._wrap + width) % len(self.pool)
+        self._shared.append(frozenset(id(d) for d in slc))
+        return slc
+
+    def adopt(self, slc):
+        """Register a slice allocated elsewhere (`device_slices` at
+        group construction) so this ledger can later free it. Devices
+        already owned mark the adoption shared — a wrapped
+        `device_slices` layout adopts as all-shared, so freeing it
+        never pollutes the pool."""
+        ids = {id(d) for d in slc}
+        if any(i not in self._order for i in ids):
+            raise ValueError("adopted slice holds devices outside "
+                             "this allocator's pool")
+        free_ids = {id(d) for d in self._free}
+        if ids <= free_ids:
+            self._free = [d for d in self._free if id(d) not in ids]
+            self._exclusive.append(ids)
+        else:
+            self._shared.append(frozenset(ids))
+        return slc
+
+    def free(self, slc):
+        """Release a slice. Exclusive devices rejoin the free pool in
+        stable pool order (reusable at any width); shared aliases are
+        just forgotten. Unknown slices raise — double-free is a bug,
+        not a no-op."""
+        ids = {id(d) for d in slc}
+        fids = frozenset(ids)
+        if fids in self._shared:
+            self._shared.remove(fids)
+            return 0
+        for owned in self._exclusive:
+            if owned == ids:
+                self._exclusive.remove(owned)
+                self._free.extend(slc)
+                self._free.sort(key=lambda d: self._order[id(d)])
+                return len(slc)
+        raise ValueError("free() of a slice this allocator never "
+                         "allocated (or already freed)")

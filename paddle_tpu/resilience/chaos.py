@@ -72,6 +72,14 @@ Faults and their injection points:
       crashes when it steps (and crashes AGAIN on every resubmission,
       because the tag rides the request). Proves the guard isolates a
       bad REQUEST without condemning the replicas it burns through.
+  ``traffic_spike:at=N,x=K[,len=M]``
+      point ``serving.request`` — load multiplier: starting at the
+      N-th farm submission and lasting ``len`` submissions (default
+      1), every real request is amplified by K-1 shadow copies routed
+      through the normal path, so queue depth and slot pressure see a
+      genuine Kx arrival burst (the tpuscale ramp driver — the
+      autoscaler must grow through it; overflow shadows are shed, real
+      traffic must not be).
 
 Counting: every point keeps a process-wide hit counter (1-based).
 ``at=N`` fires on hit N; ``times=K`` keeps firing through hit N+K-1;
@@ -110,12 +118,13 @@ POINTS = {
     "replica_slow": "serving.worker",
     "replica_flap": "serving.worker",
     "request_poison": "serving.request",
+    "traffic_spike": "serving.request",
     "rank_lost": "executor.step",
     "resize": "executor.step",
 }
 
 _INT_KNOBS = ("at", "times", "every", "byte", "seed", "step", "rank",
-              "to", "replica")
+              "to", "replica", "x", "len")
 _FLOAT_KNOBS = ("prob", "ms")
 
 
@@ -220,6 +229,17 @@ def _parse_fault(text):
             raise ChaosSpecError("resize needs to=M (the new world size)")
         if fault["to"] < 1:
             raise ChaosSpecError(f"resize: to={fault['to']} must be >= 1")
+    if name == "traffic_spike":
+        if "x" not in fault or fault["x"] < 2:
+            raise ChaosSpecError(
+                "traffic_spike needs x=K >= 2 (the load multiplier)")
+        # len=M is the burst length in submissions — times= in the
+        # shared counting machinery
+        if "len" in fault:
+            if fault["len"] < 1:
+                raise ChaosSpecError(
+                    f"traffic_spike: len={fault['len']} must be >= 1")
+            fault["times"] = fault.pop("len")
     if "prob" in fault:
         p = fault["prob"]
         if not 0.0 <= p <= 1.0:

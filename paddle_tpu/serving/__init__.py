@@ -24,9 +24,17 @@ and drive it over HTTP via the predict route's `max_new_tokens` /
 never attach a decoder never pay for it (pinned by the bench
 contract).
 
+Scale-out stacks on top, each tier opt-in and lazily imported (the
+bench contract pins that unused tiers are never even imported):
+`serving.farm` replicates the decode tier behind a least-loaded
+router, `serving.guard` adds overload defense (health probation,
+hedging, brownout), and `serving.scale` (tpuscale) closes the control
+loop — SLO-rule-driven grow/shrink of the replica group, shedding
+only at the device ceiling.
+
 `tools/tpuserve.py` is the CLI: serve a `save_inference_model` dir,
 load-test it (`--bench`, `--bench-decode`), or run the CI self-tests
-(`--selftest`, `--selftest-decode`).
+(`--selftest`, `--selftest-decode`, ... `--selftest-scale`).
 """
 from .batcher import (BatchConfig, DynamicBatcher, Future,
                       RejectedError, DeadlineExceeded, PreemptedError,

@@ -42,6 +42,16 @@ story rebuilt TPU-native:
   waiters block), so group warmup cost is per GROUP, not per replica
   — `ReplicaGroup.compile_count` pins the cache's build count.
 
+- **Elastic membership.** `add_replica` / `remove_replica` grow and
+  shrink the routed set mid-flight: a joining replica warms up through
+  the shared build cache (same config -> zero new compiles) and serves
+  the group's current weight version; a leaving replica drains to
+  empty first (the rolling-update discipline) and hands its device
+  slice back. Replica indices are monotonic — never reused — so
+  telemetry and health rows stay unambiguous across cycles. The
+  POLICY for when to do either lives above, in `serving.scale`
+  (tpuscale), which this module never imports.
+
 - **Overload defense (opt-in).** `FarmConfig(guard=GuardConfig(...))`
   attaches a `serving.guard.GroupGuard`: per-replica health probation
   / ejection / half-open probing consulted by the router, hedged
@@ -201,8 +211,9 @@ class FarmConfig:
             mc = model_config
             hid = getattr(mc, "hidden", None) or getattr(
                 mc, "d_model", 0)
-            layers = getattr(mc, "layers", None) or getattr(
-                mc, "n_layers", 0)
+            layers = (getattr(mc, "layers", None)
+                      or getattr(mc, "n_layers", None)
+                      or getattr(mc, "n_layer", 0))
             max_len = eng.max_len or getattr(mc, "max_len", 0)
             if hid and layers and max_len:
                 per_elem = 1 if eng.kv_quant == "int8" else 4
@@ -463,21 +474,13 @@ class ReplicaGroup:
         self.version = 1
         self._lock = threading.Lock()
         self._rate = {}          # index -> (t, tokens) goodput sample
+        self._params = params    # current weights (scale-up spawns)
+        self._started = False
+        self._next_index = 0     # monotonic: removed indices never reused
+        self.scale = None        # a ScaleController attaches itself here
         self.replicas = []
         for i in range(self.config.replicas):
-            engine = DecodeEngine(
-                model_cfg, params, config=self.config.engine,
-                device=slices[i][0],
-                prefill_device=(reserved[i % len(reserved)]
-                                if reserved else None),
-                build_cache=self.build_cache)
-            qos = self.config.qos_factory() \
-                if self.config.qos_factory else None
-            sched = ContinuousScheduler(
-                engine, qos=qos, config=self.config.decode,
-                name=f"{name}.r{i}", warmup=warmup)
-            sched.replica_index = i
-            self.replicas.append(Replica(i, engine, sched, slices[i]))
+            self._spawn_replica(slices[i], warmup=warmup)
         if _tm.enabled():
             _tm.gauge("serving.farm.replicas").set(len(self.replicas))
             _tm.gauge("serving.farm.compile_count").set(
@@ -492,15 +495,23 @@ class ReplicaGroup:
         replica), the satellite pin."""
         if self.build_cache is not None:
             return self.build_cache.builds
-        return sum(r.engine.compile_count for r in self.replicas)
+        return sum(r.engine.compile_count
+                   for r in list(self.replicas))
 
     @property
     def queued(self):
-        return sum(r.scheduler.queued for r in self.replicas)
+        return sum(r.scheduler.queued for r in list(self.replicas))
 
     @property
     def num_slots(self):
-        return sum(r.scheduler.pool.num_slots for r in self.replicas)
+        return sum(r.scheduler.pool.num_slots
+                   for r in list(self.replicas))
+
+    @property
+    def free_slots(self):
+        return sum(r.scheduler.pool.num_slots
+                   - r.scheduler.pool.active_count()
+                   for r in list(self.replicas))
 
     # ---------------------------------------------------------- serving
     def submit(self, src, src_len=None, tenant="default",
@@ -519,13 +530,38 @@ class ReplicaGroup:
         if _chaos.armed():
             # the serving.request chaos point: request_poison tags the
             # N-th farm submission; the tag rides resubmissions, so
-            # the request stays lethal wherever it lands
+            # the request stays lethal wherever it lands.
+            # traffic_spike amplifies this submission x-fold with
+            # shadow copies through the normal route — REAL queue and
+            # slot pressure, the tpuscale ramp driver.
             f = _chaos.hit("serving.request")
             if f is not None and f["name"] == "request_poison":
                 kwargs["poison"] = True
+            elif f is not None and f["name"] == "traffic_spike":
+                self._spike(kwargs, int(f.get("x", 2)))
         rep, fut = self._route(kwargs, exclude=())
         return GroupFuture(self, kwargs, rep, fut,
                            retries=self.config.retries)
+
+    def _spike(self, kwargs, x):
+        """Route x-1 shadow copies of a spiking request. Shadows are
+        fire-and-forget synthetic load: a full queue sheds them
+        (counted, never raised to the real caller) and nobody waits on
+        their futures — the scheduler retires them like any other
+        request."""
+        for j in range(max(0, x - 1)):
+            shadow = dict(kwargs)
+            rid = kwargs.get("request_id")
+            shadow["request_id"] = f"spike-{j}" if rid is None \
+                else f"{rid}.spike-{j}"
+            try:
+                self._route(shadow, exclude=())
+            except RejectedError:
+                if _tm.enabled():
+                    _tm.counter("serving.farm.spike_shed").inc()
+                continue
+            if _tm.enabled():
+                _tm.counter("serving.farm.spike_shadows").inc()
 
     def decode(self, src, timeout=None, **kw):
         """Blocking convenience: submit + wait -> DecodeResult."""
@@ -557,20 +593,145 @@ class ReplicaGroup:
         EVERY replica (tests and the selftest use this instead of the
         loop threads). Returns total active slots stepped."""
         stepped = 0
-        for r in self.replicas:
+        for r in list(self.replicas):
             stepped += r.scheduler.run_iteration()
         self._publish()
         return stepped
 
     # ------------------------------------------------------- lifecycle
     def start(self):
+        self._started = True
         for r in self.replicas:
             r.scheduler.start()
         return self
 
     def stop(self, drain=True, timeout=30.0):
+        self._started = False
         for r in self.replicas:
             r.scheduler.stop(drain=drain, timeout=timeout)
+
+    # ------------------------------------------------------- scaling
+    def _spawn_replica(self, devices, params=None, warmup=True):
+        """Build one replica on `devices` at the next monotonic index
+        and add it to the routed set. Indices are never reused —
+        telemetry/health rows stay unambiguous across grow/shrink
+        cycles. Shared-build-cache groups compile NOTHING new when a
+        same-config replica already warmed up (the scale-up pin)."""
+        params = self._params if params is None else params
+        with self._lock:
+            i = self._next_index
+            self._next_index += 1
+        engine = DecodeEngine(
+            self.model_cfg, params, config=self.config.engine,
+            device=devices[0],
+            prefill_device=(self.prefill_devices[
+                i % len(self.prefill_devices)]
+                if self.prefill_devices else None),
+            build_cache=self.build_cache)
+        qos = self.config.qos_factory() \
+            if self.config.qos_factory else None
+        sched = ContinuousScheduler(
+            engine, qos=qos, config=self.config.decode,
+            name=f"{self.name}.r{i}", warmup=warmup)
+        sched.replica_index = i
+        rep = Replica(i, engine, sched, devices)
+        rep.version = self.version
+        if self.guard is not None:
+            self.guard.on_replica_added(i)
+        with self._lock:
+            self.replicas.append(rep)
+        if self._started:
+            sched.start()
+        return rep
+
+    def add_replica(self, devices, params=None,
+                    checkpoint_dir=None, warmup=True):
+        """Grow the group by one replica serving the CURRENT weights
+        (or an explicit `params` dict / PR-11 `checkpoint_dir`) on the
+        given device slice. The new replica enters the routed set as
+        soon as its warmup lands; with a shared build cache and a
+        same-config sibling, warmup is all cache hits — zero new
+        compiles (`compile_count` unchanged). Returns the Replica.
+
+        This is the mechanism layer only: placement policy, the
+        pre-spawn verify gate, and WHEN to grow live in
+        `serving.scale` (never imported from here — bench-contract
+        pin)."""
+        if checkpoint_dir is not None:
+            if params is not None:
+                raise ValueError("pass params or checkpoint_dir, "
+                                 "not both")
+            params = load_checkpoint_params(checkpoint_dir)
+        rep = self._spawn_replica(list(devices), params=params,
+                                  warmup=warmup)
+        _LOG.info("farm %s: replica %d joined (now %d live)",
+                  self.name, rep.index, len(self.replicas))
+        if _tm.enabled():
+            _tm.counter("serving.farm.replicas_added").inc()
+            _tm.gauge("serving.farm.replicas").set(len(self.replicas))
+            _tm.gauge("serving.farm.compile_count").set(
+                self.compile_count)
+        self._publish()
+        return rep
+
+    def remove_replica(self, index=None, drain_timeout=30.0,
+                       poll_s=0.002, drive=False):
+        """Shrink the group by draining one replica to empty and
+        detaching it — zero dropped requests, same discipline as a
+        rolling update's per-replica drain. Picks the least-loaded
+        routable replica when `index` is None; refuses to remove the
+        last one (an autoscaler bug must not take the group dark).
+        `drive=True` pumps `run_iteration()` to drain (manual mode).
+        Returns the freed device slice for the caller's allocator."""
+        with self._lock:
+            if len(self.replicas) <= 1:
+                raise ValueError(
+                    f"farm {self.name!r}: refusing to remove the "
+                    f"last replica")
+            if index is None:
+                cands = [r for r in self.replicas if r.routable] \
+                    or list(self.replicas)
+                rep = min(cands,
+                          key=lambda r: (r.scheduler.queued
+                                         + r.scheduler.pool
+                                         .active_count()))
+            else:
+                match = [r for r in self.replicas
+                         if r.index == index]
+                if not match:
+                    raise ValueError(f"no replica with index {index}")
+                rep = match[0]
+            rep.draining = True     # router skips it from here on
+        self._publish()
+        try:
+            deadline = time.monotonic() + drain_timeout
+            while (rep.scheduler.pool.active_count() > 0
+                   or rep.scheduler.queued > 0):
+                if drive:
+                    self.run_iteration()
+                else:
+                    time.sleep(poll_s)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replica {rep.index} did not drain within "
+                        f"{drain_timeout}s for removal")
+            rep.scheduler.stop(drain=True, timeout=drain_timeout)
+        except Exception:
+            rep.draining = False    # failed removal: keep serving
+            self._publish()
+            raise
+        with self._lock:
+            self.replicas.remove(rep)
+        _LOG.info("farm %s: replica %d drained and released "
+                  "(now %d live)", self.name, rep.index,
+                  len(self.replicas))
+        if _tm.enabled():
+            _tm.counter("serving.farm.replicas_removed").inc()
+            _tm.gauge("serving.farm.replicas").set(len(self.replicas))
+            _tm.gauge(
+                f"serving.replica.{rep.index}.alive").set(0.0)
+        self._publish()
+        return rep.devices
 
     # -------------------------------------------------- rolling updates
     def rolling_update(self, params=None, checkpoint_dir=None,
@@ -597,7 +758,7 @@ class ReplicaGroup:
                       else self.version + 1)
         with _tm.span("serving.farm.rolling_update", farm=self.name,
                       version=version):
-            for r in self.replicas:
+            for r in list(self.replicas):
                 r.draining = True
                 self._publish()
                 try:
@@ -622,6 +783,7 @@ class ReplicaGroup:
                 _LOG.info("farm %s: replica %d now serving version %d",
                           self.name, r.index, version)
         self.version = version
+        self._params = params    # scale-up spawns serve this version
         self._publish()
         return version
 
@@ -638,7 +800,9 @@ class ReplicaGroup:
                                    for d in self.prefill_devices]}
         if self.guard is not None:
             out["guard"] = self.guard.stats()
-        for r in self.replicas:
+        if self.scale is not None:
+            out["scale"] = self.scale.stats()
+        for r in list(self.replicas):
             s = r.scheduler
             out["replicas"].append({
                 "index": r.index,
@@ -674,7 +838,7 @@ class ReplicaGroup:
     def _publish(self):
         if not _tm.enabled():
             return
-        for r in self.replicas:
+        for r in list(self.replicas):
             s = r.scheduler
             pre = f"serving.replica.{r.index}"
             _tm.gauge(f"{pre}.slots_in_use").set(

@@ -47,9 +47,11 @@ class BrownoutController:
         self._active = False
         self._entered_at = 0.0
         self._miss_ewma = 0.0
+        self._headroom = False
         self.entries = 0
         self.sheds = 0
         self.clamped = 0
+        self.deferred = 0
 
     # -------------------------------------------------------- signals
     def on_deadline_miss(self):
@@ -71,6 +73,23 @@ class BrownoutController:
         with self._lock:
             return self._active
 
+    # ------------------------------------------------------- headroom
+    def set_headroom(self, flag):
+        """tpuscale's demotion lever: while an autoscale controller
+        reports spare device capacity (`flag=True`), overload must be
+        answered by GROWING, not shedding — brownout ENTRY is deferred
+        (counted on `deferred`) until the controller reports the
+        device ceiling. Exit and already-active behavior are
+        untouched, and the flag defaults False — a group without a
+        controller sheds exactly as PR 14 shipped it."""
+        with self._lock:
+            self._headroom = bool(flag)
+
+    @property
+    def headroom(self):
+        with self._lock:
+            return self._headroom
+
     # ------------------------------------------------------ admission
     def observe(self, queue_depth):
         """Update the state machine against the current queue depth;
@@ -79,6 +98,14 @@ class BrownoutController:
             if not self._active:
                 if queue_depth >= self.queue_high \
                         or self._miss_ewma >= self.miss_high:
+                    if self._headroom:
+                        # scale-out beats brownout: the autoscaler has
+                        # free slices, let it absorb the surge
+                        self.deferred += 1
+                        if _tm.enabled():
+                            _tm.counter(
+                                "serving.guard.brownout_deferred").inc()
+                        return self._active
                     self._active = True
                     self._entered_at = self._clock()
                     self.entries += 1

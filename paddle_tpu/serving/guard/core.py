@@ -162,6 +162,17 @@ class GroupGuard:
     def on_deadline_miss(self):
         self.brownout.on_deadline_miss()
 
+    # ---------------------------------------------------- autoscaling
+    def on_replica_added(self, index):
+        """Scale-up joined a replica at `index`: grow the health table
+        so routing/recording never indexes past it."""
+        self.health.ensure(index + 1)
+
+    def set_scale_headroom(self, flag):
+        """tpuscale's shed-only-at-ceiling lever (see
+        BrownoutController.set_headroom)."""
+        self.brownout.set_headroom(flag)
+
     def on_cancelled(self):
         self.hedge_cancelled += 1
         if _tm.enabled():
@@ -226,6 +237,8 @@ class GroupGuard:
             "brownout": self.brownout.active,
             "brownout_entries": self.brownout.entries,
             "brownout_sheds": self.brownout.sheds,
+            "brownout_deferred": self.brownout.deferred,
+            "scale_headroom": self.brownout.headroom,
             "clamped": self.brownout.clamped,
             "p99_ms": None if p99 is None else round(p99, 3)}
 

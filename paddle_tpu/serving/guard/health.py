@@ -227,6 +227,19 @@ class HealthTracker:
                 self.probes += 1
                 self._count("probes")
 
+    # ----------------------------------------------------- membership
+    def ensure(self, num_replicas):
+        """Grow the tracked-replica table to cover indices up to
+        `num_replicas - 1` (autoscale scale-up: a fresh replica starts
+        HEALTHY with empty EWMAs). Shrinking keeps the rows — indices
+        are stable for a group's lifetime, and a later re-grow at the
+        same index inherits nothing because scale-up always mints a
+        NEW index."""
+        with self._lock:
+            while len(self._reps) < int(num_replicas):
+                self._reps.append(_ReplicaHealth(self.cooldown_base_s))
+            return len(self._reps)
+
     # ------------------------------------------------------ inspection
     def set_state(self, index, state):
         """Operator/test override (tpustat drain-style intervention)."""

@@ -1,0 +1,32 @@
+"""tpuscale: SLO-driven autoscaling for the serving farm.
+
+The control loop the rest of the serving stack was built for: tpuscope
+measures, tpuguard defends, tpuelastic re-shards, tpufarm routes — and
+this package turns the knob. A `ScaleController` watches the group's
+live signals through declarative `ScalePolicy` rules (tpuscope's
+SLO grammar plus ``-> up/down`` actions, cooldowns, dwell hysteresis)
+and a `ScalePlanner` executes verified transitions: grow through the
+SharedBuildCache onto ledgered device slices (zero new compiles),
+shrink by drain-then-release, shed only at the device ceiling.
+
+Minimal session::
+
+    from paddle_tpu.serving.scale import ScaleController, ScalePolicy
+
+    policy = ScalePolicy(
+        ["queue_per_replica > 6 -> up",
+         "free_slot_ratio > 0.8 -> down"],
+        min_replicas=1, max_replicas=4)
+    ctl = ScaleController(group, policy).start(interval_s=0.5)
+
+Strictly opt-in: a farm without a controller NEVER imports this
+package and routes byte-identically to PR 17 — pinned by the bench
+contract, like guard/farm/kern before it.
+"""
+from .controller import DECISION_CODES, ScaleController, ScaleDecision
+from .planner import ScalePlanner, ScalePlanRejected
+from .policy import SIGNALS, ScalePolicy, ScaleRule, parse_scale_rule
+
+__all__ = ["ScaleController", "ScaleDecision", "ScalePlanner",
+           "ScalePlanRejected", "ScalePolicy", "ScaleRule",
+           "parse_scale_rule", "SIGNALS", "DECISION_CODES"]

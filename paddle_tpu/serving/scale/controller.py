@@ -1,0 +1,295 @@
+"""ScaleController: the loop that closes tpuscope -> tpuguard ->
+tpufarm into traffic-proportional capacity.
+
+Each `tick()`:
+
+1. builds the signal snapshot (queue depth, deadline-miss EWMA,
+   goodput, free-slot ratio — `policy.SIGNALS`) from the live group,
+2. evaluates the policy's triggers under dwell + cooldown flap
+   control,
+3. hands "up"/"down" to the `ScalePlanner` (verify-gated grow through
+   the shared build cache / drain-then-release shrink),
+4. relays headroom to tpuguard: while another exclusive slice exists
+   below `max_replicas`, brownout entry is DEFERRED (scale-out beats
+   shedding); at the ceiling the deferral lifts and shedding is the
+   correct last resort,
+5. publishes `scale.*` telemetry for tpustat --fleet/--watch and the
+   fleet rollup.
+
+Drive it either way: `start(interval_s)` runs a daemon loop;
+`tick()` by hand is the deterministic mode every test and the
+`--selftest-scale` gate use (same discipline as the farm's
+run_iteration). Attaching sets `group.scale = self`, so
+`group.stats()` carries the controller's view without the farm ever
+importing this package.
+"""
+import threading
+import time
+
+from ... import telemetry as _tm
+from .planner import ScalePlanner, ScalePlanRejected
+from .policy import ScalePolicy
+
+__all__ = ["ScaleController", "ScaleDecision", "DECISION_CODES"]
+
+# gauge encoding for scale.last_decision (tpustat decodes it)
+DECISION_CODES = {"hold": 0.0, "up": 1.0, "down": 2.0, "ceiling": 3.0,
+                  "rejected": 4.0, "cooldown": 5.0}
+
+
+class ScaleDecision:
+    """One tick's verdict: what happened and why."""
+
+    __slots__ = ("action", "reason", "rule", "target", "live",
+                 "at_ceiling")
+
+    def __init__(self, action, reason, rule=None, target=None,
+                 live=None, at_ceiling=False):
+        self.action = action        # a DECISION_CODES key
+        self.reason = reason        # human-readable why
+        self.rule = rule            # policy rule index, or None
+        self.target = target
+        self.live = live
+        self.at_ceiling = at_ceiling
+
+    def to_dict(self):
+        return {"action": self.action, "reason": self.reason,
+                "rule": self.rule, "target": self.target,
+                "live": self.live, "at_ceiling": self.at_ceiling}
+
+    def __repr__(self):
+        return (f"ScaleDecision({self.action}, {self.reason!r}, "
+                f"live={self.live}->{self.target})")
+
+
+class ScaleController:
+    """SLO-driven autoscaler for one ReplicaGroup."""
+
+    def __init__(self, group, policy, planner=None,
+                 clock=time.monotonic):
+        if not isinstance(policy, ScalePolicy):
+            policy = ScalePolicy(policy)
+        self.group = group
+        self.policy = policy
+        self.planner = planner or ScalePlanner(group)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self._cooldown_until = 0.0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last = ScaleDecision("hold", "no tick yet",
+                                   live=len(group.replicas),
+                                   target=len(group.replicas))
+        self.ticks = 0
+        self.decisions = {"up": 0, "down": 0, "hold": 0,
+                          "ceiling": 0, "rejected": 0, "cooldown": 0}
+        group.scale = self          # farm stats pick this up, no import
+
+    # -------------------------------------------------------- signals
+    def signals(self):
+        """The fleet-shaped snapshot policy conditions read (see
+        policy.SIGNALS for the vocabulary)."""
+        g = self.group
+        live = len(g.replicas)
+        slots = g.num_slots
+        free = g.free_slots
+        miss = 0.0
+        if g.guard is not None:
+            miss = g.guard.brownout.miss_ewma
+        goodput = 0.0
+        for r in list(g.replicas):
+            goodput += g._goodput(r)
+        return {
+            "queue_depth": float(g.queued),
+            "queue_per_replica": g.queued / max(1, live),
+            "free_slot_ratio": free / max(1, slots),
+            "miss_ewma": float(miss),
+            "goodput_tps": float(goodput),
+            "replicas": float(live),
+        }
+
+    # ----------------------------------------------------------- tick
+    def tick(self, drive=False):
+        """One evaluate-and-maybe-act pass. Returns the
+        ScaleDecision. `drive=True` pumps the group's run_iteration
+        while a shrink drains (manual/deterministic mode)."""
+        sig = self.signals()
+        live = len(self.group.replicas)
+        now = self._clock()
+        self.ticks += 1
+        up_i, up_rule = self.policy.first_triggered("up", sig)
+        down_i, down_rule = self.policy.first_triggered("down", sig)
+        with self._lock:
+            self._up_streak = self._up_streak + 1 \
+                if up_rule is not None else 0
+            # an up-trigger vetoes any down-dwell in progress
+            self._down_streak = self._down_streak + 1 \
+                if down_rule is not None and up_rule is None else 0
+            up_streak, down_streak = self._up_streak, self._down_streak
+            cooling = now < self._cooldown_until
+
+        decision = None
+        if up_rule is not None and up_streak >= self.policy.up_dwell:
+            decision = self._try_grow(up_i, up_rule, live, cooling)
+        elif down_rule is not None \
+                and down_streak >= self.policy.down_dwell:
+            decision = self._try_shrink(down_i, down_rule, live,
+                                        cooling, drive)
+        if decision is None:
+            decision = ScaleDecision(
+                "hold", "no trigger", live=live, target=live,
+                at_ceiling=self._ceiling(live))
+        self._settle(decision)
+        return decision
+
+    def _ceiling(self, live):
+        """At the ceiling = the next grow is impossible, by policy
+        bound or by physical device exhaustion."""
+        return (live >= self.policy.max_replicas
+                or self.planner.at_ceiling())
+
+    def _try_grow(self, i, rule, live, cooling):
+        target = min(live + rule.step, self.policy.max_replicas)
+        if cooling and target > live:
+            return ScaleDecision(
+                "cooldown", f"up trigger {rule.text!r} held by "
+                f"cooldown", rule=i, live=live, target=live,
+                at_ceiling=self._ceiling(live))
+        if target <= live or self.planner.at_ceiling():
+            # wanted to grow, can't: THE ceiling moment — brownout
+            # deferral lifts (see _settle -> headroom False)
+            return ScaleDecision(
+                "ceiling", f"up trigger {rule.text!r} at the "
+                f"device ceiling (live={live}, "
+                f"max={self.policy.max_replicas}, free="
+                f"{self.planner.free_devices()})", rule=i,
+                live=live, target=live, at_ceiling=True)
+        try:
+            self.planner.grow(target - live)
+        except ScalePlanRejected as e:
+            return ScaleDecision(
+                "rejected", f"grow to {target} rejected: {e}",
+                rule=i, live=live, target=live,
+                at_ceiling=e.reason == "ceiling")
+        with self._lock:
+            self._cooldown_until = (self._clock()
+                                    + self.policy.up_cooldown_s)
+            self._up_streak = 0
+        return ScaleDecision(
+            "up", f"{rule.text!r} grew {live}->{target}", rule=i,
+            live=len(self.group.replicas), target=target,
+            at_ceiling=self._ceiling(target))
+
+    def _try_shrink(self, i, rule, live, cooling, drive):
+        target = max(live - rule.step, self.policy.min_replicas)
+        if target >= live:
+            return None            # already at the floor: plain hold
+        if cooling:
+            return ScaleDecision(
+                "cooldown", f"down trigger {rule.text!r} held by "
+                f"cooldown", rule=i, live=live, target=live,
+                at_ceiling=self._ceiling(live))
+        self.planner.shrink(live - target, drive=drive)
+        with self._lock:
+            self._cooldown_until = (self._clock()
+                                    + self.policy.down_cooldown_s)
+            self._down_streak = 0
+        live_now = len(self.group.replicas)
+        return ScaleDecision(
+            "down", f"{rule.text!r} shrank {live}->{live_now}",
+            rule=i, live=live_now, target=target,
+            at_ceiling=self._ceiling(live_now))
+
+    def _settle(self, decision):
+        """Bookkeeping every tick ends with: decision counters, the
+        guard headroom relay, telemetry."""
+        with self._lock:
+            self._last = decision
+        self.decisions[decision.action] = \
+            self.decisions.get(decision.action, 0) + 1
+        if self.group.guard is not None:
+            # headroom == another slice exists below the ceiling;
+            # False exactly when the planner/policy report the ceiling
+            self.group.guard.set_scale_headroom(
+                not decision.at_ceiling)
+        self.publish(decision)
+
+    # ------------------------------------------------------ loop mode
+    def start(self, interval_s=0.5):
+        """Background control loop (daemon). Manual tick() keeps
+        working — the lock serializes transitions."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.tick()
+                except Exception:       # noqa: BLE001 — keep looping
+                    import logging
+                    logging.getLogger(
+                        "paddle_tpu.serving.scale").exception(
+                        "scale tick failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="tpuscale", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout)
+
+    # ------------------------------------------------------ telemetry
+    def cooldown_remaining_s(self):
+        with self._lock:
+            return max(0.0, self._cooldown_until - self._clock())
+
+    @property
+    def last_decision(self):
+        with self._lock:
+            return self._last
+
+    def stats(self):
+        last = self.last_decision
+        return {"policy": self.policy.describe(),
+                "ticks": self.ticks,
+                "decisions": dict(self.decisions),
+                "live_replicas": len(self.group.replicas),
+                "target_replicas": last.target,
+                "last": last.to_dict(),
+                "cooldown_remaining_s": round(
+                    self.cooldown_remaining_s(), 3),
+                "planner": self.planner.stats()}
+
+    def publish(self, decision=None):
+        if not _tm.enabled():
+            return
+        last = decision or self.last_decision
+        _tm.gauge("scale.live_replicas").set(
+            float(len(self.group.replicas)))
+        _tm.gauge("scale.target_replicas").set(
+            float(last.target if last.target is not None
+                  else len(self.group.replicas)))
+        _tm.gauge("scale.last_decision").set(
+            DECISION_CODES.get(last.action, 0.0))
+        _tm.gauge("scale.last_rule").set(
+            -1.0 if last.rule is None else float(last.rule))
+        _tm.gauge("scale.at_ceiling").set(
+            1.0 if last.at_ceiling else 0.0)
+        _tm.gauge("scale.cooldown_remaining_s").set(
+            self.cooldown_remaining_s())
+        _tm.gauge("scale.free_devices").set(
+            float(self.planner.free_devices()))
+        _tm.counter("scale.ticks").inc()
+        if last.action in ("up", "down"):
+            _tm.counter(f"scale.{last.action}s").inc()
+            _tm.instant_event(
+                "scale.transition", farm=self.group.name,
+                action=last.action, reason=last.reason,
+                live=len(self.group.replicas))

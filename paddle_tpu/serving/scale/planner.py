@@ -1,0 +1,167 @@
+"""ScalePlanner: decisions -> safe transitions on a live ReplicaGroup.
+
+The controller says "grow" or "shrink"; this class owns HOW:
+
+- **grow** re-runs the farm's pre-spawn verify gate at the NEW count
+  (`FarmConfig.verify` -> meshlint device-footprint pass, so a plan
+  whose per-replica KV bytes exceed ``PADDLE_TPU_DEVICE_MEM_CAP`` is
+  rejected with the same typed diagnostics a bad static config gets),
+  takes a device slice from the `SliceAllocator` ledger, and calls
+  `group.add_replica` — which warms the new replica through the
+  SharedBuildCache, so a same-config grow compiles nothing new.
+  Weights default to the group's current version; a PR-11
+  topology-independent `checkpoint_dir` spawns from disk instead.
+- **shrink** drains the least-loaded replica to empty through the
+  group's rolling-update discipline (`group.remove_replica`) and
+  returns its slice to the ledger for the next grow.
+- **ceiling** (`at_ceiling`) is the physical truth the controller
+  relays to tpuguard: no free devices for another exclusive slice (or
+  the policy max). Below it, brownout entry is deferred — scale-out
+  beats shedding; at it, shedding is correct and allowed.
+
+The allocator ledger is seeded lazily from the group's own slices
+(`adopt`), so an unscaled group never constructs one — and a
+wrap-shared CPU layout adopts as shared, keeping free() honest.
+"""
+import threading
+
+from ...parallel.mesh import SliceAllocator
+
+__all__ = ["ScalePlanner", "ScalePlanRejected"]
+
+
+class ScalePlanRejected(RuntimeError):
+    """A grow plan failed the pre-spawn gate (footprint over the
+    device cap, no devices free, or policy bounds). `.reason` is the
+    short machine tag, the message carries the diagnostics."""
+
+    def __init__(self, reason, detail=""):
+        self.reason = reason
+        super().__init__(detail or reason)
+
+
+class ScalePlanner:
+    """Transition executor for one ReplicaGroup."""
+
+    def __init__(self, group, devices=None, width=None, verify=True,
+                 checkpoint_dir=None):
+        self.group = group
+        self.verify = bool(verify)
+        self.checkpoint_dir = checkpoint_dir
+        self._lock = threading.Lock()
+        self._alloc = None
+        self._devices = devices     # explicit universe (default: the
+        self._width = width         # group's), slice width (default:
+        self.grows = 0              # the group's existing width)
+        self.shrinks = 0
+        self.rejections = 0
+
+    # ------------------------------------------------------ allocator
+    def _allocator(self):
+        """Build the ledger on first use: universe = the group's
+        device config (or an explicit list), minus the prefill
+        reserve; existing replica slices are adopted so shrink can
+        free them."""
+        with self._lock:
+            if self._alloc is not None:
+                return self._alloc
+            devices = self._devices
+            if devices is None:
+                devices = self.group.config.devices
+            alloc = SliceAllocator(
+                devices=devices,
+                reserve=len(self.group.prefill_devices))
+            for r in list(self.group.replicas):
+                alloc.adopt(r.devices)
+            if self._width is None:
+                widths = [len(r.devices)
+                          for r in list(self.group.replicas)]
+                self._width = max(1, min(widths) if widths else 1)
+            self._alloc = alloc
+            return alloc
+
+    @property
+    def width(self):
+        self._allocator()
+        return self._width
+
+    # -------------------------------------------------------- ceiling
+    def at_ceiling(self, extra=1):
+        """No room for `extra` more exclusive slices: the physical
+        device ceiling (policy bounds are the controller's job). THIS
+        is the signal that flips brownout from deferred to allowed."""
+        alloc = self._allocator()
+        return alloc.free_count() < self.width * extra
+
+    def free_devices(self):
+        return self._allocator().free_count()
+
+    # ----------------------------------------------------------- grow
+    def grow(self, n=1, params=None, checkpoint_dir=None):
+        """Spawn `n` replicas. Verify-gate first, allocate second,
+        spawn third — a rejected plan changes nothing. Returns the new
+        Replica list; raises ScalePlanRejected on gate failure or
+        device exhaustion."""
+        alloc = self._allocator()
+        group = self.group
+        if self.verify:
+            import copy
+            probe = copy.copy(group.config)
+            probe.replicas = len(group.replicas) + int(n)
+            try:
+                probe.verify(
+                    devices=list(alloc.reserved) + list(alloc.pool),
+                    model_config=group.model_cfg,
+                    raise_on_error=True)
+            except Exception as e:
+                self.rejections += 1
+                raise ScalePlanRejected(
+                    "verify", f"pre-spawn gate rejected the grow to "
+                    f"{probe.replicas} replicas: {e}") from e
+        if alloc.free_count() < self.width * int(n):
+            self.rejections += 1
+            raise ScalePlanRejected(
+                "ceiling", f"device ceiling: want {n} slice(s) of "
+                f"width {self.width}, only {alloc.free_count()} "
+                f"device(s) free")
+        new = []
+        ckpt = checkpoint_dir if checkpoint_dir is not None \
+            else self.checkpoint_dir
+        for _ in range(int(n)):
+            slc = alloc.alloc(self.width)
+            try:
+                rep = group.add_replica(
+                    slc, params=params,
+                    checkpoint_dir=None if params is not None
+                    else ckpt)
+            except Exception:
+                alloc.free(slc)     # failed spawn leaks no devices
+                raise
+            new.append(rep)
+            self.grows += 1
+        return new
+
+    # --------------------------------------------------------- shrink
+    def shrink(self, n=1, drain_timeout=30.0, drive=False):
+        """Drain-then-release `n` replicas; freed slices rejoin the
+        ledger. Returns the number actually removed (the group refuses
+        to drop below one)."""
+        alloc = self._allocator()
+        removed = 0
+        for _ in range(int(n)):
+            if len(self.group.replicas) <= 1:
+                break
+            devices = self.group.remove_replica(
+                drain_timeout=drain_timeout, drive=drive)
+            alloc.free(devices)
+            self.shrinks += 1
+            removed += 1
+        return removed
+
+    def stats(self):
+        alloc = self._allocator()
+        return {"grows": self.grows, "shrinks": self.shrinks,
+                "rejections": self.rejections,
+                "free_devices": alloc.free_count(),
+                "slice_width": self.width,
+                "at_ceiling": self.at_ceiling()}

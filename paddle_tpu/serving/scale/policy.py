@@ -1,0 +1,154 @@
+"""ScalePolicy: tpuscope's SLO-rule grammar, extended with actions.
+
+A tpuscope rule (`telemetry.slo`) is an assertion — ``"step_ms.p99 <
+250"`` PASSES or FAILS. A scale rule is a *trigger*: the same
+``metric[.stat] OP value`` condition syntax, plus an arrow naming what
+to do when the condition HOLDS::
+
+    "queue_per_replica > 6 -> up"        # grow by 1
+    "queue_per_replica > 20 -> up:2"     # grow by 2 (steeper surge)
+    "free_slot_ratio > 0.8 -> down"      # shrink by 1
+
+Conditions are evaluated against the controller's signal snapshot —
+the fleet-merge-shaped dict of serving signals (`SIGNALS` below), not
+the raw metric registry, so a policy reads the same whether the
+controller watches a live group or a fleet report.
+
+Flap control is structural, not advisory:
+
+- **hysteresis bands** — up and down conditions are separate rules,
+  so the quiet band between their thresholds is explicit in the
+  policy text;
+- **dwell (consecutive-evaluation) hysteresis** — `up_dwell` /
+  `down_dwell` require a rule to hold for that many *consecutive*
+  controller ticks before acting (down defaults to 3: growing is
+  urgent, shrinking is a savings optimization that can wait);
+- **cooldowns** — `up_cooldown_s` / `down_cooldown_s` freeze further
+  action after a transition so its effect is measurable before the
+  next decision;
+- **bounds** — `min_replicas` / `max_replicas` clamp every target;
+  `max_replicas` is the policy's share of the device ceiling (the
+  planner may report a lower, physical one).
+"""
+from ...telemetry.slo import _OPS, parse_rule
+
+__all__ = ["ScaleRule", "ScalePolicy", "SIGNALS", "parse_scale_rule"]
+
+# the signal vocabulary scale conditions are written against; the
+# controller builds this snapshot each tick (see
+# ScaleController.signals)
+SIGNALS = {
+    "queue_depth": "total queued requests across the group",
+    "queue_per_replica": "queue_depth / live replicas",
+    "free_slot_ratio": "free decode slots / total slots (0..1)",
+    "miss_ewma": "deadline-miss EWMA from the guard's brownout "
+                 "controller (0 without a guard)",
+    "goodput_tps": "group tokens/s (sum of per-replica goodput)",
+    "replicas": "live replica count",
+}
+
+
+class ScaleRule:
+    """One parsed trigger: a tpuscope condition + an action."""
+
+    __slots__ = ("text", "rule", "action", "step")
+
+    def __init__(self, text, rule, action, step):
+        self.text = text
+        self.rule = rule          # telemetry.slo.Rule (the condition)
+        self.action = action      # "up" | "down"
+        self.step = step          # replicas per firing
+
+    def triggered(self, signals):
+        """Does the condition HOLD against this snapshot? Missing
+        signals never trigger (a policy watching guard-only signals
+        stays quiet on a guardless group)."""
+        val = signals.get(self.rule.metric)
+        if val is None:
+            return False
+        return _OPS[self.rule.op](float(val) * self.rule.scale,
+                                  self.rule.threshold)
+
+    def __repr__(self):
+        return f"ScaleRule({self.text!r})"
+
+
+def parse_scale_rule(text):
+    """``"cond -> up[:step]"`` -> ScaleRule. The condition half reuses
+    `telemetry.slo.parse_rule` verbatim — one grammar, two engines."""
+    cond, sep, act = text.partition("->")
+    if not sep:
+        raise ValueError(
+            f"bad scale rule {text!r}: want 'metric[.stat] OP value "
+            f"-> up|down[:step]'")
+    act = act.strip()
+    action, _, step_s = act.partition(":")
+    action = action.strip()
+    if action not in ("up", "down"):
+        raise ValueError(
+            f"bad scale rule {text!r}: action {action!r} not in "
+            f"('up', 'down')")
+    try:
+        step = int(step_s) if step_s.strip() else 1
+    except ValueError:
+        raise ValueError(
+            f"bad scale rule {text!r}: step {step_s!r} is not an int")
+    if step < 1:
+        raise ValueError(
+            f"bad scale rule {text!r}: step must be >= 1")
+    rule = parse_rule(cond)
+    if rule.stat != "value":
+        raise ValueError(
+            f"bad scale rule {text!r}: scale signals are scalars "
+            f"(no .{rule.stat} statistics)")
+    return ScaleRule(text.strip(), rule, action, step)
+
+
+class ScalePolicy:
+    """The declarative half of tpuscale: triggers + flap control.
+
+    rules: scale-rule strings (or ScaleRule objects). Up rules are
+        checked first and win ties — under pressure, growing beats
+        shrinking.
+    min_replicas / max_replicas: hard bounds on every target.
+    up_cooldown_s / down_cooldown_s: freeze after a grow / shrink.
+    up_dwell / down_dwell: consecutive triggering ticks required
+        before acting.
+    """
+
+    def __init__(self, rules, min_replicas=1, max_replicas=4,
+                 up_cooldown_s=5.0, down_cooldown_s=30.0,
+                 up_dwell=1, down_dwell=3):
+        self.rules = [r if isinstance(r, ScaleRule)
+                      else parse_scale_rule(r) for r in rules]
+        if not self.rules:
+            raise ValueError("a ScalePolicy needs at least one rule")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}..{self.max_replicas}")
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self.up_dwell = int(up_dwell)
+        self.down_dwell = int(down_dwell)
+        if self.up_dwell < 1 or self.down_dwell < 1:
+            raise ValueError("dwell counts must be >= 1")
+
+    def first_triggered(self, action, signals):
+        """(rule_index, ScaleRule) of the first `action` rule whose
+        condition holds, or (None, None)."""
+        for i, sr in enumerate(self.rules):
+            if sr.action == action and sr.triggered(signals):
+                return i, sr
+        return None, None
+
+    def describe(self):
+        return {"rules": [sr.text for sr in self.rules],
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "up_cooldown_s": self.up_cooldown_s,
+                "down_cooldown_s": self.down_cooldown_s,
+                "up_dwell": self.up_dwell,
+                "down_dwell": self.down_dwell}

@@ -657,6 +657,15 @@ class FleetCollector:
                         and ent.get("kind") != "histogram":
                     serving_guard[name[len("serving.guard."):]] = \
                         ent["value"]
+            # autoscaler (serving/scale): scale.* controller gauges →
+            # one flat dict per rank (target/live replicas, last
+            # decision code, cooldown — the tpustat scale line)
+            serving_scale = {}
+            for name, ent in m.items():
+                if name.startswith("scale.") \
+                        and ent.get("kind") != "histogram":
+                    serving_scale[name[len("scale."):]] = \
+                        ent["value"]
             per_rank[str(r)] = {
                 "steps": h["count"] if h else 0,
                 "step_seconds_mean": (h["sum"] / h["count"])
@@ -683,6 +692,7 @@ class FleetCollector:
                 "embed_tables": embed_tables,
                 "serving_replicas": serving_replicas,
                 "serving_guard": serving_guard,
+                "serving_scale": serving_scale,
                 "serving_tokens_total": sum(
                     int(d.get("tokens_total", 0))
                     for d in serving_replicas.values()),

@@ -234,6 +234,62 @@ def test_guard_off_paths_untouched():
     assert "GUARD_OFF_OK" in p.stdout
 
 
+def test_scale_off_paths_untouched():
+    """tpuscale's off contract (the bench-contract pin): a farm with
+    no ScalePolicy never imports the serving.scale package — no
+    controller, no planner, no allocator ledger — and the ReplicaGroup
+    serve path behaves exactly as the static PR 17 farm (group.scale
+    stays None, stats() carries no scale section)."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu.core import framework as fw\n"
+        "from paddle_tpu.models import transformer as tfm\n"
+        "from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup\n"
+        "from paddle_tpu.serving.decode import (DecodeConfig,"
+        " DecodeEngineConfig)\n"
+        "cfg = tfm.TransformerConfig(src_vocab=16, trg_vocab=16,"
+        " max_len=8, d_model=8, d_inner=16, n_head=2, n_layer=1,"
+        " dropout=0.0, label_smooth_eps=0.0)\n"
+        "infer, start = fw.Program(), fw.Program()\n"
+        "with pt.program_guard(infer, start):\n"
+        "    with pt.unique_name.guard():\n"
+        "        tfm.build_infer_program(cfg, maxlen=8)\n"
+        "pt.Executor(pt.CPUPlace()).run(start)\n"
+        "scope = pt.global_scope()\n"
+        "params = {v.name: np.asarray(scope.get(v.name))"
+        " for v in infer.persistable_vars()}\n"
+        "group = ReplicaGroup(cfg, params, FarmConfig(replicas=2,"
+        " engine=DecodeEngineConfig(num_slots=2, max_len=8,"
+        " prefill_buckets=(1, 2)),"
+        " decode=DecodeConfig(bos=0)), name='static')\n"
+        "assert group.scale is None, "
+        "'a controller-less group grew a scale hook'\n"
+        "fut = group.submit(np.arange(2, 6).astype('int64'),"
+        " src_len=4, max_new_tokens=3)\n"
+        "for _ in range(60):\n"
+        "    if fut.done():\n"
+        "        break\n"
+        "    group.run_iteration()\n"
+        "assert len(fut.result(timeout=0).tokens) == 3\n"
+        "assert 'scale' not in group.stats(), "
+        "'stats() must not carry a scale section without a controller'\n"
+        "assert 'paddle_tpu.serving.scale' not in sys.modules, "
+        "'an unconfigured farm imported the scale package'\n"
+        "assert 'paddle_tpu.serving.scale.controller' not in"
+        " sys.modules\n"
+        "assert 'paddle_tpu.serving.scale.planner' not in"
+        " sys.modules\n"
+        "print('SCALE_OFF_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=REPO)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-1200:])
+    assert "SCALE_OFF_OK" in p.stdout
+
+
 def test_sparse_engine_off_paths_untouched():
     """tpusparse's off contract (the bench-contract pin): without a
     distributed table — or with one but no sparse= opt-in — the engine

@@ -154,6 +154,29 @@ def test_chaos_spec_grammar():
     assert chaos.parse_spec("") == []
 
 
+def test_chaos_traffic_spike_grammar():
+    """traffic_spike multiplies serving load: x=K (>= 2) is required,
+    len=M (the burst length in submissions) maps onto the shared
+    times= counting machinery."""
+    f, = chaos.parse_spec("traffic_spike:at=3,x=5,len=6")
+    assert f["name"] == "traffic_spike"
+    assert f["point"] == "serving.request"
+    assert f["at"] == 3 and f["x"] == 5
+    assert f["times"] == 6 and "len" not in f
+    # x defaults to nothing: it is required, and must be >= 2
+    for bad in ("traffic_spike:at=1", "traffic_spike:at=1,x=1",
+                "traffic_spike:x=2,len=0"):
+        with pytest.raises(chaos.ChaosSpecError):
+            chaos.parse_spec(bad)
+    # the counting machinery fires it like any burst fault
+    chaos.configure("traffic_spike:at=2,x=3,len=2")
+    hits = [chaos.hit("serving.request") for _ in range(5)]
+    chaos.reset()
+    assert [h is not None for h in hits] == [False, True, True, False,
+                                            False]
+    assert hits[1]["x"] == 3
+
+
 def test_chaos_counting_is_deterministic():
     chaos.configure("spool_drop:prob=0.5,seed=7")
     pattern1 = [chaos.hit("fleet.spool") is not None

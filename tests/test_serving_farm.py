@@ -20,7 +20,7 @@ import paddle_tpu as pt
 from paddle_tpu import telemetry as tm
 from paddle_tpu.core import framework as fw
 from paddle_tpu.models import transformer as tfm
-from paddle_tpu.parallel.mesh import device_slices
+from paddle_tpu.parallel.mesh import SliceAllocator, device_slices
 from paddle_tpu.resilience import chaos
 from paddle_tpu.resilience.chaos import ChaosFault
 from paddle_tpu.serving import ModelServer, HttpFrontend
@@ -149,6 +149,81 @@ def test_device_slices_leftovers_and_wraparound():
         device_slices(0, devices=[0])
     with pytest.raises(ValueError):
         device_slices(1, devices=[])
+
+
+def test_slice_allocator_exclusive_alloc_free_cycle():
+    """alloc carves the pool front-to-back; free returns exactly the
+    freed devices in stable pool order, reusable at ANY width."""
+    devs = [object() for _ in range(6)]
+    al = SliceAllocator(devices=devs, reserve=2)
+    assert al.reserved == devs[:2] and al.free_count() == 4
+    a = al.alloc(2)
+    b = al.alloc(1)
+    assert a == devs[2:4] and b == [devs[4]]
+    assert al.free_count() == 1 and not al.can_alloc(2)
+    al.free(a)
+    # the freed width-2 slice re-requested at width 1, three times:
+    # exactly the freed devices come back, pool order preserved
+    assert al.free_count() == 3
+    assert al.alloc(1) == [devs[2]]
+    assert al.alloc(1) == [devs[3]]
+    assert al.alloc(1) == [devs[5]]
+    with pytest.raises(RuntimeError, match="device ceiling"):
+        al.alloc(1)
+
+
+def test_slice_allocator_shared_free_never_pollutes_pool():
+    """THE regression pin: freeing a wrap-around SHARED slice must
+    not feed its devices (aliases of an exclusive owner's) back into
+    the free pool — a later alloc at a different width must hit the
+    ceiling, not hand a device out twice."""
+    devs = [object() for _ in range(2)]
+    al = SliceAllocator(devices=devs)
+    own = al.alloc(2)               # exclusive: the whole pool
+    sh = al.alloc(1, shared_ok=True)
+    assert sh[0] in devs            # an alias of an owned device
+    assert al.free_count() == 0
+    assert al.free(sh) == 0         # shared: forgotten, NOT pooled
+    assert al.free_count() == 0
+    with pytest.raises(RuntimeError, match="device ceiling"):
+        al.alloc(1)                 # different width than the owner's
+    assert al.free(own) == 2
+    assert al.free_count() == 2
+    # identical shared slices are tracked per allocation, not merged
+    al2 = SliceAllocator(devices=devs[:1])
+    e = al2.alloc(1)
+    s1 = al2.alloc(1, shared_ok=True)
+    s2 = al2.alloc(1, shared_ok=True)
+    assert al2.free(s1) == 0 and al2.free(s2) == 0
+    assert al2.free(e) == 1
+    with pytest.raises(ValueError):
+        al2.free(e)                 # double-free is a bug, not a no-op
+
+
+def test_slice_allocator_adopts_wrapped_layouts_as_shared():
+    """Adopting a group's construction-time device_slices layout:
+    disjoint slices adopt exclusive; a wrapped (sharing) layout
+    adopts all-shared so freeing never yields phantom capacity."""
+    devs = [object() for _ in range(4)]
+    _, slices = device_slices(2, devices=devs)
+    al = SliceAllocator(devices=devs)
+    for s in slices:
+        al.adopt(s)
+    assert al.free_count() == 0
+    al.free(slices[0])
+    assert al.free_count() == 2
+    # wrapped: 3 width-2 slices over 4 devices share
+    one = [object()]
+    al1 = SliceAllocator(devices=one)
+    _, wrapped = device_slices(2, devices=one)
+    assert wrapped == [one, one]
+    al1.adopt(wrapped[0])           # exclusive (pool was free)
+    al1.adopt(wrapped[1])           # alias -> shared
+    assert al1.free_count() == 0
+    al1.free(wrapped[1])
+    assert al1.free_count() == 0    # no phantom device
+    with pytest.raises(ValueError):
+        al1.adopt([object()])       # outside the pool
 
 
 # --------------------------------------------------- shared build cache

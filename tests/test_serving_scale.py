@@ -1,0 +1,432 @@
+"""tpuscale: the SLO-driven autoscaling control loop — scale-rule
+grammar (tpuscope conditions + up/down actions), controller dwell /
+cooldown / hysteresis flap control against a fake planner, real-group
+grow-through-the-build-cache (zero recompiles, monotonic indices),
+drain-then-release shrink, the meshlint verify gate on grows
+(PADDLE_TPU_DEVICE_MEM_CAP), brownout deferral while headroom exists,
+fleet rollup + tpustat rendering of scale.* telemetry, and the
+tpuserve --selftest-scale subprocess CI gate."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import telemetry as tm
+from paddle_tpu.core import framework as fw
+from paddle_tpu.models import transformer as tfm
+from paddle_tpu.serving.decode import DecodeConfig, DecodeEngineConfig
+from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup
+from paddle_tpu.serving.scale import (DECISION_CODES, ScaleController,
+                                      ScalePlanner, ScalePlanRejected,
+                                      ScalePolicy, parse_scale_rule)
+from paddle_tpu.telemetry import fleet as tf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    tm.disable()
+    tm.reset()
+    tf._reset_for_tests()
+    yield
+    tm.disable()
+    tm.reset()
+    tf._reset_for_tests()
+
+
+# ---------------------------------------------------------------- grammar
+def test_parse_scale_rule_grammar():
+    r = parse_scale_rule("queue_per_replica > 6 -> up")
+    assert r.action == "up" and r.step == 1
+    assert r.rule.metric == "queue_per_replica"
+    assert r.triggered({"queue_per_replica": 7.0})
+    assert not r.triggered({"queue_per_replica": 6.0})
+    assert not r.triggered({})          # missing signal never fires
+    r2 = parse_scale_rule("queue_depth >= 20 -> up:2")
+    assert r2.step == 2
+    r3 = parse_scale_rule("free_slot_ratio > 0.8 -> down")
+    assert r3.action == "down"
+
+
+def test_parse_scale_rule_rejections():
+    for bad in ("queue_depth > 4",              # no action
+                "queue_depth > 4 -> sideways",  # unknown action
+                "queue_depth > 4 -> up:0",      # step < 1
+                "queue_depth > 4 -> up:x",      # non-int step
+                "step_ms.p99 < 250 -> up"):     # stats are for SLOs
+        with pytest.raises(ValueError):
+            parse_scale_rule(bad)
+
+
+def test_scale_policy_validation_and_trigger_order():
+    with pytest.raises(ValueError):
+        ScalePolicy([])
+    with pytest.raises(ValueError):
+        ScalePolicy(["queue_depth > 1 -> up"], min_replicas=3,
+                    max_replicas=2)
+    pol = ScalePolicy(["queue_depth > 10 -> up:2",
+                       "queue_depth > 4 -> up",
+                       "queue_depth < 1 -> down"])
+    i, r = pol.first_triggered("up", {"queue_depth": 6.0})
+    assert i == 1 and r.step == 1       # first matching up rule wins
+    i, r = pol.first_triggered("up", {"queue_depth": 12.0})
+    assert i == 0 and r.step == 2
+    i, r = pol.first_triggered("down", {"queue_depth": 0.0})
+    assert i == 2
+    assert pol.first_triggered("down", {"queue_depth": 5.0}) \
+        == (None, None)
+    assert "rules" in pol.describe()
+
+
+# ----------------------------------------------- controller (fake group)
+class _FakeGroup:
+    """Just enough surface for ScaleController: signals + a mutable
+    replica list the fake planner grows/shrinks."""
+
+    def __init__(self, replicas=1, queued=0):
+        self.replicas = list(range(replicas))
+        self.queued = queued
+        self.num_slots = 2 * replicas
+        self.free_slots = self.num_slots
+        self.guard = None
+        self.scale = None
+        self.name = "fake"
+
+    def _goodput(self, _r):
+        return 0.0
+
+
+class _FakePlanner:
+    def __init__(self, group, capacity=4, reject=None):
+        self.group = group
+        self.capacity = capacity
+        self.reject = reject
+        self.rejections = 0
+
+    def at_ceiling(self, extra=1):
+        return len(self.group.replicas) + extra > self.capacity
+
+    def free_devices(self):
+        return self.capacity - len(self.group.replicas)
+
+    def grow(self, n=1, **_kw):
+        if self.reject is not None:
+            self.rejections += 1
+            raise ScalePlanRejected(self.reject, "injected")
+        self.group.replicas.extend(
+            range(len(self.group.replicas),
+                  len(self.group.replicas) + n))
+        return n
+
+    def shrink(self, n=1, **_kw):
+        del self.group.replicas[-n:]
+        return n
+
+    def stats(self):
+        return {"free_devices": self.free_devices()}
+
+
+def _fake_controller(policy, replicas=1, capacity=4, reject=None,
+                     clock=None):
+    g = _FakeGroup(replicas=replicas)
+    ctl = ScaleController(g, policy, _FakePlanner(g, capacity, reject),
+                          clock=clock or (lambda: 0.0))
+    return g, ctl
+
+
+def test_controller_up_down_dwell_and_veto():
+    pol = ScalePolicy(["queue_depth > 4 -> up",
+                       "queue_depth < 1 -> down"],
+                      max_replicas=4, up_cooldown_s=0.0,
+                      down_cooldown_s=0.0, up_dwell=2, down_dwell=2)
+    g, ctl = _fake_controller(pol)
+    g.queued = 9
+    assert ctl.tick().action == "hold"          # dwell 1 of 2
+    d = ctl.tick()
+    assert d.action == "up" and len(g.replicas) == 2
+    g.queued = 0
+    assert ctl.tick().action == "hold"          # down dwell 1 of 2
+    g.queued = 9                                # pressure returns:
+    ctl.tick()                                  # vetoes the down streak
+    g.queued = 0
+    assert ctl.tick().action == "hold"          # streak restarted
+    d = ctl.tick()
+    assert d.action == "down" and len(g.replicas) == 1
+    assert ctl.decisions["up"] >= 1 and ctl.decisions["down"] == 1
+    assert g.scale is ctl                       # farm stats hook
+
+
+def test_controller_cooldown_freezes_action():
+    now = [0.0]
+    pol = ScalePolicy(["queue_depth > 4 -> up",
+                       "queue_depth < 1 -> down"],
+                      up_cooldown_s=10.0, down_cooldown_s=30.0,
+                      up_dwell=1, down_dwell=1, max_replicas=4)
+    g, ctl = _fake_controller(pol, clock=lambda: now[0])
+    g.queued = 9
+    assert ctl.tick().action == "up"
+    assert ctl.tick().action == "cooldown"      # frozen, no growth
+    assert len(g.replicas) == 2
+    assert ctl.cooldown_remaining_s() == 10.0
+    now[0] = 11.0                               # cooldown expired
+    assert ctl.tick().action == "up"
+    g.queued = 0
+    assert ctl.tick().action == "cooldown"      # up cooldown blocks down
+    now[0] = 30.0
+    assert ctl.tick().action == "down"
+
+
+def test_controller_ceiling_and_floor():
+    pol = ScalePolicy(["queue_depth > 4 -> up",
+                       "queue_depth < 1 -> down"],
+                      min_replicas=1, max_replicas=2,
+                      up_cooldown_s=0.0, down_cooldown_s=0.0,
+                      up_dwell=1, down_dwell=1)
+    g, ctl = _fake_controller(pol, capacity=8)
+    g.queued = 9
+    assert ctl.tick().action == "up"
+    d = ctl.tick()                              # at the policy bound
+    assert d.action == "ceiling" and d.at_ceiling
+    assert len(g.replicas) == 2
+    g.queued = 0
+    assert ctl.tick().action == "down"
+    assert ctl.tick().action == "hold"          # at the floor: hold
+    assert len(g.replicas) == 1
+    # physical ceiling: the planner runs out of device slices
+    g2, ctl2 = _fake_controller(pol, capacity=1)
+    g2.queued = 9
+    d = ctl2.tick()
+    assert d.action == "ceiling" and d.at_ceiling
+
+
+def test_controller_surfaces_planner_rejection():
+    pol = ScalePolicy(["queue_depth > 4 -> up"], up_cooldown_s=0.0,
+                      up_dwell=1)
+    g, ctl = _fake_controller(pol, reject="verify")
+    g.queued = 9
+    d = ctl.tick()
+    assert d.action == "rejected" and not d.at_ceiling
+    assert len(g.replicas) == 1
+    assert ctl.planner.rejections == 1
+    assert set(DECISION_CODES) >= {"hold", "up", "down", "ceiling",
+                                   "rejected", "cooldown"}
+
+
+# ------------------------------------------------------ real-group legs
+def _seeded_stack(maxlen=12, seed=7):
+    cfg = tfm.TransformerConfig(src_vocab=64, trg_vocab=64,
+                                max_len=maxlen, d_model=32, d_inner=64,
+                                n_head=4, n_layer=2, dropout=0.0,
+                                label_smooth_eps=0.0)
+    infer, start = fw.Program(), fw.Program()
+    with pt.program_guard(infer, start):
+        with pt.unique_name.guard():
+            _feeds, _logits = tfm.build_infer_program(cfg,
+                                                      maxlen=maxlen)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(start)
+    rng = np.random.RandomState(seed)
+    scope = pt.global_scope()
+    params = {}
+    for v in infer.persistable_vars():
+        a = np.asarray(scope.get(v.name))
+        nv = (0.35 * rng.randn(*a.shape)).astype(a.dtype)
+        scope.set(v.name, nv)
+        params[v.name] = nv
+    return cfg, params
+
+
+def _elastic_group(cfg, params, maxlen=12, guard=None, name="scale"):
+    """The elastic provisioning shape: seed replica on device 0 only,
+    the rest of the local devices left for the planner."""
+    import jax
+    devs = jax.devices()
+    group = ReplicaGroup(cfg, params, FarmConfig(
+        replicas=1, devices=devs[:1],
+        engine=DecodeEngineConfig(num_slots=2, max_len=maxlen,
+                                  prefill_buckets=(1, 2)),
+        decode=DecodeConfig(bos=0, max_queue_requests=64),
+        guard=guard), name=name)
+    return group, devs
+
+
+def _drain(group, futs, budget=600):
+    pending = list(futs)
+    for _ in range(budget):
+        if all(f.done() for f in pending):
+            break
+        group.run_iteration()
+    return [f.result(timeout=0) for f in pending]
+
+
+def test_planner_grow_zero_recompile_shrink_and_indices():
+    """grow() allocates a fresh slice and spawns through the shared
+    build cache (compile_count pinned), shrink() drains and returns
+    the devices, and replica indices stay monotonic across cycles."""
+    cfg, params = _seeded_stack()
+    group, devs = _elastic_group(cfg, params)
+    pl = ScalePlanner(group, devices=devs, width=1)
+    c0 = group.compile_count
+    free0 = pl.free_devices()
+    pl.grow(2)
+    assert len(group.replicas) == 3
+    assert group.compile_count == c0            # THE zero-recompile pin
+    assert pl.free_devices() == free0 - 2
+    assert [r.index for r in group.replicas] == [0, 1, 2]
+    # the grown replicas actually serve
+    futs = [group.submit(np.arange(2, 8), src_len=6, max_new_tokens=3)
+            for _ in range(4)]
+    res = _drain(group, futs)
+    assert all(len(r.tokens) == 3 for r in res)
+    assert pl.shrink(1, drive=True) == 1
+    assert len(group.replicas) == 2
+    assert pl.free_devices() == free0 - 1
+    pl.grow(1)
+    assert [r.index for r in group.replicas][-1] == 3   # never reused
+    # the floor: a group never shrinks below one replica
+    assert pl.shrink(5, drive=True) == 2
+    with pytest.raises(ValueError):
+        group.remove_replica()
+
+
+def test_planner_verify_gate_rejects_over_cap_grow(monkeypatch):
+    """Growing re-runs the FarmConfig.verify/meshlint pre-spawn gate:
+    a plan whose per-replica KV floor exceeds the device mem cap is
+    rejected typed, with the live set untouched."""
+    cfg, params = _seeded_stack()
+    group, devs = _elastic_group(cfg, params, name="gate")
+    pl = ScalePlanner(group, devices=devs, width=1)
+    monkeypatch.setenv("PADDLE_TPU_DEVICE_MEM_CAP", "0.01")  # MiB
+    with pytest.raises(ScalePlanRejected) as ei:
+        pl.grow(1)
+    assert ei.value.reason == "verify"
+    assert len(group.replicas) == 1 and pl.rejections == 1
+    monkeypatch.delenv("PADDLE_TPU_DEVICE_MEM_CAP")
+    pl.grow(1)                                  # cap lifted: grows
+    assert len(group.replicas) == 2
+
+
+def test_controller_relays_headroom_to_brownout():
+    """Scale-out beats brownout: with a free slice below the ceiling
+    the guard defers entry (deferred counted); once the controller
+    reports the ceiling the deferral lifts and entry proceeds."""
+    from paddle_tpu.serving.guard import GuardConfig
+    cfg, params = _seeded_stack()
+    gcfg = GuardConfig(hedge=False, slow_factor=1e9, queue_high=3,
+                       queue_low=1, dwell_s=0.01, retry_rate=200.0,
+                       retry_burst=200, enter_streak=10**6)
+    group, devs = _elastic_group(cfg, params, guard=gcfg,
+                                 name="headroom")
+    pol = ScalePolicy(["queue_depth > 3 -> up"], max_replicas=2,
+                      up_cooldown_s=0.0, up_dwell=1)
+    ctl = ScaleController(group, pol,
+                          ScalePlanner(group, devices=devs, width=1))
+    bo = group.guard.brownout
+    ctl.tick()
+    assert bo.headroom                          # below the ceiling
+    futs = [group.submit(np.arange(2, 6), src_len=4,
+                         max_new_tokens=2) for _ in range(5)]
+    assert bo.deferred >= 1 and bo.entries == 0
+    d = ctl.tick()                              # grow 1->2 == ceiling
+    assert d.action == "up" and d.at_ceiling
+    assert not bo.headroom                      # deferral lifted
+    futs.append(group.submit(np.arange(2, 6), src_len=4,
+                             max_new_tokens=2))
+    assert bo.entries == 1                      # engages exactly now
+    assert group.guard.stats()["brownout_deferred"] == bo.deferred
+    _drain(group, futs)
+    assert group.stats()["scale"]["live_replicas"] == 2
+
+
+def test_scale_telemetry_fleet_rollup_and_tpustat(tmp_path, capsys):
+    """scale.* gauges land in the fleet per-rank report as
+    serving_scale and render as the tpustat scale line."""
+    tm.enable()
+    cfg, params = _seeded_stack()
+    group, devs = _elastic_group(cfg, params, name="telescale")
+    pol = ScalePolicy(["queue_depth > 2 -> up", "queue_depth < 1 -> down"],
+                      max_replicas=2, up_cooldown_s=0.0, up_dwell=1)
+    ctl = ScaleController(group, pol,
+                          ScalePlanner(group, devices=devs, width=1))
+    futs = [group.submit(np.arange(2, 6), src_len=4, max_new_tokens=2)
+            for _ in range(4)]
+    d = ctl.tick()
+    assert d.action == "up"
+    _drain(group, futs)
+
+    tf.configure(rank=0, world=1, spool_dir=str(tmp_path))
+    tf.write_rank_snapshot()
+    rep = tf.FleetCollector().collect(str(tmp_path)).report()
+    s = rep["per_rank"]["0"]["serving_scale"]
+    assert s["live_replicas"] == 2.0
+    assert s["target_replicas"] == 2.0
+    assert s["last_decision"] == DECISION_CODES["up"]
+    assert s["ups"] == 1
+
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tpustat_scale_test", os.path.join(REPO, "tools",
+                                           "tpustat.py"))
+    tpustat = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tpustat)
+    tpustat._print_replica_table(rep)
+    out = capsys.readouterr().out
+    assert "scale[rank 0]:" in out
+    assert "target=2 live=2" in out
+    assert "last=up(rule#0)" in out
+    assert "ups=1" in out
+
+
+def test_traffic_spike_chaos_multiplies_group_load():
+    """The traffic_spike fault shadows real submissions x-1 times
+    through the normal router; real requests still complete."""
+    from paddle_tpu.resilience import chaos
+    tm.enable()
+    cfg, params = _seeded_stack()
+    group, _devs = _elastic_group(cfg, params, name="spike")
+    chaos.configure("traffic_spike:at=1,x=3,len=2")
+    try:
+        futs = [group.submit(np.arange(2, 6), src_len=4,
+                             max_new_tokens=2) for _ in range(3)]
+    finally:
+        chaos.reset()
+    snap = tm.snapshot()
+    assert snap["serving.farm.spike_shadows"] == 4   # 2 bursts x (3-1)
+    assert group.queued > 3
+    res = _drain(group, futs, budget=800)
+    assert all(len(r.tokens) == 2 for r in res)
+
+
+# ------------------------------------------------------ subprocess gate
+def test_tpuserve_selftest_scale_subprocess():
+    """The tpuscale CI gate: spike ramp 1->N->1 with zero drops and
+    zero scale-up recompiles, brownout deferred until the ceiling and
+    engaging exactly there, verify-rejected over-cap grow."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TELEMETRY", None)
+    env.pop("PADDLE_TPU_CHAOS", None)
+    env.pop("PADDLE_TPU_DEVICE_MEM_CAP", None)
+    env.pop("XLA_FLAGS", None)
+    env["BENCH_HISTORY_PATH"] = os.devnull
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpuserve.py"),
+         "--selftest-scale", "--json"],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["ok"] is True and obj["problems"] == []
+    r = obj["ramp"]
+    assert r["dropped"] == 0 and r["scaleup_recompiles"] == 0
+    assert r["max_live"] >= 2 and r["final_live"] == 1
+    assert r["spike_shadows"] > 0
+    c = obj["ceiling"]
+    assert c["early_sheds"] == 0 and c["entries"] == 1
+    assert c["deferred_below_ceiling"] >= 1
+    assert c["sheds_at_ceiling"] >= 1
+    assert obj["gate"]["rejected"] is True
+    assert obj["gate"]["reason"] == "verify"

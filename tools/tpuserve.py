@@ -58,6 +58,20 @@ Modes:
                    replica_slow throttles 1 of 2 replicas; writes
                    BENCH_guard.json and appends guard_* records to
                    the bench history spine (tpustat --slo).
+  --selftest-scale the tpuscale CI gate: under a tpuchaos
+                   traffic_spike the controller must ramp the group
+                   1->N and back with zero dropped requests and ZERO
+                   scale-up recompiles (shared build cache); an
+                   overloaded guard must DEFER brownout while a free
+                   device slice exists and shed exactly when the
+                   planner reports the ceiling; an over-mem-cap grow
+                   must be rejected by the meshlint pre-spawn gate.
+                   Writes BENCH_autoscale.json + autoscale_* history
+                   records.
+  --bench-scale    static 1-replica vs SLO-autoscaled group under
+                   the same traffic_spike script: goodput, peak
+                   replicas, extra compiles; merges a bench section
+                   into BENCH_autoscale.json.
 
 Examples:
   python tools/tpuserve.py /models/mnist --name mnist --port 8500
@@ -69,6 +83,8 @@ Examples:
   python tools/tpuserve.py --bench-farm --duration 5 --json
   python tools/tpuserve.py --selftest-guard --json
   python tools/tpuserve.py --bench-guard --duration 5 --json
+  python tools/tpuserve.py --selftest-scale --json
+  python tools/tpuserve.py --bench-scale --json
 """
 import argparse
 import json
@@ -1886,6 +1902,447 @@ def _guard_append_history(cases):
         return None
 
 
+# ------------------------------------------------------------------ scale
+def _scale_group(cfg, params, slots, maxlen, buckets, name,
+                 guard=None, qos_factory=None, max_queue=64):
+    """A 1-replica group provisioned ELASTICALLY: the seed replica
+    owns device 0 only, every other local device stays free for the
+    planner's ledger. (A statically-provisioned group's single slice
+    spans ALL devices — the planner would see free=0 and report the
+    ceiling immediately; see the scale package docstring.)"""
+    import jax
+
+    from paddle_tpu.serving.decode import (DecodeConfig,
+                                           DecodeEngineConfig)
+    from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup
+    devs = jax.devices()
+    group = ReplicaGroup(cfg, params, FarmConfig(
+        replicas=1, devices=devs[:1],
+        engine=DecodeEngineConfig(num_slots=slots, max_len=maxlen,
+                                  prefill_buckets=buckets),
+        decode=DecodeConfig(bos=0, max_queue_requests=max_queue),
+        guard=guard, qos_factory=qos_factory), name=name)
+    return group, devs
+
+
+def _scale_ramp_leg(problems, cfg, params, maxlen, buckets):
+    """Leg (a): a tpuchaos traffic_spike rides the queue up — the
+    controller must ramp N->M (through the shared build cache: ZERO
+    new compiles), serve every real request, then drain-and-shrink
+    back to N once the spike passes."""
+    import numpy as np
+
+    from paddle_tpu import telemetry as _tm
+    from paddle_tpu.resilience import chaos as _chaos
+    from paddle_tpu.serving.batcher import RejectedError
+    from paddle_tpu.serving.scale import (ScaleController, ScalePlanner,
+                                          ScalePolicy)
+
+    group, devs = _scale_group(cfg, params, slots=2, maxlen=maxlen,
+                               buckets=buckets, name="scale-ramp")
+    policy = ScalePolicy(
+        ["queue_per_replica > 4 -> up", "queue_depth < 1 -> down"],
+        min_replicas=1, max_replicas=3,
+        up_cooldown_s=0.0, down_cooldown_s=0.0,
+        up_dwell=1, down_dwell=2)
+    ctl = ScaleController(group, policy,
+                          ScalePlanner(group, devices=devs, width=1))
+    c0 = group.compile_count
+    rng = np.random.RandomState(53)
+    reqs = _decode_requests(rng, 12, maxlen, cfg.trg_vocab, 4)
+    _chaos.configure("traffic_spike:at=3,x=5,len=6")
+    futs, timeline, max_live = [], [], 1
+    try:
+        for k, (src, n, mn) in enumerate(reqs):
+            try:
+                futs.append(group.submit(src, src_len=n,
+                                         max_new_tokens=mn))
+            except RejectedError:
+                problems.append(f"scale ramp DROPPED real request "
+                                f"{k} at admission")
+            d = ctl.tick()
+            max_live = max(max_live, len(group.replicas))
+            timeline.append({"k": k, "queued": group.queued,
+                             "live": len(group.replicas),
+                             "action": d.action})
+    finally:
+        _chaos.reset()
+    compiles_up = group.compile_count - c0
+    t0 = time.monotonic()
+    results = _pump_group(group, futs, problems, "scale-ramp",
+                          budget=2000)
+    drain_s = time.monotonic() - t0
+    # shadows the spike injected may still be queued: drain them so
+    # the down trigger (queue_depth < 1) can see a quiet group
+    for _ in range(800):
+        if group.queued == 0 and all(
+                r.scheduler.pool.active_count() == 0
+                for r in group.replicas):
+            break
+        group.run_iteration()
+    for _ in range(8):
+        d = ctl.tick(drive=True)
+        timeline.append({"k": "drain", "queued": group.queued,
+                         "live": len(group.replicas),
+                         "action": d.action})
+        if len(group.replicas) <= policy.min_replicas:
+            break
+    if max_live < 2:
+        problems.append(f"controller never scaled up under the "
+                        f"traffic spike (max live {max_live})")
+    if compiles_up != 0:
+        problems.append(f"scale-up RECOMPILED: compile_count grew by "
+                        f"{compiles_up} (shared build cache must make "
+                        f"grows free)")
+    if len(group.replicas) != policy.min_replicas:
+        problems.append(f"group did not shrink back to "
+                        f"{policy.min_replicas} after the spike "
+                        f"(live {len(group.replicas)})")
+    if len(results) != len(reqs):
+        problems.append(f"scale ramp served {len(results)}/"
+                        f"{len(reqs)} real requests")
+    tokens = sum(len(r.tokens) for r in results.values())
+    shadows = _tm.counter("serving.farm.spike_shadows").value
+    if shadows < 1:
+        problems.append("traffic_spike fault never injected a "
+                        "shadow request")
+    ctl.stop()
+    group.stop()
+    return {"served": len(results), "dropped": len(reqs)
+            - len(results), "max_live": max_live,
+            "final_live": len(group.replicas),
+            "scaleup_recompiles": compiles_up,
+            "spike_shadows": int(shadows),
+            "goodput_tokens_per_s": round(tokens / max(drain_s, 1e-6),
+                                          1),
+            "drain_ms": round(drain_s * 1000.0, 2),
+            "decisions": dict(ctl.decisions),
+            "planner": ctl.planner.stats(),
+            "timeline": timeline}
+
+
+def _scale_ceiling_leg(problems, cfg, params, maxlen, buckets):
+    """Leg (b): shed-only-at-ceiling. While a free device slice
+    exists, an overloaded guard must DEFER brownout (the controller
+    relays headroom); the moment the planner/policy report the
+    ceiling, brownout engages — exactly then, exactly once."""
+    import numpy as np
+
+    from paddle_tpu.serving.batcher import BrownoutShed
+    from paddle_tpu.serving.decode import QosPolicy
+    from paddle_tpu.serving.guard import GuardConfig
+    from paddle_tpu.serving.scale import (ScaleController, ScalePlanner,
+                                          ScalePolicy)
+
+    gcfg = GuardConfig(hedge=False, slow_factor=1e9, queue_high=4,
+                       queue_low=1, dwell_s=0.01, retry_after_s=1.5,
+                       retry_rate=200.0, retry_burst=200,
+                       enter_streak=10**6)
+    group, devs = _scale_group(
+        cfg, params, slots=2, maxlen=maxlen, buckets=buckets,
+        name="scale-ceiling", guard=gcfg,
+        qos_factory=lambda: QosPolicy(
+            tenants=[("interactive", 4.0), ("batch", 1.0)]))
+    policy = ScalePolicy(["queue_depth > 4 -> up"], min_replicas=1,
+                         max_replicas=2, up_cooldown_s=0.0,
+                         up_dwell=1)
+    ctl = ScaleController(group, policy,
+                          ScalePlanner(group, devices=devs, width=1))
+    bo = group.guard.brownout
+    ctl.tick()                      # below the ceiling: headroom on
+    if not bo.headroom:
+        problems.append("controller did not relay headroom to the "
+                        "guard below the ceiling")
+    rng = np.random.RandomState(59)
+    reqs = _decode_requests(rng, 14, maxlen, cfg.trg_vocab, 3)
+    futs, early_shed = [], 0
+    for k in range(7):              # flood: queue >= queue_high
+        src, n, mn = reqs[k]
+        try:
+            futs.append(group.submit(src, src_len=n, tenant="batch",
+                                     max_new_tokens=mn))
+        except BrownoutShed:
+            early_shed += 1
+    deferred_below = bo.deferred
+    if early_shed:
+        problems.append(f"brownout shed {early_shed} request(s) "
+                        f"while a free device slice existed")
+    if bo.entries != 0:
+        problems.append("brownout ENGAGED below the device ceiling "
+                        "(scale-out must beat shedding)")
+    if deferred_below < 1:
+        problems.append("brownout entry was never deferred under "
+                        "overload with headroom")
+    d = ctl.tick()                  # grow 1->2; now at policy ceiling
+    if d.action != "up":
+        problems.append(f"overloaded controller decided "
+                        f"{d.action!r}, expected 'up'")
+    if not d.at_ceiling:
+        problems.append("grow to max_replicas did not report the "
+                        "ceiling")
+    if bo.headroom:
+        problems.append("headroom still on at the ceiling — brownout "
+                        "deferral never lifts")
+    sheds_at_ceiling = 0
+    for k in range(7, 11):          # still flooded, no slices left
+        src, n, mn = reqs[k]
+        try:
+            futs.append(group.submit(src, src_len=n, tenant="batch",
+                                     max_new_tokens=mn))
+        except BrownoutShed:
+            sheds_at_ceiling += 1
+    if bo.entries != 1:
+        problems.append(f"brownout entries={bo.entries} at the "
+                        f"ceiling, expected exactly 1")
+    if sheds_at_ceiling < 1:
+        problems.append("brownout never shed at the device ceiling")
+    src, n, _ = reqs[11]            # the paying class rides through
+    try:
+        futs.append(group.submit(src, src_len=n, tenant="interactive",
+                                 max_new_tokens=3))
+    except BrownoutShed:
+        problems.append("brownout shed the interactive class")
+    _pump_guard(group, futs, problems, "scale-ceiling", budget=800)
+    ctl.stop()
+    group.stop()
+    return {"deferred_below_ceiling": deferred_below,
+            "entries": bo.entries, "sheds": bo.sheds,
+            "sheds_at_ceiling": sheds_at_ceiling,
+            "early_sheds": early_shed,
+            "grew_to": len(group.replicas),
+            "decisions": dict(ctl.decisions)}
+
+
+def _scale_gate_leg(problems, cfg, params, maxlen, buckets):
+    """Leg (c): growing re-runs the meshlint pre-spawn gate — a plan
+    whose per-replica KV footprint exceeds PADDLE_TPU_DEVICE_MEM_CAP
+    is REJECTED before any engine is built."""
+    from paddle_tpu.serving.scale import (ScalePlanner,
+                                          ScalePlanRejected)
+
+    group, devs = _scale_group(cfg, params, slots=2, maxlen=maxlen,
+                               buckets=buckets, name="scale-gate")
+    planner = ScalePlanner(group, devices=devs, width=1)
+    live0 = len(group.replicas)
+    old = os.environ.get("PADDLE_TPU_DEVICE_MEM_CAP")
+    # the cap env var is in MiB; 0.01 MiB is far below the tiny
+    # model's per-replica KV floor, so the plan must be rejected
+    os.environ["PADDLE_TPU_DEVICE_MEM_CAP"] = "0.01"
+    rejected = None
+    try:
+        try:
+            planner.grow(1)
+            problems.append("planner grew past a 0.01 MiB device "
+                            "mem cap — the verify gate did not run")
+        except ScalePlanRejected as e:
+            rejected = e
+    finally:
+        if old is None:
+            os.environ.pop("PADDLE_TPU_DEVICE_MEM_CAP", None)
+        else:
+            os.environ["PADDLE_TPU_DEVICE_MEM_CAP"] = old
+    if rejected is not None and rejected.reason != "verify":
+        problems.append(f"grow rejection reason "
+                        f"{rejected.reason!r}, expected 'verify'")
+    if len(group.replicas) != live0:
+        problems.append("a rejected grow still changed the live "
+                        "replica count")
+    ok = None
+    try:                            # cap restored: the same plan goes
+        planner.grow(1)
+        ok = len(group.replicas)
+    except ScalePlanRejected as e:
+        problems.append(f"grow rejected with the cap restored: {e}")
+    if ok is not None and ok != live0 + 1:
+        problems.append(f"post-gate grow left {ok} replicas, "
+                        f"expected {live0 + 1}")
+    group.stop()
+    return {"rejected": rejected is not None,
+            "reason": None if rejected is None else rejected.reason,
+            "rejections": planner.rejections,
+            "live_after": len(group.replicas)}
+
+
+def _scale_selftest_problems(problems):
+    """The tpuscale CI gate: spike ramp with zero drops and zero
+    scale-up recompiles, shed-only-at-ceiling, verify-gated grows."""
+    maxlen, buckets = 16, (1, 2, 4)
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+    return {"ramp": _scale_ramp_leg(problems, cfg, params, maxlen,
+                                    buckets),
+            "ceiling": _scale_ceiling_leg(problems, cfg, params,
+                                          maxlen, buckets),
+            "gate": _scale_gate_leg(problems, cfg, params, maxlen,
+                                    buckets)}
+
+
+def _scale_write_bench(section, payload):
+    """Merge one section into BENCH_autoscale.json (selftest and
+    bench write different halves of the same artifact)."""
+    out_path = os.path.join(_REPO, "BENCH_autoscale.json")
+    data = {}
+    try:
+        with open(out_path) as f:
+            data = json.load(f)
+    except Exception:  # noqa: BLE001 — first write / stale file
+        data = {}
+    data["schema"] = "paddle_tpu.bench.autoscale.v1"
+    data[section] = payload
+    try:
+        with open(out_path, "w") as f:
+            json.dump(data, f, indent=2)
+    except OSError:
+        return None
+    return out_path
+
+
+def _scale_append_history(ramp):
+    """autoscale_* records onto the bench history spine (same shape
+    as _guard_append_history; `tpustat --slo` gates them: goodput is
+    higher-better, _ms lower-better). Best-effort."""
+    try:
+        import subprocess
+
+        from paddle_tpu.telemetry import slo
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO,
+                capture_output=True, text=True,
+                timeout=10).stdout.strip() or None
+        except Exception:  # noqa: BLE001 — sha is optional
+            sha = None
+        common = {"schema": slo.HISTORY_SCHEMA,
+                  "platform": os.environ.get("JAX_PLATFORMS", "cpu"),
+                  "device_kind": "cpu", "git_sha": sha,
+                  "unix_time": round(time.time(), 1),
+                  "stage": "scale"}
+        recs = []
+        for key, metric, unit in (
+                ("goodput_tokens_per_s", "autoscale_spike_goodput_tps",
+                 "tokens/s"),
+                ("drain_ms", "autoscale_spike_drain_ms", "ms")):
+            v = ramp.get(key)
+            if isinstance(v, (int, float)) and v:
+                recs.append(dict(common, metric=metric, value=v,
+                                 unit=unit))
+        if not recs:
+            return None
+        path = os.environ.get("BENCH_HISTORY_PATH") \
+            or os.path.join(_REPO, "BENCH_history.jsonl")
+        slo.append_history(path, recs)
+        return path
+    except Exception:  # noqa: BLE001 — history is best-effort
+        return None
+
+
+def run_selftest_scale(args):
+    from paddle_tpu import telemetry
+    telemetry.enable()
+    problems = []
+    info = _scale_selftest_problems(problems)
+    result = {"mode": "selftest-scale", **info,
+              "problems": problems, "ok": not problems}
+    result["artifact"] = _scale_write_bench("selftest", result)
+    result["history_appended"] = _scale_append_history(info["ramp"])
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    else:
+        r, c, g = info["ramp"], info["ceiling"], info["gate"]
+        print(f"tpuserve selftest-scale: spike ramp 1->"
+              f"{r['max_live']}->{r['final_live']} replicas, "
+              f"{r['served']} served / {r['dropped']} dropped, "
+              f"{r['scaleup_recompiles']} scale-up recompiles, "
+              f"{r['spike_shadows']} spike shadows; ceiling "
+              f"deferred={c['deferred_below_ceiling']} "
+              f"entries={c['entries']} sheds={c['sheds']}; gate "
+              f"rejected={g['rejected']} ({g['reason']})")
+        for prob in problems:
+            print(f"FAIL: {prob}", file=sys.stderr)
+    return 2 if problems else 0
+
+
+def run_bench_scale(args):
+    """Static 1-replica vs SLO-autoscaled under the identical
+    traffic_spike script: goodput, peak replicas, compiles. Manual
+    drive — deterministic, honest about single-host CPU (the win is
+    queueing delay absorbed, not raw FLOPs)."""
+    import numpy as np
+
+    from paddle_tpu import telemetry
+    from paddle_tpu.resilience import chaos as _chaos
+    from paddle_tpu.serving.batcher import RejectedError
+    from paddle_tpu.serving.scale import (ScaleController, ScalePlanner,
+                                          ScalePolicy)
+    telemetry.enable()
+    maxlen, buckets = 16, (1, 2, 4)
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+    cases = {}
+    for label, autoscaled in (("static_1", False),
+                              ("autoscaled", True)):
+        group, devs = _scale_group(cfg, params, slots=2,
+                                   maxlen=maxlen, buckets=buckets,
+                                   name=f"bench-{label}",
+                                   max_queue=256)
+        ctl = None
+        if autoscaled:
+            ctl = ScaleController(
+                group,
+                ScalePolicy(["queue_per_replica > 4 -> up",
+                             "queue_depth < 1 -> down"],
+                            min_replicas=1, max_replicas=4,
+                            up_cooldown_s=0.0, down_cooldown_s=0.0,
+                            up_dwell=1, down_dwell=2),
+                ScalePlanner(group, devices=devs, width=1))
+        c0 = group.compile_count
+        rng = np.random.RandomState(67)
+        reqs = _decode_requests(rng, 24, maxlen, cfg.trg_vocab, 4)
+        _chaos.configure("traffic_spike:at=4,x=4,len=8")
+        futs, rejected, max_live = [], 0, 1
+        probs = []
+        t0 = time.monotonic()
+        try:
+            for src, n, mn in reqs:
+                try:
+                    futs.append(group.submit(src, src_len=n,
+                                             max_new_tokens=mn))
+                except RejectedError:
+                    rejected += 1
+                if ctl is not None:
+                    ctl.tick()
+                    max_live = max(max_live, len(group.replicas))
+        finally:
+            _chaos.reset()
+        results = _pump_group(group, futs, probs, label, budget=4000)
+        wall = time.monotonic() - t0
+        tokens = sum(len(r.tokens) for r in results.values())
+        case = {"replicas_peak": max_live,
+                "served": len(results), "rejected": rejected,
+                "dropped": len(probs),
+                "compile_count": group.compile_count,
+                "extra_compiles": group.compile_count - c0,
+                "wall_s": round(wall, 3),
+                "goodput_tokens_per_s": round(
+                    tokens / max(wall, 1e-6), 1)}
+        if ctl is not None:
+            case["decisions"] = dict(ctl.decisions)
+            ctl.stop()
+        group.stop()
+        cases[label] = case
+        if not args.as_json:
+            print(f"  {label:<12} {case['goodput_tokens_per_s']:>8} "
+                  f"tok/s  peak {case['replicas_peak']} replicas  "
+                  f"{case['extra_compiles']} extra compiles  "
+                  f"{case['served']} served")
+    result = {"mode": "bench-scale", "model": "transformer-tiny",
+              "maxlen": maxlen,
+              "fault": "traffic_spike:at=4,x=4,len=8",
+              "cases": cases}
+    result["artifact"] = _scale_write_bench("bench", result)
+    if args.as_json:
+        print(json.dumps(result))
+    return 0
+
+
 # ------------------------------------------------------------------ serve
 def run_serve(args):
     from paddle_tpu import telemetry
@@ -1977,6 +2434,20 @@ def main(argv=None):
                         "replica_slow throttles 1 of 2 replicas; "
                         "writes BENCH_guard.json and appends to the "
                         "bench history spine")
+    p.add_argument("--selftest-scale", action="store_true",
+                   dest="selftest_scale",
+                   help="the tpuscale CI gate: a traffic_spike ramp "
+                        "must scale 1->N->1 with zero drops and zero "
+                        "scale-up recompiles, brownout must shed "
+                        "ONLY at the device ceiling (deferred while "
+                        "a free slice exists), and an over-cap grow "
+                        "must be verify-rejected; writes "
+                        "BENCH_autoscale.json + history records")
+    p.add_argument("--bench-scale", action="store_true",
+                   dest="bench_scale",
+                   help="static 1-replica vs SLO-autoscaled group "
+                        "under the same traffic_spike script; merges "
+                        "into BENCH_autoscale.json")
     p.add_argument("--slots", type=int, default=8,
                    help="--bench-decode slot-pool size")
     p.add_argument("--decode-max-len", type=int, default=32,
@@ -1989,7 +2460,8 @@ def main(argv=None):
     if args.platform != "env":
         os.environ["JAX_PLATFORMS"] = args.platform
     if args.selftest_farm or args.bench_farm or args.selftest_guard \
-            or args.bench_guard:
+            or args.bench_guard or args.selftest_scale \
+            or args.bench_scale:
         # the farm slices real devices: give the CPU backend 8
         # virtual ones (must land before jax is first imported)
         flags = os.environ.get("XLA_FLAGS", "")
@@ -2011,11 +2483,16 @@ def main(argv=None):
         return run_selftest_guard(args)
     if args.bench_guard:
         return run_bench_guard(args)
+    if args.selftest_scale:
+        return run_selftest_scale(args)
+    if args.bench_scale:
+        return run_bench_scale(args)
     if not args.model_dir:
         p.error("model_dir is required unless --selftest / "
                 "--selftest-decode / --bench-decode / "
                 "--selftest-farm / --bench-farm / "
-                "--selftest-guard / --bench-guard")
+                "--selftest-guard / --bench-guard / "
+                "--selftest-scale / --bench-scale")
     if args.bench:
         return run_bench(args)
     return run_serve(args)

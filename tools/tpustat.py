@@ -305,12 +305,18 @@ def _print_fleet_table(rep):
 _GUARD_STATES = {0.0: "ok", 1.0: "probation", 2.0: "EJECTED",
                  3.0: "half-open"}
 
+# scale.last_decision gauge -> label (serving.scale DECISION_CODES)
+_SCALE_DECISIONS = {0.0: "hold", 1.0: "up", 2.0: "down",
+                    3.0: "ceiling", 4.0: "rejected", 5.0: "cooldown"}
+
 
 def _print_replica_table(rep):
     """Serving-farm sub-table: one row per decode replica, from the
     serving.replica.<i>.* gauges (ranks serving no farm print
     nothing), plus one guard line per rank running overload defense
-    (serving.guard.* rollups)."""
+    (serving.guard.* rollups) and one autoscaler line per rank with a
+    live ScaleController (scale.* rollups: target vs live, last
+    decision + triggering rule, cooldown remaining)."""
     rows = []
     for r in rep["ranks"]:
         pr = rep["per_rank"][str(r)]
@@ -351,6 +357,25 @@ def _print_replica_table(rep):
               f"resubmits={int(g.get('resubmits', 0))} "
               f"sheds={int(g.get('brownout_sheds', 0))} "
               f"p99={f'{p99:.1f}ms' if p99 is not None else '-'}")
+    for r in rep["ranks"]:
+        s = rep["per_rank"][str(r)].get("serving_scale") or {}
+        if not s:
+            continue
+        dec = _SCALE_DECISIONS.get(s.get("last_decision", 0.0),
+                                   "hold")
+        rule = s.get("last_rule", -1.0)
+        if rule is not None and rule >= 0:
+            dec = f"{dec}(rule#{int(rule)})"
+        cool = s.get("cooldown_remaining_s", 0.0) or 0.0
+        print(f"    scale[rank {r}]: "
+              f"target={int(s.get('target_replicas', 0))} "
+              f"live={int(s.get('live_replicas', 0))} "
+              f"last={dec} "
+              f"cooldown={cool:.1f}s "
+              f"{'AT-CEILING' if s.get('at_ceiling') else 'headroom'} "
+              f"free_dev={int(s.get('free_devices', 0))} "
+              f"ups={int(s.get('ups', 0))} "
+              f"downs={int(s.get('downs', 0))}")
 
 
 def _fleet_report(spool, as_json, trace_path):
