@@ -837,6 +837,47 @@ def _git_sha():
         return None
 
 
+def _host_fingerprint():
+    """Stable 12-hex id for the machine class a record was measured
+    on. Same-fingerprint records are directly comparable; across
+    fingerprints only the calibration ratio makes them commensurable."""
+    import hashlib
+    import platform as _pf
+    probe = "|".join((_pf.system(), _pf.machine(),
+                      _pf.processor() or "",
+                      str(os.cpu_count() or 0)))
+    return hashlib.sha1(probe.encode()).hexdigest()[:12]
+
+
+_CALIB_MS = None
+
+
+def _calibrate():
+    """Fixed host-CPU calibration microbenchmark: best-of-5 wall time
+    for 64 seeded 128x128 fp32 matmuls (~270 MFLOP per trial). The
+    SAME work every run, every box, forever — so the ratio of two
+    records' `calib_ms` is the relative speed of the boxes that
+    produced them, and the history gate can normalize a spine that
+    spans machines instead of flagging a slower box as a perf
+    regression. Cached per process (one stamp per bench run)."""
+    global _CALIB_MS
+    if _CALIB_MS is None:
+        import numpy as np
+        rng = np.random.RandomState(0)
+        a = rng.randn(128, 128).astype(np.float32)
+        b = rng.randn(128, 128).astype(np.float32)
+        for _ in range(8):
+            (a @ b)                      # warm the BLAS path
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(64):
+                (a @ b)
+            best = min(best, time.perf_counter() - t0)
+        _CALIB_MS = round(best * 1e3, 4)
+    return _CALIB_MS
+
+
 def _history_records(result, now=None):
     """The schema'd per-stage records for one bench result. The
     headline 'value' is renamed to its real metric name; zero values
@@ -847,7 +888,13 @@ def _history_records(result, now=None):
     common = {"schema": _HISTORY_SCHEMA,
               "platform": result.get("platform"),
               "device_kind": result.get("device_kind"),
-              "git_sha": sha, "unix_time": round(now, 1)}
+              "git_sha": sha, "unix_time": round(now, 1),
+              # calibration spine: the fixed microbenchmark's wall
+              # time plus the host class it ran on. history_gate
+              # divides these out, so records from differently-sized
+              # CI boxes gate against each other fairly
+              "calib_ms": _calibrate(),
+              "fingerprint": _host_fingerprint()}
     records = []
     for key, unit, stage in _HISTORY_METRICS:
         v = result.get(key)

@@ -206,6 +206,10 @@ class Batch:
                 r.future.set_error(DeadlineExceeded(
                     "deadline expired in queue"))
                 dropped += 1
+                if r.request_id and _tm.reqtrace_enabled():
+                    _tm.reqtrace.flag(r.request_id, "deadline")
+                    _tm.reqtrace.event(r.request_id,
+                                       "batch.deadline_drop")
             else:
                 live.append(r)
         self.requests = live
@@ -290,10 +294,14 @@ class DynamicBatcher:
                     f"queue full ({self.config.max_queue_requests} "
                     f"requests); retry later")
             self._queue.append(req)
+            depth = len(self._queue)
             if _tm.enabled():
                 _tm.counter("serving.requests").inc()
-                _tm.gauge("serving.queue_depth").set(len(self._queue))
+                _tm.gauge("serving.queue_depth").set(depth)
             self._cond.notify()
+        if request_id and _tm.reqtrace_enabled():
+            _tm.reqtrace.event(request_id, "batcher.enqueue",
+                               rows=rows, queue_depth=depth)
         return req.future
 
     # ---------------------------------------------------- worker side

@@ -137,6 +137,11 @@ class DecodeEngine:
         return cls(model_cfg, decode_params(arrays, model_cfg),
                    config=config, **kw)
 
+    # set by serving.farm at spawn (like ContinuousScheduler's): lands
+    # engine-side trace events (prefill, KV handoff) on the right
+    # replica pid of a request exemplar; None for single engines
+    replica_index = None
+
     # ------------------------------------------------------- properties
     @property
     def num_slots(self):
@@ -228,10 +233,21 @@ class DecodeEngine:
             src[j, :len(s)] = s
             src_len[j] = min(Ts, max(1, int(r.src_len)))
         pf = self.prefill_decoder or self.decoder
+        trace = _tm.reqtrace_enabled()
+        t0 = _tm.now_us() if trace else 0
         with _tm.span("serving.decode.prefill", rows=n, bucket=bucket):
             out = pf.prefill(src, src_len)
+        if trace:
+            dur = _tm.now_us() - t0
+            for r in requests:
+                if r.request_id:
+                    _tm.reqtrace.span_at(
+                        r.request_id, "engine.prefill", t0, dur,
+                        replica=self.replica_index, rows=n,
+                        bucket=bucket,
+                        disaggregated=self.prefill_decoder is not None)
         if self.prefill_decoder is not None:
-            out = self._handoff(out)
+            out = self._handoff(out, requests)
         if _tm.enabled():
             _tm.counter("serving.decode.prefill_rows").inc(n)
             _tm.counter("serving.decode.prefill_pad_rows").inc(
@@ -240,7 +256,7 @@ class DecodeEngine:
                 self.compile_count)
         return self.decoder.write_slots(state, out, slots)
 
-    def _handoff(self, out):
+    def _handoff(self, out, requests=()):
         """Move prefilled KV state (ck, cv, src_bias) from the prefill
         device onto the decode device. `jax.device_put` is the one
         transfer op that lowers to whatever the platform has —
@@ -249,14 +265,24 @@ class DecodeEngine:
         operands."""
         import jax
         ck, cv, src_bias = out
+        nbytes = int(ck.nbytes + cv.nbytes + src_bias.nbytes)
         if _tm.enabled():
-            _tm.counter("serving.decode.handoff_bytes").inc(
-                int(ck.nbytes + cv.nbytes + src_bias.nbytes))
+            _tm.counter("serving.decode.handoff_bytes").inc(nbytes)
             _tm.counter("serving.decode.handoffs").inc()
         dev = self.device if self.device is not None \
             else jax.devices()[0]
+        trace = _tm.reqtrace_enabled()
+        t0 = _tm.now_us() if trace else 0
         with _tm.span("serving.decode.handoff"):
-            return jax.device_put((ck, cv, src_bias), dev)
+            moved = jax.device_put((ck, cv, src_bias), dev)
+        if trace:
+            dur = _tm.now_us() - t0
+            for r in requests:
+                if r.request_id:
+                    _tm.reqtrace.span_at(
+                        r.request_id, "engine.kv_handoff", t0, dur,
+                        replica=self.replica_index, bytes=nbytes)
+        return moved
 
     def step(self, state, ids, pos, seed=0):
         """One decode iteration over all slots -> next ids [S]."""

@@ -181,11 +181,15 @@ class ContinuousScheduler:
                     + list(self.pool.held_by_tenant()))
             self._queues.setdefault(tenant, []).append(req)
             self._queued += 1
+            depth = self._queued
             if _tm.enabled():
                 _tm.counter("serving.decode.requests").inc()
-                _tm.gauge("serving.decode.queue_depth").set(
-                    self._queued)
+                _tm.gauge("serving.decode.queue_depth").set(depth)
             self._cond.notify()
+        if request_id and _tm.reqtrace_enabled():
+            _tm.reqtrace.event(request_id, "decode.enqueue",
+                               replica=self.replica_index,
+                               tenant=tenant, queue_depth=depth)
         return req.future
 
     def decode(self, src, timeout=None, **kw):
@@ -239,15 +243,35 @@ class ContinuousScheduler:
             # replica_slow / replica_flap faults): counted per working
             # iteration, like ModelServer counts per dequeued batch —
             # deterministic under load
-            _chaos.check("serving.worker",
-                         detail=f"decode loop {self.name}",
-                         replica=self.replica_index)
+            try:
+                _chaos.check("serving.worker",
+                             detail=f"decode loop {self.name}",
+                             replica=self.replica_index)
+            except _chaos.ChaosFault:
+                if _tm.reqtrace_enabled():
+                    # every request riding this replica is about to
+                    # die with it — a chaos fault is a capture trigger
+                    for slot in self.pool.active():
+                        r = slot.request
+                        if r is not None and r.request_id:
+                            _tm.reqtrace.flag(r.request_id, "chaos")
+                            _tm.reqtrace.event(
+                                r.request_id, "chaos.fault",
+                                replica=self.replica_index,
+                                slot=slot.index)
+                raise
             # a poisoned request (request_poison fault, tagged at farm
             # submit so the tag rides resubmissions) kills the replica
             # that stepped it — the blast the guard must contain
             for slot in self.pool.active():
                 r = slot.request
                 if r is not None and r.poisoned:
+                    if r.request_id and _tm.reqtrace_enabled():
+                        _tm.reqtrace.flag(r.request_id, "chaos")
+                        _tm.reqtrace.event(
+                            r.request_id, "chaos.request_poison",
+                            replica=self.replica_index,
+                            slot=slot.index)
                     raise _chaos.ChaosFault(
                         {"name": "request_poison",
                          "point": "serving.request"},
@@ -335,6 +359,13 @@ class ContinuousScheduler:
                 _tm.instant_event("serving.decode.admit",
                                   tenant=req.tenant, slot=slot.index,
                                   request_id=req.request_id)
+            if req.request_id and _tm.reqtrace_enabled():
+                _tm.reqtrace.event(
+                    req.request_id, "decode.admit",
+                    replica=self.replica_index, slot=slot.index,
+                    tenant=req.tenant,
+                    queue_wait_ms=round(
+                        (time.monotonic() - req.enqueue_t) * 1e3, 3))
         if batch:
             self.state = self.engine.admit(self.state, batch, slots)
             if _tm.enabled():
@@ -378,14 +409,28 @@ class ContinuousScheduler:
                                seed=self._iteration)
         now = time.monotonic()
         eos = self.config.eos
+        trace = _tm.reqtrace_enabled()
+        occupancy = self.pool.occupancy() if trace else None
         for slot in active:
             req = slot.request
             tok = int(nxt[slot.index])
+            if trace and req.request_id:
+                # per-iteration slot occupancy on the request's
+                # timeline: which step, in how full a pool
+                _tm.reqtrace.event(
+                    req.request_id, "decode.step",
+                    replica=self.replica_index, slot=slot.index,
+                    iteration=self._iteration, occupancy=occupancy)
             if slot.first_token_t is None:
                 slot.first_token_t = now
                 if _tm.enabled():
                     _tm.histogram("serving.decode.ttft_seconds").observe(
                         now - req.enqueue_t)
+                if trace and req.request_id:
+                    _tm.reqtrace.event(
+                        req.request_id, "decode.first_token",
+                        replica=self.replica_index, slot=slot.index,
+                        ttft_ms=round((now - req.enqueue_t) * 1e3, 3))
             slot.tokens.append(tok)
             self.tokens_generated += 1
             if _tm.enabled():
@@ -419,6 +464,22 @@ class ContinuousScheduler:
         unused = req.max_new_tokens - len(slot.tokens or ())
         if unused > 0:
             self.qos.refund(req.tenant, unused)
+        if req.request_id and _tm.reqtrace_enabled():
+            if reason == "deadline":
+                _tm.reqtrace.flag(req.request_id, "deadline")
+            # the slot's admit->retire lifetime as one span, stamped
+            # at retirement (the admit instant anchors its start)
+            dur_us = int((time.monotonic() - slot.joined_t) * 1e6)
+            _tm.reqtrace.span_at(
+                req.request_id, "decode.slot",
+                _tm.now_us() - dur_us, dur_us,
+                replica=self.replica_index, slot=slot.index,
+                reason=reason, delivered=delivered,
+                tokens=len(slot.tokens or ()))
+            _tm.reqtrace.event(
+                req.request_id, "decode.retire",
+                replica=self.replica_index, slot=slot.index,
+                reason=reason, delivered=delivered)
         self.pool.release(slot)
         self._ids[slot.index] = 0
         self._pos[slot.index] = 0

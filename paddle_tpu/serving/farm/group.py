@@ -317,13 +317,21 @@ class GroupFuture:
                 self._retries -= 1
                 self._failed.add(self._replica)
                 rep, fut = self._group._route(
-                    self._kwargs, exclude=self._failed)
+                    self._kwargs, exclude=self._failed,
+                    leg="resubmit")
                 _LOG.warning(
                     "farm %s: request resubmitted from crashed "
                     "replica %d to %d (%s)", self._group.name,
                     self._replica.index, rep.index, type(e).__name__)
                 if _tm.enabled():
                     _tm.counter("serving.farm.retries").inc()
+                rid = self._kwargs.get("request_id")
+                if rid and _tm.reqtrace_enabled():
+                    _tm.reqtrace.flag(rid, "resubmit")
+                    _tm.reqtrace.event(rid, "farm.resubmit",
+                                       replica=rep.index,
+                                       dead=self._replica.index,
+                                       cause=type(e).__name__)
                 self._replica, self._future = rep, fut
 
     # ------------------------------------------------- guarded path
@@ -370,9 +378,21 @@ class GroupFuture:
         g = self._guard
         g.on_result(winner["rep"].index, latency_s,
                     hedge=winner["hedge"])
+        rid = self._kwargs.get("request_id")
+        trace = rid and _tm.reqtrace_enabled()
         for c in self._cands:
             if c is not winner and c["rep"].scheduler.cancel(c["fut"]):
                 g.on_cancelled()
+                if trace:
+                    _tm.reqtrace.event(
+                        rid, "farm.hedge.cancel",
+                        replica=c["rep"].index, outcome="loser",
+                        hedge=c["hedge"])
+        if trace:
+            _tm.reqtrace.event(
+                rid, "farm.win", replica=winner["rep"].index,
+                outcome="winner", hedge=winner["hedge"],
+                latency_ms=round(latency_s * 1e3, 3))
         self._cands = [winner]
         self._replica, self._future = winner["rep"], winner["fut"]
 
@@ -389,13 +409,15 @@ class GroupFuture:
         if delay is None or time.monotonic() - c0["t0"] < delay:
             return
         self._hedged = True
-        if not g.allow_hedge():
+        rid = self._kwargs.get("request_id")
+        if not g.allow_hedge(request_id=rid):
             return
         exclude = set(self._failed)
         exclude.add(c0["rep"])
         try:
             rep, fut = self._group._route(self._kwargs,
-                                          exclude=exclude)
+                                          exclude=exclude,
+                                          leg="hedge")
         except RejectedError:
             g.refund_hedge()        # nowhere to hedge to
             return
@@ -404,7 +426,12 @@ class GroupFuture:
             _tm.instant_event(
                 "serving.guard.hedge", farm=self._group.name,
                 primary=c0["rep"].index, hedge=rep.index,
-                request_id=self._kwargs.get("request_id"))
+                request_id=rid)
+        if rid and _tm.reqtrace_enabled():
+            _tm.reqtrace.flag(rid, "hedge")
+            _tm.reqtrace.event(
+                rid, "farm.hedge.launch", primary=c0["rep"].index,
+                hedge=rep.index, **g.hedge.describe())
         self._cands.append({"rep": rep, "fut": fut,
                             "t0": time.monotonic(), "hedge": True})
 
@@ -413,16 +440,27 @@ class GroupFuture:
         per-request retry count and the group retry budget allow,
         else fail fast and typed."""
         g = self._guard
+        rid = self._kwargs.get("request_id")
         if self._retries <= 0:
             raise exc
-        if not g.allow_resubmit():
+        if not g.allow_resubmit(request_id=rid):
             raise RetryBudgetExhausted(
                 f"farm {self._group.name!r}: retry budget exhausted "
                 f"resubmitting after {type(exc).__name__}") from exc
         self._retries -= 1
         rep, fut = self._group._route(self._kwargs,
-                                      exclude=self._failed)
+                                      exclude=self._failed,
+                                      leg="resubmit")
         g.on_resubmit()
+        if rid and _tm.reqtrace_enabled():
+            # the ORIGINAL id rides self._kwargs: the resubmitted leg
+            # re-enters scheduler spans under the same trace, not a
+            # fresh context
+            _tm.reqtrace.flag(rid, "resubmit")
+            _tm.reqtrace.event(rid, "farm.resubmit",
+                               replica=rep.index,
+                               dead=self._replica.index,
+                               cause=type(exc).__name__)
         _LOG.warning(
             "farm %s: request resubmitted from crashed replica %d "
             "to %d (%s)", self._group.name, self._replica.index,
@@ -519,14 +557,24 @@ class ReplicaGroup:
         """Route one sequence to the least-loaded replica; returns a
         `GroupFuture` (resolves to a DecodeResult, resubmitting across
         replicas on a crash)."""
+        if request_id is None and _tm.reqtrace_enabled():
+            # one request, one id: hedge duplicates and crash
+            # resubmissions must join the SAME trace, so a request
+            # that arrived without an id gets one here — before any
+            # leg exists to diverge
+            import uuid
+            request_id = uuid.uuid4().hex[:16]
         kwargs = dict(src=src, src_len=src_len, tenant=tenant,
                       max_new_tokens=max_new_tokens,
                       deadline_ms=deadline_ms, request_id=request_id)
+        if request_id and _tm.reqtrace_enabled():
+            _tm.reqtrace.trace_begin(request_id, farm=self.name,
+                                     tenant=str(tenant))
         if self.guard is not None:
             # brownout shed/clamp + hedge-allowance deposit
             kwargs["max_new_tokens"] = self.guard.admit(
                 str(tenant), self.replicas[0].scheduler.qos,
-                self.queued, max_new_tokens)
+                self.queued, max_new_tokens, request_id=request_id)
         if _chaos.armed():
             # the serving.request chaos point: request_poison tags the
             # N-th farm submission; the tag rides resubmissions, so
@@ -567,7 +615,7 @@ class ReplicaGroup:
         """Blocking convenience: submit + wait -> DecodeResult."""
         return self.submit(src, **kw).result(timeout=timeout)
 
-    def _route(self, kwargs, exclude):
+    def _route(self, kwargs, exclude, leg="primary"):
         with self._lock:
             rep = self.router.pick(self.replicas, exclude=exclude)
             if rep is None:
@@ -579,6 +627,13 @@ class ReplicaGroup:
                     raise RejectedError(
                         f"farm {self.name!r}: no replica available")
                 rep = min(live, key=lambda r: r.scheduler.queued)
+        rid = kwargs.get("request_id")
+        if rid and _tm.reqtrace_enabled():
+            # the routing decision opens the request's per-replica
+            # leg; scheduler/engine events on this replica parent to
+            # it, which is what stitches the cross-replica chain
+            _tm.reqtrace.leg(rid, rep.index, kind=leg,
+                             queued=rep.scheduler.queued)
         fut = rep.scheduler.submit(**kwargs)
         if _tm.enabled():
             _tm.counter("serving.farm.routed").inc()
@@ -634,6 +689,7 @@ class ReplicaGroup:
             engine, qos=qos, config=self.config.decode,
             name=f"{self.name}.r{i}", warmup=warmup)
         sched.replica_index = i
+        engine.replica_index = i
         rep = Replica(i, engine, sched, devices)
         rep.version = self.version
         if self.guard is not None:

@@ -120,10 +120,12 @@ class BrownoutController:
                     self._active = False
             return self._active
 
-    def admit(self, tenant, shed_classes, max_new_tokens):
+    def admit(self, tenant, shed_classes, max_new_tokens,
+              request_id=None):
         """Admission verdict while the controller may be active.
         Returns the (possibly clamped) max_new_tokens, or raises
-        BrownoutShed for the shed classes. No-op when inactive."""
+        BrownoutShed for the shed classes. No-op when inactive.
+        `request_id` lands the shed verdict on the request's trace."""
         with self._lock:
             if not self._active:
                 return max_new_tokens
@@ -132,6 +134,11 @@ class BrownoutController:
                 self.sheds += 1
             if _tm.enabled():
                 _tm.counter("serving.guard.brownout_sheds").inc()
+            if request_id is not None and _tm.reqtrace_enabled():
+                _tm.reqtrace.flag(request_id, "shed")
+                _tm.reqtrace.event(request_id, "guard.brownout.shed",
+                                   tenant=tenant,
+                                   retry_after_s=self.retry_after_s)
             from ..batcher import BrownoutShed
             raise BrownoutShed(
                 f"brownout: tenant {tenant!r} is in the lowest QoS "
@@ -145,5 +152,8 @@ class BrownoutController:
                 self.clamped += 1
             if _tm.enabled():
                 _tm.counter("serving.guard.clamped").inc()
+            if request_id is not None and _tm.reqtrace_enabled():
+                _tm.reqtrace.event(request_id, "guard.brownout.clamp",
+                                   clamp=self.clamp_new_tokens)
             return self.clamp_new_tokens
         return max_new_tokens

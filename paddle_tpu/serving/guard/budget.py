@@ -20,7 +20,20 @@ is imported unless a guard is configured (guard-off pays nothing).
 import threading
 import time
 
+from ... import telemetry as _tm
+
 __all__ = ["RetryBudget", "FractionBucket"]
+
+
+def _trace_denial(request_id, bucket, tokens, need):
+    """Budget denials are exactly the events a tail exemplar must
+    explain — mark the request's trace (no-op when tracing is off)."""
+    if request_id is None or not _tm.reqtrace_enabled():
+        return
+    _tm.reqtrace.flag(request_id, "budget")
+    _tm.reqtrace.event(request_id, "guard.budget.denied",
+                       bucket=bucket, tokens=round(tokens, 3),
+                       need=need)
 
 
 class RetryBudget:
@@ -44,15 +57,18 @@ class RetryBudget:
                 self.burst, self._tokens + (now - self._last) * self.rate)
         self._last = now
 
-    def acquire(self, n=1.0):
+    def acquire(self, n=1.0, request_id=None):
         """Take `n` tokens; False (and `denied` grows) when short."""
         with self._lock:
             self._refill(self._clock())
             if self._tokens + 1e-9 < n:
                 self.denied += 1
-                return False
-            self._tokens -= n
-            return True
+                tokens = self._tokens
+            else:
+                self._tokens -= n
+                return True
+        _trace_denial(request_id, "retry", tokens, n)
+        return False
 
     def refund(self, n=1.0):
         """Give tokens back (an acquire whose action never launched)."""
@@ -84,13 +100,16 @@ class FractionBucket:
         with self._lock:
             self._tokens = min(self.burst, self._tokens + self.fraction)
 
-    def acquire(self, n=1.0):
+    def acquire(self, n=1.0, request_id=None):
         with self._lock:
             if self._tokens + 1e-9 < n:
                 self.denied += 1
-                return False
-            self._tokens -= n
-            return True
+                tokens = self._tokens
+            else:
+                self._tokens -= n
+                return True
+        _trace_denial(request_id, "hedge_fraction", tokens, n)
+        return False
 
     def refund(self, n=1.0):
         with self._lock:

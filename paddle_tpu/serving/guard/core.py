@@ -135,14 +135,16 @@ class GroupGuard:
         self.resubmits = 0
 
     # ------------------------------------------------------ admission
-    def admit(self, tenant, qos, queue_depth, max_new_tokens):
+    def admit(self, tenant, qos, queue_depth, max_new_tokens,
+              request_id=None):
         """Group-submit admission: update brownout against the queue,
         shed/clamp, and bank this request's hedge allowance. Returns
-        the max_new_tokens to submit with (possibly clamped)."""
+        the max_new_tokens to submit with (possibly clamped).
+        `request_id` attributes shed verdicts to the request's trace."""
         self.brownout.observe(queue_depth)
         out = self.brownout.admit(
             tenant, qos.lowest_classes() if qos is not None else (),
-            max_new_tokens)
+            max_new_tokens, request_id=request_id)
         self.hedge_budget.deposit()
         return out
 
@@ -182,16 +184,16 @@ class GroupGuard:
     def hedge_delay(self):
         return self.hedge.delay()
 
-    def allow_hedge(self):
+    def allow_hedge(self, request_id=None):
         """One hedge = one hedge-fraction token AND one retry token
         (hedges and resubmissions drain the same storm budget)."""
         if not self.hedge.enabled:
             return False
-        if not self.hedge_budget.acquire():
+        if not self.hedge_budget.acquire(request_id=request_id):
             if _tm.enabled():
                 _tm.counter("serving.guard.hedge_denied").inc()
             return False
-        if not self.retry_budget.acquire():
+        if not self.retry_budget.acquire(request_id=request_id):
             self.hedge_budget.refund()
             if _tm.enabled():
                 _tm.counter("serving.guard.hedge_denied").inc()
@@ -208,8 +210,8 @@ class GroupGuard:
         if _tm.enabled():
             _tm.counter("serving.guard.hedges").inc()
 
-    def allow_resubmit(self):
-        if not self.retry_budget.acquire():
+    def allow_resubmit(self, request_id=None):
+        if not self.retry_budget.acquire(request_id=request_id):
             if _tm.enabled():
                 _tm.counter("serving.guard.retry_denied").inc()
             return False

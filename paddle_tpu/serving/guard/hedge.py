@@ -85,3 +85,13 @@ class HedgePolicy:
     def p99_ms(self):
         q = self.window.quantile(0.99)
         return None if q is None else q * 1000.0
+
+    def describe(self):
+        """Why-this-delay provenance for a hedge-launch trace event:
+        the effective delay plus whether it was pinned or p99-derived
+        (and from how many window samples)."""
+        d = self.delay()
+        return {"delay_ms": None if d is None else round(d * 1e3, 3),
+                "fixed": self.fixed_delay_s is not None,
+                "p99_ms": self.p99_ms(),
+                "window_n": len(self.window)}

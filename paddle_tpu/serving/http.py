@@ -112,6 +112,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _finish_trace(self, status, trigger=None):
+        """Complete the request's reqtrace context (no-op when tracing
+        is off — the gate is one bool, the module is never imported)."""
+        if not (self._request_id and _tm.reqtrace_enabled()):
+            return
+        rt = _tm.reqtrace
+        if trigger:
+            rt.flag(self._request_id, trigger)
+        rt.trace_end(self._request_id, status=status)
+
     def _error(self, code, msg, kind=None, retry_after=None):
         if _tm.enabled():
             _tm.counter("serving.http_errors").inc()
@@ -148,6 +158,21 @@ class _Handler(BaseHTTPRequestHandler):
                      self.model_server.decoders().items()
                      if hasattr(dec, "stats")}
             self._reply(200, {"farms": farms})
+        elif self.path == "/v1/traces":
+            if _tm.reqtrace_enabled():
+                self._reply(200, _tm.reqtrace.snapshot())
+            else:
+                self._reply(200, {"enabled": False, "seen": 0,
+                                  "kept": 0, "stored": 0,
+                                  "triggers": {}, "traces": []})
+        elif self.path.startswith("/v1/traces/"):
+            tid = self.path[len("/v1/traces/"):]
+            exemplar = (_tm.reqtrace.chrome_trace(tid)
+                        if _tm.reqtrace_enabled() else None)
+            if exemplar is None:
+                self._error(404, f"no captured trace {tid!r}")
+            else:
+                self._reply(200, exemplar)
         else:
             self._error(404, f"no route {self.path!r}")
 
@@ -170,6 +195,9 @@ class _Handler(BaseHTTPRequestHandler):
             rid = body.get("request_id") or self._request_id \
                 or uuid.uuid4().hex[:16]
             self._request_id = rid = str(rid)
+            if _tm.reqtrace_enabled():
+                _tm.reqtrace.trace_begin(rid, path=self.path,
+                                         model=name)
             version = body.get("version", m.group("version"))
             if body.get("max_new_tokens") is not None:
                 with _tm.span("serving.http.predict", model=name,
@@ -190,25 +218,35 @@ class _Handler(BaseHTTPRequestHandler):
                     "model": name, "version": version,
                     "request_id": rid}
         except KeyError as e:
+            self._finish_trace("not_found")
             self._error(404, str(e))
         except DeadlineExceeded as e:
+            self._finish_trace("deadline", trigger="deadline")
             self._error(504, str(e), kind="deadline")
         except PreemptedError as e:
+            self._finish_trace("preempted")
             self._error(429, str(e), kind="preempted")
         except ServerClosed as e:
+            self._finish_trace("draining")
             self._error(503, str(e), kind="draining")
         except BrownoutShed as e:
+            self._finish_trace("shed", trigger="shed")
             self._error(429, str(e), kind="brownout",
                         retry_after=e.retry_after_s)
         except RetryBudgetExhausted as e:
+            self._finish_trace("retry_budget", trigger="budget")
             self._error(429, str(e), kind="retry_budget")
         except RejectedError as e:
+            self._finish_trace("rejected", trigger="shed")
             self._error(429, str(e), kind="rejected")
         except (ValueError, TypeError) as e:
+            self._finish_trace("bad_request")
             self._error(400, f"bad request: {e}")
         except Exception as e:              # noqa: BLE001 — last resort
+            self._finish_trace("internal")
             self._error(500, f"{type(e).__name__}: {e}")
         else:
+            self._finish_trace("ok")
             self._reply(200, payload)
 
     def _decode_request(self, name, body, version):

@@ -208,6 +208,11 @@ class ModelServer:
             raise KeyError(f"model {name!r} has no decode tier; "
                            f"decoders: {sorted(self._decoders)}")
         t0 = time.perf_counter()
+        if request_id and _tm.reqtrace_enabled():
+            _tm.reqtrace.trace_begin(request_id, model=name)
+            _tm.reqtrace.event(request_id, "server.decode.submit",
+                               model=name, tenant=tenant,
+                               max_new_tokens=max_new_tokens)
         future = decoder.submit(src, src_len=src_len, tenant=tenant,
                                 max_new_tokens=max_new_tokens,
                                 deadline_ms=deadline_ms,
@@ -270,6 +275,10 @@ class ModelServer:
         served = self._served[(name, version)]
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
+        if request_id and _tm.reqtrace_enabled():
+            _tm.reqtrace.trace_begin(request_id, model=name)
+            _tm.reqtrace.event(request_id, "server.submit",
+                               model=name, version=version)
         return served.batcher.submit(feed, deadline_ms=deadline_ms,
                                      request_id=request_id), \
             version
@@ -354,6 +363,12 @@ class ModelServer:
                                        for r in batch.requests
                                        if r.request_id] or None):
                 outs = served.engine.run(padded)
+            if _tm.reqtrace_enabled():
+                for r in batch.requests:
+                    if r.request_id:
+                        _tm.reqtrace.event(
+                            r.request_id, "batch.run", rows=true_rows,
+                            bucket=bucket, model=served.name)
             if _tm.enabled():
                 _tm.counter("serving.batch_rows_total").inc(true_rows)
                 _tm.counter("serving.pad_rows_total").inc(

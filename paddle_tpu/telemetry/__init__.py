@@ -55,13 +55,14 @@ __all__ = ["enabled", "enable", "disable", "counter", "gauge",
            "device_memory_supported", "reset", "flush", "fleet",
            "append_span", "now_us", "instant_event", "Counter",
            "Gauge", "Histogram", "SpanRecord", "DEFAULT_TIME_BUCKETS",
-           "attribution", "slo"]
+           "attribution", "slo", "reqtrace", "reqtrace_enabled",
+           "reqtrace_enable", "reqtrace_disable"]
 
 
 def __getattr__(name):
-    # attribution/slo load lazily: the off-path contract (bench pin)
-    # is that a telemetry-disabled run never even imports them
-    if name in ("attribution", "slo"):
+    # attribution/slo/reqtrace load lazily: the off-path contract
+    # (bench pin) is that a disabled run never even imports them
+    if name in ("attribution", "slo", "reqtrace"):
         import importlib
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute "
@@ -96,6 +97,27 @@ def disable():
 # span()/fleet consult the same flag without importing this module back
 _spans._span_enabled = enabled
 fleet._enabled = enabled
+
+
+_REQTRACE = _env_truthy(os.environ.get("PADDLE_TPU_REQTRACE"))
+
+
+def reqtrace_enabled():
+    """Gate every request-tracing seam checks before touching the
+    reqtrace module: a plain bool, so `PADDLE_TPU_REQTRACE` unset costs
+    one flag check and provably never imports
+    paddle_tpu.telemetry.reqtrace (pinned by test_bench_contract)."""
+    return _REQTRACE
+
+
+def reqtrace_enable():
+    global _REQTRACE
+    _REQTRACE = True
+
+
+def reqtrace_disable():
+    global _REQTRACE
+    _REQTRACE = False
 
 
 def snapshot():
@@ -151,6 +173,14 @@ def flush(log=True):
         with open(os.path.join(out_dir, "metrics.prom"), "w") as f:
             f.write(prometheus_text())
         write_chrome_trace(os.path.join(out_dir, "trace.json"))
+        # request-trace exemplars ride the same artifact directory —
+        # but only if reqtrace was ever loaded (a sys.modules probe,
+        # like reset() uses for attribution, keeps the off-path pure)
+        import sys
+        rt = sys.modules.get(__name__ + ".reqtrace")
+        if rt is not None:
+            with open(os.path.join(out_dir, "traces.json"), "w") as f:
+                json.dump(rt.dump(), f, indent=2, default=str)
     if r is not None and fleet.spool_dir() is not None:
         try:
             fleet.write_rank_snapshot()

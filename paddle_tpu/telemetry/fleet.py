@@ -666,6 +666,16 @@ class FleetCollector:
                         and ent.get("kind") != "histogram":
                     serving_scale[name[len("scale."):]] = \
                         ent["value"]
+            # request-trace exemplars (telemetry/reqtrace): the
+            # serving.trace.* gauges each rank's trace_end publishes —
+            # seen/kept/stored plus trigger.<name> counts (the tpustat
+            # traces line). Gauges, so a re-merged spool stays stable.
+            serving_traces = {}
+            for name, ent in m.items():
+                if name.startswith("serving.trace.") \
+                        and ent.get("kind") != "histogram":
+                    serving_traces[name[len("serving.trace."):]] = \
+                        ent["value"]
             per_rank[str(r)] = {
                 "steps": h["count"] if h else 0,
                 "step_seconds_mean": (h["sum"] / h["count"])
@@ -693,6 +703,7 @@ class FleetCollector:
                 "serving_replicas": serving_replicas,
                 "serving_guard": serving_guard,
                 "serving_scale": serving_scale,
+                "serving_traces": serving_traces,
                 "serving_tokens_total": sum(
                     int(d.get("tokens_total", 0))
                     for d in serving_replicas.values()),

@@ -1,0 +1,441 @@
+"""Per-request tracing with tail-based exemplar capture.
+
+Aggregate metrics (registry.py) answer "how slow is the p99"; this
+module answers "*why was that one request* the p99": every serving seam
+— http accept, batcher enqueue, router pick, hedge launch/cancel,
+guard shed, crash resubmit, decode admit/step/retire, prefill and KV
+handoff — emits an event into a bounded per-thread ring keyed by the
+request's `X-Request-Id`. The hot path never takes a lock and never
+allocates unboundedly: events go into a `deque(maxlen=...)` owned by
+the emitting thread; span ids come from an atomic counter.
+
+Tail-based capture: when a request *completes*, the trace decides
+whether it is worth keeping. Triggers:
+
+- ``p99``       latency above the live p99 of the completion window
+- ``deadline``  the request missed its deadline
+- ``shed``      brownout shed or queue rejection
+- ``budget``    retry/hedge token-budget denial
+- ``hedge``     a hedge was launched for it
+- ``resubmit``  it was resubmitted after a replica death
+- ``chaos``     a chaos fault hit it
+- ``error``     it failed with any other error
+
+Triggered traces are materialised into exemplars (their events are
+gathered from the rings and frozen); non-triggered completions keep a
+summary row only. The exemplar store is bounded by a fixed budget with
+a pinned eviction order: oldest *non-triggered* rows go first, and a
+triggered exemplar is never evicted while the triggered population
+fits the budget.
+
+Exemplars export as Chrome-trace JSON on the spans.py clock — one pid
+per replica (pid 0 is the frontend: http/batcher/router), events
+colored per request — so a hedged request renders as a causal chain
+across two replica tracks.
+
+Never imported unless `PADDLE_TPU_REQTRACE` is set (or
+`telemetry.reqtrace_enable()` is called): the serving seams gate on
+`telemetry.reqtrace_enabled()`, a plain bool check, before touching
+this module (pinned by tests/test_bench_contract.py).
+"""
+import collections
+import itertools
+import threading
+
+from .spans import _now_us
+
+__all__ = [
+    "trace_begin", "trace_end", "span", "span_at", "event", "leg",
+    "flag", "snapshot", "get", "exemplars", "chrome_trace",
+    "chrome_trace_from", "dump", "configure", "reset", "publish",
+    "TRIGGERS",
+]
+
+TRIGGERS = ("p99", "deadline", "shed", "budget", "hedge", "resubmit",
+            "chaos", "error")
+
+_RING_CAP = 8192          # events per emitting thread
+_BUDGET = 64              # exemplar store rows
+_MAX_ACTIVE = 4096        # in-flight trace contexts
+_LAT_WINDOW = 512         # completion latencies feeding the live p99
+_P99_MIN_SAMPLES = 32     # below this the p99 trigger stays silent
+
+_span_ids = itertools.count(1)           # CPython-atomic
+_tls = threading.local()
+_reg_lock = threading.Lock()
+_rings = []                              # every thread's deque
+
+_lock = threading.Lock()                 # trace begin/end/flag only
+_active = {}                             # trace_id -> _Trace
+_store = collections.OrderedDict()       # trace_id -> exemplar dict
+_lat = collections.deque(maxlen=_LAT_WINDOW)
+
+seen = 0                                 # completed traces
+kept = 0                                 # triggered exemplars captured
+dropped = 0                              # begins refused (active cap)
+trigger_counts = collections.Counter()
+
+
+class _Trace(object):
+    __slots__ = ("trace_id", "t0_us", "root_id", "flags", "legs",
+                 "args")
+
+    def __init__(self, trace_id, args):
+        self.trace_id = trace_id
+        self.t0_us = _now_us()
+        self.root_id = next(_span_ids)
+        self.flags = set()
+        self.legs = {}                   # replica index -> leg span id
+        self.args = args
+
+
+def _ring():
+    r = getattr(_tls, "ring", None)
+    if r is None:
+        r = _tls.ring = collections.deque(maxlen=_RING_CAP)
+        with _reg_lock:
+            _rings.append(r)
+    return r
+
+
+def _emit(trace_id, name, ph, ts_us, dur_us, replica, parent_id,
+          span_id, args):
+    # hot path: no lock — the ring belongs to this thread
+    _ring().append((trace_id, span_id, parent_id, name, ph, ts_us,
+                    dur_us, replica, threading.get_ident(), args))
+    return span_id
+
+
+def _parent_for(trace_id, replica):
+    t = _active.get(trace_id)            # GIL-atomic read
+    if t is None:
+        return None
+    if replica is not None:
+        leg_id = t.legs.get(replica)
+        if leg_id is not None:
+            return leg_id
+    return t.root_id
+
+
+# ----------------------------------------------------------- context
+def trace_begin(trace_id, **args):
+    """Open a trace for one request id. Idempotent: a second begin for
+    a live id (a hedge leg, a resubmission) reuses the original
+    context — one request keeps one trace end-to-end."""
+    if not trace_id:
+        return None
+    with _lock:
+        t = _active.get(trace_id)
+        if t is not None:
+            return t.root_id
+        if len(_active) >= _MAX_ACTIVE:
+            global dropped
+            dropped += 1
+            return None
+        t = _active[trace_id] = _Trace(trace_id, args)
+    _emit(trace_id, "request", "B", t.t0_us, 0, None, None, t.root_id,
+          args or None)
+    return t.root_id
+
+
+def flag(trace_id, trigger):
+    """Mark a capture trigger on a live trace (hedge, resubmit, shed,
+    budget, deadline, chaos, error)."""
+    t = _active.get(trace_id)
+    if t is not None:
+        t.flags.add(trigger)
+
+
+def leg(trace_id, replica, kind="primary", **args):
+    """Open a per-replica leg of the trace (the primary routing, a
+    hedge duplicate, a resubmission). Scheduler/engine events carrying
+    this replica index parent to the leg, which is what makes the
+    cross-replica causal chain hang together."""
+    t = _active.get(trace_id)
+    if t is None:
+        return None
+    span_id = next(_span_ids)
+    t.legs[replica] = span_id
+    a = {"kind": kind, "replica": replica}
+    if args:
+        a.update(args)
+    _emit(trace_id, "leg.%s" % kind, "i", _now_us(), 0, replica,
+          t.root_id, span_id, a)
+    return span_id
+
+
+def event(trace_id, name, replica=None, **args):
+    """Zero-duration instant on the request's timeline."""
+    if not trace_id:
+        return None
+    return _emit(trace_id, name, "i", _now_us(), 0, replica,
+                 _parent_for(trace_id, replica), next(_span_ids),
+                 args or None)
+
+
+class _SpanCM(object):
+    __slots__ = ("trace_id", "name", "replica", "args", "t0")
+
+    def __init__(self, trace_id, name, replica, args):
+        self.trace_id = trace_id
+        self.name = name
+        self.replica = replica
+        self.args = args
+
+    def __enter__(self):
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t0 = self.t0
+        _emit(self.trace_id, self.name, "X", t0, _now_us() - t0,
+              self.replica, _parent_for(self.trace_id, self.replica),
+              next(_span_ids), self.args or None)
+        return False
+
+
+class _NullCM(object):
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL = _NullCM()
+
+
+def span(trace_id, name, replica=None, **args):
+    """Duration span on the request's timeline (context manager)."""
+    if not trace_id:
+        return _NULL
+    return _SpanCM(trace_id, name, replica, args or None)
+
+
+def span_at(trace_id, name, t0_us, dur_us, replica=None, **args):
+    """Record a span whose start was observed earlier (e.g. a decode
+    slot's admit→retire lifetime, stamped at retire)."""
+    if not trace_id:
+        return None
+    return _emit(trace_id, name, "X", t0_us, dur_us, replica,
+                 _parent_for(trace_id, replica), next(_span_ids),
+                 args or None)
+
+
+# ------------------------------------------------------- completion
+def _p99():
+    n = len(_lat)
+    if n < _P99_MIN_SAMPLES:
+        return None
+    vals = sorted(_lat)
+    return vals[min(n - 1, int(0.99 * (n - 1) + 0.5))]
+
+
+def _gather(trace_id):
+    with _reg_lock:
+        rings = list(_rings)
+    evs = []
+    for r in rings:
+        # snapshot under the GIL; concurrent appends may be missed for
+        # *other* traces, never for this one (its request is done)
+        evs.extend(e for e in list(r) if e[0] == trace_id)
+    evs.sort(key=lambda e: (e[5], e[1]))
+    return [{"span_id": e[1], "parent_id": e[2], "name": e[3],
+             "ph": e[4], "ts_us": e[5], "dur_us": e[6],
+             "replica": e[7], "tid": e[8], "args": e[9]}
+            for e in evs]
+
+
+def _evict_locked():
+    while len(_store) > _BUDGET:
+        victim = None
+        for tid, row in _store.items():
+            if not row["triggers"]:
+                victim = tid
+                break
+        if victim is None:
+            # every row is triggered and we are over budget: only now
+            # may a triggered exemplar go, oldest first
+            victim = next(iter(_store))
+        del _store[victim]
+
+
+def trace_end(trace_id, status="ok", latency_s=None, **args):
+    """Complete a trace: evaluate triggers, capture an exemplar when
+    one fired, keep a summary row otherwise. Returns the trigger list
+    (empty when the trace was not worth keeping in full)."""
+    if not trace_id:
+        return []
+    with _lock:
+        t = _active.pop(trace_id, None)
+        if t is None:
+            return []
+        global seen, kept
+        seen += 1
+        now = _now_us()
+        if latency_s is None:
+            latency_s = (now - t.t0_us) / 1e6
+        trig = set(t.flags)
+        if status not in ("ok",):
+            trig.add("error")
+        p99 = _p99()
+        if p99 is not None and latency_s > p99:
+            trig.add("p99")
+        _lat.append(latency_s)
+        triggers = sorted(trig)
+        for name in triggers:
+            trigger_counts[name] += 1
+        row = {"trace_id": trace_id, "status": status,
+               "latency_ms": latency_s * 1000.0, "triggers": triggers,
+               "t0_us": t.t0_us, "root_id": t.root_id,
+               "args": dict(t.args, **args) if (t.args or args)
+               else None, "events": None}
+        if triggers:
+            kept += 1
+        _store[trace_id] = row
+        _store.move_to_end(trace_id)
+        _evict_locked()
+    _emit(trace_id, "request", "E", now,
+          int(latency_s * 1e6), None, None, t.root_id,
+          {"status": status} if not args else dict(args, status=status))
+    if triggers and trace_id in _store:
+        # materialise outside the lock: ring scan is the slow part and
+        # only triggered (tail) traces pay it
+        events = _gather(trace_id)
+        with _lock:
+            live = _store.get(trace_id)
+            if live is not None:
+                live["events"] = events
+    publish()
+    return triggers
+
+
+# --------------------------------------------------------- exports
+def snapshot():
+    """Counters plus summary rows for every stored trace (newest
+    last). The shape behind ``GET /v1/traces`` and ``tputrace list``."""
+    with _lock:
+        rows = [{k: v for k, v in row.items() if k != "events"}
+                for row in _store.values()]
+        for row, full in zip(rows, _store.values()):
+            row["captured"] = full["events"] is not None
+            row["n_events"] = (len(full["events"])
+                               if full["events"] else 0)
+        return {"enabled": True, "seen": seen, "kept": kept,
+                "dropped": dropped, "budget": _BUDGET,
+                "stored": len(_store),
+                "triggers": dict(trigger_counts), "traces": rows}
+
+
+def get(trace_id):
+    """Full exemplar (summary + events) or None."""
+    with _lock:
+        row = _store.get(trace_id)
+        return dict(row) if row is not None else None
+
+
+def exemplars():
+    """Stored trace ids in insertion order (oldest first)."""
+    with _lock:
+        return list(_store)
+
+
+_CNAMES = ("thread_state_running", "rail_response", "rail_animation",
+           "rail_idle", "rail_load", "cq_build_running",
+           "cq_build_passed", "thread_state_iowait", "good",
+           "vsync_highlight_color", "heap_dump_stack_frame",
+           "olive", "generic_work")
+
+
+def chrome_trace(trace_id):
+    """One exemplar as Chrome trace-event JSON: pid 0 is the frontend
+    (http/batcher/router/guard), pid i+1 is replica i; all events carry
+    the request's color so multiple exported traces stay tellable
+    apart."""
+    return chrome_trace_from(get(trace_id))
+
+
+def chrome_trace_from(row):
+    """Convert one exemplar row (live, or loaded back from a
+    traces.json artifact) to Chrome trace-event JSON."""
+    if row is None:
+        return None
+    trace_id = row["trace_id"]
+    # color per request, stable across processes (hash() is salted)
+    cname = _CNAMES[sum(trace_id.encode()) % len(_CNAMES)]
+    out, pids = [], {}
+    for e in row["events"] or []:
+        rep = e["replica"]
+        pid = 0 if rep is None else int(rep) + 1
+        pids.setdefault(pid, "frontend" if rep is None
+                        else "replica %d" % rep)
+        ev = {"name": e["name"], "ph": "X" if e["ph"] == "X" else "i",
+              "ts": e["ts_us"], "pid": pid, "tid": e["tid"],
+              "cat": "reqtrace", "cname": cname,
+              "args": dict(e["args"] or {}, request_id=trace_id,
+                           span_id=e["span_id"],
+                           parent_id=e["parent_id"])}
+        if e["ph"] == "X":
+            ev["dur"] = max(0, e["dur_us"])
+        else:
+            ev["s"] = "t"
+        out.append(ev)
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": label}}
+            for pid, label in sorted(pids.items())]
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms",
+            "metadata": {"trace_id": trace_id, "status": row["status"],
+                         "latency_ms": row["latency_ms"],
+                         "triggers": row["triggers"]}}
+
+
+def dump():
+    """Everything, events included — the traces.json artifact
+    telemetry.flush writes (what `tputrace list/show --path` reads)."""
+    with _lock:
+        full = [dict(row) for row in _store.values()]
+    return {"enabled": True, "seen": seen, "kept": kept,
+            "dropped": dropped, "budget": _BUDGET,
+            "triggers": dict(trigger_counts), "traces": full}
+
+
+def publish():
+    """Mirror the capture counters into the metrics registry so fleet
+    spool rows (and `tpustat --fleet/--watch`) carry per-rank trace
+    pressure. Gauges, not counters: re-publishing is idempotent and
+    the fleet merge stays stable on re-merge."""
+    from . import enabled, gauge
+    if not enabled():
+        return
+    gauge("serving.trace.seen").set(seen)
+    gauge("serving.trace.kept").set(kept)
+    gauge("serving.trace.stored").set(len(_store))
+    for name, n in trigger_counts.items():
+        gauge("serving.trace.trigger.%s" % name).set(n)
+
+
+# ----------------------------------------------------------- config
+def configure(budget=None, ring_cap=None, p99_min_samples=None):
+    """Test/ops hook. ring_cap only affects rings created after the
+    call (existing per-thread rings keep their bound)."""
+    global _BUDGET, _RING_CAP, _P99_MIN_SAMPLES
+    if budget is not None:
+        _BUDGET = int(budget)
+    if ring_cap is not None:
+        _RING_CAP = int(ring_cap)
+    if p99_min_samples is not None:
+        _P99_MIN_SAMPLES = int(p99_min_samples)
+
+
+def reset():
+    """Drop all traces, rings, and counters (not the config)."""
+    global seen, kept, dropped
+    with _lock:
+        _active.clear()
+        _store.clear()
+        _lat.clear()
+        trigger_counts.clear()
+        seen = kept = dropped = 0
+    with _reg_lock:
+        for r in _rings:
+            r.clear()

@@ -309,11 +309,47 @@ def append_history(path, records):
     return len(records)
 
 
+def _normalized_prior(prior, latest, direction):
+    """Prior values expressed in the LATEST record's machine units.
+
+    Records carry `calib_ms` — the wall time of bench.py's fixed
+    calibration microbenchmark on the box that produced them; the
+    ratio of two stamps is the relative speed of the two boxes. A
+    prior throughput measured on a box 2x faster than today's is
+    halved before it joins the rolling median (a latency is doubled),
+    so a spine that spans CI machine generations gates on the CODE's
+    trajectory, not the hardware lottery.
+
+    When the latest record is calibrated, uncalibrated prior records
+    are EXCLUDED (no ratio exists — comparing them raw is exactly the
+    cross-box bug this removes). When the latest record itself has no
+    stamp, values pass through untouched (the pre-calibration
+    behavior). Returns (values, n_excluded)."""
+    latest_calib = latest.get("calib_ms")
+    if not latest_calib:
+        return [r["value"] for r in prior], 0
+    vals = []
+    excluded = 0
+    for r in prior:
+        c = r.get("calib_ms")
+        if not c:
+            excluded += 1
+            continue
+        if direction == "higher":
+            # prior box faster (smaller calib_ms) -> its throughput
+            # is inflated relative to this box -> scale it down
+            vals.append(r["value"] * (c / latest_calib))
+        else:
+            vals.append(r["value"] * (latest_calib / c))
+    return vals, excluded
+
+
 def history_gate(records, k=_DEFAULT_K_MAD, window=20,
                  platform=None):
     """Regression-gate the newest record of each metric against the
-    rolling median of its predecessors. Records for other platforms
-    are excluded (a CPU smoke run must not drag a TPU baseline).
+    rolling median of its predecessors, calibration-normalized (see
+    `_normalized_prior`). Records for other platforms are excluded (a
+    CPU smoke run must not drag a TPU baseline).
     Returns {"ok", "checked", "regressions": [per-metric dicts]}."""
     by_metric = {}
     for rec in records:
@@ -326,12 +362,18 @@ def history_gate(records, k=_DEFAULT_K_MAD, window=20,
         if len(recs) < 2:
             continue                     # nothing to compare against
         *prior, latest = recs
-        checked += 1
         direction = metric_direction(metric, latest.get("unit"))
+        vals, excluded = _normalized_prior(prior, latest, direction)
+        if not vals:
+            continue          # nothing commensurable to compare against
+        checked += 1
         res = check_regression(
-            [r["value"] for r in prior], latest["value"],
+            vals, latest["value"],
             direction=direction, k=k, window=window)
         res["metric"] = metric
+        if latest.get("calib_ms"):
+            res["calib_ms"] = latest["calib_ms"]
+            res["excluded_uncalibrated"] = excluded
         if res["regressed"]:
             regressions.append(res)
     return {"ok": not regressions, "checked": checked,

@@ -234,6 +234,65 @@ def test_guard_off_paths_untouched():
     assert "GUARD_OFF_OK" in p.stdout
 
 
+def test_reqtrace_off_paths_untouched():
+    """tputrace's off contract (the bench-contract pin): with
+    PADDLE_TPU_REQTRACE unset, serving a request through the farm
+    never imports telemetry.reqtrace — every seam is one bool check —
+    and flipping tracing on decodes byte-identical tokens."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import telemetry as tm\n"
+        "from paddle_tpu.core import framework as fw\n"
+        "from paddle_tpu.models import transformer as tfm\n"
+        "from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup\n"
+        "from paddle_tpu.serving.decode import (DecodeConfig,"
+        " DecodeEngineConfig)\n"
+        "assert tm.reqtrace_enabled() is False\n"
+        "cfg = tfm.TransformerConfig(src_vocab=16, trg_vocab=16,"
+        " max_len=8, d_model=8, d_inner=16, n_head=2, n_layer=1,"
+        " dropout=0.0, label_smooth_eps=0.0)\n"
+        "infer, start = fw.Program(), fw.Program()\n"
+        "with pt.program_guard(infer, start):\n"
+        "    with pt.unique_name.guard():\n"
+        "        tfm.build_infer_program(cfg, maxlen=8)\n"
+        "pt.Executor(pt.CPUPlace()).run(start)\n"
+        "scope = pt.global_scope()\n"
+        "params = {v.name: np.asarray(scope.get(v.name))"
+        " for v in infer.persistable_vars()}\n"
+        "group = ReplicaGroup(cfg, params, FarmConfig(replicas=1,"
+        " engine=DecodeEngineConfig(num_slots=2, max_len=8,"
+        " prefill_buckets=(1, 2)),"
+        " decode=DecodeConfig(bos=0)), name='quiet')\n"
+        "def run(rid):\n"
+        "    fut = group.submit(np.arange(2, 6).astype('int64'),"
+        " src_len=4, max_new_tokens=3, request_id=rid)\n"
+        "    for _ in range(60):\n"
+        "        if fut.done():\n"
+        "            break\n"
+        "        group.run_iteration()\n"
+        "    return np.asarray(fut.result(timeout=0).tokens,"
+        " np.int64)\n"
+        "off = run('r-off')\n"
+        "assert 'paddle_tpu.telemetry.reqtrace' not in sys.modules, "
+        "'trace-off serving imported the tracer'\n"
+        "tm.reqtrace_enable()\n"
+        "on = run('r-on')\n"
+        "assert off.tobytes() == on.tobytes(), "
+        "'tracing changed the decoded bytes'\n"
+        "assert tm.reqtrace.trace_end('r-on') == []\n"
+        "assert tm.reqtrace.snapshot()['seen'] == 1\n"
+        "print('REQTRACE_OFF_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_REQTRACE", None)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=REPO)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-1200:])
+    assert "REQTRACE_OFF_OK" in p.stdout
+
+
 def test_scale_off_paths_untouched():
     """tpuscale's off contract (the bench-contract pin): a farm with
     no ScalePolicy never imports the serving.scale package — no

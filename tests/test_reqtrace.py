@@ -1,0 +1,376 @@
+"""tputrace — end-to-end request tracing with tail-exemplar capture:
+the exemplar store's trigger-aware eviction, the live-p99 trigger,
+hedged cross-replica causality under replica_slow chaos, the
+one-request-one-id invariant through minted ids / hedge duplicates /
+crash resubmission, the `GET /v1/traces` surface, and the
+`tputrace --selftest` CI gate."""
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import telemetry as tm
+from paddle_tpu.core import framework as fw
+from paddle_tpu.models import transformer as tfm
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.chaos import ChaosFault
+from paddle_tpu.serving.decode import DecodeConfig, DecodeEngineConfig
+from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup
+from paddle_tpu.serving.guard import GuardConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Tracing off and empty on both sides; restore the default
+    exemplar budget so one test's configure() can't leak."""
+    tm.disable()
+    tm.reset()
+    chaos.reset()
+    tm.reqtrace_disable()
+    rt = sys.modules.get("paddle_tpu.telemetry.reqtrace")
+    if rt is not None:
+        rt.reset()
+        rt.configure(budget=64, ring_cap=8192, p99_min_samples=32)
+    yield
+    tm.disable()
+    tm.reset()
+    chaos.reset()
+    tm.reqtrace_disable()
+    rt = sys.modules.get("paddle_tpu.telemetry.reqtrace")
+    if rt is not None:
+        rt.reset()
+        rt.configure(budget=64, ring_cap=8192, p99_min_samples=32)
+
+
+# ---------------------------------------------------------------- helpers
+def _seeded_stack(maxlen=12, seed=7, n_layer=2):
+    cfg = tfm.TransformerConfig(src_vocab=64, trg_vocab=64,
+                                max_len=maxlen, d_model=32, d_inner=64,
+                                n_head=4, n_layer=n_layer, dropout=0.0,
+                                label_smooth_eps=0.0)
+    infer, start = fw.Program(), fw.Program()
+    with pt.program_guard(infer, start):
+        with pt.unique_name.guard():
+            _feeds, logits = tfm.build_infer_program(cfg, maxlen=maxlen)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(start)
+    rng = np.random.RandomState(seed)
+    scope = pt.global_scope()
+    params = {}
+    for v in infer.persistable_vars():
+        a = np.asarray(scope.get(v.name))
+        if v.name.startswith("layer_norm") and v.name.endswith(".w_0"):
+            nv = 1.0 + 0.2 * rng.randn(*a.shape)
+        elif v.name.endswith(".b_0"):
+            nv = 0.1 * rng.randn(*a.shape)
+        else:
+            nv = 0.35 * rng.randn(*a.shape)
+        nv = nv.astype(a.dtype)
+        scope.set(v.name, nv)
+        params[v.name] = nv
+    return cfg, exe, infer, logits, params
+
+
+def _group(cfg, params, replicas=2, slots=2, maxlen=12,
+           buckets=(1, 2), name="trace", retries=1, guard=None):
+    return ReplicaGroup(cfg, params, FarmConfig(
+        replicas=replicas,
+        engine=DecodeEngineConfig(num_slots=slots, max_len=maxlen,
+                                  prefill_buckets=buckets),
+        decode=DecodeConfig(bos=0, max_queue_requests=64),
+        retries=retries, guard=guard), name=name)
+
+
+def _hedge_cfg():
+    """Deterministic hedging: zero delay, unbounded tokens, and every
+    health/ejection trigger parked out of reach."""
+    return GuardConfig(hedge_fixed_delay_s=0.0, hedge_fraction=1.0,
+                       hedge_burst=1e9, retry_rate=1000.0,
+                       retry_burst=1000, slow_factor=1e9,
+                       enter_streak=10**6, err_probation=2.0,
+                       queue_high=10**9)
+
+
+def _greedy_ref(exe, infer, logits, src, src_len, maxlen, max_new):
+    row = np.zeros((1, maxlen), np.int64)
+    row[0, :len(src)] = src
+    ids = tfm.greedy_decode(exe, infer, logits, row,
+                            np.array([src_len], "int64"), bos=0,
+                            fetch_argmax=True)
+    return ids[0, 1:1 + max_new].astype(np.int64)
+
+
+def _drive(group, fut, budget=600):
+    """Manual guarded drive over every replica; chaos crashes recover
+    the way the real scheduler loop thread does."""
+    for _ in range(budget):
+        try:
+            return fut.result(timeout=0)
+        except TimeoutError:
+            pass
+        for r in group.replicas:
+            try:
+                r.scheduler.run_iteration()
+            except ChaosFault as e:
+                r.scheduler._crash_recover(e)
+                r.scheduler.restarts += 1
+    raise AssertionError("request never completed")
+
+
+def _trace_on():
+    tm.enable()
+    tm.reqtrace_enable()
+    rt = tm.reqtrace
+    rt.reset()
+    return rt
+
+
+# ------------------------------------------------- exemplar store rules
+def test_exemplar_budget_eviction_prefers_untriggered():
+    """Over budget, the oldest NON-triggered row goes first; a
+    triggered exemplar is only evicted once every stored row is
+    triggered — and then oldest-first."""
+    rt = _trace_on()
+    rt.configure(budget=3)
+
+    def end(tid, trigger=None):
+        rt.trace_begin(tid)
+        if trigger:
+            rt.flag(tid, trigger)
+        rt.trace_end(tid)
+
+    end("u1")
+    end("u2")
+    end("t3", "hedge")
+    assert rt.exemplars() == ["u1", "u2", "t3"]
+    end("u4")                       # oldest untriggered (u1) evicted
+    assert rt.exemplars() == ["u2", "t3", "u4"]
+    end("t5", "shed")               # u2 out; t3 survives though older
+    assert rt.exemplars() == ["t3", "u4", "t5"]
+    end("t6", "chaos")              # u4 out, never a triggered row
+    assert rt.exemplars() == ["t3", "t5", "t6"]
+    end("t7", "resubmit")           # all triggered: only now oldest
+    assert rt.exemplars() == ["t5", "t6", "t7"]
+    snap = rt.snapshot()
+    assert snap["seen"] == 7 and snap["kept"] == 4
+    assert snap["stored"] == 3 and snap["budget"] == 3
+    # evicted exemplars stay counted in the trigger mix
+    assert snap["triggers"]["hedge"] == 1
+
+
+def test_live_p99_trigger_needs_warmup_then_fires():
+    rt = _trace_on()
+    for i in range(40):
+        rt.trace_begin(f"warm-{i}")
+        assert rt.trace_end(f"warm-{i}", latency_s=0.01) == [], \
+            "uniform latency must never trip the p99 trigger"
+    rt.trace_begin("tail")
+    assert "p99" in rt.trace_end("tail", latency_s=1.0)
+    assert rt.get("tail")["events"] is not None
+
+
+# ------------------------------------- causality under chaos (tentpole)
+def test_hedged_trace_causality_under_replica_slow():
+    """replica_slow chaos on replica 0 forces the zero-delay hedge to
+    win from the other replica; the exemplar must hold BOTH legs under
+    one root, every decode event parented to its replica's leg, and
+    tokens identical to the unhedged greedy reference."""
+    rt = _trace_on()
+    maxlen = 12
+    cfg, exe, infer, logits, params = _seeded_stack(maxlen=maxlen)
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   guard=_hedge_cfg(), name="trhedge", retries=2)
+    group.start()
+    try:
+        chaos.configure("replica_slow:ms=60,replica=0")
+        src = np.arange(2, 9).astype("int64")
+        res = group.decode(src, src_len=7, max_new_tokens=6,
+                           timeout=60.0, request_id="hedge-t1")
+        chaos.reset()
+        trig = rt.trace_end("hedge-t1")
+    finally:
+        group.stop(drain=True, timeout=30.0)
+
+    assert "hedge" in trig
+    row = rt.get("hedge-t1")
+    assert row["events"], "a triggered trace must capture its events"
+    names = [e["name"] for e in row["events"]]
+    for needed in ("request", "leg.primary", "leg.hedge",
+                   "farm.hedge.launch", "farm.win", "decode.enqueue",
+                   "decode.admit", "decode.retire"):
+        assert needed in names, f"missing {needed} in {sorted(set(names))}"
+
+    legs = [e for e in row["events"] if e["name"] in
+            ("leg.primary", "leg.hedge")]
+    assert len(legs) == 2
+    assert {e["replica"] for e in legs} == {0, 1}, \
+        "hedge leg must land on the other replica"
+    assert all(e["parent_id"] == row["root_id"] for e in legs), \
+        "both legs must parent directly to the request root"
+    leg_span = {e["replica"]: e["span_id"] for e in legs}
+    scoped = [e for e in row["events"]
+              if e["name"].startswith(("decode.", "engine."))
+              and e["replica"] in leg_span]
+    assert scoped, "decode-tier events must appear in the exemplar"
+    for e in scoped:
+        assert e["parent_id"] == leg_span[e["replica"]], \
+            f"{e['name']} on replica {e['replica']} parented wrong"
+
+    win = [e for e in row["events"] if e["name"] == "farm.win"]
+    assert len(win) == 1 and win[0]["replica"] == 1, \
+        "the slow replica must lose under replica_slow chaos"
+    exp = _greedy_ref(exe, infer, logits, src, 7, maxlen, 6)
+    np.testing.assert_array_equal(np.asarray(res.tokens, np.int64), exp)
+
+
+def test_minted_request_id_joins_all_hedge_legs():
+    """Satellite bugfix pin: submit() with no request_id mints ONE id
+    before any leg diverges; the hedge duplicate joins the same trace
+    instead of starting an orphan."""
+    rt = _trace_on()
+    maxlen = 12
+    cfg, exe, infer, logits, params = _seeded_stack(maxlen=maxlen)
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   guard=_hedge_cfg(), name="trmint", retries=2)
+    src = np.arange(2, 9).astype("int64")
+    fut = group.submit(src, src_len=7, max_new_tokens=4)
+    rid = fut._kwargs.get("request_id")
+    assert rid, "tracing on: the farm must mint a request id"
+    res = _drive(group, fut)
+    trig = rt.trace_end(rid)
+    assert "hedge" in trig
+    row = rt.get(rid)
+    legs = [e for e in row["events"] if e["name"] in
+            ("leg.primary", "leg.hedge")]
+    assert len(legs) == 2 and len({e["replica"] for e in legs}) == 2, \
+        "both hedge legs must ride the single minted id"
+    assert rt.snapshot()["seen"] == 1, \
+        "one request = one trace, hedging must not double-count"
+    exp = _greedy_ref(exe, infer, logits, src, 7, maxlen, 4)
+    np.testing.assert_array_equal(np.asarray(res.tokens, np.int64), exp)
+
+
+def test_one_request_id_survives_crash_resubmit():
+    """worker_crash kills the first leg mid-flight; the resubmitted
+    leg keeps the ORIGINAL id, the exemplar shows the fault, the
+    resubmit hop, and legs on two replicas, tokens unharmed."""
+    rt = _trace_on()
+    maxlen = 12
+    cfg, exe, infer, logits, params = _seeded_stack(maxlen=maxlen)
+    gcfg = GuardConfig(hedge=False, slow_factor=1e9, retry_rate=1000.0,
+                       retry_burst=1000, enter_streak=10**6,
+                       err_probation=2.0, queue_high=10**9)
+    group = _group(cfg, params, replicas=2, slots=2, maxlen=maxlen,
+                   guard=gcfg, name="trcrash", retries=3)
+    # at=2: the request's first working iteration admits it, the
+    # second crashes with the slot ACTIVE -> the leg dies -> resubmit
+    chaos.configure("worker_crash:at=2")
+    src = np.arange(2, 9).astype("int64")
+    fut = group.submit(src, src_len=7, max_new_tokens=5,
+                       request_id="crash-t1")
+    res = _drive(group, fut)
+    chaos.reset()
+    trig = rt.trace_end("crash-t1")
+    assert "resubmit" in trig and "chaos" in trig
+    row = rt.get("crash-t1")
+    names = [e["name"] for e in row["events"]]
+    assert "farm.resubmit" in names and "chaos.fault" in names
+    legs = [e for e in row["events"]
+            if e["name"].startswith("leg.")]
+    assert len({e["replica"] for e in legs}) == 2, \
+        "the resubmitted leg must land on the surviving replica"
+    assert rt.snapshot()["seen"] == 1
+    exp = _greedy_ref(exe, infer, logits, src, 7, maxlen, 5)
+    np.testing.assert_array_equal(np.asarray(res.tokens, np.int64), exp)
+
+
+# --------------------------------------------------- HTTP surface
+def test_http_traces_route_and_error_exemplar(tmp_path):
+    from paddle_tpu import layers
+    from paddle_tpu.serving import (BatchConfig, HttpFrontend,
+                                    ModelServer, ServerConfig)
+    img = layers.data("img", shape=[8])
+    pred = layers.fc(img, 4, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.io.save_inference_model(str(tmp_path), ["img"], [pred], exe)
+    tm.enable()
+    server = ModelServer(ServerConfig(
+        batch=BatchConfig(max_batch_size=4, buckets=(4,),
+                          max_wait_ms=1.0), workers=1))
+    server.load("m", str(tmp_path))
+    x = np.zeros((2, 8), dtype="float32")
+    with HttpFrontend(server, port=0) as fe:
+        # tracing off: the route answers with the disabled shape
+        with urllib.request.urlopen(fe.url + "/v1/traces",
+                                    timeout=30) as resp:
+            off = json.loads(resp.read())
+        assert off["enabled"] is False and off["traces"] == []
+
+        tm.reqtrace_enable()
+        req = urllib.request.Request(
+            fe.url + "/v1/models/m:predict",
+            data=json.dumps({"inputs": {"img": x.tolist()},
+                             "request_id": "http-ok-1"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["X-Request-Id"] == "http-ok-1"
+        # malformed body -> 400 -> status bad_request -> error trigger
+        req = urllib.request.Request(
+            fe.url + "/v1/models/m:predict", data=b'{"inputs": "nope"}',
+            headers={"X-Request-Id": "http-err-1"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=30)
+        assert err.value.code == 400
+
+        with urllib.request.urlopen(fe.url + "/v1/traces",
+                                    timeout=30) as resp:
+            idx = json.loads(resp.read())
+        assert idx["enabled"] is True and idx["seen"] == 2
+        rows = {r["trace_id"]: r for r in idx["traces"]}
+        assert rows["http-ok-1"]["status"] == "ok"
+        assert not rows["http-ok-1"]["captured"], \
+            "a clean request is summary-only, not an exemplar"
+        assert rows["http-err-1"]["status"] == "bad_request"
+        assert "error" in rows["http-err-1"]["triggers"]
+        assert rows["http-err-1"]["captured"]
+
+        # per-trace chrome payload + 404 for the unknown id
+        with urllib.request.urlopen(fe.url + "/v1/traces/http-err-1",
+                                    timeout=30) as resp:
+            chrome = json.loads(resp.read())
+        assert chrome["metadata"]["trace_id"] == "http-err-1"
+        assert any(ev["name"] == "request"
+                   for ev in chrome["traceEvents"])
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(fe.url + "/v1/traces/nope",
+                                   timeout=30)
+        assert err.value.code == 404
+    server.shutdown()
+
+
+# --------------------------------------------------------- CI gate
+def test_tputrace_selftest_subprocess():
+    """The acceptance path (tpudoctor pattern): deterministic chaos
+    run captures exemplars for exactly the triggered requests, the
+    hedged exemplar holds the full causal chain, trace-off stays
+    import-pure and byte-identical — as a CPU-only subprocess."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("PADDLE_TPU_TELEMETRY", "PADDLE_TPU_REQTRACE",
+              "PADDLE_TPU_TELEMETRY_DIR"):
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tputrace.py"),
+         "--selftest", "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=540)
+    assert p.returncode == 0, p.stdout + "\n" + p.stderr
+    verdict = json.loads(p.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True and verdict["problems"] == []

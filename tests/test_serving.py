@@ -377,7 +377,11 @@ def test_concurrent_same_signature_compiles_once(tmp_path):
     assert eng.signature_count() == 1
     dedup = snap.get("inference.compile_dedup_count", 0)
     hits = snap.get("inference.cache_hit_count", 0)
-    assert dedup + hits == n_threads - 1
+    # every non-leader either cache-hit directly (leader finished
+    # first) or deduped on the in-flight event AND cache-hit on its
+    # retry loop — one or two counts per waiter depending on
+    # scheduling, never a compile
+    assert n_threads - 1 <= dedup + hits <= 2 * (n_threads - 1)
 
 
 # ----------------------------------------------------- tpuserve CI gate

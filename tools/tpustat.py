@@ -202,6 +202,18 @@ def _fleet_worker(rank, spool):
     telemetry.gauge("embed.big_table.unique_ratio").set(0.5)
     telemetry.counter("embed.big_table.exchange_bytes").inc(4096)
 
+    # request-trace exemplar gauges with known values — two completed
+    # traces, one hedge-triggered, through the REAL reqtrace publish
+    # path — so the fleet traces rollup (the tpustat --watch header
+    # line) is pinned end to end
+    telemetry.reqtrace_enable()
+    rt = telemetry.reqtrace
+    rt.trace_begin(f"w{rank}-hedged")
+    rt.flag(f"w{rank}-hedged", "hedge")
+    rt.trace_end(f"w{rank}-hedged")
+    rt.trace_begin(f"w{rank}-plain")
+    rt.trace_end(f"w{rank}-plain")
+
     if rank == 1:
         # synthetic straggler: this "host" reports pathologically slow
         # steps, so the detector path is exercised deterministically
@@ -520,17 +532,36 @@ def _fleet_selftest(as_json, trace_path):
                 problems.append(
                     f"straggler detector should flag rank 1, got "
                     f"{strag.get('flagged')}")
+            # request-trace rollup: each worker completed 2 traces,
+            # 1 hedge-triggered (serving.trace.* gauges from the
+            # reqtrace publish path), and the --watch header renders
+            # the fleet-wide traces line from them
+            for r in (0, 1):
+                t = rep["per_rank"][str(r)].get("serving_traces") or {}
+                if (t.get("seen"), t.get("kept"),
+                        t.get("trigger.hedge")) != (2, 1, 1):
+                    problems.append(
+                        f"rank {r} serving_traces wrong: {t}")
+            if "traces: 2/4 kept (hedge=2)" not in _watch_header(rep):
+                problems.append(
+                    "watch header is missing the traces rollup line")
             st = coll.stitched_trace()
             if st["fleetAlignment"] != "marker":
                 problems.append(
                     f"expected marker clock alignment, got "
                     f"{st['fleetAlignment']}")
-            # idempotent re-merge: same spool again, same totals
+            # idempotent re-merge: same spool again, same totals —
+            # and the traces line (gauges, not counters) must not
+            # double when the same rank envelopes land twice
             coll.collect(spool)
-            ar2 = coll.report()["merged"]["collective.all_reduce.count"]
+            rep2 = coll.report()
+            ar2 = rep2["merged"]["collective.all_reduce.count"]
             if ar2["value"] != 4:
                 problems.append(
                     f"re-merge not idempotent: count {ar2['value']}")
+            if "traces: 2/4 kept (hedge=2)" not in _watch_header(rep2):
+                problems.append(
+                    "traces rollup not idempotent on re-merge")
             if trace_path:
                 with open(trace_path, "w") as f:
                     json.dump(st, f)
@@ -767,6 +798,24 @@ def _watch_header(rep):
             bar = "#" * max(1, round(s / total * width))
             parts.append(f"{label} {s / total * 100:4.1f}% {bar}")
         lines.append("  step budget: " + "  ".join(parts))
+    # request-trace exemplar pressure: sum of the per-rank
+    # serving.trace.* gauges (fleet rollup). Gauges, so the line is
+    # stable when the same spool is merged twice.
+    tr = [pr.get("serving_traces") or {}
+          for pr in rep.get("per_rank", {}).values()]
+    tr = [t for t in tr if t]
+    if tr:
+        seen = sum(int(t.get("seen", 0)) for t in tr)
+        kept = sum(int(t.get("kept", 0)) for t in tr)
+        mix = {}
+        for t in tr:
+            for k, v in t.items():
+                if k.startswith("trigger."):
+                    name = k[len("trigger."):]
+                    mix[name] = mix.get(name, 0) + int(v)
+        mixs = " ".join(f"{k}={v}" for k, v in sorted(mix.items()))
+        lines.append(f"  traces: {kept}/{seen} kept"
+                     + (f" ({mixs})" if mixs else ""))
     return "\n".join(lines)
 
 

@@ -1,0 +1,524 @@
+#!/usr/bin/env python
+"""tputrace — per-request trace exemplars: list, inspect, export.
+
+The serving tier (PADDLE_TPU_REQTRACE=1) captures full event traces
+for *tail* requests only — latency above the live p99, deadline miss,
+brownout shed, budget denial, hedge fired, crash resubmit, chaos fault
+— up to a fixed exemplar budget. `telemetry.flush` writes them to
+`$PADDLE_TPU_TELEMETRY_DIR/traces.json`; a live server exposes them at
+`GET /v1/traces`. This CLI reads either.
+
+  list        summary table of the stored traces (one row per request:
+              status, latency, trigger mix, event count)
+  show ID     one exemplar as an indented event tree (frontend events
+              plus per-replica legs); `--chrome OUT` also writes
+              Chrome trace-event JSON (chrome://tracing, Perfetto) —
+              one pid per replica, pid 0 is the frontend
+  --selftest  CI gate (pattern of tools/tpudoctor.py --selftest): a
+              deterministic chaos run — replica_slow hedging, a
+              worker_crash resubmit, a forced brownout shed — must
+              capture exemplars for exactly the triggered requests;
+              the hedged exemplar must show the full cross-replica
+              causal chain (hedge launch, loser cancel, winner, legs
+              on two distinct replica pids with consistent parent
+              links); with tracing off the serve path must not even
+              import telemetry.reqtrace and must return byte-identical
+              tokens. One JSON verdict line with --json; exit 2 on
+              any problem.
+
+Examples:
+  python tools/tputrace.py list --path telemetry/traces.json
+  python tools/tputrace.py list --url http://localhost:8000
+  python tools/tputrace.py show 4f2a... --path telemetry/traces.json \\
+      --chrome /tmp/req.trace.json
+  python tools/tputrace.py --selftest --json
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+# ------------------------------------------------------------- sources
+def _fetch(url):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _load_index(args):
+    """The trace index: a traces.json artifact (--path) or a live
+    server's /v1/traces (--url). Both carry {seen, kept, triggers,
+    traces: [...]}; artifact rows keep their events inline."""
+    if args.url:
+        base = args.url.rstrip("/")
+        if not base.endswith("/v1/traces"):
+            base += "/v1/traces"
+        return _fetch(base)
+    if args.path:
+        with open(args.path) as f:
+            return json.load(f)
+    raise SystemExit("tputrace: need --path FILE or --url URL")
+
+
+def _row_events(row):
+    n = row.get("n_events")
+    if n is None:
+        n = len(row.get("events") or [])
+    return n
+
+
+# ----------------------------------------------------------------- list
+def cmd_list(args):
+    payload = _load_index(args)
+    trig = payload.get("triggers") or {}
+    mix = " ".join(f"{k}={v}" for k, v in sorted(trig.items()))
+    print(f"traces: kept {payload.get('kept', 0)}/"
+          f"{payload.get('seen', 0)} seen, "
+          f"{len(payload.get('traces') or [])} stored "
+          f"(budget {payload.get('budget', '?')})"
+          + (f"  [{mix}]" if mix else ""))
+    rows = payload.get("traces") or []
+    if not rows:
+        return 0
+    print(f"  {'trace_id':<20} {'status':<10} {'latency_ms':>10} "
+          f"{'events':>7}  triggers")
+    for row in rows:
+        n = _row_events(row)
+        print(f"  {row['trace_id']:<20} {row['status']:<10} "
+              f"{row['latency_ms']:>10.2f} "
+              f"{n if n else '-':>7}  "
+              f"{','.join(row['triggers']) or '-'}")
+    return 0
+
+
+# ----------------------------------------------------------------- show
+def _render_events(row):
+    """Indented event tree for one exemplar row: children under their
+    parent span, frontend vs replica called out per line."""
+    events = row.get("events") or []
+    by_parent = {}
+    ids = {e["span_id"] for e in events}
+    for e in events:
+        p = e.get("parent_id")
+        by_parent.setdefault(p if p in ids else None, []).append(e)
+    t0 = row.get("t0_us") or (events[0]["ts_us"] if events else 0)
+    lines, walked = [], set()
+
+    def walk(parent, depth):
+        # the root's B and E phases share one span id: recurse into a
+        # span's children once, not once per phase row
+        if parent in walked:
+            return
+        walked.add(parent)
+        for e in by_parent.get(parent, ()):
+            where = ("frontend" if e.get("replica") is None
+                     else f"replica {e['replica']}")
+            dur = (f" dur={e['dur_us'] / 1000.0:.2f}ms"
+                   if e.get("ph") == "X" else "")
+            extra = {k: v for k, v in (e.get("args") or {}).items()}
+            lines.append(
+                f"  {'  ' * depth}+{(e['ts_us'] - t0) / 1000.0:8.2f}ms "
+                f"{e['name']:<24} [{where}]{dur}"
+                + (f"  {json.dumps(extra, default=str)}" if extra
+                   else ""))
+            walk(e["span_id"], depth + 1)
+
+    walk(None, 0)
+    return lines
+
+
+def cmd_show(args):
+    if args.url:
+        base = args.url.rstrip("/")
+        if not base.endswith("/v1/traces"):
+            base += "/v1/traces"
+        chrome = _fetch(f"{base}/{args.trace_id}")
+        meta = chrome.get("metadata") or {}
+        print(f"trace {args.trace_id}: status={meta.get('status')} "
+              f"latency={meta.get('latency_ms', 0):.2f}ms "
+              f"triggers={','.join(meta.get('triggers') or []) or '-'}")
+        for e in chrome.get("traceEvents", []):
+            if e.get("ph") == "M":
+                continue
+            print(f"  pid {e['pid']} {e['ts']:>12} {e['name']}")
+        if args.chrome:
+            with open(args.chrome, "w") as f:
+                json.dump(chrome, f, indent=2)
+            print(f"chrome trace written to {args.chrome}")
+        return 0
+    payload = _load_index(args)
+    row = next((r for r in payload.get("traces") or []
+                if r["trace_id"] == args.trace_id), None)
+    if row is None:
+        print(f"tputrace: trace {args.trace_id!r} not found",
+              file=sys.stderr)
+        return 1
+    print(f"trace {row['trace_id']}: status={row['status']} "
+          f"latency={row['latency_ms']:.2f}ms "
+          f"triggers={','.join(row['triggers']) or '-'} "
+          f"events={_row_events(row)}")
+    if row.get("args"):
+        print(f"  args: {json.dumps(row['args'], default=str)}")
+    for line in _render_events(row):
+        print(line)
+    if not row.get("events"):
+        print("  (summary row only — this trace fired no capture "
+              "trigger, its events were not materialised)")
+    if args.chrome:
+        from paddle_tpu.telemetry import reqtrace as rt
+        with open(args.chrome, "w") as f:
+            json.dump(rt.chrome_trace_from(row), f, indent=2)
+        print(f"chrome trace written to {args.chrome}")
+    return 0
+
+
+# ------------------------------------------------------------- selftest
+def _decode_stack(seed=7, maxlen=12, vocab=64, d_model=32, n_layer=2):
+    """Tiny seeded transformer (the tpuserve selftest stack): infer
+    program + executor + params dict for the decode engines."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.core import framework as fw
+    from paddle_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        src_vocab=vocab, trg_vocab=vocab, max_len=maxlen,
+        d_model=d_model, d_inner=2 * d_model, n_head=4,
+        n_layer=n_layer, dropout=0.0, label_smooth_eps=0.0)
+    infer, start = fw.Program(), fw.Program()
+    with pt.program_guard(infer, start):
+        with pt.unique_name.guard():
+            _feeds, logits = tfm.build_infer_program(cfg, maxlen=maxlen)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(start)
+    rng = np.random.RandomState(seed)
+    scope = pt.global_scope()
+    params = {}
+    for v in infer.persistable_vars():
+        a = np.asarray(scope.get(v.name))
+        if v.name.startswith("layer_norm") and v.name.endswith(".w_0"):
+            nv = 1.0 + 0.2 * rng.randn(*a.shape)
+        elif v.name.endswith(".b_0"):
+            nv = 0.1 * rng.randn(*a.shape)
+        else:
+            nv = 0.35 * rng.randn(*a.shape)
+        nv = nv.astype(a.dtype)
+        scope.set(v.name, nv)
+        params[v.name] = nv
+    return cfg, exe, infer, logits, params
+
+
+def _selftest_problems(problems):
+    """Runs the deterministic chaos scenario; appends failures to
+    `problems`, returns the info dict for the verdict line."""
+    import numpy as np
+    from paddle_tpu import telemetry as tm
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving.batcher import BrownoutShed
+    from paddle_tpu.serving.decode import (DecodeConfig,
+                                           DecodeEngineConfig)
+    from paddle_tpu.serving.decode.qos import QosPolicy
+    from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup
+    from paddle_tpu.serving.guard import GuardConfig
+
+    tm.enable()
+    tm.reset()
+    tm.reqtrace_disable()
+    chaos.reset()
+
+    maxlen = 12
+    cfg, exe, infer, logits, params = _decode_stack(maxlen=maxlen)
+
+    def ref(src, n, max_new):
+        row = np.zeros((1, maxlen), np.int64)
+        row[0, :n] = src
+        ids = tfm.greedy_decode(exe, infer, logits, row,
+                                np.array([n], "int64"), bos=0,
+                                fetch_argmax=True)
+        return ids[0, 1:1 + max_new].astype(np.int64)
+
+    def farm(name, guard, qos_factory=None, retries=2):
+        return ReplicaGroup(cfg, params, FarmConfig(
+            replicas=2,
+            engine=DecodeEngineConfig(num_slots=2, max_len=maxlen,
+                                      prefill_buckets=(1, 2)),
+            decode=DecodeConfig(bos=0, max_queue_requests=64),
+            retries=retries, guard=guard, qos_factory=qos_factory),
+            name=name)
+
+    # base group: hedging OFF (so phase C's crash actually resubmits),
+    # generous retry budget, brownout thresholds never reached by load
+    # (phase D forces entry through the miss EWMA), a two-weight QoS
+    # so "shed the lowest class" has a victim
+    base = farm("trace-base", GuardConfig(
+        hedge=False, slow_factor=1e9, retry_rate=1000.0,
+        retry_burst=1000, enter_streak=10**6, err_probation=2.0,
+        queue_high=10**9),
+        qos_factory=lambda: QosPolicy([("gold", 4.0), ("free", 1.0)]))
+    base.start()
+    src_a = np.arange(2, 9).astype("int64")
+    want_a = ref(src_a, 7, 5)
+
+    # ---- phase A: trace-off purity + byte-identical tokens ----------
+    res_off = base.decode(src_a, src_len=7, max_new_tokens=5,
+                          timeout=60, request_id="off-1")
+    toks_off = np.asarray(res_off.tokens, np.int64)
+    if "paddle_tpu.telemetry.reqtrace" in sys.modules:
+        problems.append(
+            "trace-off serve path imported telemetry.reqtrace — the "
+            "PADDLE_TPU_REQTRACE-unset purity contract is broken")
+    if not np.array_equal(toks_off, want_a):
+        problems.append("trace-off tokens diverged from greedy ref")
+
+    tm.reqtrace_enable()
+    res_on = base.decode(src_a, src_len=7, max_new_tokens=5,
+                         timeout=60, request_id="on-1")
+    toks_on = np.asarray(res_on.tokens, np.int64)
+    if toks_on.tobytes() != toks_off.tobytes():
+        problems.append(
+            "tracing changed the answer: tokens are not "
+            "byte-identical with PADDLE_TPU_REQTRACE on vs off")
+    rt = tm.reqtrace
+    if rt.trace_end("on-1"):
+        problems.append("an untriggered request reported triggers")
+
+    # ---- phase B: replica_slow -> hedge -> cross-replica chain ------
+    hedged = farm("trace-hedge", GuardConfig(
+        hedge_fixed_delay_s=0.0, hedge_fraction=1.0, hedge_burst=1e9,
+        retry_rate=1000.0, retry_burst=1000, slow_factor=1e9,
+        enter_streak=10**6, err_probation=2.0, queue_high=10**9))
+    hedged.start()
+    src_b = np.arange(3, 10).astype("int64")
+    want_b = ref(src_b, 7, 6)
+    chaos.configure("replica_slow:ms=60,replica=0")
+    try:
+        res_h = hedged.decode(src_b, src_len=7, max_new_tokens=6,
+                              timeout=60, request_id="hedge-1")
+    finally:
+        chaos.reset()
+    if not np.array_equal(np.asarray(res_h.tokens, np.int64), want_b):
+        problems.append("hedged request tokens diverged from ref")
+    trig_h = rt.trace_end("hedge-1")
+    hedged.stop(drain=True, timeout=30.0)
+    if "hedge" not in trig_h:
+        problems.append(f"hedge trigger missing: {trig_h}")
+    row_h = rt.get("hedge-1")
+    hedge_pids = []
+    if row_h is None or not row_h["events"]:
+        problems.append("hedged exemplar was not captured")
+    else:
+        evs = row_h["events"]
+        names = [e["name"] for e in evs]
+        for need in ("request", "leg.primary", "leg.hedge",
+                     "farm.hedge.launch", "farm.hedge.cancel",
+                     "farm.win", "decode.enqueue", "decode.admit",
+                     "decode.step", "decode.retire", "engine.prefill"):
+            if need not in names:
+                problems.append(
+                    f"hedged exemplar missing {need!r} event")
+        legs = {e["replica"]: e["span_id"] for e in evs
+                if e["name"].startswith("leg.")}
+        if len(legs) != 2:
+            problems.append(
+                f"hedged legs landed on {sorted(legs)} — expected two "
+                f"distinct replicas")
+        root = row_h["root_id"]
+        for e in evs:
+            if e["name"].startswith("leg.") \
+                    and e["parent_id"] != root:
+                problems.append(
+                    f"leg {e['name']} parent {e['parent_id']} != "
+                    f"request root {root}")
+            if e["name"].startswith("decode.") \
+                    and e["parent_id"] != legs.get(e["replica"]):
+                problems.append(
+                    f"{e['name']} on replica {e['replica']} parents "
+                    f"to {e['parent_id']}, not its leg "
+                    f"{legs.get(e['replica'])}")
+        win = [e for e in evs if e["name"] == "farm.win"]
+        lose = [e for e in evs if e["name"] == "farm.hedge.cancel"]
+        if win and lose and win[0]["replica"] == lose[0]["replica"]:
+            problems.append("hedge winner and cancelled loser report "
+                            "the same replica")
+        chrome = rt.chrome_trace("hedge-1")
+        hedge_pids = sorted({e["pid"]
+                             for e in chrome["traceEvents"]})
+        if not {0, 1, 2}.issubset(hedge_pids):
+            problems.append(
+                f"chrome export pids {hedge_pids}: expected the "
+                f"frontend pid 0 plus two replica pids")
+
+    # ---- phase C: worker_crash -> resubmit under the same id --------
+    src_c = np.arange(4, 11).astype("int64")
+    want_c = ref(src_c, 7, 5)
+    # at=2, not at=1: the first working iteration ADMITS the queued
+    # request (chaos checks before admission); the second crashes with
+    # the slot active, so the future dies and the farm must resubmit.
+    # A crash at iteration 1 would hit a still-queued request, which
+    # _crash_recover deliberately leaves queued for the respawned loop.
+    chaos.configure("worker_crash:at=2")
+    try:
+        res_c = base.decode(src_c, src_len=7, max_new_tokens=5,
+                            timeout=60, request_id="crash-1")
+    finally:
+        chaos.reset()
+    if not np.array_equal(np.asarray(res_c.tokens, np.int64), want_c):
+        problems.append("resubmitted request tokens diverged from ref")
+    trig_c = rt.trace_end("crash-1")
+    for need in ("chaos", "resubmit"):
+        if need not in trig_c:
+            problems.append(f"crash trigger {need!r} missing: {trig_c}")
+    row_c = rt.get("crash-1")
+    if row_c is None or not row_c["events"]:
+        problems.append("crash exemplar was not captured")
+    else:
+        names = [e["name"] for e in row_c["events"]]
+        for need in ("chaos.fault", "farm.resubmit", "leg.resubmit"):
+            if need not in names:
+                problems.append(
+                    f"crash exemplar missing {need!r} event")
+        reps = {e["replica"] for e in row_c["events"]
+                if e["name"].startswith("leg.")}
+        if len(reps) != 2:
+            problems.append(
+                f"crash legs landed on {sorted(reps)} — the resubmit "
+                f"must move to a second replica under the SAME id")
+
+    # ---- phase D: forced brownout -> lowest-QoS shed ----------------
+    bo = base.guard.brownout
+    while bo.miss_ewma < bo.miss_high:
+        bo.on_deadline_miss()
+    bo.observe(0)                        # enter on miss pressure
+    if not bo.active:
+        problems.append("brownout refused to enter on miss pressure")
+    shed = None
+    try:
+        base.submit(src_a, src_len=7, max_new_tokens=5, tenant="free",
+                    request_id="shed-1")
+    except BrownoutShed as e:
+        shed = e
+    if shed is None:
+        problems.append("brownout active but the lowest QoS class "
+                        "was not shed")
+    trig_s = rt.trace_end("shed-1", status="shed")
+    if "shed" not in trig_s:
+        problems.append(f"shed trigger missing: {trig_s}")
+    row_s = rt.get("shed-1")
+    if row_s is None or not row_s["events"]:
+        problems.append("shed exemplar was not captured")
+    elif "guard.brownout.shed" not in [e["name"]
+                                       for e in row_s["events"]]:
+        problems.append("shed exemplar missing guard.brownout.shed")
+    base.stop(drain=True, timeout=30.0)
+
+    # ---- exactness: exemplars for exactly the triggered requests ----
+    snap = rt.snapshot()
+    captured = {r["trace_id"] for r in snap["traces"] if r["captured"]}
+    if captured != {"hedge-1", "crash-1", "shed-1"}:
+        problems.append(
+            f"captured set {sorted(captured)} != the triggered "
+            f"requests ['crash-1', 'hedge-1', 'shed-1']")
+    stored = {r["trace_id"] for r in snap["traces"]}
+    if "on-1" not in stored:
+        problems.append("untriggered trace lost its summary row")
+    if "off-1" in stored:
+        problems.append("a trace-off request leaked into the store")
+    if snap["seen"] != 4:
+        problems.append(f"seen {snap['seen']} != 4 completed traces")
+
+    # fleet rollup gauges (publish() ran on every trace_end)
+    msnap = tm.snapshot()
+    if msnap.get("serving.trace.kept") != 3:
+        problems.append(
+            f"serving.trace.kept gauge "
+            f"{msnap.get('serving.trace.kept')} != 3")
+
+    # ---- artifact round-trip: dump -> file -> list/show/chrome ------
+    import tempfile
+    dump = rt.dump()
+    with tempfile.NamedTemporaryFile("w", suffix=".traces.json",
+                                     delete=False) as f:
+        json.dump(dump, f, default=str)
+        path = f.name
+    try:
+        with open(path) as f:
+            back = json.load(f)
+        row = next(r for r in back["traces"]
+                   if r["trace_id"] == "hedge-1")
+        if not _render_events(row):
+            problems.append("show rendering of the reloaded exemplar "
+                            "came back empty")
+        from paddle_tpu.telemetry import reqtrace as _rt
+        chrome2 = _rt.chrome_trace_from(row)
+        if sorted({e["pid"] for e in chrome2["traceEvents"]}) \
+                != hedge_pids:
+            problems.append("chrome export changed across the "
+                            "traces.json round-trip")
+    finally:
+        os.unlink(path)
+
+    return {
+        "seen": snap["seen"], "kept": snap["kept"],
+        "stored": snap["stored"], "triggers": snap["triggers"],
+        "hedge_pids": hedge_pids,
+        "captured": sorted(captured),
+    }
+
+
+def run_selftest(args):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    problems = []
+    info = _selftest_problems(problems)
+    result = {"mode": "selftest", **info, "problems": problems,
+              "ok": not problems}
+    if args.json:
+        print(json.dumps(result, default=str))
+    else:
+        print(f"tputrace selftest: {info['kept']}/{info['seen']} "
+              f"exemplars kept ({', '.join(info['captured'])}), "
+              f"trigger mix "
+              + " ".join(f"{k}={v}"
+                         for k, v in sorted(info["triggers"].items()))
+              + f", hedged chrome pids {info['hedge_pids']}")
+        for prob in problems:
+            print(f"FAIL: {prob}", file=sys.stderr)
+    return 2 if problems else 0
+
+
+# ----------------------------------------------------------------- main
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tputrace",
+        description="per-request trace exemplars: list, show, export")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the CI gate")
+    ap.add_argument("--json", action="store_true",
+                    help="selftest: one JSON verdict line")
+    sub = ap.add_subparsers(dest="cmd")
+    lp = sub.add_parser("list", help="summary table of stored traces")
+    lp.add_argument("--path", help="a traces.json artifact")
+    lp.add_argument("--url", help="a live server (GET /v1/traces)")
+    sp = sub.add_parser("show", help="one exemplar as an event tree")
+    sp.add_argument("trace_id")
+    sp.add_argument("--path", help="a traces.json artifact")
+    sp.add_argument("--url", help="a live server (GET /v1/traces)")
+    sp.add_argument("--chrome", metavar="OUT",
+                    help="also write Chrome trace-event JSON")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return run_selftest(args)
+    if args.cmd == "list":
+        return cmd_list(args)
+    if args.cmd == "show":
+        return cmd_show(args)
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
