@@ -697,12 +697,33 @@ def run_benchmarks(platform, emit_progress=None):
         progress()
 
         stage_s = result.setdefault("stage_seconds", {})
+
+        def _stage_peak():
+            """Per-stage HBM watermark: the memory ledger's
+            read-and-reset peak, None when PADDLE_TPU_MEMLEDGER is off
+            (the off path never imports the ledger)."""
+            try:
+                from paddle_tpu import telemetry as _tm
+                if not _tm.memledger_enabled():
+                    return None
+                from paddle_tpu.telemetry import memledger as _ml
+                return _ml.get().take_peak() or None
+            except Exception:
+                return None
+
+        def _stamp_peak(stage):
+            pk = _stage_peak()
+            if pk:
+                result.setdefault("peak_hbm_bytes", {})[stage] = pk
+
+        _stage_peak()              # drop any pre-bench watermark
         _STAGE["stage"] = "transformer"
         if want("transformer"):
             _t0 = time.perf_counter()
             tokens_per_sec, mfu, loss, evidence = \
                 bench_transformer(platform)
             stage_s["transformer"] = round(time.perf_counter() - _t0, 1)
+            _stamp_peak("transformer")
             result["value"] = round(tokens_per_sec, 1)
             if mfu is not None:
                 result["mfu"] = round(mfu, 4)
@@ -741,6 +762,7 @@ def run_benchmarks(platform, emit_progress=None):
                 result[err_key or f"{names[0]}_error"] = \
                     f"{type(e).__name__}: {e}"
             stage_s[names[0]] = round(time.perf_counter() - t0, 1)
+            _stamp_peak(names[0])
             progress()
 
         run_stage("inference", ("inference",), bench_inference)
@@ -910,6 +932,15 @@ def _history_records(result, now=None):
             metric = key
         records.append(dict(common, metric=metric, value=v,
                             unit=unit, stage=stage))
+    # per-stage HBM watermarks (memory-ledger runs only — the dict is
+    # absent with PADDLE_TPU_MEMLEDGER off, so the spine is unchanged)
+    for stage, pk in sorted((result.get("peak_hbm_bytes")
+                             or {}).items()):
+        if isinstance(pk, (int, float)) and pk:
+            records.append(dict(common,
+                                metric=f"{stage}_peak_hbm_bytes",
+                                value=int(pk), unit="bytes",
+                                stage=stage))
     return records
 
 

@@ -30,6 +30,16 @@ OPTIMIZER_SLOTS = {"sgd": 0, "momentum": 1, "adam": 2, "adamw": 2,
 
 _CAP_ENV = "PADDLE_TPU_DEVICE_MEM_CAP"
 
+# names of MATERIALIZED optimizer accumulators (the slot vocabulary of
+# paddle_tpu/optimizer.py — mirrored by telemetry/memledger.py's
+# runtime classifier): once minimize() has appended these as
+# persistable vars, pricing them under params AND predicting
+# OPTIMIZER_SLOTS copies on top would double-count the same bytes
+SLOT_NAME_MARKERS = ("_velocity_", "_moment", "_beta1_pow",
+                     "_beta2_pow", "_inf_norm", "_avg_squared_",
+                     "_mean_square", "_mean_grad", "_squared_",
+                     "_linear_", "learning_rate")
+
 
 def _shard_factor(mesh, spec):
     """How many ways a value with `spec` splits across one member's
@@ -65,6 +75,7 @@ def member_footprint(mctx):
             if op.type == "backward_macro":
                 grad_params |= set(op.attrs.get("param_names", ()))
         named = []
+        predicted_slots = 0
         for v in mctx.program.list_vars():
             if not v.persistable:
                 continue
@@ -74,13 +85,20 @@ def member_footprint(mctx):
             nbytes = n * _dtype_bytes(v.dtype)
             per_member = nbytes // _shard_factor(
                 mesh, mctx.param_specs.get(v.name))
-            out["params"] += per_member
             out["detail"].append((v.name, per_member))
+            if any(m in v.name for m in SLOT_NAME_MARKERS):
+                # accumulator already materialized: price it as
+                # optimizer state, don't predict it a second time
+                out["optimizer"] += per_member
+                continue
+            out["params"] += per_member
             if v.name in grad_params:
                 # optimizer slots are fp32 regardless of param dtype
-                out["optimizer"] += slots * n * 4 // _shard_factor(
+                predicted_slots += slots * n * 4 // _shard_factor(
                     mesh, mctx.param_specs.get(v.name))
                 named.append((v.name, tuple(v.shape), v.dtype))
+        if not out["optimizer"]:
+            out["optimizer"] = predicted_slots
         if mctx.grad_sync is not None and named:
             from ...parallel import gradsync as _gs
             try:

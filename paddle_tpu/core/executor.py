@@ -506,10 +506,26 @@ class Executor:
         check = self._check_requested(check_nan_inf)
         from ..diagnostics import recorder as _fr
         flight = _fr.active()
+        # device-memory ledger: one plain-bool check when off (the
+        # module is never imported then — bench-contract pin)
+        ml_on = _tm.memledger_enabled()
         t_fp = time.perf_counter() if tm_on else 0.0
         with _tm.span("executor.feed_put", feeds=len(feed),
                       step=self._step - 1):
-            feed_arrays = self._put_feeds(program, feed, dev)
+            try:
+                feed_arrays = self._put_feeds(program, feed, dev)
+            except Exception as e:
+                if ml_on:
+                    from ..telemetry import memledger as _ml
+                    _ml.handle_possible_oom(
+                        e, context={"site": "executor.feed_put",
+                                    "step": self._step - 1,
+                                    "program": program._version})
+                raise
+        if ml_on:
+            from ..telemetry import memledger as _ml
+            for _n, _v in feed_arrays.items():
+                _ml.register("feed", _n, _v)
         if tm_on:
             _tm.histogram("executor.feed_put_seconds").observe(
                 time.perf_counter() - t_fp)
@@ -615,12 +631,21 @@ class Executor:
                           compile_run=first_run):
                 fetches, new_persist, step_dev = fn(persist, feed_arrays,
                                                     step_dev)
-        except Exception:
+        except Exception as e:
             # the counter was donated into the failed execution — drop
             # it so the next run() re-seeds instead of passing a deleted
             # buffer forever
             self._step_counters.pop(dev, None)
             self._step_counter_vals.pop(dev, None)
+            if ml_on:
+                # RESOURCE_EXHAUSTED anywhere in the step turns into a
+                # typed MemoryReport through the flight recorder; any
+                # other exception passes through untouched
+                from ..telemetry import memledger as _ml
+                _ml.handle_possible_oom(
+                    e, context={"site": "executor.step",
+                                "step": self._step - 1,
+                                "program": program._version})
             raise
         self._step_counters[dev] = step_dev
         self._step_counter_vals[dev] = step_val + 1
@@ -630,11 +655,32 @@ class Executor:
             jax.block_until_ready(fetches)
         dt = time.perf_counter() - t0
         self.last_step_time = dt
+        hbm = None
+        if ml_on:
+            # the step's outputs are the creation site of the next
+            # step's state: attribute params vs optimizer slots vs
+            # gradsync EF by name, then take the cheap per-step sample
+            # (peaks, timeline, over-cap watch)
+            from ..telemetry import memledger as _ml
+            for _n, _v in new_persist.items():
+                _ml.register(_ml.classify_persist_name(_n), _n, _v)
+            hbm = _ml.on_step(step=self._step - 1,
+                              context={"site": "executor.step",
+                                       "step": self._step - 1,
+                                       "program": program._version})
         if flight is not None:
-            flight.record(step=self._step - 1,
-                          program=program._version, compile=first_run,
-                          step_s=round(dt, 5),
-                          fetches=len(fetch_names))
+            # the ring carries the per-step HBM watermark so an OOM
+            # post-mortem shows the memory trajectory, not one number
+            if hbm is not None:
+                flight.record(step=self._step - 1,
+                              program=program._version,
+                              compile=first_run, step_s=round(dt, 5),
+                              fetches=len(fetch_names), hbm=hbm)
+            else:
+                flight.record(step=self._step - 1,
+                              program=program._version,
+                              compile=first_run, step_s=round(dt, 5),
+                              fetches=len(fetch_names))
         if tm_on:
             _tm.counter("executor.steps").inc()
             _tm.histogram("executor.step_seconds").observe(dt)
@@ -763,6 +809,15 @@ class Executor:
             return out
         return fetches
 
+    def _scan_oom_hook(self, e, steps):
+        """Memledger OOM classification for the scanned-window path;
+        never raises (the original exception propagates)."""
+        if _tm.memledger_enabled():
+            from ..telemetry import memledger as _ml
+            _ml.handle_possible_oom(
+                e, context={"site": "executor.run_scanned",
+                            "steps": steps})
+
     # ------------------------------------------------------------------
     def run_scanned(self, program=None, feed=None, fetch_list=None,
                     scope=None, return_numpy=True, is_test=None,
@@ -868,10 +923,14 @@ class Executor:
             outs = []
             p = persist
             with _tm.span("executor.scan_window_fallback", steps=steps):
-                for i in range(steps):
-                    step_fetches, p = fn(p, feed_arrays, keys,
-                                         jnp.asarray(i, jnp.int32))
-                    outs.append(step_fetches)
+                try:
+                    for i in range(steps):
+                        step_fetches, p = fn(p, feed_arrays, keys,
+                                             jnp.asarray(i, jnp.int32))
+                        outs.append(step_fetches)
+                except Exception as e:
+                    self._scan_oom_hook(e, steps)
+                    raise
             new_persist = p
             fetches = [jnp.stack([o[j] for o in outs])
                        for j in range(len(fetch_names))]
@@ -902,9 +961,24 @@ class Executor:
                 self._cache[ckey] = fn
 
             with _tm.span("executor.scan_window", steps=steps):
-                fetches, new_persist = fn(persist, feed_arrays, key)
+                try:
+                    fetches, new_persist = fn(persist, feed_arrays, key)
+                except Exception as e:
+                    self._scan_oom_hook(e, steps)
+                    raise
         for name, val in new_persist.items():
             scope.set(name, val)
+        if _tm.memledger_enabled():
+            # a scanned window multiplies live staging by K (ROADMAP
+            # item 2) — one ledger sample per window keeps the
+            # trajectory visible without per-iteration host work
+            from ..telemetry import memledger as _ml
+            for _n, _v in new_persist.items():
+                _ml.register(_ml.classify_persist_name(_n), _n, _v)
+            _ml.register("staging", "scan_window", fetches)
+            _ml.on_step(step=self._step - 1,
+                        context={"site": "executor.run_scanned",
+                                 "steps": steps})
         if self.check_nan_inf and fetches:
             try:
                 self._check_fetches_finite(fetch_names, fetches)

@@ -185,6 +185,14 @@ class StepWindow:
             self._materialize_oldest()
         self._q.append(pending)
         self._gauge()
+        if _tm.memledger_enabled():
+            # the window's un-materialized fetches are live device
+            # bytes the static footprint can't see — the ledger's
+            # staging bucket is how "async window K multiplies live
+            # buffers" shows up in an OOM post-mortem
+            from ..telemetry import memledger as _ml
+            _ml.register("staging", "async_window",
+                         pending._rec.get("fetches"))
         return pending
 
     def _materialize_oldest(self):
@@ -266,12 +274,22 @@ class DevicePrefetcher:
                     put(("eof", e))
                     return
                 staged = {}
-                for name, arr in host.items():
-                    dt = self._cast(name)
-                    a = np.asarray(arr)
-                    if dt is not None and a.dtype != dt:
-                        a = a.astype(dt)
-                    staged[name] = jax.device_put(a, self.dev)
+                try:
+                    for name, arr in host.items():
+                        dt = self._cast(name)
+                        a = np.asarray(arr)
+                        if dt is not None and a.dtype != dt:
+                            a = a.astype(dt)
+                        staged[name] = jax.device_put(a, self.dev)
+                except Exception as e:
+                    if _tm.memledger_enabled():
+                        from ..telemetry import memledger as _ml
+                        _ml.handle_possible_oom(
+                            e, context={"site": "prefetch.device_put"})
+                    raise
+                if _tm.memledger_enabled():
+                    from ..telemetry import memledger as _ml
+                    _ml.register("staging", "prefetch", staged)
                 if _tm.enabled():
                     _tm.counter("reader.device_prefetch.batches").inc()
                 if not put(("ok", staged)):

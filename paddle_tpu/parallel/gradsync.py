@@ -60,7 +60,8 @@ from .. import telemetry as _tm
 from . import collective as C
 
 __all__ = ["GradSyncPolicy", "parse_policy", "resolve_policy",
-           "plan_buckets", "state_entries", "sync_gradients",
+           "plan_buckets", "state_entries", "ef_footprint_bytes",
+           "sync_gradients",
            "make_grad_transform", "make_probe_transform",
            "quantize_int8_blockwise", "dequantize_int8_blockwise",
            "EF_PREFIX"]
@@ -217,6 +218,16 @@ def state_entries(plan, policy):
     if policy is None or not policy.error_feedback:
         return []
     return [(EF_PREFIX + str(b.index), b.padded) for b in plan]
+
+
+def ef_footprint_bytes(plan, policy, dp=1):
+    """Analytic device bytes of the error-feedback state this policy
+    carries (fp32 residual per bucket element, dp members). The memory
+    ledger's gradsync_ef bucket should reconcile against this — the
+    runtime analog of meshlint's static gradsync_ef floor."""
+    if policy is None or not policy.error_feedback:
+        return 0
+    return sum(b.padded for b in plan) * 4 * max(1, int(dp))
 
 
 # ---------------------------------------------------------- quantization

@@ -233,6 +233,13 @@ class ParallelExecutor:
                 self.scope.set(name, val)
             persist_sh[name] = sh
             persist[name] = self._param_to_global(val, sh)
+            if _tm.memledger_enabled():
+                # creation site of the error-feedback residuals — the
+                # per-step classify keeps them attributed as they are
+                # donated/recreated, this seeds the first sample
+                from ..telemetry import memledger as _ml
+                _ml.register("gradsync_ef", name, persist[name],
+                             mode=policy.mode)
         return plan, sparse_taps
 
     def _build_gradsync_fn(self, program, fetch_names, is_test,
@@ -601,7 +608,16 @@ class ParallelExecutor:
 
         with _tm.span("pexe.step", step=self._step - 1,
                       devices=self.device_count):
-            fetches, new_persist = fn(persist, feed_arrays, key)
+            try:
+                fetches, new_persist = fn(persist, feed_arrays, key)
+            except Exception as e:
+                if _tm.memledger_enabled():
+                    from ..telemetry import memledger as _ml
+                    _ml.handle_possible_oom(
+                        e, context={"site": "pexe.step",
+                                    "step": self._step - 1,
+                                    "devices": self.device_count})
+                raise
         if k_async > 0:
             # a fetch that is ALSO a persistable output may alias the
             # state buffer the next queued step donates — give pending
@@ -610,6 +626,24 @@ class ParallelExecutor:
                        for n, f in zip(fetch_names, fetches)]
         for name, val in new_persist.items():
             self.scope.set(name, val)
+        if _tm.memledger_enabled():
+            # attribute the global (sharded) state: gradsync.ef.* and
+            # optimizer slots classify by name, engine rows + engine
+            # state are the sparse_table bucket; feeds are transient
+            from ..telemetry import memledger as _ml
+            sparse_names = set(engine_rows)
+            if engine is not None:
+                sparse_names.update(
+                    n for n, *_rest in engine.state_entries())
+            for _n, _v in new_persist.items():
+                cat = ("sparse_table" if _n in sparse_names
+                       else _ml.classify_persist_name(_n))
+                _ml.register(cat, _n, _v)
+            for _n, _v in feed_arrays.items():
+                _ml.register("feed", _n, _v)
+            _ml.on_step(step=self._step - 1,
+                        context={"site": "pexe.step",
+                                 "devices": self.device_count})
         dt = time.perf_counter() - t_run0
         if tm_on:
             _tm.counter("pexe.steps").inc()

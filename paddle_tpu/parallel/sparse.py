@@ -463,9 +463,16 @@ class SparseEngine:
                     f"{rows.shape}, want {(_L, _t.dim)}")
             return rows
 
-        scope.set(name, jax.make_array_from_callback(
-            t.physical_shape, sh, cb))
+        arr = jax.make_array_from_callback(t.physical_shape, sh, cb)
+        scope.set(name, arr)
         self._physical.add(name)
+        if _tm.memledger_enabled():
+            # creation site of a table shard: attribute the physical
+            # [N*local_rows, dim] array so OOM post-mortems name the
+            # table, not an anonymous buffer
+            from ..telemetry import memledger as _ml
+            _ml.register("sparse_table", name, arr,
+                         rows=t.local_rows, dim=t.dim)
 
     def init_shards(self, scope, seed=0, scale=0.02):
         """Seed every engine table shard-WISE (no host copy of the full

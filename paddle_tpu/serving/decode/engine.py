@@ -108,6 +108,20 @@ class DecodeEngine:
                 src_max_len=self.config.src_max_len,
                 device=prefill_device,
                 build_cache=build_cache)
+        if _tm.memledger_enabled():
+            self._register_params()
+
+    def _register_params(self, owner=None):
+        """Attribute the decoder-held device weight copies. Owner is
+        re-stamped at init_state time once the farm has assigned a
+        replica index (registration by id moves, never duplicates)."""
+        from ...telemetry import memledger as _ml
+        if owner is None:
+            owner = ("decode" if self.replica_index is None
+                     else f"replica{self.replica_index}")
+        _ml.register("params", owner, self.decoder.params)
+        if self.prefill_decoder is not None:
+            _ml.register("params", owner, self.prefill_decoder.params)
 
     # ----------------------------------------------------- constructors
     @classmethod
@@ -169,7 +183,18 @@ class DecodeEngine:
 
     # -------------------------------------------------------- lifecycle
     def init_state(self):
-        return self.decoder.init_state()
+        state = self.decoder.init_state()
+        if _tm.memledger_enabled():
+            # creation site of the KV-cache blocks: owner is the
+            # replica (once the farm assigned one), quant rides as
+            # metadata so an OOM hint knows fp32 from int8
+            from ...telemetry import memledger as _ml
+            owner = ("decode" if self.replica_index is None
+                     else f"replica{self.replica_index}")
+            _ml.register("kv_cache", owner, state,
+                         quant=self.config.kv_quant)
+            self._register_params(owner)
+        return state
 
     def set_params(self, arrays):
         """Rolling weight update: swap the parameter set under the
@@ -180,6 +205,8 @@ class DecodeEngine:
         self.decoder.load_params(arrays)
         if self.prefill_decoder is not None:
             self.prefill_decoder.load_params(arrays)
+        if _tm.memledger_enabled():
+            self._register_params()
 
     def warmup(self):
         """Compile every prefill bucket + the step on zero feeds.
@@ -286,11 +313,23 @@ class DecodeEngine:
 
     def step(self, state, ids, pos, seed=0):
         """One decode iteration over all slots -> next ids [S]."""
-        nxt = self.decoder.step(state, ids, pos, seed=seed)
+        try:
+            nxt = self.decoder.step(state, ids, pos, seed=seed)
+        except Exception as e:
+            if _tm.memledger_enabled():
+                from ...telemetry import memledger as _ml
+                _ml.handle_possible_oom(
+                    e, context={"site": "decode.step",
+                                "replica": self.replica_index})
+            raise
         if _tm.enabled():
             _tm.counter("serving.decode.steps").inc()
             _tm.gauge("serving.decode.compile_count").set(
                 self.compile_count)
+        if _tm.memledger_enabled():
+            from ...telemetry import memledger as _ml
+            _ml.on_step(context={"site": "decode.step",
+                                 "replica": self.replica_index})
         return nxt
 
 

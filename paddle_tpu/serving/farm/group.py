@@ -685,11 +685,13 @@ class ReplicaGroup:
             build_cache=self.build_cache)
         qos = self.config.qos_factory() \
             if self.config.qos_factory else None
+        # index BEFORE the scheduler builds slot state: the memory
+        # ledger attributes KV blocks to their replica at creation
+        engine.replica_index = i
         sched = ContinuousScheduler(
             engine, qos=qos, config=self.config.decode,
             name=f"{self.name}.r{i}", warmup=warmup)
         sched.replica_index = i
-        engine.replica_index = i
         rep = Replica(i, engine, sched, devices)
         rep.version = self.version
         if self.guard is not None:

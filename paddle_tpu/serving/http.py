@@ -21,6 +21,10 @@ Endpoints (TF-Serving-shaped):
 - ``GET /v1/farm`` — per-replica stats for every attached decode tier
   that is a replica group (slots in use, queue depth, KV bytes,
   goodput, versions); ``{}`` when serving single engines only.
+- ``GET /v1/memory`` — the live device-memory ledger (per-category
+  bytes, peaks, per-replica footprints, last OOM post-mortem) when
+  ``PADDLE_TPU_MEMLEDGER`` is on; ``{"enabled": false}`` plus raw
+  device watermarks otherwise.
 
 Every POST carries a correlation id: ``X-Request-Id`` header or
 ``request_id`` body field if the caller sent one, generated otherwise.
@@ -158,6 +162,18 @@ class _Handler(BaseHTTPRequestHandler):
                      self.model_server.decoders().items()
                      if hasattr(dec, "stats")}
             self._reply(200, {"farms": farms})
+        elif self.path == "/v1/memory":
+            if _tm.memledger_enabled():
+                payload = _tm.memledger.snapshot_report()
+                rep = _tm.memledger.last_report()
+                if rep is not None:
+                    payload["last_report"] = rep.to_dict()
+            else:
+                # ledger off: the device watermarks are all the truth
+                # there is (empty on stats-less backends)
+                payload = {"enabled": False,
+                           "device": _tm.sample_device_memory()}
+            self._reply(200, payload)
         elif self.path == "/v1/traces":
             if _tm.reqtrace_enabled():
                 self._reply(200, _tm.reqtrace.snapshot())

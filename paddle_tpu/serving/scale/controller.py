@@ -169,10 +169,13 @@ class ScaleController:
         try:
             self.planner.grow(target - live)
         except ScalePlanRejected as e:
+            # "measured" = the memory ledger ruled the grow out — that
+            # is a ceiling for the brownout headroom relay too: another
+            # slice physically won't fit, so shedding is allowed
             return ScaleDecision(
                 "rejected", f"grow to {target} rejected: {e}",
                 rule=i, live=live, target=live,
-                at_ceiling=e.reason == "ceiling")
+                at_ceiling=e.reason in ("ceiling", "measured"))
         with self._lock:
             self._cooldown_until = (self._clock()
                                     + self.policy.up_cooldown_s)

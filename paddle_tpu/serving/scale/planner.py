@@ -19,6 +19,12 @@ The controller says "grow" or "shrink"; this class owns HOW:
   the policy max). Below it, brownout entry is deferred — scale-out
   beats shedding; at it, shedding is correct and allowed.
 
+With the memory ledger on (`PADDLE_TPU_MEMLEDGER=1`) both gates also
+consult MEASURED per-replica bytes: a grow whose measured replica peak
+exceeds the per-device cap is rejected with reason ``"measured"`` even
+when the static floor fits, and `at_ceiling` flips true — so the
+brownout headroom relay runs on truth, not just the prediction.
+
 The allocator ledger is seeded lazily from the group's own slices
 (`adopt`), so an unscaled group never constructs one — and a
 wrap-shared CPU layout adopts as shared, keeping free() honest.
@@ -26,6 +32,7 @@ wrap-shared CPU layout adopts as shared, keeping free() honest.
 import threading
 
 from ...parallel.mesh import SliceAllocator
+from ... import telemetry as _tm
 
 __all__ = ["ScalePlanner", "ScalePlanRejected"]
 
@@ -44,7 +51,7 @@ class ScalePlanner:
     """Transition executor for one ReplicaGroup."""
 
     def __init__(self, group, devices=None, width=None, verify=True,
-                 checkpoint_dir=None):
+                 checkpoint_dir=None, measured_bytes=None):
         self.group = group
         self.verify = bool(verify)
         self.checkpoint_dir = checkpoint_dir
@@ -55,6 +62,55 @@ class ScalePlanner:
         self.grows = 0              # the group's existing width)
         self.shrinks = 0
         self.rejections = 0
+        # () -> peak bytes a replica was MEASURED to occupy, or None
+        # when unknown. Default: the memory ledger's per-replica peaks
+        # (only when PADDLE_TPU_MEMLEDGER is on — off-path never
+        # imports the ledger). Injectable for tests/selftest.
+        self._measured_bytes = measured_bytes
+
+    # ------------------------------------------------------- measured
+    def measured_replica_peak(self):
+        """Largest measured per-replica footprint in bytes, or None
+        when no measurement exists (ledger off / nothing sampled)."""
+        if self._measured_bytes is not None:
+            try:
+                v = self._measured_bytes()
+                return int(v) if v else None
+            except Exception:
+                return None
+        if not _tm.memledger_enabled():
+            return None
+        from ...telemetry import memledger as _ml
+        peaks = _ml.replica_peaks()
+        return max(peaks.values()) if peaks else None
+
+    def _measured_overrun(self):
+        """(peak, cap) when measured bytes rule out another replica on
+        a fresh slice; None otherwise."""
+        peak = self.measured_replica_peak()
+        if peak is None:
+            return None
+        if not _tm.memledger_enabled() and self._measured_bytes is None:
+            return None
+        if _tm.memledger_enabled():
+            from ...telemetry import memledger as _ml
+            cap = _ml.device_cap_bytes()
+        else:
+            cap = self._env_cap_bytes()
+        if cap and peak > cap:
+            return peak, cap
+        return None
+
+    @staticmethod
+    def _env_cap_bytes():
+        import os
+        env = os.environ.get("PADDLE_TPU_DEVICE_MEM_CAP")
+        if not env:
+            return None
+        try:
+            return int(float(env) * (1 << 20))
+        except ValueError:
+            return None
 
     # ------------------------------------------------------ allocator
     def _allocator(self):
@@ -89,9 +145,15 @@ class ScalePlanner:
     def at_ceiling(self, extra=1):
         """No room for `extra` more exclusive slices: the physical
         device ceiling (policy bounds are the controller's job). THIS
-        is the signal that flips brownout from deferred to allowed."""
+        is the signal that flips brownout from deferred to allowed.
+
+        Measured memory counts as ceiling too: when the ledger has
+        seen a replica peak past the per-device cap, another slice
+        would not actually fit, whatever the allocator says."""
         alloc = self._allocator()
-        return alloc.free_count() < self.width * extra
+        if alloc.free_count() < self.width * extra:
+            return True
+        return self._measured_overrun() is not None
 
     def free_devices(self):
         return self._allocator().free_count()
@@ -118,6 +180,15 @@ class ScalePlanner:
                 raise ScalePlanRejected(
                     "verify", f"pre-spawn gate rejected the grow to "
                     f"{probe.replicas} replicas: {e}") from e
+        over = self._measured_overrun()
+        if over is not None:
+            peak, cap = over
+            self.rejections += 1
+            raise ScalePlanRejected(
+                "measured", f"measured per-replica peak {peak} bytes "
+                f"exceeds the per-device cap {cap} bytes — the static "
+                f"floor fit, the runtime ledger says a new replica "
+                f"won't (shrink the KV cache / kv_quant=int8 first)")
         if alloc.free_count() < self.width * int(n):
             self.rejections += 1
             raise ScalePlanRejected(
@@ -164,4 +235,6 @@ class ScalePlanner:
                 "rejections": self.rejections,
                 "free_devices": alloc.free_count(),
                 "slice_width": self.width,
-                "at_ceiling": self.at_ceiling()}
+                "at_ceiling": self.at_ceiling(),
+                "measured_replica_peak":
+                    self.measured_replica_peak() or 0}

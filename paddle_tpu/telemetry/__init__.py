@@ -56,13 +56,16 @@ __all__ = ["enabled", "enable", "disable", "counter", "gauge",
            "append_span", "now_us", "instant_event", "Counter",
            "Gauge", "Histogram", "SpanRecord", "DEFAULT_TIME_BUCKETS",
            "attribution", "slo", "reqtrace", "reqtrace_enabled",
-           "reqtrace_enable", "reqtrace_disable"]
+           "reqtrace_enable", "reqtrace_disable", "memledger",
+           "memledger_enabled", "memledger_enable",
+           "memledger_disable"]
 
 
 def __getattr__(name):
-    # attribution/slo/reqtrace load lazily: the off-path contract
-    # (bench pin) is that a disabled run never even imports them
-    if name in ("attribution", "slo", "reqtrace"):
+    # attribution/slo/reqtrace/memledger load lazily: the off-path
+    # contract (bench pin) is that a disabled run never even imports
+    # them
+    if name in ("attribution", "slo", "reqtrace", "memledger"):
         import importlib
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute "
@@ -118,6 +121,27 @@ def reqtrace_enable():
 def reqtrace_disable():
     global _REQTRACE
     _REQTRACE = False
+
+
+_MEMLEDGER = _env_truthy(os.environ.get("PADDLE_TPU_MEMLEDGER"))
+
+
+def memledger_enabled():
+    """Gate every device-memory attribution seam checks before touching
+    the ledger: a plain bool, so `PADDLE_TPU_MEMLEDGER` unset costs one
+    flag check and provably never imports
+    paddle_tpu.telemetry.memledger (pinned by test_bench_contract)."""
+    return _MEMLEDGER
+
+
+def memledger_enable():
+    global _MEMLEDGER
+    _MEMLEDGER = True
+
+
+def memledger_disable():
+    global _MEMLEDGER
+    _MEMLEDGER = False
 
 
 def snapshot():
@@ -181,6 +205,17 @@ def flush(log=True):
         if rt is not None:
             with open(os.path.join(out_dir, "traces.json"), "w") as f:
                 json.dump(rt.dump(), f, indent=2, default=str)
+        # the memory ledger rides along the same way — only if it was
+        # ever loaded (sys.modules probe keeps the off-path pure)
+        ml = sys.modules.get(__name__ + ".memledger")
+        if ml is not None:
+            payload = ml.snapshot_report()
+            payload["timeline"] = ml.get().timeline()
+            rep = ml.last_report()
+            if rep is not None:
+                payload["last_report"] = rep.to_dict()
+            with open(os.path.join(out_dir, "memory.json"), "w") as f:
+                json.dump(payload, f, indent=2, default=str)
     if r is not None and fleet.spool_dir() is not None:
         try:
             fleet.write_rank_snapshot()

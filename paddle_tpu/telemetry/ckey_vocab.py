@@ -18,7 +18,8 @@ drag the other in, so the shared words live below both.
 """
 
 __all__ = ["COMPONENT", "component_name", "fmt_field",
-           "diff_feed_signature"]
+           "diff_feed_signature", "MEM_COMPONENT",
+           "mem_component_phrase"]
 
 # ckey field -> the component name the event/report/diagnostic leads with
 COMPONENT = {
@@ -40,6 +41,32 @@ COMPONENT = {
 def component_name(field):
     """The human name a ckey field is reported under."""
     return COMPONENT.get(field, field)
+
+
+# memory-ledger category -> the ckey field whose knob governs that
+# footprint. The memledger's "what grew since the last fit" diff is
+# phrased through this table so an OOM post-mortem names memory growth
+# in the SAME vocabulary the recompile explainer and meshlint use for
+# the knob that caused it (regression-tested by tests/test_memledger).
+MEM_COMPONENT = {
+    "feed": "feed_signature",
+    "staging": "async",
+    "gradsync_ef": "grad_sync",
+    "sparse_table": "engine",
+    "kv_cache": "engine",
+    "optimizer": "fuse_optimizer_tail",
+    "params": "program_id",
+    "workspace": "program_version",
+}
+
+
+def mem_component_phrase(category):
+    """ckey-vocab phrasing for a memory category's governing knob,
+    e.g. staging -> \"async window (async)\"."""
+    field = MEM_COMPONENT.get(category)
+    if field is None:
+        return category
+    return f"{component_name(field)} ({field})"
 
 
 def diff_feed_signature(old, new):

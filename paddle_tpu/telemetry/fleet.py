@@ -676,6 +676,21 @@ class FleetCollector:
                         and ent.get("kind") != "histogram":
                     serving_traces[name[len("serving.trace."):]] = \
                         ent["value"]
+            # device-memory ledger (telemetry/memledger): memledger.*
+            # gauges (total/peak + bytes.<category>) plus the raw
+            # device.<platform:id>.* watermarks → one memory dict per
+            # rank (the tpustat hbm/peak columns)
+            memory = {}
+            for name, ent in m.items():
+                if name.startswith("memledger.") \
+                        and ent.get("kind") != "histogram":
+                    memory[name[len("memledger."):]] = ent["value"]
+            dev_in_use = [ent["value"] for name, ent in m.items()
+                          if name.startswith("device.")
+                          and name.endswith(".bytes_in_use")]
+            dev_peak = [ent["value"] for name, ent in m.items()
+                        if name.startswith("device.")
+                        and name.endswith(".peak_bytes_in_use")]
             per_rank[str(r)] = {
                 "steps": h["count"] if h else 0,
                 "step_seconds_mean": (h["sum"] / h["count"])
@@ -707,6 +722,16 @@ class FleetCollector:
                 "serving_tokens_total": sum(
                     int(d.get("tokens_total", 0))
                     for d in serving_replicas.values()),
+                "memory": memory,
+                # rank HBM truth for the fleet columns: ledger bytes
+                # when the rank ran one, allocator watermarks when the
+                # backend reports them, whichever is larger
+                "hbm_bytes": max(
+                    [int(memory.get("total_bytes", 0))]
+                    + [int(v) for v in dev_in_use]) or None,
+                "hbm_peak_bytes": max(
+                    [int(memory.get("peak_bytes", 0))]
+                    + [int(v) for v in dev_peak]) or None,
                 # tpuscope attribution gauges, when the rank ran with
                 # the attribution layer live
                 "mfu": _rank_gauge(m, "perf.mfu"),

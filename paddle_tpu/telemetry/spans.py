@@ -20,7 +20,7 @@ import time
 
 __all__ = ["span", "iter_spans", "clear_spans", "chrome_trace",
            "write_chrome_trace", "merge_device_ops", "SpanRecord",
-           "now_us", "append_span", "instant_event"]
+           "now_us", "append_span", "instant_event", "counter_event"]
 
 _EPOCH_NS = time.perf_counter_ns()
 _MAX_SPANS = 200_000
@@ -68,6 +68,15 @@ def instant_event(name, cat="instant", **args):
     rendered as a Chrome instant ("i") event — a vertical tick on the
     timeline rather than a bar. No-op when telemetry is disabled."""
     return append_span(name, cat=cat, dur_us=0.0, args=args or None)
+
+
+def counter_event(name, values, ts_us=None, track="memory"):
+    """Sampled counter values (per-step HBM bytes by ledger category)
+    rendered as a Chrome counter ("C") event — a stacked area track in
+    Perfetto. `values` is {series_name: number}. No-op when telemetry
+    is disabled."""
+    return append_span(name, cat="counter", ts_us=ts_us, dur_us=0.0,
+                       tid=track, args=dict(values))
 
 
 class _Span:
@@ -170,6 +179,13 @@ def chrome_trace():
     tids = set()
     for s in spans:
         tids.add(s.tid)
+        if s.cat == "counter":
+            # counter ("C") events: args ARE the series values — no
+            # depth key, or Perfetto would chart it as a series
+            events.append({"name": s.name, "cat": s.cat, "ph": "C",
+                           "ts": s.ts_us, "pid": pid, "tid": s.tid,
+                           "args": dict(s.args) if s.args else {}})
+            continue
         if s.cat == "instant":
             ev = {"name": s.name, "cat": s.cat, "ph": "i",
                   "ts": s.ts_us, "s": "t", "pid": pid, "tid": s.tid}

@@ -349,6 +349,65 @@ def test_scale_off_paths_untouched():
     assert "SCALE_OFF_OK" in p.stdout
 
 
+def test_memledger_off_paths_untouched():
+    """tpumem's off contract (the bench-contract pin): with
+    PADDLE_TPU_MEMLEDGER unset, training steps and serving a request
+    through the farm never import telemetry.memledger — every seam is
+    one bool check — and flipping the ledger on decodes byte-identical
+    tokens (measurement must never perturb the measured)."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import telemetry as tm\n"
+        "from paddle_tpu.core import framework as fw\n"
+        "from paddle_tpu.models import transformer as tfm\n"
+        "from paddle_tpu.serving.farm import FarmConfig, ReplicaGroup\n"
+        "from paddle_tpu.serving.decode import (DecodeConfig,"
+        " DecodeEngineConfig)\n"
+        "assert tm.memledger_enabled() is False\n"
+        "cfg = tfm.TransformerConfig(src_vocab=16, trg_vocab=16,"
+        " max_len=8, d_model=8, d_inner=16, n_head=2, n_layer=1,"
+        " dropout=0.0, label_smooth_eps=0.0)\n"
+        "infer, start = fw.Program(), fw.Program()\n"
+        "with pt.program_guard(infer, start):\n"
+        "    with pt.unique_name.guard():\n"
+        "        tfm.build_infer_program(cfg, maxlen=8)\n"
+        "pt.Executor(pt.CPUPlace()).run(start)\n"
+        "scope = pt.global_scope()\n"
+        "params = {v.name: np.asarray(scope.get(v.name))"
+        " for v in infer.persistable_vars()}\n"
+        "group = ReplicaGroup(cfg, params, FarmConfig(replicas=1,"
+        " engine=DecodeEngineConfig(num_slots=2, max_len=8,"
+        " prefill_buckets=(1, 2)),"
+        " decode=DecodeConfig(bos=0)), name='unmetered')\n"
+        "def run(rid):\n"
+        "    fut = group.submit(np.arange(2, 6).astype('int64'),"
+        " src_len=4, max_new_tokens=3, request_id=rid)\n"
+        "    for _ in range(60):\n"
+        "        if fut.done():\n"
+        "            break\n"
+        "        group.run_iteration()\n"
+        "    return np.asarray(fut.result(timeout=0).tokens,"
+        " np.int64)\n"
+        "off = run('m-off')\n"
+        "assert 'paddle_tpu.telemetry.memledger' not in sys.modules, "
+        "'ledger-off serving imported the memory ledger'\n"
+        "tm.memledger_enable()\n"
+        "on = run('m-on')\n"
+        "assert off.tobytes() == on.tobytes(), "
+        "'the memory ledger changed the decoded bytes'\n"
+        "print('MEMLEDGER_OFF_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_MEMLEDGER", None)
+    env.pop("PADDLE_TPU_DEVICE_MEM_CAP", None)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=REPO)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-1200:])
+    assert "MEMLEDGER_OFF_OK" in p.stdout
+
+
 def test_sparse_engine_off_paths_untouched():
     """tpusparse's off contract (the bench-contract pin): without a
     distributed table — or with one but no sparse= opt-in — the engine

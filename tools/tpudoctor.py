@@ -54,6 +54,8 @@ def format_dump(payload):
     if records:
         cols = ("step", "loss", "grad_norm", "update_ratio", "step_s",
                 "compile", "program")
+        if any("hbm" in r for r in records):
+            cols += ("hbm",)    # memory-ledger runs watermark the ring
         lines.append("last steps:")
         lines.append("  " + "  ".join(f"{c:>12}" for c in cols))
         for r in records[-12:]:

@@ -279,7 +279,8 @@ def _print_fleet_table(rep):
            f"{'mfu%':>6} "
            f"{'coll#':>6} {'coll_KB':>8} {'bubble%':>8} "
            f"{'gs_raw_KB':>10} {'gs_wire_KB':>11} {'gs_x':>6} "
-           f"{'emb_rows':>9} {'uniq%':>6} {'exch_KB':>8}  verdict")
+           f"{'emb_rows':>9} {'uniq%':>6} {'exch_KB':>8} "
+           f"{'hbm_MB':>8} {'peak_MB':>8}  verdict")
     print(hdr)
     for r in rep["ranks"]:
         pr = rep["per_rank"][str(r)]
@@ -288,6 +289,8 @@ def _print_fleet_table(rep):
         ratio = pr.get("gradsync_ratio")
         uniq = pr.get("embed_unique_ratio")
         mfu = pr.get("mfu")
+        hbm = pr.get("hbm_bytes")
+        hbm_pk = pr.get("hbm_peak_bytes")
         print(f"  {r:<5} {str(pr.get('hostname') or '-')[:12]:<12} "
               f"{pr['steps']:>5} "
               f"{(mean * 1e3 if mean else 0):>9.2f} "
@@ -300,7 +303,9 @@ def _print_fleet_table(rep):
               f"{(f'{ratio:.2f}' if ratio else '-'):>6} "
               f"{pr.get('embed_rows', 0):>9} "
               f"{(f'{uniq * 100:.1f}' if uniq is not None else '-'):>6} "
-              f"{pr.get('embed_exchange_bytes', 0) / 1024:>8.1f}  "
+              f"{pr.get('embed_exchange_bytes', 0) / 1024:>8.1f} "
+              f"{(f'{hbm / 1e6:.1f}' if hbm else '-'):>8} "
+              f"{(f'{hbm_pk / 1e6:.1f}' if hbm_pk else '-'):>8}  "
               f"{'STRAGGLER' if r in flagged else 'ok'}")
     if rep["collectives"]:
         parts = [f"{op} x{d.get('count', 0)} "
@@ -816,6 +821,17 @@ def _watch_header(rep):
         mixs = " ".join(f"{k}={v}" for k, v in sorted(mix.items()))
         lines.append(f"  traces: {kept}/{seen} kept"
                      + (f" ({mixs})" if mixs else ""))
+    # memory-ledger rollup (PR-20): worst rank's live and peak HBM,
+    # from the per-rank memledger.* / device.* gauges
+    hbms = [(int(pr.get("hbm_bytes") or 0),
+             int(pr.get("hbm_peak_bytes") or 0), r)
+            for r, pr in rep.get("per_rank", {}).items()
+            if pr.get("hbm_bytes") or pr.get("hbm_peak_bytes")]
+    if hbms:
+        cur, pk, worst = max(hbms, key=lambda t: t[1] or t[0])
+        lines.append(f"  hbm: {cur / 1e6:.1f} MB live, "
+                     f"{pk / 1e6:.1f} MB peak "
+                     f"(worst rank {worst}, {len(hbms)} reporting)")
     return "\n".join(lines)
 
 
